@@ -1,0 +1,26 @@
+"""bigdl_tpu — a TPU-native deep-learning framework with the capabilities of
+BigDL (reference: yiheng/BigDL, a fork of Intel's Spark-based BigDL 0.x).
+
+Not a port: the reference's Scala/JVM + MKL JNI + Spark-BlockManager design is
+rebuilt idiomatically on JAX/XLA — modules are pure ``init/apply`` pairs under
+a BigDL-style stateful facade, training steps compile to single SPMD programs
+via ``jax.jit`` over a ``jax.sharding.Mesh``, and the distributed gradient
+plane is XLA collectives (``psum`` / ``psum_scatter`` + ``all_gather``) over
+ICI instead of Spark BlockManager shuffles.
+
+Layer map (mirrors SURVEY.md §1):
+    bigdl_tpu.tensor        — Tensor facade over jax.Array        (ref L1)
+    bigdl_tpu.nn            — Module/Criterion/layers/Graph       (ref L2)
+    bigdl_tpu.optim         — Optimizer/OptimMethod/Trigger/...   (ref L3)
+    bigdl_tpu.dataset       — DataSet/Transformer/Sample/...      (ref L4)
+    bigdl_tpu.models        — model zoo                           (ref L6)
+    bigdl_tpu.parallel      — distributed parameter plane         (ref L7)
+    bigdl_tpu.utils         — Engine/Table/File/RNG               (ref L8)
+    bigdl_tpu.visualization — TrainSummary/ValidationSummary      (ref L10)
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.utils.engine import Engine, EngineType
+
+__all__ = ["Engine", "EngineType", "__version__"]

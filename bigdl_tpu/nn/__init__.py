@@ -1,0 +1,38 @@
+"""bigdl_tpu.nn — the NN module library (reference layer L2, SURVEY.md §2.2)."""
+
+from bigdl_tpu.nn.module import AbstractModule, TensorModule, Identity, Echo
+from bigdl_tpu.nn.containers import (
+    Container, Sequential, Concat, ConcatTable, ParallelTable, MapTable, Bottle,
+)
+from bigdl_tpu.nn.graph import Graph, StaticGraph, Input, ModuleNode
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.conv import SpatialConvolution, SpatialFullConvolution
+from bigdl_tpu.nn.pooling import SpatialMaxPooling, SpatialAveragePooling
+from bigdl_tpu.nn.normalization import (
+    BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN, Normalize,
+)
+from bigdl_tpu.nn.activations import (
+    ReLU, ReLU6, Tanh, Sigmoid, SoftMax, LogSoftMax, PReLU, ELU, LeakyReLU,
+    HardTanh, SoftPlus, SoftSign, GELU,
+)
+from bigdl_tpu.nn.shape_ops import (
+    Reshape, View, Select, Narrow, Squeeze, Unsqueeze, Transpose, Contiguous,
+    Padding, CAddTable, CMulTable, CSubTable, CDivTable, JoinTable, SplitTable,
+    FlattenTable,
+)
+from bigdl_tpu.nn.misc import (
+    Dropout, LookupTable, MulConstant, AddConstant, Power, Square, Sqrt, Abs,
+    Log, Exp, Clamp, Mean, Sum, Max, Min, MM, MV, Mul, Add, CMul, CAdd,
+)
+from bigdl_tpu.nn.criterion import (
+    AbstractCriterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,
+    AbsCriterion, BCECriterion, SmoothL1Criterion, MultiLabelSoftMarginCriterion,
+    ParallelCriterion, TimeDistributedCriterion, MarginCriterion,
+    DistKLDivCriterion,
+)
+from bigdl_tpu.nn.init_methods import (
+    InitializationMethod, Zeros, Ones, ConstInitMethod, RandomUniform,
+    RandomNormal, Xavier, MsraFiller, BilinearFiller,
+)
+
+Module = AbstractModule  # reference alias: ``Module.load`` etc.

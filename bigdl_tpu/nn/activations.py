@@ -1,0 +1,148 @@
+"""Activation layers.
+
+Reference (UNVERIFIED, SURVEY.md §0): one class per file under
+``.../bigdl/nn/`` — ``ReLU`` (optionally in-place), ``Tanh``, ``Sigmoid``,
+``SoftMax``, ``LogSoftMax``, ``PReLU``, ``ELU``, ``HardTanh``, ``LeakyReLU``,
+``SoftPlus``, ``SoftSign``.
+
+TPU-native: pure elementwise jnp ops; XLA fuses them into the surrounding
+matmul/conv so "in-place" (a memory-traffic optimization on the JVM heap)
+has no meaning here — the flag is accepted and ignored.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class ReLU(TensorModule):
+    def __init__(self, ip: bool = False) -> None:
+        super().__init__()
+        self.inplace = ip  # accepted for parity; fusion makes it moot
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.maximum(input, 0.0), state
+
+
+class ReLU6(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.clip(input, 0.0, 6.0), state
+
+
+class Tanh(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.tanh(input), state
+
+
+class Sigmoid(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.sigmoid(input), state
+
+
+class SoftMax(TensorModule):
+    """Softmax over the feature dim (last for 1/2-D, channel for 3/4-D)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        axis = -1 if input.ndim <= 2 else 1
+        return jax.nn.softmax(input, axis=axis), state
+
+
+class LogSoftMax(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.log_softmax(input, axis=-1), state
+
+
+class PReLU(TensorModule):
+    def __init__(self, n_output_plane: int = 0) -> None:
+        super().__init__()
+        self.n_output_plane = n_output_plane  # 0 = single shared alpha
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        n = self.n_output_plane if self.n_output_plane > 0 else 1
+        return {"weight": jnp.full((n,), 0.25)}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        w = params["weight"]
+        if self.n_output_plane > 0 and input.ndim >= 3:
+            w = w[None, :, None, None] if input.ndim == 4 else w[:, None, None]
+        elif self.n_output_plane > 0 and input.ndim == 2:
+            w = w[None, :]
+        return jnp.where(input > 0, input, w * input), state
+
+
+class ELU(TensorModule):
+    def __init__(self, alpha: float = 1.0, inplace: bool = False) -> None:
+        super().__init__()
+        self.alpha = alpha
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.where(input > 0, input, self.alpha * (jnp.exp(input) - 1.0)), state
+
+
+class LeakyReLU(TensorModule):
+    def __init__(self, negval: float = 0.01, inplace: bool = False) -> None:
+        super().__init__()
+        self.negval = negval
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.where(input > 0, input, self.negval * input), state
+
+
+class HardTanh(TensorModule):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 inplace: bool = False) -> None:
+        super().__init__()
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.clip(input, self.min_value, self.max_value), state
+
+
+class SoftPlus(TensorModule):
+    def __init__(self, beta: float = 1.0) -> None:
+        super().__init__()
+        self.beta = beta
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.softplus(self.beta * input) / self.beta, state
+
+
+class SoftSign(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return input / (1.0 + jnp.abs(input)), state
+
+
+class GELU(TensorModule):
+    """Not in the 0.x reference; provided for the transformer extension path."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.gelu(input), state

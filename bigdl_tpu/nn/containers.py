@@ -1,0 +1,192 @@
+"""Containers — Sequential, Concat, ConcatTable, ParallelTable, MapTable.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/Container.scala``,
+``Sequential.scala``, ``Concat.scala`` — containers hold ``modules:
+ArrayBuffer`` and compose child forward/backward calls.
+
+TPU-native redesign: a container's ``init_params`` builds a nested dict
+pytree keyed by ``"{index}:{child-name}"`` and its ``apply`` composes child
+``apply`` calls — the whole tree traces into ONE XLA computation, so
+containers are zero-cost at runtime (no per-layer dispatch like the
+reference's JVM virtual calls into MKL).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from bigdl_tpu.nn.module import AbstractModule
+
+
+class Container(AbstractModule):
+    def __init__(self) -> None:
+        super().__init__()
+        self.modules: List[AbstractModule] = []
+
+    def add(self, module: AbstractModule) -> "Container":
+        self.modules.append(module)
+        return self
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return list(self.modules)
+
+    def _child_key(self, i: int) -> str:
+        return f"{i}:{self.modules[i].name}"
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        import jax
+
+        out = {}
+        for i, m in enumerate(self.modules):
+            out[self._child_key(i)] = m.init_params(jax.random.fold_in(rng, i))
+        return out
+
+    def init_state(self) -> Dict[str, Any]:
+        return {self._child_key(i): m.init_state() for i, m in enumerate(self.modules)}
+
+    def _child_rng(self, rng, i: int):
+        if rng is None:
+            return None
+        import jax
+
+        return jax.random.fold_in(rng, i)
+
+    def __len__(self) -> int:
+        return len(self.modules)
+
+    def __getitem__(self, i: int) -> AbstractModule:
+        return self.modules[i]
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference ``nn/Sequential.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        state = state or {}
+        new_state = {}
+        x = input
+        for i, m in enumerate(self.modules):
+            k = self._child_key(i)
+            x, s = m.apply(
+                params.get(k, {}), x, state.get(k, {}),
+                training=training, rng=self._child_rng(rng, i),
+            )
+            new_state[k] = s
+        return x, new_state
+
+    def __repr__(self) -> str:
+        inner = " -> ".join(type(m).__name__ for m in self.modules)
+        return f"Sequential({inner})"
+
+
+class Concat(Container):
+    """Apply every child to the same input, concatenate outputs along
+    ``dimension`` (1-based, reference ``nn/Concat.scala``). Inception's
+    building block."""
+
+    def __init__(self, dimension: int) -> None:
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        state = state or {}
+        new_state = {}
+        outs = []
+        for i, m in enumerate(self.modules):
+            k = self._child_key(i)
+            o, s = m.apply(
+                params.get(k, {}), input, state.get(k, {}),
+                training=training, rng=self._child_rng(rng, i),
+            )
+            outs.append(o)
+            new_state[k] = s
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+
+class ConcatTable(Container):
+    """Apply every child to the same input; output is the list of results
+    (reference ``nn/ConcatTable.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        state = state or {}
+        new_state = {}
+        outs = []
+        for i, m in enumerate(self.modules):
+            k = self._child_key(i)
+            o, s = m.apply(
+                params.get(k, {}), input, state.get(k, {}),
+                training=training, rng=self._child_rng(rng, i),
+            )
+            outs.append(o)
+            new_state[k] = s
+        return outs, new_state
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th element of the input list
+    (reference ``nn/ParallelTable.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        state = state or {}
+        new_state = {}
+        outs = []
+        for i, m in enumerate(self.modules):
+            k = self._child_key(i)
+            o, s = m.apply(
+                params.get(k, {}), input[i], state.get(k, {}),
+                training=training, rng=self._child_rng(rng, i),
+            )
+            outs.append(o)
+            new_state[k] = s
+        return outs, new_state
+
+
+class MapTable(Container):
+    """One shared child applied to every element of the input list
+    (reference ``nn/MapTable.scala``). Parameters are shared across
+    applications by construction (same pytree)."""
+
+    def __init__(self, module: AbstractModule = None) -> None:
+        super().__init__()
+        if module is not None:
+            self.add(module)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        state = state or {}
+        m = self.modules[0]
+        k = self._child_key(0)
+        outs = []
+        s = state.get(k, {})
+        for i, el in enumerate(input):
+            o, s = m.apply(
+                params.get(k, {}), el, s,
+                training=training, rng=self._child_rng(rng, i),
+            )
+            outs.append(o)
+        return outs, {k: s}
+
+
+class Bottle(Container):
+    """Reshape leading dims into one batch dim, apply child, restore
+    (reference ``nn/Bottle.scala``; default nInputDim=2)."""
+
+    def __init__(self, module: AbstractModule, n_input_dim: int = 2, n_output_dim: int = 2) -> None:
+        super().__init__()
+        self.add(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        state = state or {}
+        k = self._child_key(0)
+        shape = input.shape
+        lead = shape[: len(shape) - self.n_input_dim + 1]
+        rest = shape[len(shape) - self.n_input_dim + 1:]
+        flat = input.reshape((-1,) + rest)
+        out, s = self.modules[0].apply(
+            params.get(k, {}), flat, state.get(k, {}), training=training, rng=rng
+        )
+        out = out.reshape(lead + out.shape[1:])
+        return out, {k: s}

@@ -1,0 +1,193 @@
+"""Spatial convolutions.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/SpatialConvolution.scala``
+— im2col into per-thread ``fInput`` buffers followed by an MKL gemm; weight
+laid out ``(nGroup, out/g, in/g, kH, kW)``; argument order is
+``(nInputPlane, nOutputPlane, kW, kH, dW, dH, padW, padH, nGroup,
+propagateBack)`` (width before height, Torch style).
+
+TPU-native redesign: im2col disappears entirely — ``lax.conv_general_dilated``
+lowers to the MXU's native convolution path, which is the whole point of the
+TPU engine (SURVEY.md §7: "im2col-free conv comes from XLA itself"). Weight
+is stored OIHW (groups folded into O) and grouping uses XLA's
+``feature_group_count``. Activations use NCHW dimension numbers for
+reference semantic parity (weight/bias shapes, Reshape arithmetic); XLA's
+layout assignment re-tiles internally for the hardware.
+
+``padW = padH = -1`` selects SAME padding, as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomUniform
+from bigdl_tpu.nn.module import TensorModule
+
+
+class SpatialConvolution(TensorModule):
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        n_group: int = 1,
+        propagate_back: bool = True,
+        with_bias: bool = True,
+        w_regularizer=None,
+        b_regularizer=None,
+        init_weight: Optional[InitializationMethod] = None,
+        init_bias: Optional[InitializationMethod] = None,
+    ) -> None:
+        super().__init__()
+        assert n_input_plane % n_group == 0, "input planes must divide groups"
+        assert n_output_plane % n_group == 0, "output planes must divide groups"
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.n_group = n_group
+        self.propagate_back = propagate_back
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None) -> "SpatialConvolution":
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        w_shape = (
+            self.n_output_plane,
+            self.n_input_plane // self.n_group,
+            self.kernel_h,
+            self.kernel_w,
+        )
+        p = {"weight": self.weight_init.init(k1, w_shape)}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.n_output_plane,))
+        return p
+
+    def _padding(self):
+        if self.pad_w == -1 or self.pad_h == -1:
+            return "SAME"
+        return ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w))
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze_batch = input.ndim == 3
+        x = input[None] if squeeze_batch else input
+        out = lax.conv_general_dilated(
+            x,
+            params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=self._padding(),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze_batch:
+            out = out[0]
+        return out, state
+
+    def __repr__(self) -> str:
+        return (
+            f"SpatialConvolution({self.n_input_plane} -> {self.n_output_plane}, "
+            f"{self.kernel_w}x{self.kernel_h}, {self.stride_w}x{self.stride_h}, "
+            f"{self.pad_w},{self.pad_h})"
+        )
+
+
+class SpatialFullConvolution(TensorModule):
+    """Transposed convolution (reference ``nn/SpatialFullConvolution.scala``);
+    used by segmentation-style models and ``BilinearFiller`` upsampling."""
+
+    def __init__(
+        self,
+        n_input_plane: int,
+        n_output_plane: int,
+        kernel_w: int,
+        kernel_h: int,
+        stride_w: int = 1,
+        stride_h: int = 1,
+        pad_w: int = 0,
+        pad_h: int = 0,
+        adj_w: int = 0,
+        adj_h: int = 0,
+        n_group: int = 1,
+        no_bias: bool = False,
+        init_weight: Optional[InitializationMethod] = None,
+        init_bias: Optional[InitializationMethod] = None,
+    ) -> None:
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.adj_w = adj_w
+        self.adj_h = adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        # IOHW layout for transposed conv (input planes lead, reference-style)
+        w_shape = (
+            self.n_input_plane,
+            self.n_output_plane // self.n_group,
+            self.kernel_h,
+            self.kernel_w,
+        )
+        p = {"weight": self.weight_init.init(k1, w_shape)}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.n_output_plane,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze_batch = input.ndim == 3
+        x = input[None] if squeeze_batch else input
+        out = lax.conv_transpose(
+            x,
+            params["weight"],
+            strides=(self.stride_h, self.stride_w),
+            padding=(
+                (self.pad_h, self.pad_h - self.adj_h),
+                (self.pad_w, self.pad_w - self.adj_w),
+            ),
+            dimension_numbers=("NCHW", "IOHW", "NCHW"),
+            transpose_kernel=True,
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        if squeeze_batch:
+            out = out[0]
+        return out, state

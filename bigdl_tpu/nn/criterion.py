@@ -1,0 +1,259 @@
+"""Criterions (loss functions).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/abstractnn/AbstractCriterion.scala``
+plus one class per criterion file — ``ClassNLLCriterion``,
+``CrossEntropyCriterion``, ``MSECriterion``, ``AbsCriterion``,
+``BCECriterion``, ``SmoothL1Criterion``, ``MultiLabelSoftMarginCriterion``,
+``ParallelCriterion``, ``TimeDistributedCriterion``.
+
+Conventions kept for parity: **class labels are 1-based floats** (the Torch
+heritage the reference keeps); ``size_average=True`` divides by batch size.
+
+TPU-native: a criterion is one pure scalar function ``apply(input, target)``;
+the facade ``forward``/``backward`` mirrors the reference contract, with
+``backward`` = ``jax.grad`` w.r.t. the input. Optimizers jit
+``criterion.apply`` straight into the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+def _unwrap(x):
+    from bigdl_tpu.nn.module import _unwrap_activity
+
+    return _unwrap_activity(x)
+
+
+class AbstractCriterion:
+    def __init__(self) -> None:
+        self.output: float = 0.0
+        self.grad_input: Any = None
+
+    def apply(self, input, target):
+        """Pure scalar loss."""
+        raise NotImplementedError
+
+    def forward(self, input, target) -> float:
+        out = self.apply(_unwrap(input), _unwrap(target))
+        self.output = float(out)
+        return self.output
+
+    __call__ = forward
+
+    def backward(self, input, target):
+        import jax
+
+        x = _unwrap(input)
+        t = _unwrap(target)
+        self.grad_input = jax.grad(lambda i: self.apply(i, t))(x)
+        return self.grad_input
+
+    # reference aliases
+    def update_output(self, input, target) -> float:
+        return self.forward(input, target)
+
+    def update_grad_input(self, input, target):
+        return self.backward(input, target)
+
+
+class ClassNLLCriterion(AbstractCriterion):
+    """Negative log-likelihood over log-probability input (N, C) with 1-based
+    integer class targets (N,). ``logProbAsInput=False`` applies log first."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 log_prob_as_input: bool = True) -> None:
+        super().__init__()
+        self.weights = weights
+        self.size_average = size_average
+        self.log_prob_as_input = log_prob_as_input
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        logp = input if self.log_prob_as_input else jnp.log(input + 1e-8)
+        if logp.ndim == 1:
+            logp = logp[None]
+            target = jnp.reshape(target, (1,))
+        idx = jnp.asarray(target).astype(jnp.int32).reshape(-1) - 1
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(jnp.asarray(self.weights), idx)
+            loss = -jnp.sum(picked * w)
+            return loss / jnp.sum(w) if self.size_average else loss
+        loss = -jnp.sum(picked)
+        return loss / picked.shape[0] if self.size_average else loss
+
+
+class CrossEntropyCriterion(AbstractCriterion):
+    """LogSoftMax + ClassNLL fused (reference ``CrossEntropyCriterion.scala``).
+    Fusing here also gives the numerically-stable logsumexp form."""
+
+    def __init__(self, weights=None, size_average: bool = True) -> None:
+        super().__init__()
+        self.weights = weights
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax
+
+        logp = jax.nn.log_softmax(input, axis=-1)
+        return ClassNLLCriterion(self.weights, self.size_average).apply(logp, target)
+
+
+class MSECriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        se = jnp.sum((input - target) ** 2)
+        return se / input.size if self.size_average else se
+
+
+class AbsCriterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        ae = jnp.sum(jnp.abs(input - target))
+        return ae / input.size if self.size_average else ae
+
+
+class BCECriterion(AbstractCriterion):
+    def __init__(self, weights=None, size_average: bool = True) -> None:
+        super().__init__()
+        self.weights = weights
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1.0 - eps)
+        ll = target * jnp.log(x) + (1.0 - target) * jnp.log(1.0 - x)
+        if self.weights is not None:
+            ll = ll * jnp.asarray(self.weights)
+        loss = -jnp.sum(ll)
+        return loss / input.size if self.size_average else loss
+
+
+class SmoothL1Criterion(AbstractCriterion):
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        d = jnp.abs(input - target)
+        loss = jnp.sum(jnp.where(d < 1.0, 0.5 * d * d, d - 0.5))
+        return loss / input.size if self.size_average else loss
+
+
+class MultiLabelSoftMarginCriterion(AbstractCriterion):
+    def __init__(self, weights=None, size_average: bool = True) -> None:
+        super().__init__()
+        self.weights = weights
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax
+
+        import jax.numpy as jnp
+
+        logsig = jax.nn.log_sigmoid(input)
+        logsig_neg = jax.nn.log_sigmoid(-input)
+        ll = target * logsig + (1.0 - target) * logsig_neg
+        if self.weights is not None:
+            ll = ll * jnp.asarray(self.weights)
+        n = input.shape[0] if input.ndim > 1 else 1
+        c = input.shape[-1]
+        loss = -jnp.sum(ll) / c
+        return loss / n if self.size_average else loss
+
+
+class ParallelCriterion(AbstractCriterion):
+    """Weighted sum of criterions over a table of (input, target) pairs
+    (reference ``ParallelCriterion.scala``)."""
+
+    def __init__(self, repeat_target: bool = False) -> None:
+        super().__init__()
+        self.criterions: List[AbstractCriterion] = []
+        self.crit_weights: List[float] = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append(criterion)
+        self.crit_weights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.crit_weights)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.apply(input[i], t)
+        return total
+
+
+class TimeDistributedCriterion(AbstractCriterion):
+    """Apply a criterion at every time step of (N, T, ...) input
+    (reference ``TimeDistributedCriterion.scala``)."""
+
+    def __init__(self, critrn: AbstractCriterion, size_average: bool = False,
+                 dimension: int = 2) -> None:
+        super().__init__()
+        self.critrn = critrn
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        ax = self.dimension - 1
+        steps = input.shape[ax]
+        total = 0.0
+        for t in range(steps):
+            xi = jnp.take(input, t, axis=ax)
+            ti = jnp.take(target, t, axis=ax) if target.ndim > ax else target
+            total = total + self.critrn.apply(xi, ti)
+        return total / steps if self.size_average else total
+
+
+class MarginCriterion(AbstractCriterion):
+    """Hinge loss (reference ``MarginCriterion.scala``); targets ±1."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True) -> None:
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        loss = jnp.sum(jnp.maximum(0.0, self.margin - input * target))
+        return loss / input.size if self.size_average else loss
+
+
+class DistKLDivCriterion(AbstractCriterion):
+    """KL divergence with log-prob input (reference ``DistKLDivCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        t = jnp.asarray(target)
+        contrib = jnp.where(t > 0, t * (jnp.log(jnp.where(t > 0, t, 1.0)) - input), 0.0)
+        loss = jnp.sum(contrib)
+        return loss / input.size if self.size_average else loss

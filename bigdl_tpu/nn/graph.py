@@ -1,0 +1,142 @@
+"""Graph — functional DAG API.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/Graph.scala`` /
+``StaticGraph.scala`` + ``utils/Node.scala`` — ``module.inputs(prevNodes...)``
+builds edges, ``Graph(input, output)`` topologically sorts and executes. The
+ResNet/Inception zoo is built on this.
+
+TPU-native: the DAG is walked once at trace time inside ``apply``; XLA sees a
+flat computation, so graph execution order costs nothing at runtime. Shared
+modules (same instance at several nodes) naturally share one params subtree —
+keyed by module name — which reproduces the reference's weight-sharing
+semantics without its clone/share machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Union
+
+from bigdl_tpu.nn.module import AbstractModule, Identity
+
+
+class ModuleNode:
+    """DAG node: a module plus its predecessor nodes (reference ``Node``)."""
+
+    def __init__(self, module: AbstractModule, prev: Sequence["ModuleNode"] = ()) -> None:
+        self.module = module
+        self.prev: List[ModuleNode] = list(prev)
+
+    def __repr__(self) -> str:
+        return f"Node({self.module.name})"
+
+
+def Input() -> ModuleNode:
+    """Placeholder input node (reference ``Input()``)."""
+    return ModuleNode(Identity().set_name(f"Input{id(object())%100000}"), ())
+
+
+def _inputs(self: AbstractModule, *nodes: ModuleNode) -> ModuleNode:
+    """``module.inputs(n1, n2, ...)`` — attach and return this module's node."""
+    return ModuleNode(self, nodes)
+
+
+AbstractModule.inputs = _inputs  # reference API: module.inputs(...)
+
+
+def _as_list(x) -> List[Any]:
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class Graph(AbstractModule):
+    def __init__(
+        self,
+        input: Union[ModuleNode, Sequence[ModuleNode]],
+        output: Union[ModuleNode, Sequence[ModuleNode]],
+    ) -> None:
+        super().__init__()
+        self.input_nodes = _as_list(input)
+        self.output_nodes = _as_list(output)
+        self._single_input = not isinstance(input, (list, tuple))
+        self._single_output = not isinstance(output, (list, tuple))
+        self.topo: List[ModuleNode] = self._topo_sort()
+        # one params subtree per distinct module (shared nodes share params)
+        self._module_keys: Dict[int, str] = {}
+        seen: Dict[int, AbstractModule] = {}
+        for node in self.topo:
+            mid = id(node.module)
+            if mid not in seen:
+                seen[mid] = node.module
+                self._module_keys[mid] = f"{len(seen) - 1}:{node.module.name}"
+        self._distinct_modules = list(seen.values())
+
+    def _topo_sort(self) -> List[ModuleNode]:
+        order: List[ModuleNode] = []
+        visited: Dict[int, int] = {}  # 0=visiting, 1=done
+
+        def visit(n: ModuleNode) -> None:
+            vid = id(n)
+            if visited.get(vid) == 1:
+                return
+            if visited.get(vid) == 0:
+                raise ValueError("Graph contains a cycle")
+            visited[vid] = 0
+            for p in n.prev:
+                visit(p)
+            visited[vid] = 1
+            order.append(n)
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if id(inp) not in visited:
+                raise ValueError(f"input node {inp} is not connected to any output")
+        return order
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return list(self._distinct_modules)
+
+    def init_params(self, rng):
+        import jax
+
+        out = {}
+        for i, m in enumerate(self._distinct_modules):
+            out[self._module_keys[id(m)]] = m.init_params(jax.random.fold_in(rng, i))
+        return out
+
+    def init_state(self):
+        return {self._module_keys[id(m)]: m.init_state() for m in self._distinct_modules}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        state = state or {}
+        new_state = dict(state)
+        values: Dict[int, Any] = {}
+        inputs = _as_list(input) if not self._single_input else [input]
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"graph expects {len(self.input_nodes)} inputs, got {len(inputs)}"
+            )
+        for node, val in zip(self.input_nodes, inputs):
+            values[id(node)] = val
+        for i, node in enumerate(self.topo):
+            nid = id(node)
+            if nid in values:  # an input node
+                continue
+            args = [values[id(p)] for p in node.prev]
+            x = args[0] if len(args) == 1 else args
+            key = self._module_keys[id(node.module)]
+            child_rng = None if rng is None else jax.random.fold_in(rng, i)
+            out, s = node.module.apply(
+                params.get(key, {}), x, new_state.get(key, {}),
+                training=training, rng=child_rng,
+            )
+            values[nid] = out
+            new_state[key] = s
+        outs = [values[id(n)] for n in self.output_nodes]
+        return (outs[0] if self._single_output else outs), new_state
+
+
+StaticGraph = Graph

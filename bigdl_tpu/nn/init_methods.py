@@ -1,0 +1,134 @@
+"""Initialization methods.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/InitializationMethod.scala``
+— ``RandomUniform``, ``RandomNormal``, ``Xavier``, ``MsraFiller``,
+``BilinearFiller``, ``Zeros``, ``Ones``, ``ConstInitMethod``; layers expose
+``setInitMethod(weightInit, biasInit)``. The ResNet zoo uses MSRA.
+
+Fan computation follows the Torch convention the reference uses: for a conv
+weight of shape (out, in, kH, kW), fan_in = in*kH*kW, fan_out = out*kH*kW;
+for a linear weight (out, in), fan_in = in, fan_out = out.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def _fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    if len(shape) == 2:
+        fan_out, fan_in = shape
+    elif len(shape) >= 3:
+        receptive = int(np.prod(shape[2:]))
+        fan_out = shape[0] * receptive
+        fan_in = shape[1] * receptive
+    elif len(shape) == 1:
+        fan_in = fan_out = shape[0]
+    else:
+        fan_in = fan_out = 1
+    return int(fan_in), int(fan_out)
+
+
+class InitializationMethod:
+    def init(self, rng, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def init(self, rng, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        return jnp.zeros(shape, dtype=dtype)
+
+
+class Ones(InitializationMethod):
+    def init(self, rng, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        return jnp.ones(shape, dtype=dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float) -> None:
+        self.value = value
+
+    def init(self, rng, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """Uniform(lower, upper); no-arg form uses Torch default ±1/sqrt(fan_in)."""
+
+    def __init__(self, lower: float = None, upper: float = None) -> None:
+        self.lower = lower
+        self.upper = upper
+
+    def init(self, rng, shape, dtype="float32"):
+        import jax
+
+        if self.lower is None:
+            fan_in, _ = _fans(shape)
+            bound = 1.0 / np.sqrt(max(fan_in, 1))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, minval=lo, maxval=hi, dtype=dtype)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0) -> None:
+        self.mean = mean
+        self.stdv = stdv
+
+    def init(self, rng, shape, dtype="float32"):
+        import jax
+
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype=dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: ±sqrt(6/(fan_in+fan_out)) — reference ``Xavier``."""
+
+    def init(self, rng, shape, dtype="float32"):
+        import jax
+
+        fan_in, fan_out = _fans(shape)
+        bound = np.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, minval=-bound, maxval=bound, dtype=dtype)
+
+
+class MsraFiller(InitializationMethod):
+    """He init — reference ``MsraFiller(varianceNormAverage)``; N(0, sqrt(2/fan))."""
+
+    def __init__(self, variance_norm_average: bool = True) -> None:
+        self.variance_norm_average = variance_norm_average
+
+    def init(self, rng, shape, dtype="float32"):
+        import jax
+
+        fan_in, fan_out = _fans(shape)
+        n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_in
+        std = np.sqrt(2.0 / max(n, 1.0))
+        return std * jax.random.normal(rng, shape, dtype=dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear-upsampling kernel for deconvolution weights."""
+
+    def init(self, rng, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        if len(shape) < 4:
+            raise ValueError("BilinearFiller needs a 4D+ weight")
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = np.ceil(kh / 2.0), np.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        filt = (1 - np.abs(yy / f_h - c_h)) * (1 - np.abs(xx / f_w - c_w))
+        w = np.zeros(shape, dtype=np.float32)
+        w[..., :, :] = filt
+        return jnp.asarray(w, dtype=dtype)

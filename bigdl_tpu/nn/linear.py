@@ -1,0 +1,63 @@
+"""Linear — fully-connected layer.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/Linear.scala`` — weight
+shape ``(outputSize, inputSize)``, optional bias, gemm via
+``DenseTensorBLAS``/MKL. Here the gemm is ``x @ W.T`` which XLA lowers
+straight onto the MXU; fp32 params with, by default, highest matmul precision
+to keep parity with the reference's fp32 MKL path (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.module import TensorModule
+
+
+class Linear(TensorModule):
+    def __init__(
+        self,
+        input_size: int,
+        output_size: int,
+        with_bias: bool = True,
+        w_regularizer=None,
+        b_regularizer=None,
+        init_weight: Optional[InitializationMethod] = None,
+        init_bias: Optional[InitializationMethod] = None,
+    ) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None) -> "Linear":
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": self.weight_init.init(k1, (self.output_size, self.input_size))}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.output_size,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        out = jnp.matmul(input, params["weight"].T)
+        if self.with_bias:
+            out = out + params["bias"]
+        return out, state
+
+    def __repr__(self) -> str:
+        return f"Linear({self.input_size} -> {self.output_size})"

@@ -1,0 +1,297 @@
+"""Misc layers: Dropout, LookupTable, constants, reductions, MM/MV.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/Dropout.scala``
+(scale-at-train-time), ``LookupTable.scala`` (embedding with optional
+max-norm), ``MulConstant``/``AddConstant``/``Power``/``Square``/``Sqrt``,
+``Mean``/``Max``/``Min``/``Sum``, ``MM``/``MV``, ``Mul``/``Add``/``CMul``/``CAdd``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomNormal
+from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.nn.shape_ops import _axis
+
+
+class Dropout(TensorModule):
+    """Inverted dropout: mask and scale by 1/(1-p) at train time only.
+
+    TPU-native note: the bernoulli mask comes from the functional ``rng``
+    threaded through ``apply`` — no stateful generator, so the train step
+    stays jittable and reproducible.
+    """
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True) -> None:
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float) -> "Dropout":
+        self.p = p
+        return self
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return input, state
+        import jax
+
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, input.shape)
+        out = input * mask
+        if self.scale:
+            out = out / keep
+        return out, state
+
+
+class LookupTable(TensorModule):
+    """Embedding lookup; indices are 1-based like the reference."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = float("inf"), norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False,
+                 init_weight: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = int(padding_value)
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.weight_init = init_weight or RandomNormal(0.0, 1.0)
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        return self
+
+    def init_params(self, rng):
+        return {"weight": self.weight_init.init(rng, (self.n_index, self.n_output))}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        w = params["weight"]
+        if self.max_norm != float("inf"):
+            norms = jnp.sum(jnp.abs(w) ** self.norm_type, axis=1, keepdims=True) ** (
+                1.0 / self.norm_type
+            )
+            w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+        idx = input.astype(jnp.int32) - 1  # 1-based reference indices
+        out = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.padding_value != 0:
+            pad_mask = (input.astype(jnp.int32) == self.padding_value)
+            out = jnp.where(pad_mask[..., None], 0.0, out)
+        return out, state
+
+
+class MulConstant(TensorModule):
+    def __init__(self, scalar: float, inplace: bool = False) -> None:
+        super().__init__()
+        self.scalar = scalar
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input * self.scalar, state
+
+
+class AddConstant(TensorModule):
+    def __init__(self, constant_scalar: float, inplace: bool = False) -> None:
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input + self.constant_scalar, state
+
+
+class Power(TensorModule):
+    """out = (shift + scale * x) ** power (reference ``nn/Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0) -> None:
+        super().__init__()
+        self.power = power
+        self.scale = scale
+        self.shift = shift
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return (self.shift + self.scale * input) ** self.power, state
+
+
+class Square(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input * input, state
+
+
+class Sqrt(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.sqrt(input), state
+
+
+class Abs(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.abs(input), state
+
+
+class Log(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.log(input), state
+
+
+class Exp(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.exp(input), state
+
+
+class Clamp(TensorModule):
+    def __init__(self, min_v: float, max_v: float) -> None:
+        super().__init__()
+        self.min_v = min_v
+        self.max_v = max_v
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.clip(input, self.min_v, self.max_v), state
+
+
+class _Reduction(TensorModule):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True) -> None:
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def _ax(self, input):
+        return _axis(self.dimension, input.ndim, self.n_input_dims)
+
+
+class Mean(_Reduction):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.mean(input, axis=self._ax(input), keepdims=not self.squeeze), state
+
+
+class Sum(_Reduction):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.sum(input, axis=self._ax(input), keepdims=not self.squeeze), state
+
+
+class Max(_Reduction):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.max(input, axis=self._ax(input), keepdims=not self.squeeze), state
+
+
+class Min(_Reduction):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.min(input, axis=self._ax(input), keepdims=not self.squeeze), state
+
+
+class MM(TensorModule):
+    """Batch/plain matmul of a two-tensor table (reference ``nn/MM.scala``)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False) -> None:
+        super().__init__()
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        a, b = input
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), state
+
+
+class MV(TensorModule):
+    def __init__(self, trans: bool = False) -> None:
+        super().__init__()
+        self.trans = trans
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        m, v = input
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), state
+
+
+class Mul(TensorModule):
+    """Learnable scalar gain (reference ``nn/Mul.scala``)."""
+
+    def init_params(self, rng):
+        import jax
+
+        return {"weight": jax.random.uniform(rng, (), minval=-1.0, maxval=1.0)}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class Add(TensorModule):
+    """Learnable bias vector (reference ``nn/Add.scala``)."""
+
+    def __init__(self, input_size: int) -> None:
+        super().__init__()
+        self.input_size = input_size
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        return {"bias": jnp.zeros((self.input_size,))}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class CMul(TensorModule):
+    """Learnable per-element gain with broadcast shape (reference ``nn/CMul.scala``)."""
+
+    def __init__(self, size) -> None:
+        super().__init__()
+        self.size = tuple(size)
+
+    def init_params(self, rng):
+        import jax
+
+        import numpy as np
+
+        fan = max(int(np.prod(self.size)), 1)
+        bound = 1.0 / np.sqrt(fan)
+        return {"weight": jax.random.uniform(rng, self.size, minval=-bound, maxval=bound)}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class CAdd(TensorModule):
+    def __init__(self, size) -> None:
+        super().__init__()
+        self.size = tuple(size)
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        return {"bias": jnp.zeros(self.size)}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input + params["bias"], state

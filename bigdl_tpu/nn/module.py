@@ -1,0 +1,311 @@
+"""AbstractModule — the core layer contract.
+
+Reference role (UNVERIFIED, SURVEY.md §0):
+``.../bigdl/nn/abstractnn/AbstractModule.scala`` — ``forward`` →
+``updateOutput``, ``backward`` → ``updateGradInput`` + ``accGradParameters``,
+``parameters()``, ``zeroGradParameters``, ``training()/evaluate()``; the north
+star requires ``Module.forward`` call sites to stay source-unchanged.
+
+TPU-native redesign — the central architectural decision of this framework:
+
+* Every module is a **pure function pair**: ``init_params(rng) -> params``
+  (a pytree of jax arrays) and
+  ``apply(params, input, state, training, rng) -> (output, new_state)``.
+  ``state`` carries non-learned buffers (BatchNorm running stats, RNN
+  carry defaults); ``rng`` feeds stochastic layers (Dropout). ``apply`` is
+  referentially transparent, so one ``jax.jit`` traces the whole model and
+  XLA fuses it end-to-end — this replaces the reference's per-layer virtual
+  dispatch into MKL JNI.
+
+* The BigDL **stateful facade** (``forward``/``backward``/``parameters``/
+  ``zero_grad_parameters``) is a thin shell over the pure core: the module
+  object owns a ``params`` pytree, a ``grad_params`` accumulator and a
+  ``state`` pytree, and ``backward`` is ``jax.vjp`` of ``apply``. Model-zoo
+  code and per-layer parity tests use the facade; optimizers compile the
+  pure core directly and never touch the facade in the hot loop.
+
+* Mutation-looking reference semantics (in-place ReLU, shared weights,
+  gradient accumulation across backward calls) are reproduced at the facade
+  level only; under jit everything is functional, which deletes the
+  reference's thread-safety sharp edges (SURVEY.md §5.2) by construction.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_instance_counter = itertools.count()
+
+
+def _unwrap_activity(x: Any) -> Any:
+    """Tensor facade / numpy → jax arrays, recursively through Tables/lists."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.tensor import Tensor
+    from bigdl_tpu.utils.table import Table
+
+    if isinstance(x, Tensor):
+        return x.data
+    if isinstance(x, Table):
+        return [_unwrap_activity(v) for v in x.to_list()]
+    if isinstance(x, (list, tuple)):
+        return [_unwrap_activity(v) for v in x]
+    if isinstance(x, (np.ndarray, float, int)):
+        return jnp.asarray(x)
+    return x
+
+
+class AbstractModule:
+    """Base class for every layer, container and graph."""
+
+    def __init__(self) -> None:
+        self.name: str = f"{type(self).__name__}{next(_instance_counter)}"
+        self.train_mode: bool = True
+        # facade storage
+        self.params: Optional[Dict[str, Any]] = None
+        self.grad_params: Optional[Dict[str, Any]] = None
+        self.state: Dict[str, Any] = {}
+        self.output: Any = None
+        self.grad_input: Any = None
+        self._facade_rng_count = 0
+
+    # ------------------------------------------------------------------
+    # pure core — subclasses override these three
+    # ------------------------------------------------------------------
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        """Build this module's learnable parameter pytree."""
+        return {}
+
+    def init_state(self) -> Dict[str, Any]:
+        """Build this module's non-learnable buffer pytree."""
+        return {}
+
+    def apply(self, params, input, state=None, training: bool = False, rng=None):
+        """Pure forward: returns ``(output, new_state)``."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # naming / modes
+    # ------------------------------------------------------------------
+
+    def set_name(self, name: str) -> "AbstractModule":
+        self.name = name
+        return self
+
+    def get_name(self) -> str:
+        return self.name
+
+    def training(self) -> "AbstractModule":
+        self.train_mode = True
+        for m in self.sub_modules():
+            m.training()
+        return self
+
+    def evaluate(self) -> "AbstractModule":
+        self.train_mode = False
+        for m in self.sub_modules():
+            m.evaluate()
+        return self
+
+    def is_training(self) -> bool:
+        return self.train_mode
+
+    def sub_modules(self) -> List["AbstractModule"]:
+        return []
+
+    # ------------------------------------------------------------------
+    # facade: parameter materialization
+    # ------------------------------------------------------------------
+
+    def _ensure_params(self) -> None:
+        if self.params is None:
+            from bigdl_tpu.utils.random_gen import RNG
+
+            self.params = self.init_params(RNG.next_key())
+            self.state = self.init_state()
+        if self.grad_params is None:
+            import jax
+
+            self.grad_params = jax.tree_util.tree_map(
+                lambda p: np.zeros_like(np.asarray(p)), self.params
+            )
+
+    def reset(self, rng=None) -> "AbstractModule":
+        """Re-initialize parameters (reference ``reset()``)."""
+        from bigdl_tpu.utils.random_gen import RNG
+
+        self.params = self.init_params(rng if rng is not None else RNG.next_key())
+        self.state = self.init_state()
+        self.grad_params = None
+        self._ensure_params()
+        return self
+
+    def parameters(self) -> Tuple[List[Any], List[Any]]:
+        """(weights, gradWeights) as flat leaf lists, reference-style."""
+        import jax
+
+        self._ensure_params()
+        ws = jax.tree_util.tree_leaves(self.params)
+        gs = jax.tree_util.tree_leaves(self.grad_params)
+        return ws, gs
+
+    def get_parameters(self):
+        """One flattened (weight, grad) vector pair.
+
+        Reference: ``Module.getParameters`` compacts all parameters into a
+        single contiguous tensor — the representation ``AllReduceParameter``
+        shards. Used by tests and the partitioned optimizer path.
+        """
+        import jax.numpy as jnp
+
+        ws, gs = self.parameters()
+        if not ws:
+            return jnp.zeros((0,)), jnp.zeros((0,))
+        flat_w = jnp.concatenate([jnp.ravel(w) for w in ws])
+        flat_g = jnp.concatenate([jnp.ravel(jnp.asarray(g)) for g in gs])
+        return flat_w, flat_g
+
+    def zero_grad_parameters(self) -> None:
+        import jax
+
+        self._ensure_params()
+        self.grad_params = jax.tree_util.tree_map(
+            lambda g: np.zeros_like(np.asarray(g)), self.grad_params
+        )
+
+    def n_parameters(self) -> int:
+        ws, _ = self.parameters()
+        return int(sum(np.prod(np.asarray(w).shape) for w in ws))
+
+    # ------------------------------------------------------------------
+    # facade: forward / backward
+    # ------------------------------------------------------------------
+
+    def _facade_rng(self):
+        from bigdl_tpu.utils.random_gen import RNG
+
+        self._facade_rng_count += 1
+        return RNG.next_key()
+
+    def forward(self, input: Any) -> Any:
+        self._ensure_params()
+        x = _unwrap_activity(input)
+        rng = self._facade_rng() if self.train_mode else None
+        out, new_state = self.apply(
+            self.params, x, self.state, training=self.train_mode, rng=rng
+        )
+        self.state = new_state
+        self.output = out
+        return out
+
+    __call__ = forward
+
+    # reference aliases
+    def update_output(self, input: Any) -> Any:
+        return self.forward(input)
+
+    def backward(self, input: Any, grad_output: Any) -> Any:
+        """gradInput = d(loss)/d(input); also ACCUMULATES param grads
+        (reference ``updateGradInput`` + ``accGradParameters`` in one vjp)."""
+        import jax
+
+        self._ensure_params()
+        x = _unwrap_activity(input)
+        g = _unwrap_activity(grad_output)
+        rng = None  # deterministic backward against the last forward
+
+        def f(p, xx):
+            return self.apply(p, xx, self.state, training=self.train_mode, rng=rng)
+
+        (out, _new_state), vjp_fn = jax.vjp(f, self.params, x, has_aux=False)
+        # apply returns (out, state); vjp over the tuple needs a zero cotangent
+        # for the state leg.
+        zero_state = jax.tree_util.tree_map(lambda s: np.zeros_like(np.asarray(s)), _new_state)
+        gp, gx = vjp_fn((g, zero_state))
+        self.grad_params = jax.tree_util.tree_map(
+            lambda a, b: np.asarray(a) + np.asarray(b), self.grad_params, gp
+        )
+        self.grad_input = gx
+        return gx
+
+    def update_grad_input(self, input: Any, grad_output: Any) -> Any:
+        return self.backward(input, grad_output)
+
+    def acc_grad_parameters(self, input: Any, grad_output: Any) -> None:
+        self.backward(input, grad_output)
+
+    # ------------------------------------------------------------------
+    # persistence (reference Module.save / Module.load via utils.File)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str, over_write: bool = False) -> "AbstractModule":
+        from bigdl_tpu.utils.file_io import File
+
+        self._ensure_params()
+        File.save(
+            {"module": self, "params": self.params, "state": self.state},
+            path,
+            over_write=over_write,
+        )
+        return self
+
+    @staticmethod
+    def load(path: str) -> "AbstractModule":
+        from bigdl_tpu.utils.file_io import File
+
+        blob = File.load(path)
+        m: AbstractModule = blob["module"]
+        m.params = blob["params"]
+        m.state = blob["state"]
+        m.grad_params = None
+        m._ensure_params()
+        return m
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        # grads and cached activations are not part of a snapshot
+        d["grad_params"] = None
+        d["output"] = None
+        d["grad_input"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+    # ------------------------------------------------------------------
+    # evaluation / prediction conveniences (full versions in optim/)
+    # ------------------------------------------------------------------
+
+    def predict(self, inputs) -> Any:
+        """Batched forward in evaluate mode (local predictor)."""
+        was_training = self.train_mode
+        self.evaluate()
+        try:
+            return self.forward(inputs)
+        finally:
+            if was_training:
+                self.training()
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name})"
+
+
+class TensorModule(AbstractModule):
+    """Marker base for modules whose Activity is a single tensor."""
+
+
+class Identity(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input, state
+
+
+class Echo(TensorModule):
+    """Debug layer: prints shape on forward (reference ``Echo``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        print(f"[Echo {self.name}] shape={getattr(input, 'shape', None)}")
+        return input, state

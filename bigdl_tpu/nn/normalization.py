@@ -1,0 +1,161 @@
+"""Normalization layers.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/BatchNormalization.scala``
+(running mean/var buffers, ``momentum``, ``eps``, affine),
+``SpatialBatchNormalization.scala`` (per-channel over N×H×W, NCHW),
+``SpatialCrossMapLRN.scala`` (AlexNet/Inception local response norm).
+
+TPU-native: running statistics live in the module's **state pytree**, updated
+functionally (``apply`` returns the new state) — this is what lets the whole
+train step stay jittable while preserving the reference's stateful-buffer
+semantics. Torch conventions kept for oracle parity: normalize with biased
+batch variance, store unbiased variance in the running buffer, running update
+``r = (1-momentum)*r + momentum*batch``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, Ones, Zeros
+from bigdl_tpu.nn.module import TensorModule
+
+
+class BatchNormalization(TensorModule):
+    """1-D batch norm over (N, D) input."""
+
+    _reduce_axes = (0,)
+    _param_shape_fn = staticmethod(lambda n, nd: (n,))
+
+    def __init__(
+        self,
+        n_output: int,
+        eps: float = 1e-5,
+        momentum: float = 0.1,
+        affine: bool = True,
+        init_weight: Optional[InitializationMethod] = None,
+        init_bias: Optional[InitializationMethod] = None,
+    ) -> None:
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.weight_init = init_weight or Ones()
+        self.bias_init = init_bias or Zeros()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def init_params(self, rng):
+        if not self.affine:
+            return {}
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        return {
+            "weight": self.weight_init.init(k1, (self.n_output,)),
+            "bias": self.bias_init.init(k2, (self.n_output,)),
+        }
+
+    def init_state(self):
+        import jax.numpy as jnp
+
+        return {
+            "running_mean": jnp.zeros((self.n_output,)),
+            "running_var": jnp.ones((self.n_output,)),
+        }
+
+    def _broadcast(self, v, ndim: int):
+        if ndim == 2:
+            return v[None, :]
+        return v[None, :, None, None]
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        axes = tuple(i for i in range(input.ndim) if i != 1)
+        if training:
+            mean = jnp.mean(input, axis=axes)
+            var = jnp.var(input, axis=axes)
+            n = 1
+            for i in axes:
+                n *= input.shape[i]
+            unbiased = var * (n / max(n - 1, 1))
+            new_state = {
+                "running_mean": (1 - self.momentum) * state["running_mean"]
+                + self.momentum * mean,
+                "running_var": (1 - self.momentum) * state["running_var"]
+                + self.momentum * unbiased,
+            }
+        else:
+            mean = state["running_mean"]
+            var = state["running_var"]
+            new_state = state
+        inv = 1.0 / jnp.sqrt(var + self.eps)
+        out = (input - self._broadcast(mean, input.ndim)) * self._broadcast(
+            inv, input.ndim
+        )
+        if self.affine:
+            out = out * self._broadcast(params["weight"], input.ndim) + self._broadcast(
+                params["bias"], input.ndim
+            )
+        return out, new_state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """Per-channel BN over (N, C, H, W) — same math, channel axis 1."""
+
+
+class SpatialCrossMapLRN(TensorModule):
+    """Local response normalization across channels:
+    ``out = x / (k + alpha/size * sum_window x^2)^beta``."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0) -> None:
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze_batch = input.ndim == 3
+        x = input[None] if squeeze_batch else input
+        sq = x * x
+        half = (self.size - 1) // 2
+        # sum x^2 over a window of `size` channels centered at each channel
+        window_sum = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)),
+        )
+        denom = (self.k + (self.alpha / self.size) * window_sum) ** self.beta
+        out = x / denom
+        if squeeze_batch:
+            out = out[0]
+        return out, state
+
+
+class Normalize(TensorModule):
+    """Lp-normalize along dim 1 (reference ``nn/Normalize.scala``)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10) -> None:
+        super().__init__()
+        self.p = p
+        self.eps = eps
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        norm = jnp.sum(jnp.abs(input) ** self.p, axis=1, keepdims=True) ** (
+            1.0 / self.p
+        )
+        return input / (norm + self.eps), state

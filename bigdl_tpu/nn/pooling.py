@@ -1,0 +1,127 @@
+"""Spatial pooling layers.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/SpatialMaxPooling.scala``,
+``SpatialAveragePooling.scala`` — Torch argument order ``(kW, kH, dW, dH,
+padW, padH)``; ``.ceil()`` switches output-size rounding (Inception-v1 uses
+ceil-mode max pooling).
+
+TPU-native: ``lax.reduce_window`` — XLA lowers windowed reductions natively;
+ceil mode becomes explicit extra right/bottom padding with the reduction
+identity (−inf for max, 0 for average).
+"""
+
+from __future__ import annotations
+
+import math
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class _SpatialPooling(TensorModule):
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0) -> None:
+        super().__init__()
+        self.kw = kw
+        self.kh = kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _out_size(self, in_size: int, k: int, d: int, p: int) -> int:
+        if self.ceil_mode:
+            out = int(math.ceil((in_size + 2 * p - k) / d)) + 1
+        else:
+            out = int(math.floor((in_size + 2 * p - k) / d)) + 1
+        if p > 0 and (out - 1) * d >= in_size + p:
+            out -= 1  # last window must start inside the (left-padded) input
+        return out
+
+    def _pads(self, h: int, w: int):
+        """(low, high) padding per spatial dim incl. ceil-mode extra."""
+        oh = self._out_size(h, self.kh, self.dh, self.pad_h)
+        ow = self._out_size(w, self.kw, self.dw, self.pad_w)
+        extra_h = max((oh - 1) * self.dh + self.kh - h - 2 * self.pad_h, 0)
+        extra_w = max((ow - 1) * self.dw + self.kw - w - 2 * self.pad_w, 0)
+        return (self.pad_h, self.pad_h + extra_h), (self.pad_w, self.pad_w + extra_w)
+
+
+class SpatialMaxPooling(_SpatialPooling):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze_batch = input.ndim == 3
+        x = input[None] if squeeze_batch else input
+        ph, pw = self._pads(x.shape[2], x.shape[3])
+        out = lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), ph, pw),
+        )
+        if squeeze_batch:
+            out = out[0]
+        return out, state
+
+
+class SpatialAveragePooling(_SpatialPooling):
+    def __init__(
+        self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+        global_pooling: bool = False,
+        ceil_mode: bool = False,
+        count_include_pad: bool = True,
+        divide: bool = True,
+    ) -> None:
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h)
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze_batch = input.ndim == 3
+        x = input[None] if squeeze_batch else input
+        if self.global_pooling:
+            kh, kw = x.shape[2], x.shape[3]
+        else:
+            kh, kw = self.kh, self.kw
+        self_kh, self_kw = self.kh, self.kw
+        self.kh, self.kw = kh, kw  # so _pads sees effective kernel
+        ph, pw = self._pads(x.shape[2], x.shape[3])
+        self.kh, self.kw = self_kh, self_kw
+        sums = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), ph, pw),
+        )
+        if not self.divide:
+            out = sums
+        elif self.count_include_pad:
+            out = sums / float(kh * kw)
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add,
+                window_dimensions=(1, 1, kh, kw),
+                window_strides=(1, 1, self.dh, self.dw),
+                padding=((0, 0), (0, 0), ph, pw),
+            )
+            out = sums / counts
+        if squeeze_batch:
+            out = out[0]
+        return out, state

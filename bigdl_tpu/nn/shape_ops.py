@@ -1,0 +1,253 @@
+"""Shape / table manipulation layers.
+
+Reference (UNVERIFIED, SURVEY.md §0): one class per file under
+``.../bigdl/nn/`` — ``Reshape``, ``View``, ``Select``, ``Narrow``,
+``Squeeze``, ``Unsqueeze``, ``Transpose``, ``Padding``, ``JoinTable``,
+``SplitTable``, ``CAddTable``/``CMulTable``/``CSubTable``/``CDivTable``,
+``FlattenTable``. Dims and indices are 1-based like the reference; negative
+dims count from the end.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+def _axis(dim: int, ndim: int, n_input_dims: int = -1) -> int:
+    """1-based reference dim → 0-based axis, honoring the batch-dim
+    convention: when the runtime tensor has one more dim than declared
+    (``n_input_dims``), dim 1 refers to the first non-batch axis."""
+    if dim < 0:
+        return ndim + dim
+    ax = dim - 1
+    if 0 < n_input_dims < ndim:
+        ax += ndim - n_input_dims
+    return ax
+
+
+class Reshape(TensorModule):
+    """Reshape non-batch dims to ``size`` (reference ``nn/Reshape.scala``;
+    ``batchMode=None`` auto-detects a leading batch dim)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = None) -> None:
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+        self._n_element = int(np.prod(self.size))
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        total = int(np.prod(input.shape))
+        batch = self.batch_mode
+        if batch is None:
+            batch = total != self._n_element
+        if batch:
+            return input.reshape((input.shape[0],) + self.size), state
+        return input.reshape(self.size), state
+
+
+class View(TensorModule):
+    def __init__(self, *sizes: int) -> None:
+        super().__init__()
+        self.sizes = tuple(int(s) for s in sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n: int) -> "View":
+        self.num_input_dims = n
+        return self
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        total = int(np.prod(input.shape))
+        if total != int(np.prod(self.sizes)):
+            return input.reshape((input.shape[0],) + self.sizes), state
+        return input.reshape(self.sizes), state
+
+
+class Select(TensorModule):
+    def __init__(self, dim: int, index: int) -> None:
+        super().__init__()
+        self.dim = dim
+        self.index = index
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.dim, input.ndim)
+        idx = self.index - 1 if self.index > 0 else input.shape[ax] + self.index
+        return jnp.take(input, idx, axis=ax), state
+
+
+class Narrow(TensorModule):
+    def __init__(self, dim: int, offset: int, length: int = 1) -> None:
+        super().__init__()
+        self.dim = dim
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        ax = _axis(self.dim, input.ndim)
+        start = self.offset - 1 if self.offset > 0 else input.shape[ax] + self.offset
+        length = self.length
+        if length < 0:
+            length = input.shape[ax] - start + 1 + length
+        sl = [slice(None)] * input.ndim
+        sl[ax] = slice(start, start + length)
+        return input[tuple(sl)], state
+
+
+class Squeeze(TensorModule):
+    def __init__(self, dim: int = None, num_input_dims: int = -1) -> None:
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        if self.dim is None:
+            return jnp.squeeze(input), state
+        ax = _axis(self.dim, input.ndim, self.num_input_dims)
+        return jnp.squeeze(input, axis=ax), state
+
+
+class Unsqueeze(TensorModule):
+    def __init__(self, pos: int, num_input_dims: int = -1) -> None:
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.pos, input.ndim + 1,
+                   self.num_input_dims + 1 if self.num_input_dims > 0 else -1)
+        return jnp.expand_dims(input, axis=ax), state
+
+
+class Transpose(TensorModule):
+    """Swap listed (1-based) dim pairs in order (reference ``nn/Transpose.scala``)."""
+
+    def __init__(self, permutations: Sequence[Sequence[int]]) -> None:
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        out = input
+        for d1, d2 in self.permutations:
+            out = jnp.swapaxes(out, _axis(d1, out.ndim), _axis(d2, out.ndim))
+        return out, state
+
+
+class Contiguous(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input, state
+
+
+class Padding(TensorModule):
+    """Pad ``pad`` entries (negative = before, positive = after) along ``dim``
+    with ``value`` (reference ``nn/Padding.scala``)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1) -> None:
+        super().__init__()
+        self.dim = dim
+        self.pad = pad
+        self.n_input_dim = n_input_dim
+        self.value = value
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.dim, input.ndim, self.n_input_dim)
+        widths = [(0, 0)] * input.ndim
+        widths[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, widths, constant_values=self.value), state
+
+
+# ---------------------------------------------------------------------------
+# table (multi-input) arithmetic
+# ---------------------------------------------------------------------------
+
+
+class CAddTable(TensorModule):
+    """Sum a list of tensors (reference ``nn/CAddTable.scala``) — the residual
+    join in ResNet graphs."""
+
+    def __init__(self, inplace: bool = False) -> None:
+        super().__init__()
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = out + x
+        return out, state
+
+
+class CMulTable(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = out * x
+        return out, state
+
+
+class CSubTable(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input[0] - input[1], state
+
+
+class CDivTable(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input[0] / input[1], state
+
+
+class JoinTable(TensorModule):
+    """Concatenate a list along ``dimension`` (reference ``nn/JoinTable.scala``).
+    ``n_input_dims`` handles the implicit batch dim as in the reference."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1) -> None:
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.dimension, input[0].ndim, self.n_input_dims)
+        return jnp.concatenate(list(input), axis=ax), state
+
+
+class SplitTable(TensorModule):
+    """Split along ``dimension`` into a list (reference ``nn/SplitTable.scala``)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1) -> None:
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.dimension, input.ndim, self.n_input_dims)
+        n = input.shape[ax]
+        return [jnp.take(input, i, axis=ax) for i in range(n)], state
+
+
+class FlattenTable(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        flat = []
+
+        def rec(x):
+            if isinstance(x, (list, tuple)):
+                for v in x:
+                    rec(v)
+            else:
+                flat.append(x)
+
+        rec(input)
+        return flat, state

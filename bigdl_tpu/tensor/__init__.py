@@ -1,0 +1,3 @@
+from bigdl_tpu.tensor.tensor import Tensor
+
+__all__ = ["Tensor"]

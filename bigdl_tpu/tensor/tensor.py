@@ -1,0 +1,421 @@
+"""Tensor — BigDL-style tensor facade over ``jax.Array``.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/tensor/Tensor.scala`` +
+``DenseTensor.scala`` — a Torch7-style strided dense tensor with ~400 mutating
+methods, generic over the scalar type via the ``TensorNumeric`` type class,
+with BLAS fast paths in ``DenseTensorBLAS`` / ``DenseTensorMath`` that call
+Intel MKL over JNI.
+
+TPU-native redesign — deliberately NOT a strided-storage port:
+
+* Storage/stride machinery is XLA's job. ``Tensor`` wraps one immutable
+  ``jax.Array``; views (``select``/``narrow``/``t``) are lazy XLA slices that
+  fuse into consumers, which beats materialized strided views on TPU.
+* "In-place" reference methods (``add``, ``mul_``-style) rebind the wrapped
+  array on the host object. Inside jitted code the pure functional form is
+  used; the mutating surface exists for source-level parity at user level.
+* ``TensorNumeric[T]`` collapses to the dtype: ``Tensor(..., dtype=...)``.
+  MKL BLAS calls (``MKL.vsgemm`` etc.) become ``jnp.dot``/``lax`` ops that
+  XLA lowers to MXU matmuls in bf16/f32.
+* Registered as a JAX pytree, so Tensors can cross jit boundaries and live
+  inside param pytrees (they mostly don't need to — modules use raw arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import numpy as np
+
+ArrayLike = Any
+
+
+def _unwrap(x: Any):
+    return x.data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    """Dense tensor facade. ``Tensor(np_or_jax_array)`` or ``Tensor(*sizes)``."""
+
+    __slots__ = ("data",)
+    # Let `np_array * tensor` dispatch to our __rmul__ instead of numpy
+    # broadcasting over the wrapper object.
+    __array_priority__ = 100
+
+    def __init__(self, *args: Any, dtype: Any = None) -> None:
+        import jax.numpy as jnp
+
+        if len(args) == 1 and not isinstance(args[0], (int, np.integer)):
+            arr = _unwrap(args[0])
+            self.data = jnp.asarray(arr, dtype=dtype)
+        elif len(args) == 0:
+            self.data = jnp.zeros((), dtype=dtype or jnp.float32)
+        else:  # Tensor(2, 3) — zero-filled with the given shape
+            sizes = tuple(int(a) for a in args)
+            self.data = jnp.zeros(sizes, dtype=dtype or jnp.float32)
+
+    # -- shape/meta --------------------------------------------------------
+
+    def size(self, dim: Optional[int] = None):
+        """1-based ``dim`` like the reference; no arg returns the full shape."""
+        if dim is None:
+            return tuple(self.data.shape)
+        return self.data.shape[dim - 1]
+
+    def dim(self) -> int:
+        return self.data.ndim
+
+    def n_element(self) -> int:
+        return int(np.prod(self.data.shape)) if self.data.ndim else 1
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    # -- construction helpers ---------------------------------------------
+
+    @staticmethod
+    def zeros(*sizes: int, dtype: Any = None) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros(sizes, dtype=dtype or jnp.float32))
+
+    @staticmethod
+    def ones(*sizes: int, dtype: Any = None) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.ones(sizes, dtype=dtype or jnp.float32))
+
+    @staticmethod
+    def arange(start: float, end: float, step: float = 1.0) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.arange(start, end, step, dtype=jnp.float32))
+
+    @staticmethod
+    def randn(*sizes: int, seed: int = 0) -> "Tensor":
+        import jax
+
+        return Tensor(jax.random.normal(jax.random.PRNGKey(seed), sizes))
+
+    def fill(self, value: float) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.full_like(self.data, value)
+        return self
+
+    def zero(self) -> "Tensor":
+        return self.fill(0.0)
+
+    def copy(self, other: "Tensor") -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.asarray(_unwrap(other), dtype=self.data.dtype).reshape(
+            self.data.shape
+        )
+        return self
+
+    def clone(self) -> "Tensor":
+        return Tensor(self.data)
+
+    # -- views (lazy XLA slices, not strided storage) ----------------------
+
+    def view(self, *sizes: int) -> "Tensor":
+        return Tensor(self.data.reshape(sizes))
+
+    def reshape(self, sizes: Sequence[int]) -> "Tensor":
+        return Tensor(self.data.reshape(tuple(sizes)))
+
+    def resize(self, *sizes: int) -> "Tensor":
+        """Reference ``resize`` reallocates; here: reshape if same count else new zeros."""
+        import jax.numpy as jnp
+
+        if int(np.prod(sizes)) == self.n_element():
+            self.data = self.data.reshape(sizes)
+        else:
+            self.data = jnp.zeros(sizes, dtype=self.data.dtype)
+        return self
+
+    def select(self, dim: int, index: int) -> "Tensor":
+        """1-based dim and index, like the reference."""
+        import jax.numpy as jnp
+
+        return Tensor(jnp.take(self.data, index - 1, axis=dim - 1))
+
+    def narrow(self, dim: int, index: int, size: int) -> "Tensor":
+        import jax.lax as lax
+
+        starts = [0] * self.data.ndim
+        sizes = list(self.data.shape)
+        starts[dim - 1] = index - 1
+        sizes[dim - 1] = size
+        return Tensor(lax.dynamic_slice(self.data, starts, sizes))
+
+    def t(self) -> "Tensor":
+        return Tensor(self.data.T)
+
+    def transpose(self, dim1: int, dim2: int) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.swapaxes(self.data, dim1 - 1, dim2 - 1))
+
+    def squeeze(self, dim: Optional[int] = None) -> "Tensor":
+        import jax.numpy as jnp
+
+        if dim is None:
+            return Tensor(jnp.squeeze(self.data))
+        return Tensor(jnp.squeeze(self.data, axis=dim - 1))
+
+    def unsqueeze(self, dim: int) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.expand_dims(self.data, axis=dim - 1))
+
+    def contiguous(self) -> "Tensor":
+        return self  # XLA arrays are always "contiguous" logically
+
+    # -- elementwise math (mutating surface rebinds; pure forms return new) --
+
+    def add(self, *args) -> "Tensor":
+        """add(value) | add(other) | add(alpha, other) — in-place like reference."""
+        if len(args) == 1:
+            self.data = self.data + _unwrap(args[0])
+        else:
+            alpha, other = args
+            self.data = self.data + alpha * _unwrap(other)
+        return self
+
+    def sub(self, *args) -> "Tensor":
+        if len(args) == 1:
+            self.data = self.data - _unwrap(args[0])
+        else:
+            alpha, other = args
+            self.data = self.data - alpha * _unwrap(other)
+        return self
+
+    def mul(self, value) -> "Tensor":
+        self.data = self.data * _unwrap(value)
+        return self
+
+    def cmul(self, other) -> "Tensor":
+        self.data = self.data * _unwrap(other)
+        return self
+
+    def div(self, value) -> "Tensor":
+        self.data = self.data / _unwrap(value)
+        return self
+
+    def cdiv(self, other) -> "Tensor":
+        self.data = self.data / _unwrap(other)
+        return self
+
+    def pow(self, n: float) -> "Tensor":
+        self.data = self.data ** n
+        return self
+
+    def sqrt(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.sqrt(self.data)
+        return self
+
+    def log(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.log(self.data)
+        return self
+
+    def exp(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.exp(self.data)
+        return self
+
+    def abs(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.abs(self.data)
+        return self
+
+    def negative(self) -> "Tensor":
+        self.data = -self.data
+        return self
+
+    def clamp(self, min_v: float, max_v: float) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.clip(self.data, min_v, max_v)
+        return self
+
+    # -- reductions --------------------------------------------------------
+
+    def sum(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.sum(self.data))
+        return Tensor(jnp.sum(self.data, axis=dim - 1, keepdims=True))
+
+    def mean(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.mean(self.data))
+        return Tensor(jnp.mean(self.data, axis=dim - 1, keepdims=True))
+
+    def max(self, dim: Optional[int] = None):
+        """No-arg: scalar max. With dim: (values, 1-based indices) like reference."""
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.max(self.data))
+        vals = jnp.max(self.data, axis=dim - 1, keepdims=True)
+        idx = jnp.argmax(self.data, axis=dim - 1, keepdims=True) + 1
+        return Tensor(vals), Tensor(idx)
+
+    def min(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.min(self.data))
+        vals = jnp.min(self.data, axis=dim - 1, keepdims=True)
+        idx = jnp.argmin(self.data, axis=dim - 1, keepdims=True) + 1
+        return Tensor(vals), Tensor(idx)
+
+    def norm(self, p: float = 2.0) -> float:
+        import jax.numpy as jnp
+
+        return float(jnp.sum(jnp.abs(self.data) ** p) ** (1.0 / p))
+
+    def dot(self, other) -> float:
+        import jax.numpy as jnp
+
+        return float(jnp.vdot(self.data, _unwrap(other)))
+
+    # -- linear algebra (MXU path) ----------------------------------------
+
+    def mm(self, a, b) -> "Tensor":
+        """self = a @ b (reference ``Tensor.mm``)."""
+        import jax.numpy as jnp
+
+        self.data = jnp.matmul(_unwrap(a), _unwrap(b))
+        return self
+
+    def addmm(self, *args) -> "Tensor":
+        """addmm([beta,] [t,] [alpha,] a, b): self = beta*t + alpha*(a@b).
+
+        Accepts the common reference arities: (a, b), (t, a, b),
+        (beta, t, alpha, a, b).
+        """
+        import jax.numpy as jnp
+
+        beta, alpha = 1.0, 1.0
+        if len(args) == 2:
+            t, (a, b) = self.data, args
+        elif len(args) == 3:
+            t, a, b = args
+            t = _unwrap(t)
+        elif len(args) == 5:
+            beta, t, alpha, a, b = args
+            t = _unwrap(t)
+        else:
+            raise TypeError(f"addmm: unsupported arity {len(args)}")
+        self.data = beta * t + alpha * jnp.matmul(_unwrap(a), _unwrap(b))
+        return self
+
+    def addmv(self, alpha, mat, vec) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = self.data + alpha * jnp.matmul(_unwrap(mat), _unwrap(vec))
+        return self
+
+    def addr(self, alpha, vec1, vec2) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = self.data + alpha * jnp.outer(_unwrap(vec1), _unwrap(vec2))
+        return self
+
+    # -- indexing / comparison --------------------------------------------
+
+    def value_at(self, *indices: int) -> float:
+        """1-based scalar read (reference ``valueAt``)."""
+        idx = tuple(i - 1 for i in indices)
+        return float(self.data[idx])
+
+    def set_value(self, *args) -> "Tensor":
+        """1-based scalar write: set_value(i, j, ..., value)."""
+        idx = tuple(i - 1 for i in args[:-1])
+        self.data = self.data.at[idx].set(args[-1])
+        return self
+
+    def almost_equal(self, other, tolerance: float = 1e-6) -> bool:
+        return bool(
+            np.allclose(np.asarray(self.data), np.asarray(_unwrap(other)),
+                        atol=tolerance, rtol=0)
+        )
+
+    # -- numpy/jax interop -------------------------------------------------
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- operators ---------------------------------------------------------
+
+    def __add__(self, other):
+        return Tensor(self.data + _unwrap(other))
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return Tensor(self.data - _unwrap(other))
+
+    def __rsub__(self, other):
+        return Tensor(_unwrap(other) - self.data)
+
+    def __mul__(self, other):
+        return Tensor(self.data * _unwrap(other))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return Tensor(self.data / _unwrap(other))
+
+    def __neg__(self):
+        return Tensor(-self.data)
+
+    def __matmul__(self, other):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.matmul(self.data, _unwrap(other)))
+
+    def __getitem__(self, item):
+        return Tensor(self.data[item])
+
+    def __repr__(self) -> str:
+        return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"
+
+
+def _tensor_flatten(t: Tensor):
+    return [t.data], None
+
+
+def _tensor_unflatten(aux, children) -> Tensor:
+    out = object.__new__(Tensor)
+    out.data = children[0]
+    return out
+
+
+try:
+    import jax.tree_util as _jtu
+
+    _jtu.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+except Exception:  # pragma: no cover
+    pass

@@ -1,0 +1,6 @@
+from bigdl_tpu.utils.engine import Engine, EngineType
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.file_io import File
+from bigdl_tpu.utils.random_gen import RandomGenerator, RNG
+
+__all__ = ["Engine", "EngineType", "Table", "T", "File", "RandomGenerator", "RNG"]

@@ -1,0 +1,188 @@
+"""Engine — global runtime configuration singleton.
+
+Reference role (UNVERIFIED citation, see SURVEY.md §0):
+``spark/dl/src/main/scala/com/intel/analytics/bigdl/utils/Engine.scala`` —
+``object Engine`` parses SparkConf + ``bigdl.*`` system properties into
+``nodeNumber`` / ``coreNumber`` / ``engineType`` and owns the compute thread
+pools. The north star adds ``EngineType.TPU`` here exactly the way
+``MklDnn`` was added alongside ``MklBlas``.
+
+TPU-native redesign: there are no executor JVMs or thread pools to manage —
+XLA owns the chip. ``Engine`` instead owns *device topology*: it discovers
+``jax.devices()``, validates the requested node/core counts against them, and
+hands out ``jax.sharding.Mesh`` objects that every distributed component
+(DistriOptimizer, AllReduceParameter, sequence/tensor parallel layers) builds
+on. Configuration mirrors the reference's ``bigdl.*`` system-property tier as
+``BIGDL_*`` environment variables.
+"""
+
+from __future__ import annotations
+
+import os
+from enum import Enum
+from typing import Optional, Sequence
+
+
+class EngineType(Enum):
+    """Compute-engine selector.
+
+    Reference: ``EngineType`` sealed trait with ``MklBlas`` / ``MklDnn``
+    (utils/Engine.scala). ``TPU`` is the new native engine; the two MKL
+    values are accepted for source compatibility and execute on whatever
+    backend JAX has (they do NOT call MKL — on this framework all math
+    lowers to XLA).
+    """
+
+    MklBlas = "mklblas"
+    MklDnn = "mkldnn"
+    TPU = "tpu"
+
+    @staticmethod
+    def parse(name: str) -> "EngineType":
+        key = name.strip().lower()
+        for e in EngineType:
+            if e.value == key or e.name.lower() == key:
+                return e
+        raise ValueError(f"unknown engine type: {name!r}")
+
+
+def _env(name: str, default=None):
+    return os.environ.get(name, default)
+
+
+class _EngineSingleton:
+    """Process-wide runtime state. Mirrors ``object Engine``."""
+
+    def __init__(self) -> None:
+        self._initialized = False
+        self._node_number = 1
+        self._core_number = 1
+        self._engine_type = EngineType.TPU
+        self._local_mode = True
+        self._seed: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(
+        self,
+        node_number: Optional[int] = None,
+        core_number: Optional[int] = None,
+        engine_type: Optional[EngineType | str] = None,
+        local_mode: Optional[bool] = None,
+    ) -> "_EngineSingleton":
+        """Validate and freeze the runtime topology.
+
+        Reference: ``Engine.init`` validates executor topology from
+        SparkConf; here ``node_number`` is the number of JAX processes
+        (multi-host) and ``core_number`` the number of local devices each
+        drives. Defaults come from ``BIGDL_*`` env vars then from the live
+        JAX backend.
+        """
+        import jax
+
+        if engine_type is None:
+            engine_type = _env("BIGDL_ENGINE_TYPE", "tpu")
+        if isinstance(engine_type, str):
+            engine_type = EngineType.parse(engine_type)
+        self._engine_type = engine_type
+
+        if node_number is None:
+            node_number = int(_env("BIGDL_NODE_NUMBER", jax.process_count()))
+        if core_number is None:
+            core_number = int(_env("BIGDL_CORE_NUMBER", jax.local_device_count()))
+        if node_number < 1 or core_number < 1:
+            raise ValueError(
+                f"invalid topology: node_number={node_number} core_number={core_number}"
+            )
+        self._node_number = node_number
+        self._core_number = core_number
+        self._local_mode = (
+            local_mode
+            if local_mode is not None
+            else _env("BIGDL_LOCAL_MODE", str(node_number == 1)).lower()
+            in ("1", "true")
+        )
+        seed = _env("BIGDL_SEED")
+        if seed is not None:
+            self._seed = int(seed)
+        self._initialized = True
+        return self
+
+    def _ensure_init(self) -> None:
+        if not self._initialized:
+            self.init()
+
+    def reset(self) -> None:
+        """Testing hook: forget topology so the next init() re-discovers."""
+        self._initialized = False
+
+    # -- topology accessors ------------------------------------------------
+
+    def node_number(self) -> int:
+        self._ensure_init()
+        return self._node_number
+
+    def core_number(self) -> int:
+        self._ensure_init()
+        return self._core_number
+
+    def engine_type(self) -> EngineType:
+        self._ensure_init()
+        return self._engine_type
+
+    def is_local_mode(self) -> bool:
+        self._ensure_init()
+        return self._local_mode
+
+    def device_count(self) -> int:
+        """Total chips visible to this process group."""
+        import jax
+
+        return jax.device_count()
+
+    def devices(self):
+        import jax
+
+        return jax.devices()
+
+    # -- mesh construction -------------------------------------------------
+
+    def mesh(
+        self,
+        axis_names: Sequence[str] = ("data",),
+        axis_sizes: Optional[Sequence[int]] = None,
+        devices=None,
+    ):
+        """Build a ``jax.sharding.Mesh`` over the visible devices.
+
+        The default is a 1-D data-parallel mesh over every chip — the
+        TPU-native analog of the reference's "one partition owner per
+        executor" layout (parameters/AllReduceParameter.scala). Pass
+        ``axis_names=("data","model")`` etc. for hybrid layouts.
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        if axis_sizes is None:
+            axis_sizes = [n] + [1] * (len(axis_names) - 1)
+        if int(np.prod(axis_sizes)) != n:
+            raise ValueError(
+                f"axis_sizes {tuple(axis_sizes)} do not cover {n} devices"
+            )
+        dev_array = np.asarray(devices).reshape(axis_sizes)
+        return Mesh(dev_array, tuple(axis_names))
+
+    # -- misc --------------------------------------------------------------
+
+    def set_seed(self, seed: int) -> None:
+        self._seed = seed
+
+    def seed(self) -> Optional[int]:
+        return self._seed
+
+
+Engine = _EngineSingleton()

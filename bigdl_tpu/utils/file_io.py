@@ -1,0 +1,60 @@
+"""File — snapshot save/load for modules, optim methods and raw objects.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/utils/File.scala`` —
+Java-serialization save/load to local FS or HDFS; backs ``Module.save`` and
+checkpoint snapshots.
+
+TPU-native redesign: pickle for object structure with every ``jax.Array``
+converted to host numpy on save and restored lazily on load (device placement
+happens on first use — there is no need to pin arrays to a chip inside a
+snapshot). Atomic write (tmp + rename) so a preempted checkpoint never leaves
+a torn file, which is what the DistriOptimizer retry loop (SURVEY.md §5.3)
+relies on.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any
+
+import numpy as np
+
+
+def _to_host(obj: Any) -> Any:
+    """Recursively convert jax arrays to numpy for serialization."""
+    import jax
+
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(conv, obj)
+
+
+class _File:
+    def save(self, obj: Any, path: str, over_write: bool = False) -> None:
+        if os.path.exists(path) and not over_write:
+            raise FileExistsError(
+                f"{path} already exists; pass over_write=True to replace it"
+            )
+        payload = _to_host(obj)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def load(self, path: str) -> Any:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+File = _File()

@@ -1,0 +1,64 @@
+"""RandomGenerator — seeded RNG plumbing.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/utils/RandomGenerator.scala``
+— per-thread Mersenne-Twister with ``RNG.setSeed``.
+
+TPU-native redesign: JAX uses splittable counter-based keys, not stateful
+generators; statefulness would break trace-once jit semantics. ``RNG`` keeps
+one root key per process and hands out fresh subkeys (``next_key``), which is
+what module init and dropout consume. Inside jitted train steps keys are
+threaded functionally; ``RNG`` only feeds the host-side entry points.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RandomGenerator:
+    def __init__(self, seed: int = 0) -> None:
+        self._seed = seed
+        self._key = None
+        self._count = 0
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        self._seed = seed
+        self._key = None
+        self._count = 0
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def _root(self):
+        import jax
+
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
+
+    def next_key(self):
+        """A fresh independent PRNG key (deterministic given the seed)."""
+        import jax
+
+        k = jax.random.fold_in(self._root(), self._count)
+        self._count += 1
+        return k
+
+    def uniform(self, low: float, high: float, shape=(), dtype=None):
+        import jax
+
+        return jax.random.uniform(
+            self.next_key(), shape, minval=low, maxval=high,
+            dtype=dtype or "float32",
+        )
+
+    def normal(self, mean: float, stdv: float, shape=(), dtype=None):
+        import jax
+
+        return mean + stdv * jax.random.normal(
+            self.next_key(), shape, dtype=dtype or "float32"
+        )
+
+
+RNG = RandomGenerator()

@@ -1,0 +1,136 @@
+"""Table — Lua-style heterogeneous 1-based table.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/utils/Table.scala`` —
+the ``Activity`` for multi-input/multi-output layers and the state container
+for optimization methods (``state("epoch")``, ``state("neval")``).
+
+TPU-native note: inside jitted code plain pytrees (lists/dicts) are used;
+``Table`` exists for API parity at the user surface and is registered as a
+JAX pytree so it can cross jit boundaries when needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+
+class Table:
+    """Int-or-string keyed table; integer keys are 1-based like the reference."""
+
+    def __init__(self, *elements: Any, **named: Any) -> None:
+        self._data: Dict[Any, Any] = {}
+        for i, el in enumerate(elements):
+            self._data[i + 1] = el
+        self._data.update(named)
+
+    # -- element access ----------------------------------------------------
+
+    def __getitem__(self, key: Any) -> Any:
+        return self._data[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._data[key] = value
+
+    def __call__(self, key: Any) -> Any:  # state("epoch") style access
+        return self._data[key]
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def get_or_update(self, key: Any, default: Any) -> Any:
+        if key not in self._data:
+            self._data[key] = default
+        return self._data[key]
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def length(self) -> int:
+        return len(self._data)
+
+    def keys(self):
+        return self._data.keys()
+
+    def values(self):
+        return self._data.values()
+
+    def items(self):
+        return self._data.items()
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self._data.values())
+
+    # -- mutation ----------------------------------------------------------
+
+    def insert(self, value: Any) -> "Table":
+        """Append at the next free integer index (1-based)."""
+        i = 1
+        while i in self._data:
+            i += 1
+        self._data[i] = value
+        return self
+
+    def remove(self, key: Any = None) -> Any:
+        if key is None:
+            key = max(k for k in self._data if isinstance(k, int))
+        return self._data.pop(key, None)
+
+    def update(self, other) -> "Table":
+        items = other.items() if hasattr(other, "items") else other
+        for k, v in items:
+            self._data[k] = v
+        return self
+
+    def clear(self) -> "Table":
+        self._data.clear()
+        return self
+
+    # -- conversion --------------------------------------------------------
+
+    def to_list(self) -> list:
+        n = len(self._data)
+        return [self._data[i + 1] for i in range(n)]
+
+    def to_dict(self) -> dict:
+        return dict(self._data)
+
+    @staticmethod
+    def from_list(xs) -> "Table":
+        return Table(*xs)
+
+    # -- dunder ------------------------------------------------------------
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Table) and self._data == other._data
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self._data.items())
+        return f"T({{{inner}}})"
+
+
+def T(*elements: Any, **named: Any) -> Table:
+    """Constructor shorthand mirroring the reference's ``T()``."""
+    return Table(*elements, **named)
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t._data.keys(), key=lambda k: (isinstance(k, str), k))
+    return [t._data[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, children) -> Table:
+    t = Table()
+    for k, v in zip(keys, children):
+        t[k] = v
+    return t
+
+
+try:  # register as pytree so Tables can cross jit boundaries
+    import jax.tree_util as _jtu
+
+    _jtu.register_pytree_node(Table, _table_flatten, _table_unflatten)
+except Exception:  # pragma: no cover
+    pass

@@ -1,0 +1,32 @@
+"""Test harness config.
+
+Mirrors the reference's `new SparkContext("local[N]")` trick (SURVEY.md §4):
+the full distributed path runs in one process by giving JAX 8 virtual CPU
+devices. Must run before jax initializes a backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# This sandbox's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon already in the env, so the env vars above are too late
+# for jax's config — override via jax.config before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# fp32 matmuls for oracle-parity tests
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,46 @@
+"""Torch-cpu oracle helpers — the analog of the reference's Torch7 `TH`
+differential-test harness (SURVEY.md §4: `torch/TH.scala` pattern)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def assert_close(a, b, atol=1e-4, rtol=1e-4, msg=""):
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    assert a.shape == b.shape, f"shape mismatch {a.shape} vs {b.shape} {msg}"
+    np.testing.assert_allclose(a, b, atol=atol, rtol=rtol, err_msg=msg)
+
+
+def torch_forward_backward(torch_module, x_np, grad_out_np=None):
+    """Run a torch module fwd (+ optional bwd); returns (out, grad_in, grads)."""
+    import torch
+
+    x = torch.from_numpy(np.asarray(x_np, dtype=np.float32)).requires_grad_(True)
+    out = torch_module(x)
+    grad_in = None
+    if grad_out_np is not None:
+        g = torch.from_numpy(np.asarray(grad_out_np, dtype=np.float32))
+        out.backward(g)
+        grad_in = x.grad.detach().numpy()
+    grads = {n: p.grad.detach().numpy() if p.grad is not None else None
+             for n, p in torch_module.named_parameters()}
+    return out.detach().numpy(), grad_in, grads
+
+
+def finite_diff_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar f at numpy array x — the analog
+    of the reference's nn/GradientChecker.scala."""
+    x = np.asarray(x, dtype=np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g

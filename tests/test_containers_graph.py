@@ -1,0 +1,134 @@
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def test_sequential_forward_backward(rng):
+    import torch
+
+    from bigdl_tpu.nn import Linear, ReLU, Sequential
+
+    model = Sequential().add(Linear(4, 8)).add(ReLU()).add(Linear(8, 3))
+    model._ensure_params()
+    keys = sorted(model.params.keys())
+    l1, l3 = model.params[keys[0]], model.params[keys[2]]
+
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 3)
+    )
+    with torch.no_grad():
+        tm[0].weight.copy_(torch.from_numpy(np.asarray(l1["weight"])))
+        tm[0].bias.copy_(torch.from_numpy(np.asarray(l1["bias"])))
+        tm[2].weight.copy_(torch.from_numpy(np.asarray(l3["weight"])))
+        tm[2].bias.copy_(torch.from_numpy(np.asarray(l3["bias"])))
+
+    x = rng.randn(5, 4).astype(np.float32)
+    g = rng.randn(5, 3).astype(np.float32)
+    out = model.forward(x)
+    xt = torch.from_numpy(x).requires_grad_(True)
+    t_out = tm(xt)
+    t_out.backward(torch.from_numpy(g))
+    assert_close(out, t_out.detach().numpy(), atol=1e-5)
+    gin = model.backward(x, g)
+    assert_close(gin, xt.grad.numpy(), atol=1e-5)
+
+
+def test_concat(rng):
+    from bigdl_tpu.nn import Concat, Identity, MulConstant
+
+    c = Concat(2).add(Identity()).add(MulConstant(2.0))
+    x = rng.randn(3, 4).astype(np.float32)
+    out = np.asarray(c.forward(x))
+    assert out.shape == (3, 8)
+    assert_close(out[:, :4], x)
+    assert_close(out[:, 4:], 2 * x)
+
+
+def test_concat_table_and_caddtable(rng):
+    from bigdl_tpu.nn import CAddTable, ConcatTable, Identity, MulConstant, Sequential
+
+    # y = x + 3x = 4x — the residual-block shape
+    m = (
+        Sequential()
+        .add(ConcatTable().add(Identity()).add(MulConstant(3.0)))
+        .add(CAddTable())
+    )
+    x = rng.randn(2, 5).astype(np.float32)
+    assert_close(np.asarray(m.forward(x)), 4 * x, atol=1e-6)
+
+
+def test_parallel_table(rng):
+    from bigdl_tpu.nn import MulConstant, ParallelTable
+
+    m = ParallelTable().add(MulConstant(2.0)).add(MulConstant(3.0))
+    a, b = rng.randn(2, 2).astype(np.float32), rng.randn(2, 2).astype(np.float32)
+    out = m.forward([a, b])
+    assert_close(np.asarray(out[0]), 2 * a)
+    assert_close(np.asarray(out[1]), 3 * b)
+
+
+def test_graph_diamond(rng):
+    """input -> (id, 2x) -> add  == 3x, via the functional Graph API."""
+    from bigdl_tpu.nn import CAddTable, Graph, Identity, Input, MulConstant
+
+    inp = Input()
+    a = Identity().inputs(inp)
+    b = MulConstant(2.0).inputs(inp)
+    out = CAddTable().inputs(a, b)
+    g = Graph(inp, out)
+    x = rng.randn(4, 3).astype(np.float32)
+    assert_close(np.asarray(g.forward(x)), 3 * x, atol=1e-6)
+
+
+def test_graph_multi_io(rng):
+    from bigdl_tpu.nn import CAddTable, Graph, Input, MulConstant
+
+    i1, i2 = Input(), Input()
+    s = CAddTable().inputs(i1, i2)
+    d = MulConstant(2.0).inputs(s)
+    g = Graph([i1, i2], [s, d])
+    a, b = rng.randn(2, 2).astype(np.float32), rng.randn(2, 2).astype(np.float32)
+    out = g.forward([a, b])
+    assert_close(np.asarray(out[0]), a + b, atol=1e-6)
+    assert_close(np.asarray(out[1]), 2 * (a + b), atol=1e-6)
+
+
+def test_graph_weight_sharing(rng):
+    """The same Linear instance at two nodes must share one params subtree."""
+    from bigdl_tpu.nn import CAddTable, Graph, Input, Linear
+
+    shared = Linear(4, 4)
+    inp = Input()
+    a = shared.inputs(inp)
+    b = shared.inputs(a)
+    out = CAddTable().inputs(a, b)
+    g = Graph(inp, out)
+    g._ensure_params()
+    # only one params subtree for the shared module
+    assert len([k for k in g.params if "Linear" in k]) == 1
+    x = rng.randn(2, 4).astype(np.float32)
+    w = np.asarray(g.params[[k for k in g.params if "Linear" in k][0]]["weight"])
+    bias = np.asarray(g.params[[k for k in g.params if "Linear" in k][0]]["bias"])
+    h = x @ w.T + bias
+    expect = h + (h @ w.T + bias)
+    assert_close(np.asarray(g.forward(x)), expect, atol=1e-5)
+
+
+def test_jit_whole_model(rng):
+    """A container model's pure apply must trace into one jitted function."""
+    import jax
+
+    from bigdl_tpu.nn import Linear, ReLU, Sequential
+
+    model = Sequential().add(Linear(4, 8)).add(ReLU()).add(Linear(8, 2))
+    model._ensure_params()
+
+    @jax.jit
+    def f(params, x):
+        out, _ = model.apply(params, x, model.init_state(), training=False)
+        return out
+
+    x = rng.randn(3, 4).astype(np.float32)
+    out1 = f(model.params, x)
+    out2 = model.forward(x)
+    assert_close(np.asarray(out1), np.asarray(out2), atol=1e-6)

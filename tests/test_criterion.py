@@ -1,0 +1,105 @@
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def test_classnll_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import ClassNLLCriterion
+
+    logp = torch.log_softmax(torch.from_numpy(rng.randn(4, 5).astype(np.float32)), 1)
+    target = np.array([1, 3, 5, 2], np.float32)  # 1-based
+    crit = ClassNLLCriterion()
+    loss = crit.forward(logp.numpy(), target)
+    t_loss = torch.nn.NLLLoss()(logp, torch.from_numpy(target).long() - 1)
+    assert abs(loss - float(t_loss)) < 1e-5
+
+    gin = crit.backward(logp.numpy(), target)
+    lt = logp.clone().requires_grad_(True)
+    torch.nn.NLLLoss()(lt, torch.from_numpy(target).long() - 1).backward()
+    assert_close(np.asarray(gin), lt.grad.numpy(), atol=1e-5)
+
+
+def test_crossentropy_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import CrossEntropyCriterion
+
+    x = rng.randn(6, 4).astype(np.float32)
+    target = np.array([1, 2, 3, 4, 1, 2], np.float32)
+    crit = CrossEntropyCriterion()
+    loss = crit.forward(x, target)
+    t_loss = torch.nn.CrossEntropyLoss()(
+        torch.from_numpy(x), torch.from_numpy(target).long() - 1
+    )
+    assert abs(loss - float(t_loss)) < 1e-5
+
+
+def test_mse_abs_smoothl1_bce_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import AbsCriterion, BCECriterion, MSECriterion, SmoothL1Criterion
+
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    assert abs(
+        MSECriterion().forward(x, y)
+        - float(torch.nn.MSELoss()(torch.from_numpy(x), torch.from_numpy(y)))
+    ) < 1e-5
+    assert abs(
+        AbsCriterion().forward(x, y)
+        - float(torch.nn.L1Loss()(torch.from_numpy(x), torch.from_numpy(y)))
+    ) < 1e-5
+    assert abs(
+        SmoothL1Criterion().forward(x, y)
+        - float(torch.nn.SmoothL1Loss()(torch.from_numpy(x), torch.from_numpy(y)))
+    ) < 1e-5
+
+    p = 1.0 / (1.0 + np.exp(-x))
+    t = (rng.rand(3, 4) > 0.5).astype(np.float32)
+    assert abs(
+        BCECriterion().forward(p, t)
+        - float(torch.nn.BCELoss()(torch.from_numpy(p), torch.from_numpy(t)))
+    ) < 1e-4
+
+
+def test_parallel_criterion(rng):
+    from bigdl_tpu.nn import MSECriterion, ParallelCriterion
+
+    pc = ParallelCriterion().add(MSECriterion(), 0.3).add(MSECriterion(), 0.7)
+    x1, y1 = rng.randn(2, 3).astype(np.float32), rng.randn(2, 3).astype(np.float32)
+    x2, y2 = rng.randn(2, 3).astype(np.float32), rng.randn(2, 3).astype(np.float32)
+    loss = pc.forward([x1, x2], [y1, y2])
+    expect = 0.3 * np.mean((x1 - y1) ** 2) + 0.7 * np.mean((x2 - y2) ** 2)
+    assert abs(loss - expect) < 1e-5
+
+
+def test_timedistributed_criterion(rng):
+    import torch
+
+    from bigdl_tpu.nn import CrossEntropyCriterion, TimeDistributedCriterion
+
+    x = rng.randn(2, 3, 5).astype(np.float32)  # (N, T, C)
+    t = np.array([[1, 2, 3], [4, 5, 1]], np.float32)
+    crit = TimeDistributedCriterion(CrossEntropyCriterion(), size_average=True)
+    loss = crit.forward(x, t)
+    ref = np.mean([
+        float(torch.nn.CrossEntropyLoss()(
+            torch.from_numpy(x[:, i]), torch.from_numpy(t[:, i]).long() - 1))
+        for i in range(3)
+    ])
+    assert abs(loss - ref) < 1e-5
+
+
+def test_gradient_check_crossentropy(rng):
+    """Finite-difference check (reference GradientChecker pattern)."""
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from tests.oracle import finite_diff_grad
+
+    x = rng.randn(3, 4).astype(np.float64)
+    t = np.array([1, 2, 3], np.float32)
+    crit = CrossEntropyCriterion()
+    g = np.asarray(crit.backward(x.astype(np.float32), t))
+    g_fd = finite_diff_grad(lambda xx: float(crit.apply(xx.astype(np.float32), t)), x)
+    assert_close(g, g_fd, atol=1e-3)

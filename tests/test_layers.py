@@ -1,0 +1,252 @@
+"""Per-layer forward/backward parity vs torch-cpu — the reference's Torch7
+oracle pattern (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close, torch_forward_backward
+
+
+def _facade_grads(module):
+    import jax
+
+    return [np.asarray(g) for g in jax.tree_util.tree_leaves(module.grad_params)]
+
+
+def test_linear_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import Linear
+
+    layer = Linear(7, 5)
+    layer._ensure_params()
+    w = rng.randn(5, 7).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    layer.params = {"weight": w, "bias": b}
+    layer.grad_params = None
+    layer._ensure_params()
+
+    tl = torch.nn.Linear(7, 5)
+    with torch.no_grad():
+        tl.weight.copy_(torch.from_numpy(w))
+        tl.bias.copy_(torch.from_numpy(b))
+
+    x = rng.randn(4, 7).astype(np.float32)
+    g = rng.randn(4, 5).astype(np.float32)
+    out = layer.forward(x)
+    t_out, t_gin, t_grads = torch_forward_backward(tl, x, g)
+    assert_close(out, t_out, atol=1e-5)
+
+    gin = layer.backward(x, g)
+    assert_close(gin, t_gin, atol=1e-5)
+    assert_close(np.asarray(layer.grad_params["weight"]), t_grads["weight"], atol=1e-5)
+    assert_close(np.asarray(layer.grad_params["bias"]), t_grads["bias"], atol=1e-5)
+
+
+def test_grad_accumulation_semantics(rng):
+    """backward() must ACCUMULATE grads until zero_grad_parameters()."""
+    from bigdl_tpu.nn import Linear
+
+    layer = Linear(3, 2)
+    x = rng.randn(2, 3).astype(np.float32)
+    g = rng.randn(2, 2).astype(np.float32)
+    layer.forward(x)
+    layer.backward(x, g)
+    g1 = np.asarray(layer.grad_params["weight"]).copy()
+    layer.backward(x, g)
+    assert_close(np.asarray(layer.grad_params["weight"]), 2 * g1, atol=1e-5)
+    layer.zero_grad_parameters()
+    assert np.abs(np.asarray(layer.grad_params["weight"])).max() == 0.0
+
+
+def test_spatial_convolution_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialConvolution
+
+    # BigDL arg order: (nIn, nOut, kW, kH, dW, dH, padW, padH)
+    layer = SpatialConvolution(3, 8, 5, 3, 2, 1, 2, 1)
+    w = (rng.randn(8, 3, 3, 5) * 0.1).astype(np.float32)  # OIHW, kH=3 kW=5
+    b = rng.randn(8).astype(np.float32)
+    layer.params = {"weight": w, "bias": b}
+    layer.grad_params = None
+    layer._ensure_params()
+
+    tl = torch.nn.Conv2d(3, 8, kernel_size=(3, 5), stride=(1, 2), padding=(1, 2))
+    with torch.no_grad():
+        tl.weight.copy_(torch.from_numpy(w))
+        tl.bias.copy_(torch.from_numpy(b))
+
+    x = rng.randn(2, 3, 9, 11).astype(np.float32)
+    out = layer.forward(x)
+    t_out, t_gin, t_grads = torch_forward_backward(tl, x, np.ones_like(np.asarray(out)))
+    assert_close(out, t_out, atol=1e-4)
+
+    gin = layer.backward(x, np.ones_like(np.asarray(out)))
+    assert_close(gin, t_gin, atol=1e-4)
+    assert_close(np.asarray(layer.grad_params["weight"]), t_grads["weight"], atol=1e-3)
+
+
+def test_grouped_convolution_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialConvolution
+
+    layer = SpatialConvolution(4, 6, 3, 3, 1, 1, 1, 1, n_group=2)
+    w = (rng.randn(6, 2, 3, 3) * 0.1).astype(np.float32)
+    layer.params = {"weight": w, "bias": np.zeros(6, np.float32)}
+    layer.grad_params = None
+    layer._ensure_params()
+
+    tl = torch.nn.Conv2d(4, 6, 3, padding=1, groups=2)
+    with torch.no_grad():
+        tl.weight.copy_(torch.from_numpy(w))
+        tl.bias.zero_()
+
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    out = layer.forward(x)
+    t_out, _, _ = torch_forward_backward(tl, x)
+    assert_close(out, t_out, atol=1e-4)
+
+
+def test_max_pooling_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialMaxPooling
+
+    layer = SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+    tl = torch.nn.MaxPool2d(3, stride=2, padding=1)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    out = layer.forward(x)
+    t_out, t_gin, _ = torch_forward_backward(tl, x, np.ones_like(np.asarray(out)))
+    assert_close(out, t_out, atol=1e-5)
+    gin = layer.backward(x, np.ones_like(np.asarray(out)))
+    assert_close(gin, t_gin, atol=1e-5)
+
+
+def test_max_pooling_ceil_mode(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialMaxPooling
+
+    layer = SpatialMaxPooling(3, 3, 2, 2).ceil()  # Inception-v1 pattern
+    tl = torch.nn.MaxPool2d(3, stride=2, ceil_mode=True)
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+    out = layer.forward(x)
+    t_out, _, _ = torch_forward_backward(tl, x)
+    assert_close(out, t_out, atol=1e-5)
+
+
+def test_avg_pooling_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialAveragePooling
+
+    layer = SpatialAveragePooling(7, 7, 1, 1)  # ResNet head
+    tl = torch.nn.AvgPool2d(7, stride=1)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    out = layer.forward(x)
+    t_out, _, _ = torch_forward_backward(tl, x)
+    assert_close(out, t_out, atol=1e-5)
+
+
+def test_batchnorm_train_and_eval_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialBatchNormalization
+
+    layer = SpatialBatchNormalization(5, eps=1e-5, momentum=0.1)
+    layer._ensure_params()
+    tl = torch.nn.BatchNorm2d(5, eps=1e-5, momentum=0.1)
+
+    x = rng.randn(4, 5, 6, 6).astype(np.float32)
+    out = layer.forward(x)  # train mode
+    tl.train()
+    t_out, _, _ = torch_forward_backward(tl, x)
+    assert_close(out, t_out, atol=1e-4)
+    assert_close(
+        np.asarray(layer.state["running_mean"]),
+        tl.running_mean.detach().numpy(), atol=1e-5,
+    )
+    assert_close(
+        np.asarray(layer.state["running_var"]),
+        tl.running_var.detach().numpy(), atol=1e-4,
+    )
+
+    layer.evaluate()
+    tl.eval()
+    x2 = rng.randn(4, 5, 6, 6).astype(np.float32)
+    out2 = layer.forward(x2)
+    t_out2 = tl(torch.from_numpy(x2)).detach().numpy()
+    assert_close(out2, t_out2, atol=1e-4)
+
+
+def test_lrn_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialCrossMapLRN
+
+    layer = SpatialCrossMapLRN(5, alpha=1e-4, beta=0.75, k=1.0)
+    tl = torch.nn.LocalResponseNorm(5, alpha=1e-4, beta=0.75, k=1.0)
+    x = (rng.randn(2, 8, 5, 5) * 2).astype(np.float32)
+    out = layer.forward(x)
+    t_out, _, _ = torch_forward_backward(tl, x)
+    assert_close(out, t_out, atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "ours,theirs",
+    [
+        ("ReLU", "ReLU"),
+        ("Tanh", "Tanh"),
+        ("Sigmoid", "Sigmoid"),
+        ("ELU", "ELU"),
+        ("SoftPlus", "Softplus"),
+        ("SoftSign", "Softsign"),
+        ("LogSoftMax", "LogSoftmax"),
+    ],
+)
+def test_activations_vs_torch(rng, ours, theirs):
+    import torch
+
+    import bigdl_tpu.nn as nn
+
+    layer = getattr(nn, ours)()
+    kwargs = {"dim": -1} if theirs == "LogSoftmax" else {}
+    tl = getattr(torch.nn, theirs)(**kwargs)
+    x = rng.randn(3, 6).astype(np.float32)
+    g = rng.randn(3, 6).astype(np.float32)
+    out = layer.forward(x)
+    t_out, t_gin, _ = torch_forward_backward(tl, x, g)
+    assert_close(out, t_out, atol=1e-5)
+    gin = layer.backward(x, g)
+    assert_close(gin, t_gin, atol=1e-5)
+
+
+def test_dropout_semantics():
+    import jax
+
+    from bigdl_tpu.nn import Dropout
+
+    layer = Dropout(0.5)
+    x = np.ones((1000,), np.float32)
+    out = np.asarray(layer.forward(x))
+    # scaled: surviving entries are 2.0, dropped are 0
+    assert set(np.round(np.unique(out), 5)) <= {0.0, 2.0}
+    assert 0.3 < (out == 0).mean() < 0.7
+    layer.evaluate()
+    out_eval = np.asarray(layer.forward(x))
+    assert_close(out_eval, x)
+
+
+def test_lookup_table(rng):
+    from bigdl_tpu.nn import LookupTable
+
+    layer = LookupTable(10, 4)
+    layer._ensure_params()
+    w = np.asarray(layer.params["weight"])
+    idx = np.array([[1, 5], [10, 3]], np.float32)  # 1-based
+    out = np.asarray(layer.forward(idx))
+    assert out.shape == (2, 2, 4)
+    assert_close(out[0, 0], w[0])
+    assert_close(out[1, 0], w[9])

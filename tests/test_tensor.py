@@ -1,0 +1,79 @@
+import numpy as np
+
+from bigdl_tpu.tensor import Tensor
+from tests.oracle import assert_close
+
+
+def test_construct_and_meta():
+    t = Tensor(2, 3)
+    assert t.size() == (2, 3)
+    assert t.size(1) == 2 and t.size(2) == 3
+    assert t.dim() == 2
+    assert t.n_element() == 6
+
+    a = Tensor(np.arange(6.0).reshape(2, 3))
+    assert a.value_at(1, 1) == 0.0
+    assert a.value_at(2, 3) == 5.0
+
+
+def test_fill_zero_copy_clone():
+    t = Tensor(2, 2).fill(3.0)
+    assert_close(t.to_numpy(), np.full((2, 2), 3.0))
+    c = t.clone()
+    t.zero()
+    assert_close(t.to_numpy(), np.zeros((2, 2)))
+    assert_close(c.to_numpy(), np.full((2, 2), 3.0))
+
+
+def test_views():
+    a = Tensor(np.arange(24.0).reshape(2, 3, 4))
+    assert a.view(6, 4).size() == (6, 4)
+    assert a.select(1, 2).size() == (3, 4)
+    assert_close(a.select(1, 2).to_numpy(), np.arange(24.0).reshape(2, 3, 4)[1])
+    n = a.narrow(2, 2, 2)
+    assert n.size() == (2, 2, 4)
+    assert_close(n.to_numpy(), np.arange(24.0).reshape(2, 3, 4)[:, 1:3])
+    assert a.transpose(1, 3).size() == (4, 3, 2)
+    assert a.unsqueeze(1).size() == (1, 2, 3, 4)
+
+
+def test_elementwise_and_reductions():
+    a = Tensor(np.array([[1.0, -2.0], [3.0, 4.0]]))
+    b = Tensor(np.ones((2, 2)))
+    s = a + b
+    assert_close(s.to_numpy(), np.array([[2.0, -1.0], [4.0, 5.0]]))
+    assert a.clone().add(2.0, b).almost_equal(
+        Tensor(np.array([[3.0, 0.0], [5.0, 6.0]])), 1e-6
+    )
+    assert abs(a.sum() - 6.0) < 1e-6
+    assert abs(a.mean() - 1.5) < 1e-6
+    assert a.max() == 4.0
+    vals, idx = a.max(2)
+    assert_close(vals.to_numpy(), np.array([[1.0], [4.0]]))
+    assert_close(idx.to_numpy(), np.array([[1], [2]]))  # 1-based
+
+
+def test_matmul_paths():
+    rs = np.random.RandomState(0)
+    a, b = rs.randn(3, 4).astype(np.float32), rs.randn(4, 5).astype(np.float32)
+    out = Tensor(3, 5).mm(Tensor(a), Tensor(b))
+    assert_close(out.to_numpy(), a @ b, atol=1e-5)
+    t = rs.randn(3, 5).astype(np.float32)
+    out2 = Tensor(3, 5).addmm(0.5, Tensor(t), 2.0, Tensor(a), Tensor(b))
+    assert_close(out2.to_numpy(), 0.5 * t + 2.0 * (a @ b), atol=1e-5)
+    assert_close((Tensor(a) @ Tensor(b)).to_numpy(), a @ b, atol=1e-5)
+
+
+def test_pytree_registration():
+    import jax
+
+    t = Tensor(np.ones((2, 2)))
+    out = jax.jit(lambda x: x + 1.0)(t)
+    assert isinstance(out, Tensor)
+    assert_close(out.to_numpy(), np.full((2, 2), 2.0))
+
+
+def test_virtual_device_count():
+    import jax
+
+    assert jax.device_count() == 8, "tests must see 8 virtual CPU devices"
