@@ -1,0 +1,14 @@
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, DataSet, DistributedDataSet, LocalDataSet,
+)
+from bigdl_tpu.dataset.sample import MiniBatch, Sample, stack_samples
+from bigdl_tpu.dataset.transformer import (
+    ChainedTransformer, FnTransformer, SampleToBatch, SampleToMiniBatch,
+    Transformer,
+)
+
+__all__ = [
+    "AbstractDataSet", "DataSet", "DistributedDataSet", "LocalDataSet",
+    "MiniBatch", "Sample", "stack_samples", "ChainedTransformer",
+    "FnTransformer", "SampleToBatch", "SampleToMiniBatch", "Transformer",
+]

@@ -1,0 +1,58 @@
+"""CIFAR-10 loader (reference VGG config's dataset).
+
+Reads the python-pickle batch format from disk when present; synthetic
+fallback otherwise (zero-egress sandbox).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+TRAIN_MEAN = (125.3, 123.0, 113.9)
+TRAIN_STD = (63.0, 62.1, 66.7)
+
+
+def _synthetic(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    protos = np.random.RandomState(99).rand(10, 3, 32, 32).astype(np.float32) * 255
+    labels = rng.randint(0, 10, n)
+    imgs = 0.6 * protos[labels] + 0.4 * rng.rand(n, 3, 32, 32).astype(np.float32) * 255
+    return imgs.astype(np.uint8), labels.astype(np.uint8)
+
+
+def read_data_sets(data_dir: str, kind: str = "train",
+                   synthetic_fallback: bool = True,
+                   synthetic_count: int = 2048) -> Tuple[np.ndarray, np.ndarray]:
+    """(images uint8 (N,3,32,32), labels uint8 0-9)."""
+    batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+    root = batch_dir if os.path.isdir(batch_dir) else data_dir
+    names = (
+        [f"data_batch_{i}" for i in range(1, 6)] if kind == "train" else ["test_batch"]
+    )
+    imgs, labels = [], []
+    for name in names:
+        p = os.path.join(root, name)
+        if not os.path.exists(p):
+            if synthetic_fallback:
+                seed = 21 if kind == "train" else 22
+                return _synthetic(synthetic_count, seed)
+            raise FileNotFoundError(p)
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        imgs.append(np.asarray(d[b"data"], np.uint8).reshape(-1, 3, 32, 32))
+        labels.append(np.asarray(d[b"labels"], np.uint8))
+    return np.concatenate(imgs), np.concatenate(labels)
+
+
+def load_samples(data_dir: str, kind: str = "train", **kw) -> List[Sample]:
+    imgs, labels = read_data_sets(data_dir, kind, **kw)
+    return [
+        Sample(imgs[i].astype(np.float32), np.float32(labels[i] + 1))
+        for i in range(len(imgs))
+    ]

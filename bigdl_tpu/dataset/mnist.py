@@ -1,0 +1,87 @@
+"""MNIST loader.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``pyspark/bigdl/dataset/mnist.py`` —
+idx-file download + parse. This sandbox has zero egress, so the loader reads
+idx files from disk when present and otherwise falls back to a deterministic
+synthetic digit set (class-dependent blob patterns) so the LeNet config runs
+end-to-end anywhere.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+TRAIN_MEAN = 0.13066047740239436 * 255
+TRAIN_STD = 0.30810780876661765 * 255
+TEST_MEAN = 0.13251460696903547 * 255
+TEST_STD = 0.31048024294290553 * 255
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic} in {path}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic} in {path}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+def _synthetic_digits(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in: each class is a distinct 28x28
+    blob pattern plus noise."""
+    rng = np.random.RandomState(seed)
+    protos = np.zeros((10, 28, 28), np.float32)
+    proto_rng = np.random.RandomState(1234)
+    for c in range(10):
+        for _ in range(4):
+            cy, cx = proto_rng.randint(4, 24, 2)
+            yy, xx = np.mgrid[0:28, 0:28]
+            protos[c] += np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)
+    protos = protos / protos.max(axis=(1, 2), keepdims=True) * 255.0
+    labels = rng.randint(0, 10, n)
+    imgs = protos[labels] + rng.randn(n, 28, 28).astype(np.float32) * 25.0
+    return np.clip(imgs, 0, 255).astype(np.uint8), labels.astype(np.uint8)
+
+
+def read_data_sets(data_dir: str, kind: str = "train",
+                   synthetic_fallback: bool = True,
+                   synthetic_count: int = 2048) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images uint8 (N,28,28), labels uint8 0-9)."""
+    prefix = "train" if kind == "train" else "t10k"
+    candidates = [
+        (f"{prefix}-images-idx3-ubyte", f"{prefix}-labels-idx1-ubyte"),
+        (f"{prefix}-images-idx3-ubyte.gz", f"{prefix}-labels-idx1-ubyte.gz"),
+    ]
+    for img_name, lab_name in candidates:
+        ip = os.path.join(data_dir, img_name)
+        lp = os.path.join(data_dir, lab_name)
+        if os.path.exists(ip) and os.path.exists(lp):
+            return _read_idx_images(ip), _read_idx_labels(lp)
+    if not synthetic_fallback:
+        raise FileNotFoundError(f"no MNIST idx files under {data_dir}")
+    seed = 7 if kind == "train" else 13
+    return _synthetic_digits(synthetic_count, seed)
+
+
+def load_samples(data_dir: str, kind: str = "train", **kw) -> List[Sample]:
+    """Samples with (1,28,28) float features and 1-based labels, the shape
+    the reference LeNet pipeline produces."""
+    imgs, labels = read_data_sets(data_dir, kind, **kw)
+    return [
+        Sample(imgs[i].astype(np.float32)[None, :, :], np.float32(labels[i] + 1))
+        for i in range(len(imgs))
+    ]

@@ -1,0 +1,3 @@
+from bigdl_tpu.models.lenet import LeNet5
+
+__all__ = ["LeNet5"]

@@ -1,0 +1,33 @@
+"""LeNet-5 — BASELINE config #1 (MNIST via LocalOptimizer).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/lenet/LeNet5.scala``
+— Reshape → Conv(1,6,5,5) → Tanh → MaxPool → Conv(6,12,5,5) → Tanh →
+MaxPool → Reshape → Linear(12*4*4,100) → Tanh → Linear(100,classNum) →
+LogSoftMax. Signature kept source-compatible: ``LeNet5(class_num)``.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Linear, LogSoftMax, Reshape, Sequential, SpatialConvolution,
+    SpatialMaxPooling, Tanh,
+)
+
+
+def LeNet5(class_num: int = 10) -> Sequential:
+    model = (
+        Sequential()
+        .add(Reshape([1, 28, 28]))
+        .add(SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"))
+        .add(Tanh())
+        .add(SpatialMaxPooling(2, 2, 2, 2))
+        .add(SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"))
+        .add(Tanh())
+        .add(SpatialMaxPooling(2, 2, 2, 2))
+        .add(Reshape([12 * 4 * 4]))
+        .add(Linear(12 * 4 * 4, 100).set_name("fc1"))
+        .add(Tanh())
+        .add(Linear(100, class_num).set_name("fc2"))
+        .add(LogSoftMax())
+    )
+    return model

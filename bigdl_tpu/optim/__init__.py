@@ -1,0 +1,23 @@
+from bigdl_tpu.optim.optim_method import (
+    Adadelta, Adagrad, Adam, Adamax, Default, Exponential, Ftrl,
+    LearningRateSchedule, MultiStep, OptimMethod, Plateau, Poly, RMSprop,
+    SequentialSchedule, SGD, Step, Warmup,
+)
+from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
+    ValidationMethod, ValidationResult,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer
+
+__all__ = [
+    "Adadelta", "Adagrad", "Adam", "Adamax", "Default", "Exponential", "Ftrl",
+    "LearningRateSchedule", "MultiStep", "OptimMethod", "Plateau", "Poly",
+    "RMSprop", "SequentialSchedule", "SGD", "Step", "Warmup",
+    "LocalOptimizer", "Optimizer", "Trigger",
+    "AccuracyResult", "Loss", "LossResult", "MAE", "Top1Accuracy",
+    "Top5Accuracy", "ValidationMethod", "ValidationResult",
+    "Metrics", "L1L2Regularizer", "L1Regularizer", "L2Regularizer",
+]

@@ -1,0 +1,527 @@
+"""OptimMethods — SGD (with embedded LR schedules), Adam, Adagrad, Adadelta,
+Adamax, RMSprop, Ftrl.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/SGD.scala``,
+``Adam.scala``, ``OptimMethod.scala`` — Torch-convention updates with state
+held in a ``Table`` (``state("epoch")``, ``state("neval")``,
+``state("evalCounter")``); SGD embeds the LR schedule family (``Default``,
+``Step``, ``MultiStep``, ``Exponential``, ``Poly``, ``Plateau``, ``Warmup``,
+``SequentialSchedule``).
+
+TPU-native redesign: each method is a **pure jittable update**
+``update(grads, state, params) -> (new_params, new_state)`` over arbitrary
+pytrees — slot buffers and the step counter live in the state pytree, and LR
+schedules are traced functions of the (int32) step counter, so the whole
+optimizer step compiles into the SPMD train step (and shards per-partition in
+the ZeRO-style partitioned-parameter mode, mirroring the reference's
+owner-updates-its-slice design). The reference's ``optimize(feval, x)``
+facade is kept for API parity and per-method unit tests. ``Plateau`` is
+host-driven (it depends on validation scores), matching the reference's
+driver-side trigger cadence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils.table import Table
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (SGD.scala inner classes)
+# ---------------------------------------------------------------------------
+
+
+class LearningRateSchedule:
+    def lr(self, base_lr: float, step):
+        """Traced: ``step`` is an int32 scalar (neval - 1)."""
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + step * learningRateDecay) — reference SGD default."""
+
+    def __init__(self, learning_rate_decay: float = 0.0) -> None:
+        self.learning_rate_decay = learning_rate_decay
+
+    def lr(self, base_lr, step):
+        return base_lr / (1.0 + step * self.learning_rate_decay)
+
+
+class Step(LearningRateSchedule):
+    def __init__(self, step_size: int, gamma: float) -> None:
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def lr(self, base_lr, step):
+        import jax.numpy as jnp
+
+        return base_lr * self.gamma ** jnp.floor(step / self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    def __init__(self, step_sizes: Sequence[int], gamma: float) -> None:
+        self.step_sizes = list(step_sizes)
+        self.gamma = gamma
+
+    def lr(self, base_lr, step):
+        import jax.numpy as jnp
+
+        exponent = sum(
+            (step >= s).astype(jnp.float32) for s in self.step_sizes
+        )
+        return base_lr * self.gamma ** exponent
+
+
+class Exponential(LearningRateSchedule):
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False) -> None:
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.stair_case = stair_case
+
+    def lr(self, base_lr, step):
+        import jax.numpy as jnp
+
+        e = step / self.decay_step
+        if self.stair_case:
+            e = jnp.floor(e)
+        return base_lr * self.decay_rate ** e
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - step/maxIteration)^power — Inception-v1's schedule."""
+
+    def __init__(self, power: float, max_iteration: int) -> None:
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def lr(self, base_lr, step):
+        import jax.numpy as jnp
+
+        frac = jnp.clip(step / self.max_iteration, 0.0, 1.0)
+        return base_lr * (1.0 - frac) ** self.power
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp by ``delta`` per step for ``iteration_num`` steps
+    (reference ``SGD.Warmup``; ResNet ImageNet warmup+step recipe chains it
+    inside a SequentialSchedule)."""
+
+    def __init__(self, delta: float, iteration_num: Optional[int] = None) -> None:
+        self.delta = delta
+        self.iteration_num = iteration_num
+
+    def lr(self, base_lr, step):
+        return base_lr + step * self.delta
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for ``iterations`` steps
+    (reference ``SGD.SequentialSchedule``)."""
+
+    def __init__(self, iteration_per_schedule: Optional[int] = None) -> None:
+        self.schedules: List[Tuple[LearningRateSchedule, int]] = []
+
+    def add(self, schedule: LearningRateSchedule, iterations: int) -> "SequentialSchedule":
+        self.schedules.append((schedule, iterations))
+        return self
+
+    def lr(self, base_lr, step):
+        import jax.numpy as jnp
+
+        out = None
+        offset = 0
+        for i, (sched, iters) in enumerate(self.schedules):
+            local = sched.lr(base_lr, step - offset)
+            if out is None:
+                out = local
+            else:
+                out = jnp.where(step >= offset, local, out)
+            offset += iters
+        return out
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce-on-plateau; host-driven via ``record_score`` between steps
+    (reference ``SGD.Plateau``)."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0) -> None:
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._scale = 1.0
+        self._best: Optional[float] = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def record_score(self, score: float) -> None:
+        improved = (
+            self._best is None
+            or (self.mode == "min" and score < self._best - self.epsilon)
+            or (self.mode == "max" and score > self._best + self.epsilon)
+        )
+        if improved:
+            self._best = score
+            self._wait = 0
+        elif self._cooldown_left > 0:
+            self._cooldown_left -= 1
+        else:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self._scale *= self.factor
+                self._wait = 0
+                self._cooldown_left = self.cooldown
+
+    def lr(self, base_lr, step):
+        import jax.numpy as jnp
+
+        return jnp.maximum(base_lr * self._scale, self.min_lr)
+
+
+# ---------------------------------------------------------------------------
+# optimization methods
+# ---------------------------------------------------------------------------
+
+
+def _tree_map(f, *trees):
+    import jax
+
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class OptimMethod:
+    """Base: pure ``init_state``/``update`` + reference ``optimize`` facade."""
+
+    def __init__(self) -> None:
+        self.state = Table(epoch=1, neval=1)  # reference-style host state
+
+    # pure core ---------------------------------------------------------
+
+    def init_state(self, params) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        return {"neval": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params):
+        raise NotImplementedError
+
+    # facade ------------------------------------------------------------
+
+    def optimize(self, feval: Callable, x):
+        """Reference contract: ``feval(x) -> (loss, grad)``; updates x in
+        place of the return. Host-level; used by tests and LBFGS-style use."""
+        loss, grad = feval(x)
+        if not hasattr(self, "_facade_state") or self._facade_state is None:
+            self._facade_state = self.init_state(x)
+        new_x, self._facade_state = self.update(grad, self._facade_state, x)
+        self.state["neval"] = self.state.get("neval", 1) + 1
+        return new_x, [float(np.asarray(loss))]
+
+    def get_learning_rate(self) -> float:
+        return getattr(self, "learning_rate", 0.0)
+
+    def clear_history(self) -> "OptimMethod":
+        self._facade_state = None
+        self.state = Table(epoch=1, neval=1)
+        return self
+
+    # persistence (reference OptimMethod.save/load)
+    def save(self, path: str, over_write: bool = False) -> "OptimMethod":
+        from bigdl_tpu.utils.file_io import File
+
+        File.save(self, path, over_write=over_write)
+        return self
+
+    @staticmethod
+    def load(path: str) -> "OptimMethod":
+        from bigdl_tpu.utils.file_io import File
+
+        return File.load(path)
+
+
+class SGD(OptimMethod):
+    def __init__(
+        self,
+        learning_rate: float = 1e-3,
+        learning_rate_decay: float = 0.0,
+        weight_decay: float = 0.0,
+        momentum: float = 0.0,
+        dampening: Optional[float] = None,
+        nesterov: bool = False,
+        learning_rate_schedule: Optional[LearningRateSchedule] = None,
+    ) -> None:
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = dampening if dampening is not None else momentum and 0.0
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or (self.dampening or 0.0) != 0):
+            raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+        self.learning_rate_schedule = learning_rate_schedule or Default(
+            learning_rate_decay
+        )
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        s: Dict[str, Any] = {"neval": jnp.zeros((), jnp.int32)}
+        if self.momentum > 0:
+            s["velocity"] = _tree_map(jnp.zeros_like, params)
+        return s
+
+    def update(self, grads, state, params):
+        clr = self.learning_rate_schedule.lr(self.learning_rate, state["neval"])
+        if self.weight_decay > 0:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        new_state = dict(state)
+        if self.momentum > 0:
+            damp = self.dampening or 0.0
+            vel = _tree_map(
+                lambda v, g: self.momentum * v + (1.0 - damp) * g,
+                state["velocity"], grads,
+            )
+            new_state["velocity"] = vel
+            if self.nesterov:
+                grads = _tree_map(lambda g, v: g + self.momentum * v, grads, vel)
+            else:
+                grads = vel
+        new_params = _tree_map(lambda p, g: p - clr * g, params, grads)
+        new_state["neval"] = state["neval"] + 1
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8) -> None:
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "m": _tree_map(jnp.zeros_like, params),
+            "v": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        t = state["neval"] + 1
+        clr = self.learning_rate / (1.0 + state["neval"] * self.learning_rate_decay)
+        m = _tree_map(lambda m_, g: self.beta1 * m_ + (1 - self.beta1) * g,
+                      state["m"], grads)
+        v = _tree_map(lambda v_, g: self.beta2 * v_ + (1 - self.beta2) * g * g,
+                      state["v"], grads)
+        bc1 = 1.0 - self.beta1 ** t.astype(jnp.float32)
+        bc2 = 1.0 - self.beta2 ** t.astype(jnp.float32)
+        new_params = _tree_map(
+            lambda p, m_, v_: p - clr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            params, m, v,
+        )
+        return new_params, {"neval": t, "m": m, "v": v}
+
+
+class Adagrad(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0) -> None:
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        if self.weight_decay > 0:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p, grads, params)
+        clr = self.learning_rate / (1.0 + state["neval"] * self.learning_rate_decay)
+        accum = _tree_map(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = _tree_map(
+            lambda p, g, a: p - clr * g / (jnp.sqrt(a) + 1e-10), params, grads, accum
+        )
+        return new_params, {"neval": state["neval"] + 1, "accum": accum}
+
+
+class Adadelta(OptimMethod):
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10) -> None:
+        super().__init__()
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+        self.learning_rate = 1.0
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum": _tree_map(jnp.zeros_like, params),
+            "delta_accum": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        rho, eps = self.decay_rate, self.epsilon
+        accum = _tree_map(lambda a, g: rho * a + (1 - rho) * g * g,
+                          state["accum"], grads)
+        delta = _tree_map(
+            lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+            grads, accum, state["delta_accum"],
+        )
+        delta_accum = _tree_map(
+            lambda d_, d: rho * d_ + (1 - rho) * d * d, state["delta_accum"], delta
+        )
+        new_params = _tree_map(lambda p, d: p - d, params, delta)
+        return new_params, {
+            "neval": state["neval"] + 1,
+            "accum": accum,
+            "delta_accum": delta_accum,
+        }
+
+
+class Adamax(OptimMethod):
+    # reference default epsilon is 1e-38 (double); that is subnormal in
+    # float32 and flushes to zero on XLA:CPU/TPU -> 0/0. Use 1e-8.
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8) -> None:
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "m": _tree_map(jnp.zeros_like, params),
+            "u": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        t = state["neval"] + 1
+        m = _tree_map(lambda m_, g: self.beta1 * m_ + (1 - self.beta1) * g,
+                      state["m"], grads)
+        u = _tree_map(
+            lambda u_, g: jnp.maximum(self.beta2 * u_, jnp.abs(g) + self.epsilon),
+            state["u"], grads,
+        )
+        bc = 1.0 - self.beta1 ** t.astype(jnp.float32)
+        new_params = _tree_map(
+            lambda p, m_, u_: p - (self.learning_rate / bc) * m_ / u_, params, m, u
+        )
+        return new_params, {"neval": t, "m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8) -> None:
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "sq": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        clr = self.learning_rate / (1.0 + state["neval"] * self.learning_rate_decay)
+        sq = _tree_map(
+            lambda s, g: self.decay_rate * s + (1 - self.decay_rate) * g * g,
+            state["sq"], grads,
+        )
+        new_params = _tree_map(
+            lambda p, g, s: p - clr * g / (jnp.sqrt(s) + self.epsilon),
+            params, grads, sq,
+        )
+        return new_params, {"neval": state["neval"] + 1, "sq": sq}
+
+
+class Ftrl(OptimMethod):
+    """Follow-the-regularized-leader (reference ``optim/Ftrl.scala``)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0) -> None:
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        return {
+            "neval": jnp.zeros((), jnp.int32),
+            "accum": _tree_map(lambda p: jnp.full_like(p, self.init_accum), params),
+            "linear": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        lr, p_ = self.learning_rate, self.lr_power
+
+        def upd(w, g, a, l):
+            new_a = a + g * g
+            sigma = (new_a ** -p_ - a ** -p_) / lr
+            new_l = l + g - sigma * w
+            quad = new_a ** -p_ / lr + 2.0 * self.l2
+            l1_part = jnp.clip(new_l, -self.l1, self.l1)
+            new_w = (l1_part - new_l) / quad
+            return new_w, new_a, new_l
+
+        flat = _tree_map(upd, params, grads, state["accum"], state["linear"])
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            flat, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        new_params = treedef.unflatten([x[0] for x in leaves])
+        accum = treedef.unflatten([x[1] for x in leaves])
+        linear = treedef.unflatten([x[2] for x in leaves])
+        return new_params, {
+            "neval": state["neval"] + 1,
+            "accum": accum,
+            "linear": linear,
+        }

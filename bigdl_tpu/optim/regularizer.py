@@ -1,0 +1,43 @@
+"""Regularizers.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/Regularizer.scala`` —
+``L1Regularizer``/``L2Regularizer``/``L1L2Regularizer`` applied inside
+``accGradParameters``.
+
+TPU-native: a pure gradient transform ``grad_update(param, grad) -> grad``
+applied inside the jitted train step for layers that carry a regularizer
+(and a ``loss_term`` form for totals).
+"""
+
+from __future__ import annotations
+
+
+class Regularizer:
+    def grad_update(self, param, grad):
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0) -> None:
+        self.l1 = l1
+        self.l2 = l2
+
+    def grad_update(self, param, grad):
+        import jax.numpy as jnp
+
+        out = grad
+        if self.l1 != 0.0:
+            out = out + self.l1 * jnp.sign(param)
+        if self.l2 != 0.0:
+            out = out + self.l2 * param
+        return out
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float) -> None:
+        super().__init__(l1=l1, l2=0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float) -> None:
+        super().__init__(l1=0.0, l2=l2)

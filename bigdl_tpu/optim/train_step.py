@@ -1,0 +1,110 @@
+"""Train-step builder — the compiled hot loop.
+
+This replaces the reference's entire per-iteration machinery
+(``DistriOptimizer.train()``'s thread-pool forward/backward, gradient
+summing, and ``AllReduceParameter`` exchange — SURVEY.md §3.1): the forward,
+loss, backward, gradient aggregation, clipping, regularization and optimizer
+update trace into ONE jitted XLA program. On a mesh, gradient aggregation is
+an XLA collective over ICI inserted by sharding propagation (or explicit
+psum_scatter/all_gather in the partitioned path in ``bigdl_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    total = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def clip_by_value(grads, min_v: float, max_v: float):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, min_v, max_v), grads)
+
+
+def apply_module_regularizers(model, params, grads):
+    """Apply per-layer regularizers (reference: inside accGradParameters).
+
+    Walks the module tree alongside the params pytree; a module with
+    ``w_regularizer``/``b_regularizer`` contributes extra gradient terms for
+    its weight/bias leaves.
+    """
+    def walk(module, p, g):
+        if not isinstance(p, dict):
+            return g
+        out = dict(g)
+        wreg = getattr(module, "w_regularizer", None)
+        breg = getattr(module, "b_regularizer", None)
+        if wreg is not None and "weight" in p:
+            out["weight"] = wreg.grad_update(p["weight"], g["weight"])
+        if breg is not None and "bias" in p:
+            out["bias"] = breg.grad_update(p["bias"], g["bias"])
+        subs = module.sub_modules()
+        if subs:
+            # container keys are "{i}:{name}" (containers) or graph keys
+            for key in p:
+                idx = None
+                try:
+                    idx = int(key.split(":", 1)[0])
+                except (ValueError, IndexError):
+                    pass
+                if idx is not None and idx < len(subs):
+                    out[key] = walk(subs[idx], p[key], g[key])
+        return out
+
+    return walk(model, params, grads)
+
+
+def make_train_step(
+    model,
+    criterion,
+    optim_method,
+    grad_clip: Optional[dict] = None,
+    grad_transform: Optional[Callable] = None,
+    loss_scale: float = 1.0,
+):
+    """Returns pure ``step(params, opt_state, model_state, rng, inp, tgt)``
+    → ``(params, opt_state, model_state, loss)``. Caller jits (possibly with
+    shardings)."""
+
+    def step(params, opt_state, model_state, rng, inputs, targets):
+        import jax
+
+        def loss_fn(p):
+            out, new_ms = model.apply(p, inputs, model_state, training=True, rng=rng)
+            loss = criterion.apply(out, targets)
+            return loss, new_ms
+
+        (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = apply_module_regularizers(model, params, grads)
+        if grad_transform is not None:
+            grads = grad_transform(grads)
+        if grad_clip:
+            if grad_clip.get("l2_norm") is not None:
+                grads = clip_by_global_norm(grads, grad_clip["l2_norm"])
+            if grad_clip.get("constant") is not None:
+                lo, hi = grad_clip["constant"]
+                grads = clip_by_value(grads, lo, hi)
+        new_params, new_opt = optim_method.update(grads, opt_state, params)
+        return new_params, new_opt, new_ms, loss
+
+    return step
+
+
+def make_eval_step(model):
+    def step(params, model_state, inputs):
+        out, _ = model.apply(params, inputs, model_state, training=False, rng=None)
+        return out
+
+    return step

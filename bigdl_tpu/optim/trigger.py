@@ -1,0 +1,68 @@
+"""Trigger — composable stop/fire conditions.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/Trigger.scala`` —
+``maxEpoch``, ``maxIteration``, ``everyEpoch``, ``severalIteration``,
+``minLoss``, ``maxScore``, ``and``/``or``. Evaluated host-side against the
+optimizer's state table each iteration, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[dict], bool]) -> None:
+        self._fn = fn
+
+    def __call__(self, state) -> bool:
+        return self._fn(state)
+
+    def and_(self, other: "Trigger") -> "Trigger":
+        return Trigger(lambda s: self(s) and other(s))
+
+    def or_(self, other: "Trigger") -> "Trigger":
+        return Trigger(lambda s: self(s) or other(s))
+
+    # -- factories ---------------------------------------------------------
+
+    @staticmethod
+    def max_epoch(max_e: int) -> "Trigger":
+        return Trigger(lambda s: s["epoch"] > max_e)
+
+    @staticmethod
+    def max_iteration(max_it: int) -> "Trigger":
+        return Trigger(lambda s: s["neval"] > max_it)
+
+    @staticmethod
+    def every_epoch() -> "Trigger":
+        holder = {"last": None}
+
+        def fn(s):
+            if s["epoch"] != holder["last"] and s.get("epoch_finished", False):
+                holder["last"] = s["epoch"]
+                return True
+            return False
+
+        return Trigger(fn)
+
+    @staticmethod
+    def several_iteration(interval: int) -> "Trigger":
+        return Trigger(lambda s: (s["neval"] - 1) % interval == 0 and s["neval"] > 1)
+
+    @staticmethod
+    def min_loss(min_l: float) -> "Trigger":
+        return Trigger(lambda s: s.get("loss") is not None and s["loss"] < min_l)
+
+    @staticmethod
+    def max_score(max_s: float) -> "Trigger":
+        return Trigger(lambda s: s.get("score") is not None and s["score"] > max_s)
+
+
+# module-level factory aliases matching the reference's Trigger.xxx style
+max_epoch = Trigger.max_epoch
+max_iteration = Trigger.max_iteration
+every_epoch = Trigger.every_epoch
+several_iteration = Trigger.several_iteration
+min_loss = Trigger.min_loss
+max_score = Trigger.max_score

@@ -1,0 +1,117 @@
+"""ValidationMethods + results.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/ValidationMethod.scala``
+— ``Top1Accuracy``, ``Top5Accuracy``, ``Loss``, ``MAE``;
+``ValidationResult``/``AccuracyResult`` with ``+`` merge (the executor→driver
+reduction). Labels are 1-based like the criterions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int) -> None:
+        self.correct = int(correct)
+        self.count = int(count)
+
+    def result(self):
+        acc = self.correct / self.count if self.count else 0.0
+        return acc, self.count
+
+    def __add__(self, other: "AccuracyResult") -> "AccuracyResult":
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self) -> str:
+        acc, n = self.result()
+        return f"Accuracy(correct={self.correct}, count={n}, accuracy={acc:.4f})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int) -> None:
+        self.loss = float(loss)
+        self.count = int(count)
+
+    def result(self):
+        mean = self.loss / self.count if self.count else 0.0
+        return mean, self.count
+
+    def __add__(self, other: "LossResult") -> "LossResult":
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self) -> str:
+        mean, n = self.result()
+        return f"Loss(mean={mean:.4f}, count={n})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def apply(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    __call__ = apply
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    name = "Top1Accuracy"
+
+    def apply(self, output, target) -> AccuracyResult:
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64) - 1
+        if out.ndim == 1:
+            out = out[None]
+        pred = out.argmax(axis=-1)
+        return AccuracyResult(int((pred == t).sum()), len(t))
+
+
+class Top5Accuracy(ValidationMethod):
+    name = "Top5Accuracy"
+
+    def apply(self, output, target) -> AccuracyResult:
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64) - 1
+        if out.ndim == 1:
+            out = out[None]
+        top5 = np.argsort(-out, axis=-1)[:, :5]
+        correct = int(sum(t[i] in top5[i] for i in range(len(t))))
+        return AccuracyResult(correct, len(t))
+
+
+class Loss(ValidationMethod):
+    name = "Loss"
+
+    def __init__(self, criterion=None) -> None:
+        if criterion is None:
+            from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+            criterion = ClassNLLCriterion()
+        self.criterion = criterion
+
+    def apply(self, output, target) -> LossResult:
+        n = np.asarray(output).shape[0]
+        return LossResult(self.criterion.forward(output, target) * n, n)
+
+
+class MAE(ValidationMethod):
+    name = "MAE"
+
+    def apply(self, output, target) -> LossResult:
+        out = np.asarray(output)
+        t = np.asarray(target)
+        n = out.shape[0]
+        return LossResult(float(np.abs(out - t).mean()) * n, n)

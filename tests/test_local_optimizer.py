@@ -1,0 +1,79 @@
+"""End-to-end: LeNet-5 on (synthetic) MNIST via LocalOptimizer — BASELINE
+config #1, the reference's minimum end-to-end slice (SURVEY.md §7 stage 4)."""
+
+import numpy as np
+
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.mnist import TRAIN_MEAN, TRAIN_STD, load_samples
+from bigdl_tpu.dataset.image import GreyImgNormalizer
+from bigdl_tpu.models import LeNet5
+from bigdl_tpu.nn import ClassNLLCriterion
+from bigdl_tpu.optim import Adam, Optimizer, LocalOptimizer, Top1Accuracy, Trigger
+
+
+def _mnist_ds(kind, n, batch):
+    samples = load_samples("/nonexistent", kind, synthetic_count=n)
+    return (
+        DataSet.array(samples)
+        .transform(GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD))
+        .transform(SampleToMiniBatch(batch))
+    )
+
+
+def test_lenet_mnist_end_to_end(tmp_path):
+    train_ds = _mnist_ds("train", 512, 64)
+    val_ds = _mnist_ds("val", 256, 64)
+
+    model = LeNet5(10)
+    optimizer = Optimizer(
+        model=model, dataset=train_ds, criterion=ClassNLLCriterion()
+    )
+    assert isinstance(optimizer, LocalOptimizer)
+    (
+        optimizer.set_optim_method(Adam(learning_rate=1e-3))
+        .set_end_when(Trigger.max_epoch(3))
+        .set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])
+        .set_checkpoint(str(tmp_path / "ckpt"), Trigger.every_epoch())
+    )
+    trained = optimizer.optimize()
+
+    # the synthetic digits are learnable: expect well above chance
+    correct = total = 0
+    for batch in val_ds.data(train=False):
+        out = trained.predict(batch.get_input())
+        r = Top1Accuracy().apply(out, batch.get_target())
+        correct += r.correct
+        total += r.count
+    acc = correct / total
+    assert acc > 0.5, f"accuracy {acc} not above chance"
+
+    # checkpoint exists and resumes
+    assert (tmp_path / "ckpt" / "model").exists()
+    assert (tmp_path / "ckpt" / "optimMethod").exists()
+
+
+def test_checkpoint_resume(tmp_path):
+    """Kill mid-training, resume from snapshot (reference §5.3 retry loop)."""
+    train_ds = _mnist_ds("train", 256, 64)
+    model = LeNet5(10)
+    opt = Optimizer(model=model, dataset=train_ds, criterion=ClassNLLCriterion())
+    opt.set_optim_method(Adam(1e-3)).set_end_when(Trigger.max_iteration(6))
+    opt.set_checkpoint(str(tmp_path / "ck"), Trigger.several_iteration(2))
+    opt.optimize()
+
+    snap = opt._latest_checkpoint()
+    assert snap is not None
+    _mblob, oblob = snap
+    assert oblob["neval"] >= 5
+
+
+def test_raw_sample_list_api():
+    """pyspark-style: pass raw samples + batch_size straight to Optimizer."""
+    samples = load_samples("/nonexistent", "train", synthetic_count=128)
+    model = LeNet5(10)
+    opt = Optimizer(
+        model=model, dataset=samples, criterion=ClassNLLCriterion(), batch_size=32
+    )
+    opt.set_optim_method(Adam(1e-3)).set_end_when(Trigger.max_iteration(3))
+    trained = opt.optimize()
+    assert trained is model

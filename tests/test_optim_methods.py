@@ -1,0 +1,168 @@
+"""OptimMethod unit tests: convergence on a quadratic + parity vs torch SGD."""
+
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def _quad_feval(target):
+    def feval(x):
+        g = x - target
+        loss = 0.5 * float(np.sum(np.asarray(g) ** 2))
+        return loss, g
+
+    return feval
+
+
+def test_sgd_converges_quadratic():
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import SGD
+
+    target = jnp.asarray(np.arange(4.0, dtype=np.float32))
+    x = jnp.zeros(4)
+    opt = SGD(learning_rate=0.5)
+    feval = _quad_feval(target)
+    for _ in range(50):
+        x, losses = opt.optimize(feval, x)
+    assert losses[0] < 1e-4
+
+
+def test_sgd_momentum_matches_torch():
+    import torch
+
+    from bigdl_tpu.optim import SGD
+
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    grads = [np.array([0.5, 0.1, -0.3], np.float32),
+             np.array([-0.2, 0.4, 0.6], np.float32),
+             np.array([0.3, -0.5, 0.2], np.float32)]
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, weight_decay=0.01)
+    for g in grads:
+        topt.zero_grad()
+        tw.grad = torch.from_numpy(g.copy())
+        topt.step()
+
+    import jax.numpy as jnp
+
+    opt = SGD(learning_rate=0.1, momentum=0.9, weight_decay=0.01)
+    x = jnp.asarray(w0)
+    st = opt.init_state(x)
+    for g in grads:
+        x, st = opt.update(jnp.asarray(g), st, x)
+    assert_close(np.asarray(x), tw.detach().numpy(), atol=1e-6)
+
+
+def test_adam_matches_torch():
+    import torch
+
+    from bigdl_tpu.optim import Adam
+
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    grads = [np.array([0.5, 0.1, -0.3], np.float32)] * 5
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch.optim.Adam([tw], lr=0.01)
+    for g in grads:
+        topt.zero_grad()
+        tw.grad = torch.from_numpy(g.copy())
+        topt.step()
+
+    import jax.numpy as jnp
+
+    opt = Adam(learning_rate=0.01)
+    x = jnp.asarray(w0)
+    st = opt.init_state(x)
+    for g in grads:
+        x, st = opt.update(jnp.asarray(g), st, x)
+    assert_close(np.asarray(x), tw.detach().numpy(), atol=1e-5)
+
+
+def test_rmsprop_adagrad_adadelta_adamax_ftrl_run():
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import Adadelta, Adagrad, Adamax, Ftrl, RMSprop
+
+    target = jnp.asarray(np.arange(4.0, dtype=np.float32))
+    # Adadelta's effective lr starts near sqrt(eps) so it needs far more steps
+    for opt, iters in [(RMSprop(learning_rate=0.05), 100),
+                       (Adagrad(learning_rate=0.5), 100),
+                       (Adadelta(epsilon=1e-4), 3000),
+                       (Adamax(learning_rate=0.1), 100),
+                       (Ftrl(learning_rate=0.5), 100)]:
+        x = jnp.zeros(4)
+        st = opt.init_state(x)
+        import jax
+
+        @jax.jit
+        def run_step(x, st):
+            g = x - target
+            return opt.update(g, st, x)
+
+        for _ in range(iters):
+            x, st = run_step(x, st)
+        loss = float(jnp.sum((x - target) ** 2))
+        assert loss < 1.0, f"{type(opt).__name__} did not converge: {loss}"
+
+
+def test_lr_schedules():
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import (
+        Default, Exponential, MultiStep, Poly, SequentialSchedule, Step, Warmup,
+    )
+
+    s = jnp.asarray(10, jnp.int32)
+    assert abs(float(Step(5, 0.1).lr(1.0, s)) - 0.01) < 1e-9
+    assert abs(float(MultiStep([3, 8], 0.1).lr(1.0, s)) - 0.01) < 1e-9
+    assert abs(float(Default(0.1).lr(1.0, s)) - 0.5) < 1e-9
+    assert abs(float(Poly(2.0, 20).lr(1.0, s)) - 0.25) < 1e-6
+    assert abs(float(Exponential(10, 0.5, stair_case=True).lr(1.0, s)) - 0.5) < 1e-9
+    # warmup 0.1 + 0.09/step for 10 steps then constant 1.0
+    seq = SequentialSchedule().add(Warmup(0.09), 10).add(Default(0.0), 1000)
+    assert abs(float(seq.lr(0.1, jnp.asarray(0, jnp.int32))) - 0.1) < 1e-6
+    assert abs(float(seq.lr(0.1, jnp.asarray(5, jnp.int32))) - 0.55) < 1e-6
+    assert abs(float(seq.lr(0.1, jnp.asarray(15, jnp.int32))) - 0.1) < 1e-6
+
+
+def test_plateau_host_schedule():
+    from bigdl_tpu.optim import Plateau
+
+    p = Plateau(factor=0.5, patience=2, mode="min")
+    for score in [1.0, 0.9, 0.91, 0.92]:  # 2 non-improving -> reduce
+        p.record_score(score)
+    import jax.numpy as jnp
+
+    assert abs(float(p.lr(1.0, jnp.asarray(0))) - 0.5) < 1e-9
+
+
+def test_triggers():
+    from bigdl_tpu.optim import Trigger
+
+    st = {"epoch": 3, "neval": 21, "loss": 0.5, "score": 0.9, "epoch_finished": True}
+    assert Trigger.max_epoch(2)(st)
+    assert not Trigger.max_epoch(5)(st)
+    assert Trigger.max_iteration(20)(st)
+    assert Trigger.several_iteration(10)(st)
+    assert Trigger.min_loss(0.6)(st)
+    assert Trigger.max_score(0.8)(st)
+    assert Trigger.max_epoch(2).and_(Trigger.min_loss(0.6))(st)
+    ee = Trigger.every_epoch()
+    assert ee(st)
+    assert not ee(st)  # same epoch: fires once
+
+
+def test_optim_method_save_load(tmp_path):
+    from bigdl_tpu.optim import SGD, OptimMethod, Step
+
+    opt = SGD(learning_rate=0.1, momentum=0.9,
+              learning_rate_schedule=Step(10, 0.5))
+    opt.state["neval"] = 42
+    p = str(tmp_path / "optim.snapshot")
+    opt.save(p)
+    loaded = OptimMethod.load(p)
+    assert isinstance(loaded, SGD)
+    assert loaded.state["neval"] == 42
+    assert loaded.momentum == 0.9
