@@ -1,0 +1,274 @@
+"""DistriOptimizer — the distributed data-parallel trainer.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/DistriOptimizer.scala``
+— "the single most important file in the repo": per-executor model caches,
+``AllReduceParameter`` gradient partition exchange, straggler gradient-drop,
+retry-from-checkpoint, validation/summary/checkpoint triggers (call stack in
+SURVEY.md §3.1).
+
+TPU-native redesign: the entire per-iteration Spark job — broadcast, thread
+forward/backward, BlockManager reduce-scatter, owner update, allgather —
+collapses into ONE jitted shard_map program over a ``jax.sharding.Mesh``:
+
+* batch sharded over the ``data`` mesh axis (one shard per chip — the "one
+  executor per TPU chip" of the north star);
+* ``parameter_mode="partitioned"`` (default, faithful): params + optimizer
+  slots live sharded 1/N per chip; per step: ``all_gather`` weights →
+  local fwd/bwd → ``psum_scatter`` grads → owner updates its slice. This is
+  ``AllReduceParameter`` verbatim, riding ICI instead of BlockManager.
+* ``parameter_mode="allreduce"``: replicated params, ``pmean`` grads,
+  identical replicated update — fewer collectives on small models.
+* ``compress="bf16"|"fp16"`` mirrors ``FP16CompressedTensor`` on the
+  gradient exchange.
+* BatchNorm running stats are ``pmean``-ed across shards each step.
+
+Straggler gradient-drop (``dropPercentage``) has no SPMD analog — synchronous
+XLA collectives cannot partially complete — and is documented unsupported.
+
+The host driver loop (triggers, checkpoint cadence, bounded retry) is shared
+with LocalOptimizer: exactly the thin loop the reference's driver runs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.train_step import (
+    apply_module_regularizers, clip_by_global_norm, clip_by_value, make_eval_step,
+)
+from bigdl_tpu.parallel.all_reduce import AllReduceParameter
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+class DistriOptimizer(Optimizer):
+    def __init__(self, model=None, dataset=None, criterion=None,
+                 batch_size: Optional[int] = None, end_trigger=None,
+                 parameter_mode: str = "partitioned",
+                 compress: Optional[str] = None,
+                 mesh=None, **kw) -> None:
+        super().__init__(model, dataset, criterion, batch_size, end_trigger, **kw)
+        if parameter_mode not in ("partitioned", "allreduce"):
+            raise ValueError(f"unknown parameter_mode {parameter_mode!r}")
+        self.parameter_mode = parameter_mode
+        self.compress = compress
+        self._mesh = mesh
+        self._arp: Optional[AllReduceParameter] = None
+
+    # -- mesh --------------------------------------------------------------
+
+    def mesh(self):
+        if self._mesh is None:
+            from bigdl_tpu.utils.engine import Engine
+
+            self._mesh = Engine.mesh(("data",))
+        return self._mesh
+
+    # -- spmd step construction -------------------------------------------
+
+    def _grad_hooks(self, grads, params):
+        grads = apply_module_regularizers(self.model, params, grads)
+        if self.grad_clip.get("l2_norm") is not None:
+            grads = clip_by_global_norm(grads, self.grad_clip["l2_norm"])
+        if self.grad_clip.get("constant") is not None:
+            lo, hi = self.grad_clip["constant"]
+            grads = clip_by_value(grads, lo, hi)
+        return grads
+
+    def _clip_shard(self, gshard):
+        """Gradient clipping on the sharded gradient: the global L2 norm is a
+        psum of per-shard square sums (the shards tile the full vector)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self.grad_clip.get("l2_norm") is not None:
+            total = lax.psum(jnp.sum(gshard.astype(jnp.float32) ** 2), "data")
+            norm = jnp.sqrt(total)
+            gshard = gshard * jnp.minimum(1.0, self.grad_clip["l2_norm"] / (norm + 1e-6))
+        if self.grad_clip.get("constant") is not None:
+            lo, hi = self.grad_clip["constant"]
+            gshard = jnp.clip(gshard, lo, hi)
+        return gshard
+
+    def _pmean_state(self, model_state, axis):
+        """Average float buffers (BN running stats) across data shards."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def avg(x):
+            if isinstance(x, jnp.ndarray) and jnp.issubdtype(x.dtype, jnp.floating):
+                return lax.pmean(x, axis)
+            return x
+
+        return jax.tree_util.tree_map(avg, model_state)
+
+    def _build_partitioned_step(self, mesh, params):
+        import jax
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shard_map = jax.shard_map
+
+        n = mesh.devices.size
+        arp = AllReduceParameter(params, n, "data", compress=self.compress)
+        self._arp = arp
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        from bigdl_tpu.optim.train_step import regularizer_loss
+
+        def spmd(shards, opt_state, model_state, rng, inputs, targets):
+            my_shard = shards[0]  # (shard_size,) — this chip's partition
+            # per-device slice of the stacked opt state (leading axis 1)
+            opt_local = jax.tree_util.tree_map(lambda x: x[0], opt_state)
+            # decorrelate stochastic layers (dropout) across data shards
+            rng = jax.random.fold_in(rng, lax.axis_index("data"))
+
+            # Differentiate w.r.t. THE SHARD: the forward runs the
+            # all-gather (getWeights) and the cotangent path runs the
+            # compressed reduce-scatter (putGradients +
+            # aggregateGradientPartition) — see AllReduceParameter.
+            def loss_fn(shard):
+                p = arp.get_weights(shard)
+                out, new_ms = model.apply(p, inputs, model_state,
+                                          training=True, rng=rng)
+                loss = criterion.apply(out, targets) + regularizer_loss(model, p)
+                return loss, new_ms
+
+            (loss, new_ms), gshard = jax.value_and_grad(loss_fn, has_aux=True)(
+                my_shard
+            )
+            gshard = gshard / n  # sum of per-shard means -> global mean
+            gshard = self._clip_shard(gshard)
+            new_shard, new_opt = optim.update(gshard, opt_local, my_shard)
+            new_opt = jax.tree_util.tree_map(lambda x: x[None], new_opt)
+            loss = lax.pmean(loss, "data")
+            new_ms = self._pmean_state(new_ms, "data")
+            return new_shard[None], new_opt, new_ms, loss
+
+        sharded = P("data")
+        rep = P()
+        step = jax.jit(
+            shard_map(
+                spmd, mesh=mesh,
+                in_specs=(sharded, sharded, rep, rep, sharded, sharded),
+                out_specs=(sharded, sharded, rep, rep),
+            )
+        )
+
+        # initial placement: stacked shards + sharded opt state
+        shards_host = arp.init_shards(params)
+        dev_shards = jax.device_put(
+            shards_host, NamedSharding(mesh, P("data"))
+        )
+        # vmap broadcasts scalar counters to (n,), slot buffers to (n, shard)
+        opt_state = jax.vmap(optim.init_state)(shards_host)
+        opt_state = jax.device_put(
+            opt_state, NamedSharding(mesh, P("data"))
+        )
+        return step, dev_shards, opt_state
+
+    def _build_allreduce_step(self, mesh, params):
+        import jax
+        from jax import lax
+        shard_map = jax.shard_map
+        from jax.sharding import PartitionSpec as P
+
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+
+        def spmd(params, opt_state, model_state, rng, inputs, targets):
+            rng = jax.random.fold_in(rng, lax.axis_index("data"))
+            # mark replicated params device-varying so grads come back LOCAL
+            # (jax 0.9 shard_map auto-psums cotangents of unvaried inputs);
+            # the pmean below is then the one explicit all-reduce.
+            pcast = getattr(lax, "pcast", None)
+            mark_varying = (
+                (lambda x: pcast(x, "data", to="varying"))
+                if pcast is not None
+                else (lambda x: lax.pvary(x, "data"))
+            )
+            params_v = jax.tree_util.tree_map(mark_varying, params)
+
+            def loss_fn(p):
+                out, new_ms = model.apply(p, inputs, model_state,
+                                          training=True, rng=rng)
+                return criterion.apply(out, targets), new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params_v
+            )
+            grads = lax.pmean(grads, "data")
+            grads = self._grad_hooks(grads, params)
+            new_params, new_opt = optim.update(grads, opt_state, params)
+            loss = lax.pmean(loss, "data")
+            new_ms = self._pmean_state(new_ms, "data")
+            return new_params, new_opt, new_ms, loss
+
+        rep, sharded = P(), P("data")
+        step = jax.jit(
+            shard_map(
+                spmd, mesh=mesh,
+                in_specs=(rep, rep, rep, rep, sharded, sharded),
+                out_specs=(rep, rep, rep, rep),
+            )
+        )
+        opt_state = optim.init_state(params)
+        return step, params, opt_state
+
+    # -- Optimizer hooks ---------------------------------------------------
+
+    def _prepare(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh()
+        self._n_devices = mesh.devices.size
+        params, model_state = self.model.params, self.model.state
+
+        if self.parameter_mode == "partitioned":
+            step, dev_params, opt_state = self._build_partitioned_step(mesh, params)
+        else:
+            step, dev_params, opt_state = self._build_allreduce_step(mesh, params)
+
+        batch_sharding = NamedSharding(mesh, P("data"))
+
+        def place_batch(batch: MiniBatch):
+            def put(x):
+                if isinstance(x, (list, tuple)):
+                    return [jax.device_put(v, batch_sharding) for v in x]
+                return jax.device_put(x, batch_sharding)
+
+            inp, tgt = batch.get_input(), batch.get_target()
+            if batch.size() % self._n_devices != 0:
+                raise ValueError(
+                    f"global batch {batch.size()} must divide the "
+                    f"{self._n_devices}-chip data axis"
+                )
+            return put(inp), put(tgt)
+
+        return step, place_batch, dev_params, opt_state, model_state
+
+    def _ckpt_params_to_host(self, params):
+        if self.parameter_mode == "partitioned":
+            return self._arp.to_full(params)
+        return params
+
+    def _host_params_to_device(self, params):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.parameter_mode == "partitioned":
+            shards = self._arp.init_shards(params)
+            return jax.device_put(shards, NamedSharding(self.mesh(), P("data")))
+        return params
+
+    def _writeback(self, params, opt_state, model_state) -> None:
+        import jax
+
+        host_params = self._ckpt_params_to_host(params)
+        self.model.params = jax.tree_util.tree_map(np.asarray, host_params)
+        self.model.state = jax.tree_util.tree_map(np.asarray, model_state)
+        self._final_opt_state = opt_state

@@ -183,17 +183,21 @@ class Optimizer:
         except Exception:  # torn/partial snapshot — treat as absent
             return None
 
+    def _eval_forward(self, params, model_state, inp):
+        import jax
+
+        if not hasattr(self, "_eval_step"):
+            self._eval_step = jax.jit(make_eval_step(self.model))
+        return self._eval_step(params, model_state, inp)
+
     def _run_validation(self, params, model_state, state) -> Optional[float]:
         if not (self.validation_dataset and self.validation_methods):
             return None
-        import jax
-
-        eval_step = jax.jit(make_eval_step(self.model))
         totals = [None] * len(self.validation_methods)
         for batch in self.validation_dataset.data(train=False):
             inp = batch.get_input() if isinstance(batch, MiniBatch) else batch
             tgt = batch.get_target() if isinstance(batch, MiniBatch) else None
-            out = eval_step(params, model_state, inp)
+            out = self._eval_forward(params, model_state, inp)
             for i, m in enumerate(self.validation_methods):
                 r = m.apply(out, tgt)
                 totals[i] = r if totals[i] is None else totals[i] + r
@@ -215,15 +219,6 @@ class Optimizer:
         return score
 
     def optimize(self):
-        raise NotImplementedError
-
-
-class LocalOptimizer(Optimizer):
-    """Single-process trainer driving the local chip(s) with one jitted step."""
-
-    def optimize(self):
-        import jax
-
         last_err = None
         for attempt in range(self.retry_times):
             try:
@@ -238,30 +233,50 @@ class LocalOptimizer(Optimizer):
                 time.sleep(self.retry_interval_s)
         raise last_err
 
+    # -- subclass hooks ----------------------------------------------------
+
+    def _prepare(self):
+        """Returns (step, place_batch, params, opt_state, model_state).
+
+        ``step(params, opt_state, model_state, rng, inp, tgt)`` is compiled;
+        ``place_batch(batch) -> (inp, tgt)`` stages a host MiniBatch onto
+        device(s) with the right sharding.
+        """
+        raise NotImplementedError
+
+    def _writeback(self, params, opt_state, model_state) -> None:
+        """Store final (host-layout) params back into the module facade."""
+        import jax
+
+        self.model.params = jax.tree_util.tree_map(np.asarray, params)
+        self.model.state = jax.tree_util.tree_map(np.asarray, model_state)
+        self._final_opt_state = opt_state
+
+    def _ckpt_params_to_host(self, params):
+        return params
+
+    def _host_params_to_device(self, params):
+        return params
+
     def _optimize_once(self, resume: bool = False):
         import jax
 
-        model, criterion = self.model, self.criterion
-        model.training()
-        model._ensure_params()
-        params, model_state = model.params, model.state
-        opt_state = self.optim_method.init_state(params)
+        self.model.training()
+        self.model._ensure_params()
+        step, place_batch, params, opt_state, model_state = self._prepare()
         state = self._state0()
 
         if resume:
             snap = self._latest_checkpoint()
             if snap is not None:
                 mblob, oblob = snap
-                params = mblob["params"]
+                params = self._host_params_to_device(mblob["params"])
                 model_state = mblob["model_state"]
                 opt_state = oblob["opt_state"]
                 state["epoch"] = oblob["epoch"]
                 state["neval"] = oblob["neval"]
                 logger.info("resumed from checkpoint at iteration %d", state["neval"])
 
-        step = jax.jit(
-            make_train_step(model, criterion, self.optim_method, self.grad_clip)
-        )
         from bigdl_tpu.utils.random_gen import RNG
 
         base_key = RNG.next_key()
@@ -277,9 +292,9 @@ class LocalOptimizer(Optimizer):
             bsz = batch.size()
             t0 = time.time()
             rng = jax.random.fold_in(base_key, state["neval"])
+            inp, tgt = place_batch(batch)
             params, opt_state, model_state, loss = step(
-                params, opt_state, model_state, rng,
-                batch.get_input(), batch.get_target(),
+                params, opt_state, model_state, rng, inp, tgt,
             )
             loss_f = float(loss)
             dt = time.time() - t0
@@ -308,14 +323,38 @@ class LocalOptimizer(Optimizer):
                 epoch_start = time.time()
 
             if self.validation_trigger is not None and self.validation_trigger(state):
-                score = self._run_validation(params, model_state, state)
+                score = self._run_validation(
+                    self._ckpt_params_to_host(params), model_state, state
+                )
                 if score is not None:
                     state["score"] = score
             if self.checkpoint_trigger is not None and self.checkpoint_trigger(state):
-                self._checkpoint(state, params, model_state, opt_state)
+                self._checkpoint(
+                    state, self._ckpt_params_to_host(params), model_state, opt_state
+                )
 
-        # write results back into the module facade
-        model.params = jax.tree_util.tree_map(np.asarray, params)
-        model.state = jax.tree_util.tree_map(np.asarray, model_state)
-        self._final_opt_state = opt_state
-        return model
+        self._writeback(params, opt_state, model_state)
+        return self.model
+
+
+class LocalOptimizer(Optimizer):
+    """Single-process trainer driving the local chip(s) with one jitted step.
+
+    Reference ``LocalOptimizer.scala``'s thread-pool model clones vanish:
+    one compiled step saturates the chip (SURVEY.md §2.4).
+    """
+
+    def _prepare(self):
+        import jax
+
+        params, model_state = self.model.params, self.model.state
+        opt_state = self.optim_method.init_state(params)
+        step = jax.jit(
+            make_train_step(self.model, self.criterion, self.optim_method,
+                            self.grad_clip)
+        )
+
+        def place_batch(batch: MiniBatch):
+            return batch.get_input(), batch.get_target()
+
+        return step, place_batch, params, opt_state, model_state

@@ -16,6 +16,11 @@ class Regularizer:
     def grad_update(self, param, grad):
         raise NotImplementedError
 
+    def loss_term(self, param):
+        """Equivalent penalty as a loss term (used by the partitioned
+        distributed path, where full gradients are never materialized)."""
+        raise NotImplementedError
+
 
 class L1L2Regularizer(Regularizer):
     def __init__(self, l1: float = 0.0, l2: float = 0.0) -> None:
@@ -31,6 +36,16 @@ class L1L2Regularizer(Regularizer):
         if self.l2 != 0.0:
             out = out + self.l2 * param
         return out
+
+    def loss_term(self, param):
+        import jax.numpy as jnp
+
+        loss = 0.0
+        if self.l1 != 0.0:
+            loss = loss + self.l1 * jnp.sum(jnp.abs(param))
+        if self.l2 != 0.0:
+            loss = loss + 0.5 * self.l2 * jnp.sum(param * param)
+        return loss
 
 
 class L1Regularizer(L1L2Regularizer):

@@ -66,6 +66,36 @@ def apply_module_regularizers(model, params, grads):
     return walk(model, params, grads)
 
 
+def regularizer_loss(model, params):
+    """Sum of per-layer regularizer penalties as one scalar loss term —
+    gradient-equivalent to ``apply_module_regularizers`` but usable when full
+    gradients are never materialized (partitioned distributed path)."""
+    total = 0.0
+
+    def walk(module, p):
+        nonlocal total
+        if not isinstance(p, dict):
+            return
+        wreg = getattr(module, "w_regularizer", None)
+        breg = getattr(module, "b_regularizer", None)
+        if wreg is not None and "weight" in p:
+            total = total + wreg.loss_term(p["weight"])
+        if breg is not None and "bias" in p:
+            total = total + breg.loss_term(p["bias"])
+        subs = module.sub_modules()
+        if subs:
+            for key in p:
+                try:
+                    idx = int(key.split(":", 1)[0])
+                except (ValueError, IndexError):
+                    continue
+                if idx < len(subs):
+                    walk(subs[idx], p[key])
+
+    walk(model, params)
+    return total
+
+
 def make_train_step(
     model,
     criterion,

@@ -1,0 +1,6 @@
+"""bigdl_tpu.parallel — the distributed parameter/communication plane
+(reference layer L7, SURVEY.md §2.4 / §5.8)."""
+
+from bigdl_tpu.parallel.all_reduce import AllReduceParameter, flatten_params
+
+__all__ = ["AllReduceParameter", "flatten_params"]

