@@ -1,3 +1,16 @@
-from bigdl_tpu.models.lenet import LeNet5
+"""bigdl_tpu.models — the model zoo (reference layer L6, SURVEY.md §2.8)."""
 
-__all__ = ["LeNet5"]
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.models.vgg import VggForCifar10, Vgg_16, Vgg_19
+from bigdl_tpu.models.resnet import ResNet
+from bigdl_tpu.models.inception import (
+    Inception_v1, Inception_v1_NoAuxClassifier, Inception_Layer_v1,
+)
+from bigdl_tpu.models.alexnet import AlexNet, AlexNet_OWT
+from bigdl_tpu.models.autoencoder import Autoencoder
+
+__all__ = [
+    "LeNet5", "VggForCifar10", "Vgg_16", "Vgg_19", "ResNet",
+    "Inception_v1", "Inception_v1_NoAuxClassifier", "Inception_Layer_v1",
+    "AlexNet", "AlexNet_OWT", "Autoencoder",
+]

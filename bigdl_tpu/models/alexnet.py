@@ -1,0 +1,114 @@
+"""AlexNet (OWT single-tower variant).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/alexnet/AlexNet.scala``
+— ``AlexNet(classNum)`` is the "one weird trick" single-tower layout;
+``AlexNet_OWT`` drops the LRN layers. Xavier init.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Dropout, Linear, LogSoftMax, ReLU, Reshape, Sequential,
+    SpatialConvolution, SpatialCrossMapLRN, SpatialMaxPooling, Xavier, Zeros,
+)
+
+
+def AlexNet_OWT(class_num: int = 1000, has_dropout: bool = True,
+                first_layer_propagate_back: bool = False) -> Sequential:
+    model = Sequential()
+    model.add(
+        SpatialConvolution(
+            3, 64, 11, 11, 4, 4, 2, 2,
+            propagate_back=first_layer_propagate_back,
+            init_weight=Xavier(), init_bias=Zeros(),
+        ).set_name("conv1")
+    )
+    model.add(ReLU(True).set_name("relu1"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"))
+    model.add(
+        SpatialConvolution(64, 192, 5, 5, 1, 1, 2, 2,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv2")
+    )
+    model.add(ReLU(True).set_name("relu2"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"))
+    model.add(
+        SpatialConvolution(192, 384, 3, 3, 1, 1, 1, 1,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv3")
+    )
+    model.add(ReLU(True).set_name("relu3"))
+    model.add(
+        SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv4")
+    )
+    model.add(ReLU(True).set_name("relu4"))
+    model.add(
+        SpatialConvolution(256, 256, 3, 3, 1, 1, 1, 1,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv5")
+    )
+    model.add(ReLU(True).set_name("relu5"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"))
+    model.add(Reshape([256 * 6 * 6], batch_mode=True))
+    model.add(Linear(256 * 6 * 6, 4096,
+                     init_weight=Xavier(), init_bias=Zeros()).set_name("fc6"))
+    model.add(ReLU(True).set_name("relu6"))
+    if has_dropout:
+        model.add(Dropout(0.5).set_name("drop6"))
+    model.add(Linear(4096, 4096,
+                     init_weight=Xavier(), init_bias=Zeros()).set_name("fc7"))
+    model.add(ReLU(True).set_name("relu7"))
+    if has_dropout:
+        model.add(Dropout(0.5).set_name("drop7"))
+    model.add(Linear(4096, class_num,
+                     init_weight=Xavier(), init_bias=Zeros()).set_name("fc8"))
+    model.add(LogSoftMax().set_name("logsoftmax"))
+    return model
+
+
+def AlexNet(class_num: int = 1000, has_dropout: bool = True) -> Sequential:
+    """Caffe-style AlexNet (with cross-map LRN after pool1/pool2)."""
+    model = Sequential()
+    model.add(
+        SpatialConvolution(3, 96, 11, 11, 4, 4,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv1")
+    )
+    model.add(ReLU(True).set_name("relu1"))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm1"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"))
+    model.add(
+        SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, n_group=2,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv2")
+    )
+    model.add(ReLU(True).set_name("relu2"))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm2"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"))
+    model.add(
+        SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv3")
+    )
+    model.add(ReLU(True).set_name("relu3"))
+    model.add(
+        SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, n_group=2,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv4")
+    )
+    model.add(ReLU(True).set_name("relu4"))
+    model.add(
+        SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, n_group=2,
+                           init_weight=Xavier(), init_bias=Zeros()).set_name("conv5")
+    )
+    model.add(ReLU(True).set_name("relu5"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"))
+    model.add(Reshape([256 * 6 * 6], batch_mode=True))
+    model.add(Linear(256 * 6 * 6, 4096,
+                     init_weight=Xavier(), init_bias=Zeros()).set_name("fc6"))
+    model.add(ReLU(True).set_name("relu6"))
+    if has_dropout:
+        model.add(Dropout(0.5).set_name("drop6"))
+    model.add(Linear(4096, 4096,
+                     init_weight=Xavier(), init_bias=Zeros()).set_name("fc7"))
+    model.add(ReLU(True).set_name("relu7"))
+    if has_dropout:
+        model.add(Dropout(0.5).set_name("drop7"))
+    model.add(Linear(4096, class_num,
+                     init_weight=Xavier(), init_bias=Zeros()).set_name("fc8"))
+    model.add(LogSoftMax().set_name("logsoftmax"))
+    return model

@@ -1,0 +1,23 @@
+"""Autoencoder — MNIST MLP autoencoder from the reference zoo.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/autoencoder/
+Autoencoder.scala`` — ``Autoencoder(classNum=32)``: 784 → hidden (ReLU) →
+784 (Sigmoid), trained with MSECriterion against the input.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import Linear, ReLU, Reshape, Sequential, Sigmoid
+
+
+def Autoencoder(class_num: int = 32) -> Sequential:
+    row_n, col_n = 28, 28
+    feature_size = row_n * col_n
+    return (
+        Sequential()
+        .add(Reshape([feature_size]))
+        .add(Linear(feature_size, class_num))
+        .add(ReLU(True))
+        .add(Linear(class_num, feature_size))
+        .add(Sigmoid())
+    )

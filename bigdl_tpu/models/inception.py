@@ -1,0 +1,148 @@
+"""Inception-v1 (GoogLeNet) — BASELINE config #4 (ImageNet, poly LR).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/inception/
+Inception.scala`` — ``Inception_v1(classNum)`` / ``Inception_v1_NoAuxClassifier``;
+inception blocks are a 4-way ``Concat(2)`` (1x1 | 1x1→3x3 | 1x1→5x5 |
+maxpool→1x1), stem is 7x7/2 conv → maxpool(ceil) → LRN → 1x1 → 3x3 → LRN →
+maxpool, head is 7x7 avgpool → Dropout(0.4) → Linear(1024, classNum) →
+LogSoftMax. Xavier init throughout.
+
+TPU-native notes: the four branches are independent convs over the same
+input — XLA schedules them back-to-back on the MXU and the ``Concat`` is a
+layout no-op folded into the next conv's operand. Ceil-mode pooling maps to
+explicit -inf padding in ``lax.reduce_window``.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Concat, Dropout, Linear, LogSoftMax, ReLU, Reshape, Sequential,
+    SpatialAveragePooling, SpatialConvolution, SpatialCrossMapLRN,
+    SpatialMaxPooling, Xavier, Zeros,
+)
+
+
+def _conv_relu(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    seq = Sequential()
+    seq.add(
+        SpatialConvolution(
+            n_in, n_out, kw, kh, sw, sh, pw, ph,
+            init_weight=Xavier(), init_bias=Zeros(),
+        ).set_name(name + "conv")
+    )
+    seq.add(ReLU(True).set_name(name + "relu"))
+    return seq
+
+
+def Inception_Layer_v1(input_size: int, config, name_prefix: str = "") -> Concat:
+    """One inception block. ``config`` is reference-style:
+    ``T(T(out1x1), T(reduce3x3, out3x3), T(reduce5x5, out5x5), T(pool_proj))``
+    — accepted here as a nested list/tuple."""
+    c = [list(branch) for branch in config]
+    concat = Concat(2)
+
+    b1 = Sequential()
+    b1.add(
+        SpatialConvolution(
+            input_size, c[0][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name(name_prefix + "1x1")
+    )
+    b1.add(ReLU(True))
+    concat.add(b1)
+
+    b2 = Sequential()
+    b2.add(
+        SpatialConvolution(
+            input_size, c[1][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name(name_prefix + "3x3_reduce")
+    )
+    b2.add(ReLU(True))
+    b2.add(
+        SpatialConvolution(
+            c[1][0], c[1][1], 3, 3, 1, 1, 1, 1,
+            init_weight=Xavier(), init_bias=Zeros(),
+        ).set_name(name_prefix + "3x3")
+    )
+    b2.add(ReLU(True))
+    concat.add(b2)
+
+    b3 = Sequential()
+    b3.add(
+        SpatialConvolution(
+            input_size, c[2][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name(name_prefix + "5x5_reduce")
+    )
+    b3.add(ReLU(True))
+    b3.add(
+        SpatialConvolution(
+            c[2][0], c[2][1], 5, 5, 1, 1, 2, 2,
+            init_weight=Xavier(), init_bias=Zeros(),
+        ).set_name(name_prefix + "5x5")
+    )
+    b3.add(ReLU(True))
+    concat.add(b3)
+
+    b4 = Sequential()
+    b4.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil().set_name(name_prefix + "pool"))
+    b4.add(
+        SpatialConvolution(
+            input_size, c[3][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name(name_prefix + "pool_proj")
+    )
+    b4.add(ReLU(True))
+    concat.add(b4)
+    return concat
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000,
+                                 has_dropout: bool = True) -> Sequential:
+    model = Sequential()
+    model.add(
+        SpatialConvolution(
+            3, 64, 7, 7, 2, 2, 3, 3, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name("conv1/7x7_s2")
+    )
+    model.add(ReLU(True))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
+    model.add(
+        SpatialConvolution(
+            64, 64, 1, 1, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name("conv2/3x3_reduce")
+    )
+    model.add(ReLU(True))
+    model.add(
+        SpatialConvolution(
+            64, 192, 3, 3, 1, 1, 1, 1, init_weight=Xavier(), init_bias=Zeros()
+        ).set_name("conv2/3x3")
+    )
+    model.add(ReLU(True))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2"))
+
+    model.add(Inception_Layer_v1(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"))
+    model.add(Inception_Layer_v1(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
+    model.add(Inception_Layer_v1(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"))
+    model.add(Inception_Layer_v1(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"))
+    model.add(Inception_Layer_v1(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"))
+    model.add(Inception_Layer_v1(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"))
+    model.add(Inception_Layer_v1(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
+    model.add(Inception_Layer_v1(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"))
+    model.add(Inception_Layer_v1(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    if has_dropout:
+        model.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+    model.add(Reshape([1024], batch_mode=True))
+    model.add(
+        Linear(1024, class_num, init_weight=Xavier(), init_bias=Zeros())
+        .set_name("loss3/classifier")
+    )
+    model.add(LogSoftMax().set_name("loss3/loss3"))
+    return model
+
+
+# The aux-classifier training variant shares the same main tower; the two
+# auxiliary heads only change the training loss. Parity alias:
+Inception_v1 = Inception_v1_NoAuxClassifier

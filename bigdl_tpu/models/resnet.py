@@ -1,0 +1,196 @@
+"""ResNet — BASELINE config #3 (ResNet-50 / ImageNet / SGD + step LR).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/resnet/ResNet.scala``
+— ``ResNet(classNum, T("shortcutType" -> "B", "depth" -> 50, ...))`` builds a
+Graph of conv-BN blocks with MSRA init; CIFAR-10 depths are ``6n+2`` basic
+blocks over 16/32/64 planes, ImageNet depths 18/34 (basic) and 50/101/152
+(bottleneck) over 64..512 planes with expansion 4; shortcut type A =
+padded identity, B = 1x1-conv projection on dimension change, C = always
+projection. ``TrainImageNet`` additionally zero-initializes the last BN gamma
+of every residual block ("zero gamma") and uses no-bias convolutions.
+
+TPU-native notes: the whole Graph traces into one XLA program; residual adds
+fuse into the preceding conv epilogues, and the 7x7/stride-2 stem + 3x3 convs
+hit the MXU's native convolution path (no im2col). Shortcut type A is a
+strided slice + channel zero-pad, which XLA folds into a cheap pad op.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from bigdl_tpu.nn import (
+    CAddTable, Graph, Input, Linear, LogSoftMax, MsraFiller, ReLU, Reshape,
+    Sequential, SpatialAveragePooling, SpatialBatchNormalization,
+    SpatialConvolution, SpatialMaxPooling, Xavier, Zeros,
+)
+from bigdl_tpu.nn.module import TensorModule
+
+
+class _PaddedShortcut(TensorModule):
+    """Type-A shortcut: stride the identity spatially and zero-pad channels
+    (reference ResNet.scala shortcut ``shortcutType == "A"`` — a
+    SpatialAveragePooling(1,1,stride,stride) + Concat with zero tensor; here
+    a strided slice + lax.pad, identical math, one XLA op)."""
+
+    def __init__(self, n_in: int, n_out: int, stride: int) -> None:
+        super().__init__()
+        self.n_in = n_in
+        self.n_out = n_out
+        self.stride = stride
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x = input[:, :, :: self.stride, :: self.stride]
+        if self.n_out > self.n_in:
+            pad = self.n_out - self.n_in
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return x, state
+
+
+def _conv(n_in, n_out, k, stride=1, pad=0, zero_gamma=False):
+    """conv(no bias) → BN → handled by caller; MSRA weight init as in
+    ``ResNet.modelInit``."""
+    return SpatialConvolution(
+        n_in, n_out, k, k, stride, stride, pad, pad, with_bias=False,
+        init_weight=MsraFiller(False),
+    )
+
+
+def _bn(n, zero_gamma=False):
+    bn = SpatialBatchNormalization(n)
+    if zero_gamma:
+        bn.set_init_method(weight_init=Zeros())
+    return bn
+
+
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str):
+    use_conv = shortcut_type == "C" or (shortcut_type == "B" and n_in != n_out)
+    if use_conv:
+        return (
+            Sequential()
+            .add(_conv(n_in, n_out, 1, stride))
+            .add(_bn(n_out))
+        )
+    if n_in != n_out or stride != 1:
+        return _PaddedShortcut(n_in, n_out, stride)
+    return None  # identity
+
+
+def _basic_block(n_in, planes, stride, shortcut_type, zero_gamma):
+    residual = (
+        Sequential()
+        .add(_conv(n_in, planes, 3, stride, 1))
+        .add(_bn(planes))
+        .add(ReLU(True))
+        .add(_conv(planes, planes, 3, 1, 1))
+        .add(_bn(planes, zero_gamma))
+    )
+    return residual, planes
+
+
+def _bottleneck_block(n_in, planes, stride, shortcut_type, zero_gamma):
+    n_out = planes * 4
+    residual = (
+        Sequential()
+        .add(_conv(n_in, planes, 1))
+        .add(_bn(planes))
+        .add(ReLU(True))
+        .add(_conv(planes, planes, 3, stride, 1))
+        .add(_bn(planes))
+        .add(ReLU(True))
+        .add(_conv(planes, n_out, 1))
+        .add(_bn(n_out, zero_gamma))
+    )
+    return residual, n_out
+
+
+def _residual(node, n_in, planes, stride, block_fn, shortcut_type, zero_gamma):
+    """residual(x) + shortcut(x) → ReLU, as a Graph sub-DAG."""
+    residual, n_out = block_fn(n_in, planes, stride, shortcut_type, zero_gamma)
+    res_node = residual.inputs(node)
+    sc = _shortcut(n_in, n_out, stride, shortcut_type)
+    sc_node = node if sc is None else sc.inputs(node)
+    add = CAddTable().inputs(res_node, sc_node)
+    out = ReLU(True).inputs(add)
+    return out, n_out
+
+
+_IMAGENET_CFG: Dict[int, tuple] = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+    200: ("bottleneck", (3, 24, 36, 3)),
+}
+
+
+def ResNet(class_num: int = 1000, opt: Optional[dict] = None) -> Graph:
+    """Reference-compatible entry: ``ResNet(classNum, T("depth" -> 50,
+    "shortcutType" -> "B", "dataSet" -> "ImageNet"))``."""
+    opt = dict(opt or {})
+    depth = int(opt.get("depth", 50))
+    shortcut_type = str(opt.get("shortcutType", opt.get("shortcut_type", "B")))
+    dataset = str(opt.get("dataSet", opt.get("dataset", "ImageNet")))
+    zero_gamma = bool(opt.get("zeroGamma", opt.get("zero_gamma", True)))
+
+    if dataset.lower() == "cifar10":
+        return _resnet_cifar(class_num, depth, shortcut_type, zero_gamma)
+    return _resnet_imagenet(class_num, depth, shortcut_type, zero_gamma)
+
+
+def _resnet_imagenet(class_num, depth, shortcut_type, zero_gamma) -> Graph:
+    if depth not in _IMAGENET_CFG:
+        raise ValueError(f"unsupported ImageNet ResNet depth {depth}")
+    kind, counts = _IMAGENET_CFG[depth]
+    block_fn = _basic_block if kind == "basic" else _bottleneck_block
+
+    inp = Input()
+    x = SpatialConvolution(
+        3, 64, 7, 7, 2, 2, 3, 3, with_bias=False, init_weight=MsraFiller(False)
+    ).inputs(inp)
+    x = _bn(64).inputs(x)
+    x = ReLU(True).inputs(x)
+    x = SpatialMaxPooling(3, 3, 2, 2, 1, 1).inputs(x)
+
+    n_in = 64
+    for stage, (planes, count) in enumerate(zip((64, 128, 256, 512), counts)):
+        for i in range(count):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            x, n_in = _residual(
+                x, n_in, planes, stride, block_fn, shortcut_type, zero_gamma
+            )
+
+    x = SpatialAveragePooling(7, 7, 1, 1).inputs(x)
+    x = Reshape([n_in], batch_mode=True).inputs(x)
+    out = Linear(
+        n_in, class_num, init_weight=Xavier(), init_bias=Zeros()
+    ).inputs(x)
+    return Graph(inp, out)
+
+
+def _resnet_cifar(class_num, depth, shortcut_type, zero_gamma) -> Graph:
+    if (depth - 2) % 6 != 0:
+        raise ValueError("CIFAR ResNet depth must be 6n+2 (20, 32, 44, 56, 110)")
+    n = (depth - 2) // 6
+
+    inp = Input()
+    x = _conv(3, 16, 3, 1, 1).inputs(inp)
+    x = _bn(16).inputs(x)
+    x = ReLU(True).inputs(x)
+
+    n_in = 16
+    for stage, planes in enumerate((16, 32, 64)):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            x, n_in = _residual(
+                x, n_in, planes, stride, _basic_block, shortcut_type, zero_gamma
+            )
+
+    x = SpatialAveragePooling(8, 8, 1, 1).inputs(x)
+    x = Reshape([64], batch_mode=True).inputs(x)
+    x = Linear(64, class_num, init_weight=Xavier(), init_bias=Zeros()).inputs(x)
+    out = LogSoftMax().inputs(x)
+    return Graph(inp, out)

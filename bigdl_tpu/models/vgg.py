@@ -1,0 +1,118 @@
+"""VGG — BASELINE config #2 (VGG-16 / CIFAR-10 / DistriOptimizer).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/vgg/`` —
+``VggForCifar10`` is the conv-BN-ReLU variant ending in two 512-wide FC
+layers with BN + Dropout and LogSoftMax; ``Vgg_16``/``Vgg_19`` are the plain
+ImageNet towers (no BN, 4096-wide FCs).
+
+TPU-native notes: all convs are 3x3 stride-1 — the best possible shape for
+the MXU; BN and ReLU fuse into the conv epilogue under XLA, so the
+conv-BN-ReLU triple costs one fused kernel per layer.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    BatchNormalization, Dropout, Linear, LogSoftMax, ReLU, Reshape, Sequential,
+    SpatialBatchNormalization, SpatialConvolution, SpatialMaxPooling,
+)
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True) -> Sequential:
+    model = Sequential()
+
+    def conv_bn_relu(n_in, n_out):
+        model.add(SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+        model.add(SpatialBatchNormalization(n_out, 1e-3))
+        model.add(ReLU(True))
+
+    conv_bn_relu(3, 64)
+    if has_dropout:
+        model.add(Dropout(0.3))
+    conv_bn_relu(64, 64)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(64, 128)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(128, 128)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(128, 256)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(256, 256)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(256, 256)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(256, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    model.add(Reshape([512], batch_mode=True))
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(512, 512))
+    model.add(BatchNormalization(512))
+    model.add(ReLU(True))
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(512, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def _vgg_tower(cfg, class_num: int, has_dropout: bool = True) -> Sequential:
+    model = Sequential()
+    n_in = 3
+    for item in cfg:
+        if item == "M":
+            model.add(SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(SpatialConvolution(n_in, item, 3, 3, 1, 1, 1, 1))
+            model.add(ReLU(True))
+            n_in = item
+    model.add(Reshape([512 * 7 * 7], batch_mode=True))
+    model.add(Linear(512 * 7 * 7, 4096))
+    model.add(ReLU(True))
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(4096, 4096))
+    model.add(ReLU(True))
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(4096, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int = 1000, has_dropout: bool = True) -> Sequential:
+    return _vgg_tower(
+        [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+        class_num, has_dropout,
+    )
+
+
+def Vgg_19(class_num: int = 1000, has_dropout: bool = True) -> Sequential:
+    return _vgg_tower(
+        [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+        class_num, has_dropout,
+    )

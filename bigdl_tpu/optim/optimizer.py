@@ -36,13 +36,24 @@ logger = logging.getLogger("bigdl_tpu")
 
 
 def _ensure_dataset(dataset, batch_size: Optional[int]) -> AbstractDataSet:
-    if isinstance(dataset, AbstractDataSet):
-        return dataset
-    # raw list of Samples → batched local dataset (pyspark-API convenience)
-    ds = DataSet.array(list(dataset))
-    if batch_size is None:
-        raise ValueError("batch_size required when passing raw samples")
-    return ds.transform(SampleToMiniBatch(batch_size))
+    if dataset is None:
+        raise ValueError(
+            "Optimizer requires a dataset (pass dataset=...; a raw Sample "
+            "sequence also needs batch_size=...)"
+        )
+    if not isinstance(dataset, AbstractDataSet):
+        # raw list of Samples → local dataset (pyspark-API convenience)
+        if batch_size is None:
+            raise ValueError("batch_size required when passing raw samples")
+        dataset = DataSet.array(list(dataset))
+    if batch_size is not None:
+        # Reference semantics: Optimizer(model, sampleRDD, criterion,
+        # batchSize) batches a Sample dataset itself; a dataset already
+        # yielding MiniBatch (Scala-style transformer chain) passes through.
+        probe = next(iter(dataset.data(train=False)), None)
+        if isinstance(probe, Sample):
+            dataset = dataset.transform(SampleToMiniBatch(batch_size))
+    return dataset
 
 
 class Optimizer:
@@ -52,8 +63,9 @@ class Optimizer:
     def __new__(cls, model=None, dataset=None, criterion=None,
                 batch_size: Optional[int] = None, end_trigger=None, **kw):
         if cls is Optimizer:
-            ds = _ensure_dataset(dataset, batch_size)
-            if isinstance(ds, DistributedDataSet) or kw.pop("distributed", False):
+            # dispatch on dataset TYPE only; the side-effecting conversion
+            # (list(), probe, SampleToMiniBatch) happens once, in __init__
+            if isinstance(dataset, DistributedDataSet) or kw.pop("distributed", False):
                 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 
                 inst = object.__new__(DistriOptimizer)
