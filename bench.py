@@ -1,0 +1,76 @@
+"""North-star benchmark: ResNet-50 synthetic-ImageNet training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric (BASELINE.json): ResNet-50 ImageNet images/sec/chip. The reference's
+own MKL-DNN CPU number could not be read this round (empty mount,
+BASELINE.json.published == {}); the recorded proxy baseline is the BigDL
+SoCC'19-era figure of ~50 img/s per 44-core Xeon node for ResNet-50 training
+— `vs_baseline` is computed against that until a measured reference number
+lands in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_NODE = 50.0  # proxy; see module docstring
+
+
+def main() -> None:
+    import jax
+
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.train_step import make_train_step
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(7)
+    batch = 64
+    model = ResNet(class_num=1000, opt={"depth": 50, "shortcutType": "B"})
+    model._ensure_params()
+    criterion = CrossEntropyCriterion()
+    optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+
+    step = jax.jit(make_train_step(model, criterion, optim))
+    params, model_state = model.params, model.state
+    opt_state = optim.init_state(params)
+    rng = jax.random.PRNGKey(0)
+
+    x = jax.device_put(np.random.default_rng(0)
+                       .standard_normal((batch, 3, 224, 224)).astype(np.float32))
+    y = jax.device_put(np.random.default_rng(1)
+                       .integers(1, 1001, size=(batch,)).astype(np.int32))  # 1-based labels
+
+    # compile + warmup
+    params, opt_state, model_state, loss = step(
+        params, opt_state, model_state, rng, x, y)
+    jax.block_until_ready(loss)
+    for _ in range(2):
+        params, opt_state, model_state, loss = step(
+            params, opt_state, model_state, rng, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, model_state, loss = step(
+            params, opt_state, model_state, rng, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / REFERENCE_IMG_PER_SEC_PER_NODE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
