@@ -22,15 +22,17 @@ from bigdl_tpu.nn import (
 )
 
 
-def _conv_relu(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
-    seq = Sequential()
+def _conv_relu(seq: Sequential, n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0,
+               name: str = "") -> Sequential:
+    """Append Xavier-init conv + in-place ReLU to ``seq`` (every conv in this
+    net uses exactly this pattern)."""
     seq.add(
         SpatialConvolution(
             n_in, n_out, kw, kh, sw, sh, pw, ph,
             init_weight=Xavier(), init_bias=Zeros(),
-        ).set_name(name + "conv")
+        ).set_name(name)
     )
-    seq.add(ReLU(True).set_name(name + "relu"))
+    seq.add(ReLU(True))
     return seq
 
 
@@ -41,55 +43,25 @@ def Inception_Layer_v1(input_size: int, config, name_prefix: str = "") -> Concat
     c = [list(branch) for branch in config]
     concat = Concat(2)
 
-    b1 = Sequential()
-    b1.add(
-        SpatialConvolution(
-            input_size, c[0][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name(name_prefix + "1x1")
-    )
-    b1.add(ReLU(True))
+    b1 = _conv_relu(Sequential(), input_size, c[0][0], 1, 1,
+                    name=name_prefix + "1x1")
     concat.add(b1)
 
-    b2 = Sequential()
-    b2.add(
-        SpatialConvolution(
-            input_size, c[1][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name(name_prefix + "3x3_reduce")
-    )
-    b2.add(ReLU(True))
-    b2.add(
-        SpatialConvolution(
-            c[1][0], c[1][1], 3, 3, 1, 1, 1, 1,
-            init_weight=Xavier(), init_bias=Zeros(),
-        ).set_name(name_prefix + "3x3")
-    )
-    b2.add(ReLU(True))
+    b2 = _conv_relu(Sequential(), input_size, c[1][0], 1, 1,
+                    name=name_prefix + "3x3_reduce")
+    _conv_relu(b2, c[1][0], c[1][1], 3, 3, 1, 1, 1, 1,
+               name=name_prefix + "3x3")
     concat.add(b2)
 
-    b3 = Sequential()
-    b3.add(
-        SpatialConvolution(
-            input_size, c[2][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name(name_prefix + "5x5_reduce")
-    )
-    b3.add(ReLU(True))
-    b3.add(
-        SpatialConvolution(
-            c[2][0], c[2][1], 5, 5, 1, 1, 2, 2,
-            init_weight=Xavier(), init_bias=Zeros(),
-        ).set_name(name_prefix + "5x5")
-    )
-    b3.add(ReLU(True))
+    b3 = _conv_relu(Sequential(), input_size, c[2][0], 1, 1,
+                    name=name_prefix + "5x5_reduce")
+    _conv_relu(b3, c[2][0], c[2][1], 5, 5, 1, 1, 2, 2,
+               name=name_prefix + "5x5")
     concat.add(b3)
 
     b4 = Sequential()
     b4.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil().set_name(name_prefix + "pool"))
-    b4.add(
-        SpatialConvolution(
-            input_size, c[3][0], 1, 1, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name(name_prefix + "pool_proj")
-    )
-    b4.add(ReLU(True))
+    _conv_relu(b4, input_size, c[3][0], 1, 1, name=name_prefix + "pool_proj")
     concat.add(b4)
     return concat
 
@@ -97,26 +69,11 @@ def Inception_Layer_v1(input_size: int, config, name_prefix: str = "") -> Concat
 def Inception_v1_NoAuxClassifier(class_num: int = 1000,
                                  has_dropout: bool = True) -> Sequential:
     model = Sequential()
-    model.add(
-        SpatialConvolution(
-            3, 64, 7, 7, 2, 2, 3, 3, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name("conv1/7x7_s2")
-    )
-    model.add(ReLU(True))
+    _conv_relu(model, 3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2")
     model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
     model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
-    model.add(
-        SpatialConvolution(
-            64, 64, 1, 1, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name("conv2/3x3_reduce")
-    )
-    model.add(ReLU(True))
-    model.add(
-        SpatialConvolution(
-            64, 192, 3, 3, 1, 1, 1, 1, init_weight=Xavier(), init_bias=Zeros()
-        ).set_name("conv2/3x3")
-    )
-    model.add(ReLU(True))
+    _conv_relu(model, 64, 64, 1, 1, name="conv2/3x3_reduce")
+    _conv_relu(model, 64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
     model.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
     model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2"))
 

@@ -49,7 +49,7 @@ class _PaddedShortcut(TensorModule):
         return x, state
 
 
-def _conv(n_in, n_out, k, stride=1, pad=0, zero_gamma=False):
+def _conv(n_in, n_out, k, stride=1, pad=0):
     """conv(no bias) → BN → handled by caller; MSRA weight init as in
     ``ResNet.modelInit``."""
     return SpatialConvolution(
@@ -78,7 +78,7 @@ def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str):
     return None  # identity
 
 
-def _basic_block(n_in, planes, stride, shortcut_type, zero_gamma):
+def _basic_block(n_in, planes, stride, zero_gamma):
     residual = (
         Sequential()
         .add(_conv(n_in, planes, 3, stride, 1))
@@ -90,7 +90,7 @@ def _basic_block(n_in, planes, stride, shortcut_type, zero_gamma):
     return residual, planes
 
 
-def _bottleneck_block(n_in, planes, stride, shortcut_type, zero_gamma):
+def _bottleneck_block(n_in, planes, stride, zero_gamma):
     n_out = planes * 4
     residual = (
         Sequential()
@@ -108,7 +108,7 @@ def _bottleneck_block(n_in, planes, stride, shortcut_type, zero_gamma):
 
 def _residual(node, n_in, planes, stride, block_fn, shortcut_type, zero_gamma):
     """residual(x) + shortcut(x) → ReLU, as a Graph sub-DAG."""
-    residual, n_out = block_fn(n_in, planes, stride, shortcut_type, zero_gamma)
+    residual, n_out = block_fn(n_in, planes, stride, zero_gamma)
     res_node = residual.inputs(node)
     sc = _shortcut(n_in, n_out, stride, shortcut_type)
     sc_node = node if sc is None else sc.inputs(node)

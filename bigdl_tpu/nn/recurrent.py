@@ -1,0 +1,461 @@
+"""Recurrent family — cells, unrollers, bidirectional wrapper.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/Recurrent.scala`` (time
+loop + hidden-state management), ``Cell.scala``, ``LSTM.scala``,
+``LSTMPeephole.scala``, ``GRU.scala``, ``RnnCell.scala``,
+``BiRecurrent.scala``, ``RecurrentDecoder.scala``, ``TimeDistributed.scala``.
+
+TPU-native redesign: the reference unrolls time in a serial Scala loop over
+mutable hidden tensors (SURVEY.md §5.7) — one layer call per step, no fusion
+across steps. Here the whole sequence is ONE ``jax.lax.scan``: XLA compiles
+the per-step cell body once, keeps the carry in registers/VMEM, and the
+input/output time axes are laid out as a single HBM array. Gate projections
+for the input leg are batched over ALL timesteps in one big gemm before the
+scan (``x @ W_ih^T`` on the full (B,T,I) array — MXU-friendly), so the scan
+body only carries the hidden-to-hidden gemm.
+
+Layout: activity is ``(batch, time, feature)`` (reference ``batchFirst``
+convention for ``Recurrent``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.module import AbstractModule, TensorModule
+
+
+class Cell(AbstractModule):
+    """Base of recurrent cells.
+
+    Pure single-step contract: ``step(params, x_t, carry) -> (out_t, carry)``
+    with ``init_carry(batch_size)`` building the zero carry. A cell can also
+    be driven through the generic ``apply`` facade, where the input is a list
+    ``[x_t, *carry]`` and the output ``[out_t, *carry]`` (the reference's
+    ``T(input, hidden)`` table convention).
+    """
+
+    # regularizer key sets consumed by optim.train_step's walkers
+    _reg_w_keys = ("w_ih",)
+    _reg_u_keys = ("w_hh",)
+    _reg_b_keys = ("b_ih", "b_hh")
+
+    def __init__(self, hidden_size: int) -> None:
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.p = 0.0  # dropout probability (see Recurrent.apply)
+
+    # number of carry tensors (1 for RNN/GRU, 2 for LSTM)
+    carry_len = 1
+
+    def init_carry(self, batch_size: int):
+        import jax.numpy as jnp
+
+        return tuple(
+            jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+            for _ in range(self.carry_len)
+        )
+
+    def step(self, params, x_t, carry):
+        raise NotImplementedError
+
+    def precompute_input(self, params, x):
+        """Optional whole-sequence input projection done OUTSIDE the scan.
+
+        Returns an array consumed by ``step_pre`` instead of the raw
+        ``x_t``. Default: identity (no precompute).
+        """
+        return x
+
+    def step_pre(self, params, pre_t, carry):
+        """Step consuming a precomputed input slice (default: raw step)."""
+        return self.step(params, pre_t, carry)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x_t, carry = input[0], tuple(input[1:])
+        if not carry:
+            carry = self.init_carry(x_t.shape[0])
+        out, new_carry = self.step(params, x_t, carry)
+        return [out, *new_carry], state
+
+
+class _FusedInputCell(Cell):
+    """Cells whose input leg is one fused gate projection ``x @ w_ih^T + b_ih``
+    — hoisted over the whole sequence (one MXU gemm) by ``Recurrent``."""
+
+    def precompute_input(self, params, x):
+        import jax.numpy as jnp
+
+        return jnp.matmul(x, params["w_ih"].T) + params["b_ih"]
+
+    def step(self, params, x_t, carry):
+        return self.step_pre(params, self.precompute_input(params, x_t), carry)
+
+
+class RnnCell(_FusedInputCell):
+    """Vanilla RNN: h' = act(W_ih x + b_ih + W_hh h + b_hh)
+    (reference ``nn/RnnCell.scala``; both biases kept for torch parity)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: Optional[AbstractModule] = None,
+                 w_regularizer=None, u_regularizer=None,
+                 b_regularizer=None) -> None:
+        super().__init__(hidden_size)
+        from bigdl_tpu.nn.activations import Tanh
+
+        self.input_size = input_size
+        self.activation = activation or Tanh()
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init_params(self, rng):
+        import jax
+
+        k = jax.random.split(rng, 4)
+        u = RandomUniform()
+        return {
+            "w_ih": u.init(k[0], (self.hidden_size, self.input_size)),
+            "w_hh": u.init(k[1], (self.hidden_size, self.hidden_size)),
+            "b_ih": Zeros().init(k[2], (self.hidden_size,)),
+            "b_hh": Zeros().init(k[3], (self.hidden_size,)),
+        }
+
+    def step_pre(self, params, pre_t, carry):
+        import jax.numpy as jnp
+
+        (h,) = carry
+        a = pre_t + jnp.matmul(h, params["w_hh"].T) + params["b_hh"]
+        out, _ = self.activation.apply({}, a, {}, training=False, rng=None)
+        return out, (out,)
+
+
+class LSTM(_FusedInputCell):
+    """LSTM cell (reference ``nn/LSTM.scala``). Gate order i, f, g, o in the
+    fused weight matrices (torch layout, for oracle parity tests)."""
+
+    carry_len = 2  # (h, c)
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None) -> None:
+        super().__init__(hidden_size)
+        self.input_size = input_size
+        self.p = p
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init_params(self, rng):
+        import jax
+
+        k = jax.random.split(rng, 4)
+        u = RandomUniform()
+        H, I = self.hidden_size, self.input_size
+        return {
+            "w_ih": u.init(k[0], (4 * H, I)),
+            "w_hh": u.init(k[1], (4 * H, H)),
+            "b_ih": Zeros().init(k[2], (4 * H,)),
+            "b_hh": Zeros().init(k[3], (4 * H,)),
+        }
+
+    def step_pre(self, params, pre_t, carry):
+        import jax
+        import jax.numpy as jnp
+
+        h, c = carry
+        gates = pre_t + jnp.matmul(h, params["w_hh"].T) + params["b_hh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class LSTMPeephole(LSTM):
+    """LSTM with peephole connections from the cell state into the i/f/o
+    gates (reference ``nn/LSTMPeephole.scala``; diagonal peephole weights)."""
+
+    def init_params(self, rng):
+        import jax
+
+        p = super().init_params(rng)
+        # fresh stream: split(rng, 3) would repeat the first 3 of the
+        # split(rng, 4) the base class already consumed
+        k = jax.random.split(jax.random.fold_in(rng, 1), 3)
+        u = RandomUniform()
+        H = self.hidden_size
+        p["w_pi"] = u.init(k[0], (H,))
+        p["w_pf"] = u.init(k[1], (H,))
+        p["w_po"] = u.init(k[2], (H,))
+        return p
+
+    def step_pre(self, params, pre_t, carry):
+        import jax
+        import jax.numpy as jnp
+
+        h, c = carry
+        gates = pre_t + jnp.matmul(h, params["w_hh"].T) + params["b_hh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["w_pi"] * c)
+        f = jax.nn.sigmoid(f + params["w_pf"] * c)
+        g = jnp.tanh(g)
+        new_c = f * c + i * g
+        o = jax.nn.sigmoid(o + params["w_po"] * new_c)
+        new_h = o * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+
+class GRU(_FusedInputCell):
+    """GRU cell (reference ``nn/GRU.scala``). Gate order r, z, n; separate
+    input/hidden biases so the candidate gate matches torch:
+    n = tanh(W_in x + b_in + r * (W_hn h + b_hn))."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None) -> None:
+        super().__init__(hidden_size)
+        self.input_size = input_size
+        self.p = p
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init_params(self, rng):
+        import jax
+
+        k = jax.random.split(rng, 4)
+        u = RandomUniform()
+        H, I = self.hidden_size, self.input_size
+        return {
+            "w_ih": u.init(k[0], (3 * H, I)),
+            "w_hh": u.init(k[1], (3 * H, H)),
+            "b_ih": Zeros().init(k[2], (3 * H,)),
+            "b_hh": Zeros().init(k[3], (3 * H,)),
+        }
+
+    def step_pre(self, params, pre_t, carry):
+        import jax
+        import jax.numpy as jnp
+
+        (h,) = carry
+        hp = jnp.matmul(h, params["w_hh"].T) + params["b_hh"]
+        xr, xz, xn = jnp.split(pre_t, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1.0 - z) * n + z * h
+        return new_h, (new_h,)
+
+
+class Recurrent(AbstractModule):
+    """Unrolls a cell over the time axis of a ``(batch, time, feature)``
+    input (reference ``nn/Recurrent.scala``); output ``(batch, time, hidden)``.
+
+    The serial reference loop becomes one ``lax.scan``; the input-side gate
+    gemm runs over the whole sequence before the scan (one MXU matmul).
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.cell: Optional[Cell] = None
+        self.reverse = False
+
+    def add(self, cell: Cell) -> "Recurrent":
+        self.cell = cell
+        return self
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return [self.cell] if self.cell is not None else []
+
+    def _key(self) -> str:
+        return f"0:{self.cell.name}"
+
+    def init_params(self, rng):
+        return {self._key(): self.cell.init_params(rng)}
+
+    def init_state(self):
+        return {self._key(): self.cell.init_state()}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        cell, cp = self.cell, params[self._key()]
+        batch = input.shape[0]
+        x = input
+        h_mask = None
+        p = getattr(cell, "p", 0.0)
+        if training and p > 0.0 and rng is not None:
+            # variational dropout (one mask per sequence, shared across
+            # timesteps) on the input and on the recurrent h connection —
+            # the role of the reference cells' dropout `p`
+            k_in, k_h = jax.random.split(rng)
+            keep = 1.0 - p
+            in_mask = jax.random.bernoulli(
+                k_in, keep, (batch, 1) + x.shape[2:]
+            ).astype(x.dtype) / keep
+            x = x * in_mask
+            h_mask = jax.random.bernoulli(
+                k_h, keep, (batch, cell.hidden_size)
+            ).astype(x.dtype) / keep
+        pre = cell.precompute_input(cp, x)           # (B, T, ...)
+        pre_t = jnp.swapaxes(pre, 0, 1)              # (T, B, ...)
+        carry0 = cell.init_carry(batch)
+
+        def body(carry, p_t):
+            if h_mask is not None:
+                carry = (carry[0] * h_mask,) + tuple(carry[1:])
+            out, new_carry = cell.step_pre(cp, p_t, carry)
+            return new_carry, out
+
+        # reverse mode scans from the last timestep; lax.scan stacks each
+        # step's output at its original position, which IS the reversed-RNN
+        # output layout (no explicit flips needed)
+        _, outs = jax.lax.scan(body, carry0, pre_t, reverse=self.reverse)
+        out = jnp.swapaxes(outs, 0, 1)               # (B, T, H)
+        return out, state
+
+
+class BiRecurrent(AbstractModule):
+    """Forward + time-reversed ``Recurrent`` merged per step (reference
+    ``nn/BiRecurrent.scala``; default merge = elementwise add, the
+    reference's ``CAddTable``; ``merge_mode="concat"`` = ``JoinTable``)."""
+
+    def __init__(self, merge: Optional[str] = None) -> None:
+        super().__init__()
+        self.merge_mode = merge or "add"
+        if self.merge_mode not in ("add", "concat"):
+            raise ValueError(f"unknown merge {merge!r}")
+        self.fwd = Recurrent()
+        self.bwd = Recurrent()
+        self.bwd.reverse = True
+
+    def add(self, cell: Cell) -> "BiRecurrent":
+        import copy
+
+        self.fwd.add(cell)
+        bwd_cell = copy.deepcopy(cell)
+        bwd_cell.name = cell.name + "_rev"
+        self.bwd.add(bwd_cell)
+        return self
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return [self.fwd, self.bwd]
+
+    def init_params(self, rng):
+        import jax
+
+        return {
+            f"0:{self.fwd.name}": self.fwd.init_params(jax.random.fold_in(rng, 0)),
+            f"1:{self.bwd.name}": self.bwd.init_params(jax.random.fold_in(rng, 1)),
+        }
+
+    def init_state(self):
+        return {
+            f"0:{self.fwd.name}": self.fwd.init_state(),
+            f"1:{self.bwd.name}": self.bwd.init_state(),
+        }
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        state = state or {}
+        kf, kb = (None, None)
+        if rng is not None:
+            import jax
+
+            kf, kb = jax.random.split(rng)
+        fk, bk = f"0:{self.fwd.name}", f"1:{self.bwd.name}"
+        fo, fs = self.fwd.apply(params[fk], input, state.get(fk, {}),
+                                training=training, rng=kf)
+        bo, bs = self.bwd.apply(params[bk], input, state.get(bk, {}),
+                                training=training, rng=kb)
+        if self.merge_mode == "add":
+            out = fo + bo
+        else:
+            out = jnp.concatenate([fo, bo], axis=-1)
+        return out, {fk: fs, bk: bs}
+
+
+class RecurrentDecoder(AbstractModule):
+    """Decoder unroll (reference ``nn/RecurrentDecoder.scala``): the input is
+    the FIRST timestep ``(batch, feature)``; each step's output feeds the
+    next step's input, for ``output_length`` steps. Requires a cell whose
+    output size equals its input size."""
+
+    def __init__(self, output_length: int) -> None:
+        super().__init__()
+        self.output_length = output_length
+        self.cell: Optional[Cell] = None
+
+    def add(self, cell: Cell) -> "RecurrentDecoder":
+        self.cell = cell
+        return self
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return [self.cell] if self.cell is not None else []
+
+    def _key(self) -> str:
+        return f"0:{self.cell.name}"
+
+    def init_params(self, rng):
+        return {self._key(): self.cell.init_params(rng)}
+
+    def init_state(self):
+        return {self._key(): self.cell.init_state()}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        cell, cp = self.cell, params[self._key()]
+        batch = input.shape[0]
+        carry0 = cell.init_carry(batch)
+
+        def body(loop_carry, _):
+            x_t, carry = loop_carry
+            out, new_carry = cell.step(cp, x_t, carry)
+            return (out, new_carry), out
+
+        _, outs = jax.lax.scan(
+            body, (input, carry0), None, length=self.output_length
+        )
+        return jnp.swapaxes(outs, 0, 1), state
+
+
+class TimeDistributed(TensorModule):
+    """Applies an inner layer independently to every timestep of a
+    ``(batch, time, ...)`` activity (reference ``nn/TimeDistributed.scala``):
+    fold time into batch, run the layer ONCE on the (B·T, ...) array — a
+    single big MXU-friendly call instead of T small ones — and unfold."""
+
+    def __init__(self, layer: AbstractModule) -> None:
+        super().__init__()
+        self.layer = layer
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return [self.layer]
+
+    def _key(self) -> str:
+        return f"0:{self.layer.name}"
+
+    def init_params(self, rng):
+        return {self._key(): self.layer.init_params(rng)}
+
+    def init_state(self):
+        return {self._key(): self.layer.init_state()}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        state = state or {}
+        b, t = input.shape[0], input.shape[1]
+        flat = input.reshape((b * t,) + input.shape[2:])
+        out, s = self.layer.apply(
+            params[self._key()], flat, state.get(self._key(), {}),
+            training=training, rng=rng,
+        )
+        out = out.reshape((b, t) + out.shape[1:])
+        return out, {self._key(): s}

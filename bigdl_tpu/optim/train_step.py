@@ -37,19 +37,26 @@ def apply_module_regularizers(model, params, grads):
     """Apply per-layer regularizers (reference: inside accGradParameters).
 
     Walks the module tree alongside the params pytree; a module with
-    ``w_regularizer``/``b_regularizer`` contributes extra gradient terms for
-    its weight/bias leaves.
+    ``w_regularizer``/``u_regularizer``/``b_regularizer`` contributes extra
+    gradient terms for its weight/recurrent-weight/bias leaves (the key sets
+    come from the module's ``_reg_w_keys``/``_reg_u_keys``/``_reg_b_keys``,
+    so recurrent cells' ``w_ih``/``w_hh``/``b_*`` participate too).
     """
     def walk(module, p, g):
         if not isinstance(p, dict):
             return g
         out = dict(g)
-        wreg = getattr(module, "w_regularizer", None)
-        breg = getattr(module, "b_regularizer", None)
-        if wreg is not None and "weight" in p:
-            out["weight"] = wreg.grad_update(p["weight"], g["weight"])
-        if breg is not None and "bias" in p:
-            out["bias"] = breg.grad_update(p["bias"], g["bias"])
+        for reg_attr, keys_attr, default_keys in (
+            ("w_regularizer", "_reg_w_keys", ("weight",)),
+            ("u_regularizer", "_reg_u_keys", ("w_hh",)),
+            ("b_regularizer", "_reg_b_keys", ("bias", "b_ih", "b_hh")),
+        ):
+            reg = getattr(module, reg_attr, None)
+            if reg is None:
+                continue
+            for key in getattr(module, keys_attr, default_keys):
+                if key in p:
+                    out[key] = reg.grad_update(p[key], g[key])
         subs = module.sub_modules()
         if subs:
             # container keys are "{i}:{name}" (containers) or graph keys
@@ -76,12 +83,17 @@ def regularizer_loss(model, params):
         nonlocal total
         if not isinstance(p, dict):
             return
-        wreg = getattr(module, "w_regularizer", None)
-        breg = getattr(module, "b_regularizer", None)
-        if wreg is not None and "weight" in p:
-            total = total + wreg.loss_term(p["weight"])
-        if breg is not None and "bias" in p:
-            total = total + breg.loss_term(p["bias"])
+        for reg_attr, keys_attr, default_keys in (
+            ("w_regularizer", "_reg_w_keys", ("weight",)),
+            ("u_regularizer", "_reg_u_keys", ("w_hh",)),
+            ("b_regularizer", "_reg_b_keys", ("bias", "b_ih", "b_hh")),
+        ):
+            reg = getattr(module, reg_attr, None)
+            if reg is None:
+                continue
+            for key in getattr(module, keys_attr, default_keys):
+                if key in p:
+                    total = total + reg.loss_term(p[key])
         subs = module.sub_modules()
         if subs:
             for key in p:

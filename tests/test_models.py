@@ -94,7 +94,7 @@ class TestTraining:
 
         rs = np.random.RandomState(0)
         x = rs.rand(8, 3, 32, 32).astype(np.float32)
-        y = rs.randint(0, 10, size=(8,))
+        y = rs.randint(1, 11, size=(8,))  # 1-based labels (Torch convention)
         rng = jax.random.PRNGKey(1)
         losses = []
         for i in range(4):
@@ -105,9 +105,23 @@ class TestTraining:
         assert losses[-1] < losses[0]
 
     def test_zero_gamma_makes_blocks_identity_at_init(self):
-        """With zeroGamma, each residual branch contributes 0 at init, so
-        the net behaves like its plain (non-residual) stem —  outputs must be
-        finite and well-scaled."""
+        """With zeroGamma, each residual branch contributes 0 at init: a
+        basic block's output must equal ReLU(shortcut) == its input for an
+        identity shortcut with non-negative input."""
+        import jax
+
+        from bigdl_tpu.models.resnet import _basic_block
+        from bigdl_tpu.nn import ReLU
+
+        block, _ = _basic_block(8, 8, 1, True)
+        block._ensure_params()
+        block.evaluate()
+        rs = np.random.RandomState(3)
+        x = np.abs(rs.randn(2, 8, 5, 5)).astype(np.float32)  # >= 0
+        res = np.asarray(block.forward(x))
+        # residual branch is exactly zero at init → block + shortcut = x
+        np.testing.assert_allclose(res, np.zeros_like(res), atol=0)
+
         model = ResNet(10, {"depth": 20, "dataSet": "cifar10", "zeroGamma": True})
         _, out = _forward(model, (2, 3, 32, 32))
         assert np.all(np.isfinite(np.asarray(out)))

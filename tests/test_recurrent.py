@@ -1,0 +1,260 @@
+"""Recurrent-family parity vs torch-cpu (the reference's Torch7 oracle
+pattern, SURVEY.md §4) + scan-semantics tests."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+
+def _set_lstm_weights(cell_params, t_lstm):
+    import torch
+
+    with torch.no_grad():
+        t_lstm.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cell_params["w_ih"])))
+        t_lstm.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cell_params["w_hh"])))
+        t_lstm.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cell_params["b_ih"])))
+        t_lstm.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cell_params["b_hh"])))
+
+
+def test_lstm_recurrent_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import LSTM, Recurrent
+
+    B, T, I, H = 3, 5, 4, 6
+    rec = Recurrent().add(LSTM(I, H))
+    rec._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = rec.forward(x)
+
+    t_lstm = torch.nn.LSTM(I, H, batch_first=True)
+    _set_lstm_weights(rec.params[rec._key()], t_lstm)
+    t_out, _ = t_lstm(torch.from_numpy(x))
+    assert_close(out, t_out.detach().numpy(), atol=1e-5)
+
+
+def test_lstm_recurrent_backward_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import LSTM, Recurrent
+
+    B, T, I, H = 2, 4, 3, 5
+    rec = Recurrent().add(LSTM(I, H))
+    rec._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    g = rng.randn(B, T, H).astype(np.float32)
+    rec.forward(x)
+    gin = rec.backward(x, g)
+
+    t_lstm = torch.nn.LSTM(I, H, batch_first=True)
+    _set_lstm_weights(rec.params[rec._key()], t_lstm)
+    tx = torch.from_numpy(x).requires_grad_(True)
+    t_out, _ = t_lstm(tx)
+    t_out.backward(torch.from_numpy(g))
+    assert_close(gin, tx.grad.numpy(), atol=1e-4)
+    cp = rec.grad_params[rec._key()]
+    assert_close(np.asarray(cp["w_ih"]), t_lstm.weight_ih_l0.grad.numpy(), atol=1e-4)
+    assert_close(np.asarray(cp["w_hh"]), t_lstm.weight_hh_l0.grad.numpy(), atol=1e-4)
+    assert_close(np.asarray(cp["b_ih"]), t_lstm.bias_ih_l0.grad.numpy(), atol=1e-4)
+
+
+def test_gru_recurrent_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import GRU, Recurrent
+
+    B, T, I, H = 3, 6, 4, 5
+    rec = Recurrent().add(GRU(I, H))
+    rec._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = rec.forward(x)
+
+    t_gru = torch.nn.GRU(I, H, batch_first=True)
+    cp = rec.params[rec._key()]
+    with torch.no_grad():
+        t_gru.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cp["w_ih"])))
+        t_gru.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cp["w_hh"])))
+        t_gru.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cp["b_ih"])))
+        t_gru.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cp["b_hh"])))
+    t_out, _ = t_gru(torch.from_numpy(x))
+    assert_close(out, t_out.detach().numpy(), atol=1e-5)
+
+
+def test_rnncell_recurrent_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import RnnCell, Recurrent
+
+    B, T, I, H = 2, 5, 3, 4
+    rec = Recurrent().add(RnnCell(I, H))
+    rec._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = rec.forward(x)
+
+    t_rnn = torch.nn.RNN(I, H, nonlinearity="tanh", batch_first=True)
+    cp = rec.params[rec._key()]
+    with torch.no_grad():
+        t_rnn.weight_ih_l0.copy_(torch.from_numpy(np.asarray(cp["w_ih"])))
+        t_rnn.weight_hh_l0.copy_(torch.from_numpy(np.asarray(cp["w_hh"])))
+        t_rnn.bias_ih_l0.copy_(torch.from_numpy(np.asarray(cp["b_ih"])))
+        t_rnn.bias_hh_l0.copy_(torch.from_numpy(np.asarray(cp["b_hh"])))
+    t_out, _ = t_rnn(torch.from_numpy(x))
+    assert_close(out, t_out.detach().numpy(), atol=1e-5)
+
+
+def test_birecurrent_concat_matches_bidirectional_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import LSTM, BiRecurrent
+
+    B, T, I, H = 2, 4, 3, 5
+    bi = BiRecurrent(merge="concat").add(LSTM(I, H))
+    bi._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = bi.forward(x)
+    assert out.shape == (B, T, 2 * H)
+
+    t_lstm = torch.nn.LSTM(I, H, batch_first=True, bidirectional=True)
+    fwd_p = bi.params[f"0:{bi.fwd.name}"][bi.fwd._key()]
+    bwd_p = bi.params[f"1:{bi.bwd.name}"][bi.bwd._key()]
+    with torch.no_grad():
+        t_lstm.weight_ih_l0.copy_(torch.from_numpy(np.asarray(fwd_p["w_ih"])))
+        t_lstm.weight_hh_l0.copy_(torch.from_numpy(np.asarray(fwd_p["w_hh"])))
+        t_lstm.bias_ih_l0.copy_(torch.from_numpy(np.asarray(fwd_p["b_ih"])))
+        t_lstm.bias_hh_l0.copy_(torch.from_numpy(np.asarray(fwd_p["b_hh"])))
+        t_lstm.weight_ih_l0_reverse.copy_(torch.from_numpy(np.asarray(bwd_p["w_ih"])))
+        t_lstm.weight_hh_l0_reverse.copy_(torch.from_numpy(np.asarray(bwd_p["w_hh"])))
+        t_lstm.bias_ih_l0_reverse.copy_(torch.from_numpy(np.asarray(bwd_p["b_ih"])))
+        t_lstm.bias_hh_l0_reverse.copy_(torch.from_numpy(np.asarray(bwd_p["b_hh"])))
+    t_out, _ = t_lstm(torch.from_numpy(x))
+    assert_close(out, t_out.detach().numpy(), atol=1e-5)
+
+
+def test_birecurrent_add_merge(rng):
+    from bigdl_tpu.nn import GRU, BiRecurrent
+
+    B, T, I, H = 2, 3, 4, 4
+    bi = BiRecurrent().add(GRU(I, H))
+    bi._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = bi.forward(x)
+    assert out.shape == (B, T, H)
+    # add-merge must equal fwd + reversed-bwd outputs (same param subtrees)
+    fo, _ = bi.fwd.apply(bi.params[f"0:{bi.fwd.name}"], x, {})
+    bo, _ = bi.bwd.apply(bi.params[f"1:{bi.bwd.name}"], x, {})
+    assert_close(np.asarray(out), np.asarray(fo) + np.asarray(bo), atol=1e-6)
+
+
+def test_lstm_peephole_shapes_and_finiteness(rng):
+    from bigdl_tpu.nn import LSTMPeephole, Recurrent
+
+    rec = Recurrent().add(LSTMPeephole(3, 4))
+    rec._ensure_params()
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    out = rec.forward(x)
+    assert out.shape == (2, 5, 4)
+    assert np.all(np.isfinite(np.asarray(out)))
+    gin = rec.backward(x, np.ones((2, 5, 4), np.float32))
+    assert np.all(np.isfinite(np.asarray(gin)))
+
+
+def test_recurrent_decoder_feeds_output_back(rng):
+    from bigdl_tpu.nn import RnnCell, RecurrentDecoder
+
+    B, HI, T = 2, 4, 6
+    dec = RecurrentDecoder(T).add(RnnCell(HI, HI))
+    dec._ensure_params()
+    x0 = rng.randn(B, HI).astype(np.float32)
+    out = dec.forward(x0)
+    assert out.shape == (B, T, HI)
+    # step 0 must equal one manual cell step from zero carry
+    cell = dec.cell
+    o0, _ = cell.step(dec.params[dec._key()], x0, cell.init_carry(B))
+    assert_close(np.asarray(out)[:, 0], np.asarray(o0), atol=1e-6)
+
+
+def test_time_distributed_matches_per_step_linear(rng):
+    from bigdl_tpu.nn import Linear, TimeDistributed
+
+    B, T, I, O = 3, 4, 5, 2
+    inner = Linear(I, O)
+    td = TimeDistributed(inner)
+    td._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = td.forward(x)
+    assert out.shape == (B, T, O)
+    p = td.params[td._key()]
+    want = np.asarray(x) @ np.asarray(p["weight"]).T + np.asarray(p["bias"])
+    assert_close(np.asarray(out), want, atol=1e-5)
+
+
+def test_cell_regularizer_applied(rng):
+    """w/u regularizers on a cell must contribute gradient terms
+    (key sets w_ih / w_hh, not just 'weight')."""
+    import jax
+
+    from bigdl_tpu.nn import LSTM, Recurrent
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+    from bigdl_tpu.optim.train_step import apply_module_regularizers
+
+    rec = Recurrent().add(LSTM(3, 4, w_regularizer=L2Regularizer(0.5),
+                               u_regularizer=L2Regularizer(0.25)))
+    rec._ensure_params()
+    zeros = jax.tree_util.tree_map(lambda p: np.zeros_like(np.asarray(p)),
+                                   rec.params)
+    out = apply_module_regularizers(rec, rec.params, zeros)
+    cp, op = rec.params[rec._key()], out[rec._key()]
+    assert_close(np.asarray(op["w_ih"]), 0.5 * np.asarray(cp["w_ih"]), atol=1e-6)
+    assert_close(np.asarray(op["w_hh"]), 0.25 * np.asarray(cp["w_hh"]), atol=1e-6)
+    assert_close(np.asarray(op["b_ih"]), np.zeros_like(np.asarray(cp["b_ih"])),
+                 atol=0)
+
+
+def test_cell_dropout_active_in_training_only(rng):
+    import jax
+
+    from bigdl_tpu.nn import LSTM, Recurrent
+
+    rec = Recurrent().add(LSTM(4, 6, p=0.5))
+    rec._ensure_params()
+    x = rng.randn(3, 5, 4).astype(np.float32)
+    k = jax.random.PRNGKey(0)
+    train_a, _ = rec.apply(rec.params, x, {}, training=True, rng=k)
+    train_b, _ = rec.apply(rec.params, x, {}, training=True,
+                           rng=jax.random.PRNGKey(1))
+    eval_a, _ = rec.apply(rec.params, x, {}, training=False, rng=None)
+    eval_b, _ = rec.apply(rec.params, x, {}, training=False, rng=None)
+    assert not np.allclose(np.asarray(train_a), np.asarray(train_b))
+    assert_close(np.asarray(eval_a), np.asarray(eval_b), atol=0)
+    assert not np.allclose(np.asarray(train_a), np.asarray(eval_a))
+
+
+def test_recurrent_trains_under_jit(rng):
+    """A Recurrent model must train end-to-end inside one jitted step."""
+    import jax
+
+    from bigdl_tpu.nn import LSTM, Linear, Recurrent, Select, Sequential
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    model = (Sequential()
+             .add(Recurrent().add(LSTM(4, 8)))
+             .add(Select(2, -1))
+             .add(Linear(8, 3)))
+    model._ensure_params()
+    crit = CrossEntropyCriterion()
+    optim = Adam(learning_rate=1e-2)
+    step = jax.jit(make_train_step(model, crit, optim))
+
+    params, ms = model.params, model.state
+    opt_state = optim.init_state(params)
+    x = rng.randn(8, 6, 4).astype(np.float32)
+    y = (rng.randint(0, 3, size=(8,)) + 1).astype(np.float32)  # 1-based labels
+    losses = []
+    rngk = jax.random.PRNGKey(0)
+    for i in range(30):
+        params, opt_state, ms, loss = step(params, opt_state, ms, rngk, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
