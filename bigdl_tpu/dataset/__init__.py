@@ -6,9 +6,16 @@ from bigdl_tpu.dataset.transformer import (
     ChainedTransformer, FnTransformer, SampleToBatch, SampleToMiniBatch,
     Transformer,
 )
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentence, LabeledSentenceToSample, SentenceToWordIndices,
+    SequenceWindower, TextToLabeledSentence, simple_tokenize,
+)
 
 __all__ = [
     "AbstractDataSet", "DataSet", "DistributedDataSet", "LocalDataSet",
     "MiniBatch", "Sample", "stack_samples", "ChainedTransformer",
     "FnTransformer", "SampleToBatch", "SampleToMiniBatch", "Transformer",
+    "Dictionary", "LabeledSentence", "LabeledSentenceToSample",
+    "SentenceToWordIndices", "SequenceWindower", "TextToLabeledSentence",
+    "simple_tokenize",
 ]

@@ -1,0 +1,44 @@
+"""PTB language model — the reference's ``models/rnn`` zoo member.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/models/rnn/PTBModel.scala``
+— ``LookupTable`` → stacked LSTM ``Recurrent`` layers → per-timestep
+``Linear`` → ``LogSoftMax``; trained with ``TimeDistributedCriterion(
+ClassNLLCriterion)`` over next-word targets. ``SimpleRNN`` is the
+``RnnCell``-based variant from the same directory.
+
+TPU-native notes: each LSTM layer is one ``lax.scan``; the output projection
+runs on the folded ``(B·T, H)`` matrix (one MXU gemm via ``TimeDistributed``)
+and ``LogSoftMax`` is computed on the last axis of the unfolded
+``(B, T, V)`` logits.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn import (
+    Linear, LogSoftMax, LookupTable, LSTM, Recurrent, RnnCell, Sequential,
+    TimeDistributed,
+)
+
+
+def PTBModel(input_size: int, hidden_size: int = 200, output_size: int = None,
+             num_layers: int = 2, key_type: str = "lstm") -> Sequential:
+    """``input_size``/``output_size`` = vocabulary size (1-based ids in,
+    per-step class log-probs out)."""
+    output_size = output_size or input_size
+    model = Sequential()
+    model.add(LookupTable(input_size, hidden_size))
+    in_size = hidden_size
+    for _ in range(num_layers):
+        cell = (LSTM(in_size, hidden_size) if key_type == "lstm"
+                else RnnCell(in_size, hidden_size))
+        model.add(Recurrent().add(cell))
+        in_size = hidden_size
+    model.add(TimeDistributed(Linear(hidden_size, output_size)))
+    model.add(LogSoftMax())  # last-axis log-softmax on (B, T, V)
+    return model
+
+
+def SimpleRNN(input_size: int, hidden_size: int = 200,
+              output_size: int = None) -> Sequential:
+    return PTBModel(input_size, hidden_size, output_size, num_layers=1,
+                    key_type="rnn")

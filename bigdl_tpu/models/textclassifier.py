@@ -1,0 +1,64 @@
+"""BiRecurrent LSTM text classifier — BASELINE config #5.
+
+Reference (UNVERIFIED, SURVEY.md §0):
+``pyspark/bigdl/models/textclassifier/textclassifier.py`` and
+``.../bigdl/example/textclassification/TextClassifier.scala`` — GloVe
+embeddings + ``Recurrent``/``BiRecurrent`` LSTM over the sequence, last
+hidden state → ``Linear`` → ``LogSoftMax``.
+
+Two fronts are provided, matching the reference's two pipelines:
+* ``embedding_input=True`` (reference default): the host pipeline already
+  embedded tokens (GloVe); input is ``(batch, seq, embedding_dim)`` floats.
+* ``embedding_input=False``: a trainable ``LookupTable`` front; input is
+  ``(batch, seq)`` of 1-based word ids (0 = padding → zero vector), as
+  produced by ``bigdl_tpu.dataset.text.SentenceToWordIndices``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn import (
+    BiRecurrent, Linear, LogSoftMax, LookupTable, LSTM, Recurrent, Select,
+    Sequential, TensorModule,
+)
+
+
+class _BiEnds(TensorModule):
+    """(B, T, 2H) bidirectional output → (B, 2H) summary: forward half's
+    LAST step ‖ backward half's FIRST step — the two positions where each
+    direction has consumed the whole sequence (a reversed Recurrent stores
+    step outputs at their original time index, so its full-sequence state
+    sits at t=0, not t=T-1)."""
+
+    def __init__(self, hidden_size: int) -> None:
+        super().__init__()
+        self.hidden_size = hidden_size
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        h = self.hidden_size
+        return jnp.concatenate([input[:, -1, :h], input[:, 0, h:]], axis=-1), state
+
+
+def TextClassifier(class_num: int, embedding_dim: int = 200,
+                   hidden_size: int = 128, vocab_size: Optional[int] = None,
+                   embedding_input: bool = True,
+                   bidirectional: bool = True) -> Sequential:
+    model = Sequential()
+    if not embedding_input:
+        if vocab_size is None:
+            raise ValueError("vocab_size is required with embedding_input=False")
+        model.add(LookupTable(vocab_size, embedding_dim))
+    if bidirectional:
+        model.add(BiRecurrent(merge="concat").add(LSTM(embedding_dim, hidden_size)))
+        model.add(_BiEnds(hidden_size))
+        feat = 2 * hidden_size
+    else:
+        model.add(Recurrent().add(LSTM(embedding_dim, hidden_size)))
+        model.add(Select(2, -1))  # last timestep (1-based dim 2 = time)
+        feat = hidden_size
+    model.add(Linear(feat, class_num))
+    model.add(LogSoftMax())
+    return model

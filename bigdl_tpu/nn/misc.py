@@ -80,6 +80,9 @@ class LookupTable(TensorModule):
             w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
         idx = input.astype(jnp.int32) - 1  # 1-based reference indices
         out = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        # ids < 1 (the text pipeline's padding id 0) embed to the zero
+        # vector — static-shape-friendly padding with no dedicated pad row
+        out = jnp.where((idx < 0)[..., None], 0.0, out)
         if self.padding_value != 0:
             pad_mask = (input.astype(jnp.int32) == self.padding_value)
             out = jnp.where(pad_mask[..., None], 0.0, out)

@@ -1,0 +1,140 @@
+"""Text pipeline + textclassifier/PTB zoo tests (BASELINE config #5 and the
+reference's models/rnn member)."""
+
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def test_dictionary_vocab_and_oov():
+    from bigdl_tpu.dataset import Dictionary
+
+    sents = [["a", "b", "a"], ["a", "c"]]
+    d = Dictionary(sents, vocab_size=2)
+    assert d.vocab_size() == 3  # 2 kept words + OOV slot
+    assert d.get_index("a") == 0  # most frequent first
+    assert d.get_index("zzz") == 2  # OOV → last index
+    assert d.get_word(d.get_index("b")) == "b"
+
+
+def test_text_to_labeled_sentence_next_word():
+    from bigdl_tpu.dataset import Dictionary, TextToLabeledSentence
+
+    d = Dictionary([["x", "y"]])
+    d.add_word("SENTENCE_START")
+    d.add_word("SENTENCE_END")
+    t = TextToLabeledSentence(d)
+    (ls,) = list(t.apply(iter([["x", "y"]])))
+    s, e = d.get_index("SENTENCE_START"), d.get_index("SENTENCE_END")
+    x, y = d.get_index("x"), d.get_index("y")
+    assert ls.data == [s, x, y]
+    assert ls.labels == [x, y, e]
+
+
+def test_labeled_sentence_to_sample_padding_and_ids():
+    from bigdl_tpu.dataset import LabeledSentence, LabeledSentenceToSample
+
+    t = LabeledSentenceToSample(vocab_size=10, sequence_len=5)
+    (smp,) = list(t.apply(iter([LabeledSentence([2, 4, 6], [4, 6, 8])])))
+    feat, lab = smp.feature(), smp.label()
+    assert feat.shape == (5,) and lab.shape == (5,)
+    np.testing.assert_array_equal(feat, [3, 5, 7, 0, 0])     # 1-based, 0 pad
+    np.testing.assert_array_equal(lab, [5, 7, 9, 1, 1])       # 1-based labels
+
+
+def test_sequence_windower_no_padding():
+    from bigdl_tpu.dataset import SequenceWindower
+
+    w = SequenceWindower(3)
+    out = list(w.apply(iter([list(range(10))])))
+    assert [ls.data for ls in out] == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    assert [ls.labels for ls in out] == [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+
+
+def test_lookup_table_pads_to_zero_vector(rng):
+    from bigdl_tpu.nn import LookupTable
+
+    lt = LookupTable(5, 3)
+    lt._ensure_params()
+    out = np.asarray(lt.forward(np.array([[1.0, 0.0, 5.0]], np.float32)))
+    assert out.shape == (1, 3, 3)
+    np.testing.assert_array_equal(out[0, 1], np.zeros(3))  # id 0 → zeros
+    assert_close(out[0, 0], np.asarray(lt.params["weight"])[0], atol=0)
+    assert_close(out[0, 2], np.asarray(lt.params["weight"])[4], atol=0)
+
+
+def test_textclassifier_trains_on_toy_data(rng):
+    """End-to-end: tokens → Dictionary → SentenceToWordIndices →
+    TextClassifier(LookupTable front) learns a separable toy task."""
+    import jax
+
+    from bigdl_tpu.dataset import (
+        DataSet, Dictionary, SentenceToWordIndices, simple_tokenize,
+    )
+    from bigdl_tpu.models import TextClassifier
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, Trigger
+    from bigdl_tpu.optim.optim_method import Adam
+
+    texts = [("good great excellent fine", 1), ("bad awful terrible poor", 2),
+             ("great fine good good", 1), ("poor bad awful awful", 2)] * 8
+    tokenized = [(simple_tokenize(t), lab) for t, lab in texts]
+    d = Dictionary([tok for tok, _ in tokenized])
+    tr = SentenceToWordIndices(d, sequence_len=6)
+    samples = list(tr.apply(iter(tokenized)))
+
+    model = TextClassifier(class_num=2, embedding_dim=8, hidden_size=8,
+                           vocab_size=d.vocab_size(), embedding_input=False)
+    opt = Optimizer(model=model, dataset=DataSet.array(samples),
+                    criterion=ClassNLLCriterion(), batch_size=16)
+    opt.set_optim_method(Adam(learning_rate=1e-2))
+    opt.set_end_when(Trigger.max_epoch(15))
+    trained = opt.optimize()
+
+    xs = np.stack([s.feature() for s in samples])
+    ys = np.array([int(s.label()) for s in samples])
+    trained.evaluate()
+    pred = np.asarray(trained.forward(xs)).argmax(-1) + 1
+    assert (pred == ys).mean() > 0.9
+
+
+def test_ptb_model_shapes_and_lm_training(rng):
+    import jax
+
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion, TimeDistributedCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.optim.train_step import make_train_step
+
+    V, H, B, T = 12, 16, 4, 7
+    model = PTBModel(input_size=V, hidden_size=H, num_layers=2)
+    model._ensure_params()
+    ids = rng.randint(1, V + 1, size=(B, T)).astype(np.float32)
+    out = model.forward(ids)
+    assert out.shape == (B, T, V)
+    # log_softmax rows sum to 1 in prob space
+    assert_close(np.exp(np.asarray(out)).sum(-1), np.ones((B, T)), atol=1e-4)
+
+    crit = TimeDistributedCriterion(ClassNLLCriterion())
+    optim = Adam(learning_rate=5e-2)
+    step = jax.jit(make_train_step(model, crit, optim))
+    params, ms = model.params, model.state
+    opt_state = optim.init_state(params)
+    # memorize a tiny fixed corpus window
+    y = rng.randint(1, V + 1, size=(B, T)).astype(np.float32)
+    k = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(40):
+        params, opt_state, ms, loss = step(params, opt_state, ms, k, ids, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_simple_rnn_variant(rng):
+    from bigdl_tpu.models import SimpleRNN
+
+    model = SimpleRNN(input_size=9, hidden_size=6)
+    model._ensure_params()
+    ids = rng.randint(1, 10, size=(2, 4)).astype(np.float32)
+    out = model.forward(ids)
+    assert out.shape == (2, 4, 9)
