@@ -105,7 +105,15 @@ class AbstractModule:
             m.training()
         return self
 
-    def evaluate(self) -> "AbstractModule":
+    def evaluate(self, dataset=None, methods=None, batch_size: int = 32):
+        """No args: switch to eval mode (reference ``evaluate()``).
+        With a dataset + ValidationMethods: run batched evaluation and
+        return the results (reference ``evaluate(rdd, methods)`` →
+        ``Evaluator`` path, SURVEY.md §3.3)."""
+        if dataset is not None:
+            from bigdl_tpu.optim.evaluator import Evaluator
+
+            return Evaluator(self).test(dataset, methods or [], batch_size)
         self.train_mode = False
         for m in self.sub_modules():
             m.evaluate()
@@ -280,12 +288,25 @@ class AbstractModule:
     # evaluation / prediction conveniences (full versions in optim/)
     # ------------------------------------------------------------------
 
-    def predict(self, inputs) -> Any:
-        """Batched forward in evaluate mode (local predictor)."""
+    def predict(self, inputs, batch_size: int = 32) -> Any:
+        """Batched prediction in evaluate mode (reference
+        ``model.predict`` → Predictor path)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+
         was_training = self.train_mode
-        self.evaluate()
         try:
-            return self.forward(inputs)
+            return Predictor(self).predict(inputs, batch_size)
+        finally:
+            if was_training:
+                self.training()
+
+    def predict_class(self, inputs, batch_size: int = 32):
+        """1-based predicted classes (reference ``predictClass``)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+
+        was_training = self.train_mode
+        try:
+            return Predictor(self).predict_class(inputs, batch_size)
         finally:
             if was_training:
                 self.training()

@@ -4,6 +4,8 @@ from bigdl_tpu.optim.optim_method import (
     SequentialSchedule, SGD, Step, Warmup,
 )
 from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.evaluator import Evaluator, LocalPredictor, Predictor
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
@@ -16,7 +18,8 @@ __all__ = [
     "Adadelta", "Adagrad", "Adam", "Adamax", "Default", "Exponential", "Ftrl",
     "LearningRateSchedule", "MultiStep", "OptimMethod", "Plateau", "Poly",
     "RMSprop", "SequentialSchedule", "SGD", "Step", "Warmup",
-    "LocalOptimizer", "Optimizer", "Trigger",
+    "LocalOptimizer", "Optimizer", "DistriOptimizer", "Trigger",
+    "Evaluator", "LocalPredictor", "Predictor",
     "AccuracyResult", "Loss", "LossResult", "MAE", "Top1Accuracy",
     "Top5Accuracy", "ValidationMethod", "ValidationResult",
     "Metrics", "L1L2Regularizer", "L1Regularizer", "L2Regularizer",

@@ -1,0 +1,133 @@
+"""Evaluator / Predictor — the batched inference plane.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/Evaluator.scala``
+(broadcast model, per-partition batched forward, ``ValidationResult.merge``
+reduce — call stack SURVEY.md §3.3) and ``Predictor.scala`` /
+``LocalPredictor.scala`` (same shape, returns outputs instead of reducing).
+
+TPU-native redesign: "broadcast + mapPartitions" collapses to ONE jitted
+forward. Single chip: plain ``jax.jit``. Mesh: the batch is sharded over the
+``data`` axis (``NamedSharding``) and XLA runs the same program on every
+chip — the reference's executor fan-out with zero explicit comm (metrics
+reduce host-side exactly like ``ValidationResult.merge``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample, stack_samples
+from bigdl_tpu.optim.train_step import make_eval_step
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+
+def _batches(data, batch_size: int):
+    """Normalize list-of-Samples / arrays / DataSets / MiniBatches →
+    MiniBatch stream (DataSet handling shared with the Optimizer)."""
+    if isinstance(data, MiniBatch):
+        yield data
+        return
+    if hasattr(data, "data") and callable(getattr(data, "data")):  # DataSet
+        from bigdl_tpu.optim.optimizer import _ensure_dataset
+
+        yield from _ensure_dataset(data, batch_size).data(train=False)
+        return
+    items = list(data) if not isinstance(data, (list, tuple)) else data
+    if items and isinstance(items[0], Sample):
+        for i in range(0, len(items), batch_size):
+            yield stack_samples(items[i:i + batch_size])
+    else:  # raw feature arrays
+        arr = np.asarray(items, np.float32)
+        for i in range(0, len(arr), batch_size):
+            yield MiniBatch(arr[i:i + batch_size])
+
+
+class Evaluator:
+    """Distributed/batched evaluation of a model against ValidationMethods
+    (reference ``Evaluator(model).test(dataset, methods, batchSize)``)."""
+
+    def __init__(self, model, mesh=None) -> None:
+        self.model = model
+        self.mesh = mesh
+        self._step = None
+
+    def _forward(self, params, model_state, inp):
+        import jax
+
+        if self._step is None:
+            fn = make_eval_step(self.model)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                batch_sh = NamedSharding(self.mesh, P("data"))
+                rep = NamedSharding(self.mesh, P())
+                self._step = jax.jit(
+                    fn, in_shardings=(rep, rep, batch_sh), out_shardings=batch_sh
+                )
+            else:
+                self._step = jax.jit(fn)
+        if self.mesh is not None:
+            # a ragged final batch can't shard N ways — pad rows to the mesh
+            # size (repeating row 0) and trim the outputs back
+            n_dev = int(np.prod(list(self.mesh.shape.values())))
+            n = np.asarray(inp).shape[0] if not isinstance(inp, (list, tuple)) \
+                else np.asarray(inp[0]).shape[0]
+            pad = (-n) % n_dev
+            if pad:
+                def pad_rows(x):
+                    x = np.asarray(x)
+                    return np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
+
+                inp = ([pad_rows(v) for v in inp]
+                       if isinstance(inp, (list, tuple)) else pad_rows(inp))
+                out = self._step(params, model_state, inp)
+                trim = lambda o: o[:n]
+                return ([trim(o) for o in out]
+                        if isinstance(out, (list, tuple)) else trim(out))
+        return self._step(params, model_state, inp)
+
+    def test(self, dataset, methods: Sequence[ValidationMethod],
+             batch_size: int = 32) -> List[ValidationResult]:
+        self.model.evaluate()
+        self.model._ensure_params()
+        params, model_state = self.model.params, self.model.state
+        totals: List[Optional[ValidationResult]] = [None] * len(methods)
+        for batch in _batches(dataset, batch_size):
+            out = self._forward(params, model_state, batch.get_input())
+            tgt = batch.get_target()
+            for i, m in enumerate(methods):
+                r = m.apply(out, tgt)
+                totals[i] = r if totals[i] is None else totals[i] + r
+        return [t for t in totals if t is not None]
+
+
+class Predictor:
+    """Batched prediction (reference ``Predictor.predict/predictClass``)."""
+
+    def __init__(self, model, mesh=None) -> None:
+        self._ev = Evaluator(model, mesh=mesh)
+        self.model = model
+
+    def predict(self, data, batch_size: int = 32):
+        self.model.evaluate()
+        self.model._ensure_params()
+        params, model_state = self.model.params, self.model.state
+        outs = [
+            self._ev._forward(params, model_state, b.get_input())
+            for b in _batches(data, batch_size)
+        ]
+        if outs and isinstance(outs[0], (list, tuple)):  # multi-output model
+            return [
+                np.concatenate([np.asarray(o[i]) for o in outs], axis=0)
+                for i in range(len(outs[0]))
+            ]
+        return np.concatenate([np.asarray(o) for o in outs], axis=0)
+
+    def predict_class(self, data, batch_size: int = 32) -> np.ndarray:
+        """1-based class predictions (Torch convention)."""
+        return self.predict(data, batch_size).argmax(axis=-1) + 1
+
+
+LocalPredictor = Predictor  # single-process alias (reference LocalPredictor)

@@ -322,6 +322,16 @@ class Optimizer:
                 self.train_summary.add_scalar(
                     "Throughput", bsz / max(dt, 1e-9), state["neval"] - 1
                 )
+                sched = getattr(self.optim_method, "learning_rate_schedule", None)
+                base_lr = getattr(self.optim_method, "learning_rate", None)
+                if sched is not None and base_lr is not None:
+                    # jitted optim state's neval counts from 0, host neval
+                    # from 1: the lr JUST applied was sched.lr(neval - 2)
+                    self.train_summary.add_scalar(
+                        "LearningRate",
+                        float(sched.lr(base_lr, max(0, state["neval"] - 2))),
+                        state["neval"] - 1,
+                    )
 
             if seen_this_epoch >= epoch_size:
                 state["epoch_finished"] = True

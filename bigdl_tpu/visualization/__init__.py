@@ -1,0 +1,21 @@
+"""Visualization — TensorBoard summaries (reference layer L10, SURVEY.md §2.9/§5.5).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/visualization/`` —
+``TrainSummary`` (Loss / Throughput / LearningRate scalars, optional
+parameter histograms), ``ValidationSummary`` (per-validation accuracy), both
+written by an in-repo TF-event-file writer with CRC-masked record framing
+(``visualization/tensorboard/{FileWriter, EventWriter}``) so there is no
+TensorFlow dependency. The rebuild keeps that property: the protobuf
+``Event``/``Summary`` encoding and the TFRecord CRC32C framing are
+hand-rolled below (~60 lines), and files are readable by any TensorBoard.
+"""
+
+from bigdl_tpu.visualization.tensorboard import FileWriter, read_scalars
+from bigdl_tpu.visualization.summary import (
+    Summary, TrainSummary, ValidationSummary,
+)
+
+__all__ = [
+    "FileWriter", "read_scalars", "Summary", "TrainSummary",
+    "ValidationSummary",
+]

@@ -1,0 +1,173 @@
+"""Minimal TF-event-file writer/reader (no TensorFlow dependency).
+
+Reference (UNVERIFIED, SURVEY.md §0):
+``.../bigdl/visualization/tensorboard/{FileWriter, EventWriter, Summary}`` —
+BigDL ships its own event writer emitting protobuf ``Event`` records with
+CRC-masked TFRecord framing for exactly the same reason (no TF dep on the
+Spark cluster). Encodings implemented by hand:
+
+* protobuf wire format for the two messages used
+  (``Event``: wall_time=1 double, step=2 int64, file_version=3 string,
+  summary=5 message; ``Summary.Value``: tag=1 string, simple_value=2 float)
+* TFRecord framing: u64-le length, masked-crc32c(length), payload,
+  masked-crc32c(payload).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Dict, List, Tuple
+
+# -- crc32c (Castagnoli, reflected poly 0x82F63B78) ------------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire helpers -------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_varint(num: int, val: int) -> bytes:
+    return _varint(num << 3) + _varint(val)
+
+
+def _field_double(num: int, val: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", val)
+
+
+def _field_float(num: int, val: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", val)
+
+
+def _field_bytes(num: int, val: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(val)) + val
+
+
+def scalar_event(tag: str, value: float, step: int,
+                 wall_time: float | None = None) -> bytes:
+    sv = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    summary = _field_bytes(1, sv)
+    return (_field_double(1, wall_time if wall_time is not None else time.time())
+            + _field_varint(2, int(step))
+            + _field_bytes(5, summary))
+
+
+def version_event() -> bytes:
+    return (_field_double(1, time.time())
+            + _field_bytes(3, b"brain.Event:2"))
+
+
+def frame_record(payload: bytes) -> bytes:
+    header = struct.pack("<Q", len(payload))
+    return (header + struct.pack("<I", _masked_crc(header))
+            + payload + struct.pack("<I", _masked_crc(payload)))
+
+
+class FileWriter:
+    """Append-only event-file writer (reference ``tensorboard/FileWriter``).
+    File name follows the TB convention ``events.out.tfevents.<ts>.<tag>``."""
+
+    def __init__(self, log_dir: str, suffix: str = "bigdl_tpu") -> None:
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(
+            log_dir, f"events.out.tfevents.{int(time.time()*1e6)}.{suffix}"
+        )
+        self._f = open(self.path, "ab")
+        self._f.write(frame_record(version_event()))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._f.write(frame_record(scalar_event(tag, value, step)))
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# -- reader (for tests and BigDL-style readScalar) -------------------------
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _parse_event(buf: bytes) -> Dict:
+    i, out = 0, {}
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        num, wire = key >> 3, key & 7
+        if wire == 1:
+            val = struct.unpack_from("<d", buf, i)[0]; i += 8
+        elif wire == 5:
+            val = struct.unpack_from("<f", buf, i)[0]; i += 4
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]; i += ln
+        else:
+            val, i = _read_varint(buf, i)
+        out.setdefault(num, []).append(val)
+    return out
+
+
+def read_scalars(path: str) -> List[Tuple[str, float, int]]:
+    """Parse an event file back into (tag, value, step) triples."""
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    i = 0
+    while i + 12 <= len(data):
+        (ln,) = struct.unpack_from("<Q", data, i)
+        payload = data[i + 12:i + 12 + ln]
+        i += 12 + ln + 4
+        ev = _parse_event(payload)
+        step = ev.get(2, [0])[0]
+        for summary in ev.get(5, []):
+            for value_msg in _parse_event(summary).get(1, []):
+                v = _parse_event(value_msg)
+                if 1 in v and 2 in v:
+                    out.append((v[1][0].decode(), v[2][0], step))
+    return out

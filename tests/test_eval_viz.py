@@ -1,0 +1,169 @@
+"""Evaluator/Predictor plane + TensorBoard summary round-trip tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def _toy_classifier(rng, n_feat=6, n_cls=3):
+    from bigdl_tpu.nn import Linear, LogSoftMax, Sequential
+
+    m = Sequential().add(Linear(n_feat, n_cls)).add(LogSoftMax())
+    m._ensure_params()
+    return m
+
+
+def _toy_samples(rng, n=32, n_feat=6, n_cls=3):
+    from bigdl_tpu.dataset.sample import Sample
+
+    return [
+        Sample(rng.randn(n_feat).astype(np.float32),
+               np.float32(rng.randint(1, n_cls + 1)))
+        for _ in range(n)
+    ]
+
+
+def test_evaluator_top1_counts(rng):
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+
+    m = _toy_classifier(rng)
+    samples = _toy_samples(rng)
+    (res,) = Evaluator(m).test(samples, [Top1Accuracy()], batch_size=8)
+    acc, total = res.result()
+    assert total == 32
+    # cross-check against a manual forward
+    xs = np.stack([s.feature() for s in samples])
+    ys = np.array([int(s.label()) for s in samples])
+    pred = np.asarray(m.forward(xs)).argmax(-1) + 1
+    assert acc == pytest.approx((pred == ys).mean())
+
+
+def test_module_evaluate_overload_and_predict_class(rng):
+    from bigdl_tpu.optim import Top1Accuracy
+
+    m = _toy_classifier(rng)
+    samples = _toy_samples(rng, n=16)
+    (res,) = m.evaluate(samples, [Top1Accuracy()], batch_size=4)
+    _, total = res.result()
+    assert total == 16
+    # predict/predict_class on raw arrays
+    xs = np.stack([s.feature() for s in samples])
+    probs = m.predict(xs, batch_size=4)
+    assert probs.shape == (16, 3)
+    cls = m.predict_class(xs, batch_size=4)
+    assert cls.min() >= 1 and cls.max() <= 3
+    np.testing.assert_array_equal(cls, probs.argmax(-1) + 1)
+
+
+def test_predict_restores_training_mode(rng):
+    m = _toy_classifier(rng)
+    m.training()
+    xs = rng.randn(4, 6).astype(np.float32)
+    m.predict(xs)
+    assert m.is_training() is True
+    m.evaluate()
+    m.predict(xs)
+    assert m.is_training() is False
+
+
+def test_evaluator_on_mesh(rng):
+    """Distributed eval: batch sharded over the 8-device CPU mesh."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.optim import Evaluator, Loss, Top1Accuracy
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("data",))
+    m = _toy_classifier(rng)
+    samples = _toy_samples(rng, n=64)
+    res = Evaluator(m, mesh=mesh).test(samples, [Top1Accuracy()], batch_size=16)
+    (acc_res,) = res
+    _, total = acc_res.result()
+    assert total == 64
+    # ragged final batch (20 % 16 = 4 rows, not divisible by the 8-dev mesh)
+    ragged = _toy_samples(rng, n=20)
+    (r,) = Evaluator(m, mesh=mesh).test(ragged, [Top1Accuracy()], batch_size=16)
+    _, total = r.result()
+    assert total == 20
+
+
+def test_evaluator_accepts_dataset_and_respects_batch_size(rng):
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+
+    m = _toy_classifier(rng)
+    samples = _toy_samples(rng, n=24)
+    (res,) = Evaluator(m).test(DataSet.array(samples), [Top1Accuracy()],
+                               batch_size=8)
+    _, total = res.result()
+    assert total == 24
+
+
+def test_biends_uses_backward_first_step(rng):
+    """The backward half of the classifier feature must see the WHOLE
+    sequence, not just the final (often padding) token."""
+    from bigdl_tpu.models import TextClassifier
+
+    m = TextClassifier(class_num=2, embedding_dim=4, hidden_size=4,
+                       vocab_size=10, embedding_input=False)
+    m._ensure_params()
+    m.evaluate()
+    x = rng.randint(1, 11, size=(2, 6)).astype(np.float32)
+    base = np.asarray(m.forward(x))
+    x2 = x.copy()
+    x2[:, 0] = (x2[:, 0] % 10) + 1  # perturb FIRST token
+    changed = np.asarray(m.forward(x2))
+    assert not np.allclose(base, changed)
+
+
+def test_tfevent_crc32c_known_vector():
+    from bigdl_tpu.visualization.tensorboard import crc32c
+
+    # known vectors: 32 zero bytes and "123456789"
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_summary_roundtrip(tmp_path):
+    from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+    ts = TrainSummary(str(tmp_path), "app")
+    for i in range(5):
+        ts.add_scalar("Loss", 1.0 / (i + 1), i)
+        ts.add_scalar("Throughput", 100.0 + i, i)
+    ts.close()
+    hist = TrainSummary(str(tmp_path), "app").read_scalar("Loss")
+    got = {s: v for s, v in hist}
+    for i in range(5):
+        assert got[i] == pytest.approx(1.0 / (i + 1))
+
+    vs = ValidationSummary(str(tmp_path), "app")
+    vs.add_scalar("Top1Accuracy", 0.5, 10)
+    vs.close()
+    hist = ValidationSummary(str(tmp_path), "app").read_scalar("Top1Accuracy")
+    assert (10, pytest.approx(0.5)) in [(s, v) for s, v in hist]
+
+
+def test_optimizer_writes_summaries(tmp_path, rng):
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+    m = _toy_classifier(rng)
+    samples = _toy_samples(rng)
+    opt = Optimizer(model=m, dataset=DataSet.array(samples),
+                    criterion=ClassNLLCriterion(), batch_size=8)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(Trigger.max_epoch(2))
+    ts = TrainSummary(str(tmp_path), "job")
+    vs = ValidationSummary(str(tmp_path), "job")
+    opt.set_train_summary(ts)
+    opt.set_val_summary(vs)
+    opt.set_validation(Trigger.every_epoch(), samples, [Top1Accuracy()],
+                       batch_size=8)
+    opt.optimize()
+    assert len(ts.read_scalar("Loss")) == 8  # 4 iters/epoch × 2 epochs
+    assert len(ts.read_scalar("LearningRate")) == 8
+    assert len(vs.read_scalar("Top1Accuracy")) == 2
