@@ -24,6 +24,7 @@ from bigdl_tpu.nn.misc import (
     Dropout, LookupTable, MulConstant, AddConstant, Power, Square, Sqrt, Abs,
     Log, Exp, Clamp, Mean, Sum, Max, Min, MM, MV, Mul, Add, CMul, CAdd,
 )
+from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, BiRecurrent,
     RecurrentDecoder, TimeDistributed,

@@ -1,0 +1,78 @@
+"""MultiHeadAttention layer (long-context extension; no reference
+counterpart — SURVEY.md §5.7 documents the reference as attention-free).
+
+A standard pre-projection MHA over ``(batch, time, hidden)`` activities that
+slots into Sequential/Graph like any other layer. ``sequence_parallel``
+selects the distributed attention algorithm when the model runs inside a
+``shard_map`` with a sequence mesh axis:
+
+* ``None``      — dense local attention (single chip / no SP)
+* ``"ring"``    — blockwise ring attention over ``sp_axis`` (ICI ppermute)
+* ``"ulysses"`` — all-to-all head-sharded attention over ``sp_axis``
+
+The projections are plain MXU gemms; attention math lives in
+``bigdl_tpu.parallel.ring_attention``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.init_methods import RandomUniform, Zeros, Xavier
+from bigdl_tpu.nn.module import TensorModule
+
+
+class MultiHeadAttention(TensorModule):
+    def __init__(self, hidden_size: int, n_heads: int, causal: bool = False,
+                 sequence_parallel: Optional[str] = None,
+                 sp_axis: str = "seq") -> None:
+        super().__init__()
+        if hidden_size % n_heads:
+            raise ValueError(f"hidden {hidden_size} % heads {n_heads} != 0")
+        if sequence_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(f"unknown sequence_parallel {sequence_parallel!r}")
+        self.hidden_size = hidden_size
+        self.n_heads = n_heads
+        self.head_dim = hidden_size // n_heads
+        self.causal = causal
+        self.sequence_parallel = sequence_parallel
+        self.sp_axis = sp_axis
+
+    def init_params(self, rng):
+        import jax
+
+        ks = jax.random.split(rng, 4)
+        init = Xavier()
+        H = self.hidden_size
+        return {
+            name: {"weight": init.init(k, (H, H)),
+                   "bias": Zeros().init(k, (H,))}
+            for name, k in zip(("wq", "wk", "wv", "wo"), ks)
+        }
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        from bigdl_tpu.parallel.ring_attention import (
+            attention, ring_attention, ulysses_attention,
+        )
+
+        B, T, _ = input.shape
+
+        def proj(p, x):
+            return jnp.matmul(x, p["weight"].T) + p["bias"]
+
+        def split(x):  # (B, T, H*D) -> (B, T, H, D)
+            return x.reshape(B, T, self.n_heads, self.head_dim)
+
+        q = split(proj(params["wq"], input))
+        k = split(proj(params["wk"], input))
+        v = split(proj(params["wv"], input))
+        if self.sequence_parallel == "ring":
+            out = ring_attention(q, k, v, self.sp_axis, causal=self.causal)
+        elif self.sequence_parallel == "ulysses":
+            out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal)
+        else:
+            out = attention(q, k, v, causal=self.causal)
+        out = out.reshape(B, T, self.hidden_size)
+        return proj(params["wo"], out), state

@@ -2,5 +2,11 @@
 (reference layer L7, SURVEY.md §2.4 / §5.8)."""
 
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter, flatten_params
+from bigdl_tpu.parallel.ring_attention import (
+    attention, ring_attention, ulysses_attention,
+)
 
-__all__ = ["AllReduceParameter", "flatten_params"]
+__all__ = [
+    "AllReduceParameter", "flatten_params",
+    "attention", "ring_attention", "ulysses_attention",
+]

@@ -1,0 +1,134 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+No reference counterpart (SURVEY.md §5.7: the reference predates attention;
+its only sequence handling is a serial ``Recurrent`` loop). These are the
+framework's first-class long-context primitives, designed for the TPU
+interconnect:
+
+* **Ring attention** (blockwise, online-softmax): each chip holds one
+  sequence shard of Q/K/V; K/V blocks rotate around the ring with
+  ``lax.ppermute`` (nearest-neighbour ICI hops) while each chip accumulates
+  its Q-block's attention with the streaming max/sum rescaling — full
+  attention over N·T tokens with T-sized memory per chip and no all-gather.
+* **Ulysses attention** (all-to-all): ``lax.all_to_all`` re-shards from
+  sequence-sharded to head-sharded, runs dense local attention per head
+  group, and re-shards back — cheaper for moderate sequence lengths when
+  heads ≥ chips.
+
+Both are pure functions usable inside any ``shard_map`` over a mesh axis
+(tested on the 8-virtual-device CPU mesh exactly like the DP plane).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+
+def _local_attention(q, k, v, scale: float, causal: bool,
+                     q_offset=0, k_offset=0):
+    """Dense softmax attention on local blocks.
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D); offsets give the blocks' global
+    positions for causal masking across sequence shards.
+    """
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return out / jnp.maximum(p.sum(-1)[..., None].swapaxes(1, 2), 1e-20)
+
+
+def attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Single-device multi-head attention, (B, T, H, D) layout."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _local_attention(q, k, v, scale, causal)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention inside a ``shard_map`` over ``axis_name``.
+
+    q/k/v: this chip's sequence shard, (B, T_local, H, D); the global
+    sequence is the concatenation over the mesh axis in axis-index order.
+    Returns the (B, T_local, H, D) attention output for the local Q block.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    q_off = my * T
+
+    # online-softmax running state per (B, H, Tq), derived FROM q so the
+    # accumulators inherit q's device-varying axes and the fori_loop carry
+    # types line up with the permuted K/V blocks (jax 0.9 vma tracking)
+    base = jnp.sum(q.astype(jnp.float32) * 0.0, axis=-1).transpose(0, 2, 1)
+    m0 = base - jnp.inf                      # (B, H, T)
+    l0 = base                                # (B, H, T)
+    o0 = q.astype(jnp.float32) * 0.0         # (B, T, H, D)
+
+    # ring: after `step` rotations this chip holds the K/V block that
+    # ORIGINATED at axis index (my - step) mod n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        kb, vb, m, l, o = carry
+        src = (my - step) % n
+        k_off = src * T
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb).astype(jnp.float32) * scale
+        if causal:
+            qpos = q_off + jnp.arange(T)
+            kpos = k_off + jnp.arange(T)
+            s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None],
+                          s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(-1))
+        # blocks can be fully masked (-inf): keep the correction finite
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * corr + p.sum(-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return kb, vb, m_new, l, o
+
+    _, _, _, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    out = o / jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-20)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all sequence parallelism inside a ``shard_map``: re-shard
+    (B, T_local, H, D) → (B, T_global, H_local, D), attend densely, and
+    re-shard back. Requires H divisible by the axis size."""
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    def seq_to_heads(x):  # gather seq (axis 1), scatter heads (axis 2)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _local_attention(qg, kg, vg, scale, causal)
+    return heads_to_seq(out)
