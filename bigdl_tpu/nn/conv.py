@@ -139,6 +139,8 @@ class SpatialFullConvolution(TensorModule):
         init_bias: Optional[InitializationMethod] = None,
     ) -> None:
         super().__init__()
+        assert n_input_plane % n_group == 0, "input planes must divide groups"
+        assert n_output_plane % n_group == 0, "output planes must divide groups"
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w = kernel_w
@@ -172,19 +174,31 @@ class SpatialFullConvolution(TensorModule):
 
     def apply(self, params, input, state=None, training=False, rng=None):
         import jax.lax as lax
+        import jax.numpy as jnp
 
         squeeze_batch = input.ndim == 3
         x = input[None] if squeeze_batch else input
-        out = lax.conv_transpose(
+        # transposed conv == conv with lhs dilation (the gradient-of-conv
+        # formulation); kernel goes (in, out/g, kh, kw) -> (out, in/g, kh, kw)
+        # with spatial flip, grouped along the output dim
+        g = self.n_group
+        kh, kw = self.kernel_h, self.kernel_w
+        w = params["weight"]
+        in_pl = w.shape[0]
+        w = w.reshape(g, in_pl // g, -1, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(-1, in_pl // g, kh, kw)
+        w = w[:, :, ::-1, ::-1]
+        out = lax.conv_general_dilated(
             x,
-            params["weight"],
-            strides=(self.stride_h, self.stride_w),
+            w,
+            window_strides=(1, 1),
             padding=(
-                (self.pad_h, self.pad_h - self.adj_h),
-                (self.pad_w, self.pad_w - self.adj_w),
+                (kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h),
+                (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w),
             ),
-            dimension_numbers=("NCHW", "IOHW", "NCHW"),
-            transpose_kernel=True,
+            lhs_dilation=(self.stride_h, self.stride_w),
+            feature_group_count=g,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
         )
         if self.with_bias:
             out = out + params["bias"][None, :, None, None]

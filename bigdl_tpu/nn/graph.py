@@ -61,7 +61,12 @@ class Graph(AbstractModule):
         self._single_input = not isinstance(input, (list, tuple))
         self._single_output = not isinstance(output, (list, tuple))
         self.topo: List[ModuleNode] = self._topo_sort()
-        # one params subtree per distinct module (shared nodes share params)
+        self._rebuild_keys()
+
+    def _rebuild_keys(self) -> None:
+        """Derive params keys from topo order + module names. Keys are
+        position-based (never ``id()``-based) so they are stable across
+        serialization round-trips; called again from ``__setstate__``."""
         self._module_keys: Dict[int, str] = {}
         seen: Dict[int, AbstractModule] = {}
         for node in self.topo:
@@ -70,6 +75,17 @@ class Graph(AbstractModule):
                 seen[mid] = node.module
                 self._module_keys[mid] = f"{len(seen) - 1}:{node.module.name}"
         self._distinct_modules = list(seen.values())
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        # id()-keyed caches don't survive a round-trip; rebuilt on load
+        d.pop("_module_keys", None)
+        d.pop("_distinct_modules", None)
+        return d
+
+    def __setstate__(self, d):
+        super().__setstate__(d)
+        self._rebuild_keys()
 
     def _topo_sort(self) -> List[ModuleNode]:
         order: List[ModuleNode] = []

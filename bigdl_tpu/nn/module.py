@@ -261,6 +261,21 @@ class AbstractModule:
         )
         return self
 
+    def save_module(self, path: str, over_write: bool = False) -> "AbstractModule":
+        """Versioned structured snapshot (reference ``saveModule`` — the
+        protobuf path, vs ``save``'s legacy serialization)."""
+        from bigdl_tpu.utils.serializer import save_module
+
+        save_module(self, path, over_write=over_write)
+        return self
+
+    @staticmethod
+    def load_module(path: str) -> "AbstractModule":
+        """Load a :meth:`save_module` snapshot (reference ``loadModule``)."""
+        from bigdl_tpu.utils.serializer import load_module
+
+        return load_module(path)
+
     @staticmethod
     def load(path: str) -> "AbstractModule":
         from bigdl_tpu.utils.file_io import File

@@ -250,3 +250,28 @@ def test_lookup_table(rng):
     assert out.shape == (2, 2, 4)
     assert_close(out[0, 0], w[0])
     assert_close(out[1, 0], w[9])
+
+
+def test_spatial_full_convolution_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialFullConvolution
+
+    for g, s, p, adj in [(1, 1, 0, 0), (1, 2, 1, 1), (2, 2, 1, 0)]:
+        layer = SpatialFullConvolution(4, 6, 3, 3, s, s, p, p,
+                                       adj_w=adj, adj_h=adj, n_group=g)
+        layer._ensure_params()
+        tl = torch.nn.ConvTranspose2d(4, 6, 3, stride=s, padding=p,
+                                      output_padding=adj, groups=g)
+        with torch.no_grad():
+            tl.weight.copy_(torch.from_numpy(np.asarray(layer.params["weight"]).copy()))
+            tl.bias.copy_(torch.from_numpy(np.asarray(layer.params["bias"]).copy()))
+        x = rng.randn(2, 4, 5, 5).astype(np.float32)
+        out = layer.forward(x)
+        t_out, t_gin, t_grads = torch_forward_backward(
+            tl, x, np.ones_like(np.asarray(out)))
+        assert_close(out, t_out, atol=1e-4, msg=f"g={g} s={s} p={p} adj={adj}")
+        gin = layer.backward(x, np.ones_like(np.asarray(out)))
+        assert_close(gin, t_gin, atol=1e-4)
+        assert_close(np.asarray(layer.grad_params["weight"]), t_grads["weight"],
+                     atol=1e-3)

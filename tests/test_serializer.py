@@ -1,0 +1,216 @@
+"""Reflective serialization round-trip sweep.
+
+Mirrors the reference's ``SerializerSpec.scala`` (SURVEY.md §4): enumerate
+every exported module class, round-trip each through the structured
+``save_module``/``load_module`` format, and diff forward outputs — so no
+layer can silently miss serialization support.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import AbstractModule
+from tests.oracle import assert_close
+
+R = np.random.default_rng(7)
+
+
+def x(*shape):
+    return R.standard_normal(shape).astype(np.float32)
+
+
+def _seq():
+    return nn.Sequential().add(nn.Linear(4, 3)).add(nn.ReLU())
+
+
+def _recurrent():
+    return nn.Recurrent().add(nn.LSTM(3, 4))
+
+
+def _graph():
+    inp = nn.Input()
+    a = nn.Linear(4, 4).inputs(inp)
+    b = nn.ReLU().inputs(a)
+    c = nn.CAddTable().inputs(a, b)  # diamond: shared predecessor
+    return nn.Graph(inp, c)
+
+
+# name -> (factory, example_input); input None = layer takes a table/list
+FACTORIES = {
+    "Abs": (lambda: nn.Abs(), x(2, 3)),
+    "Add": (lambda: nn.Add(4), x(2, 4)),
+    "AddConstant": (lambda: nn.AddConstant(1.5), x(2, 3)),
+    "BatchNormalization": (lambda: nn.BatchNormalization(4), x(3, 4)),
+    "BiRecurrent": (lambda: nn.BiRecurrent().add(nn.GRU(3, 4)), x(2, 5, 3)),
+    "Bottle": (lambda: nn.Bottle(nn.Linear(4, 3), 2, 2), x(2, 5, 4)),
+    "CAdd": (lambda: nn.CAdd((3,)), x(2, 3)),
+    "CAddTable": (lambda: nn.CAddTable(), [x(2, 3), x(2, 3)]),
+    "CDivTable": (lambda: nn.CDivTable(), [x(2, 3), x(2, 3) + 3.0]),
+    "CMul": (lambda: nn.CMul((3,)), x(2, 3)),
+    "CMulTable": (lambda: nn.CMulTable(), [x(2, 3), x(2, 3)]),
+    "CSubTable": (lambda: nn.CSubTable(), [x(2, 3), x(2, 3)]),
+    "Clamp": (lambda: nn.Clamp(-0.5, 0.5), x(2, 3)),
+    "Concat": (lambda: nn.Concat(2).add(nn.Linear(4, 2)).add(nn.Linear(4, 3)), x(2, 4)),
+    "ConcatTable": (lambda: nn.ConcatTable().add(nn.Linear(4, 2)).add(nn.Linear(4, 2)), x(2, 4)),
+    "Contiguous": (lambda: nn.Contiguous(), x(2, 3)),
+    "Dropout": (lambda: nn.Dropout(0.5), x(2, 3)),
+    "ELU": (lambda: nn.ELU(), x(2, 3)),
+    "Echo": (lambda: nn.Echo(), x(2, 3)),
+    "Exp": (lambda: nn.Exp(), x(2, 3)),
+    "FlattenTable": (lambda: nn.FlattenTable(), [x(2, 3), [x(2, 3), x(2, 3)]]),
+    "GELU": (lambda: nn.GELU(), x(2, 3)),
+    "GRU": (lambda: nn.GRU(3, 4), None),
+    "Graph": (_graph, x(2, 4)),
+    "HardTanh": (lambda: nn.HardTanh(), x(2, 3)),
+    "Identity": (lambda: nn.Identity(), x(2, 3)),
+    "JoinTable": (lambda: nn.JoinTable(1, 2), [x(2, 3), x(2, 3)]),
+    "LSTM": (lambda: nn.LSTM(3, 4), None),
+    "LSTMPeephole": (lambda: nn.LSTMPeephole(3, 4), None),
+    "LeakyReLU": (lambda: nn.LeakyReLU(), x(2, 3)),
+    "Linear": (lambda: nn.Linear(4, 3), x(2, 4)),
+    "Log": (lambda: nn.Log(), np.abs(x(2, 3)) + 0.1),
+    "LogSoftMax": (lambda: nn.LogSoftMax(), x(2, 3)),
+    "LookupTable": (lambda: nn.LookupTable(10, 4), np.array([[1, 2], [3, 4]], np.int32)),
+    "MM": (lambda: nn.MM(), [x(2, 3, 4), x(2, 4, 5)]),
+    "MV": (lambda: nn.MV(), [x(2, 3, 4), x(2, 4)]),
+    "MapTable": (lambda: nn.MapTable(nn.Linear(4, 3)), [x(2, 4), x(2, 4)]),
+    "Max": (lambda: nn.Max(1), x(2, 3)),
+    "Mean": (lambda: nn.Mean(1), x(2, 3)),
+    "Min": (lambda: nn.Min(1), x(2, 3)),
+    "Mul": (lambda: nn.Mul(), x(2, 3)),
+    "MulConstant": (lambda: nn.MulConstant(2.0), x(2, 3)),
+    "MultiHeadAttention": (lambda: nn.MultiHeadAttention(8, 2), x(2, 5, 8)),
+    "Narrow": (lambda: nn.Narrow(1, 0, 2), x(2, 4)),
+    "Normalize": (lambda: nn.Normalize(2.0), x(2, 3)),
+    "PReLU": (lambda: nn.PReLU(), x(2, 3)),
+    "Padding": (lambda: nn.Padding(1, 2, 2), x(2, 3)),
+    "ParallelTable": (lambda: nn.ParallelTable().add(nn.Linear(4, 2)).add(nn.Linear(3, 2)),
+                      [x(2, 4), x(2, 3)]),
+    "Power": (lambda: nn.Power(2.0), np.abs(x(2, 3)) + 0.1),
+    "ReLU": (lambda: nn.ReLU(), x(2, 3)),
+    "ReLU6": (lambda: nn.ReLU6(), x(2, 3)),
+    "Recurrent": (_recurrent, x(2, 5, 3)),
+    "RecurrentDecoder": (lambda: nn.RecurrentDecoder(4).add(nn.RnnCell(3, 3)), x(2, 3)),
+    "Reshape": (lambda: nn.Reshape([6]), x(2, 2, 3)),
+    "RnnCell": (lambda: nn.RnnCell(3, 4), None),
+    "Select": (lambda: nn.Select(1, 1), x(2, 4)),
+    "Sequential": (_seq, x(2, 4)),
+    "Sigmoid": (lambda: nn.Sigmoid(), x(2, 3)),
+    "SoftMax": (lambda: nn.SoftMax(), x(2, 3)),
+    "SoftPlus": (lambda: nn.SoftPlus(), x(2, 3)),
+    "SoftSign": (lambda: nn.SoftSign(), x(2, 3)),
+    "SpatialAveragePooling": (lambda: nn.SpatialAveragePooling(2, 2, 2, 2), x(2, 3, 4, 4)),
+    "SpatialBatchNormalization": (lambda: nn.SpatialBatchNormalization(3), x(2, 3, 4, 4)),
+    "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), x(2, 3, 5, 5)),
+    "SpatialCrossMapLRN": (lambda: nn.SpatialCrossMapLRN(), x(2, 5, 4, 4)),
+    "SpatialFullConvolution": (lambda: nn.SpatialFullConvolution(3, 4, 3, 3), x(2, 3, 5, 5)),
+    "SpatialMaxPooling": (lambda: nn.SpatialMaxPooling(2, 2, 2, 2), x(2, 3, 4, 4)),
+    "SplitTable": (lambda: nn.SplitTable(1, 2), x(2, 3)),
+    "Sqrt": (lambda: nn.Sqrt(), np.abs(x(2, 3)) + 0.1),
+    "Square": (lambda: nn.Square(), x(2, 3)),
+    "Squeeze": (lambda: nn.Squeeze(2), x(2, 1, 3)),
+    "Sum": (lambda: nn.Sum(1), x(2, 3)),
+    "Tanh": (lambda: nn.Tanh(), x(2, 3)),
+    "TimeDistributed": (lambda: nn.TimeDistributed(nn.Linear(3, 4)), x(2, 5, 3)),
+    "Transpose": (lambda: nn.Transpose([(0, 1)]), x(2, 3)),
+    "Unsqueeze": (lambda: nn.Unsqueeze(1), x(2, 3)),
+    "View": (lambda: nn.View(6), x(2, 2, 3)),
+}
+
+# abstract/base/helper classes with no standalone forward semantics,
+# or classes exercised only through a wrapper factory above
+EXEMPT = {
+    "AbstractModule", "TensorModule", "Container", "Module",
+    "Cell", "StaticGraph", "ModuleNode", "Input",
+}
+
+
+def _module_classes():
+    out = {}
+    for name in dir(nn):
+        obj = getattr(nn, name)
+        if isinstance(obj, type) and issubclass(obj, AbstractModule):
+            out[name] = obj
+    return out
+
+
+def test_sweep_is_complete():
+    """Every exported module class must have a round-trip factory."""
+    classes = _module_classes()
+    missing = set(classes) - set(FACTORIES) - EXEMPT
+    assert not missing, f"layers missing serialization sweep coverage: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("name", sorted(FACTORIES))
+def test_roundtrip(name, tmp_path):
+    factory, inp = FACTORIES[name]
+    m = factory()
+    m.evaluate()  # deterministic forward for comparison
+    path = str(tmp_path / f"{name}.bigdl")
+    if inp is not None:
+        before = np.asarray(m.forward(inp))
+    m.save_module(path)
+    m2 = AbstractModule.load_module(path)
+    assert type(m2) is type(m)
+    m2.evaluate()
+    if inp is not None:
+        after = np.asarray(m2.forward(inp))
+        assert_close(before, after, atol=1e-6, rtol=1e-6,
+                     msg=f"{name} forward changed across round-trip")
+    else:  # bare cells: compare parameter pytrees
+        w1, _ = m.parameters()
+        w2, _ = m2.parameters()
+        assert len(w1) == len(w2)
+        for a, b in zip(w1, w2):
+            assert_close(a, b, atol=0, rtol=0, msg=name)
+
+
+def test_version_check(tmp_path):
+    import json
+    import zipfile
+
+    from bigdl_tpu.utils.serializer import FORMAT_VERSION
+
+    p = str(tmp_path / "m.bigdl")
+    nn.Linear(2, 2).save_module(p)
+    with zipfile.ZipFile(p) as z:
+        spec = json.loads(z.read("spec.json"))
+        arrays = z.read("arrays.npz")
+    spec["version"] = FORMAT_VERSION + 1
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("spec.json", json.dumps(spec))
+        z.writestr("arrays.npz", arrays)
+    with pytest.raises(ValueError, match="newer"):
+        AbstractModule.load_module(p)
+
+
+def test_legacy_pickle_graph_roundtrip(tmp_path):
+    """Module.save/load (legacy path) must survive Graph id-keyed caches."""
+    m = _graph()
+    m.evaluate()
+    inp = x(2, 4)
+    before = np.asarray(m.forward(inp))
+    p = str(tmp_path / "g.bin")
+    m.save(p)
+    m2 = AbstractModule.load(p)
+    after = np.asarray(m2.forward(inp))
+    assert_close(before, after, atol=1e-6, rtol=1e-6)
+
+
+def test_resnet_roundtrip(tmp_path):
+    """End-to-end: a real zoo Graph model round-trips bit-exact."""
+    from bigdl_tpu.models.resnet import ResNet
+
+    m = ResNet(class_num=10, opt={"depth": 20, "shortcutType": "A",
+                                  "dataSet": "cifar10"})
+    m.evaluate()
+    inp = x(2, 3, 32, 32)
+    before = np.asarray(m.forward(inp))
+    p = str(tmp_path / "resnet.bigdl")
+    m.save_module(p)
+    m2 = AbstractModule.load_module(p)
+    after = np.asarray(m2.forward(inp))
+    assert_close(before, after, atol=1e-6, rtol=1e-6)
