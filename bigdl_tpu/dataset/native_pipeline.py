@@ -1,0 +1,150 @@
+"""MiniBatch pipeline backed by the native C++ prefetch executor.
+
+Reference (UNVERIFIED, SURVEY.md §0): the reference's hot image path is
+OpenCV-JNI decode/augment on ``Engine.default`` ThreadPool threads feeding
+``SampleToMiniBatch`` (``.../dataset/image/*.scala``,
+``.../utils/ThreadPool.scala``). This module is the TPU-host analog: raw
+uint8 images stay in one NHWC array, a background thread draws augmentation
+randomness and pushes batch jobs into :class:`bigdl_tpu.native.NativeLoader`
+(C++ worker pool, off-GIL), and the training loop pops finished float32
+CHW batches — augmentation overlaps device compute.
+
+Falls back to an equivalent pure-numpy iterator when the toolchain is
+missing (``bigdl_tpu.native.is_available()`` False).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+import bigdl_tpu.native as native
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+
+
+class NativeImagePipeline(AbstractDataSet):
+    """Iterates MiniBatches from (N, H, W, C) uint8 images + int labels.
+
+    train=True: infinite shuffled stream, random crop + hflip.
+    train=False: one pass, center crop, no flip.
+    Crop padding (pad then random-crop, the reference CIFAR recipe) is
+    supported via ``pad``.
+    """
+
+    def __init__(self, images: np.ndarray, labels: Sequence[int], *,
+                 batch_size: int, crop: Optional[tuple] = None,
+                 mean, std, pad: int = 0, hflip: bool = True,
+                 queue_depth: int = 4, n_workers: int = 4,
+                 seed: int = 0) -> None:
+        images = np.ascontiguousarray(images, np.uint8)
+        assert images.ndim == 4, "expect (N, H, W, C) uint8"
+        if pad:
+            images = np.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        self.images = images
+        self.labels = np.ascontiguousarray(labels, np.int32)
+        self.n, self.h, self.w, self.c = images.shape
+        self.crop_h, self.crop_w = crop if crop else (self.h, self.w)
+        self.batch = batch_size
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.hflip = hflip
+        self.queue_depth = queue_depth
+        self.n_workers = n_workers
+        self.seed = seed
+
+    def size(self) -> int:
+        return self.n
+
+    # -- index/param generation (host RNG stays in Python, §5.2 analog) --
+
+    def _epoch_indices(self, rng: np.random.RandomState, train: bool):
+        idx = np.arange(self.n)
+        if train:
+            rng.shuffle(idx)
+        return idx
+
+    def _params(self, rng: np.random.RandomState, train: bool, k: int):
+        max_y = self.h - self.crop_h
+        max_x = self.w - self.crop_w
+        if train:
+            oy = rng.randint(0, max_y + 1, k).astype(np.int32)
+            ox = rng.randint(0, max_x + 1, k).astype(np.int32)
+            fl = (rng.rand(k) < 0.5).astype(np.uint8) if self.hflip else \
+                np.zeros(k, np.uint8)
+        else:
+            oy = np.full(k, max_y // 2, np.int32)
+            ox = np.full(k, max_x // 2, np.int32)
+            fl = np.zeros(k, np.uint8)
+        return oy, ox, fl
+
+    # -- iteration --
+
+    def data(self, train: bool) -> Iterator[MiniBatch]:
+        if native.is_available():
+            return self._native_iter(train)
+        return self._numpy_iter(train)
+
+    def _numpy_iter(self, train: bool) -> Iterator[MiniBatch]:
+        rng = np.random.RandomState(self.seed)
+        while True:
+            idx = self._epoch_indices(rng, train)
+            for i in range(0, self.n - self.batch + 1, self.batch):
+                sel = idx[i:i + self.batch]
+                oy, ox, fl = self._params(rng, train, len(sel))
+                out = np.empty((len(sel), self.c, self.crop_h, self.crop_w),
+                               np.float32)
+                for j, s in enumerate(sel):
+                    im = self.images[s, oy[j]:oy[j] + self.crop_h,
+                                     ox[j]:ox[j] + self.crop_w, :]
+                    if fl[j]:
+                        im = im[:, ::-1, :]
+                    out[j] = ((im.astype(np.float32) - self.mean) /
+                              self.std).transpose(2, 0, 1)
+                yield MiniBatch(out, self.labels[sel].astype(np.float32))
+            if not train:
+                return
+
+    def _native_iter(self, train: bool) -> Iterator[MiniBatch]:
+        loader = native.NativeLoader(
+            self.batch, self.h, self.w, self.c, self.crop_h, self.crop_w,
+            self.mean, self.std, queue_depth=self.queue_depth,
+            n_workers=self.n_workers)
+        rng = np.random.RandomState(self.seed)
+        n_batches_per_epoch = self.n // self.batch
+        stop = threading.Event()
+
+        def producer():
+            try:
+                while not stop.is_set():
+                    idx = self._epoch_indices(rng, train)
+                    for i in range(n_batches_per_epoch):
+                        if stop.is_set():
+                            return
+                        sel = idx[i * self.batch:(i + 1) * self.batch]
+                        oy, ox, fl = self._params(rng, train, len(sel))
+                        loader.push(self.images[sel], self.labels[sel],
+                                    oy, ox, fl)
+                    if not train:
+                        return
+            except RuntimeError:
+                pass  # loader closed under us — consumer is done
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            if train:
+                while True:
+                    out, lab = loader.pop()
+                    yield MiniBatch(out, lab.astype(np.float32))
+            else:
+                for _ in range(n_batches_per_epoch):
+                    out, lab = loader.pop()
+                    yield MiniBatch(out, lab.astype(np.float32))
+        finally:
+            stop.set()
+            loader.stop()       # unblock a producer stuck in push()
+            t.join(timeout=5)
+            loader.close()      # frees only after no thread can touch it

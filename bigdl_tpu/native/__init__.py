@@ -1,0 +1,206 @@
+"""Native host-side runtime: C++ image ops + prefetch executor via ctypes.
+
+Reference (UNVERIFIED, SURVEY.md §0/§2.1): the native row-set — MKL JNI
+(``com.intel.analytics.bigdl.mkl.MKL``), MKL-DNN JNI, and OpenCV JNI
+(``.../transform/vision/image/opencv/OpenCVMat.scala``) — plus the
+``Engine.default`` ThreadPool that drives the data path. On TPU the math
+backend is XLA/Pallas; what remains genuinely native is the host data
+plane, rebuilt here in C++ (``src/bigdl_native.cpp``):
+
+* ``augment_batch`` — crop/flip/normalize, HWC u8 → CHW f32 (OpenCV role)
+* ``resize_bilinear`` — batched bilinear resize
+* ``decode_cifar`` — binary record split
+* ``NativeLoader`` — threaded bounded prefetch executor (ThreadPool role)
+
+Availability is probed lazily; ``is_available()`` is False when no C++
+toolchain exists, and callers (``bigdl_tpu.dataset``) fall back to numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+_lib = None
+_lib_error: Optional[str] = None
+
+
+def _load():
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        from bigdl_tpu.native.build import build_library
+        path = build_library()
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        _lib_error = str(e)
+        return None
+    c_u8p = ctypes.POINTER(ctypes.c_uint8)
+    c_i32p = ctypes.POINTER(ctypes.c_int32)
+    c_f32p = ctypes.POINTER(ctypes.c_float)
+    i32 = ctypes.c_int32
+    lib.bigdl_augment_batch.argtypes = [
+        c_u8p, i32, i32, i32, i32, c_i32p, c_i32p, c_u8p, i32, i32,
+        c_f32p, c_f32p, c_f32p, i32]
+    lib.bigdl_resize_bilinear.argtypes = [
+        c_u8p, i32, i32, i32, i32, c_u8p, i32, i32, i32]
+    lib.bigdl_decode_cifar.argtypes = [
+        c_u8p, i32, i32, i32, c_u8p, c_i32p, i32, i32]
+    lib.bigdl_loader_create.restype = ctypes.c_void_p
+    lib.bigdl_loader_create.argtypes = [
+        i32, i32, i32, i32, i32, i32, c_f32p, c_f32p, i32, i32]
+    lib.bigdl_loader_push.restype = i32
+    lib.bigdl_loader_push.argtypes = [
+        ctypes.c_void_p, c_u8p, c_i32p, c_i32p, c_i32p, c_u8p]
+    lib.bigdl_loader_pop.restype = i32
+    lib.bigdl_loader_pop.argtypes = [ctypes.c_void_p, c_f32p, c_i32p]
+    lib.bigdl_loader_stop.argtypes = [ctypes.c_void_p]
+    lib.bigdl_loader_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def unavailable_reason() -> Optional[str]:
+    _load()
+    return _lib_error
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _f32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def augment_batch(images: np.ndarray, off_y: np.ndarray, off_x: np.ndarray,
+                  flip: np.ndarray, crop_h: int, crop_w: int,
+                  mean, std, n_threads: int = 4) -> np.ndarray:
+    """(n, H, W, C) u8 → (n, C, crop_h, crop_w) f32, crop/flip/normalize."""
+    lib = _load()
+    assert lib is not None, _lib_error
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    off_y = np.ascontiguousarray(off_y, np.int32)
+    off_x = np.ascontiguousarray(off_x, np.int32)
+    flip = np.ascontiguousarray(flip, np.uint8)
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    out = np.empty((n, c, crop_h, crop_w), np.float32)
+    lib.bigdl_augment_batch(_u8(images), n, h, w, c, _i32(off_y), _i32(off_x),
+                            _u8(flip), crop_h, crop_w, _f32(mean), _f32(std),
+                            _f32(out), n_threads)
+    return out
+
+
+def resize_bilinear(images: np.ndarray, dst_h: int, dst_w: int,
+                    n_threads: int = 4) -> np.ndarray:
+    """(n, H, W, C) u8 → (n, dst_h, dst_w, C) u8, half-pixel bilinear."""
+    lib = _load()
+    assert lib is not None, _lib_error
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    out = np.empty((n, dst_h, dst_w, c), np.uint8)
+    lib.bigdl_resize_bilinear(_u8(images), n, h, w, c, _u8(out), dst_h, dst_w,
+                              n_threads)
+    return out
+
+
+def decode_cifar(records: np.ndarray, record_len: int = 3073,
+                 label_offset: int = 0, label_base: int = 1,
+                 n_threads: int = 4):
+    """Raw .bin bytes → ((n, 3, 32, 32) u8 planar, (n,) int32 labels).
+
+    label_base=1 matches the reference's 1-based ClassNLL labels.
+    """
+    lib = _load()
+    assert lib is not None, _lib_error
+    records = np.ascontiguousarray(records, np.uint8).reshape(-1)
+    n = records.size // record_len
+    img_len = record_len - label_offset - 1
+    images = np.empty((n, img_len), np.uint8)
+    labels = np.empty((n,), np.int32)
+    lib.bigdl_decode_cifar(_u8(records), n, record_len, label_offset,
+                           _u8(images), _i32(labels), label_base, n_threads)
+    return images.reshape(n, 3, 32, 32), labels
+
+
+class NativeLoader:
+    """Bounded prefetch executor over the C++ worker pool.
+
+    push() copies a batch of raw HWC u8 images + host-drawn aug params into
+    the library (blocking when queue_depth batches are in flight); pop()
+    returns the oldest finished (images_f32_CHW, labels_i32) batch. The
+    augmentation pipeline runs off-GIL in C++ threads, overlapping with the
+    TPU step — the DistriOptimizer data-feed analog of Engine.default.
+    """
+
+    def __init__(self, batch: int, src_h: int, src_w: int, c: int,
+                 crop_h: int, crop_w: int, mean, std,
+                 queue_depth: int = 4, n_workers: int = 4) -> None:
+        lib = _load()
+        assert lib is not None, _lib_error
+        self._lib = lib
+        mean = np.ascontiguousarray(mean, np.float32)
+        std = np.ascontiguousarray(std, np.float32)
+        assert mean.size == c and std.size == c
+        self._h = lib.bigdl_loader_create(batch, src_h, src_w, c, crop_h,
+                                          crop_w, _f32(mean), _f32(std),
+                                          queue_depth, n_workers)
+        self.batch, self.c, self.crop_h, self.crop_w = batch, c, crop_h, crop_w
+
+    def push(self, images: np.ndarray, labels: np.ndarray,
+             off_y: np.ndarray, off_x: np.ndarray, flip: np.ndarray) -> None:
+        images = np.ascontiguousarray(images, np.uint8)
+        labels = np.ascontiguousarray(labels, np.int32)
+        off_y = np.ascontiguousarray(off_y, np.int32)
+        off_x = np.ascontiguousarray(off_x, np.int32)
+        flip = np.ascontiguousarray(flip, np.uint8)
+        rc = self._lib.bigdl_loader_push(self._h, _u8(images), _i32(labels),
+                                         _i32(off_y), _i32(off_x), _u8(flip))
+        if rc != 0:
+            raise RuntimeError("NativeLoader stopped")
+
+    def pop(self):
+        out = np.empty((self.batch, self.c, self.crop_h, self.crop_w),
+                       np.float32)
+        labels = np.empty((self.batch,), np.int32)
+        rc = self._lib.bigdl_loader_pop(self._h, _f32(out), _i32(labels))
+        if rc != 0:
+            raise RuntimeError("NativeLoader stopped and drained")
+        return out, labels
+
+    def stop(self) -> None:
+        """Unblocks every thread waiting in push/pop (they raise
+        RuntimeError). Must precede close() when producer threads exist —
+        close() frees the loader, so no thread may still be inside a call."""
+        if self._h:
+            self._lib.bigdl_loader_stop(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.bigdl_loader_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,52 @@
+"""On-demand build of the native runtime (g++ → shared library).
+
+The reference ships its native backends as prebuilt JNI jars (bigdl-core);
+here the library is compiled once per source change with the system g++ and
+cached next to the sources. No external deps — pure C++17 + pthreads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_OUT_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LOCK = threading.Lock()
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(_SRC_DIR)):
+        with open(os.path.join(_SRC_DIR, name), "rb") as f:
+            h.update(name.encode())
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_library() -> str:
+    """Compiles (if needed) and returns the path to libbigdl_native.so.
+
+    Raises OSError when no working C++ toolchain is available; callers fall
+    back to the numpy path.
+    """
+    with _LOCK:
+        digest = _source_digest()
+        out = os.path.join(_OUT_DIR, f"libbigdl_native-{digest}.so")
+        if os.path.exists(out):
+            return out
+        os.makedirs(_OUT_DIR, exist_ok=True)
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            os.path.join(_SRC_DIR, "bigdl_native.cpp"), "-o", out + ".tmp",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise OSError("g++ not found; native runtime unavailable") from e
+        except subprocess.CalledProcessError as e:
+            raise OSError(f"native build failed:\n{e.stderr}") from e
+        os.replace(out + ".tmp", out)
+        return out

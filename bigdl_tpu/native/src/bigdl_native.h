@@ -1,0 +1,76 @@
+// bigdl_tpu native runtime — C API.
+//
+// Role (SURVEY.md §2.1, native row-set): the reference ships C/C++ JNI
+// backends (MKL, MKL-DNN, OpenCV) under its JVM tensor/data layers. On TPU
+// the *math* backend is XLA/Pallas, but the host-side data plane — image
+// augmentation, record decode, and the prefetch executor that keeps the chip
+// fed — is the part that still wants native code (the OpenCV-JNI +
+// Engine.ThreadPool analog). This library is loaded from Python via ctypes.
+//
+// Threading model: a fixed worker pool (std::thread) inside the library;
+// Python enqueues jobs whose randomness (crop offsets, flip flags) was
+// already drawn host-side, so C++ is purely deterministic data movement.
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+// ---- stateless batch ops (parallelised internally over n_threads) ----
+
+// HWC uint8 -> CHW float32 with per-image crop/flip and per-channel
+// (x - mean) / std. src: n*(src_h*src_w*c); dst: n*(c*crop_h*crop_w).
+void bigdl_augment_batch(const uint8_t* src, int32_t n, int32_t src_h,
+                         int32_t src_w, int32_t c, const int32_t* off_y,
+                         const int32_t* off_x, const uint8_t* flip,
+                         int32_t crop_h, int32_t crop_w, const float* mean,
+                         const float* stdv, float* dst, int32_t n_threads);
+
+// Bilinear resize, HWC uint8 -> HWC uint8 (half-pixel centres, like
+// OpenCV INTER_LINEAR / jax.image.resize "linear").
+void bigdl_resize_bilinear(const uint8_t* src, int32_t n, int32_t src_h,
+                           int32_t src_w, int32_t c, uint8_t* dst,
+                           int32_t dst_h, int32_t dst_w, int32_t n_threads);
+
+// CIFAR-10/100 .bin records: [label u8][3072 u8 planar RGB] each.
+// Splits into labels (int32, +label_base) and planar CHW uint8 images.
+void bigdl_decode_cifar(const uint8_t* records, int32_t n,
+                        int32_t record_len, int32_t label_offset,
+                        uint8_t* images, int32_t* labels, int32_t label_base,
+                        int32_t n_threads);
+
+// ---- prefetch executor ----
+// A bounded ring of batch slots filled by the worker pool; Python pushes
+// raw-record jobs (data is copied in) and pops completed float32 batches.
+// This is the native analog of the reference's Engine.default ThreadPool
+// feeding MiniBatches to the optimizer.
+
+typedef struct bigdl_loader bigdl_loader;
+
+// Creates a loader producing (batch, c, crop_h, crop_w) float32 batches
+// from (src_h, src_w, c) uint8 HWC images. queue_depth = max in-flight
+// batches; n_workers = worker threads.
+bigdl_loader* bigdl_loader_create(int32_t batch, int32_t src_h, int32_t src_w,
+                                  int32_t c, int32_t crop_h, int32_t crop_w,
+                                  const float* mean, const float* stdv,
+                                  int32_t queue_depth, int32_t n_workers);
+
+// Enqueue one batch job. Copies `batch` images (+ labels + aug params) into
+// an internal arena, then returns; blocks only when queue_depth jobs are
+// already in flight. Returns 0 on success, -1 if the loader was stopped.
+int32_t bigdl_loader_push(bigdl_loader* L, const uint8_t* images,
+                          const int32_t* labels, const int32_t* off_y,
+                          const int32_t* off_x, const uint8_t* flip);
+
+// Dequeue the oldest completed batch into caller buffers (FIFO order).
+// Blocks until one is ready. Returns 0, or -1 if stopped and drained.
+int32_t bigdl_loader_pop(bigdl_loader* L, float* out_images,
+                         int32_t* out_labels);
+
+// Marks the loader stopped and wakes every blocked push/pop. Safe to call
+// while other threads are inside push/pop; they return -1. Call this (and
+// join producer threads) BEFORE destroy, which frees the loader.
+void bigdl_loader_stop(bigdl_loader* L);
+
+void bigdl_loader_destroy(bigdl_loader* L);
+
+}  // extern "C"

@@ -45,8 +45,8 @@ class SpatialConvolution(TensorModule):
         init_bias: Optional[InitializationMethod] = None,
     ) -> None:
         super().__init__()
-        assert n_input_plane % n_group == 0, "input planes must divide groups"
-        assert n_output_plane % n_group == 0, "output planes must divide groups"
+        assert n_input_plane % n_group == 0, "n_group must divide n_input_plane"
+        assert n_output_plane % n_group == 0, "n_group must divide n_output_plane"
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w = kernel_w
@@ -139,8 +139,8 @@ class SpatialFullConvolution(TensorModule):
         init_bias: Optional[InitializationMethod] = None,
     ) -> None:
         super().__init__()
-        assert n_input_plane % n_group == 0, "input planes must divide groups"
-        assert n_output_plane % n_group == 0, "output planes must divide groups"
+        assert n_input_plane % n_group == 0, "n_group must divide n_input_plane"
+        assert n_output_plane % n_group == 0, "n_group must divide n_output_plane"
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w = kernel_w

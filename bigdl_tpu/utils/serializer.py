@@ -26,6 +26,7 @@ import importlib
 import io
 import json
 import os
+import tempfile
 import zipfile
 from typing import Any, Dict, List
 
@@ -49,6 +50,11 @@ class _Encoder:
         self.objs: List[Dict[str, Any]] = []
         self.obj_ids: Dict[int, int] = {}
         self.arrays: List[np.ndarray] = []
+        # id(original array) → index, so aliased arrays (reference share()
+        # semantics) keep identity across a round-trip; holding the original
+        # in _array_refs keeps the ids valid for the encoder's lifetime
+        self.array_ids: Dict[int, int] = {}
+        self._array_refs: List[Any] = []
 
     def encode(self, x: Any) -> Any:
         if x is None or isinstance(x, (bool, int, float, str)):
@@ -56,8 +62,13 @@ class _Encoder:
         if isinstance(x, np.generic):  # numpy scalar
             return {"__npscalar__": [x.dtype.str, x.item()]}
         if _is_array(x):
-            self.arrays.append(np.asarray(x))
-            return {"__array__": len(self.arrays) - 1}
+            idx = self.array_ids.get(id(x))
+            if idx is None:
+                idx = len(self.arrays)
+                self.arrays.append(np.asarray(x))
+                self.array_ids[id(x)] = idx
+                self._array_refs.append(x)
+            return {"__array__": idx}
         if isinstance(x, (list, tuple)):
             tag = "__tuple__" if isinstance(x, tuple) else "__list__"
             return {tag: [self.encode(v) for v in x]}
@@ -84,8 +95,19 @@ class _Encoder:
         }
         self.objs.append(entry)  # reserve slot first: attrs may refer back
         state = x.__getstate__() if hasattr(x, "__getstate__") else None
-        if not isinstance(state, dict):  # object.__getstate__ may return None
-            state = dict(x.__dict__)
+        if state is None:  # object.__getstate__ returns None for empty state
+            state = dict(getattr(x, "__dict__", {}))
+        elif isinstance(state, tuple) and len(state) == 2:
+            # py3.11+ object.__getstate__ for __slots__ classes:
+            # (dict_state | None, slots_state | None)
+            d, slots = state
+            state = dict(d or {})
+            state.update(slots or {})
+        if not isinstance(state, dict):
+            raise TypeError(
+                f"save_module: {type(x).__qualname__}.__getstate__ returned "
+                f"{type(state).__name__}; only dict state is supported"
+            )
         entry["attrs"] = {k: self.encode(v) for k, v in state.items()}
         return oid
 
@@ -132,7 +154,8 @@ class _Decoder:
         if hasattr(obj, "__setstate__"):
             obj.__setstate__(attrs)
         else:
-            obj.__dict__.update(attrs)
+            for k, v in attrs.items():  # object.__setattr__ covers __slots__
+                object.__setattr__(obj, k, v)
         return obj
 
 
@@ -140,7 +163,11 @@ def save_module(module, path: str, over_write: bool = False) -> None:
     """Serialize a module (topology + params + buffers) to ``path``."""
     if os.path.exists(path) and not over_write:
         raise FileExistsError(f"{path} exists (pass over_write=True)")
-    module._ensure_params()
+    if module.params is None:  # materialize weights only — grads aren't saved
+        from bigdl_tpu.utils.random_gen import RNG
+
+        module.params = module.init_params(RNG.next_key())
+        module.state = module.init_state()
     # params/state ride along inside the module's own attribute state
     # (AbstractModule.__getstate__ keeps them, drops grads/activations)
     enc = _Encoder()
@@ -153,11 +180,18 @@ def save_module(module, path: str, over_write: bool = False) -> None:
     }
     buf = io.BytesIO()
     np.savez_compressed(buf, **{f"a{i}": a for i, a in enumerate(enc.arrays)})
-    tmp = path + ".tmp"
-    with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("spec.json", json.dumps(payload))
-        z.writestr("arrays.npz", buf.getvalue())
-    os.replace(tmp, path)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            with zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED) as z:
+                z.writestr("spec.json", json.dumps(payload))
+                z.writestr("arrays.npz", buf.getvalue())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_module(path: str):

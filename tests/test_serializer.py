@@ -214,3 +214,31 @@ def test_resnet_roundtrip(tmp_path):
     m2 = AbstractModule.load_module(p)
     after = np.asarray(m2.forward(inp))
     assert_close(before, after, atol=1e-6, rtol=1e-6)
+
+
+def test_aliased_arrays_keep_identity(tmp_path):
+    """Tied weights (reference share() semantics): aliased arrays must come
+    back as ONE array, stored once."""
+    from bigdl_tpu.utils.serializer import save_module, load_module
+
+    a = nn.Linear(4, 4)
+    b = nn.Linear(4, 4)
+    a._ensure_params()
+    b._ensure_params()
+    b.params["weight"] = a.params["weight"]  # tie
+    m = nn.Sequential().add(a).add(b)
+    p = str(tmp_path / "tied.bigdl")
+    save_module(m, p)
+    m2 = load_module(p)
+    w1 = m2.modules[0].params["weight"]
+    w2 = m2.modules[1].params["weight"]
+    assert w1 is w2, "aliased parameter arrays were untied by a round-trip"
+
+
+def test_save_module_creates_directories(tmp_path):
+    from bigdl_tpu.utils.serializer import save_module, load_module
+
+    m = nn.Linear(3, 2)
+    p = str(tmp_path / "new" / "sub" / "m.bigdl")
+    save_module(m, p)
+    load_module(p)
