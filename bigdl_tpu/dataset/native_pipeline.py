@@ -47,6 +47,10 @@ class NativeImagePipeline(AbstractDataSet):
         self.labels = np.ascontiguousarray(labels, np.int32)
         self.n, self.h, self.w, self.c = images.shape
         self.crop_h, self.crop_w = crop if crop else (self.h, self.w)
+        if self.crop_h > self.h or self.crop_w > self.w:
+            raise ValueError(
+                f"crop {self.crop_h}x{self.crop_w} exceeds (padded) image "
+                f"{self.h}x{self.w}")
         self.batch = batch_size
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)

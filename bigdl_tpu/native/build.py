@@ -10,6 +10,7 @@ from __future__ import annotations
 import hashlib
 import os
 import subprocess
+import tempfile
 import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
@@ -38,15 +39,23 @@ def build_library() -> str:
         if os.path.exists(out):
             return out
         os.makedirs(_OUT_DIR, exist_ok=True)
+        # unique tmp per builder: concurrent processes may race to build the
+        # same digest; each compiles privately, last os.replace wins (same
+        # bits either way)
+        fd, tmp = tempfile.mkstemp(dir=_OUT_DIR, suffix=".so.tmp")
+        os.close(fd)
         cmd = [
             "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-            os.path.join(_SRC_DIR, "bigdl_native.cpp"), "-o", out + ".tmp",
+            os.path.join(_SRC_DIR, "bigdl_native.cpp"), "-o", tmp,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, out)
         except FileNotFoundError as e:
             raise OSError("g++ not found; native runtime unavailable") from e
         except subprocess.CalledProcessError as e:
             raise OSError(f"native build failed:\n{e.stderr}") from e
-        os.replace(out + ".tmp", out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return out

@@ -23,20 +23,29 @@ from bigdl_tpu.nn.module import TensorModule
 
 
 class MultiHeadAttention(TensorModule):
+    # class-level default so instances deserialized from pre-use_flash
+    # checkpoints (decoder bypasses __init__) still forward correctly
+    use_flash = "auto"
+
     def __init__(self, hidden_size: int, n_heads: int, causal: bool = False,
                  sequence_parallel: Optional[str] = None,
-                 sp_axis: str = "seq") -> None:
+                 sp_axis: str = "seq", use_flash: str = "auto") -> None:
         super().__init__()
         if hidden_size % n_heads:
             raise ValueError(f"hidden {hidden_size} % heads {n_heads} != 0")
         if sequence_parallel not in (None, "ring", "ulysses"):
             raise ValueError(f"unknown sequence_parallel {sequence_parallel!r}")
+        if use_flash not in ("auto", "always", "never"):
+            raise ValueError(f"unknown use_flash {use_flash!r}")
         self.hidden_size = hidden_size
         self.n_heads = n_heads
         self.head_dim = hidden_size // n_heads
         self.causal = causal
         self.sequence_parallel = sequence_parallel
         self.sp_axis = sp_axis
+        # local path kernel choice: the Pallas flash kernel
+        # (bigdl_tpu.ops.flash_attention) on TPU, dense jnp otherwise
+        self.use_flash = use_flash
 
     def init_params(self, rng):
         import jax
@@ -51,6 +60,7 @@ class MultiHeadAttention(TensorModule):
         }
 
     def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
         import jax.numpy as jnp
 
         from bigdl_tpu.parallel.ring_attention import (
@@ -72,6 +82,12 @@ class MultiHeadAttention(TensorModule):
             out = ring_attention(q, k, v, self.sp_axis, causal=self.causal)
         elif self.sequence_parallel == "ulysses":
             out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal)
+        elif self.use_flash == "always" or (
+                self.use_flash == "auto"
+                and jax.default_backend() == "tpu"):
+            from bigdl_tpu.ops import flash_attention
+
+            out = flash_attention(q, k, v, causal=self.causal)
         else:
             out = attention(q, k, v, causal=self.causal)
         out = out.reshape(B, T, self.hidden_size)

@@ -129,12 +129,16 @@ class AbstractModule:
     # facade: parameter materialization
     # ------------------------------------------------------------------
 
-    def _ensure_params(self) -> None:
+    def _materialize_params(self) -> None:
+        """Weights/state only — no gradient buffers (save-path half)."""
         if self.params is None:
             from bigdl_tpu.utils.random_gen import RNG
 
             self.params = self.init_params(RNG.next_key())
             self.state = self.init_state()
+
+    def _ensure_params(self) -> None:
+        self._materialize_params()
         if self.grad_params is None:
             import jax
 

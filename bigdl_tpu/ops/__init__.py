@@ -1,0 +1,13 @@
+"""Pallas (Mosaic) TPU kernels — the hand-written hot-op layer.
+
+Reference role (UNVERIFIED, SURVEY.md §0/§2.1): the reference's native math
+backends (MKL/MKL-DNN JNI) provide fast kernels under the generic layer
+API. On TPU, XLA covers that role for gemms/convs; this package holds the
+Pallas kernels for the ops XLA doesn't schedule optimally — currently
+flash attention (fused online-softmax attention, linear memory in sequence
+length).
+"""
+
+from bigdl_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

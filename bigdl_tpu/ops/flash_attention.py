@@ -1,0 +1,266 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+No reference counterpart (SURVEY.md §5.7 — the reference is attention-free);
+this is part of the framework's long-context extension. The dense
+``attention`` in ``bigdl_tpu.parallel.ring_attention`` materialises the
+(T, T) score matrix in HBM; these kernels keep scores in VMEM tiles with an
+online softmax (running max / normaliser), so memory is linear in T and the
+QK^T / PV gemms stay on the MXU back-to-back without round-tripping HBM.
+
+Layout: public API takes (B, T, H, D) to match the attention layers; the
+kernels run on (B*H, T, D) with a (batch*heads, seq-block) grid. The
+backward pass is the FlashAttention-2 split: a dq kernel gridded over query
+blocks and a dk/dv kernel gridded over key blocks, both replaying the
+online softmax from the saved logsumexp.
+
+Numerics: accumulation is f32 regardless of input dtype (bf16 in, f32
+softmax state, cast on write) — the `jax.default_matmul_precision` analog
+of the reference's fp32 MKL paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30  # finite sentinel: keeps exp() well-defined for masked rows
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, kv_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (BQ, D)
+    bq, d = q.shape
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (BQ, BK)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = cols < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    n_kb = k_ref.shape[1] // block_k
+    if causal:  # skip key blocks entirely above the diagonal
+        n_kb = jnp.minimum(n_kb, pl.cdiv((qi + 1) * bq, block_k))
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+# --------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_k, kv_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)                  # (BQ, D)
+    lse = lse_ref[0]                                    # (BQ, 1)
+    delta = delta_ref[0]                                # (BQ, 1)
+    bq, d = q.shape
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        mask = cols < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                            # (BQ, BK)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    n_kb = k_ref.shape[1] // block_k
+    if causal:
+        n_kb = jnp.minimum(n_kb, pl.cdiv((qi + 1) * bq, block_k))
+    dq = jax.lax.fori_loop(0, n_kb, body, dq)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, q_len):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                    # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        qs = q * scale
+        s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)  # (BQ, BK)
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        if causal:
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        p = jnp.exp(s - lse)                            # rows beyond q_len: do=0
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, qs, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    n_qb = q_ref.shape[1] // block_q
+    start = (ki * bk) // block_q if causal else 0  # rows above diag: ds == 0
+    dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------- host wrappers
+
+
+def _pad_seq(x, block):
+    t = x.shape[1]
+    pad = (-t) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
+    bh, t, d = q3.shape
+    tp = q3.shape[1] + (-q3.shape[1]) % block
+    qp, kp, vp = (_pad_seq(x, block) for x in (q3, k3, v3))
+    kv_len = k3.shape[1]
+    grid = (bh, tp // block)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block, kv_len=kv_len),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kp.shape[1], d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, vp.shape[1], d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :t], lse[:, :t]
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
+    bh, t, d = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # (BH, T, 1)
+    qp, kp, vp, dop = (_pad_seq(x, block) for x in (q3, k3, v3, do3))
+    lsep = jnp.pad(lse, ((0, 0), (0, qp.shape[1] - t), (0, 0)))
+    deltap = jnp.pad(delta, ((0, 0), (0, qp.shape[1] - t), (0, 0)))
+    tp = qp.shape[1]
+    full = lambda n: pl.BlockSpec((1, tp, n), lambda b, i: (b, 0, 0))
+    blk = lambda n: pl.BlockSpec((1, block, n), lambda b, i: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=block, kv_len=k3.shape[1]),
+        grid=(bh, tp // block),
+        in_specs=[blk(d), full(d), full(d), blk(d), blk(1), blk(1)],
+        out_specs=blk(d),
+        out_shape=jax.ShapeDtypeStruct((bh, tp, d), q3.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block, q_len=t),
+        grid=(bh, tp // block),
+        in_specs=[full(d), blk(d), blk(d), full(d), full(1), full(1)],
+        out_specs=[blk(d), blk(d)],
+        out_shape=[jax.ShapeDtypeStruct((bh, tp, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, tp, d), v3.dtype)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :t], dk[:, :k3.shape[1]], dv[:, :v3.shape[1]]
+
+
+# ------------------------------------------------------------- public API
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, scale, causal, block, interpret):
+    o, _ = _flash_fwd(q3, k3, v3, scale, causal, block, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q3, k3, v3, scale, causal, block, interpret):
+    o, lse = _flash_fwd(q3, k3, v3, scale, causal, block, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block, interpret, res, do3):
+    q3, k3, v3, o3, lse = res
+    return _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block,
+                      interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention over (B, T, H, D) tensors; differentiable.
+
+    Drop-in for ``bigdl_tpu.parallel.ring_attention.attention`` with
+    O(T) memory. ``block`` is the VMEM tile length (MXU-aligned, 128).
+    ``interpret=None`` auto-selects Pallas interpreter mode off-TPU.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal),
+                int(block), bool(interpret))
+    return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -163,11 +163,7 @@ def save_module(module, path: str, over_write: bool = False) -> None:
     """Serialize a module (topology + params + buffers) to ``path``."""
     if os.path.exists(path) and not over_write:
         raise FileExistsError(f"{path} exists (pass over_write=True)")
-    if module.params is None:  # materialize weights only — grads aren't saved
-        from bigdl_tpu.utils.random_gen import RNG
-
-        module.params = module.init_params(RNG.next_key())
-        module.state = module.init_state()
+    module._materialize_params()  # weights only — grads aren't saved
     # params/state ride along inside the module's own attribute state
     # (AbstractModule.__getstate__ keeps them, drops grads/activations)
     enc = _Encoder()
