@@ -1,12 +1,21 @@
 """bigdl_tpu.parallel — the distributed parameter/communication plane
-(reference layer L7, SURVEY.md §2.4 / §5.8)."""
+(reference layer L7, SURVEY.md §2.4 / §5.8) plus the TPU-native tensor/
+pipeline/sequence/expert parallel extensions the reference lacks."""
 
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter, flatten_params
+from bigdl_tpu.parallel.moe import mlp_expert, moe_layer, top_k_gating
+from bigdl_tpu.parallel.pipeline import gpipe, microbatch, stack_stage_params
 from bigdl_tpu.parallel.ring_attention import (
     attention, ring_attention, ulysses_attention,
+)
+from bigdl_tpu.parallel.tensor_parallel import (
+    column_parallel_linear, row_parallel_linear, tp_attention, tp_mlp,
 )
 
 __all__ = [
     "AllReduceParameter", "flatten_params",
     "attention", "ring_attention", "ulysses_attention",
+    "column_parallel_linear", "row_parallel_linear", "tp_mlp", "tp_attention",
+    "gpipe", "microbatch", "stack_stage_params",
+    "moe_layer", "top_k_gating", "mlp_expert",
 ]

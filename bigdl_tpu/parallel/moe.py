@@ -1,0 +1,119 @@
+"""Expert parallelism: mixture-of-experts with all_to_all dispatch.
+
+No reference counterpart (SURVEY.md §2.4: no MoE layers in the reference;
+expert parallel listed as out-of-scope for parity — built here as a
+first-class TPU extension). The design is the GShard/Switch dense-dispatch
+formulation, which is the shape XLA maps best onto TPU:
+
+* gating, top-k selection and capacity masking are dense einsums over a
+  ``(tokens, experts, capacity)`` one-hot dispatch/combine tensor — no
+  gather/scatter, so everything tiles onto the MXU;
+* expert placement is ``lax.all_to_all`` over the mesh axis: tokens routed
+  to expert e travel to the chip owning e, the expert MLPs run as one
+  batched (vmapped) matmul per chip, and a second all_to_all brings results
+  home — both transfers ride ICI.
+
+Pure functions usable inside any ``shard_map``; capacity drops follow the
+standard cumsum-position rule (tokens beyond an expert's capacity contribute
+zero, matching Switch Transformer semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def top_k_gating(logits, k: int, capacity: int):
+    """Build dispatch/combine tensors from router logits.
+
+    ``logits``: (T, E). Returns ``(dispatch, combine)`` of shape
+    (T, E, C): ``dispatch`` is the 0/1 routing tensor, ``combine`` carries
+    the gate probabilities on the same support. Top-k per token, positions
+    within each expert assigned in token order, overflow dropped.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    # top-k expert mask per token, built iteratively (k is small and static)
+    masked = probs
+    sel = []
+    for _ in range(k):
+        ix = jnp.argmax(masked, axis=-1)                     # (T,)
+        onehot = jax.nn.one_hot(ix, E, dtype=probs.dtype)    # (T, E)
+        sel.append(onehot)
+        masked = masked * (1.0 - onehot)
+    dispatch_e = jnp.zeros_like(probs)
+    for onehot in sel:
+        dispatch_e = dispatch_e + onehot                      # (T, E) 0/1
+    # position of each token within its expert's queue (token order)
+    pos = jnp.cumsum(dispatch_e, axis=0) - dispatch_e         # (T, E)
+    keep = dispatch_e * (pos < capacity)
+    pos_onehot = jax.nn.one_hot(
+        pos.astype(jnp.int32), capacity, dtype=probs.dtype)   # (T,E,C)
+    dispatch = keep[..., None] * pos_onehot                   # (T, E, C)
+    gates = probs * keep
+    # renormalize the surviving top-k gates per token (Switch/GShard rule)
+    denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    combine = (gates / denom)[..., None] * pos_onehot
+    return dispatch, combine
+
+
+def moe_layer(x, router_w, expert_params, expert_fn: Callable,
+              axis_name: str = "expert", top_k: int = 1,
+              capacity_factor: float = 1.25,
+              capacity: Optional[int] = None):
+    """Expert-parallel MoE block, called inside shard_map over ``axis_name``.
+
+    * ``x`` — this chip's token shard ``(T_local, d)``.
+    * ``router_w`` — replicated router weights ``(d, E)`` over ALL experts.
+    * ``expert_params`` — THIS chip's experts' parameters, each leaf with a
+      ``(E_local, ...)`` leading axis (host side: shard the ``(E, ...)``
+      stack with ``in_specs=P(axis_name)``).
+    * ``expert_fn(params_one_expert, tokens) -> tokens`` — the expert net.
+
+    Returns ``(T_local, d)`` combined outputs for this chip's tokens.
+    """
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    n_dev = lax.psum(1, axis_name)
+    T, d = x.shape
+    E = router_w.shape[1]
+    assert E % n_dev == 0, f"{E} experts over {n_dev} chips"
+    e_local = E // n_dev
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * top_k * T / E))
+
+    logits = jnp.matmul(x, router_w)                          # (T, E)
+    dispatch, combine = top_k_gating(logits, top_k, capacity)
+
+    # route: (T,E,C)×(T,d) → (E,C,d), then all_to_all so chip j receives
+    # every chip's slabs for ITS experts
+    slabs = jnp.einsum("tec,td->ecd", dispatch, x)            # (E, C, d)
+    slabs = slabs.reshape(n_dev, e_local, capacity, d)
+    slabs = lax.all_to_all(slabs, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)                        # (n_dev, e_loc, C, d)
+    # merge the senders' capacity slots: expert e now sees n_dev*C tokens
+    slabs = slabs.transpose(1, 0, 2, 3).reshape(e_local, n_dev * capacity, d)
+
+    out = jax.vmap(expert_fn)(expert_params, slabs)           # (e_loc, n_dev*C, d)
+
+    # inverse route
+    out = out.reshape(e_local, n_dev, capacity, d).transpose(1, 0, 2, 3)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                          # (n_dev, e_loc, C, d)
+    out = out.reshape(E, capacity, d)
+    return jnp.einsum("tec,ecd->td", combine, out)            # (T_local, d)
+
+
+def mlp_expert(params, tokens):
+    """Default expert net: GELU MLP. ``params = {"w1": (d, h), "b1": (h,),
+    "w2": (h, d), "b2": (d,)}`` (one expert's slice, no leading E axis)."""
+    import jax
+    import jax.numpy as jnp
+
+    h = jax.nn.gelu(jnp.matmul(tokens, params["w1"]) + params["b1"])
+    return jnp.matmul(h, params["w2"]) + params["b2"]

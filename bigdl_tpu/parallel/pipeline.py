@@ -1,0 +1,112 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.4: no stage partitioning anywhere in
+the reference's ``optim/``; pipeline parallel is the documented TPU-native
+extension). Design follows the standard TPU pipelining recipe: every chip
+holds one stage's parameters; activations hop to the next stage with
+``lax.ppermute`` (one nearest-neighbour ICI transfer per tick) while
+microbatches stream through, filling and draining the pipeline.
+
+The whole schedule is ONE traced ``lax.fori_loop`` inside ``shard_map`` —
+XLA sees a static program with ``n_micro + n_stages - 1`` ticks, each tick a
+(stage-compute, ppermute) pair it can overlap. Autodiff works end-to-end:
+the transpose of ``ppermute`` is the reverse permute, so ``jax.grad``
+produces the backward pipeline automatically (bubbles and all) with no
+hand-written schedule.
+
+Homogeneous-stage form: ``fn(stage_params, x) -> y`` with matching x/y
+shapes (classic transformer-block pipelining). Heterogeneous models should
+pad stages to a common signature or pipeline only their uniform trunk.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def gpipe(fn: Callable, stage_params, microbatches, axis_name: str = "pipe"):
+    """Run ``microbatches`` through a ``n_stages``-deep pipeline.
+
+    Call inside a ``shard_map`` over ``axis_name``:
+
+    * ``stage_params`` — the stacked per-stage pytree: each leaf
+      ``(n_stages, ...)`` (see :func:`stack_stage_params`), passed through
+      shard_map with ``in_specs=P(axis_name)`` so each chip holds a unit
+      slice; ``gpipe`` strips that unit leading axis itself.
+    * ``microbatches`` — ``(M, mb, ...)`` the full microbatched input,
+      replicated (only stage 0 reads it).
+
+    Returns ``(M, mb, ...)`` outputs, replicated on every chip (the last
+    stage's results are psum-broadcast so downstream loss code is
+    placement-oblivious).
+    """
+    import jax
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    n_stages = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    stage_params = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    out_dtype = jax.eval_shape(
+        lambda p, x: fn(p, x), stage_params, microbatches[0]
+    ).dtype
+
+    def tick(t, carry):
+        recv, outputs = carry
+        # stage 0 ingests microbatch t (clamped; masked out when t >= M)
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), keepdims=False
+        )
+        x = jnp.where(idx == 0, feed, recv)
+        y = fn(stage_params, x)
+        # last stage completes microbatch t - (n_stages - 1)
+        done = t - (n_stages - 1)
+        write = jnp.logical_and(idx == n_stages - 1,
+                                jnp.logical_and(done >= 0, done < M))
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(write, y, lax.dynamic_index_in_dim(
+                outputs, jnp.maximum(done, 0), keepdims=False)),
+            jnp.maximum(done, 0), 0,
+        )
+        recv = lax.ppermute(y, axis_name, perm)
+        return recv, outputs
+
+    # carries are device-varying (each chip holds different in-flight data);
+    # mark the initial zeros as such for shard_map's replication typing
+    pcast = getattr(lax, "pcast", None)
+    vary = ((lambda t: pcast(t, axis_name, to="varying")) if pcast is not None
+            else (lambda t: lax.pvary(t, axis_name)))
+    recv0 = vary(jnp.zeros(mb_shape, out_dtype))
+    out0 = vary(jnp.zeros((M,) + mb_shape, out_dtype))
+    _, outputs = lax.fori_loop(0, M + n_stages - 1, tick, (recv0, out0))
+    # replicate the last stage's outputs to every chip
+    outputs = lax.psum(
+        jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    return outputs
+
+
+def stack_stage_params(per_stage_params):
+    """Host helper: list of per-stage pytrees (same structure) → one pytree
+    with a ``(n_stages, ...)`` leading axis per leaf, ready for
+    ``in_specs=P(axis_name)``."""
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+        *per_stage_params,
+    )
+
+
+def microbatch(x, n_micro: int):
+    """Host helper: (B, ...) → (n_micro, B/n_micro, ...)."""
+    B = x.shape[0]
+    assert B % n_micro == 0, f"batch {B} not divisible by {n_micro} microbatches"
+    return x.reshape(n_micro, B // n_micro, *x.shape[1:])
