@@ -39,5 +39,9 @@ from bigdl_tpu.nn.init_methods import (
     InitializationMethod, Zeros, Ones, ConstInitMethod, RandomUniform,
     RandomNormal, Xavier, MsraFiller, BilinearFiller,
 )
+from bigdl_tpu.nn.sparse import SparseLinear, SparseJoinTable
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear, QuantizedSpatialConvolution, Quantizer,
+)
 
 Module = AbstractModule  # reference alias: ``Module.load`` etc.

@@ -319,6 +319,13 @@ class AbstractModule:
             if was_training:
                 self.training()
 
+    def quantize(self) -> "AbstractModule":
+        """int8-quantize this trained model for inference (reference
+        ``module.quantize()`` → ``nn/quantized`` path)."""
+        from bigdl_tpu.nn.quantized import Quantizer
+
+        return Quantizer.quantize(self)
+
     def predict_class(self, inputs, batch_size: int = 32):
         """1-based predicted classes (reference ``predictClass``)."""
         from bigdl_tpu.optim.evaluator import Predictor

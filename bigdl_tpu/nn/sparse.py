@@ -1,0 +1,72 @@
+"""Sparse-input layers: SparseLinear, SparseJoinTable.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/SparseLinear.scala``
+(dense weight, sparse activations — the wide half of wide&deep models) and
+``SparseJoinTable.scala`` (feature-wise concat of sparse inputs).
+
+TPU-native: inputs are fixed-capacity COO :class:`SparseTensor`s; the matmul
+is a gather + ``segment_sum`` (see ``tensor/sparse.py``) that XLA fuses
+without densifying, and autodiff gives the dense weight gradient for free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomUniform
+from bigdl_tpu.nn.module import AbstractModule
+from bigdl_tpu.tensor.sparse import SparseTensor, sparse_dense_matmul, sparse_join
+
+
+class SparseLinear(AbstractModule):
+    """Linear over a sparse (B, in) activation; weight is dense (out, in)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": self.weight_init.init(k1, (self.output_size, self.input_size))}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.output_size,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        assert isinstance(input, SparseTensor), (
+            "SparseLinear wants a SparseTensor input"
+        )
+        out = sparse_dense_matmul(input, params["weight"].T)
+        if self.with_bias:
+            out = out + params["bias"]
+        return out, state
+
+    def __repr__(self) -> str:
+        return f"SparseLinear({self.input_size} -> {self.output_size})"
+
+
+class SparseJoinTable(AbstractModule):
+    """Concatenate sparse inputs along ``dimension`` (1-based, reference
+    semantics; 2 = feature dim)."""
+
+    def __init__(self, dimension: int = 2) -> None:
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        assert isinstance(input, (list, tuple)) and all(
+            isinstance(t, SparseTensor) for t in input
+        ), "SparseJoinTable wants a Table of SparseTensors"
+        return sparse_join(list(input), self.dimension), state
+
+    def __repr__(self) -> str:
+        return f"SparseJoinTable(dim={self.dimension})"

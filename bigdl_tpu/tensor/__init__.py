@@ -1,3 +1,6 @@
+from bigdl_tpu.tensor.sparse import (
+    SparseTensor, sparse_dense_matmul, sparse_join,
+)
 from bigdl_tpu.tensor.tensor import Tensor
 
-__all__ = ["Tensor"]
+__all__ = ["Tensor", "SparseTensor", "sparse_dense_matmul", "sparse_join"]

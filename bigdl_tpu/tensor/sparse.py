@@ -1,0 +1,153 @@
+"""SparseTensor — COO sparse tensor for sparse-input layers.
+
+Reference role (UNVERIFIED, SURVEY.md §0): ``.../bigdl/tensor/SparseTensor.scala``
+(+ ``SparseTensorMath``/``SparseTensorBLAS``) — a COO-ish sparse tensor
+backing ``SparseLinear``/``SparseJoinTable`` for wide sparse features.
+
+TPU-native redesign: XLA wants static shapes, so a SparseTensor is a fixed-
+capacity COO triple ``(indices (ndim, cap), values (cap,), shape)`` with a
+validity convention — unused slots carry value 0 and index 0, making every
+kernel a dense einsum/segment-sum over the capacity axis (no gather/scatter,
+no dynamic shapes; zero-valued padding contributes nothing). Registered as a
+JAX pytree (shape is static aux data) so sparse activations flow through
+``jit`` like any array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SparseTensor:
+    """Fixed-capacity COO sparse tensor."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape: Sequence[int]) -> None:
+        import jax.numpy as jnp
+
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)  # (ndim, cap)
+        self.values = jnp.asarray(values)                     # (cap,)
+        self.shape = tuple(int(s) for s in shape)
+        assert self.indices.ndim == 2 and self.indices.shape[0] == len(self.shape)
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_dense(dense, capacity: Optional[int] = None) -> "SparseTensor":
+        """Host-side: keep the nonzeros (padded to ``capacity`` slots)."""
+        arr = np.asarray(dense)
+        idx = np.nonzero(arr)
+        nnz = len(idx[0])
+        cap = capacity if capacity is not None else max(nnz, 1)
+        assert cap >= nnz, f"capacity {cap} < nnz {nnz}"
+        indices = np.zeros((arr.ndim, cap), np.int32)
+        values = np.zeros((cap,), arr.dtype)
+        for d in range(arr.ndim):
+            indices[d, :nnz] = idx[d]
+        values[:nnz] = arr[idx]
+        return SparseTensor(indices, values, arr.shape)
+
+    @staticmethod
+    def coo(indices, values, shape) -> "SparseTensor":
+        return SparseTensor(np.asarray(indices).T, values, shape)
+
+    # -- meta --------------------------------------------------------------
+
+    def nnz(self) -> int:
+        """Number of stored nonzeros (padding slots hold value 0)."""
+        import numpy as _np
+
+        return int(_np.count_nonzero(_np.asarray(self.values)))
+
+    def capacity(self) -> int:
+        return int(self.values.shape[0])
+
+    def dim(self) -> int:
+        return len(self.shape)
+
+    def size(self, d: Optional[int] = None):
+        return self.shape if d is None else self.shape[d - 1]  # 1-based
+
+    # -- conversions -------------------------------------------------------
+
+    def to_dense(self):
+        """Scatter-add into a dense array (pure; jit-safe)."""
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[tuple(self.indices)].add(self.values)
+
+    def astype(self, dtype) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values.astype(dtype), self.shape)
+
+    def __repr__(self) -> str:
+        return (f"SparseTensor(shape={self.shape}, capacity="
+                f"{int(self.values.shape[0])})")
+
+
+def sparse_dense_matmul(sp: SparseTensor, dense):
+    """``sp (B, D) @ dense (D, O) -> (B, O)`` as one segment-sum.
+
+    Each stored element (b, d, v) contributes ``v * dense[d]`` to row b —
+    a gather + segment_sum, which XLA lowers without materializing the
+    dense form. Zero-padded slots add zero rows.
+    """
+    import jax
+
+    assert sp.dim() == 2, "sparse_dense_matmul wants a 2-D sparse LHS"
+    rows, cols = sp.indices[0], sp.indices[1]
+    contrib = sp.values[:, None] * dense[cols]          # (cap, O)
+    return jax.ops.segment_sum(contrib, rows, num_segments=sp.shape[0])
+
+
+def sparse_join(tensors: Sequence[SparseTensor], dim: int = 2) -> SparseTensor:
+    """Concatenate 2-D sparse tensors along feature dim (1-based ``dim=2``,
+    the reference SparseJoinTable's case) or batch dim (``dim=1``)."""
+    import jax.numpy as jnp
+
+    assert all(t.dim() == 2 for t in tensors)
+    axis = dim - 1
+    offs, off = [], 0
+    for t in tensors:
+        offs.append(off)
+        off += t.shape[axis]
+    fixed = 1 - axis
+    base = tensors[0].shape[fixed]
+    assert all(t.shape[fixed] == base for t in tensors), "mismatched join"
+    idx_parts, val_parts = [], []
+    for t, o in zip(tensors, offs):
+        shifted = t.indices.at[axis].add(
+            jnp.where(t.values != 0, o, 0)  # keep padding slots at index 0
+        )
+        idx_parts.append(shifted)
+        val_parts.append(t.values)
+    indices = jnp.concatenate(idx_parts, axis=1)
+    values = jnp.concatenate(val_parts)
+    shape = list(tensors[0].shape)
+    shape[axis] = off
+    return SparseTensor(indices, values, shape)
+
+
+def _sparse_flatten(t: SparseTensor):
+    return (t.indices, t.values), t.shape
+
+
+def _sparse_unflatten(shape, children):
+    indices, values = children
+    obj = object.__new__(SparseTensor)
+    obj.indices = indices
+    obj.values = values
+    obj.shape = shape
+    return obj
+
+
+def _register():
+    import jax.tree_util as jtu
+
+    jtu.register_pytree_node(SparseTensor, _sparse_flatten, _sparse_unflatten)
+
+
+_register()

@@ -1,0 +1,101 @@
+"""int8 quantized path vs float originals (SURVEY.md §2.2 quantized row)."""
+
+import numpy as np
+
+
+def _rel_err(a, b):
+    return np.abs(a - b).max() / max(np.abs(b).max(), 1e-6)
+
+
+def test_quantized_linear_close_to_float(rng):
+    from bigdl_tpu.nn import Linear
+    from bigdl_tpu.nn.quantized import QuantizedLinear
+
+    lin = Linear(16, 8)
+    lin._ensure_params()
+    x = rng.randn(4, 16).astype(np.float32)
+    want = np.asarray(lin.forward(x))
+    q = QuantizedLinear.from_linear(lin)
+    got = np.asarray(q.forward(x))
+    assert got.dtype == np.float32
+    assert _rel_err(got, want) < 0.05
+
+
+def test_quantized_conv_close_to_float(rng):
+    from bigdl_tpu.nn import SpatialConvolution
+    from bigdl_tpu.nn.quantized import QuantizedSpatialConvolution
+
+    conv = SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    conv._ensure_params()
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+    want = np.asarray(conv.forward(x))
+    q = QuantizedSpatialConvolution.from_conv(conv)
+    got = np.asarray(q.forward(x))
+    assert got.shape == want.shape
+    assert _rel_err(got, want) < 0.08
+
+
+def test_module_quantize_sequential(rng):
+    from bigdl_tpu.nn import Linear, ReLU, Sequential
+    from bigdl_tpu.nn.quantized import QuantizedLinear
+
+    m = Sequential().add(Linear(12, 24)).add(ReLU()).add(Linear(24, 5))
+    m._ensure_params()
+    x = rng.randn(3, 12).astype(np.float32)
+    want = np.asarray(m.forward(x))
+
+    q = m.quantize()
+    assert isinstance(q.modules[0], QuantizedLinear)
+    assert isinstance(q.modules[2], QuantizedLinear)
+    assert not q.is_training()
+    got = np.asarray(q.forward(x))
+    assert _rel_err(got, want) < 0.1
+
+
+def test_module_quantize_graph(rng):
+    from bigdl_tpu.nn import Graph, Input, Linear, ReLU
+    from bigdl_tpu.nn.quantized import QuantizedLinear
+
+    inp = Input()
+    h = Linear(10, 20).inputs(inp)
+    h = ReLU().inputs(h)
+    out = Linear(20, 4).inputs(h)
+    g = Graph(inp, out)
+    g._ensure_params()
+    x = rng.randn(5, 10).astype(np.float32)
+    want = np.asarray(g.forward(x))
+
+    q = g.quantize()
+    assert any(isinstance(m, QuantizedLinear) for m in q._distinct_modules)
+    got = np.asarray(q.forward(x))
+    assert _rel_err(got, want) < 0.1
+
+
+def test_quantized_lenet_accuracy_preserved(rng):
+    """End-to-end: quantized LeNet agrees with float LeNet on argmax for
+    the overwhelming majority of inputs."""
+    from bigdl_tpu.models.lenet import LeNet5
+
+    m = LeNet5(10)
+    m._ensure_params()
+    m.evaluate()
+    x = rng.rand(32, 28 * 28).astype(np.float32)
+    want = np.asarray(m.forward(x)).argmax(-1)
+    q = m.quantize()
+    got = np.asarray(q.forward(x)).argmax(-1)
+    assert (got == want).mean() >= 0.9
+
+
+def test_quantize_descends_into_wrappers(rng):
+    """Linear held by TimeDistributed (no .modules list) must be swapped."""
+    from bigdl_tpu.nn import Linear, Sequential, TimeDistributed
+    from bigdl_tpu.nn.quantized import QuantizedLinear
+
+    m = Sequential().add(TimeDistributed(Linear(8, 8)))
+    m._ensure_params()
+    x = rng.randn(2, 5, 8).astype(np.float32)
+    want = np.asarray(m.forward(x))
+    q = m.quantize()
+    assert isinstance(q.modules[0].layer, QuantizedLinear)
+    got = np.asarray(q.forward(x))
+    assert _rel_err(got, want) < 0.1

@@ -30,6 +30,25 @@ def _recurrent():
     return nn.Recurrent().add(nn.LSTM(3, 4))
 
 
+def _quantized_linear():
+    lin = nn.Linear(4, 3)
+    lin._ensure_params()
+    return nn.QuantizedLinear.from_linear(lin)
+
+
+def _quantized_conv():
+    conv = nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)
+    conv._ensure_params()
+    return nn.QuantizedSpatialConvolution.from_conv(conv)
+
+
+def _sparse_input():
+    from bigdl_tpu.tensor import SparseTensor
+
+    dense = x(2, 4) * (R.random((2, 4)) < 0.5)
+    return SparseTensor.from_dense(dense, capacity=10)
+
+
 def _graph():
     inp = nn.Input()
     a = nn.Linear(4, 4).inputs(inp)
@@ -92,6 +111,10 @@ FACTORIES = {
     "Power": (lambda: nn.Power(2.0), np.abs(x(2, 3)) + 0.1),
     "ReLU": (lambda: nn.ReLU(), x(2, 3)),
     "ReLU6": (lambda: nn.ReLU6(), x(2, 3)),
+    "QuantizedLinear": (_quantized_linear, x(2, 4)),
+    "QuantizedSpatialConvolution": (_quantized_conv, x(2, 3, 5, 5)),
+    "SparseLinear": (lambda: nn.SparseLinear(4, 3), _sparse_input()),
+    "SparseJoinTable": (lambda: nn.SparseJoinTable(2), None),
     "Recurrent": (_recurrent, x(2, 5, 3)),
     "RecurrentDecoder": (lambda: nn.RecurrentDecoder(4).add(nn.RnnCell(3, 3)), x(2, 3)),
     "Reshape": (lambda: nn.Reshape([6]), x(2, 2, 3)),
