@@ -1,0 +1,421 @@
+"""Keras-style API: Sequential/Model with shape inference.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/keras/`` — a Keras-1.2
+flavored layer set (``Dense``, ``Convolution2D``, ``MaxPooling2D``, …) with
+``InferShape`` propagating shapes so only the FIRST layer declares
+``input_shape``.
+
+TPU-native redesign: each Keras layer is a thin shape-aware builder over the
+core ``bigdl_tpu.nn`` modules. Shape inference runs EAGERLY at ``add()`` /
+call time (every layer knows its output shape from its input shape), so the
+underlying core module graph exists immediately and ``jit`` traces one flat
+program — no deferred-build machinery at apply time.
+
+Shapes exclude the batch dim; images are CHW (matching the core NCHW conv).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from bigdl_tpu.nn import activations as _act
+from bigdl_tpu.nn import containers as _containers
+from bigdl_tpu.nn.module import AbstractModule
+
+Shape = Tuple[int, ...]
+
+_ACTIVATIONS = {
+    "relu": _act.ReLU, "tanh": _act.Tanh, "sigmoid": _act.Sigmoid,
+    "softmax": _act.SoftMax, "log_softmax": _act.LogSoftMax,
+    "elu": _act.ELU, "softplus": _act.SoftPlus, "softsign": _act.SoftSign,
+    "gelu": _act.GELU, "linear": None, None: None,
+}
+
+
+class KerasLayer(AbstractModule):
+    """Base: a shape-aware builder producing a core module in ``build``."""
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None) -> None:
+        super().__init__()
+        self.input_shape: Optional[Shape] = (
+            tuple(input_shape) if input_shape is not None else None
+        )
+        self.output_shape: Optional[Shape] = None
+        self._core: Optional[AbstractModule] = None
+
+    # subclass contract ----------------------------------------------------
+
+    def build_core(self, input_shape: Shape) -> AbstractModule:
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        raise NotImplementedError
+
+    # plumbing -------------------------------------------------------------
+
+    def build(self, input_shape: Shape) -> "KerasLayer":
+        self.input_shape = tuple(input_shape)
+        self._core = self.build_core(self.input_shape)
+        self.output_shape = self.compute_output_shape(self.input_shape)
+        return self
+
+    def get_output_shape(self) -> Shape:
+        assert self.output_shape is not None, f"{self} is not built yet"
+        return self.output_shape
+
+    def init_params(self, rng):
+        assert self._core is not None, f"{self} is not built yet"
+        return self._core.init_params(rng)
+
+    def init_state(self):
+        return self._core.init_state() if self._core is not None else {}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        assert self._core is not None, f"{self} is not built yet"
+        return self._core.apply(params, input, state, training=training, rng=rng)
+
+    def sub_modules(self):
+        return [self._core] if self._core is not None else []
+
+    # functional (Model) API: layer(node) builds from the node's shape
+    def __call__(self, node):  # type: ignore[override]
+        if isinstance(node, KerasNode):
+            self.build(node.shape)
+            return KerasNode(self.get_output_shape(), self, [node])
+        return self.forward(node)
+
+
+class KerasNode:
+    """A symbolic tensor in the functional API: (shape, producing layer)."""
+
+    def __init__(self, shape: Shape, layer: Optional[KerasLayer],
+                 inbound: Sequence["KerasNode"]) -> None:
+        self.shape = tuple(shape)
+        self.layer = layer
+        self.inbound = list(inbound)
+
+
+def Input(shape: Sequence[int]) -> KerasNode:
+    """Entry point of the functional API (batch dim excluded)."""
+    return KerasNode(tuple(shape), None, [])
+
+
+def _maybe_activation(core: AbstractModule, activation) -> AbstractModule:
+    if activation is None or activation == "linear":
+        return core
+    act = _ACTIVATIONS[activation]() if isinstance(activation, str) else activation
+    return _containers.Sequential().add(core).add(act)
+
+
+class Dense(KerasLayer):
+    def __init__(self, output_dim: int, activation=None, bias: bool = True,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.linear import Linear
+
+        return _maybe_activation(
+            Linear(input_shape[-1], self.output_dim, with_bias=self.bias),
+            self.activation,
+        )
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.activation = activation
+
+    def build_core(self, input_shape):
+        return _ACTIVATIONS[self.activation]()
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.misc import Dropout as CoreDropout
+
+        return CoreDropout(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class Flatten(KerasLayer):
+    def build_core(self, input_shape):
+        import numpy as np
+
+        from bigdl_tpu.nn.shape_ops import Reshape
+
+        return Reshape([int(np.prod(input_shape))], batch_mode=True)
+
+    def compute_output_shape(self, input_shape):
+        import numpy as np
+
+        return (int(np.prod(input_shape)),)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Sequence[int], input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.target_shape = tuple(target_shape)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.shape_ops import Reshape as CoreReshape
+
+        return CoreReshape(list(self.target_shape), batch_mode=True)
+
+    def compute_output_shape(self, input_shape):
+        return self.target_shape
+
+
+class Convolution2D(KerasLayer):
+    """CHW input; ``border_mode``: 'valid' | 'same' (Keras-1.2 names)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample: Tuple[int, int] = (1, 1),
+                 border_mode: str = "valid", activation=None,
+                 bias: bool = True, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.subsample = tuple(subsample)
+        self.border_mode = border_mode
+        self.activation = activation
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.conv import SpatialConvolution
+
+        pad = -1 if self.border_mode == "same" else 0
+        return _maybe_activation(
+            SpatialConvolution(
+                input_shape[0], self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0], pad, pad,
+                with_bias=self.bias,
+            ),
+            self.activation,
+        )
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        sh, sw = self.subsample
+        if self.border_mode == "same":
+            oh = -(-h // sh)
+            ow = -(-w // sw)
+        else:
+            oh = (h - self.nb_row) // sh + 1
+            ow = (w - self.nb_col) // sw + 1
+        return (self.nb_filter, oh, ow)
+
+
+class _Pooling2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides is not None else self.pool_size
+        self.border_mode = border_mode
+
+    def _core_cls(self):
+        raise NotImplementedError
+
+    def build_core(self, input_shape):
+        pad = -1 if self.border_mode == "same" else 0
+        return self._core_cls()(
+            self.pool_size[1], self.pool_size[0],
+            self.strides[1], self.strides[0], pad, pad,
+        )
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        if self.border_mode == "same":
+            return (c, -(-h // sh), -(-w // sw))
+        return (c, (h - ph) // sh + 1, (w - pw) // sw + 1)
+
+
+class MaxPooling2D(_Pooling2D):
+    def _core_cls(self):
+        from bigdl_tpu.nn.pooling import SpatialMaxPooling
+
+        return SpatialMaxPooling
+
+
+class AveragePooling2D(_Pooling2D):
+    def _core_cls(self):
+        from bigdl_tpu.nn.pooling import SpatialAveragePooling
+
+        return SpatialAveragePooling
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn import normalization as _norm
+
+        if len(input_shape) == 3:  # CHW feature maps
+            return _norm.SpatialBatchNormalization(
+                input_shape[0], eps=self.epsilon, momentum=1 - self.momentum)
+        return _norm.BatchNormalization(
+            input_shape[-1], eps=self.epsilon, momentum=1 - self.momentum)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class _ShiftIndices(AbstractModule):
+    """Keras token ids are 0-based; the core LookupTable is 1-based
+    (reference convention) — shift by +1, preserving the integer dtype."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input + 1, state
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim: int, output_dim: int, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.misc import LookupTable
+
+        return (_containers.Sequential()
+                .add(_ShiftIndices())
+                .add(LookupTable(self.input_dim, self.output_dim)))
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class _KerasRecurrent(KerasLayer):
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+
+    def _cell(self, input_size):
+        raise NotImplementedError
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.recurrent import Recurrent
+        from bigdl_tpu.nn.shape_ops import Select
+
+        rec = Recurrent().add(self._cell(input_shape[-1]))
+        if self.return_sequences:
+            return rec
+        return _containers.Sequential().add(rec).add(Select(2, -1))
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], self.output_dim)
+        return (self.output_dim,)
+
+
+class LSTM(_KerasRecurrent):
+    def _cell(self, input_size):
+        from bigdl_tpu.nn.recurrent import LSTM as CoreLSTM
+
+        return CoreLSTM(input_size, self.output_dim)
+
+
+class GRU(_KerasRecurrent):
+    def _cell(self, input_size):
+        from bigdl_tpu.nn.recurrent import GRU as CoreGRU
+
+        return CoreGRU(input_size, self.output_dim)
+
+
+class Sequential(KerasLayer):
+    """Keras-style Sequential: the first layer carries ``input_shape``;
+    every later layer infers its shape at ``add`` time."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.layers = []
+        self._seq = _containers.Sequential()
+        self._core = self._seq
+        self._cur: Optional[Shape] = None
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        if self._cur is None:
+            assert layer.input_shape is not None, (
+                "first layer needs input_shape=..."
+            )
+            self._cur = layer.input_shape
+            self.input_shape = layer.input_shape
+        layer.build(self._cur)
+        self._cur = layer.get_output_shape()
+        self.output_shape = self._cur
+        self.layers.append(layer)
+        self._seq.add(layer)
+        return self
+
+    def build_core(self, input_shape):
+        return self._seq
+
+    def compute_output_shape(self, input_shape):
+        return self._cur
+
+    def get_output_shape(self) -> Shape:
+        assert self._cur is not None, "empty keras Sequential"
+        return self._cur
+
+
+class Model(KerasLayer):
+    """Functional API: ``Model(input=node(s), output=node)`` assembles the
+    core ``Graph`` from the symbolic KerasNode DAG."""
+
+    def __init__(self, input, output) -> None:
+        super().__init__()
+        from bigdl_tpu.nn.graph import Graph
+        from bigdl_tpu.nn.graph import Input as GraphInput
+
+        ins = input if isinstance(input, (list, tuple)) else [input]
+        node_map = {}
+
+        def lower(kn: KerasNode):
+            nid = id(kn)
+            if nid in node_map:
+                return node_map[nid]
+            if kn.layer is None:
+                gn = GraphInput()
+            else:
+                gn = kn.layer.inputs(*[lower(p) for p in kn.inbound])
+            node_map[nid] = gn
+            return gn
+
+        outs = output if isinstance(output, (list, tuple)) else [output]
+        g_outs = [lower(o) for o in outs]
+        g_ins = [node_map[id(i)] for i in ins]
+        self._core = Graph(g_ins if len(g_ins) > 1 else g_ins[0],
+                           g_outs if len(g_outs) > 1 else g_outs[0])
+        self.input_shape = tuple(ins[0].shape)
+        self.output_shape = tuple(outs[0].shape)
+
+    def build_core(self, input_shape):
+        return self._core
+
+    def compute_output_shape(self, input_shape):
+        return self.output_shape

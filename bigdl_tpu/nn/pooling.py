@@ -37,6 +37,8 @@ class _SpatialPooling(TensorModule):
         return self
 
     def _out_size(self, in_size: int, k: int, d: int, p: int) -> int:
+        if p == -1:  # reference convention: -1 = TF-style SAME
+            return -(-in_size // d)
         if self.ceil_mode:
             out = int(math.ceil((in_size + 2 * p - k) / d)) + 1
         else:
@@ -47,6 +49,12 @@ class _SpatialPooling(TensorModule):
 
     def _pads(self, h: int, w: int):
         """(low, high) padding per spatial dim incl. ceil-mode extra."""
+        if self.pad_h == -1 or self.pad_w == -1:  # TF-style SAME
+            oh = -(-h // self.dh)
+            ow = -(-w // self.dw)
+            th = max((oh - 1) * self.dh + self.kh - h, 0)
+            tw = max((ow - 1) * self.dw + self.kw - w, 0)
+            return (th // 2, th - th // 2), (tw // 2, tw - tw // 2)
         oh = self._out_size(h, self.kh, self.dh, self.pad_h)
         ow = self._out_size(w, self.kw, self.dw, self.pad_w)
         extra_h = max((oh - 1) * self.dh + self.kh - h - 2 * self.pad_h, 0)

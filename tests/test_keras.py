@@ -1,0 +1,154 @@
+"""Keras-style API: shape inference + parity with hand-built core models
+(SURVEY.md §2.2 keras row)."""
+
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def test_sequential_shape_inference(rng):
+    from bigdl_tpu.nn import keras as K
+
+    m = (K.Sequential()
+         .add(K.Convolution2D(6, 5, 5, input_shape=(1, 28, 28),
+                              activation="tanh"))
+         .add(K.MaxPooling2D((2, 2)))
+         .add(K.Convolution2D(12, 5, 5, activation="tanh"))
+         .add(K.MaxPooling2D((2, 2)))
+         .add(K.Flatten())
+         .add(K.Dense(100, activation="tanh"))
+         .add(K.Dense(10, activation="log_softmax")))
+    assert m.get_output_shape() == (10,)
+
+    x = rng.rand(4, 1, 28, 28).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (4, 10)
+    # log_softmax rows sum to 1 in prob space
+    assert_close(np.exp(out).sum(-1), np.ones(4), atol=1e-4)
+
+
+def test_dense_matches_core_linear(rng):
+    from bigdl_tpu.nn import Linear
+    from bigdl_tpu.nn import keras as K
+
+    d = K.Dense(7, input_shape=(12,))
+    d.build((12,))
+    d._ensure_params()
+    x = rng.randn(3, 12).astype(np.float32)
+    out = np.asarray(d.forward(x))
+
+    assert isinstance(d._core, Linear)  # no activation wraps Linear directly
+    lin = Linear(12, 7)
+    lin.params = d.params  # KerasLayer materializes the core's params
+    lin.state = {}
+    lin._ensure_params()
+    want = np.asarray(lin.forward(x))
+    assert_close(out, want)
+
+
+def test_same_padding_shapes(rng):
+    from bigdl_tpu.nn import keras as K
+
+    m = (K.Sequential()
+         .add(K.Convolution2D(4, 3, 3, input_shape=(3, 9, 9),
+                              border_mode="same", subsample=(2, 2)))
+         .add(K.AveragePooling2D((2, 2), border_mode="same")))
+    assert m.get_output_shape() == (4, 3, 3)
+    out = m.forward(rng.rand(2, 3, 9, 9).astype(np.float32))
+    assert np.asarray(out).shape == (2, 4, 3, 3)
+
+
+def test_batchnorm_dropout_reshape(rng):
+    from bigdl_tpu.nn import keras as K
+
+    m = (K.Sequential()
+         .add(K.Dense(24, input_shape=(8,)))
+         .add(K.BatchNormalization())
+         .add(K.Dropout(0.5))
+         .add(K.Reshape((4, 6)))
+         .add(K.Flatten()))
+    assert m.get_output_shape() == (24,)
+    m.evaluate()
+    out = m.forward(rng.randn(5, 8).astype(np.float32))
+    assert np.asarray(out).shape == (5, 24)
+
+
+def test_lstm_return_sequences(rng):
+    from bigdl_tpu.nn import keras as K
+
+    x = rng.randn(2, 7, 5).astype(np.float32)
+    seq = K.Sequential().add(K.LSTM(9, return_sequences=True,
+                                    input_shape=(7, 5)))
+    assert seq.get_output_shape() == (7, 9)
+    assert np.asarray(seq.forward(x)).shape == (2, 7, 9)
+
+    last = K.Sequential().add(K.LSTM(9, input_shape=(7, 5)))
+    assert last.get_output_shape() == (9,)
+    assert np.asarray(last.forward(x)).shape == (2, 9)
+
+
+def test_functional_model(rng):
+    from bigdl_tpu.nn import keras as K
+
+    inp = K.Input(shape=(16,))
+    h = K.Dense(32, activation="relu")(inp)
+    out = K.Dense(4, activation="softmax")(h)
+    m = K.Model(input=inp, output=out)
+    assert m.output_shape == (4,)
+
+    x = rng.randn(6, 16).astype(np.float32)
+    y = np.asarray(m.forward(x))
+    assert y.shape == (6, 4)
+    assert_close(y.sum(-1), np.ones(6), atol=1e-5)
+
+
+def test_keras_model_trains(rng):
+    """End-to-end: a keras Sequential trains through the Optimizer plane."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.nn import keras as K
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    m = (K.Sequential()
+         .add(K.Dense(16, activation="relu", input_shape=(6,)))
+         .add(K.Dense(3, activation="log_softmax")))
+    # separable blobs
+    xs, ys = [], []
+    for i in range(60):
+        c = i % 3
+        xs.append((rng.randn(6) * 0.3 + np.eye(3)[c].repeat(2) * 2
+                   ).astype(np.float32))
+        ys.append(np.int32(c + 1))
+    samples = [Sample(x, y) for x, y in zip(xs, ys)]
+    opt = Optimizer(model=m, dataset=DataSet.array(samples),
+                    criterion=ClassNLLCriterion(), batch_size=20)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(Trigger.max_epoch(20))
+    trained = opt.optimize()
+
+    logits = np.asarray(trained.evaluate().forward(np.stack(xs)))
+    acc = (logits.argmax(-1) + 1 == np.asarray(ys)).mean()
+    assert acc > 0.8, f"keras model failed to train, acc={acc}"
+
+
+def test_embedding_zero_based_ids(rng):
+    """Keras ids are 0-based; row i of the table must embed token i."""
+    from bigdl_tpu.nn import keras as K
+
+    emb = K.Embedding(10, 4, input_shape=(3,))
+    emb.build((3,))
+    emb._ensure_params()
+    ids = np.array([[0, 1, 9]], np.int32)
+    out = np.asarray(emb.forward(ids))
+    # find the LookupTable weight leaf
+    import jax
+
+    table = [w for w in jax.tree_util.tree_leaves(emb.params)
+             if np.asarray(w).shape == (10, 4)][0]
+    table = np.asarray(table)
+    assert_close(out[0, 0], table[0], atol=1e-6)
+    assert_close(out[0, 1], table[1], atol=1e-6)
+    assert_close(out[0, 2], table[9], atol=1e-6)
+    # token 0 must receive gradient (not a silently zeroed row)
+    assert np.abs(out[0, 0]).sum() > 0
