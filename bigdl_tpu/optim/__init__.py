@@ -11,6 +11,7 @@ from bigdl_tpu.optim.validation import (
     AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
     ValidationMethod, ValidationResult,
 )
+from bigdl_tpu.optim.lbfgs import LBFGS, strong_wolfe
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer
 
@@ -22,5 +23,6 @@ __all__ = [
     "Evaluator", "LocalPredictor", "Predictor",
     "AccuracyResult", "Loss", "LossResult", "MAE", "Top1Accuracy",
     "Top5Accuracy", "ValidationMethod", "ValidationResult",
+    "LBFGS", "strong_wolfe",
     "Metrics", "L1L2Regularizer", "L1Regularizer", "L2Regularizer",
 ]

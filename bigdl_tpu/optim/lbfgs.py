@@ -1,0 +1,206 @@
+"""LBFGS + line search.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/LBFGS.scala`` +
+``LineSearch.scala`` — torch/optim-style L-BFGS: two-loop recursion over an
+``nCorrection``-deep curvature history, optional strong-Wolfe cubic line
+search (``lswolfe``), tolerances ``tolFun``/``tolX``, eval budget
+``maxEval``.
+
+TPU-native shape: the driver loop is host-level (it is inherently
+data-dependent — bracketing line search, history pruning), but every vector
+operation runs on device over ONE flattened parameter vector, and ``feval``
+is expected to be a jitted loss/grad function — so each of the few dozen
+evaluations per step is a single compiled launch. This mirrors how the
+reference used LBFGS (full-batch, small problems) rather than the
+per-minibatch SGD path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.optim.optim_method import OptimMethod
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2); torch recipe."""
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    sq = d1 * d1 - g1 * g2
+    if sq < 0:
+        return (x1 + x2) / 2.0
+    d2 = np.sqrt(sq)
+    if x1 <= x2:
+        t = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+    else:
+        t = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+    lo, hi = min(x1, x2), max(x1, x2)
+    return float(min(max(t, lo), hi))
+
+
+def strong_wolfe(feval_dir: Callable, t: float, f0: float, g0: float,
+                 c1: float = 1e-4, c2: float = 0.9, max_ls: int = 25):
+    """Strong-Wolfe line search along a direction.
+
+    ``feval_dir(t) -> (f, g)`` with g the DIRECTIONAL derivative at step t.
+    Returns ``(t, f_t, n_evals)``. Reference ``LineSearch.scala — lswolfe``.
+    """
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    n_evals = 0
+    f_t, g_t = feval_dir(t)
+    n_evals += 1
+    bracket = None
+    for _ in range(max_ls):
+        if f_t > f0 + c1 * t * g0 or (n_evals > 1 and f_t >= f_prev):
+            bracket = (t_prev, f_prev, g_prev, t, f_t, g_t)
+            break
+        if abs(g_t) <= -c2 * g0:
+            return t, f_t, n_evals
+        if g_t >= 0:
+            bracket = (t, f_t, g_t, t_prev, f_prev, g_prev)
+            break
+        t_prev, f_prev, g_prev = t, f_t, g_t
+        t = min(10 * t, 1e8)
+        f_t, g_t = feval_dir(t)
+        n_evals += 1
+    if bracket is None:  # ran out of extrapolations
+        return t, f_t, n_evals
+    # zoom phase
+    lo_t, lo_f, lo_g, hi_t, hi_f, hi_g = bracket
+    for _ in range(max_ls):
+        t = _cubic_interpolate(lo_t, lo_f, lo_g, hi_t, hi_f, hi_g)
+        # guard against stagnation at the bracket edge
+        span = abs(hi_t - lo_t)
+        if span < 1e-9:
+            break
+        if min(abs(t - lo_t), abs(t - hi_t)) < 0.1 * span:
+            t = (lo_t + hi_t) / 2.0
+        f_t, g_t = feval_dir(t)
+        n_evals += 1
+        if f_t > f0 + c1 * t * g0 or f_t >= lo_f:
+            hi_t, hi_f, hi_g = t, f_t, g_t
+        else:
+            if abs(g_t) <= -c2 * g0:
+                return t, f_t, n_evals
+            if g_t * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+            lo_t, lo_f, lo_g = t, f_t, g_t
+    return lo_t, lo_f, n_evals
+
+
+class LBFGS(OptimMethod):
+    """Full-batch L-BFGS over the flattened parameter vector."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[int] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: Optional[str] = "strong_wolfe") -> None:
+        super().__init__()
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else int(max_iter * 1.25)
+        self.tol_fun = tol_fun
+        self.tol_x = tol_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+
+    def optimize(self, feval: Callable, x):
+        """Run up to ``max_iter`` L-BFGS iterations from ``x``.
+
+        ``feval(x) -> (loss, grad)`` over the SAME pytree/array structure as
+        ``x``. Returns ``(new_x, [loss history])`` like the reference.
+        """
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        flat0, unravel = ravel_pytree(x)
+
+        def fe(v):
+            loss, grad = feval(unravel(v))
+            gflat, _ = ravel_pytree(grad)
+            return float(np.asarray(loss)), gflat
+
+        losses: List[float] = []
+        xk = flat0
+        f, g = fe(xk)
+        losses.append(f)
+        n_evals = 1
+        s_hist: List = []
+        y_hist: List = []
+        rho_hist: List[float] = []
+        gamma = 1.0
+
+        for it in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tol_fun:
+                break  # gradient small enough
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                                 reversed(rho_hist)):
+                a = rho * float(jnp.vdot(s, q))
+                alphas.append(a)
+                q = q - a * y
+            d = gamma * q
+            for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                      reversed(alphas)):
+                b = rho * float(jnp.vdot(y, d))
+                d = d + (a - b) * s
+            d = -d
+            gtd = float(jnp.vdot(g, d))
+            if gtd > -1e-12:  # not a descent direction; reset history
+                d = -g
+                gtd = -float(jnp.vdot(g, g))
+                s_hist, y_hist, rho_hist = [], [], []
+
+            t0 = (self.learning_rate if it > 0 or s_hist
+                  else min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-12))
+                  * self.learning_rate)
+            if self.line_search == "strong_wolfe":
+                # cache (f, grad) per step size so the accepted point's full
+                # gradient is reused instead of re-launching feval
+                ls_cache = {}
+
+                def fe_dir(t):
+                    ft, gt = fe(xk + t * d)
+                    ls_cache[t] = (ft, gt)
+                    return ft, float(jnp.vdot(gt, d))
+
+                t, _f_ls, ls_evals = strong_wolfe(fe_dir, t0, f, gtd)
+                n_evals += ls_evals
+            else:
+                t, ls_cache = t0, {}
+
+            x_new = xk + t * d
+            f_old = f
+            if t in ls_cache:
+                f, g_new = ls_cache[t]
+            else:
+                f, g_new = fe(x_new)
+                n_evals += 1
+            losses.append(f)
+
+            s = x_new - xk
+            y = g_new - g
+            ys = float(jnp.vdot(y, s))
+            if ys > 1e-10:
+                if len(s_hist) >= self.n_correction:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+                    rho_hist.pop(0)
+                s_hist.append(s)
+                y_hist.append(y)
+                rho_hist.append(1.0 / ys)
+                gamma = ys / float(jnp.vdot(y, y))
+            xk, g = x_new, g_new
+
+            if n_evals >= self.max_eval:
+                break
+            if float(jnp.max(jnp.abs(s))) <= self.tol_x:
+                break
+            if abs(f - f_old) < self.tol_fun:
+                break
+
+        self.state["neval"] = self.state.get("neval", 1) + 1
+        return unravel(xk), losses

@@ -1,0 +1,88 @@
+"""LBFGS + strong-Wolfe line search (SURVEY.md §2.3 LBFGS row)."""
+
+import numpy as np
+
+
+def test_lbfgs_quadratic(rng):
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import LBFGS
+
+    A = rng.randn(6, 6).astype(np.float32)
+    A = A @ A.T + 0.5 * np.eye(6, dtype=np.float32)  # SPD
+    b = rng.randn(6).astype(np.float32)
+
+    def feval(x):
+        g = jnp.matmul(A, x) - b
+        f = 0.5 * jnp.vdot(x, jnp.matmul(A, x)) - jnp.vdot(b, x)
+        return f, g
+
+    x0 = np.zeros(6, np.float32)
+    opt = LBFGS(max_iter=50, max_eval=500)
+    x, losses = opt.optimize(feval, x0)
+    x_star = np.linalg.solve(A, b)
+    assert np.abs(np.asarray(x) - x_star).max() < 1e-2
+    assert losses[-1] < losses[0]
+
+
+def test_lbfgs_rosenbrock():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import LBFGS
+
+    def rosen(x):
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1 - x[:-1]) ** 2)
+
+    vg = jax.jit(jax.value_and_grad(rosen))
+    opt = LBFGS(max_iter=200, max_eval=2000, tol_fun=1e-9)
+    x, losses = opt.optimize(lambda x: vg(x), np.zeros(4, np.float32))
+    assert np.abs(np.asarray(x) - 1.0).max() < 1e-2, (
+        f"rosenbrock min not reached: {np.asarray(x)}, loss={losses[-1]}"
+    )
+
+
+def test_lbfgs_trains_tiny_net(rng):
+    """Full-batch LBFGS on a small classification net via the pure core."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, Sequential, Tanh
+    from bigdl_tpu.optim import LBFGS
+
+    m = (Sequential().add(Linear(6, 16)).add(Tanh())
+         .add(Linear(16, 3)).add(LogSoftMax()))
+    m._ensure_params()
+    crit = ClassNLLCriterion()
+
+    x = rng.randn(30, 6).astype(np.float32)
+    y = (np.arange(30) % 3 + 1).astype(np.int32)
+    x += np.eye(3)[(y - 1)].repeat(2, -1).astype(np.float32) * 2
+
+    def feval(params):
+        def loss_fn(p):
+            out, _ = m.apply(p, jnp.asarray(x), m.state)
+            return crit.apply(out, jnp.asarray(y))
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    new_params, losses = LBFGS(max_iter=30).optimize(feval, m.params)
+    assert losses[-1] < 0.2, f"loss history {losses[:3]}...{losses[-3:]}"
+    m.params = new_params
+    pred = np.asarray(m.forward(x)).argmax(-1) + 1
+    assert (pred == y).mean() > 0.95
+
+
+def test_strong_wolfe_conditions():
+    from bigdl_tpu.optim import strong_wolfe
+
+    # 1-D convex: f(t) = (t-2)^2, start at t=1 direction derivative at 0
+    f0, g0 = 4.0, -4.0  # f(0), f'(0)
+
+    def fe(t):
+        return (t - 2.0) ** 2, 2.0 * (t - 2.0)
+
+    t, f_t, evals = strong_wolfe(fe, 1.0, f0, g0)
+    # Armijo + curvature at the accepted point
+    assert f_t <= f0 + 1e-4 * t * g0
+    assert abs(2.0 * (t - 2.0)) <= 0.9 * abs(g0)
