@@ -1,0 +1,279 @@
+"""Extended criterion set (completing SURVEY.md §2.2's ~30-criterion row).
+
+Reference (UNVERIFIED, SURVEY.md §0): one class per file under
+``.../bigdl/nn/`` — ``CosineEmbeddingCriterion``, ``HingeEmbeddingCriterion``,
+``MarginRankingCriterion``, ``MultiMarginCriterion``,
+``MultiLabelMarginCriterion``, ``L1Cost``, ``SoftmaxWithCriterion``,
+``DiceCoefficientCriterion``, ``MultiCriterion``, ``KLDCriterion``,
+``GaussianCriterion``, ``CosineDistanceCriterion``. Torch-heritage
+semantics kept: 1-based class labels, ``size_average`` batch mean, ±1
+similarity labels.
+
+Each is one pure scalar ``apply(input, target)`` that jits into the train
+step; tensor-pair inputs arrive as 2-element Tables (lists).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from bigdl_tpu.nn.criterion import AbstractCriterion
+
+
+def _mean_or_sum(x, size_average: bool, n):
+    return x / n if size_average else x
+
+
+class CosineEmbeddingCriterion(AbstractCriterion):
+    """Input ``[x1, x2]`` (N, D), target y ∈ {1, -1} per row:
+    ``1 - cos`` for similar pairs, ``max(0, cos - margin)`` for dissimilar."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True) -> None:
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.layers_extra import cosine_similarity
+
+        x1, x2 = input
+        y = jnp.reshape(jnp.asarray(target), (-1,))
+        cos = cosine_similarity(x1, x2)
+        per = jnp.where(y > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _mean_or_sum(jnp.sum(per), self.size_average, per.shape[0])
+
+
+class HingeEmbeddingCriterion(AbstractCriterion):
+    """Scalar distances x with y ∈ {1, -1}: ``x`` when similar,
+    ``max(0, margin - x)`` when dissimilar."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True) -> None:
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        x = jnp.reshape(input, (-1,))
+        y = jnp.reshape(jnp.asarray(target), (-1,))
+        per = jnp.where(y > 0, x, jnp.maximum(0.0, self.margin - x))
+        return _mean_or_sum(jnp.sum(per), self.size_average, per.shape[0])
+
+
+class MarginRankingCriterion(AbstractCriterion):
+    """Input ``[x1, x2]`` scores; y=1 means x1 should rank higher:
+    ``max(0, -y(x1 - x2) + margin)``."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True) -> None:
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        x1 = jnp.reshape(input[0], (-1,))
+        x2 = jnp.reshape(input[1], (-1,))
+        y = jnp.reshape(jnp.asarray(target), (-1,))
+        per = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return _mean_or_sum(jnp.sum(per), self.size_average, per.shape[0])
+
+
+class MultiMarginCriterion(AbstractCriterion):
+    """Multi-class hinge on (N, C) scores with 1-based targets:
+    mean over classes of ``max(0, margin - x[y] + x[i])^p``."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True) -> None:
+        super().__init__()
+        assert p in (1, 2)
+        self.p = p
+        self.weights = weights
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.reshape(jnp.asarray(target), (-1,)).astype(jnp.int32) - 1
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, t[:, None], 1)          # (N, 1)
+        m = jnp.maximum(0.0, self.margin - xy + x)          # (N, C)
+        if self.p == 2:
+            m = m * m
+        if self.weights is not None:
+            m = m * jnp.take(jnp.asarray(self.weights), t)[:, None]
+        # the y-th column contributes margin^p; zero it like the reference
+        mask = jnp.arange(c)[None, :] != t[:, None]
+        per = jnp.sum(m * mask, -1) / c
+        return _mean_or_sum(jnp.sum(per), self.size_average, n)
+
+
+class MultiLabelMarginCriterion(AbstractCriterion):
+    """(N, C) scores, targets (N, C): 1-based class indices, 0-padded
+    (torch convention). Hinge between every target class and every
+    non-target class, normalized by C."""
+
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.asarray(target).astype(jnp.int32)
+        t = t if t.ndim == 2 else t[None]
+        n, c = x.shape
+        # torch semantics: only indices BEFORE the first 0 are targets
+        seen_zero = jnp.cumsum(t == 0, axis=1) > 0
+        valid = (t > 0) & (~seen_zero)                      # (N, K)
+        tclamped = jnp.maximum(t - 1, 0)
+        # is_target[b, c] = class c is one of row b's targets
+        is_target = jnp.any(
+            (jnp.arange(c)[None, None, :] == tclamped[:, :, None]) & valid[:, :, None],
+            axis=1,
+        )
+        xt = jnp.take_along_axis(x, tclamped, 1)            # (N, K) target scores
+        # hinge: for each valid target j and each non-target i
+        h = jnp.maximum(0.0, 1.0 - (xt[:, :, None] - x[:, None, :]))  # (N,K,C)
+        contrib = h * valid[:, :, None] * (~is_target)[:, None, :]
+        per = jnp.sum(contrib, (1, 2)) / c
+        return _mean_or_sum(jnp.sum(per), self.size_average, n)
+
+
+class L1Cost(AbstractCriterion):
+    """``sum |input|`` — the target is ignored (reference ``L1Cost``)."""
+
+    def apply(self, input, target=None):
+        import jax.numpy as jnp
+
+        return jnp.sum(jnp.abs(input))
+
+
+class SoftmaxWithCriterion(AbstractCriterion):
+    """Caffe-style SoftmaxWithLoss: raw logits (N, C) + 1-based targets;
+    softmax and NLL fused (one stable log_softmax under XLA)."""
+
+    _MODES = ("VALID", "FULL", "BATCH_SIZE", "NONE")
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID") -> None:
+        super().__init__()
+        if normalize_mode not in self._MODES:
+            raise ValueError(
+                f"normalize_mode must be one of {self._MODES}, "
+                f"got {normalize_mode!r}")
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def apply(self, input, target):
+        import jax
+        import jax.numpy as jnp
+
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.reshape(jnp.asarray(target), (-1,)).astype(jnp.int32) - 1
+        logp = jax.nn.log_softmax(x, axis=-1)
+        picked = jnp.take_along_axis(logp, jnp.maximum(t, 0)[:, None], 1)[:, 0]
+        n_valid = picked.shape[0]
+        if self.ignore_label is not None:
+            keep = t != (self.ignore_label - 1)
+            picked = picked * keep
+            n_valid = jnp.maximum(jnp.sum(keep), 1)
+        if self.normalize_mode == "NONE":
+            return -jnp.sum(picked)
+        if self.normalize_mode in ("FULL", "BATCH_SIZE"):
+            return -jnp.sum(picked) / picked.shape[0]
+        return -jnp.sum(picked) / n_valid  # VALID
+
+
+class DiceCoefficientCriterion(AbstractCriterion):
+    """1 - Dice overlap (segmentation loss): ``1 - 2·Σxt / (Σx + Σt + ε)``."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0) -> None:
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        x = input.reshape(input.shape[0], -1)
+        t = jnp.asarray(target).reshape(x.shape)
+        inter = jnp.sum(x * t, -1)
+        per = 1.0 - (2.0 * inter + self.epsilon) / (
+            jnp.sum(x, -1) + jnp.sum(t, -1) + self.epsilon)
+        return _mean_or_sum(jnp.sum(per), self.size_average, per.shape[0])
+
+
+class MultiCriterion(AbstractCriterion):
+    """Weighted sum of sub-criterions over the SAME (input, target)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.criterions: List[AbstractCriterion] = []
+        self.weights: List[float] = []
+
+    def add(self, criterion: AbstractCriterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for c, w in zip(self.criterions, self.weights):
+            total = total + w * c.apply(input, target)
+        return total
+
+
+class KLDCriterion(AbstractCriterion):
+    """VAE posterior KL to N(0, I): input ``[mean, log_var]``, target
+    ignored: ``-½ Σ (1 + log σ² - μ² - σ²)`` averaged over the batch."""
+
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target=None):
+        import jax.numpy as jnp
+
+        mean, log_var = input
+        kl = -0.5 * jnp.sum(1.0 + log_var - mean * mean - jnp.exp(log_var))
+        return _mean_or_sum(kl, self.size_average, mean.shape[0])
+
+
+class GaussianCriterion(AbstractCriterion):
+    """Negative log-likelihood of the target under N(mean, σ²) with input
+    ``[mean, log_var]``: ``½ Σ (log 2π + log σ² + (t-μ)²/σ²)``."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        mean, log_var = input
+        t = jnp.asarray(target)
+        return 0.5 * jnp.sum(
+            jnp.log(2.0 * jnp.pi) + log_var
+            + (t - mean) ** 2 / jnp.exp(log_var)
+        )
+
+
+class CosineDistanceCriterion(AbstractCriterion):
+    """``1 - cos(input, target)`` per row (reference
+    ``CosineDistanceCriterion``)."""
+
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.layers_extra import cosine_similarity
+
+        t = jnp.asarray(target)
+        per = 1.0 - cosine_similarity(input, t)
+        return _mean_or_sum(jnp.sum(per), self.size_average, per.shape[0])

@@ -1,0 +1,158 @@
+"""Extended criterions vs torch oracles / closed forms (SURVEY.md §2.2)."""
+
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def test_cosine_embedding_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import CosineEmbeddingCriterion
+
+    x1 = rng.randn(5, 8).astype(np.float32)
+    x2 = rng.randn(5, 8).astype(np.float32)
+    y = np.array([1, -1, 1, -1, 1], np.float32)
+    crit = CosineEmbeddingCriterion(margin=0.3)
+    loss = crit.forward([x1, x2], y)
+    t = torch.nn.CosineEmbeddingLoss(margin=0.3)(
+        torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(y))
+    assert abs(loss - float(t)) < 1e-5
+
+
+def test_hinge_embedding_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import HingeEmbeddingCriterion
+
+    x = np.abs(rng.randn(6).astype(np.float32))
+    y = np.array([1, -1, 1, -1, -1, 1], np.float32)
+    crit = HingeEmbeddingCriterion(margin=1.0)
+    loss = crit.forward(x, y)
+    t = torch.nn.HingeEmbeddingLoss(margin=1.0)(
+        torch.from_numpy(x), torch.from_numpy(y))
+    assert abs(loss - float(t)) < 1e-5
+
+
+def test_margin_ranking_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import MarginRankingCriterion
+
+    x1 = rng.randn(7).astype(np.float32)
+    x2 = rng.randn(7).astype(np.float32)
+    y = np.sign(rng.randn(7)).astype(np.float32)
+    crit = MarginRankingCriterion(margin=0.2)
+    loss = crit.forward([x1, x2], y)
+    t = torch.nn.MarginRankingLoss(margin=0.2)(
+        torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(y))
+    assert abs(loss - float(t)) < 1e-5
+
+
+def test_multi_margin_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import MultiMarginCriterion
+
+    x = rng.randn(4, 6).astype(np.float32)
+    y = np.array([1, 3, 6, 2], np.float32)  # 1-based
+    for p in (1, 2):
+        crit = MultiMarginCriterion(p=p)
+        loss = crit.forward(x, y)
+        t = torch.nn.MultiMarginLoss(p=p)(
+            torch.from_numpy(x), torch.from_numpy(y).long() - 1)
+        assert abs(loss - float(t)) < 1e-5, f"p={p}"
+
+    # gradient parity
+    crit = MultiMarginCriterion()
+    gin = crit.backward(x, y)
+    xt = torch.from_numpy(x).requires_grad_(True)
+    torch.nn.MultiMarginLoss()(xt, torch.from_numpy(y).long() - 1).backward()
+    assert_close(np.asarray(gin), xt.grad.numpy(), atol=1e-5)
+
+
+def test_multilabel_margin_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import MultiLabelMarginCriterion
+
+    x = rng.randn(3, 5).astype(np.float32)
+    # 1-based targets, 0-terminated (torch uses 0-based with -1 terminator)
+    t_ours = np.array([[2, 4, 0, 0, 0],
+                       [1, 0, 0, 0, 0],
+                       [3, 5, 1, 0, 0]], np.float32)
+    t_torch = torch.from_numpy(
+        np.array([[1, 3, -1, -1, -1],
+                  [0, -1, -1, -1, -1],
+                  [2, 4, 0, -1, -1]], np.int64))
+    crit = MultiLabelMarginCriterion()
+    loss = crit.forward(x, t_ours)
+    t = torch.nn.MultiLabelMarginLoss()(torch.from_numpy(x), t_torch)
+    assert abs(loss - float(t)) < 1e-5
+
+
+def test_l1cost_and_softmaxwith(rng):
+    import torch
+
+    from bigdl_tpu.nn import L1Cost, SoftmaxWithCriterion
+
+    x = rng.randn(4, 5).astype(np.float32)
+    assert abs(L1Cost().forward(x, None) - np.abs(x).sum()) < 1e-4
+
+    y = np.array([1, 2, 3, 4], np.float32)
+    loss = SoftmaxWithCriterion().forward(x, y)
+    t = torch.nn.CrossEntropyLoss()(
+        torch.from_numpy(x), torch.from_numpy(y).long() - 1)
+    assert abs(loss - float(t)) < 1e-5
+
+
+def test_dice_closed_form(rng):
+    from bigdl_tpu.nn import DiceCoefficientCriterion
+
+    x = rng.rand(2, 10).astype(np.float32)
+    t = (rng.rand(2, 10) > 0.5).astype(np.float32)
+    eps = 1.0
+    want = np.mean([
+        1 - (2 * (x[i] * t[i]).sum() + eps) / (x[i].sum() + t[i].sum() + eps)
+        for i in range(2)
+    ])
+    got = DiceCoefficientCriterion(epsilon=eps).forward(x, t)
+    assert abs(got - want) < 1e-5
+
+
+def test_multi_criterion(rng):
+    from bigdl_tpu.nn import AbsCriterion, MSECriterion, MultiCriterion
+
+    x = rng.randn(3, 4).astype(np.float32)
+    t = rng.randn(3, 4).astype(np.float32)
+    mc = MultiCriterion().add(MSECriterion(), 0.5).add(AbsCriterion(), 2.0)
+    want = 0.5 * MSECriterion().forward(x, t) + 2.0 * AbsCriterion().forward(x, t)
+    assert abs(mc.forward(x, t) - want) < 1e-5
+
+
+def test_kld_gaussian_closed_form(rng):
+    from bigdl_tpu.nn import GaussianCriterion, KLDCriterion
+
+    mean = rng.randn(4, 3).astype(np.float32)
+    log_var = rng.randn(4, 3).astype(np.float32) * 0.3
+    t = rng.randn(4, 3).astype(np.float32)
+
+    kl = KLDCriterion().forward([mean, log_var], None)
+    want_kl = (-0.5 * (1 + log_var - mean ** 2 - np.exp(log_var)).sum()) / 4
+    assert abs(kl - want_kl) < 1e-4
+
+    nll = GaussianCriterion().forward([mean, log_var], t)
+    want = 0.5 * (np.log(2 * np.pi) + log_var
+                  + (t - mean) ** 2 / np.exp(log_var)).sum()
+    assert abs(nll - want) < 1e-3
+
+
+def test_cosine_distance_criterion(rng):
+    from bigdl_tpu.nn import CosineDistanceCriterion
+
+    x = rng.randn(5, 6).astype(np.float32)
+    t = rng.randn(5, 6).astype(np.float32)
+    cos = (x * t).sum(-1) / (np.linalg.norm(x, axis=-1)
+                             * np.linalg.norm(t, axis=-1))
+    want = (1 - cos).mean()
+    assert abs(CosineDistanceCriterion().forward(x, t) - want) < 1e-5
