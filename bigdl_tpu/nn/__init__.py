@@ -35,9 +35,24 @@ from bigdl_tpu.nn.criterion import (
     ParallelCriterion, TimeDistributedCriterion, MarginCriterion,
     DistKLDivCriterion,
 )
+from bigdl_tpu.nn.criterion_extra import (
+    CosineDistanceCriterion, CosineEmbeddingCriterion,
+    DiceCoefficientCriterion, GaussianCriterion, HingeEmbeddingCriterion,
+    KLDCriterion, L1Cost, MarginRankingCriterion, MultiCriterion,
+    MultiLabelMarginCriterion, MultiMarginCriterion, SoftmaxWithCriterion,
+)
 from bigdl_tpu.nn.init_methods import (
     InitializationMethod, Zeros, Ones, ConstInitMethod, RandomUniform,
     RandomNormal, Xavier, MsraFiller, BilinearFiller,
+)
+from bigdl_tpu.nn.layers_extra import (
+    Cosine, CosineDistance, DotProduct, Euclidean, GaussianSampler,
+    GradientReversal, Index, L1Penalty, LogSigmoid, Masking, Negative,
+    NarrowTable, PairwiseDistance, Replicate, RReLU, Scale, SelectTable,
+    SoftMin, SpatialDilatedConvolution, SpatialUpSamplingBilinear,
+    SpatialUpSamplingNearest, SpatialZeroPadding, TemporalConvolution,
+    Threshold, VolumetricAveragePooling, VolumetricConvolution,
+    VolumetricMaxPooling,
 )
 from bigdl_tpu.nn.sparse import SparseLinear, SparseJoinTable
 from bigdl_tpu.nn.quantized import (

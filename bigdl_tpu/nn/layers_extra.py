@@ -1,0 +1,614 @@
+"""Extended layer set (widening SURVEY.md §2.2's ~150-layer inventory).
+
+Reference (UNVERIFIED, SURVEY.md §0): one class per file under
+``.../bigdl/nn/`` — similarity layers (``Cosine``, ``Euclidean``,
+``DotProduct``, ``PairwiseDistance``, ``CosineDistance``), activations
+(``SoftMin``, ``LogSigmoid``, ``Threshold``, ``RReLU``), shape/table ops
+(``Replicate``, ``Index``, ``Masking``, ``SelectTable``, ``NarrowTable``,
+``SpatialZeroPadding``, ``Scale``, ``GradientReversal``, ``L1Penalty``,
+``GaussianSampler``), temporal/volumetric convolution and pooling, dilated
+convolution and up-sampling.
+
+All are pure ``apply`` functions over jax arrays; convolutions lower to
+``lax.conv_general_dilated`` (MXU path), pooling to ``lax.reduce_window``,
+and everything fuses under the train-step ``jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomUniform
+from bigdl_tpu.nn.module import AbstractModule, TensorModule
+from bigdl_tpu.nn.shape_ops import _axis
+
+
+# ---------------------------------------------------------------------------
+# similarity / distance layers
+# ---------------------------------------------------------------------------
+
+def l2_normalize(x, axis: int = -1, eps: float = 1e-12):
+    """x / max(||x||, eps) along ``axis`` — the shared clamped normalizer."""
+    import jax.numpy as jnp
+
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=axis, keepdims=True), eps)
+
+
+def cosine_similarity(x, y, axis: int = -1, eps: float = 1e-12):
+    """Row-wise clamped cosine similarity (shared by the similarity layers
+    and criterions; single definition so the epsilon policy can't drift)."""
+    import jax.numpy as jnp
+
+    num = jnp.sum(x * y, axis)
+    den = jnp.maximum(
+        jnp.linalg.norm(x, axis=axis) * jnp.linalg.norm(y, axis=axis), eps)
+    return num / den
+
+
+class Cosine(TensorModule):
+    """(N, in) → (N, out): cosine similarity of x to each weight row
+    (reference ``nn/Cosine.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 init_weight: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.weight_init = init_weight or RandomUniform()
+
+    def init_params(self, rng):
+        return {"weight": self.weight_init.init(
+            rng, (self.output_size, self.input_size))}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.matmul(l2_normalize(input),
+                          l2_normalize(params["weight"]).T), state
+
+
+class Euclidean(TensorModule):
+    """(N, in) → (N, out): L2 distance of x to each weight column
+    (reference ``nn/Euclidean.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 init_weight: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.weight_init = init_weight or RandomUniform()
+
+    def init_params(self, rng):
+        return {"weight": self.weight_init.init(
+            rng, (self.output_size, self.input_size))}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        diff = input[..., None, :] - params["weight"]       # (N, out, in)
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 1e-24)), state
+
+
+class DotProduct(AbstractModule):
+    """Table [x, y] → per-row dot product (reference ``nn/DotProduct.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, y = input
+        return jnp.sum(x * y, -1), state
+
+
+class PairwiseDistance(AbstractModule):
+    """Table [x, y] → per-row Lp distance (reference ``nn/PairwiseDistance.scala``)."""
+
+    def __init__(self, norm: int = 2) -> None:
+        super().__init__()
+        self.norm = norm
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, y = input
+        d = jnp.abs(x - y) ** self.norm
+        return jnp.sum(d, -1) ** (1.0 / self.norm), state
+
+
+class CosineDistance(AbstractModule):
+    """Table [x, y] → per-row cosine similarity (reference
+    ``nn/CosineDistance.scala``; note: similarity, not 1−cos)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x, y = input
+        return cosine_similarity(x, y), state
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+class SoftMin(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.softmax(-input, axis=-1), state
+
+
+class LogSigmoid(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.log_sigmoid(input), state
+
+
+class Threshold(TensorModule):
+    """x if x > th else v (reference ``nn/Threshold.scala``)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0) -> None:
+        super().__init__()
+        self.th = th
+        self.v = v
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.where(input > self.th, input, self.v), state
+
+
+class RReLU(TensorModule):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training, the
+    midpoint in evaluation (reference ``nn/RReLU.scala``)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3) -> None:
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        if training and rng is not None:
+            a = jax.random.uniform(rng, input.shape,
+                                   minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, a * input), state
+
+
+# ---------------------------------------------------------------------------
+# shape / table utilities
+# ---------------------------------------------------------------------------
+
+class Replicate(TensorModule):
+    """Insert a new dim of size ``n_features`` at 1-based ``dim``
+    (reference ``nn/Replicate.scala``)."""
+
+    def __init__(self, n_features: int, dim: int = 1) -> None:
+        super().__init__()
+        self.n_features = n_features
+        self.dim = dim
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = self.dim  # new axis goes AFTER the batch dim for 1-based dim
+        return jnp.repeat(jnp.expand_dims(input, ax), self.n_features, ax), state
+
+
+class Index(AbstractModule):
+    """Table [tensor, 1-based indices] → ``take`` along ``dimension``
+    (reference ``nn/Index.scala``)."""
+
+    def __init__(self, dimension: int = 1) -> None:
+        super().__init__()
+        self.dimension = dimension
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, idx = input
+        ax = _axis(self.dimension, x.ndim)
+        return jnp.take(x, jnp.asarray(idx).astype(jnp.int32) - 1, axis=ax), state
+
+
+class Masking(TensorModule):
+    """Zero out timesteps equal to ``mask_value`` (reference
+    ``nn/Masking.scala``): rows where EVERY feature == mask_value → 0."""
+
+    def __init__(self, mask_value: float = 0.0) -> None:
+        super().__init__()
+        self.mask_value = mask_value
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
+        return input * keep, state
+
+
+class SelectTable(AbstractModule):
+    """Pick element ``index`` (1-based; negative from the end) of a Table
+    (reference ``nn/SelectTable.scala``)."""
+
+    def __init__(self, index: int) -> None:
+        super().__init__()
+        self.index = index
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        i = self.index - 1 if self.index > 0 else len(input) + self.index
+        return input[i], state
+
+
+class NarrowTable(AbstractModule):
+    """Slice a Table: ``length`` elements from 1-based ``offset``
+    (reference ``nn/NarrowTable.scala``)."""
+
+    def __init__(self, offset: int, length: int = 1) -> None:
+        super().__init__()
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        out = list(input)[self.offset - 1: self.offset - 1 + self.length]
+        return out, state
+
+
+class SpatialZeroPadding(TensorModule):
+    """Zero-pad H/W of an NCHW (or CHW) input (reference
+    ``nn/SpatialZeroPadding.scala``); negative pads crop."""
+
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None) -> None:
+        super().__init__()
+        self.pl = pad_left
+        self.pr = pad_right if pad_right is not None else pad_left
+        self.pt = pad_top if pad_top is not None else pad_left
+        self.pb = pad_bottom if pad_bottom is not None else pad_left
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x = input
+        h_ax, w_ax = x.ndim - 2, x.ndim - 1
+        # crops first (negative pads)
+        def crop(a, ax, lo, hi):
+            n = a.shape[ax]
+            return jnp.take(a, jnp.arange(max(0, -lo), n - max(0, -hi)), ax)
+
+        x = crop(x, h_ax, self.pt, self.pb)
+        x = crop(x, w_ax, self.pl, self.pr)
+        pads = [(0, 0)] * x.ndim
+        pads[h_ax] = (max(0, self.pt), max(0, self.pb))
+        pads[w_ax] = (max(0, self.pl), max(0, self.pr))
+        return jnp.pad(x, pads), state
+
+
+class Scale(TensorModule):
+    """Learnable per-channel affine ``x*w + b`` (reference ``nn/Scale.scala``
+    = CMul + CAdd), broadcast over an NCHW/feature layout."""
+
+    def __init__(self, size: Sequence[int]) -> None:
+        super().__init__()
+        self.size = tuple(size)
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        return {"weight": jnp.ones(self.size, jnp.float32),
+                "bias": jnp.zeros(self.size, jnp.float32)}
+
+    def _broadcast(self, p, ndim):
+        shape = (1,) + self.size + (1,) * (ndim - 1 - len(self.size))
+        return p.reshape(shape)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        w = self._broadcast(params["weight"], input.ndim)
+        b = self._broadcast(params["bias"], input.ndim)
+        return input * w + b, state
+
+
+class GradientReversal(TensorModule):
+    """Identity forward, ``-λ`` backward (reference
+    ``nn/GradientReversal.scala``; domain-adversarial training)."""
+
+    def __init__(self, the_lambda: float = 1.0) -> None:
+        super().__init__()
+        self.the_lambda = the_lambda
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        rev.defvjp(lambda x: (x, None), lambda _, ct: (-lam * ct,))
+        return rev(input), state
+
+
+class L1Penalty(TensorModule):
+    """Identity forward that ADDS an L1 subgradient on the backward pass
+    (reference ``nn/L1Penalty.scala``)."""
+
+    def __init__(self, l1_weight: float, size_average: bool = False) -> None:
+        super().__init__()
+        self.l1_weight = l1_weight
+        self.size_average = size_average
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        w = self.l1_weight
+        avg = self.size_average
+
+        @jax.custom_vjp
+        def pen(x):
+            return x
+
+        def fwd(x):
+            return x, x
+
+        def bwd(x, ct):
+            scale = w / x.size if avg else w
+            return (ct + scale * jnp.sign(x),)
+
+        pen.defvjp(fwd, bwd)
+        return pen(input), state
+
+
+class GaussianSampler(AbstractModule):
+    """VAE reparameterization: input ``[mean, log_var]`` →
+    ``mean + exp(log_var/2)·ε`` (reference ``nn/GaussianSampler.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_var = input
+        if rng is None:
+            return mean, state
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps, state
+
+
+class Negative(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return -input, state
+
+
+# ---------------------------------------------------------------------------
+# temporal / volumetric / dilated convolution + pooling
+# ---------------------------------------------------------------------------
+
+class TemporalConvolution(TensorModule):
+    """1-D conv over (N, T, in) → (N, T', out) (reference
+    ``nn/TemporalConvolution.scala``; time-major frames)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        return {
+            "weight": self.weight_init.init(
+                k1, (self.output_frame_size, self.input_frame_size,
+                     self.kernel_w)),
+            "bias": self.bias_init.init(k2, (self.output_frame_size,)),
+        }
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input          # (N, T, Cin)
+        x = x.swapaxes(1, 2)                           # (N, Cin, T)
+        out = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.stride_w,),
+            padding="VALID", dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        out = out.swapaxes(1, 2) + params["bias"]
+        return (out[0] if squeeze else out), state
+
+
+class VolumetricConvolution(TensorModule):
+    """3-D conv over (N, C, D, H, W) (reference
+    ``nn/VolumetricConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.k = (k_t, k_h, k_w)
+        self.d = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": self.weight_init.init(
+            k1, (self.n_output_plane, self.n_input_plane) + self.k)}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.n_output_plane,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        out = lax.conv_general_dilated(
+            x, params["weight"], window_strides=self.d,
+            padding=[(p, p) for p in self.pad],
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None, None]
+        return (out[0] if squeeze else out), state
+
+
+class _VolumetricPooling(TensorModule):
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: int = None, d_w: int = None, d_h: int = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0) -> None:
+        super().__init__()
+        self.k = (k_t, k_h, k_w)
+        self.d = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+
+class VolumetricMaxPooling(_VolumetricPooling):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1) + self.k,
+            window_strides=(1, 1) + self.d,
+            padding=((0, 0), (0, 0)) + tuple((p, p) for p in self.pad),
+        )
+        return (out[0] if squeeze else out), state
+
+
+class VolumetricAveragePooling(_VolumetricPooling):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import numpy as np
+
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        sums = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, 1) + self.k,
+            window_strides=(1, 1) + self.d,
+            padding=((0, 0), (0, 0)) + tuple((p, p) for p in self.pad),
+        )
+        out = sums / float(np.prod(self.k))
+        return (out[0] if squeeze else out), state
+
+
+class SpatialDilatedConvolution(TensorModule):
+    """2-D conv with dilation (reference
+    ``nn/SpatialDilatedConvolution.scala``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.with_bias = with_bias
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": self.weight_init.init(
+            k1, (self.n_output_plane, self.n_input_plane, self.kh, self.kw))}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.n_output_plane,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        out = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.dh, self.dw),
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        return (out[0] if squeeze else out), state
+
+
+class SpatialUpSamplingNearest(TensorModule):
+    """Nearest-neighbour ×scale upsampling of NCHW (reference
+    ``nn/SpatialUpSamplingNearest.scala``)."""
+
+    def __init__(self, scale: int) -> None:
+        super().__init__()
+        self.scale = scale
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        s = self.scale
+        x = input
+        x = jnp.repeat(x, s, axis=x.ndim - 2)
+        x = jnp.repeat(x, s, axis=x.ndim - 1)
+        return x, state
+
+
+class SpatialUpSamplingBilinear(TensorModule):
+    """Bilinear ×scale upsampling (align_corners=True, the reference's
+    semantics) of NCHW (reference ``nn/SpatialUpSamplingBilinear.scala``)."""
+
+    def __init__(self, scale: int) -> None:
+        super().__init__()
+        self.scale = scale
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        n, c, h, w = x.shape
+        oh, ow = h * self.scale, w * self.scale
+
+        def grid(o, i):
+            if o == 1 or i == 1:
+                return jnp.zeros((o,))
+            return jnp.arange(o) * (i - 1) / (o - 1)   # align_corners
+
+        ys, xs = grid(oh, h), grid(ow, w)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+        out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+               + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+        return (out[0] if squeeze else out), state

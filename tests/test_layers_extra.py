@@ -1,0 +1,241 @@
+"""Extended layer set vs torch oracles / closed forms (SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+
+def test_cosine_layer(rng):
+    from bigdl_tpu.nn import Cosine
+
+    m = Cosine(6, 4)
+    m._ensure_params()
+    x = rng.randn(3, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    w = np.asarray(m.params["weight"])
+    want = (x / np.linalg.norm(x, axis=1, keepdims=True)) @ (
+        w / np.linalg.norm(w, axis=1, keepdims=True)).T
+    assert_close(out, want, atol=1e-5)
+
+
+def test_euclidean_layer(rng):
+    from bigdl_tpu.nn import Euclidean
+
+    m = Euclidean(5, 3)
+    m._ensure_params()
+    x = rng.randn(4, 5).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    w = np.asarray(m.params["weight"])
+    want = np.linalg.norm(x[:, None, :] - w[None], axis=-1)
+    assert_close(out, want, atol=1e-5)
+
+
+def test_dot_pairwise_cosinedistance_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import CosineDistance, DotProduct, PairwiseDistance
+
+    x = rng.randn(4, 7).astype(np.float32)
+    y = rng.randn(4, 7).astype(np.float32)
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+
+    assert_close(np.asarray(DotProduct().forward([x, y])),
+                 (x * y).sum(-1), atol=1e-5)
+    assert_close(np.asarray(PairwiseDistance(2).forward([x, y])),
+                 torch.nn.PairwiseDistance(p=2, eps=0)(tx, ty).numpy(),
+                 atol=1e-4)
+    assert_close(np.asarray(CosineDistance().forward([x, y])),
+                 torch.nn.CosineSimilarity(dim=1)(tx, ty).numpy(), atol=1e-4)
+
+
+def test_softmin_logsigmoid_threshold_rrelu_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import LogSigmoid, RReLU, SoftMin, Threshold
+
+    x = rng.randn(3, 6).astype(np.float32)
+    tx = torch.from_numpy(x)
+    assert_close(np.asarray(SoftMin().forward(x)),
+                 torch.nn.Softmin(dim=1)(tx).numpy(), atol=1e-5)
+    assert_close(np.asarray(LogSigmoid().forward(x)),
+                 torch.nn.LogSigmoid()(tx).numpy(), atol=1e-5)
+    assert_close(np.asarray(Threshold(0.2, -1.0).forward(x)),
+                 torch.nn.Threshold(0.2, -1.0)(tx).numpy(), atol=1e-6)
+    r = RReLU().evaluate()
+    assert_close(np.asarray(r.forward(x)),
+                 torch.nn.RReLU(1 / 8, 1 / 3)(tx.requires_grad_(False)).numpy()
+                 if False else np.where(x >= 0, x, (1 / 8 + 1 / 3) / 2 * x),
+                 atol=1e-6)
+
+
+def test_replicate_index_masking(rng):
+    from bigdl_tpu.nn import Index, Masking, Replicate
+
+    x = rng.randn(2, 3).astype(np.float32)
+    out = np.asarray(Replicate(4, 1).forward(x))
+    assert out.shape == (2, 4, 3)
+    assert_close(out[:, 0], x)
+
+    idx = np.array([2, 1], np.float32)
+    got = np.asarray(Index(1).forward([x, idx]))
+    assert_close(got, x[[1, 0]])
+
+    xm = x.copy()
+    xm[1] = 0.0
+    seq = np.stack([xm, xm])  # (2, 2, 3) second row all-zero
+    masked = np.asarray(Masking(0.0).forward(seq))
+    assert np.all(masked[:, 1] == 0)
+    assert_close(masked[:, 0], seq[:, 0])
+
+
+def test_table_utilities(rng):
+    from bigdl_tpu.nn import NarrowTable, SelectTable
+
+    a, b, c = (rng.randn(2, 2).astype(np.float32) for _ in range(3))
+    assert_close(np.asarray(SelectTable(2).forward([a, b, c])), b)
+    assert_close(np.asarray(SelectTable(-1).forward([a, b, c])), c)
+    out = NarrowTable(2, 2).forward([a, b, c])
+    assert len(out) == 2
+    assert_close(np.asarray(out[0]), b)
+
+
+def test_spatial_zero_padding_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialZeroPadding
+
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    got = np.asarray(SpatialZeroPadding(1, 2, 1, 0).forward(x))
+    want = torch.nn.ZeroPad2d((1, 2, 1, 0))(torch.from_numpy(x)).numpy()
+    assert_close(got, want)
+    # negative = crop
+    got = np.asarray(SpatialZeroPadding(-1, -1, -1, -1).forward(x))
+    assert_close(got, x[:, :, 1:-1, 1:-1])
+
+
+def test_scale_layer(rng):
+    from bigdl_tpu.nn import Scale
+
+    m = Scale((3,))
+    m._ensure_params()
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    m.params = {"weight": np.full((3,), 2.0, np.float32),
+                "bias": np.full((3,), 1.0, np.float32)}
+    out = np.asarray(m.forward(x))
+    assert_close(out, x * 2.0 + 1.0)
+
+
+def test_gradient_reversal_and_l1penalty(rng):
+    from bigdl_tpu.nn import GradientReversal, L1Penalty
+
+    x = rng.randn(3, 4).astype(np.float32)
+    g = rng.randn(3, 4).astype(np.float32)
+
+    m = GradientReversal(0.5)
+    m._ensure_params()
+    assert_close(np.asarray(m.forward(x)), x)
+    gin = np.asarray(m.backward(x, g))
+    assert_close(gin, -0.5 * g, atol=1e-6)
+
+    p = L1Penalty(0.1)
+    p._ensure_params()
+    assert_close(np.asarray(p.forward(x)), x)
+    gin = np.asarray(p.backward(x, g))
+    assert_close(gin, g + 0.1 * np.sign(x), atol=1e-6)
+
+
+def test_gaussian_sampler(rng):
+    from bigdl_tpu.nn import GaussianSampler
+
+    mean = rng.randn(2000, 2).astype(np.float32)
+    log_var = np.full((2000, 2), np.log(0.25), np.float32)
+    m = GaussianSampler()
+    m._ensure_params()
+    m.training()
+    out = np.asarray(m.forward([mean, log_var]))
+    resid = out - mean
+    assert abs(resid.std() - 0.5) < 0.05  # std = exp(log_var/2) = 0.5
+
+
+def test_temporal_convolution_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import TemporalConvolution
+
+    m = TemporalConvolution(5, 8, 3, 2)
+    m._ensure_params()
+    x = rng.randn(2, 9, 5).astype(np.float32)
+    out = np.asarray(m.forward(x))
+
+    conv = torch.nn.Conv1d(5, 8, 3, stride=2)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(np.asarray(m.params["weight"])))
+        conv.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    want = conv(torch.from_numpy(x).permute(0, 2, 1)).permute(0, 2, 1)
+    assert_close(out, want.detach().numpy(), atol=1e-4)
+
+
+def test_volumetric_conv_pool_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import (
+        VolumetricAveragePooling, VolumetricConvolution, VolumetricMaxPooling,
+    )
+
+    m = VolumetricConvolution(2, 4, 3, 3, 3, 1, 1, 1, 1, 1, 1)
+    m._ensure_params()
+    x = rng.randn(2, 2, 6, 6, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    conv = torch.nn.Conv3d(2, 4, 3, stride=1, padding=1)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(np.asarray(m.params["weight"])))
+        conv.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    assert_close(out, conv(torch.from_numpy(x)).detach().numpy(), atol=1e-4)
+
+    mp = np.asarray(VolumetricMaxPooling(2, 2, 2).forward(x))
+    assert_close(mp, torch.nn.MaxPool3d(2)(torch.from_numpy(x)).numpy(),
+                 atol=1e-6)
+    ap = np.asarray(VolumetricAveragePooling(2, 2, 2).forward(x))
+    assert_close(ap, torch.nn.AvgPool3d(2)(torch.from_numpy(x)).numpy(),
+                 atol=1e-6)
+
+
+def test_dilated_conv_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialDilatedConvolution
+
+    m = SpatialDilatedConvolution(3, 5, 3, 3, 1, 1, 2, 2, 2, 2)
+    m._ensure_params()
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    conv = torch.nn.Conv2d(3, 5, 3, stride=1, padding=2, dilation=2)
+    with torch.no_grad():
+        conv.weight.copy_(torch.from_numpy(np.asarray(m.params["weight"])))
+        conv.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    assert_close(out, conv(torch.from_numpy(x)).detach().numpy(), atol=1e-4)
+
+
+def test_upsampling_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SpatialUpSamplingBilinear, SpatialUpSamplingNearest
+
+    x = rng.randn(2, 3, 4, 4).astype(np.float32)
+    got = np.asarray(SpatialUpSamplingNearest(2).forward(x))
+    want = torch.nn.Upsample(scale_factor=2, mode="nearest")(
+        torch.from_numpy(x)).numpy()
+    assert_close(got, want, atol=1e-6)
+
+    got = np.asarray(SpatialUpSamplingBilinear(2).forward(x))
+    want = torch.nn.Upsample(scale_factor=2, mode="bilinear",
+                             align_corners=True)(torch.from_numpy(x)).numpy()
+    assert_close(got, want, atol=1e-4)
+
+
+def test_negative(rng):
+    from bigdl_tpu.nn import Negative
+
+    x = rng.randn(2, 3).astype(np.float32)
+    assert_close(np.asarray(Negative().forward(x)), -x)

@@ -111,6 +111,42 @@ FACTORIES = {
     "Power": (lambda: nn.Power(2.0), np.abs(x(2, 3)) + 0.1),
     "ReLU": (lambda: nn.ReLU(), x(2, 3)),
     "ReLU6": (lambda: nn.ReLU6(), x(2, 3)),
+    "Cosine": (lambda: nn.Cosine(4, 3), x(2, 4)),
+    "CosineDistance": (lambda: nn.CosineDistance(), [x(2, 4), x(2, 4)]),
+    "DotProduct": (lambda: nn.DotProduct(), [x(2, 4), x(2, 4)]),
+    "Euclidean": (lambda: nn.Euclidean(4, 3), x(2, 4)),
+    "GaussianSampler": (lambda: nn.GaussianSampler(), [x(2, 3), x(2, 3)]),
+    "GradientReversal": (lambda: nn.GradientReversal(0.5), x(2, 3)),
+    "Index": (lambda: nn.Index(1), [x(3, 4), np.array([2.0, 1.0])]),
+    "L1Penalty": (lambda: nn.L1Penalty(0.1), x(2, 3)),
+    "LogSigmoid": (lambda: nn.LogSigmoid(), x(2, 3)),
+    "Masking": (lambda: nn.Masking(0.0), x(2, 4, 3)),
+    "NarrowTable": (lambda: nn.NarrowTable(1, 2), None),
+    "Negative": (lambda: nn.Negative(), x(2, 3)),
+    "PairwiseDistance": (lambda: nn.PairwiseDistance(), [x(2, 4), x(2, 4)]),
+    "RReLU": (lambda: nn.RReLU(), x(2, 3)),
+    "Replicate": (lambda: nn.Replicate(3, 1), x(2, 4)),
+    "Scale": (lambda: nn.Scale((3,)), x(2, 3, 4, 4)),
+    "SelectTable": (lambda: nn.SelectTable(1), [x(2, 3), x(2, 3)]),
+    "SoftMin": (lambda: nn.SoftMin(), x(2, 3)),
+    "SpatialDilatedConvolution": (
+        lambda: nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 2, 2, 2, 2),
+        x(2, 3, 8, 8)),
+    "SpatialUpSamplingBilinear": (lambda: nn.SpatialUpSamplingBilinear(2),
+                                  x(2, 3, 4, 4)),
+    "SpatialUpSamplingNearest": (lambda: nn.SpatialUpSamplingNearest(2),
+                                 x(2, 3, 4, 4)),
+    "SpatialZeroPadding": (lambda: nn.SpatialZeroPadding(1), x(2, 3, 4, 4)),
+    "TemporalConvolution": (lambda: nn.TemporalConvolution(4, 5, 3),
+                            x(2, 7, 4)),
+    "Threshold": (lambda: nn.Threshold(0.1, 0.0), x(2, 3)),
+    "VolumetricAveragePooling": (lambda: nn.VolumetricAveragePooling(2, 2, 2),
+                                 x(1, 2, 4, 4, 4)),
+    "VolumetricConvolution": (
+        lambda: nn.VolumetricConvolution(2, 3, 3, 3, 3, 1, 1, 1, 1, 1, 1),
+        x(1, 2, 5, 5, 5)),
+    "VolumetricMaxPooling": (lambda: nn.VolumetricMaxPooling(2, 2, 2),
+                             x(1, 2, 4, 4, 4)),
     "QuantizedLinear": (_quantized_linear, x(2, 4)),
     "QuantizedSpatialConvolution": (_quantized_conv, x(2, 3, 5, 5)),
     "SparseLinear": (lambda: nn.SparseLinear(4, 3), _sparse_input()),
