@@ -1,0 +1,139 @@
+"""DLEstimator / DLClassifier — ML-pipeline integration.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/dlframes/DLEstimator.scala``
+— ``DLEstimator``/``DLModel``/``DLClassifier``/``DLClassifierModel`` wrapping
+the Optimizer in Spark ML's ``Estimator``/``Transformer`` pipeline contract
+(``fit(df) -> model``, ``model.transform(df)``).
+
+TPU-native redesign: the pipeline substrate here is the scikit-learn-style
+array contract (the Python ecosystem's equivalent of Spark ML): estimators
+take ``(X, y)`` arrays, ``fit`` returns a fitted model, models expose
+``transform``/``predict``. The reference's fluent knobs (batch size, epochs,
+learning rate, optim method, feature/label sizes) are kept name-for-name.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class DLEstimator:
+    """Trains ``model`` against ``criterion`` on (X, y) arrays and returns a
+    :class:`DLModel`."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int]) -> None:
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.learning_rate = 1e-3
+        self.optim_method = None
+        self._model_cls = DLModel
+
+    # fluent config (reference setter names, snake_case) -------------------
+
+    def set_batch_size(self, n: int) -> "DLEstimator":
+        self.batch_size = n
+        return self
+
+    def set_max_epoch(self, n: int) -> "DLEstimator":
+        self.max_epoch = n
+        return self
+
+    def set_learning_rate(self, lr: float) -> "DLEstimator":
+        self.learning_rate = lr
+        return self
+
+    def set_optim_method(self, method) -> "DLEstimator":
+        self.optim_method = method
+        return self
+
+    def _label_array(self, y):
+        return np.asarray(y)
+
+    def fit(self, X, y) -> "DLModel":
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.optim.optim_method import SGD
+        from bigdl_tpu.optim.optimizer import Optimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        X = np.asarray(X, np.float32)
+        y = self._label_array(y)
+        samples = [
+            Sample(x.reshape(self.feature_size),
+                   np.asarray(t).reshape(self.label_size)
+                   if self.label_size else t)
+            for x, t in zip(X, y)
+        ]
+        opt = Optimizer(model=self.model, dataset=DataSet.array(samples),
+                        criterion=self.criterion, batch_size=self.batch_size)
+        opt.set_optim_method(
+            self.optim_method or SGD(learning_rate=self.learning_rate))
+        opt.set_end_when(Trigger.max_epoch(self.max_epoch))
+        trained = opt.optimize()
+        return self._model_cls(trained, self.feature_size, self.batch_size)
+
+
+class DLModel:
+    """Fitted transformer: ``transform(X)`` = batched forward."""
+
+    def __init__(self, model, feature_size: Sequence[int],
+                 batch_size: int = 32) -> None:
+        self.model = model
+        self.feature_size = tuple(feature_size)
+        self.batch_size = batch_size
+
+    def set_feature_size(self, size: Sequence[int]) -> "DLModel":
+        self.feature_size = tuple(size)
+        return self
+
+    def set_batch_size(self, n: int) -> "DLModel":
+        self.batch_size = n
+        return self
+
+    def transform(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        X = X.reshape((X.shape[0],) + self.feature_size)
+        outs = []
+        self.model.evaluate()
+        for i in range(0, X.shape[0], self.batch_size):
+            outs.append(np.asarray(self.model.forward(X[i:i + self.batch_size])))
+        return np.concatenate(outs, 0)
+
+    predict = transform
+
+
+class DLClassifier(DLEstimator):
+    """Classification estimator: scalar 1-based labels, argmax transform
+    (reference ``DLClassifier``)."""
+
+    def __init__(self, model, criterion, feature_size: Sequence[int]) -> None:
+        super().__init__(model, criterion, feature_size, label_size=())
+        self._model_cls = DLClassifierModel
+
+    def _label_array(self, y):
+        y = np.asarray(y)
+        assert y.min() >= 1, "DLClassifier labels are 1-based (reference)"
+        return y.astype(np.float32)
+
+
+class DLClassifierModel(DLModel):
+    """Fitted classifier: ``transform`` returns 1-based class predictions."""
+
+    def transform(self, X) -> np.ndarray:
+        scores = DLModel.transform(self, X)
+        return scores.argmax(-1) + 1
+
+    predict = transform
+
+    def predict_proba(self, X) -> np.ndarray:
+        scores = DLModel.transform(self, X)
+        # scores may be log-probs (LogSoftMax heads) or raw logits
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)

@@ -134,18 +134,19 @@ class LBFGS(OptimMethod):
         for it in range(self.max_iter):
             if float(jnp.max(jnp.abs(g))) <= self.tol_fun:
                 break  # gradient small enough
-            # two-loop recursion
+            # two-loop recursion — alpha/beta stay traced device scalars so
+            # XLA pipelines the whole recursion (no per-entry host syncs)
             q = g
             alphas = []
             for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
                                  reversed(rho_hist)):
-                a = rho * float(jnp.vdot(s, q))
+                a = rho * jnp.vdot(s, q)
                 alphas.append(a)
                 q = q - a * y
             d = gamma * q
             for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
                                       reversed(alphas)):
-                b = rho * float(jnp.vdot(y, d))
+                b = rho * jnp.vdot(y, d)
                 d = d + (a - b) * s
             d = -d
             gtd = float(jnp.vdot(g, d))
@@ -158,13 +159,17 @@ class LBFGS(OptimMethod):
                   else min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-12))
                   * self.learning_rate)
             if self.line_search == "strong_wolfe":
-                # cache (f, grad) per step size so the accepted point's full
-                # gradient is reused instead of re-launching feval
+                # cache (f, grad) at the LAST and BEST-f step sizes only —
+                # the accepted point is always one of those two, and bounding
+                # the cache keeps at most 2 extra gradient vectors on device
                 ls_cache = {}
 
                 def fe_dir(t):
                     ft, gt = fe(xk + t * d)
-                    ls_cache[t] = (ft, gt)
+                    best = ls_cache.get("best")
+                    if best is None or ft < best[1][0]:
+                        ls_cache["best"] = (t, (ft, gt))
+                    ls_cache["last"] = (t, (ft, gt))
                     return ft, float(jnp.vdot(gt, d))
 
                 t, _f_ls, ls_evals = strong_wolfe(fe_dir, t0, f, gtd)
@@ -174,8 +179,14 @@ class LBFGS(OptimMethod):
 
             x_new = xk + t * d
             f_old = f
-            if t in ls_cache:
-                f, g_new = ls_cache[t]
+            hit = None
+            for k in ("last", "best"):
+                entry = ls_cache.get(k)
+                if entry is not None and entry[0] == t:
+                    hit = entry[1]
+                    break
+            if hit is not None:
+                f, g_new = hit
             else:
                 f, g_new = fe(x_new)
                 n_evals += 1
@@ -192,7 +203,7 @@ class LBFGS(OptimMethod):
                 s_hist.append(s)
                 y_hist.append(y)
                 rho_hist.append(1.0 / ys)
-                gamma = ys / float(jnp.vdot(y, y))
+                gamma = jnp.asarray(ys) / jnp.vdot(y, y)  # device scalar
             xk, g = x_new, g_new
 
             if n_evals >= self.max_eval:

@@ -1,0 +1,51 @@
+"""DLEstimator/DLClassifier pipeline plane (SURVEY.md §2.7 dlframes row)."""
+
+import numpy as np
+
+
+def _blobs(rng, n=90, d=6, c=3):
+    xs, ys = [], []
+    for i in range(n):
+        k = i % c
+        xs.append((rng.randn(d) * 0.3 + np.eye(c)[k].repeat(d // c) * 2
+                   ).astype(np.float32))
+        ys.append(k + 1)
+    return np.stack(xs), np.asarray(ys)
+
+
+def test_dlclassifier_fit_predict(rng):
+    from bigdl_tpu.dlframes import DLClassifier, DLClassifierModel
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+
+    X, y = _blobs(rng)
+    net = (Sequential().add(Linear(6, 16)).add(ReLU())
+           .add(Linear(16, 3)).add(LogSoftMax()))
+    est = (DLClassifier(net, ClassNLLCriterion(), [6])
+           .set_batch_size(30).set_max_epoch(20).set_learning_rate(0.5))
+    model = est.fit(X, y)
+    assert isinstance(model, DLClassifierModel)
+    pred = model.predict(X)
+    assert pred.min() >= 1 and pred.max() <= 3
+    assert (pred == y).mean() > 0.9
+
+    proba = model.predict_proba(X)
+    assert proba.shape == (len(X), 3)
+    np.testing.assert_allclose(proba.sum(-1), 1.0, atol=1e-4)
+
+
+def test_dlestimator_regression(rng):
+    from bigdl_tpu.dlframes import DLEstimator
+    from bigdl_tpu.nn import Linear, MSECriterion, Sequential
+    from bigdl_tpu.optim import Adam
+
+    W = rng.randn(4, 2).astype(np.float32)
+    X = rng.randn(200, 4).astype(np.float32)
+    Y = X @ W
+    est = (DLEstimator(Sequential().add(Linear(4, 2)), MSECriterion(),
+                       [4], [2])
+           .set_batch_size(50).set_max_epoch(60)
+           .set_optim_method(Adam(learning_rate=0.05)))
+    model = est.fit(X, Y)
+    pred = model.transform(X)
+    mse = ((pred - Y) ** 2).mean()
+    assert mse < 0.05, f"regression failed to fit: mse={mse}"
