@@ -98,13 +98,13 @@ class DLModel:
         return self
 
     def transform(self, X) -> np.ndarray:
+        from bigdl_tpu.optim.evaluator import Predictor
+
         X = np.asarray(X, np.float32)
         X = X.reshape((X.shape[0],) + self.feature_size)
-        outs = []
-        self.model.evaluate()
-        for i in range(0, X.shape[0], self.batch_size):
-            outs.append(np.asarray(self.model.forward(X[i:i + self.batch_size])))
-        return np.concatenate(outs, 0)
+        # Predictor compiles one jitted eval step and batches (the same path
+        # model.predict uses) — no second inference loop to maintain here
+        return np.asarray(Predictor(self.model).predict(X, self.batch_size))
 
     predict = transform
 
@@ -119,7 +119,11 @@ class DLClassifier(DLEstimator):
 
     def _label_array(self, y):
         y = np.asarray(y)
-        assert y.min() >= 1, "DLClassifier labels are 1-based (reference)"
+        if y.min() < 1:
+            raise ValueError(
+                "DLClassifier labels are 1-based (reference convention); "
+                f"got minimum label {y.min()}"
+            )
         return y.astype(np.float32)
 
 

@@ -39,7 +39,8 @@ import numpy as np
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.train_step import (
-    apply_module_regularizers, clip_by_global_norm, clip_by_value, make_eval_step,
+    apply_module_regularizers, cast_floats, clip_by_global_norm, clip_by_value,
+    make_eval_step, resolve_dtype, restore_dtypes,
 )
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 
@@ -110,6 +111,7 @@ class DistriOptimizer(Optimizer):
 
     def _build_partitioned_step(self, mesh, params):
         import jax
+        import jax.numpy as jnp
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
         shard_map = jax.shard_map
@@ -117,6 +119,7 @@ class DistriOptimizer(Optimizer):
         n = mesh.devices.size
         arp = AllReduceParameter(params, n, "data", compress=self.compress)
         self._arp = arp
+        compute_dtype = resolve_dtype(self.compute_dtype)
         model, criterion, optim = self.model, self.criterion, self.optim_method
         from bigdl_tpu.optim.train_step import regularizer_loss
 
@@ -132,10 +135,20 @@ class DistriOptimizer(Optimizer):
             # compressed reduce-scatter (putGradients +
             # aggregateGradientPartition) — see AllReduceParameter.
             def loss_fn(shard):
-                p = arp.get_weights(shard)
-                out, new_ms = model.apply(p, inputs, model_state,
+                p_full = arp.get_weights(shard)   # fp32 master weights
+                p, x = p_full, inputs
+                if compute_dtype is not None:
+                    p = cast_floats(p_full, compute_dtype)
+                    x = cast_floats(x, compute_dtype)
+                out, new_ms = model.apply(p, x, model_state,
                                           training=True, rng=rng)
-                loss = criterion.apply(out, targets) + regularizer_loss(model, p)
+                if compute_dtype is not None:
+                    out = cast_floats(out, jnp.float32)
+                    new_ms = restore_dtypes(new_ms, model_state)
+                # regularizers act on the fp32 master weights (same policy as
+                # the local/allreduce paths' apply_module_regularizers)
+                loss = criterion.apply(out, targets) + regularizer_loss(
+                    model, p_full)
                 return loss, new_ms
 
             (loss, new_ms), gshard = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -173,11 +186,13 @@ class DistriOptimizer(Optimizer):
 
     def _build_allreduce_step(self, mesh, params):
         import jax
+        import jax.numpy as jnp
         from jax import lax
         shard_map = jax.shard_map
         from jax.sharding import PartitionSpec as P
 
         model, criterion, optim = self.model, self.criterion, self.optim_method
+        compute_dtype = resolve_dtype(self.compute_dtype)
 
         def spmd(params, opt_state, model_state, rng, inputs, targets):
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
@@ -193,8 +208,15 @@ class DistriOptimizer(Optimizer):
             params_v = jax.tree_util.tree_map(mark_varying, params)
 
             def loss_fn(p):
-                out, new_ms = model.apply(p, inputs, model_state,
+                x = inputs
+                if compute_dtype is not None:
+                    p = cast_floats(p, compute_dtype)
+                    x = cast_floats(x, compute_dtype)
+                out, new_ms = model.apply(p, x, model_state,
                                           training=True, rng=rng)
+                if compute_dtype is not None:
+                    out = cast_floats(out, jnp.float32)
+                    new_ms = restore_dtypes(new_ms, model_state)
                 return criterion.apply(out, targets), new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(

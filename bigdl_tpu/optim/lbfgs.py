@@ -43,50 +43,71 @@ def strong_wolfe(feval_dir: Callable, t: float, f0: float, g0: float,
                  c1: float = 1e-4, c2: float = 0.9, max_ls: int = 25):
     """Strong-Wolfe line search along a direction.
 
-    ``feval_dir(t) -> (f, g)`` with g the DIRECTIONAL derivative at step t.
-    Returns ``(t, f_t, n_evals)``. Reference ``LineSearch.scala — lswolfe``.
+    ``feval_dir(t) -> (f, g)`` with g the DIRECTIONAL derivative at step t —
+    or ``(f, g, payload)``, in which case the accepted point's payload is
+    returned too (LBFGS passes the full gradient vector through here, so the
+    search holds at most the bracket's three gradients alive and the caller
+    never re-evaluates the accepted point). Returns ``(t, f_t, n_evals)``
+    without payloads, ``(t, f_t, n_evals, payload)`` with.
+    Reference ``LineSearch.scala — lswolfe``.
     """
-    f_prev, g_prev, t_prev = f0, g0, 0.0
+    def fe(tt):
+        out = feval_dir(tt)
+        return out if len(out) == 3 else (out[0], out[1], None)
+
+    has_payload = None
+    prev = (0.0, f0, g0, None)          # (t, f, g_dir, payload)
     n_evals = 0
-    f_t, g_t = feval_dir(t)
+    ft, gt, pt = fe(t)
+    has_payload = pt is not None
+    cur = (t, ft, gt, pt)
     n_evals += 1
+
+    def ret(point):
+        if has_payload:
+            return point[0], point[1], n_evals, point[3]
+        return point[0], point[1], n_evals
+
     bracket = None
     for _ in range(max_ls):
-        if f_t > f0 + c1 * t * g0 or (n_evals > 1 and f_t >= f_prev):
-            bracket = (t_prev, f_prev, g_prev, t, f_t, g_t)
+        t, f_t, g_t, p_t = cur
+        if f_t > f0 + c1 * t * g0 or (n_evals > 1 and f_t >= prev[1]):
+            bracket = (prev, cur)
             break
         if abs(g_t) <= -c2 * g0:
-            return t, f_t, n_evals
+            return ret(cur)
         if g_t >= 0:
-            bracket = (t, f_t, g_t, t_prev, f_prev, g_prev)
+            bracket = (cur, prev)
             break
-        t_prev, f_prev, g_prev = t, f_t, g_t
+        prev = cur
         t = min(10 * t, 1e8)
-        f_t, g_t = feval_dir(t)
+        ft, gt, pt = fe(t)
+        cur = (t, ft, gt, pt)
         n_evals += 1
     if bracket is None:  # ran out of extrapolations
-        return t, f_t, n_evals
-    # zoom phase
-    lo_t, lo_f, lo_g, hi_t, hi_f, hi_g = bracket
+        return ret(cur)
+    # zoom phase: lo/hi are full points, so the accepted return always
+    # carries its own (f, payload)
+    lo, hi = bracket
     for _ in range(max_ls):
-        t = _cubic_interpolate(lo_t, lo_f, lo_g, hi_t, hi_f, hi_g)
-        # guard against stagnation at the bracket edge
-        span = abs(hi_t - lo_t)
+        t = _cubic_interpolate(lo[0], lo[1], lo[2], hi[0], hi[1], hi[2])
+        span = abs(hi[0] - lo[0])
         if span < 1e-9:
             break
-        if min(abs(t - lo_t), abs(t - hi_t)) < 0.1 * span:
-            t = (lo_t + hi_t) / 2.0
-        f_t, g_t = feval_dir(t)
+        if min(abs(t - lo[0]), abs(t - hi[0])) < 0.1 * span:
+            t = (lo[0] + hi[0]) / 2.0
+        ft, gt, pt = fe(t)
+        cur = (t, ft, gt, pt)
         n_evals += 1
-        if f_t > f0 + c1 * t * g0 or f_t >= lo_f:
-            hi_t, hi_f, hi_g = t, f_t, g_t
+        if ft > f0 + c1 * t * g0 or ft >= lo[1]:
+            hi = cur
         else:
-            if abs(g_t) <= -c2 * g0:
-                return t, f_t, n_evals
-            if g_t * (hi_t - lo_t) >= 0:
-                hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
-            lo_t, lo_f, lo_g = t, f_t, g_t
-    return lo_t, lo_f, n_evals
+            if abs(gt) <= -c2 * g0:
+                return ret(cur)
+            if gt * (hi[0] - lo[0]) >= 0:
+                hi = lo
+            lo = cur
+    return ret(lo)
 
 
 class LBFGS(OptimMethod):
@@ -158,36 +179,27 @@ class LBFGS(OptimMethod):
             t0 = (self.learning_rate if it > 0 or s_hist
                   else min(1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-12))
                   * self.learning_rate)
+            accepted = None
             if self.line_search == "strong_wolfe":
-                # cache (f, grad) at the LAST and BEST-f step sizes only —
-                # the accepted point is always one of those two, and bounding
-                # the cache keeps at most 2 extra gradient vectors on device
-                ls_cache = {}
-
+                # the full gradient rides through the search as a payload, so
+                # at most the bracket's three gradient vectors stay alive and
+                # the accepted point's gradient comes back with it
                 def fe_dir(t):
                     ft, gt = fe(xk + t * d)
-                    best = ls_cache.get("best")
-                    if best is None or ft < best[1][0]:
-                        ls_cache["best"] = (t, (ft, gt))
-                    ls_cache["last"] = (t, (ft, gt))
-                    return ft, float(jnp.vdot(gt, d))
+                    return ft, float(jnp.vdot(gt, d)), gt
 
-                t, _f_ls, ls_evals = strong_wolfe(fe_dir, t0, f, gtd)
+                t, f_ls, ls_evals, g_ls = strong_wolfe(fe_dir, t0, f, gtd)
                 n_evals += ls_evals
+                if g_ls is not None:
+                    accepted = (f_ls, g_ls)
             else:
-                t, ls_cache = t0, {}
+                t = t0
 
             x_new = xk + t * d
             f_old = f
-            hit = None
-            for k in ("last", "best"):
-                entry = ls_cache.get(k)
-                if entry is not None and entry[0] == t:
-                    hit = entry[1]
-                    break
-            if hit is not None:
-                f, g_new = hit
-            else:
+            if accepted is not None:
+                f, g_new = accepted
+            else:  # no search, or the search degenerated back to t=0
                 f, g_new = fe(x_new)
                 n_evals += 1
             losses.append(f)

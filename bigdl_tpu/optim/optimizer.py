@@ -90,6 +90,7 @@ class Optimizer:
         self.train_summary = None
         self.validation_summary = None
         self.grad_clip: Dict[str, Any] = {}
+        self.compute_dtype = None
         self.metrics = Metrics()
         self.retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", "5"))
         self.retry_interval_s = float(
@@ -129,6 +130,13 @@ class Optimizer:
 
     def set_val_summary(self, summary) -> "Optimizer":
         self.validation_summary = summary
+        return self
+
+    def set_compute_dtype(self, dtype) -> "Optimizer":
+        """Mixed precision: run forward/backward in ``"bf16"``/``"fp16"``
+        while master weights, optimizer state and loss stay fp32 (TPU-native
+        performance knob; no reference counterpart — MKL was fp32-only)."""
+        self.compute_dtype = dtype
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
@@ -369,11 +377,14 @@ class LocalOptimizer(Optimizer):
     def _prepare(self):
         import jax
 
+        from bigdl_tpu.optim.train_step import resolve_dtype
+
         params, model_state = self.model.params, self.model.state
         opt_state = self.optim_method.init_state(params)
         step = jax.jit(
             make_train_step(self.model, self.criterion, self.optim_method,
-                            self.grad_clip)
+                            self.grad_clip,
+                            compute_dtype=resolve_dtype(self.compute_dtype))
         )
 
         def place_batch(batch: MiniBatch):

@@ -77,3 +77,30 @@ def test_raw_sample_list_api():
     opt.set_optim_method(Adam(1e-3)).set_end_when(Trigger.max_iteration(3))
     trained = opt.optimize()
     assert trained is model
+
+
+def test_mixed_precision_trains(rng):
+    """set_compute_dtype('bf16'): loss decreases, params stay fp32."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    xs = [(rng.randn(6) * 0.3 + np.eye(3)[i % 3].repeat(2) * 2).astype(np.float32)
+          for i in range(60)]
+    ys = [np.int32(i % 3 + 1) for i in range(60)]
+    m = (Sequential().add(Linear(6, 16)).add(ReLU())
+         .add(Linear(16, 3)).add(LogSoftMax()))
+    opt = Optimizer(model=m, dataset=DataSet.array(
+        [Sample(x, y) for x, y in zip(xs, ys)]),
+        criterion=ClassNLLCriterion(), batch_size=20)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(Trigger.max_epoch(15))
+    opt.set_compute_dtype("bf16")
+    trained = opt.optimize()
+    ws, _ = trained.parameters()
+    assert all(np.asarray(w).dtype == np.float32 for w in ws)
+    pred = np.asarray(trained.evaluate().forward(np.stack(xs))).argmax(-1) + 1
+    assert (pred == np.asarray(ys)).mean() > 0.8
