@@ -1,0 +1,284 @@
+"""Fine-grained TF-style ops (the ``nn/ops`` layer of the reference).
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/ops/*.scala`` (~100
+small op classes: ``Conv2D``, ``BiasAdd``, pooling, arithmetic, shape ops) —
+they exist to EXECUTE imported TensorFlow graphs, and ``utils/tf/
+TensorflowLoader.scala`` maps GraphDef nodes onto them.
+
+TPU-native: each op is a thin ``AbstractModule`` over the matching
+``jax.lax``/``jnp`` primitive in TF's native NHWC layout (no transposes at
+import time; XLA picks layouts). Weight-carrying ops hold their imported
+constants as ordinary params, so imported graphs remain trainable exactly
+like reference-imported models.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.nn.module import AbstractModule, TensorModule
+
+
+class ParameterOp(TensorModule):
+    """An imported constant promoted to a trainable parameter (the loader
+    uses this for Variables/Consts feeding weight slots)."""
+
+    def __init__(self, value) -> None:
+        super().__init__()
+        self._value = np.asarray(value)
+
+    def init_params(self, rng):
+        return {"value": self._value}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return params["value"], state
+
+
+class ConstOp(TensorModule):
+    """A non-trainable imported constant (shapes, axes, paddings)."""
+
+    def __init__(self, value) -> None:
+        super().__init__()
+        self.value = np.asarray(value)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.value), state
+
+
+class Conv2D(AbstractModule):
+    """TF Conv2D: input NHWC, filter HWIO. Table input [x, filter]."""
+
+    def __init__(self, strides: Sequence[int], padding: str = "SAME") -> None:
+        super().__init__()
+        self.strides = tuple(strides)  # full NHWC strides or (sh, sw)
+        self.padding = padding
+
+    def _hw_strides(self) -> Tuple[int, int]:
+        s = self.strides
+        return (s[1], s[2]) if len(s) == 4 else (s[0], s[1])
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        x, w = input
+        out = lax.conv_general_dilated(
+            x, w, window_strides=self._hw_strides(), padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return out, state
+
+
+class DepthwiseConv2dNative(AbstractModule):
+    """TF depthwise conv: filter HWIM (multiplier M)."""
+
+    def __init__(self, strides: Sequence[int], padding: str = "SAME") -> None:
+        super().__init__()
+        self.strides = tuple(strides)
+        self.padding = padding
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        x, w = input
+        h, wk, c, m = w.shape
+        s = self.strides
+        hw = (s[1], s[2]) if len(s) == 4 else (s[0], s[1])
+        out = lax.conv_general_dilated(
+            x, w.reshape(h, wk, 1, c * m), window_strides=hw,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+        )
+        return out, state
+
+
+class BiasAdd(AbstractModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x, b = input
+        return x + b, state
+
+
+class MatMul(AbstractModule):
+    def __init__(self, transpose_a: bool = False, transpose_b: bool = False) -> None:
+        super().__init__()
+        self.transpose_a = transpose_a
+        self.transpose_b = transpose_b
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        a, b = input
+        if self.transpose_a:
+            a = a.T
+        if self.transpose_b:
+            b = b.T
+        return jnp.matmul(a, b), state
+
+
+class _Pool2D(TensorModule):
+    def __init__(self, ksize: Sequence[int], strides: Sequence[int],
+                 padding: str = "VALID") -> None:
+        super().__init__()
+        k, s = tuple(ksize), tuple(strides)
+        self.k = (k[1], k[2]) if len(k) == 4 else (k[0], k[1])
+        self.s = (s[1], s[2]) if len(s) == 4 else (s[0], s[1])
+        self.padding = padding
+
+    def _window(self, x):
+        return (1, self.k[0], self.k[1], 1), (1, self.s[0], self.s[1], 1)
+
+
+class MaxPool(_Pool2D):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        dims, strides = self._window(input)
+        return lax.reduce_window(
+            input, -jnp.inf, lax.max, dims, strides, self.padding), state
+
+
+class AvgPool(_Pool2D):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        dims, strides = self._window(input)
+        sums = lax.reduce_window(input, 0.0, lax.add, dims, strides, self.padding)
+        ones = jnp.ones_like(input)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, self.padding)
+        return sums / counts, state
+
+
+class FusedBatchNorm(AbstractModule):
+    """Inference-mode TF FusedBatchNorm: [x, scale, offset, mean, var]."""
+
+    def __init__(self, epsilon: float = 1e-3) -> None:
+        super().__init__()
+        self.epsilon = epsilon
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, scale, offset, mean, var = input
+        inv = scale / jnp.sqrt(var + self.epsilon)
+        return x * inv + (offset - mean * inv), state
+
+
+class Reshape(AbstractModule):
+    """TF Reshape: [x, shape] (shape may contain -1; a leading -1 keeps the
+    batch dynamic)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x, shape = input
+        target = [int(v) for v in np.asarray(shape).reshape(-1)]
+        return x.reshape(target), state
+
+
+class Squeeze(TensorModule):
+    def __init__(self, axis: Optional[Sequence[int]] = None) -> None:
+        super().__init__()
+        self.axis = tuple(axis) if axis else None
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.squeeze(input, self.axis), state
+
+
+class ExpandDims(AbstractModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, axis = input
+        return jnp.expand_dims(x, int(np.asarray(axis))), state
+
+
+class ConcatV2(AbstractModule):
+    """TF ConcatV2: [x1, ..., xn, axis]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        *xs, axis = input
+        return jnp.concatenate(xs, int(np.asarray(axis))), state
+
+
+class Pad(AbstractModule):
+    """TF Pad: [x, paddings (ndim, 2)]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, pads = input
+        pads = [(int(a), int(b)) for a, b in np.asarray(pads)]
+        return jnp.pad(x, pads), state
+
+
+class Mean(AbstractModule):
+    """TF Mean: [x, axes]."""
+
+    def __init__(self, keep_dims: bool = False) -> None:
+        super().__init__()
+        self.keep_dims = keep_dims
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, axes = input
+        axes = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+        return jnp.mean(x, axis=axes, keepdims=self.keep_dims), state
+
+
+class _Binary(AbstractModule):
+    def op(self, a, b):
+        raise NotImplementedError
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        a, b = input
+        return self.op(a, b), state
+
+
+class Add(_Binary):
+    def op(self, a, b):
+        return a + b
+
+
+class Sub(_Binary):
+    def op(self, a, b):
+        return a - b
+
+
+class Mul(_Binary):
+    def op(self, a, b):
+        return a * b
+
+
+class RealDiv(_Binary):
+    def op(self, a, b):
+        return a / b
+
+
+class Maximum(_Binary):
+    def op(self, a, b):
+        import jax.numpy as jnp
+
+        return jnp.maximum(a, b)
+
+
+class Rsqrt(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        return lax.rsqrt(input), state
+
+
+class Softmax(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.softmax(input, axis=-1), state

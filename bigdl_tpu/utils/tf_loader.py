@@ -1,0 +1,249 @@
+"""TensorflowLoader — import a frozen TensorFlow GraphDef as a Graph.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/utils/tf/
+TensorflowLoader.scala`` + ``.../utils/tf/loaders/*`` — parses a frozen
+GraphDef, maps each node onto ``nn/ops`` modules, and wires a BigDL
+``Graph``. Same architecture here: ``load_tf(path, inputs, outputs)`` walks
+the GraphDef, lowers each node to a ``bigdl_tpu.nn.ops`` module (NHWC, no
+layout shuffling — XLA assigns layouts), promotes Variables/Consts feeding
+weight slots to trainable params, and returns a ``Graph`` whose forward
+matches TF's execution of the same graph.
+
+The protobuf parsing itself uses the installed ``tensorflow`` package (the
+reference equally linked TF's protos); no TF runtime executes the model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.nn import ops as O
+from bigdl_tpu.nn.graph import Graph, Input, ModuleNode
+
+
+def _attr(node, name, default=None):
+    if name not in node.attr:
+        return default
+    return node.attr[name]
+
+
+def _const_value(node) -> np.ndarray:
+    from tensorflow.python.framework import tensor_util
+
+    return np.asarray(tensor_util.MakeNdarray(node.attr["value"].tensor))
+
+
+def _strides(node) -> List[int]:
+    return list(node.attr["strides"].list.i)
+
+
+def _padding(node) -> str:
+    return node.attr["padding"].s.decode()
+
+
+def _ksize(node) -> List[int]:
+    return list(node.attr["ksize"].list.i)
+
+
+# ops whose ONLY job is passthrough
+_IDENTITY_OPS = {"Identity", "StopGradient", "CheckNumerics", "PlaceholderWithDefault"}
+
+# weight-slot positions per op: input indices that, when fed by a Const,
+# should become trainable ParameterOps rather than frozen ConstOps
+_TRAINABLE_SLOTS = {
+    "Conv2D": {1},
+    "DepthwiseConv2dNative": {1},
+    "MatMul": {1},
+    "BiasAdd": {1},
+    "FusedBatchNorm": {1, 2},
+    "FusedBatchNormV3": {1, 2},
+}
+
+
+def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
+            generated_backward: bool = True) -> Graph:
+    """Build a :class:`Graph` from a frozen GraphDef.
+
+    ``inputs``/``outputs``: TF node names (``"x"`` or ``"scope/x:0"`` — the
+    port suffix is ignored; multi-output ops are not supported here, matching
+    the reference loader's main path).
+    """
+    gd = _load_graph_def(graph_def_or_path)
+    nodes: Dict[str, object] = {n.name: n for n in gd.node}
+    strip = lambda name: name.split(":")[0].lstrip("^")
+    input_names = [strip(n) for n in inputs]
+    output_names = [strip(n) for n in outputs]
+
+    built: Dict[str, ModuleNode] = {}
+    graph_inputs: List[ModuleNode] = []
+
+    def const_feed(name: str, consumer_op: str, slot: int) -> ModuleNode:
+        node = nodes[name]
+        value = _const_value(node)
+        trainable = slot in _TRAINABLE_SLOTS.get(consumer_op, set())
+        # a rank-1 const added/subtracted is a bias in disguise (TF lowers
+        # `matmul(x, w) + b` to AddV2, not BiasAdd) — keep it trainable
+        if consumer_op in ("Add", "AddV2", "Sub") and value.ndim == 1:
+            trainable = True
+        mod = O.ParameterOp(value) if trainable else O.ConstOp(value)
+        mod.set_name(name)
+        # constants have no graph predecessors: hang them off a shared
+        # zero-input — our Graph requires every node reachable from inputs,
+        # so constants attach to the first real input node as a dummy dep
+        return mod
+
+    def build(name: str) -> ModuleNode:
+        name = strip(name)
+        if name in built:
+            return built[name]
+        node = nodes[name]
+        op = node.op
+
+        if name in input_names:
+            mn = Input()
+            graph_inputs.append(mn)
+            built[name] = mn
+            return mn
+
+        if op in ("Placeholder",):
+            raise ValueError(
+                f"Placeholder {name!r} is not listed in inputs={input_names}")
+
+        if op in _IDENTITY_OPS:
+            mn = build(node.input[0])
+            built[name] = mn
+            return mn
+
+        if op == "Const":
+            raise ValueError(
+                f"Const {name!r} used outside a recognized operand slot")
+
+        preds: List[ModuleNode] = []
+        const_mods: List[tuple] = []
+        for i, inp in enumerate(node.input):
+            if inp.startswith("^"):
+                continue  # control edge
+            iname = strip(inp)
+            src = nodes[iname]
+            # resolve through identity chains for const-ness detection
+            seen = set()
+            while src.op in _IDENTITY_OPS and src.input:
+                if src.name in seen:
+                    break
+                seen.add(src.name)
+                src = nodes[strip(src.input[0])]
+            if src.op == "Const" and iname not in input_names:
+                const_mods.append((i, const_feed(src.name, op, i)))
+                preds.append(None)  # placeholder, filled below
+            else:
+                preds.append(build(iname))
+
+        mod = _lower(node)
+        mod.set_name(name)
+
+        # wire constants: each const module becomes a node fed by the first
+        # real predecessor (dummy dep to keep the DAG rooted at inputs)
+        anchor = next((p for p in preds if p is not None), None)
+        for i, cmod in const_mods:
+            if anchor is None:
+                # op with only-const operands: anchor on the graph input
+                anchor = graph_inputs[0] if graph_inputs else build(input_names[0])
+            preds[i] = cmod.inputs(anchor)
+
+        mn = mod.inputs(*preds)
+        built[name] = mn
+        return mn
+
+    # roots first so const anchoring has an input available
+    for n in input_names:
+        build(n)
+    out_nodes = [build(n) for n in output_names]
+    g = Graph(graph_inputs if len(graph_inputs) > 1 else graph_inputs[0],
+              out_nodes if len(out_nodes) > 1 else out_nodes[0])
+    return g
+
+
+def _load_graph_def(graph_def_or_path):
+    if isinstance(graph_def_or_path, (str, bytes)) and not isinstance(
+            graph_def_or_path, bytes):
+        from tensorflow.core.framework import graph_pb2
+
+        gd = graph_pb2.GraphDef()
+        with open(graph_def_or_path, "rb") as f:
+            gd.ParseFromString(f.read())
+        return gd
+    return graph_def_or_path  # already a GraphDef
+
+
+def _lower(node):
+    """GraphDef node → nn.ops module (the loaders/* table)."""
+    op = node.op
+    if op == "Conv2D":
+        return O.Conv2D(_strides(node), _padding(node))
+    if op == "DepthwiseConv2dNative":
+        return O.DepthwiseConv2dNative(_strides(node), _padding(node))
+    if op == "BiasAdd":
+        return O.BiasAdd()
+    if op == "MatMul":
+        return O.MatMul(node.attr["transpose_a"].b, node.attr["transpose_b"].b)
+    if op == "MaxPool":
+        return O.MaxPool(_ksize(node), _strides(node), _padding(node))
+    if op == "AvgPool":
+        return O.AvgPool(_ksize(node), _strides(node), _padding(node))
+    if op in ("FusedBatchNorm", "FusedBatchNormV3"):
+        eps = node.attr["epsilon"].f or 1e-3
+        return O.FusedBatchNorm(eps)
+    if op == "Reshape":
+        return O.Reshape()
+    if op == "Squeeze":
+        dims = list(node.attr["squeeze_dims"].list.i)
+        return O.Squeeze(dims or None)
+    if op == "ExpandDims":
+        return O.ExpandDims()
+    if op == "ConcatV2":
+        return O.ConcatV2()
+    if op == "Pad":
+        return O.Pad()
+    if op == "Mean":
+        return O.Mean(node.attr["keep_dims"].b)
+    if op in ("Add", "AddV2"):
+        return O.Add()
+    if op == "Sub":
+        return O.Sub()
+    if op == "Mul":
+        return O.Mul()
+    if op == "RealDiv":
+        return O.RealDiv()
+    if op == "Maximum":
+        return O.Maximum()
+    if op == "Rsqrt":
+        return O.Rsqrt()
+    if op == "Softmax":
+        return O.Softmax()
+    if op == "Relu":
+        from bigdl_tpu.nn.activations import ReLU
+
+        return ReLU()
+    if op == "Relu6":
+        from bigdl_tpu.nn.activations import ReLU6
+
+        return ReLU6()
+    if op == "Tanh":
+        from bigdl_tpu.nn.activations import Tanh
+
+        return Tanh()
+    if op == "Sigmoid":
+        from bigdl_tpu.nn.activations import Sigmoid
+
+        return Sigmoid()
+    raise NotImplementedError(
+        f"TF op {op!r} (node {node.name!r}) has no bigdl_tpu lowering yet")
+
+
+class TensorflowLoader:
+    """Reference-shaped facade: ``TensorflowLoader.load(path, inputs,
+    outputs)`` (reference ``Module.loadTF``)."""
+
+    load = staticmethod(load_tf)

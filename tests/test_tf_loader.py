@@ -1,0 +1,114 @@
+"""TensorflowLoader: frozen-GraphDef import vs live TF execution
+(SURVEY.md §2.7 TF import; §4 differential-testing pattern)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _freeze(fn, example):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    conc = tf.function(fn).get_concrete_function(
+        tf.TensorSpec(example.shape, tf.float32))
+    frozen = convert_variables_to_constants_v2(conc)
+    return frozen.graph.as_graph_def(), frozen
+
+
+def test_import_mlp(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    w1 = tf.Variable(rng.randn(10, 16).astype(np.float32))
+    b1 = tf.Variable(rng.randn(16).astype(np.float32))
+    w2 = tf.Variable(rng.randn(16, 4).astype(np.float32))
+    b2 = tf.Variable(rng.randn(4).astype(np.float32))
+
+    def mlp(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.matmul(h, w2) + b2)
+
+    x = rng.randn(5, 10).astype(np.float32)
+    gd, frozen = _freeze(mlp, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    out_name = gd.node[-1].name
+    g = load_tf(gd, [in_name], [out_name])
+    got = np.asarray(g.forward(x))
+    assert_close(got, want, atol=1e-4)
+
+
+def test_import_convnet(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    k = tf.Variable(rng.randn(3, 3, 3, 8).astype(np.float32) * 0.2)
+    b = tf.Variable(rng.randn(8).astype(np.float32) * 0.1)
+    w = tf.Variable(rng.randn(8, 5).astype(np.float32) * 0.2)
+
+    def net(x):
+        h = tf.nn.conv2d(x, k, strides=[1, 2, 2, 1], padding="SAME")
+        h = tf.nn.relu(tf.nn.bias_add(h, b))
+        h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+        h = tf.reduce_mean(h, axis=[1, 2])
+        return tf.matmul(h, w)
+
+    x = rng.randn(2, 12, 12, 3).astype(np.float32)
+    gd, frozen = _freeze(net, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    got = np.asarray(g.forward(x))
+    assert_close(got, want, atol=1e-4)
+
+
+def test_imported_graph_is_trainable(rng):
+    """Imported weights are params: gradients flow and SGD moves them."""
+    import jax
+
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    w1 = tf.Variable(rng.randn(6, 8).astype(np.float32))
+    b1 = tf.Variable(rng.randn(8).astype(np.float32))
+
+    def net(x):
+        return tf.nn.tanh(tf.matmul(x, w1) + b1)
+
+    x = rng.randn(4, 6).astype(np.float32)
+    gd, _ = _freeze(net, tf.constant(x))
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    g._ensure_params()
+
+    def loss(p):
+        out, _ = g.apply(p, x, g.state)
+        return (out ** 2).sum()
+
+    grads = jax.grad(loss)(g.params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    # ParameterOp leaves (w1, b1) must receive nonzero gradients
+    nonzero = [l for l in leaves if np.abs(np.asarray(l)).sum() > 0]
+    assert len(nonzero) >= 2
+
+
+def test_import_depthwise_and_pad(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    k = tf.Variable(rng.randn(3, 3, 4, 1).astype(np.float32) * 0.3)
+
+    def net(x):
+        h = tf.pad(x, [[0, 0], [1, 1], [1, 1], [0, 0]])
+        return tf.nn.depthwise_conv2d(h, k, strides=[1, 1, 1, 1],
+                                      padding="VALID")
+
+    x = rng.randn(2, 6, 6, 4).astype(np.float32)
+    gd, frozen = _freeze(net, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)

@@ -1,0 +1,51 @@
+"""TensorflowSaver: jax2tf export round-trip (SURVEY.md §2.7 TF export)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+tf = pytest.importorskip("tensorflow")
+
+
+def test_savedmodel_roundtrip(rng, tmp_path):
+    from bigdl_tpu.nn import Linear, ReLU, Sequential, SoftMax
+    from bigdl_tpu.utils.tf_saver import save_tf
+
+    m = Sequential().add(Linear(8, 16)).add(ReLU()).add(Linear(16, 3)).add(SoftMax())
+    m._ensure_params()
+    x = rng.randn(4, 8).astype(np.float32)
+    want = np.asarray(m.evaluate().forward(x))
+
+    path = str(tmp_path / "sm")
+    save_tf(m, [8], path)
+    loaded = tf.saved_model.load(path)
+    got = loaded.f(tf.constant(x)).numpy()
+    assert_close(got, want, atol=1e-5)
+
+
+def test_frozen_graph_roundtrip_via_loader(rng, tmp_path):
+    """Export to frozen GraphDef, re-import with our own TensorflowLoader —
+    full export→import cycle through the TF interchange format."""
+    from bigdl_tpu.nn import Linear, Sequential, Tanh
+    from bigdl_tpu.utils.tf_saver import save_tf
+
+    m = Sequential().add(Linear(5, 7)).add(Tanh())
+    m._ensure_params()
+    x = rng.randn(3, 5).astype(np.float32)
+    want = np.asarray(m.evaluate().forward(x))
+
+    path = str(tmp_path / "frozen.pb")
+    conc = save_tf(m, [5], path, frozen_graph=True, batch=3)
+
+    gd = tf.compat.v1.GraphDef()
+    with open(path, "rb") as f:
+        gd.ParseFromString(f.read())
+    # run it with TF as the oracle
+    out_name = conc.outputs[0].name.split(":")[0]
+    in_name = conc.inputs[0].name.split(":")[0]
+    tf.compat.v1.reset_default_graph()
+    with tf.compat.v1.Session() as sess:
+        tf.import_graph_def(gd, name="")
+        got = sess.run(out_name + ":0", {in_name + ":0": x})
+    assert_close(got, want, atol=1e-5)
