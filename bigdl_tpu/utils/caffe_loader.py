@@ -1,0 +1,422 @@
+"""CaffeLoader — import Caffe prototxt + caffemodel as a Graph.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/utils/caffe/
+CaffeLoader.scala`` + ``Converter.scala`` — parses a deploy ``prototxt``
+(topology) and binary ``caffemodel`` (weights), converting each layer via a
+per-type converter table into a BigDL ``Graph``.
+
+TPU-native implementation notes: Caffe's NCHW / ``(out, in/g, kH, kW)``
+conventions match this framework's core layers exactly, so blobs load with
+no transposition. No ``caffe_pb2`` dependency exists in this image, so two
+tiny self-contained parsers are included: a protobuf **text-format** parser
+for prototxt and a protobuf **wire-format** decoder for the caffemodel's
+``NetParameter`` subset (new-style ``layer`` only; field numbers from the
+public caffe.proto).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format decoder (subset: varint, 64-bit, length-delimited,
+# 32-bit). Returns {field_number: [raw values]}; submessages stay bytes.
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result, shift = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def decode_message(buf: bytes) -> Dict[int, List[Any]]:
+    fields: Dict[int, List[Any]] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:  # varint
+            val, pos = _read_varint(buf, pos)
+        elif wtype == 1:  # 64-bit
+            val = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+        elif wtype == 2:  # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wtype == 5:  # 32-bit
+            val = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        fields.setdefault(fnum, []).append(val)
+    return fields
+
+
+def _floats(field_vals: List[Any]) -> np.ndarray:
+    """Packed or unpacked repeated float."""
+    out: List[float] = []
+    for v in field_vals:
+        if isinstance(v, bytes):  # packed
+            out.extend(struct.unpack(f"<{len(v) // 4}f", v))
+        else:
+            out.append(float(v))
+    return np.asarray(out, np.float32)
+
+
+def _varints(field_vals: List[Any]) -> List[int]:
+    out: List[int] = []
+    for v in field_vals:
+        if isinstance(v, bytes):  # packed
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(x)
+        else:
+            out.append(int(v))
+    return out
+
+
+def _blob_to_array(blob_bytes: bytes) -> np.ndarray:
+    """BlobProto: shape=7 (BlobShape.dim=1), data=5, legacy num/c/h/w=1..4."""
+    f = decode_message(blob_bytes)
+    data = _floats(f.get(5, []))
+    if 7 in f:
+        dims = _varints(decode_message(f[7][0]).get(1, []))
+    else:
+        dims = [int(f.get(i, [1])[0]) for i in (1, 2, 3, 4)]
+        while len(dims) > 1 and dims[0] == 1:
+            dims = dims[1:]
+    return data.reshape(dims) if dims else data
+
+
+def parse_caffemodel(path_or_bytes) -> Dict[str, List[np.ndarray]]:
+    """caffemodel → {layer name: [blob arrays]} (new-style ``layer``=100)."""
+    buf = path_or_bytes
+    if isinstance(buf, str):
+        with open(buf, "rb") as fh:
+            buf = fh.read()
+    net = decode_message(buf)
+    out: Dict[str, List[np.ndarray]] = {}
+    for layer_bytes in net.get(100, []):
+        f = decode_message(layer_bytes)
+        name = f.get(1, [b""])[0].decode()
+        blobs = [_blob_to_array(b) for b in f.get(7, [])]
+        if blobs:
+            out[name] = blobs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# prototxt (protobuf text format) parser → nested dict-of-lists
+# ---------------------------------------------------------------------------
+
+
+def _tokenize(text: str) -> List[str]:
+    tokens: List[str] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c in " \t\r\n":
+            i += 1
+        elif c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c in "{}:":
+            tokens.append(c)
+            i += 1
+        elif c in "\"'":
+            j = i + 1
+            while j < n and text[j] != c:
+                j += 1
+            tokens.append(text[i:j + 1])
+            i = j + 1
+        else:
+            j = i
+            while j < n and text[j] not in " \t\r\n{}:#\"'":
+                j += 1
+            tokens.append(text[i:j])
+            i = j
+    return tokens
+
+
+def _parse_value(tok: str):
+    if tok and tok[0] in "\"'":
+        return tok[1:-1]
+    if tok in ("true", "True"):
+        return True
+    if tok in ("false", "False"):
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok  # enum name
+
+
+def parse_prototxt(text: str) -> Dict[str, List[Any]]:
+    """Text-format message → dict {field: [values]}; nested msgs are dicts."""
+    tokens = _tokenize(text)
+    pos = 0
+
+    def parse_block() -> Dict[str, List[Any]]:
+        nonlocal pos
+        msg: Dict[str, List[Any]] = {}
+        while pos < len(tokens) and tokens[pos] != "}":
+            key = tokens[pos]
+            pos += 1
+            if tokens[pos] == ":":
+                pos += 1
+                msg.setdefault(key, []).append(_parse_value(tokens[pos]))
+                pos += 1
+            elif tokens[pos] == "{":
+                pos += 1
+                sub = parse_block()
+                assert tokens[pos] == "}"
+                pos += 1
+                msg.setdefault(key, []).append(sub)
+            else:
+                raise ValueError(f"parse error near {tokens[pos - 1:pos + 2]}")
+        return msg
+
+    return parse_block()
+
+
+# ---------------------------------------------------------------------------
+# layer converters
+# ---------------------------------------------------------------------------
+
+
+def _one(msg: Dict, key: str, default=None):
+    v = msg.get(key)
+    return v[0] if v else default
+
+
+def _conv_geometry(p: Dict) -> Tuple[int, int, int, int, int, int]:
+    k = _one(p, "kernel_size")
+    kh = _one(p, "kernel_h", k)
+    kw = _one(p, "kernel_w", k)
+    s = _one(p, "stride", 1)
+    sh = _one(p, "stride_h", s)
+    sw = _one(p, "stride_w", s)
+    pd = _one(p, "pad", 0)
+    ph = _one(p, "pad_h", pd)
+    pw = _one(p, "pad_w", pd)
+    return kw, kh, sw, sh, pw, ph
+
+
+def load_caffe(prototxt, caffemodel=None, match_all: bool = True):
+    """Build a :class:`Graph` from a deploy prototxt (+ optional weights).
+
+    ``prototxt``: path or text. ``caffemodel``: path or bytes. Returns the
+    Graph (reference ``Module.loadCaffeModel(defPath, modelPath)``).
+    """
+    from bigdl_tpu.nn import (
+        CAddTable, CMulTable, Dropout, JoinTable, Linear, LogSoftMax, ReLU,
+        Scale, Sigmoid, SoftMax, SpatialAveragePooling, SpatialBatchNormalization,
+        SpatialConvolution, SpatialCrossMapLRN, SpatialMaxPooling, Tanh,
+    )
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    if isinstance(prototxt, str) and "\n" not in prototxt and prototxt.endswith(
+            (".prototxt", ".txt")):
+        with open(prototxt) as fh:
+            prototxt = fh.read()
+    net = parse_prototxt(prototxt)
+    blobs = parse_caffemodel(caffemodel) if caffemodel is not None else {}
+
+    value_nodes: Dict[str, Any] = {}
+    graph_inputs: List[Any] = []
+
+    # top-level "input:" declarations (deploy nets)
+    for name in net.get("input", []):
+        node = Input()
+        graph_inputs.append(node)
+        value_nodes[name] = node
+
+    pending_weights: Dict[str, Tuple[Any, List[np.ndarray]]] = {}
+    last_node = None
+
+    for layer in net.get("layer", []):
+        lname = _one(layer, "name", "")
+        ltype = _one(layer, "type", "")
+        bottoms = layer.get("bottom", [])
+        tops = layer.get("top", [])
+        lblobs = blobs.get(lname, [])
+
+        if ltype == "Input":
+            node = Input()
+            graph_inputs.append(node)
+            for t in tops:
+                value_nodes[t] = node
+            last_node = node
+            continue
+
+        mod, n_out = _convert_layer(
+            ltype, layer, lblobs,
+            dict(CAddTable=CAddTable, CMulTable=CMulTable, Dropout=Dropout,
+                 JoinTable=JoinTable, Linear=Linear, LogSoftMax=LogSoftMax,
+                 ReLU=ReLU, Scale=Scale, Sigmoid=Sigmoid, SoftMax=SoftMax,
+                 SpatialAveragePooling=SpatialAveragePooling,
+                 SpatialBatchNormalization=SpatialBatchNormalization,
+                 SpatialConvolution=SpatialConvolution,
+                 SpatialCrossMapLRN=SpatialCrossMapLRN,
+                 SpatialMaxPooling=SpatialMaxPooling, Tanh=Tanh),
+        )
+        if mod is None:
+            continue  # consumed structurally (e.g. train-only layers)
+        mod.set_name(lname)
+        preds = [value_nodes[b] for b in bottoms]
+        node = mod.inputs(*preds)
+        for t in tops:
+            value_nodes[t] = node
+        last_node = node
+        if lblobs:
+            pending_weights[lname] = (mod, lblobs)
+
+    outputs = [last_node]
+    g = Graph(graph_inputs if len(graph_inputs) > 1 else graph_inputs[0],
+              outputs[0])
+    g._ensure_params()
+    _install_weights(g, pending_weights, match_all)
+    return g
+
+
+def _convert_layer(ltype: str, layer: Dict, lblobs, L) -> Tuple[Any, int]:
+    p_conv = _one(layer, "convolution_param", {})
+    if ltype == "Convolution":
+        kw, kh, sw, sh, pw, ph = _conv_geometry(p_conv)
+        n_out = _one(p_conv, "num_output")
+        group = _one(p_conv, "group", 1)
+        bias = bool(_one(p_conv, "bias_term", True))
+        n_in = lblobs[0].shape[1] * group if lblobs else _one(
+            p_conv, "_n_input", None)
+        if n_in is None:
+            raise ValueError(
+                f"Convolution {_one(layer, 'name')}: input channels unknown "
+                "(no caffemodel blobs; pass the caffemodel)")
+        return L["SpatialConvolution"](
+            int(n_in), int(n_out), kw, kh, sw, sh, pw, ph, n_group=group,
+            with_bias=bias), n_out
+    if ltype == "InnerProduct":
+        p = _one(layer, "inner_product_param", {})
+        n_out = _one(p, "num_output")
+        bias = bool(_one(p, "bias_term", True))
+        if not lblobs:
+            raise ValueError("InnerProduct needs caffemodel blobs for sizing")
+        n_in = lblobs[0].shape[-1]
+        return L["Linear"](int(n_in), int(n_out), with_bias=bias), n_out
+    if ltype == "Pooling":
+        p = _one(layer, "pooling_param", {})
+        pool = _one(p, "pool", "MAX")
+        k = _one(p, "kernel_size", 2)
+        kh, kw = _one(p, "kernel_h", k), _one(p, "kernel_w", k)
+        s = _one(p, "stride", 1)
+        sh, sw = _one(p, "stride_h", s), _one(p, "stride_w", s)
+        pd = _one(p, "pad", 0)
+        ph, pw = _one(p, "pad_h", pd), _one(p, "pad_w", pd)
+        if _one(p, "global_pooling", False):
+            return L["SpatialAveragePooling"](
+                1, 1, 1, 1, global_pooling=True), None
+        cls = L["SpatialMaxPooling"] if pool in ("MAX", 0) else L[
+            "SpatialAveragePooling"]
+        return cls(kw, kh, sw, sh, pw, ph).ceil(), None  # caffe ceils
+    if ltype == "ReLU":
+        return L["ReLU"](), None
+    if ltype == "TanH":
+        return L["Tanh"](), None
+    if ltype == "Sigmoid":
+        return L["Sigmoid"](), None
+    if ltype == "Softmax":
+        return L["SoftMax"](), None
+    if ltype == "Dropout":
+        p = _one(layer, "dropout_param", {})
+        return L["Dropout"](float(_one(p, "dropout_ratio", 0.5))), None
+    if ltype == "LRN":
+        p = _one(layer, "lrn_param", {})
+        return L["SpatialCrossMapLRN"](
+            int(_one(p, "local_size", 5)), float(_one(p, "alpha", 1.0)),
+            float(_one(p, "beta", 0.75)), float(_one(p, "k", 1.0))), None
+    if ltype == "BatchNorm":
+        p = _one(layer, "batch_norm_param", {})
+        n = lblobs[0].shape[0] if lblobs else None
+        if n is None:
+            raise ValueError("BatchNorm needs caffemodel blobs for sizing")
+        return L["SpatialBatchNormalization"](
+            int(n), eps=float(_one(p, "eps", 1e-5)), affine=False), None
+    if ltype == "Scale":
+        p = _one(layer, "scale_param", {})
+        n = lblobs[0].shape[0] if lblobs else None
+        if n is None:
+            raise ValueError("Scale needs caffemodel blobs for sizing")
+        return L["Scale"]((int(n),)), None
+    if ltype == "Concat":
+        p = _one(layer, "concat_param", {})
+        axis = int(_one(p, "axis", _one(p, "concat_dim", 1)))
+        return L["JoinTable"](axis + 1, -1), None  # caffe axis incl batch
+    if ltype == "Eltwise":
+        p = _one(layer, "eltwise_param", {})
+        op = _one(p, "operation", "SUM")
+        if op in ("SUM", 1):
+            return L["CAddTable"](), None
+        if op in ("PROD", 0):
+            return L["CMulTable"](), None
+        raise NotImplementedError(f"Eltwise op {op}")
+    if ltype in ("Accuracy", "SoftmaxWithLoss", "Silence"):
+        return None, None  # train/eval-only layers: skipped in deploy graphs
+    raise NotImplementedError(f"Caffe layer type {ltype!r} unsupported")
+
+
+def _install_weights(graph, pending, match_all: bool) -> None:
+    """Copy caffemodel blobs into the built graph's param pytree."""
+    for mod in graph._distinct_modules:
+        entry = pending.get(mod.name)
+        if entry is None:
+            continue
+        _, lblobs = entry
+        key = graph._module_keys[id(mod)]
+        p = graph.params.get(key, {})
+        cls = type(mod).__name__
+        if cls == "SpatialConvolution":
+            p["weight"] = lblobs[0].astype(np.float32)
+            if len(lblobs) > 1 and "bias" in p:
+                p["bias"] = lblobs[1].astype(np.float32)
+        elif cls == "Linear":
+            p["weight"] = lblobs[0].reshape(p["weight"].shape).astype(np.float32)
+            if len(lblobs) > 1 and "bias" in p:
+                p["bias"] = lblobs[1].astype(np.float32)
+        elif cls == "SpatialBatchNormalization":
+            sf = float(lblobs[2].reshape(-1)[0]) if len(lblobs) > 2 else 1.0
+            sf = 1.0 / sf if sf != 0 else 1.0
+            st = graph.state.get(key, {})
+            st["running_mean"] = (lblobs[0] * sf).astype(np.float32)
+            st["running_var"] = (lblobs[1] * sf).astype(np.float32)
+            graph.state[key] = st
+        elif cls == "Scale":
+            p["weight"] = lblobs[0].astype(np.float32)
+            if len(lblobs) > 1:
+                p["bias"] = lblobs[1].astype(np.float32)
+        elif match_all:
+            raise ValueError(
+                f"caffemodel blobs for layer {mod.name!r} ({cls}) not matched")
+        graph.params[key] = p
+    graph.grad_params = None
+    graph._ensure_params()
+
+
+class CaffeLoader:
+    """Reference-shaped facade (``Module.loadCaffeModel``)."""
+
+    load = staticmethod(load_caffe)
