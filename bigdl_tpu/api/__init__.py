@@ -1,0 +1,15 @@
+"""bigdl_tpu.api — pyspark-BigDL-shaped import surface.
+
+Reference role (UNVERIFIED, SURVEY.md §0): the ``pyspark/bigdl`` Python
+package (``bigdl.nn.layer``, ``bigdl.optim.optimizer``, ``bigdl.util.common``)
+whose names mirror the Scala API 1:1 over py4j (SURVEY.md §2.7 Python
+bridge).
+
+Here the bridge vanishes — this package is a NAMESPACE SHIM so reference
+user scripts port with an import swap:
+
+    from bigdl.nn.layer import Linear, Sequential          # reference
+    from bigdl_tpu.api.nn.layer import Linear, Sequential  # this framework
+
+Everything resolves to the same TPU-native classes as ``bigdl_tpu.nn``.
+"""

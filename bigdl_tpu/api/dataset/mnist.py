@@ -1,0 +1,3 @@
+"""``bigdl.dataset.mnist`` equivalent (``read_data_sets``)."""
+
+from bigdl_tpu.dataset.mnist import read_data_sets  # noqa: F401

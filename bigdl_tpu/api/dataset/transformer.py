@@ -1,0 +1,8 @@
+"""``bigdl.dataset.transformer`` equivalent (normalizer helpers)."""
+
+import numpy as np
+
+
+def normalizer(data, mean: float, std: float):
+    """Elementwise (x - mean) / std (pyspark ``normalizer``)."""
+    return (np.asarray(data) - mean) / std

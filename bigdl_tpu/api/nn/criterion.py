@@ -1,0 +1,14 @@
+"""``bigdl.nn.criterion`` equivalent."""
+
+from bigdl_tpu.nn import (  # noqa: F401
+    AbsCriterion, AbstractCriterion, BCECriterion, ClassNLLCriterion,
+    CosineDistanceCriterion, CosineEmbeddingCriterion, CrossEntropyCriterion,
+    DiceCoefficientCriterion, DistKLDivCriterion, GaussianCriterion,
+    HingeEmbeddingCriterion, KLDCriterion, L1Cost, MarginCriterion,
+    MarginRankingCriterion, MSECriterion, MultiCriterion,
+    MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
+    MultiMarginCriterion, ParallelCriterion, SmoothL1Criterion,
+    SoftmaxWithCriterion, TimeDistributedCriterion,
+)
+
+Criterion = AbstractCriterion  # pyspark base-class name

@@ -1,0 +1,6 @@
+"""``bigdl.nn.layer`` equivalent: every layer/container under one module,
+plus the pyspark-style ``Model`` alias for the functional Graph."""
+
+from bigdl_tpu.nn import *  # noqa: F401,F403
+from bigdl_tpu.nn import Graph as Model  # pyspark name for Graph
+from bigdl_tpu.nn import AbstractModule as Layer  # pyspark base-class name

@@ -1,0 +1,33 @@
+"""``bigdl.optim.optimizer`` equivalent: Optimizer + OptimMethods + the
+pyspark trigger-constructor names (``MaxEpoch(5)`` etc. construct Triggers)."""
+
+from bigdl_tpu.optim import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, DistriOptimizer, Evaluator, Ftrl, LBFGS,
+    LocalOptimizer, Loss, MAE, Metrics, OptimMethod, Optimizer, Predictor,
+    RMSprop, SGD, Top1Accuracy, Top5Accuracy, Trigger, ValidationMethod,
+)
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary  # noqa: F401
+
+
+def MaxEpoch(max_epoch: int) -> Trigger:
+    return Trigger.max_epoch(max_epoch)
+
+
+def MaxIteration(max_iteration: int) -> Trigger:
+    return Trigger.max_iteration(max_iteration)
+
+
+def EveryEpoch() -> Trigger:
+    return Trigger.every_epoch()
+
+
+def SeveralIteration(interval: int) -> Trigger:
+    return Trigger.several_iteration(interval)
+
+
+def MinLoss(min_loss: float) -> Trigger:
+    return Trigger.min_loss(min_loss)
+
+
+def MaxScore(max_score: float) -> Trigger:
+    return Trigger.max_score(max_score)

@@ -1,0 +1,41 @@
+"""``bigdl.util.common`` equivalent.
+
+The py4j plumbing (``callBigDlFunc``, ``JavaCreator``) has no meaning here;
+what remains is the user-facing surface: ``init_engine``, ``Sample``, and
+``JTensor`` (a plain ndarray wrapper kept for source compatibility)."""
+
+from typing import Any
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample as _Sample
+from bigdl_tpu.utils.engine import Engine
+
+
+def init_engine(*_args, **_kw) -> None:
+    """Reference ``init_engine()``: initialize the runtime singleton."""
+    Engine.init()
+
+
+class JTensor:
+    """pyspark's ndarray carrier; ``from_ndarray``/``to_ndarray`` kept."""
+
+    def __init__(self, storage, shape, bigdl_type: str = "float") -> None:
+        self.storage = np.asarray(storage, np.float32)
+        self.shape = tuple(shape)
+
+    @classmethod
+    def from_ndarray(cls, a) -> "JTensor":
+        a = np.asarray(a)
+        return cls(a.reshape(-1), a.shape)
+
+    def to_ndarray(self) -> np.ndarray:
+        return self.storage.reshape(self.shape)
+
+
+class Sample(_Sample):
+    """pyspark Sample with its ``from_ndarray`` constructor."""
+
+    @classmethod
+    def from_ndarray(cls, features: Any, labels: Any) -> "Sample":
+        return cls(features, labels)
