@@ -88,6 +88,7 @@ class DLModel:
         self.model = model
         self.feature_size = tuple(feature_size)
         self.batch_size = batch_size
+        self._predictor = None  # built once; reuses the compiled eval step
 
     def set_feature_size(self, size: Sequence[int]) -> "DLModel":
         self.feature_size = tuple(size)
@@ -102,9 +103,11 @@ class DLModel:
 
         X = np.asarray(X, np.float32)
         X = X.reshape((X.shape[0],) + self.feature_size)
-        # Predictor compiles one jitted eval step and batches (the same path
-        # model.predict uses) — no second inference loop to maintain here
-        return np.asarray(Predictor(self.model).predict(X, self.batch_size))
+        # one Predictor for the model's lifetime: its jitted eval step
+        # compiles once and is reused across transform calls
+        if self._predictor is None:
+            self._predictor = Predictor(self.model)
+        return np.asarray(self._predictor.predict(X, self.batch_size))
 
     predict = transform
 

@@ -120,6 +120,7 @@ class DistriOptimizer(Optimizer):
         arp = AllReduceParameter(params, n, "data", compress=self.compress)
         self._arp = arp
         compute_dtype = resolve_dtype(self.compute_dtype)
+        loss_scale = self.loss_scale
         model, criterion, optim = self.model, self.criterion, self.optim_method
         from bigdl_tpu.optim.train_step import regularizer_loss
 
@@ -149,11 +150,14 @@ class DistriOptimizer(Optimizer):
                 # the local/allreduce paths' apply_module_regularizers)
                 loss = criterion.apply(out, targets) + regularizer_loss(
                     model, p_full)
-                return loss, new_ms
+                return loss * loss_scale, new_ms
 
             (loss, new_ms), gshard = jax.value_and_grad(loss_fn, has_aux=True)(
                 my_shard
             )
+            if loss_scale != 1.0:
+                loss = loss / loss_scale
+                gshard = gshard / loss_scale
             gshard = gshard / n  # sum of per-shard means -> global mean
             gshard = self._clip_shard(gshard)
             new_shard, new_opt = optim.update(gshard, opt_local, my_shard)
@@ -193,6 +197,7 @@ class DistriOptimizer(Optimizer):
 
         model, criterion, optim = self.model, self.criterion, self.optim_method
         compute_dtype = resolve_dtype(self.compute_dtype)
+        loss_scale = self.loss_scale
 
         def spmd(params, opt_state, model_state, rng, inputs, targets):
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
@@ -217,11 +222,14 @@ class DistriOptimizer(Optimizer):
                 if compute_dtype is not None:
                     out = cast_floats(out, jnp.float32)
                     new_ms = restore_dtypes(new_ms, model_state)
-                return criterion.apply(out, targets), new_ms
+                return criterion.apply(out, targets) * loss_scale, new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params_v
             )
+            if loss_scale != 1.0:
+                loss = loss / loss_scale
+                grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
             grads = lax.pmean(grads, "data")
             grads = self._grad_hooks(grads, params)
             new_params, new_opt = optim.update(grads, opt_state, params)

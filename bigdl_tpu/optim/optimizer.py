@@ -83,6 +83,7 @@ class Optimizer:
         self.end_when: Trigger = end_trigger or Trigger.max_epoch(1)
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_trigger: Optional[Trigger] = None
+        self.checkpoint_backend = "pickle"
         self.overwrite_checkpoint = True
         self.validation_trigger: Optional[Trigger] = None
         self.validation_dataset: Optional[AbstractDataSet] = None
@@ -91,6 +92,8 @@ class Optimizer:
         self.validation_summary = None
         self.grad_clip: Dict[str, Any] = {}
         self.compute_dtype = None
+        self.loss_scale = 1.0
+        self._profile: Optional[Dict[str, Any]] = None
         self.metrics = Metrics()
         self.retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", "5"))
         self.retry_interval_s = float(
@@ -107,9 +110,16 @@ class Optimizer:
         self.end_when = trigger
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       backend: str = "pickle") -> "Optimizer":
+        """``backend="pickle"`` writes the reference-style model/optimMethod
+        snapshot pair; ``backend="orbax"`` writes an orbax PyTree checkpoint
+        (tensor-store format, the TPU-ecosystem standard — SURVEY.md §5.4)."""
+        if backend not in ("pickle", "orbax"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
+        self.checkpoint_backend = backend
         return self
 
     def over_write_checkpoint(self) -> "Optimizer":
@@ -132,11 +142,33 @@ class Optimizer:
         self.validation_summary = summary
         return self
 
+    def set_profile(self, trace_dir: str, start_iteration: int = 5,
+                    n_iterations: int = 3) -> "Optimizer":
+        """Capture a ``jax.profiler`` trace for iterations
+        ``[start_iteration, start_iteration + n_iterations)`` — the deep
+        option on top of the reference-style Metrics counters (SURVEY.md
+        §5.1); view with TensorBoard's profile plugin or Perfetto."""
+        self._profile = {"dir": trace_dir, "start": start_iteration,
+                         "stop": start_iteration + n_iterations}
+        return self
+
     def set_compute_dtype(self, dtype) -> "Optimizer":
         """Mixed precision: run forward/backward in ``"bf16"``/``"fp16"``
         while master weights, optimizer state and loss stay fp32 (TPU-native
-        performance knob; no reference counterpart — MKL was fp32-only)."""
+        performance knob; no reference counterpart — MKL was fp32-only).
+        fp16 needs :meth:`set_loss_scale` — its ~6e-8 cotangent floor flushes
+        small gradients to zero unscaled (bf16 does not)."""
         self.compute_dtype = dtype
+        if dtype in ("fp16", "float16") and self.loss_scale == 1.0:
+            logger.warning(
+                "fp16 compute without loss scaling will underflow small "
+                "gradients; call set_loss_scale(e.g. 1024.0)")
+        return self
+
+    def set_loss_scale(self, scale: float) -> "Optimizer":
+        """Static loss scaling for fp16 compute (loss × scale before the
+        backward pass, gradients ÷ scale after)."""
+        self.loss_scale = float(scale)
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
@@ -169,6 +201,22 @@ class Optimizer:
             return
         tag = "" if self.overwrite_checkpoint else f".{state['neval']}"
         os.makedirs(self.checkpoint_path, exist_ok=True)
+        if self.checkpoint_backend == "orbax":
+            import jax
+            import orbax.checkpoint as ocp
+
+            target = os.path.abspath(
+                os.path.join(self.checkpoint_path, f"orbax{tag or '.0'}"))
+            ckptr = ocp.PyTreeCheckpointer()
+            blob = {
+                "params": jax.tree_util.tree_map(np.asarray, params),
+                "model_state": jax.tree_util.tree_map(np.asarray, model_state),
+                "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
+                "epoch": np.int64(state["epoch"]),
+                "neval": np.int64(state["neval"]),
+            }
+            ckptr.save(target, blob, force=True)
+            return
         File.save(
             {"params": params, "model_state": model_state, "module": self.model},
             os.path.join(self.checkpoint_path, f"model{tag}"),
@@ -190,6 +238,34 @@ class Optimizer:
 
         if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
             return None
+        if self.checkpoint_backend == "orbax":
+            import orbax.checkpoint as ocp
+
+            def _iteration_of(f):
+                # valid snapshots are "orbax.<iter>"; anything else (orbax
+                # temp dirs from a crash mid-save) must not break resume
+                try:
+                    return float(f[len("orbax."):] or 0)
+                except ValueError:
+                    return None
+
+            snaps = sorted(
+                (f for f in os.listdir(self.checkpoint_path)
+                 if f.startswith("orbax") and _iteration_of(f) is not None),
+                key=_iteration_of,
+            )
+            if not snaps:
+                return None
+            try:
+                blob = ocp.PyTreeCheckpointer().restore(os.path.abspath(
+                    os.path.join(self.checkpoint_path, snaps[-1])))
+            except Exception:
+                return None
+            return (
+                {"params": blob["params"], "model_state": blob["model_state"]},
+                {"opt_state": blob["opt_state"], "epoch": int(blob["epoch"]),
+                 "neval": int(blob["neval"])},
+            )
         models = sorted(
             f for f in os.listdir(self.checkpoint_path) if f.startswith("model")
         )
@@ -308,6 +384,14 @@ class Optimizer:
 
         while not self.end_when(state):
             state["epoch_finished"] = False
+            if self._profile is not None:
+                if state["neval"] == self._profile["start"]:
+                    jax.profiler.start_trace(self._profile["dir"])
+                    self._profile["active"] = True
+                elif state["neval"] == self._profile["stop"] and \
+                        self._profile.get("active"):
+                    jax.profiler.stop_trace()
+                    self._profile["active"] = False
             batch: MiniBatch = next(data_iter)
             bsz = batch.size()
             t0 = time.time()
@@ -340,6 +424,14 @@ class Optimizer:
                         float(sched.lr(base_lr, max(0, state["neval"] - 2))),
                         state["neval"] - 1,
                     )
+                if self.train_summary.should_record("Parameters", state):
+                    host = self._ckpt_params_to_host(params)
+                    for path, leaf in jax.tree_util.tree_flatten_with_path(
+                            host)[0]:
+                        tag = "Parameters/" + "/".join(
+                            getattr(k, "key", str(k)) for k in path)
+                        self.train_summary.add_histogram(
+                            tag, np.asarray(leaf), state["neval"] - 1)
 
             if seen_this_epoch >= epoch_size:
                 state["epoch_finished"] = True
@@ -363,6 +455,9 @@ class Optimizer:
                     state, self._ckpt_params_to_host(params), model_state, opt_state
                 )
 
+        if self._profile is not None and self._profile.get("active"):
+            jax.profiler.stop_trace()  # loop ended inside the trace window
+            self._profile["active"] = False
         self._writeback(params, opt_state, model_state)
         return self.model
 
@@ -383,7 +478,7 @@ class LocalOptimizer(Optimizer):
         opt_state = self.optim_method.init_state(params)
         step = jax.jit(
             make_train_step(self.model, self.criterion, self.optim_method,
-                            self.grad_clip,
+                            self.grad_clip, loss_scale=self.loss_scale,
                             compute_dtype=resolve_dtype(self.compute_dtype))
         )
 

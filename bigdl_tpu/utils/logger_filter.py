@@ -1,0 +1,53 @@
+"""LoggerFilter — route chatty framework logs to a file, keep ours on console.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/utils/LoggerFilter.scala``
+— log4j surgery sending verbose Spark INFO to ``bigdl.log`` while BigDL's
+per-iteration INFO stays on the console.
+
+TPU-native equivalents of "chatty Spark": jax's bridge/compiler warnings,
+tensorflow, absl, orbax. ``LoggerFilter.redirect_spark_info_logs()`` (name
+kept from the reference API) moves them to ``bigdl.log`` in the given
+directory and pins ``bigdl_tpu``'s INFO to the console.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterable, Optional
+
+_CHATTY = ("jax", "jax._src", "tensorflow", "absl", "orbax", "h5py")
+
+
+class LoggerFilter:
+    _configured = False
+
+    @staticmethod
+    def redirect_spark_info_logs(log_dir: str = ".",
+                                 chatty: Optional[Iterable[str]] = None,
+                                 filename: str = "bigdl.log") -> str:
+        """Send chatty third-party INFO/WARNING logs to ``log_dir/bigdl.log``
+        and keep ``bigdl_tpu`` INFO on the console. Returns the log path."""
+        os.makedirs(log_dir, exist_ok=True)
+        path = os.path.join(log_dir, filename)
+        file_handler = logging.FileHandler(path)
+        file_handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+
+        for name in (chatty if chatty is not None else _CHATTY):
+            lg = logging.getLogger(name)
+            lg.handlers = [file_handler]
+            lg.propagate = False
+            lg.setLevel(logging.INFO)
+
+        ours = logging.getLogger("bigdl_tpu")
+        if not any(isinstance(h, logging.StreamHandler)
+                   and not isinstance(h, logging.FileHandler)
+                   for h in ours.handlers):
+            console = logging.StreamHandler()
+            console.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s - %(message)s"))
+            ours.addHandler(console)
+        ours.setLevel(logging.INFO)
+        LoggerFilter._configured = True
+        return path

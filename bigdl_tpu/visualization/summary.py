@@ -20,10 +20,14 @@ class Summary:
     def __init__(self, log_dir: str, app_name: str, tag: str) -> None:
         self.log_dir = os.path.join(log_dir, app_name, tag)
         self.writer = FileWriter(self.log_dir)
-        self._trigger_tags = set()
+        self._triggers = {}
 
     def add_scalar(self, tag: str, value: float, step: int) -> "Summary":
         self.writer.add_scalar(tag, float(value), int(step))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+        self.writer.add_histogram(tag, values, int(step))
         return self
 
     def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
@@ -44,10 +48,14 @@ class TrainSummary(Summary):
         super().__init__(log_dir, app_name, "train")
 
     def set_summary_trigger(self, name: str, trigger) -> "TrainSummary":
-        """Parity stub for per-tag triggers (reference supports throttling
-        'Parameters' histograms); scalar tags are always recorded here."""
-        self._trigger_tags.add(name)
+        """Per-tag recording triggers (reference: throttles the expensive
+        'Parameters' histograms, e.g. ``Trigger.several_iteration(20)``)."""
+        self._triggers[name] = trigger
         return self
+
+    def should_record(self, name: str, state) -> bool:
+        trig = self._triggers.get(name)
+        return trig is not None and trig(state)
 
 
 class ValidationSummary(Summary):

@@ -90,6 +90,35 @@ def scalar_event(tag: str, value: float, step: int,
             + _field_bytes(5, summary))
 
 
+def _packed_doubles(num: int, vals) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in vals)
+    return _field_bytes(num, payload)
+
+
+def histogram_event(tag: str, values, step: int,
+                    bins: int = 30, wall_time: float | None = None) -> bytes:
+    """TF HistogramProto event (reference ``Summary.histogram`` — the
+    'Parameters' histograms of TrainSummary)."""
+    import numpy as np
+
+    v = np.asarray(values, np.float64).reshape(-1)
+    if v.size == 0:
+        v = np.zeros((1,))
+    counts, edges = np.histogram(v, bins=bins)
+    histo = (_field_double(1, float(v.min()))
+             + _field_double(2, float(v.max()))
+             + _field_double(3, float(v.size))
+             + _field_double(4, float(v.sum()))
+             + _field_double(5, float((v * v).sum()))
+             + _packed_doubles(6, edges[1:])
+             + _packed_doubles(7, counts))
+    sv = _field_bytes(1, tag.encode()) + _field_bytes(5, histo)
+    summary = _field_bytes(1, sv)
+    return (_field_double(1, wall_time if wall_time is not None else time.time())
+            + _field_varint(2, int(step))
+            + _field_bytes(5, summary))
+
+
 def version_event() -> bytes:
     return (_field_double(1, time.time())
             + _field_bytes(3, b"brain.Event:2"))
@@ -116,6 +145,10 @@ class FileWriter:
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         self._f.write(frame_record(scalar_event(tag, value, step)))
+        self._f.flush()
+
+    def add_histogram(self, tag: str, values, step: int) -> None:
+        self._f.write(frame_record(histogram_event(tag, values, step)))
         self._f.flush()
 
     def close(self) -> None:
