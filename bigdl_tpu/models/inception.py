@@ -103,3 +103,32 @@ def Inception_v1_NoAuxClassifier(class_num: int = 1000,
 # The aux-classifier training variant shares the same main tower; the two
 # auxiliary heads only change the training loss. Parity alias:
 Inception_v1 = Inception_v1_NoAuxClassifier
+
+
+def train_main(argv=None):
+    """Reference ``models/inception/TrainInceptionV1.scala`` main
+    (BASELINE target #4; poly LR decay)."""
+    from bigdl_tpu.models.utils import (
+        run_training, synthetic_imagenet_samples, train_parser,
+    )
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.optim_method import SGD, Poly
+
+    args = train_parser("Inception-v1 on ImageNet",
+                        batch_size=64, learning_rate=0.01,
+                        max_epoch=2).parse_args(argv)
+    if args.folder:
+        from bigdl_tpu.dataset.image import image_folder_samples
+
+        samples = image_folder_samples(args.folder, image_size=224)
+    else:
+        samples = synthetic_imagenet_samples(args.synthetic)
+    method = SGD(learning_rate=args.learningRate, momentum=args.momentum,
+                 weight_decay=args.weightDecay,
+                 learning_rate_schedule=Poly(0.5, 62000))
+    return run_training(Inception_v1_NoAuxClassifier(1000), samples,
+                        ClassNLLCriterion(), args, optim_method=method)
+
+
+if __name__ == "__main__":
+    train_main()

@@ -31,3 +31,32 @@ def LeNet5(class_num: int = 10) -> Sequential:
         .add(LogSoftMax())
     )
     return model
+
+
+def train_main(argv=None):
+    """Reference ``models/lenet/Train.scala`` main (``--env local`` config:
+    BASELINE target #1 — LeNet-5/MNIST via LocalOptimizer)."""
+    from bigdl_tpu.dataset.mnist import load_samples
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+    args = train_parser("LeNet-5 on MNIST", batch_size=128,
+                        learning_rate=0.05, max_epoch=5).parse_args(argv)
+    samples = load_samples(args.folder or "/nonexistent", "train",
+                           synthetic_count=args.synthetic)
+    return run_training(LeNet5(10), samples, ClassNLLCriterion(), args)
+
+
+def test_main(argv=None):
+    """Reference ``models/lenet/Test.scala`` main."""
+    from bigdl_tpu.dataset.mnist import load_samples
+    from bigdl_tpu.models.utils import run_test, test_parser
+
+    args = test_parser("LeNet-5 MNIST evaluation").parse_args(argv)
+    samples = load_samples(args.folder or "/nonexistent", "test",
+                           synthetic_count=args.synthetic)
+    return run_test(args.model, samples, args.batchSize)
+
+
+if __name__ == "__main__":
+    train_main()

@@ -194,3 +194,47 @@ def _resnet_cifar(class_num, depth, shortcut_type, zero_gamma) -> Graph:
     x = Linear(64, class_num, init_weight=Xavier(), init_bias=Zeros()).inputs(x)
     out = LogSoftMax().inputs(x)
     return Graph(inp, out)
+
+
+def train_main(argv=None):
+    """Reference ``models/resnet/TrainImageNet.scala`` /
+    ``TrainCIFAR10.scala`` mains (BASELINE target #3). ``--dataset``
+    selects imagenet (synthetic unless -f) or cifar10."""
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+
+    p = train_parser("ResNet training", batch_size=128,
+                     learning_rate=0.1, max_epoch=10)
+    p.add_argument("--dataset", default="cifar10",
+                   choices=["cifar10", "imagenet"])
+    p.add_argument("--depth", type=int, default=None,
+                   help="default: 20 (cifar10) / 50 (imagenet)")
+    p.add_argument("--warmupEpoch", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.dataset == "cifar10":
+        from bigdl_tpu.dataset.cifar import load_samples
+
+        samples = load_samples(args.folder or "/nonexistent", "train",
+                               synthetic_count=args.synthetic)
+        model = ResNet(10, {"depth": args.depth or 20, "shortcutType": "A",
+                            "dataSet": "cifar10"})
+    else:
+        from bigdl_tpu.models.utils import synthetic_imagenet_samples
+
+        if args.folder:
+            from bigdl_tpu.dataset.image import image_folder_samples
+
+            samples = image_folder_samples(args.folder, image_size=224)
+        else:
+            samples = synthetic_imagenet_samples(args.synthetic)
+        model = ResNet(1000, {"depth": args.depth or 50, "shortcutType": "B"})
+    method = SGD(learning_rate=args.learningRate, momentum=args.momentum,
+                 weight_decay=args.weightDecay, nesterov=True)
+    return run_training(model, samples, CrossEntropyCriterion(), args,
+                        optim_method=method)
+
+
+if __name__ == "__main__":
+    train_main()

@@ -42,3 +42,58 @@ def SimpleRNN(input_size: int, hidden_size: int = 200,
               output_size: int = None) -> Sequential:
     return PTBModel(input_size, hidden_size, output_size, num_layers=1,
                     key_type="rnn")
+
+
+def train_main(argv=None):
+    """Reference ``models/rnn/Train.scala`` main (PTB language model):
+    ``-f`` = a text file (PTB ``train.txt`` style); synthetic markov-ish
+    corpus otherwise."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.text import (
+        Dictionary, SequenceWindower, simple_tokenize,
+    )
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import TimeDistributedCriterion, ClassNLLCriterion
+    from bigdl_tpu.optim.optim_method import Adagrad
+
+    p = train_parser("PTB-style language model", batch_size=32,
+                     learning_rate=0.1, max_epoch=2)
+    p.add_argument("--vocab", type=int, default=200)
+    p.add_argument("--seqLen", type=int, default=20)
+    p.add_argument("--hidden", type=int, default=128)
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    samples = []
+    vocab = args.vocab
+    if args.folder:
+        # real corpus: tokenize → id stream → next-word windows
+        with open(args.folder) as f:
+            tokens = simple_tokenize(f.read())
+        d = Dictionary([tokens])
+        vocab = d.vocab_size()
+        ids = [d.get_index(t) + 1 for t in tokens]  # 1-based ids
+        for ls in SequenceWindower(args.seqLen)(iter([ids])):
+            samples.append(Sample(np.asarray(ls.data, np.float32),
+                                  np.asarray(ls.labels, np.float32)))
+        if not samples:
+            raise ValueError(f"{args.folder}: corpus shorter than --seqLen")
+    else:
+        for _ in range(args.synthetic):
+            # markov-ish synthetic ids: next token near the previous one
+            toks = [int(rng.integers(1, vocab + 1))]
+            for _ in range(args.seqLen):
+                toks.append(1 + (toks[-1] + int(rng.integers(0, 3))) % vocab)
+            arr = np.asarray(toks, np.float32)
+            samples.append(Sample(arr[:-1], arr[1:]))  # predict next token
+    model = PTBModel(vocab, hidden_size=args.hidden,
+                     output_size=vocab, num_layers=1)
+    crit = TimeDistributedCriterion(ClassNLLCriterion())
+    return run_training(model, samples, crit, args,
+                        optim_method=Adagrad(learning_rate=args.learningRate))
+
+
+if __name__ == "__main__":
+    train_main()

@@ -62,3 +62,66 @@ def TextClassifier(class_num: int, embedding_dim: int = 200,
     model.add(Linear(feat, class_num))
     model.add(LogSoftMax())
     return model
+
+
+def train_main(argv=None):
+    """Reference ``example/textclassification/TextClassifier.scala`` /
+    pyspark ``textclassifier.py`` main (BASELINE target #5 — BiRecurrent
+    LSTM). ``-f`` = news20-style directory (one subdir per class holding
+    ``.txt`` files); synthetic token sequences otherwise. Both use the
+    LookupTable path (no pretrained GloVe embeddings in this image)."""
+    import os
+
+    import numpy as np
+
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.text import Dictionary, simple_tokenize
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.optim_method import Adagrad
+
+    p = train_parser("BiRecurrent LSTM text classifier", batch_size=32,
+                     learning_rate=0.05, max_epoch=3)
+    p.add_argument("--vocab", type=int, default=500)
+    p.add_argument("--seqLen", type=int, default=50)
+    p.add_argument("--classNum", type=int, default=5)
+    p.add_argument("--embeddingDim", type=int, default=64)
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    samples = []
+    vocab, class_num = args.vocab, args.classNum
+    if args.folder:
+        classes = sorted(d for d in os.listdir(args.folder)
+                         if os.path.isdir(os.path.join(args.folder, d)))
+        if not classes:
+            raise ValueError(f"{args.folder}: no class subdirectories")
+        docs = []
+        for ci, cls in enumerate(classes):
+            cdir = os.path.join(args.folder, cls)
+            for fn in sorted(os.listdir(cdir)):
+                with open(os.path.join(cdir, fn), errors="ignore") as f:
+                    docs.append((simple_tokenize(f.read()), ci + 1))
+        d = Dictionary([t for t, _ in docs])
+        vocab, class_num = d.vocab_size(), len(classes)
+        for toks, label in docs:
+            ids = [d.get_index(t) + 1 for t in toks][: args.seqLen]
+            ids += [1] * (args.seqLen - len(ids))  # pad with id 1
+            samples.append(Sample(np.asarray(ids, np.float32),
+                                  np.int32(label)))
+    else:
+        for _ in range(args.synthetic):
+            c = int(rng.integers(1, class_num + 1))
+            # class-dependent token distribution so the task is learnable
+            base = (c - 1) * (vocab // class_num)
+            toks = rng.integers(base + 1, base + vocab // class_num + 1,
+                                size=(args.seqLen,))
+            samples.append(Sample(toks.astype(np.float32), np.int32(c)))
+    model = TextClassifier(class_num, embedding_dim=args.embeddingDim,
+                           vocab_size=vocab, embedding_input=False)
+    return run_training(model, samples, ClassNLLCriterion(), args,
+                        optim_method=Adagrad(learning_rate=args.learningRate))
+
+
+if __name__ == "__main__":
+    train_main()

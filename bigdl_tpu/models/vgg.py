@@ -116,3 +116,31 @@ def Vgg_19(class_num: int = 1000, has_dropout: bool = True) -> Sequential:
          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
         class_num, has_dropout,
     )
+
+
+def train_main(argv=None):
+    """Reference ``models/vgg/Train.scala`` main (BASELINE target #2 —
+    VGG/CIFAR-10 via DistriOptimizer; single-chip here, DP on a mesh)."""
+    from bigdl_tpu.dataset.cifar import load_samples
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+    args = train_parser("VGG on CIFAR-10", batch_size=128,
+                        learning_rate=0.01, max_epoch=10).parse_args(argv)
+    samples = load_samples(args.folder or "/nonexistent", "train",
+                           synthetic_count=args.synthetic)
+    return run_training(VggForCifar10(10), samples, ClassNLLCriterion(), args)
+
+
+def test_main(argv=None):
+    from bigdl_tpu.dataset.cifar import load_samples
+    from bigdl_tpu.models.utils import run_test, test_parser
+
+    args = test_parser("VGG CIFAR-10 evaluation").parse_args(argv)
+    samples = load_samples(args.folder or "/nonexistent", "test",
+                           synthetic_count=args.synthetic)
+    return run_test(args.model, samples, args.batchSize)
+
+
+if __name__ == "__main__":
+    train_main()

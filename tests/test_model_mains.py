@@ -1,0 +1,120 @@
+"""Model-zoo Train/Test CLI mains (SURVEY.md §2.8: builder + Train/Test
+mains with option parsers) — smoke-trained on tiny synthetic data."""
+
+import os
+
+import numpy as np
+
+
+def test_lenet_train_and_test_main(tmp_path):
+    from bigdl_tpu.models import lenet
+
+    model = lenet.train_main([
+        "-b", "32", "--maxIteration", "3", "--synthetic", "64",
+        "--cache", str(tmp_path / "ck"), "--overWrite",
+    ])
+    assert model is not None
+    assert os.path.exists(str(tmp_path / "ck" / "model"))
+
+    results = lenet.test_main([
+        "--model", str(tmp_path / "ck" / "model"),
+        "-b", "32", "--synthetic", "64",
+    ])
+    acc, total = results[0].result()
+    assert total == 64
+
+
+def test_vgg_train_main():
+    from bigdl_tpu.models import vgg
+
+    model = vgg.train_main(["-b", "16", "--maxIteration", "2",
+                            "--synthetic", "32"])
+    ws, _ = model.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_resnet_cifar_train_main():
+    from bigdl_tpu.models import resnet
+
+    model = resnet.train_main([
+        "-b", "16", "--maxIteration", "2", "--synthetic", "32",
+        "--dataset", "cifar10", "--depth", "20",
+    ])
+    ws, _ = model.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_textclassifier_train_main():
+    from bigdl_tpu.models import textclassifier
+
+    model = textclassifier.train_main([
+        "-b", "16", "--maxIteration", "3", "--synthetic", "48",
+        "--seqLen", "12", "--vocab", "60", "--classNum", "3",
+        "--embeddingDim", "16",
+    ])
+    ws, _ = model.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_rnn_train_main():
+    from bigdl_tpu.models import rnn
+
+    model = rnn.train_main([
+        "-b", "16", "--maxIteration", "3", "--synthetic", "48",
+        "--seqLen", "10", "--vocab", "40", "--hidden", "32",
+    ])
+    ws, _ = model.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_rnn_real_corpus_main(tmp_path):
+    """-f loads a real text corpus (tokenize → windows), not synthetic."""
+    corpus = tmp_path / "train.txt"
+    corpus.write_text(("the quick brown fox jumps over the lazy dog " * 40))
+    from bigdl_tpu.models import rnn
+
+    model = rnn.train_main([
+        "-f", str(corpus), "-b", "8", "--maxIteration", "2",
+        "--seqLen", "8", "--hidden", "16",
+    ])
+    ws, _ = model.parameters()
+    # vocabulary derived from the corpus (8 words + OOV = 9), so the
+    # LookupTable is (9, hidden) — proves the real path ran
+    assert any(np.asarray(w).shape[0] == 9 for w in ws)
+
+
+def test_textclassifier_real_folder_main(tmp_path):
+    for ci, cls in enumerate(["alt.atheism", "sci.space"]):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(6):
+            (d / f"{i}.txt").write_text(f"{cls.split('.')[-1]} topic words "
+                                        f"document {i} " * 5)
+    from bigdl_tpu.models import textclassifier
+
+    model = textclassifier.train_main([
+        "-f", str(tmp_path), "-b", "4", "--maxIteration", "2",
+        "--seqLen", "12", "--embeddingDim", "8",
+    ])
+    ws, _ = model.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_resnet_imagenet_default_depth():
+    """`--dataset imagenet` with no --depth must build ResNet-50, not crash."""
+    from bigdl_tpu.models.resnet import ResNet
+
+    # the main's depth resolution: args.depth or 50
+    model = ResNet(1000, {"depth": None or 50, "shortcutType": "B"})
+    assert model is not None
+
+
+def test_seqfile_rejects_empty_process_shard(tmp_path):
+    import pytest
+
+    from bigdl_tpu.dataset.seqfile import SeqFileDataSet, encode_array, write_shards
+
+    write_shards([(1, encode_array(np.zeros((2,), np.float32)))],
+                 str(tmp_path), n_shards=1)
+    with pytest.raises(ValueError, match="gets no shards"):
+        SeqFileDataSet(str(tmp_path), shard_index=1, num_shards=2)
