@@ -125,5 +125,18 @@ class _DataSetFactory:
 
         return _DataSetFactory.array(image_folder_samples(path, **kwargs))
 
+    @staticmethod
+    def seq_file_folder(path: str, decoder=None, seed: int = 0):
+        """Sharded record-file ingestion (reference ``DataSet.SeqFileFolder``
+        — ImageNet-as-SequenceFiles). Shards are split across processes."""
+        import jax
+
+        from bigdl_tpu.dataset.seqfile import SeqFileDataSet
+
+        return SeqFileDataSet(
+            path, decoder=decoder, seed=seed,
+            shard_index=jax.process_index(), num_shards=jax.process_count(),
+        )
+
 
 DataSet = _DataSetFactory()

@@ -1,0 +1,180 @@
+"""Sharded record-file ingestion — the ImageNet-at-scale input path.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``DataSet.SeqFileFolder`` — ImageNet
+packed into Hadoop SequenceFiles (key = label, value = encoded image bytes),
+one file per shard, read partition-parallel by Spark executors
+(``.../dataset/DataSet.scala — SeqFileFolder``).
+
+TPU-native redesign: Hadoop is gone; the same role is a directory of
+**record shards** — a dead-simple length-prefixed binary format
+(``RECS`` magic, then per record: varint label, varint payload length,
+payload bytes) written once by :func:`write_shards` and consumed by
+``DataSet.seq_file_folder``:
+
+* shard list split round-robin across processes (``shard_index`` /
+  ``num_shards`` — the per-host sharding of a pod job, mirroring one Spark
+  partition per executor);
+* per-epoch shard-order + intra-shard shuffling (train), sequential (eval);
+* decode on the host via the C++ native pipeline when available, feeding
+  device batches — the reference's OpenCV role (SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+
+MAGIC = b"RECS"
+
+
+def _write_varint(f, x: int) -> None:
+    if x < 0:
+        raise ValueError(f"varint fields must be non-negative, got {x}")
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        f.write(bytes([b | 0x80] if x else [b]))
+        if not x:
+            return
+
+
+def _read_varint(f) -> Optional[int]:
+    result, shift = 0, 0
+    while True:
+        c = f.read(1)
+        if not c:
+            return None
+        b = c[0]
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result
+        shift += 7
+
+
+def write_shards(records: Sequence[Tuple[int, bytes]], out_dir: str,
+                 n_shards: int = 8, prefix: str = "part") -> List[str]:
+    """Pack ``(label, payload)`` records into ``n_shards`` shard files
+    (round-robin, like the reference's SequenceFile packing job)."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = [os.path.join(out_dir, f"{prefix}-{i:05d}.recs")
+             for i in range(n_shards)]
+    files = [open(p, "wb") for p in paths]
+    try:
+        for f in files:
+            f.write(MAGIC)
+        for i, (label, payload) in enumerate(records):
+            f = files[i % n_shards]
+            _write_varint(f, int(label))
+            _write_varint(f, len(payload))
+            f.write(payload)
+    finally:
+        for f in files:
+            f.close()
+    return paths
+
+
+def read_shard(path: str) -> Iterator[Tuple[int, bytes]]:
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not a RECS shard")
+        while True:
+            label = _read_varint(f)
+            if label is None:
+                return
+            ln = _read_varint(f)
+            payload = f.read(ln)
+            if len(payload) != ln:
+                raise ValueError(f"{path}: truncated record")
+            yield label, payload
+
+
+def _default_decoder(label: int, payload: bytes) -> Sample:
+    """Payload = raw float32 tensor bytes prefixed with a shape header
+    (ndim u8, dims u32le each). Use ``decoder=`` for JPEG etc."""
+    nd = payload[0]
+    dims = struct.unpack_from(f"<{nd}I", payload, 1)
+    arr = np.frombuffer(payload, np.float32, offset=1 + 4 * nd).reshape(dims)
+    return Sample(arr.copy(), np.int32(label))
+
+
+def encode_array(arr: np.ndarray) -> bytes:
+    """Inverse of the default decoder's payload format."""
+    arr = np.ascontiguousarray(arr, np.float32)
+    header = bytes([arr.ndim]) + struct.pack(f"<{arr.ndim}I", *arr.shape)
+    return header + arr.tobytes()
+
+
+class SeqFileDataSet(LocalDataSet):
+    """Shard-backed dataset with per-process shard assignment. Follows the
+    LocalDataSet transformer-chain contract (``ds >> transformer``)."""
+
+    def __init__(self, folder: str,
+                 decoder: Optional[Callable[[int, bytes], Sample]] = None,
+                 shard_index: int = 0, num_shards: int = 1,
+                 seed: int = 0, transformers=None) -> None:
+        self._folder = folder
+        all_paths = sorted(
+            os.path.join(folder, f) for f in os.listdir(folder)
+            if f.endswith(".recs")
+        )
+        if not all_paths:
+            raise ValueError(f"no .recs shards under {folder}")
+        # round-robin shard→process assignment (one Spark partition per
+        # executor ≙ one shard subset per TPU host process)
+        self.paths = all_paths[shard_index::num_shards]
+        if not self.paths:
+            raise ValueError(
+                f"process {shard_index}/{num_shards} gets no shards — "
+                f"{folder} holds only {len(all_paths)} .recs files; write at "
+                "least one shard per process")
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self.decoder = decoder or _default_decoder
+        self._seed = seed
+        self._transformers = list(transformers or [])
+        self._epoch = 0
+        self._size: Optional[int] = None
+
+    def size(self) -> int:
+        if self._size is None:
+            n = 0
+            for p in self.paths:
+                for _ in read_shard(p):
+                    n += 1
+            self._size = n
+        return self._size
+
+    def transform(self, transformer) -> "SeqFileDataSet":
+        out = SeqFileDataSet(self._folder, self.decoder, self.shard_index,
+                             self.num_shards, self._seed,
+                             self._transformers + [transformer])
+        return out
+
+    __rshift__ = transform
+
+    def _iter_once(self, shuffle: bool) -> Iterator[Sample]:
+        rng = np.random.default_rng(self._seed + self._epoch)
+        order = list(self.paths)
+        if shuffle:
+            rng.shuffle(order)
+        for path in order:
+            records = list(read_shard(path))
+            if shuffle:
+                rng.shuffle(records)
+            for label, payload in records:
+                yield self.decoder(label, payload)
+
+    def _base_iter(self, train: bool) -> Iterator[Sample]:
+        if not train:
+            yield from self._iter_once(shuffle=False)
+            return
+        while True:
+            yield from self._iter_once(shuffle=True)
+            self._epoch += 1

@@ -1,0 +1,96 @@
+"""SeqFile-style sharded ingestion (SURVEY.md §2.5 SeqFileFolder row)."""
+
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def _write(tmp_path, n=24, n_shards=4, shape=(3, 4, 4)):
+    from bigdl_tpu.dataset.seqfile import encode_array, write_shards
+
+    rng = np.random.RandomState(0)
+    arrays = [rng.randn(*shape).astype(np.float32) for _ in range(n)]
+    labels = [i % 5 + 1 for i in range(n)]
+    write_shards(
+        [(l, encode_array(a)) for l, a in zip(labels, arrays)],
+        str(tmp_path), n_shards=n_shards,
+    )
+    return arrays, labels
+
+
+def test_write_read_roundtrip(tmp_path):
+    from bigdl_tpu.dataset.seqfile import SeqFileDataSet
+
+    arrays, labels = _write(tmp_path)
+    ds = SeqFileDataSet(str(tmp_path))
+    assert ds.size() == 24
+    seen = {}
+    for s in ds.data(train=False):
+        seen[int(np.asarray(s.labels[0]))] = seen.get(
+            int(np.asarray(s.labels[0])), 0) + 1
+    assert sum(seen.values()) == 24
+
+
+def test_eval_order_and_content(tmp_path):
+    from bigdl_tpu.dataset.seqfile import SeqFileDataSet
+
+    arrays, labels = _write(tmp_path, n=8, n_shards=2)
+    ds = SeqFileDataSet(str(tmp_path))
+    got = [np.asarray(s.features[0]) for s in ds.data(train=False)]
+    # shard 0 holds records 0,2,4,6; shard 1 holds 1,3,5,7 (round-robin)
+    want = [arrays[i] for i in (0, 2, 4, 6, 1, 3, 5, 7)]
+    for g, w in zip(got, want):
+        assert_close(g, w)
+
+
+def test_process_sharding_disjoint_and_complete(tmp_path):
+    from bigdl_tpu.dataset.seqfile import SeqFileDataSet
+
+    _write(tmp_path, n=24, n_shards=4)
+    all_labels = []
+    sizes = []
+    for idx in range(2):
+        ds = SeqFileDataSet(str(tmp_path), shard_index=idx, num_shards=2)
+        items = list(ds.data(train=False))
+        sizes.append(len(items))
+        all_labels += [float(np.asarray(s.features[0]).sum()) for s in items]
+    assert sum(sizes) == 24
+    assert len(set(all_labels)) == 24  # disjoint shards cover everything
+
+
+def test_train_shuffles_and_repeats(tmp_path):
+    from bigdl_tpu.dataset.seqfile import SeqFileDataSet
+
+    _write(tmp_path, n=12, n_shards=3)
+    ds = SeqFileDataSet(str(tmp_path), seed=1)
+    it = ds.data(train=True)
+    epoch1 = [float(np.asarray(next(it).features[0]).sum()) for _ in range(12)]
+    epoch2 = [float(np.asarray(next(it).features[0]).sum()) for _ in range(12)]
+    assert sorted(epoch1) == sorted(epoch2)  # same records
+    assert epoch1 != epoch2  # different order
+
+
+def test_transformer_chain_and_training(tmp_path):
+    """SeqFile dataset feeds the Optimizer through SampleToMiniBatch."""
+    from bigdl_tpu.dataset.seqfile import SeqFileDataSet
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.nn import Linear, MSECriterion, Reshape, Sequential
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    _write(tmp_path, n=16, n_shards=2, shape=(6,))
+    ds = SeqFileDataSet(str(tmp_path)) >> SampleToMiniBatch(8)
+    model = Sequential().add(Linear(6, 1))
+
+    class _ToFloat(MSECriterion):
+        def apply(self, input, target):
+            import jax.numpy as jnp
+
+            return super().apply(jnp.ravel(input), jnp.asarray(target,
+                                                               jnp.float32))
+
+    opt = Optimizer(model=model, dataset=ds, criterion=_ToFloat())
+    opt.set_optim_method(SGD(learning_rate=0.01))
+    opt.set_end_when(Trigger.max_iteration(3))
+    trained = opt.optimize()
+    ws, _ = trained.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
