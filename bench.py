@@ -41,9 +41,10 @@ def main() -> None:
     optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
 
     step = jax.jit(make_train_step(model, criterion, optim,
-                                   compute_dtype=jnp.bfloat16))
-    params, model_state = model.params, model.state
-    opt_state = optim.init_state(params)
+                                   compute_dtype=jnp.bfloat16),
+                   donate_argnums=(0, 1))
+    params, model_state = jax.device_put(model.params), model.state
+    opt_state = jax.device_put(optim.init_state(params))
     rng = jax.random.PRNGKey(0)
 
     x = jax.device_put(np.random.default_rng(0)

@@ -218,7 +218,9 @@ class Optimizer:
             ckptr.save(target, blob, force=True)
             return
         File.save(
-            {"params": params, "model_state": model_state, "module": self.model},
+            # same blob shape as Module.save, so Module.load() can open a
+            # checkpoint snapshot directly (reference resume semantics)
+            {"params": params, "state": model_state, "module": self.model},
             os.path.join(self.checkpoint_path, f"model{tag}"),
             over_write=True,
         )
@@ -367,7 +369,7 @@ class Optimizer:
             if snap is not None:
                 mblob, oblob = snap
                 params = self._host_params_to_device(mblob["params"])
-                model_state = mblob["model_state"]
+                model_state = mblob.get("state", mblob.get("model_state"))
                 opt_state = oblob["opt_state"]
                 state["epoch"] = oblob["epoch"]
                 state["neval"] = oblob["neval"]
@@ -474,12 +476,22 @@ class LocalOptimizer(Optimizer):
 
         from bigdl_tpu.optim.train_step import resolve_dtype
 
-        params, model_state = self.model.params, self.model.state
+        import jax.numpy as jnp
+
+        # fresh device buffers: device_put would alias arrays that already
+        # live on device (the module facade's own params), and donating an
+        # aliased buffer would delete it out from under model.params
+        params = jax.tree_util.tree_map(
+            lambda a: jnp.array(a), self.model.params)
+        model_state = self.model.state
         opt_state = self.optim_method.init_state(params)
+        # donate params+opt_state: XLA updates them in place, halving their
+        # peak HBM footprint (they are rebound to the step's outputs anyway)
         step = jax.jit(
             make_train_step(self.model, self.criterion, self.optim_method,
                             self.grad_clip, loss_scale=self.loss_scale,
-                            compute_dtype=resolve_dtype(self.compute_dtype))
+                            compute_dtype=resolve_dtype(self.compute_dtype)),
+            donate_argnums=(0, 1),
         )
 
         def place_batch(batch: MiniBatch):
