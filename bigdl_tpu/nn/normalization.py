@@ -80,12 +80,23 @@ class BatchNormalization(TensorModule):
 
         axes = tuple(i for i in range(input.ndim) if i != 1)
         if training:
-            mean = jnp.mean(input, axis=axes)
-            var = jnp.var(input, axis=axes)
+            xf = input.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            if input.dtype == jnp.float32:
+                # two-pass: E[x²]−E[x]² has no accumulator headroom over
+                # fp32 data and cancels catastrophically for large means
+                var = jnp.var(xf, axis=axes)
+            else:
+                # sub-fp32 inputs: the fused single-pass form lets XLA fold
+                # both reductions into ONE read of the activations, and the
+                # fp32 accumulator has headroom over bf16/f16 data
+                var = jnp.maximum(
+                    jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
             n = 1
             for i in axes:
                 n *= input.shape[i]
             unbiased = var * (n / max(n - 1, 1))
+            # running stats stay fp32 end to end
             new_state = {
                 "running_mean": (1 - self.momentum) * state["running_mean"]
                 + self.momentum * mean,
@@ -97,6 +108,11 @@ class BatchNormalization(TensorModule):
             var = state["running_var"]
             new_state = state
         inv = 1.0 / jnp.sqrt(var + self.eps)
+        # only the per-channel factors downcast; the elementwise math stays
+        # in the input dtype (upcasting whole activations would double HBM
+        # traffic and erase the mixed-precision win)
+        mean = mean.astype(input.dtype)
+        inv = inv.astype(input.dtype)
         out = (input - self._broadcast(mean, input.ndim)) * self._broadcast(
             inv, input.ndim
         )

@@ -275,3 +275,22 @@ def test_spatial_full_convolution_vs_torch(rng):
         assert_close(gin, t_gin, atol=1e-4)
         assert_close(np.asarray(layer.grad_params["weight"]), t_grads["weight"],
                      atol=1e-3)
+
+
+def test_batchnorm_large_mean_fp32_accuracy(rng):
+    """fp32 inputs with huge mean must not catastrophically cancel
+    (regression: single-pass E[x2]-E[x]2 variance)."""
+    import numpy as np
+
+    from bigdl_tpu.nn import BatchNormalization
+
+    bn = BatchNormalization(4)
+    bn._ensure_params()
+    bn.training()
+    x = (rng.randn(64, 4) + 10000.0).astype(np.float32)
+    out = np.asarray(bn.forward(x))
+    assert abs(out.std() - 1.0) < 0.1, f"BN output std {out.std()}"
+    # running_var blends init 1.0 with the true var 1.0; catastrophic
+    # cancellation would instead blend toward 0
+    rv = float(np.asarray(bn.state["running_var"]).mean())
+    assert 0.95 < rv < 1.05, f"running_var {rv}"
