@@ -34,6 +34,16 @@ def _unwrap(x: Any):
     return x.data if isinstance(x, Tensor) else x
 
 
+def _resolve_dim(dim: int, ndim: int) -> int:
+    """1-based positive dims; negative dims count from the end (numpy
+    style); 0 is invalid in the 1-based convention."""
+    if dim > 0:
+        return dim - 1
+    if dim < 0 and -dim <= ndim:
+        return ndim + dim
+    raise ValueError(f"invalid 1-based dim {dim} for ndim {ndim}")
+
+
 class Tensor:
     """Dense tensor facade. ``Tensor(np_or_jax_array)`` or ``Tensor(*sizes)``."""
 
@@ -398,6 +408,260 @@ class Tensor:
 
     def __getitem__(self, item):
         return Tensor(self.data[item])
+
+    # -- batched linear algebra -------------------------------------------
+
+    def bmm(self, other: "Tensor") -> "Tensor":
+        """Batched matmul (reference ``baddbmm`` family's core)."""
+        import jax.numpy as jnp
+
+        return Tensor(jnp.matmul(self.data, _unwrap(other)))
+
+    def baddbmm(self, beta: float, alpha: float, a, b) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = beta * self.data + alpha * jnp.matmul(
+            _unwrap(a), _unwrap(b))
+        return self
+
+    # -- selection / indexing ---------------------------------------------
+
+    def index_select(self, dim: int, index) -> "Tensor":
+        """1-based dim; 1-based indices (reference ``indexSelect``)."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(_unwrap(index)).astype(jnp.int32) - 1
+        return Tensor(jnp.take(self.data, idx, axis=dim - 1))
+
+    def gather(self, dim: int, index) -> "Tensor":
+        """1-based dim; 1-based index tensor of the output shape."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(_unwrap(index)).astype(jnp.int32) - 1
+        return Tensor(jnp.take_along_axis(self.data, idx, axis=dim - 1))
+
+    def scatter(self, dim: int, index, src) -> "Tensor":
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(_unwrap(index)).astype(jnp.int32) - 1
+        ax = dim - 1
+        # build open meshgrid index tuple with idx substituted on ax
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                             indexing="ij")
+        loc = tuple(idx if i == ax else g for i, g in enumerate(grids))
+        return Tensor(self.data.at[loc].set(_unwrap(src)))
+
+    def masked_fill(self, mask, value: float) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.where(jnp.asarray(_unwrap(mask), bool),
+                              value, self.data)
+        return self
+
+    def masked_select(self, mask):
+        """Host-eager: returns the selected elements as a 1-D numpy array
+        (dynamic shape — facade level only, never inside jit)."""
+        m = np.asarray(_unwrap(mask)).astype(bool)
+        return np.asarray(self.data)[m]
+
+    def top_k(self, k: int, dim: int = -1, increase: bool = False):
+        """(values, 1-based indices); ``increase=False`` = largest first
+        (reference ``topk``)."""
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        data = self.data if not increase else -self.data
+        idx = jnp.argsort(-data, axis=ax)
+        idx = jnp.take(idx, jnp.arange(k), axis=ax)
+        vals = jnp.take_along_axis(self.data, idx, axis=ax)
+        return Tensor(vals), Tensor(idx + 1)
+
+    def sort(self, dim: int = -1, descending: bool = False):
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        idx = jnp.argsort(-self.data if descending else self.data, axis=ax)
+        return (Tensor(jnp.take_along_axis(self.data, idx, axis=ax)),
+                Tensor(idx + 1))
+
+    # -- shape manipulation -----------------------------------------------
+
+    def expand(self, *sizes) -> "Tensor":
+        import jax.numpy as jnp
+
+        sizes = sizes[0] if len(sizes) == 1 and isinstance(
+            sizes[0], (list, tuple)) else sizes
+        return Tensor(jnp.broadcast_to(self.data, tuple(int(s) for s in sizes)))
+
+    def expand_as(self, other: "Tensor") -> "Tensor":
+        return self.expand(*_unwrap(other).shape)
+
+    def repeat_tensor(self, *reps) -> "Tensor":
+        """Tile (reference ``repeatTensor``)."""
+        import jax.numpy as jnp
+
+        reps = reps[0] if len(reps) == 1 and isinstance(
+            reps[0], (list, tuple)) else reps
+        return Tensor(jnp.tile(self.data, tuple(int(r) for r in reps)))
+
+    def split(self, size: int, dim: int = 1):
+        """List of chunks of ``size`` along 1-based ``dim`` (reference
+        ``split``); last chunk may be smaller."""
+        import jax.lax as lax
+
+        ax = dim - 1
+        n = self.data.shape[ax]
+        return [
+            Tensor(lax.slice_in_dim(self.data, i, min(i + size, n), axis=ax))
+            for i in range(0, n, size)
+        ]
+
+    def chunk(self, n_chunks: int, dim: int = 1):
+        import math
+
+        size = math.ceil(self.data.shape[dim - 1] / n_chunks)
+        return self.split(size, dim)
+
+    @staticmethod
+    def cat(tensors, dim: int = 1) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.concatenate([_unwrap(t) for t in tensors],
+                                      axis=dim - 1))
+
+    # -- elementwise extras -----------------------------------------------
+
+    def cmax(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.maximum(self.data, _unwrap(other))
+        return self
+
+    def cmin(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.minimum(self.data, _unwrap(other))
+        return self
+
+    def sign(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.sign(self.data)
+        return self
+
+    def floor(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.floor(self.data)
+        return self
+
+    def ceil(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.ceil(self.data)
+        return self
+
+    def round(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.round(self.data)
+        return self
+
+    def tanh(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.tanh(self.data)
+        return self
+
+    def sigmoid(self) -> "Tensor":
+        import jax
+
+        self.data = jax.nn.sigmoid(self.data)
+        return self
+
+    def addcmul(self, scale: float, a, b) -> "Tensor":
+        self.data = self.data + scale * _unwrap(a) * _unwrap(b)
+        return self
+
+    def addcdiv(self, scale: float, a, b) -> "Tensor":
+        self.data = self.data + scale * _unwrap(a) / _unwrap(b)
+        return self
+
+    # -- reductions / scans -----------------------------------------------
+
+    def prod(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.prod(self.data))
+        return Tensor(jnp.prod(self.data, axis=dim - 1))
+
+    def std(self, dim: Optional[int] = None, unbiased: bool = True):
+        import jax.numpy as jnp
+
+        dd = 1 if unbiased else 0
+        if dim is None:
+            return float(jnp.std(self.data, ddof=dd))
+        return Tensor(jnp.std(self.data, axis=dim - 1, ddof=dd))
+
+    def var(self, dim: Optional[int] = None, unbiased: bool = True):
+        import jax.numpy as jnp
+
+        dd = 1 if unbiased else 0
+        if dim is None:
+            return float(jnp.var(self.data, ddof=dd))
+        return Tensor(jnp.var(self.data, axis=dim - 1, ddof=dd))
+
+    def cumsum(self, dim: int = 1) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.cumsum(self.data, axis=dim - 1))
+
+    # -- comparisons (reference ge/gt/le/lt/eq return 0/1 tensors) --------
+
+    def ge(self, other) -> "Tensor":
+        return Tensor((self.data >= _unwrap(other)).astype(self.data.dtype))
+
+    def gt(self, other) -> "Tensor":
+        return Tensor((self.data > _unwrap(other)).astype(self.data.dtype))
+
+    def le(self, other) -> "Tensor":
+        return Tensor((self.data <= _unwrap(other)).astype(self.data.dtype))
+
+    def lt(self, other) -> "Tensor":
+        return Tensor((self.data < _unwrap(other)).astype(self.data.dtype))
+
+    def eq(self, other) -> "Tensor":
+        return Tensor((self.data == _unwrap(other)).astype(self.data.dtype))
+
+    # -- random fills (reference uniform/normal/bernoulli) ----------------
+
+    def uniform(self, lower: float = 0.0, upper: float = 1.0) -> "Tensor":
+        import jax
+
+        from bigdl_tpu.utils.random_gen import RNG
+
+        self.data = jax.random.uniform(
+            RNG.next_key(), self.data.shape, self.data.dtype, lower, upper)
+        return self
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0) -> "Tensor":
+        import jax
+
+        from bigdl_tpu.utils.random_gen import RNG
+
+        self.data = mean + stdv * jax.random.normal(
+            RNG.next_key(), self.data.shape, self.data.dtype)
+        return self
+
+    def bernoulli(self, p: float = 0.5) -> "Tensor":
+        import jax
+
+        from bigdl_tpu.utils.random_gen import RNG
+
+        self.data = jax.random.bernoulli(
+            RNG.next_key(), p, self.data.shape).astype(self.data.dtype)
+        return self
 
     def __repr__(self) -> str:
         return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"

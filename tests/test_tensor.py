@@ -77,3 +77,97 @@ def test_virtual_device_count():
     import jax
 
     assert jax.device_count() == 8, "tests must see 8 virtual CPU devices"
+
+
+def test_tensor_batched_and_indexing_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    a = rng.randn(3, 4, 5).astype(np.float32)
+    b = rng.randn(3, 5, 2).astype(np.float32)
+    assert_close(np.asarray(Tensor(a).bmm(Tensor(b))),
+                 torch.bmm(torch.from_numpy(a), torch.from_numpy(b)).numpy(),
+                 atol=1e-5)
+
+    x = rng.randn(4, 6).astype(np.float32)
+    idx = np.array([3, 1], np.float32)
+    assert_close(np.asarray(Tensor(x).index_select(2, idx)), x[:, [2, 0]])
+
+    gi = np.array([[1, 3], [2, 4], [1, 1], [6, 5]], np.float32)
+    got = np.asarray(Tensor(x).gather(2, gi))
+    want = torch.gather(torch.from_numpy(x), 1,
+                        torch.from_numpy(gi).long() - 1).numpy()
+    assert_close(got, want)
+
+
+def test_tensor_topk_sort_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    x = rng.randn(3, 8).astype(np.float32)
+    vals, idx = Tensor(x).top_k(3)
+    tv, ti = torch.topk(torch.from_numpy(x), 3, dim=1)
+    assert_close(np.asarray(vals), tv.numpy(), atol=1e-6)
+    assert_close(np.asarray(idx), ti.numpy() + 1)
+
+    sv, si = Tensor(x).sort(2, descending=True)
+    tv2, ti2 = torch.sort(torch.from_numpy(x), dim=1, descending=True)
+    assert_close(np.asarray(sv), tv2.numpy(), atol=1e-6)
+
+
+def test_tensor_shape_utils(rng):
+    from bigdl_tpu.tensor import Tensor
+
+    x = rng.randn(2, 3).astype(np.float32)
+    assert np.asarray(Tensor(x[0:1]).expand(4, 3)).shape == (4, 3)
+    assert np.asarray(Tensor(x).repeat_tensor(2, 2)).shape == (4, 6)
+    chunks = Tensor(x).split(2, dim=2)
+    assert len(chunks) == 2
+    assert np.asarray(chunks[1]).shape == (2, 1)
+    cat = Tensor.cat([Tensor(x), Tensor(x)], dim=1)
+    assert np.asarray(cat).shape == (4, 3)
+
+
+def test_tensor_elementwise_extras_vs_numpy(rng):
+    from bigdl_tpu.tensor import Tensor
+
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    assert_close(np.asarray(Tensor(x).cmax(Tensor(y))), np.maximum(x, y))
+    assert_close(np.asarray(Tensor(x).sign()), np.sign(x))
+    assert_close(np.asarray(Tensor(x).addcmul(0.5, Tensor(y), Tensor(y))),
+                 x + 0.5 * y * y, atol=1e-6)
+    assert_close(np.asarray(Tensor(x).ge(0.0)), (x >= 0).astype(np.float32))
+    assert abs(Tensor(x).std() - x.std(ddof=1)) < 1e-5
+    assert_close(np.asarray(Tensor(x).cumsum(2)), np.cumsum(x, 1), atol=1e-5)
+
+    m = (x > 0)
+    assert_close(np.asarray(Tensor(x).masked_fill(m, 0.0)),
+                 np.where(m, 0.0, x))
+    assert_close(Tensor(x).masked_select(m), x[m])
+
+    sc = np.asarray(Tensor(x).scatter(
+        2, np.ones((3, 1), np.float32), np.full((3, 1), 9.0, np.float32)))
+    want = x.copy()
+    want[:, 0] = 9.0
+    assert_close(sc, want)
+
+
+def test_tensor_random_fills():
+    from bigdl_tpu.tensor import Tensor
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(5)
+    t = Tensor(500, 4)
+    t.uniform(2.0, 3.0)
+    arr = np.asarray(t)
+    assert arr.min() >= 2.0 and arr.max() <= 3.0
+    t.normal(1.0, 0.5)
+    arr = np.asarray(t)
+    assert abs(arr.mean() - 1.0) < 0.1 and abs(arr.std() - 0.5) < 0.1
+    t.bernoulli(0.3)
+    arr = np.asarray(t)
+    assert set(np.unique(arr)) <= {0.0, 1.0}
+    assert abs(arr.mean() - 0.3) < 0.1
