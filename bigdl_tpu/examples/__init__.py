@@ -1,0 +1,1 @@
+"""Example programs (reference ``.../bigdl/example/*`` — SURVEY.md §2.8)."""

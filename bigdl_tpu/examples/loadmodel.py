@@ -1,0 +1,66 @@
+"""Load-model example: run inference with a model from ANY supported format.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``example/loadmodel`` — loads a
+BigDL / Caffe / TensorFlow model and evaluates it.
+
+    python -m bigdl_tpu.examples.loadmodel --modelType bigdl --model m.bigdl
+    python -m bigdl_tpu.examples.loadmodel --modelType caffe \
+        --caffeDefPath deploy.prototxt --model weights.caffemodel
+    python -m bigdl_tpu.examples.loadmodel --modelType tf \
+        --model frozen.pb --tfInputs x --tfOutputs prob
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def load_any(args):
+    if args.modelType == "bigdl":
+        import zipfile
+
+        from bigdl_tpu.nn.module import AbstractModule
+
+        # structured snapshots are zips; legacy Module.save blobs are pickle
+        if zipfile.is_zipfile(args.model):
+            return AbstractModule.load_module(args.model)
+        return AbstractModule.load(args.model)
+    if args.modelType == "caffe":
+        from bigdl_tpu.utils.caffe_loader import CaffeLoader
+
+        return CaffeLoader.load(args.caffeDefPath, args.model)
+    if args.modelType == "tf":
+        from bigdl_tpu.utils.tf_loader import TensorflowLoader
+
+        return TensorflowLoader.load(
+            args.model, args.tfInputs.split(","), args.tfOutputs.split(","))
+    raise ValueError(f"unknown modelType {args.modelType}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="load + predict with any model")
+    p.add_argument("--modelType", required=True,
+                   choices=["bigdl", "caffe", "tf"])
+    p.add_argument("--model", required=True)
+    p.add_argument("--caffeDefPath", default=None)
+    p.add_argument("--tfInputs", default="input")
+    p.add_argument("--tfOutputs", default="output")
+    p.add_argument("--inputShape", default="3,224,224",
+                   help="comma-separated, batch excluded")
+    p.add_argument("-b", "--batchSize", type=int, default=4)
+    args = p.parse_args(argv)
+
+    model = load_any(args)
+    shape = tuple(int(s) for s in args.inputShape.split(","))
+    x = np.random.rand(args.batchSize, *shape).astype(np.float32)
+    out = model.evaluate().predict(x, batch_size=args.batchSize)
+    out = np.asarray(out)
+    print(f"model loaded: {type(model).__name__}; output shape {out.shape}; "
+          f"top-1 ids {out.reshape(out.shape[0], -1).argmax(-1) + 1}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""UDF-predictor example: wrap a trained text classifier as a reusable
+predict function applied over a stream of documents.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``example/udfpredictor`` — registers a
+BigDL model as a Spark SQL UDF and applies it to a DataFrame of texts. The
+Spark-SQL surface becomes a plain Python callable (the TPU-era "UDF"):
+``make_udf(model, dictionary, seq_len)`` returns ``predict(texts) -> labels``
+backed by ONE compiled eval step.
+
+    python -m bigdl_tpu.examples.udfpredictor          # self-contained demo
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+
+def make_udf(model, dictionary, seq_len: int) -> Callable:
+    """Build the predict-UDF: tokenizes, pads, batches, argmaxes."""
+    from bigdl_tpu.dataset.text import simple_tokenize
+    from bigdl_tpu.optim.evaluator import Predictor
+
+    predictor = Predictor(model.evaluate())
+
+    def predict(texts: Sequence[str]) -> List[int]:
+        rows = []
+        for t in texts:
+            ids = [dictionary.get_index(w) + 1 for w in simple_tokenize(t)]
+            ids = (ids[:seq_len] + [1] * (seq_len - len(ids)))[:seq_len]
+            rows.append(np.asarray(ids, np.float32))
+        scores = np.asarray(predictor.predict(np.stack(rows),
+                                              batch_size=len(rows)))
+        return list(scores.argmax(-1) + 1)
+
+    return predict
+
+
+def main(argv=None):
+    """Self-contained demo: train a tiny classifier, serve it as a UDF."""
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.text import Dictionary, simple_tokenize
+    from bigdl_tpu.models.textclassifier import TextClassifier
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Adagrad, Optimizer, Trigger
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(7)
+
+    corpus = {
+        1: ["the market rallied as stocks rose", "shares gained on earnings",
+            "the index closed higher on trade news"] * 4,
+        2: ["the team won the final game", "a late goal sealed the match",
+            "the players celebrated the championship"] * 4,
+    }
+    docs = [(t, c) for c, ts in corpus.items() for t in ts]
+    d = Dictionary([simple_tokenize(t) for t, _ in docs])
+    seq_len = 8
+    samples = []
+    for t, c in docs:
+        ids = [d.get_index(w) + 1 for w in simple_tokenize(t)]
+        ids = (ids[:seq_len] + [1] * (seq_len - len(ids)))[:seq_len]
+        samples.append(Sample(np.asarray(ids, np.float32), np.int32(c)))
+
+    model = TextClassifier(2, embedding_dim=16, vocab_size=d.vocab_size(),
+                           embedding_input=False)
+    opt = Optimizer(model=model, dataset=samples,
+                    criterion=ClassNLLCriterion(), batch_size=8)
+    opt.set_optim_method(Adagrad(learning_rate=0.3))
+    opt.set_end_when(Trigger.max_epoch(40))
+    opt.optimize()
+
+    predict = make_udf(model, d, seq_len)
+    queries = ["stocks rose sharply on market gains",
+               "a late goal sealed the championship for the players"]
+    labels = predict(queries)
+    for q, l in zip(queries, labels):
+        print(f"[class {l}] {q}")
+    return labels
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@
 from bigdl_tpu.nn.module import AbstractModule, TensorModule, Identity, Echo
 from bigdl_tpu.nn.containers import (
     Container, Sequential, Concat, ConcatTable, ParallelTable, MapTable, Bottle,
+    Remat,
 )
 from bigdl_tpu.nn.graph import Graph, StaticGraph, Input, ModuleNode
 from bigdl_tpu.nn.linear import Linear

@@ -190,3 +190,32 @@ class Bottle(Container):
         )
         out = out.reshape(lead + out.shape[1:])
         return out, {k: s}
+
+
+class Remat(Container):
+    """Gradient checkpointing wrapper: the child's activations are NOT kept
+    for the backward pass — they are recomputed (``jax.checkpoint``),
+    trading FLOPs for HBM. No reference counterpart (the reference never
+    ran out of accelerator memory); on TPU this is the standard lever for
+    long-context / deep models (SURVEY.md hardware notes).
+
+    Usage: ``Sequential().add(Remat(block1)).add(Remat(block2))``.
+    """
+
+    def __init__(self, module: AbstractModule) -> None:
+        super().__init__()
+        self.add(module)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        state = state or {}
+        k = self._child_key(0)
+        child = self.modules[0]
+
+        def inner(p, x):
+            return child.apply(p, x, state.get(k, {}),
+                               training=training, rng=rng)
+
+        out, s = jax.checkpoint(inner)(params.get(k, {}), input)
+        return out, {k: s}

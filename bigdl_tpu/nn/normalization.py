@@ -80,16 +80,18 @@ class BatchNormalization(TensorModule):
 
         axes = tuple(i for i in range(input.ndim) if i != 1)
         if training:
-            xf = input.astype(jnp.float32)
+            # accumulate in at least fp32, preserving fp64 when x64 is on
+            acc_dtype = jnp.promote_types(input.dtype, jnp.float32)
+            xf = input.astype(acc_dtype)
             mean = jnp.mean(xf, axis=axes)
-            if input.dtype == jnp.float32:
-                # two-pass: E[x²]−E[x]² has no accumulator headroom over
-                # fp32 data and cancels catastrophically for large means
+            if jnp.finfo(input.dtype).bits >= jnp.finfo(acc_dtype).bits:
+                # no accumulator headroom over the data: the fused form
+                # E[x²]−E[x]² would cancel catastrophically for large means
                 var = jnp.var(xf, axis=axes)
             else:
                 # sub-fp32 inputs: the fused single-pass form lets XLA fold
                 # both reductions into ONE read of the activations, and the
-                # fp32 accumulator has headroom over bf16/f16 data
+                # wider accumulator has headroom over bf16/f16 data
                 var = jnp.maximum(
                     jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
             n = 1

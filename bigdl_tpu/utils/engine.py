@@ -55,6 +55,7 @@ class _EngineSingleton:
 
     def __init__(self) -> None:
         self._initialized = False
+        self._distributed_initialized = False
         self._node_number = 1
         self._core_number = 1
         self._engine_type = EngineType.TPU
@@ -107,6 +108,35 @@ class _EngineSingleton:
             self._seed = int(seed)
         self._initialized = True
         return self
+
+    def init_distributed(self, coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None,
+                         **init_kw) -> "_EngineSingleton":
+        """Multi-host pod initialization: start the JAX distributed runtime
+        (one process per host, ICI within a slice / DCN across) and then run
+        the normal :meth:`init` topology validation.
+
+        The reference analog is ``Engine.createSparkConf`` + ``Engine.init``
+        forcing full executor registration before training
+        (``minRegisteredResourcesRatio=1.0``) — ``jax.distributed.initialize``
+        blocks until every process joins, giving the same guarantee.
+        Parameters default to TPU auto-detection (env-provided) when None.
+        """
+        import jax
+
+        if self._distributed_initialized:  # idempotent like init()
+            return self.init()
+        kw = dict(init_kw)
+        if coordinator_address is not None:
+            kw["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kw["num_processes"] = num_processes
+        if process_id is not None:
+            kw["process_id"] = process_id
+        jax.distributed.initialize(**kw)
+        self._distributed_initialized = True
+        return self.init()
 
     def _ensure_init(self) -> None:
         if not self._initialized:

@@ -132,3 +132,49 @@ def test_jit_whole_model(rng):
     out1 = f(model.params, x)
     out2 = model.forward(x)
     assert_close(np.asarray(out1), np.asarray(out2), atol=1e-6)
+
+
+def test_remat_matches_plain(rng):
+    """Remat: identical forward/backward, activations recomputed."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.nn import Linear, Remat, Sequential, Tanh
+    from tests.oracle import assert_close
+
+    inner = Sequential().add(Linear(6, 12)).add(Tanh()).add(Linear(12, 6))
+    plain = Sequential().add(inner)
+    plain._ensure_params()
+    x = rng.randn(4, 6).astype(np.float32)
+
+    rm = Remat(inner)
+    rem = Sequential().add(rm)
+    rem.params = {rem._child_key(0): {
+        rm._child_key(0): plain.params[plain._child_key(0)]}}
+    rem.state = {rem._child_key(0): {rm._child_key(0): {}}}
+    rem._ensure_params()
+
+    assert_close(np.asarray(plain.forward(x)), np.asarray(rem.forward(x)),
+                 atol=1e-6)
+
+    def loss(m, p, xx):
+        out, _ = m.apply(p, xx, m.state)
+        return (out ** 2).sum()
+
+    g1 = jax.grad(lambda p: loss(plain, p, x))(plain.params)
+    g2 = jax.grad(lambda p: loss(rem, p, x))(rem.params)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        assert_close(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_gradient_checker_utility(rng):
+    import numpy as np
+
+    from bigdl_tpu.nn import Linear, Sequential, Tanh
+    from bigdl_tpu.utils.gradient_checker import GradientChecker
+
+    m = Sequential().add(Linear(5, 8)).add(Tanh())
+    m._ensure_params()
+    x = rng.randn(3, 5).astype(np.float32)
+    assert GradientChecker(perturbation=1e-2, precision=2e-2).check_layer(m, x)

@@ -1,0 +1,23 @@
+"""Example programs (SURVEY.md §2.8 example/* rows)."""
+
+import numpy as np
+
+
+def test_loadmodel_bigdl_roundtrip(tmp_path, rng):
+    from bigdl_tpu.examples import loadmodel
+    from bigdl_tpu.nn import Linear, Sequential, SoftMax
+
+    m = Sequential().add(Linear(6, 3)).add(SoftMax())
+    m._ensure_params()
+    path = str(tmp_path / "m.bigdl")
+    m.save_module(path)
+    loaded = loadmodel.main(["--modelType", "bigdl", "--model", path,
+                             "--inputShape", "6", "-b", "2"])
+    assert type(loaded).__name__ == "Sequential"
+
+
+def test_udfpredictor_end_to_end():
+    from bigdl_tpu.examples import udfpredictor
+
+    labels = udfpredictor.main([])
+    assert labels == [1, 2], f"udf misclassified: {labels}"

@@ -151,6 +151,7 @@ FACTORIES = {
     "QuantizedSpatialConvolution": (_quantized_conv, x(2, 3, 5, 5)),
     "SparseLinear": (lambda: nn.SparseLinear(4, 3), _sparse_input()),
     "SparseJoinTable": (lambda: nn.SparseJoinTable(2), None),
+    "Remat": (lambda: nn.Remat(nn.Linear(4, 3)), x(2, 4)),
     "Recurrent": (_recurrent, x(2, 5, 3)),
     "RecurrentDecoder": (lambda: nn.RecurrentDecoder(4).add(nn.RnnCell(3, 3)), x(2, 3)),
     "Reshape": (lambda: nn.Reshape([6]), x(2, 2, 3)),
