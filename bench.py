@@ -66,7 +66,9 @@ def main() -> None:
     for _ in range(iters):
         params, opt_state, model_state, loss = step(
             params, opt_state, model_state, rng, x, y)
-    jax.block_until_ready(loss)
+    # host readback: on some PJRT transports block_until_ready alone
+    # resolves before the device work drains; float() cannot
+    float(loss)
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * iters / dt

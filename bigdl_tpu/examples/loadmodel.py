@@ -30,6 +30,10 @@ def load_any(args):
     if args.modelType == "caffe":
         from bigdl_tpu.utils.caffe_loader import CaffeLoader
 
+        if not args.caffeDefPath:
+            raise SystemExit(
+                "--caffeDefPath (deploy prototxt) is required with "
+                "--modelType caffe")
         return CaffeLoader.load(args.caffeDefPath, args.model)
     if args.modelType == "tf":
         from bigdl_tpu.utils.tf_loader import TensorflowLoader

@@ -28,7 +28,7 @@ def make_udf(model, dictionary, seq_len: int) -> Callable:
         rows = []
         for t in texts:
             ids = [dictionary.get_index(w) + 1 for w in simple_tokenize(t)]
-            ids = (ids[:seq_len] + [1] * (seq_len - len(ids)))[:seq_len]
+            ids = (ids[:seq_len] + [0] * (seq_len - len(ids)))[:seq_len]
             rows.append(np.asarray(ids, np.float32))
         scores = np.asarray(predictor.predict(np.stack(rows),
                                               batch_size=len(rows)))
@@ -60,7 +60,7 @@ def main(argv=None):
     samples = []
     for t, c in docs:
         ids = [d.get_index(w) + 1 for w in simple_tokenize(t)]
-        ids = (ids[:seq_len] + [1] * (seq_len - len(ids)))[:seq_len]
+        ids = (ids[:seq_len] + [0] * (seq_len - len(ids)))[:seq_len]
         samples.append(Sample(np.asarray(ids, np.float32), np.int32(c)))
 
     model = TextClassifier(2, embedding_dim=16, vocab_size=d.vocab_size(),

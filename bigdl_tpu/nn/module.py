@@ -319,6 +319,28 @@ class AbstractModule:
             if was_training:
                 self.training()
 
+    def to_ir(self, input_shape, dtype=None, training: bool = False):
+        """Lower this module to its jaxpr IR for the given input shape.
+
+        The reference converted module graphs to an intermediate
+        representation once per engine (``utils/intermediate/IRGraph`` →
+        ``DnnGraph`` under ``EngineType.MklDnn``); here the analogous
+        lowering is Module graph → jaxpr → XLA HLO, and this inspector
+        returns the traced jaxpr (str() it for a readable dump).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        self._materialize_params()
+        x = jax.ShapeDtypeStruct(tuple(input_shape), dtype or jnp.float32)
+
+        def fn(p, xx):
+            out, _ = self.apply(p, xx, self.state, training=training,
+                                rng=None)
+            return out
+
+        return jax.make_jaxpr(fn)(self.params, x)
+
     def quantize(self) -> "AbstractModule":
         """int8-quantize this trained model for inference (reference
         ``module.quantize()`` → ``nn/quantized`` path)."""

@@ -5,13 +5,14 @@ from bigdl_tpu.optim.optim_method import (
 )
 from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
-from bigdl_tpu.optim.evaluator import Evaluator, LocalPredictor, Predictor
+from bigdl_tpu.optim.evaluator import Evaluator, LocalPredictor, Predictor, Validator
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
     ValidationMethod, ValidationResult,
 )
 from bigdl_tpu.optim.lbfgs import LBFGS, strong_wolfe
+from bigdl_tpu.optim.optim_method import LarsSGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer
 
@@ -20,9 +21,9 @@ __all__ = [
     "LearningRateSchedule", "MultiStep", "OptimMethod", "Plateau", "Poly",
     "RMSprop", "SequentialSchedule", "SGD", "Step", "Warmup",
     "LocalOptimizer", "Optimizer", "DistriOptimizer", "Trigger",
-    "Evaluator", "LocalPredictor", "Predictor",
+    "Evaluator", "LocalPredictor", "Predictor", "Validator",
     "AccuracyResult", "Loss", "LossResult", "MAE", "Top1Accuracy",
     "Top5Accuracy", "ValidationMethod", "ValidationResult",
-    "LBFGS", "strong_wolfe",
+    "LBFGS", "strong_wolfe", "LarsSGD",
     "Metrics", "L1L2Regularizer", "L1Regularizer", "L2Regularizer",
 ]

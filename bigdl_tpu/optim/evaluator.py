@@ -131,3 +131,4 @@ class Predictor:
 
 
 LocalPredictor = Predictor  # single-process alias (reference LocalPredictor)
+Validator = Evaluator  # reference alias: Validator drives ValidationMethods

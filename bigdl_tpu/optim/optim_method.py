@@ -525,3 +525,58 @@ class Ftrl(OptimMethod):
             "accum": accum,
             "linear": linear,
         }
+
+
+class LarsSGD(SGD):
+    """Layer-wise Adaptive Rate Scaling SGD.
+
+    Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/optim/LarsSGD.scala``
+    (set up inside ``DistriOptimizer.optimize()`` for large-batch training,
+    SURVEY.md §3.1). Per-parameter-tensor trust ratio
+    ``trust · ||w|| / (||g|| + wd·||w||)`` rescales the learning rate, then
+    momentum applies as in SGD — the standard LARS formulation.
+    """
+
+    def __init__(self, learning_rate: float = 1e-2, momentum: float = 0.9,
+                 weight_decay: float = 0.0, trust: float = 0.001,
+                 epsilon: float = 1e-9,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None) -> None:
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         weight_decay=weight_decay,
+                         learning_rate_schedule=learning_rate_schedule)
+        self.trust = trust
+        self.epsilon = epsilon
+
+    def update(self, grads, state, params):
+        import jax.numpy as jnp
+
+        clr = self.learning_rate_schedule.lr(self.learning_rate, state["neval"])
+
+        def local_lr(p, g):
+            # trust ratio from the RAW gradient norm (decay enters the
+            # denominator exactly once, per the LARS formulation)
+            wn = jnp.linalg.norm(jnp.ravel(p))
+            gn = jnp.linalg.norm(jnp.ravel(g))
+            ratio = self.trust * wn / (gn + self.weight_decay * wn
+                                       + self.epsilon)
+            # scalar-ish leaves (norm 0) fall back to the global rate
+            return jnp.where(wn > 0, ratio, 1.0)
+
+        ratios = _tree_map(lambda p, g: local_lr(p, g), params, grads)
+        if self.weight_decay > 0:
+            grads = _tree_map(lambda g, p: g + self.weight_decay * p,
+                              grads, params)
+        scaled = _tree_map(lambda r, g: r * g, ratios, grads)
+        new_state = dict(state)
+        if self.momentum > 0:
+            vel = _tree_map(
+                lambda v, g: self.momentum * v + clr * g,
+                state["velocity"], scaled,
+            )
+            new_state["velocity"] = vel
+            step = vel
+        else:
+            step = _tree_map(lambda g: clr * g, scaled)
+        new_params = _tree_map(lambda p, s: p - s, params, step)
+        new_state["neval"] = state["neval"] + 1
+        return new_params, new_state

@@ -127,6 +127,11 @@ class _EngineSingleton:
 
         if self._distributed_initialized:  # idempotent like init()
             return self.init()
+        if self._initialized:
+            raise RuntimeError(
+                "Engine.init_distributed() must run BEFORE Engine.init() or "
+                "any model/JAX work — jax.distributed.initialize cannot run "
+                "once the XLA backend is up. Call it first in your main.")
         kw = dict(init_kw)
         if coordinator_address is not None:
             kw["coordinator_address"] = coordinator_address

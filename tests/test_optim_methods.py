@@ -166,3 +166,38 @@ def test_optim_method_save_load(tmp_path):
     assert isinstance(loaded, SGD)
     assert loaded.state["neval"] == 42
     assert loaded.momentum == 0.9
+
+
+def test_lars_sgd_trains_and_scales(rng):
+    """LARS: loss decreases; trust ratio rescales per-tensor steps."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.optim import LarsSGD
+
+    W = rng.randn(6, 2).astype(np.float32)
+    X = rng.randn(128, 6).astype(np.float32)
+    Y = X @ W
+    params = {"w": (rng.randn(6, 2) * 0.1).astype(np.float32),
+              "b": np.zeros((2,), np.float32)}
+    opt = LarsSGD(learning_rate=1.0, momentum=0.9, trust=0.01)
+    state = opt.init_state(params)
+
+    import jax
+
+    def loss_fn(p):
+        return jnp.mean((X @ p["w"] + p["b"] - Y) ** 2)
+
+    losses = []
+    for _ in range(60):
+        l, g = jax.value_and_grad(loss_fn)(params)
+        params, state = opt.update(g, state, params)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.1, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_validator_alias():
+    from bigdl_tpu.optim import Validator
+    from bigdl_tpu.optim.evaluator import Evaluator
+
+    assert Validator is Evaluator
