@@ -333,10 +333,13 @@ class AbstractModule:
 
         self._materialize_params()
         x = jax.ShapeDtypeStruct(tuple(input_shape), dtype or jnp.float32)
+        # training mode traces with a key so rng-dependent layers (Dropout)
+        # appear in the IR instead of silently no-op'ing
+        rng = jax.random.PRNGKey(0) if training else None
 
         def fn(p, xx):
             out, _ = self.apply(p, xx, self.state, training=training,
-                                rng=None)
+                                rng=rng)
             return out
 
         return jax.make_jaxpr(fn)(self.params, x)

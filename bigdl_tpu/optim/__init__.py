@@ -1,4 +1,5 @@
 from bigdl_tpu.optim.optim_method import (
+    LarsSGD,
     Adadelta, Adagrad, Adam, Adamax, Default, Exponential, Ftrl,
     LearningRateSchedule, MultiStep, OptimMethod, Plateau, Poly, RMSprop,
     SequentialSchedule, SGD, Step, Warmup,
@@ -12,7 +13,6 @@ from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult,
 )
 from bigdl_tpu.optim.lbfgs import LBFGS, strong_wolfe
-from bigdl_tpu.optim.optim_method import LarsSGD
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.regularizer import L1L2Regularizer, L1Regularizer, L2Regularizer
 

@@ -115,4 +115,3 @@ class MAE(ValidationMethod):
         t = np.asarray(target)
         n = out.shape[0]
         return LossResult(float(np.abs(out - t).mean()) * n, n)
-

@@ -3,6 +3,7 @@
 pipeline/sequence/expert parallel extensions the reference lacks."""
 
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter, flatten_params
+from bigdl_tpu.parallel.broadcast import ModelBroadcast
 from bigdl_tpu.parallel.moe import mlp_expert, moe_layer, top_k_gating
 from bigdl_tpu.parallel.pipeline import gpipe, microbatch, stack_stage_params
 from bigdl_tpu.parallel.ring_attention import (
@@ -13,7 +14,7 @@ from bigdl_tpu.parallel.tensor_parallel import (
 )
 
 __all__ = [
-    "AllReduceParameter", "flatten_params",
+    "AllReduceParameter", "flatten_params", "ModelBroadcast",
     "attention", "ring_attention", "ulysses_attention",
     "column_parallel_linear", "row_parallel_linear", "tp_mlp", "tp_attention",
     "gpipe", "microbatch", "stack_stage_params",

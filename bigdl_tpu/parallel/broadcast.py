@@ -1,0 +1,47 @@
+"""ModelBroadcast — place a model's parameters across a mesh.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../models/utils/ModelBroadcast.scala``
+— broadcasts the model once per job with weights DETACHED
+(``getAndClearWeightBias``) so the big arrays ride the Spark broadcast
+efficiently and are re-attached per executor clone.
+
+TPU-native: the "broadcast" is a sharding decision, not a wire protocol —
+``jax.device_put`` with a replicated (or partitioned) ``NamedSharding``
+hands XLA the placement, and ICI moves the bytes once. The detach/attach
+dance disappears: params are already a separate pytree from the module
+(SURVEY.md §7 design stance). Kept as a class for reference-shaped call
+sites.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ModelBroadcast:
+    """``ModelBroadcast().broadcast(mesh, model)`` → params placed on every
+    chip (replicated), returned as the device pytree; ``value()`` retrieves
+    it (reference API shape)."""
+
+    def __init__(self) -> None:
+        self._params = None
+        self._model = None
+
+    def broadcast(self, mesh, model):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model._materialize_params()
+        sharding = NamedSharding(mesh, P())  # replicate over every mesh axis
+        self._params = jax.device_put(model.params, sharding)
+        self._model = model
+        return self
+
+    def value(self):
+        """The placed params pytree (reference ``value()`` returns the
+        executor-local model; our model is the module + these params)."""
+        assert self._params is not None, "broadcast() first"
+        return self._params
+
+    def model(self):
+        return self._model

@@ -1,0 +1,108 @@
+"""Typed runtime configuration — the unified knob surface.
+
+Reference (UNVERIFIED, SURVEY.md §0 / §5.6): the reference had THREE ad-hoc
+config tiers — ``bigdl.*`` JVM system properties, SparkConf keys injected by
+``Engine.createSparkConf``, and per-program scopt CLI parsers — with no
+unified typed config. SURVEY.md §5.6 prescribes "one typed config object
+(dataclass) + env/flag overlay, keeping the same knob names where sensible";
+this module is that object.
+
+Precedence (highest wins): explicit constructor/``replace`` values →
+``BIGDL_*`` environment variables → defaults. The reference knob names map
+1:1 (``bigdl.engineType`` → ``BIGDL_ENGINE_TYPE`` → ``engine_type``, …).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+_ENV_PREFIX = "BIGDL_"
+
+
+def _env_name(field_name: str) -> str:
+    return _ENV_PREFIX + field_name.upper()
+
+
+@dataclass
+class BigDLConfig:
+    """All runtime knobs in one place.
+
+    | field | reference knob |
+    |---|---|
+    | ``engine_type``            | ``bigdl.engineType`` |
+    | ``local_mode``             | ``bigdl.localMode`` |
+    | ``node_number``            | ``bigdl.nodeNumber`` (executors) |
+    | ``core_number``            | ``bigdl.coreNumber`` |
+    | ``check_singleton``        | ``bigdl.check.singleton`` |
+    | ``failure_retry_times``    | ``bigdl.failure.retryTimes`` |
+    | ``failure_retry_interval`` | ``bigdl.failure.retryTimeInterval`` |
+    | ``seed``                   | (RNG.setSeed) |
+    | ``compute_dtype``          | — (TPU-native mixed precision) |
+    | ``loss_scale``             | — (fp16 loss scaling) |
+    """
+
+    engine_type: str = "tpu"
+    local_mode: Optional[bool] = None
+    node_number: Optional[int] = None
+    core_number: Optional[int] = None
+    check_singleton: bool = False
+    failure_retry_times: int = 5
+    failure_retry_interval: float = 1.0
+    seed: Optional[int] = None
+    compute_dtype: Optional[str] = None
+    loss_scale: float = 1.0
+
+    @classmethod
+    def from_env(cls, **overrides) -> "BigDLConfig":
+        """Defaults ← BIGDL_* env ← explicit overrides."""
+        kw = {}
+        for f in dataclasses.fields(cls):
+            env = os.environ.get(_env_name(f.name))
+            if env is None:
+                continue
+            kw[f.name] = _parse(env, f.type)
+        kw.update({k: v for k, v in overrides.items() if v is not None})
+        return cls(**kw)
+
+    def replace(self, **kw) -> "BigDLConfig":
+        return dataclasses.replace(self, **kw)
+
+    # -- appliers ----------------------------------------------------------
+
+    def apply_engine(self):
+        """Push topology/engine knobs into the Engine singleton."""
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.init(node_number=self.node_number,
+                    core_number=self.core_number,
+                    engine_type=self.engine_type,
+                    local_mode=self.local_mode)
+        if self.seed is not None:
+            from bigdl_tpu.utils.random_gen import RNG
+
+            RNG.set_seed(self.seed)
+        return Engine
+
+    def apply_optimizer(self, optimizer):
+        """Push training knobs onto an Optimizer (dtype, scaling, retry)."""
+        if self.compute_dtype and self.compute_dtype != "fp32":
+            optimizer.set_compute_dtype(self.compute_dtype)
+        if self.loss_scale != 1.0:
+            optimizer.set_loss_scale(self.loss_scale)
+        optimizer.retry_times = self.failure_retry_times
+        optimizer.retry_interval_s = self.failure_retry_interval
+        return optimizer
+
+
+def _parse(raw: str, ftype) -> object:
+    t = str(ftype)
+    if "bool" in t:
+        return raw.strip().lower() in ("1", "true", "yes")
+    if "int" in t:
+        return int(raw)
+    if "float" in t:
+        return float(raw)
+    return raw

@@ -1,0 +1,55 @@
+"""Typed config (SURVEY.md §5.6) + ModelBroadcast (§2.4)."""
+
+import os
+
+import numpy as np
+
+
+def test_config_env_overlay(monkeypatch):
+    from bigdl_tpu.utils.config import BigDLConfig
+
+    monkeypatch.setenv("BIGDL_FAILURE_RETRY_TIMES", "9")
+    monkeypatch.setenv("BIGDL_COMPUTE_DTYPE", "bf16")
+    monkeypatch.setenv("BIGDL_LOCAL_MODE", "true")
+    cfg = BigDLConfig.from_env()
+    assert cfg.failure_retry_times == 9
+    assert cfg.compute_dtype == "bf16"
+    assert cfg.local_mode is True
+    # explicit overrides beat env
+    cfg2 = BigDLConfig.from_env(failure_retry_times=2)
+    assert cfg2.failure_retry_times == 2
+
+
+def test_config_applies_to_optimizer(rng):
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn import Linear, MSECriterion, Sequential
+    from bigdl_tpu.optim import Optimizer
+    from bigdl_tpu.utils.config import BigDLConfig
+
+    samples = [Sample(rng.randn(4).astype(np.float32),
+                      rng.randn(2).astype(np.float32)) for _ in range(8)]
+    opt = Optimizer(model=Sequential().add(Linear(4, 2)), dataset=samples,
+                    criterion=MSECriterion(), batch_size=4)
+    cfg = BigDLConfig(compute_dtype="bf16", loss_scale=8.0,
+                      failure_retry_times=3)
+    cfg.apply_optimizer(opt)
+    assert opt.compute_dtype == "bf16"
+    assert opt.loss_scale == 8.0
+    assert opt.retry_times == 3
+
+
+def test_model_broadcast_places_replicated(rng):
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.nn import Linear, Sequential
+    from bigdl_tpu.parallel import ModelBroadcast
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+    m = Sequential().add(Linear(4, 3))
+    bc = ModelBroadcast().broadcast(mesh, m)
+    params = bc.value()
+    leaves = jax.tree_util.tree_leaves(params)
+    assert leaves, "no parameters placed"
+    for l in leaves:
+        assert l.sharding.is_fully_replicated
