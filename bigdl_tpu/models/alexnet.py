@@ -112,3 +112,26 @@ def AlexNet(class_num: int = 1000, has_dropout: bool = True) -> Sequential:
                      init_weight=Xavier(), init_bias=Zeros()).set_name("fc8"))
     model.add(LogSoftMax().set_name("logsoftmax"))
     return model
+
+
+def train_main(argv=None):
+    """Reference ``models/alexnet`` Train main (OWT variant; synthetic
+    ImageNet unless ``-f`` is an image folder)."""
+    from bigdl_tpu.models.utils import (
+        run_training, synthetic_imagenet_samples, train_parser,
+    )
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+
+    args = train_parser("AlexNet-OWT on ImageNet", batch_size=64,
+                        learning_rate=0.01, max_epoch=2).parse_args(argv)
+    if args.folder:
+        from bigdl_tpu.dataset.image import image_folder_samples
+
+        samples = image_folder_samples(args.folder, image_size=224)
+    else:
+        samples = synthetic_imagenet_samples(args.synthetic)
+    return run_training(AlexNet_OWT(1000), samples, ClassNLLCriterion(), args)
+
+
+if __name__ == "__main__":
+    train_main()

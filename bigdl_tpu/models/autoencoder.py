@@ -21,3 +21,29 @@ def Autoencoder(class_num: int = 32) -> Sequential:
         .add(Linear(class_num, feature_size))
         .add(Sigmoid())
     )
+
+
+def train_main(argv=None):
+    """Reference ``models/autoencoder`` Train main (MNIST reconstruction,
+    MSE; synthetic digits unless ``-f``)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.mnist import load_samples
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import MSECriterion
+    from bigdl_tpu.optim.optim_method import Adagrad
+
+    args = train_parser("Autoencoder on MNIST", batch_size=128,
+                        learning_rate=0.01, max_epoch=2).parse_args(argv)
+    base = load_samples(args.folder or "/nonexistent", "train",
+                        synthetic_count=args.synthetic)
+    # reconstruction task: target = the flattened input itself
+    samples = [Sample(np.asarray(s.features[0]).reshape(-1),
+                      np.asarray(s.features[0]).reshape(-1)) for s in base]
+    return run_training(Autoencoder(32), samples, MSECriterion(), args,
+                        optim_method=Adagrad(learning_rate=args.learningRate))
+
+
+if __name__ == "__main__":
+    train_main()

@@ -18,7 +18,8 @@ from bigdl_tpu.nn.activations import (
 )
 from bigdl_tpu.nn.shape_ops import (
     Reshape, View, Select, Narrow, Squeeze, Unsqueeze, Transpose, Contiguous,
-    Padding, CAddTable, CMulTable, CSubTable, CDivTable, JoinTable, SplitTable,
+    Padding, CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
+    JoinTable, SplitTable,
     FlattenTable,
 )
 from bigdl_tpu.nn.misc import (

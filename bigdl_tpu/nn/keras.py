@@ -347,6 +347,149 @@ class GRU(_KerasRecurrent):
         return CoreGRU(input_size, self.output_dim)
 
 
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.padding = tuple(padding)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import SpatialZeroPadding
+
+        ph, pw = self.padding
+        return SpatialZeroPadding(pw, pw, ph, ph)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        ph, pw = self.padding
+        return (c, h + 2 * ph, w + 2 * pw)
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), input_shape=None) -> None:
+        super().__init__(input_shape)
+        assert size[0] == size[1], "UpSampling2D wants square scale"
+        self.size = tuple(size)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import SpatialUpSamplingNearest
+
+        return SpatialUpSamplingNearest(self.size[0])
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h * self.size[0], w * self.size[1])
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.pooling import SpatialAveragePooling
+        from bigdl_tpu.nn.shape_ops import Reshape
+
+        pool = SpatialAveragePooling(1, 1, 1, 1, global_pooling=True)
+        return _containers.Sequential().add(pool).add(
+            Reshape([input_shape[0]], batch_mode=True))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class Merge(KerasLayer):
+    """Combine a list of inputs: ``mode`` ∈ sum|mul|max|concat (Keras-1.2
+    ``Merge``). ``concat_axis`` follows Keras semantics — it indexes the
+    BATCHED tensor (axis 0 = batch, which is invalid to concat; -1 = last).
+    """
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        assert mode in ("sum", "mul", "max", "concat")
+        if mode == "concat" and concat_axis == 0:
+            raise ValueError("cannot concat along the batch axis")
+        self.mode = mode
+        self.concat_axis = concat_axis
+        self._n_inputs = 2  # refined when called with functional nodes
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn import shape_ops as S
+
+        if self.mode == "sum":
+            return S.CAddTable()
+        if self.mode == "mul":
+            return S.CMulTable()
+        if self.mode == "max":
+            return S.CMaxTable()
+        # concat: JoinTable's n_input_dims handles the implicit batch dim,
+        # so a batched-tensor axis k maps to 1-based non-batch dim k
+        ax = self.concat_axis
+        dim = len(self.input_shape) if ax == -1 else ax
+        return S.JoinTable(dim, len(self.input_shape))
+
+    def compute_output_shape(self, input_shape):
+        if self.mode != "concat":
+            return tuple(input_shape)
+        shape = list(input_shape)
+        ax = self.concat_axis if self.concat_axis != -1 else len(shape)
+        shape[ax - 1] *= self._n_inputs  # batchless index of batched axis ax
+        return tuple(shape)
+
+    def __call__(self, nodes):  # type: ignore[override]
+        if isinstance(nodes, (list, tuple)) and nodes and isinstance(
+                nodes[0], KerasNode):
+            self._n_inputs = len(nodes)
+            self.build(nodes[0].shape)
+            return KerasNode(self.get_output_shape(), self, list(nodes))
+        return super().__call__(nodes)
+
+
+class Highway(KerasLayer):
+    """Keras-1.2 Highway layer: ``t·h(x) + (1−t)·x`` with learned transform
+    and carry gates."""
+
+    def __init__(self, activation="relu", input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.activation = activation
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.linear import Linear
+        from bigdl_tpu.nn.module import TensorModule
+
+        d = input_shape[-1]
+        act = _ACTIVATIONS[self.activation]
+
+        class _HighwayCore(TensorModule):
+            def __init__(self, d_):
+                super().__init__()
+                self.h = Linear(d_, d_)
+                self.t = Linear(d_, d_)
+                self.act = act() if act else None
+
+            def sub_modules(self):
+                return [self.h, self.t]
+
+            def init_params(self, rng):
+                import jax
+
+                k1, k2 = jax.random.split(rng)
+                return {f"0:{self.h.name}": self.h.init_params(k1),
+                        f"1:{self.t.name}": self.t.init_params(k2)}
+
+            def apply(self, params, input, state=None, training=False,
+                      rng=None):
+                import jax
+
+                h, _ = self.h.apply(params[f"0:{self.h.name}"], input)
+                if self.act is not None:
+                    h, _ = self.act.apply({}, h)
+                t, _ = self.t.apply(params[f"1:{self.t.name}"], input)
+                t = jax.nn.sigmoid(t)
+                return t * h + (1 - t) * input, state
+
+        return _HighwayCore(d)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
 class Sequential(KerasLayer):
     """Keras-style Sequential: the first layer carries ``input_shape``;
     every later layer infers its shape at ``add`` time."""

@@ -206,6 +206,28 @@ class CDivTable(TensorModule):
         return input[0] / input[1], state
 
 
+class CMaxTable(TensorModule):
+    """Elementwise max over a Table (reference ``nn/CMaxTable.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import functools
+
+        import jax.numpy as jnp
+
+        return functools.reduce(jnp.maximum, input), state
+
+
+class CMinTable(TensorModule):
+    """Elementwise min over a Table (reference ``nn/CMinTable.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import functools
+
+        import jax.numpy as jnp
+
+        return functools.reduce(jnp.minimum, input), state
+
+
 class JoinTable(TensorModule):
     """Concatenate a list along ``dimension`` (reference ``nn/JoinTable.scala``).
     ``n_input_dims`` handles the implicit batch dim as in the reference."""

@@ -332,7 +332,11 @@ def _convert_layer(ltype: str, layer: Dict, lblobs, L) -> Tuple[Any, int]:
                 1, 1, 1, 1, global_pooling=True), None
         cls = L["SpatialMaxPooling"] if pool in ("MAX", 0) else L[
             "SpatialAveragePooling"]
-        return cls(kw, kh, sw, sh, pw, ph).ceil(), None  # caffe ceils
+        mod = cls(kw, kh, sw, sh, pw, ph)
+        # caffe defaults to CEIL; round_mode FLOOR (=1) opts out
+        if _one(p, "round_mode", "CEIL") in ("CEIL", 0):
+            mod = mod.ceil()
+        return mod, None
     if ltype == "ReLU":
         return L["ReLU"](), None
     if ltype == "TanH":
@@ -420,3 +424,135 @@ class CaffeLoader:
     """Reference-shaped facade (``Module.loadCaffeModel``)."""
 
     load = staticmethod(load_caffe)
+
+
+# ---------------------------------------------------------------------------
+# exporter (reference ``CaffePersister``) — wire-format encoder
+# ---------------------------------------------------------------------------
+
+
+from bigdl_tpu.utils.protowire import (  # noqa: E402 — exporter section
+    field_bytes as _enc_ld_raw, tag as _enc_tag, varint as _enc_varint,
+)
+
+
+def _enc_ld(fnum: int, payload: bytes) -> bytes:
+    return _enc_ld_raw(fnum, payload)
+
+
+def _enc_blob(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr, np.float32)
+    shape = b"".join(_enc_tag(1, 0) + _enc_varint(int(d)) for d in arr.shape)
+    data = _enc_tag(5, 2) + _enc_varint(arr.size * 4) + struct.pack(
+        f"<{arr.size}f", *arr.reshape(-1))
+    return _enc_ld(7, shape) + data
+
+
+def save_caffe(module, prototxt_path: str, caffemodel_path: str) -> None:
+    """Export a module's weight-bearing layers as prototxt + caffemodel.
+
+    Reference ``CaffePersister.persist``. Supported layer types mirror the
+    importer's converter table (Convolution/InnerProduct/ReLU/Pooling/
+    Softmax/...); layers outside Caffe's vocabulary raise.
+    """
+    from bigdl_tpu.nn.containers import Container, Sequential
+    from bigdl_tpu.nn.graph import Graph
+
+    module._materialize_params()
+    lines = ['name: "bigdl_tpu_export"', 'input: "data"']
+    blobs_bytes = b""
+    prev_top = "data"
+
+    def emit(mod, params):
+        nonlocal blobs_bytes, prev_top
+        cls = type(mod).__name__
+        name = mod.name
+        if cls == "SpatialConvolution":
+            p = (f'layer {{ name: "{name}" type: "Convolution" '
+                 f'bottom: "{prev_top}" top: "{name}"\n'
+                 f'  convolution_param {{ num_output: {mod.n_output_plane} '
+                 f'kernel_h: {mod.kernel_h} kernel_w: {mod.kernel_w} '
+                 f'stride_h: {mod.stride_h} stride_w: {mod.stride_w} '
+                 f'pad_h: {mod.pad_h} pad_w: {mod.pad_w} '
+                 f'group: {mod.n_group} '
+                 f'bias_term: {"true" if mod.with_bias else "false"} }} }}')
+            lines.append(p)
+            body = _enc_ld(1, name.encode())
+            body += _enc_ld(7, _enc_blob(np.asarray(params["weight"])))
+            if mod.with_bias:
+                body += _enc_ld(7, _enc_blob(np.asarray(params["bias"])))
+            blobs_bytes += _enc_ld(100, body)
+            prev_top = name
+        elif cls == "Linear":
+            lines.append(
+                f'layer {{ name: "{name}" type: "InnerProduct" '
+                f'bottom: "{prev_top}" top: "{name}"\n'
+                f'  inner_product_param {{ num_output: {mod.output_size} '
+                f'bias_term: {"true" if mod.with_bias else "false"} }} }}')
+            body = _enc_ld(1, name.encode())
+            body += _enc_ld(7, _enc_blob(np.asarray(params["weight"])))
+            if mod.with_bias:
+                body += _enc_ld(7, _enc_blob(np.asarray(params["bias"])))
+            blobs_bytes += _enc_ld(100, body)
+            prev_top = name
+        elif cls == "ReLU":
+            lines.append(f'layer {{ name: "{name}" type: "ReLU" '
+                         f'bottom: "{prev_top}" top: "{prev_top}" }}')
+        elif cls == "Tanh":
+            lines.append(f'layer {{ name: "{name}" type: "TanH" '
+                         f'bottom: "{prev_top}" top: "{prev_top}" }}')
+        elif cls == "Sigmoid":
+            lines.append(f'layer {{ name: "{name}" type: "Sigmoid" '
+                         f'bottom: "{prev_top}" top: "{prev_top}" }}')
+        elif cls == "SoftMax":
+            lines.append(f'layer {{ name: "{name}" type: "Softmax" '
+                         f'bottom: "{prev_top}" top: "{name}" }}')
+            prev_top = name
+        elif cls in ("SpatialMaxPooling", "SpatialAveragePooling"):
+            if mod.pad_h == -1 or mod.pad_w == -1:
+                raise NotImplementedError(
+                    f"pooling layer {name}: TF-style SAME padding (-1) has "
+                    "no Caffe equivalent; set explicit pads before export")
+            pool = "MAX" if cls == "SpatialMaxPooling" else "AVE"
+            round_mode = "CEIL" if mod.ceil_mode else "FLOOR"
+            lines.append(
+                f'layer {{ name: "{name}" type: "Pooling" '
+                f'bottom: "{prev_top}" top: "{name}"\n'
+                f'  pooling_param {{ pool: {pool} kernel_h: {mod.kh} '
+                f'kernel_w: {mod.kw} stride_h: {mod.dh} stride_w: {mod.dw} '
+                f'pad_h: {mod.pad_h} pad_w: {mod.pad_w} '
+                f'round_mode: {round_mode} }} }}')
+            prev_top = name
+        elif cls == "Dropout":
+            lines.append(
+                f'layer {{ name: "{name}" type: "Dropout" '
+                f'bottom: "{prev_top}" top: "{prev_top}"\n'
+                f'  dropout_param {{ dropout_ratio: {mod.p} }} }}')
+        elif cls in ("Reshape", "View", "Identity"):
+            pass  # shape plumbing has no caffe layer; consumers infer
+        else:
+            raise NotImplementedError(
+                f"layer {cls} has no Caffe export mapping")
+
+    def walk(mod, params):
+        if isinstance(mod, Container) and type(mod).__name__ == "Sequential":
+            for i, m in enumerate(mod.modules):
+                walk(m, (params or {}).get(mod._child_key(i), {}))
+        elif isinstance(mod, Graph):
+            raise NotImplementedError(
+                "Caffe export supports Sequential models (reference "
+                "CaffePersister had the same linear-topology limitation)")
+        else:
+            emit(mod, params)
+
+    walk(module, module.params)
+    with open(prototxt_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(caffemodel_path, "wb") as f:
+        f.write(blobs_bytes)
+
+
+class CaffePersister:
+    """Reference-shaped facade (``CaffePersister.persist``)."""
+
+    persist = staticmethod(save_caffe)

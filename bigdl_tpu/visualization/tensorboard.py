@@ -51,34 +51,14 @@ def _masked_crc(data: bytes) -> int:
     return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
 
 
-# -- protobuf wire helpers -------------------------------------------------
+# -- protobuf wire helpers (single shared definition in utils/protowire) ---
 
-def _varint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def _field_varint(num: int, val: int) -> bytes:
-    return _varint(num << 3) + _varint(val)
-
-
-def _field_double(num: int, val: float) -> bytes:
-    return _varint((num << 3) | 1) + struct.pack("<d", val)
-
-
-def _field_float(num: int, val: float) -> bytes:
-    return _varint((num << 3) | 5) + struct.pack("<f", val)
-
-
-def _field_bytes(num: int, val: bytes) -> bytes:
-    return _varint((num << 3) | 2) + _varint(len(val)) + val
+from bigdl_tpu.utils.protowire import (  # noqa: E402
+    field_bytes as _field_bytes,
+    field_double as _field_double,
+    field_float as _field_float,
+    field_varint as _field_varint,
+)
 
 
 def scalar_event(tag: str, value: float, step: int,

@@ -170,3 +170,71 @@ def test_load_caffe_batchnorm_scale(rng):
         var[None, :, None, None] + 1e-3)
     want = norm * sw[None, :, None, None] + sb[None, :, None, None]
     assert_close(got, want, atol=1e-4)
+
+
+def test_caffe_export_import_roundtrip(rng, tmp_path):
+    """CaffePersister → CaffeLoader round-trip preserves the forward."""
+    from bigdl_tpu.nn import (
+        Dropout, Linear, ReLU, Sequential, SoftMax, SpatialConvolution,
+        SpatialMaxPooling,
+    )
+    from bigdl_tpu.nn.shape_ops import Reshape
+    from bigdl_tpu.utils.caffe_loader import load_caffe, save_caffe
+
+    m = (Sequential()
+         .add(SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1))
+         .add(ReLU())
+         .add(SpatialMaxPooling(2, 2, 2, 2)))
+    m._ensure_params()
+    m.evaluate()
+    x = rng.rand(2, 1, 8, 8).astype(np.float32)
+    want = np.asarray(m.forward(x))
+
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    save_caffe(m, proto, weights)
+    g = load_caffe(proto, weights)
+    g.evaluate()
+    got = np.asarray(g.forward(x))
+    assert_close(got, want, atol=1e-5)
+
+
+def test_caffe_export_mlp_roundtrip(rng, tmp_path):
+    from bigdl_tpu.nn import Linear, ReLU, Sequential, SoftMax
+    from bigdl_tpu.utils.caffe_loader import load_caffe, save_caffe
+
+    m = (Sequential().add(Linear(6, 10)).add(ReLU())
+         .add(Linear(10, 3)).add(SoftMax()))
+    m._ensure_params()
+    m.evaluate()
+    x = rng.randn(4, 6).astype(np.float32)
+    want = np.asarray(m.forward(x))
+
+    proto = str(tmp_path / "mlp.prototxt")
+    weights = str(tmp_path / "mlp.caffemodel")
+    save_caffe(m, proto, weights)
+    g = load_caffe(proto, weights)
+    got = np.asarray(g.forward(x))
+    assert_close(got, want, atol=1e-5)
+
+
+def test_caffe_pooling_round_mode_fidelity(rng, tmp_path):
+    """Floor-mode pooling must round-trip with identical geometry."""
+    from bigdl_tpu.nn import Sequential, SpatialConvolution, SpatialMaxPooling
+    from bigdl_tpu.utils.caffe_loader import load_caffe, save_caffe
+
+    m = (Sequential()
+         .add(SpatialConvolution(1, 2, 3, 3))
+         .add(SpatialMaxPooling(3, 3, 2, 2)))  # floor mode
+    m._ensure_params()
+    m.evaluate()
+    x = rng.rand(1, 1, 12, 12).astype(np.float32)
+    want = np.asarray(m.forward(x))
+
+    proto = str(tmp_path / "p.prototxt")
+    weights = str(tmp_path / "p.caffemodel")
+    save_caffe(m, proto, weights)
+    g = load_caffe(proto, weights)
+    got = np.asarray(g.forward(x))
+    assert got.shape == want.shape
+    assert_close(got, want, atol=1e-5)

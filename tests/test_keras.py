@@ -152,3 +152,66 @@ def test_embedding_zero_based_ids(rng):
     assert_close(out[0, 2], table[9], atol=1e-6)
     # token 0 must receive gradient (not a silently zeroed row)
     assert np.abs(out[0, 0]).sum() > 0
+
+
+def test_keras_extras(rng):
+    from bigdl_tpu.nn import keras as K
+
+    m = (K.Sequential()
+         .add(K.ZeroPadding2D((1, 2), input_shape=(3, 5, 5)))
+         .add(K.UpSampling2D((2, 2)))
+         .add(K.GlobalAveragePooling2D()))
+    assert m.get_output_shape() == (3,)
+    out = m.forward(rng.rand(2, 3, 5, 5).astype(np.float32))
+    assert np.asarray(out).shape == (2, 3)
+
+
+def test_keras_merge_functional(rng):
+    from bigdl_tpu.nn import keras as K
+
+    a = K.Input(shape=(6,))
+    h1 = K.Dense(4)(a)
+    h2 = K.Dense(4)(a)
+    merged = K.Merge("sum")([h1, h2])
+    out = K.Dense(2)(merged)
+    model = K.Model(input=a, output=out)
+    y = model.forward(rng.randn(3, 6).astype(np.float32))
+    assert np.asarray(y).shape == (3, 2)
+
+
+def test_keras_highway(rng):
+    from bigdl_tpu.nn import keras as K
+
+    hw = K.Highway(input_shape=(8,))
+    hw.build((8,))
+    hw._ensure_params()
+    x = rng.randn(4, 8).astype(np.float32)
+    out = np.asarray(hw.forward(x))
+    assert out.shape == (4, 8)
+    assert np.all(np.isfinite(out))
+
+
+def test_merge_concat_axis_semantics(rng):
+    """concat_axis indexes the BATCHED tensor (Keras semantics); axis 1 on
+    (B, D) concatenates features, never the batch."""
+    from bigdl_tpu.nn import keras as K
+
+    a = K.Input(shape=(3,))
+    h1 = K.Dense(3)(a)
+    h2 = K.Dense(3)(a)
+    merged = K.Merge("concat", concat_axis=1)([h1, h2])
+    assert merged.shape == (6,)
+    m = K.Model(input=a, output=merged)
+    out = np.asarray(m.forward(rng.randn(4, 3).astype(np.float32)))
+    assert out.shape == (4, 6)
+
+    # three-way max merge (CMaxTable handles N inputs)
+    mx = K.Merge("max")([h1, h2, K.Dense(3)(a)])
+    m2 = K.Model(input=a, output=mx)
+    out2 = np.asarray(m2.forward(rng.randn(4, 3).astype(np.float32)))
+    assert out2.shape == (4, 3)
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        K.Merge("concat", concat_axis=0)

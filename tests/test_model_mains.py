@@ -118,3 +118,17 @@ def test_seqfile_rejects_empty_process_shard(tmp_path):
                  str(tmp_path), n_shards=1)
     with pytest.raises(ValueError, match="gets no shards"):
         SeqFileDataSet(str(tmp_path), shard_index=1, num_shards=2)
+
+
+def test_alexnet_and_autoencoder_mains():
+    from bigdl_tpu.models import alexnet, autoencoder
+
+    m1 = alexnet.train_main(["-b", "8", "--maxIteration", "1",
+                             "--synthetic", "16"])
+    ws, _ = m1.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+    m2 = autoencoder.train_main(["-b", "16", "--maxIteration", "2",
+                                 "--synthetic", "32"])
+    ws, _ = m2.parameters()
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)

@@ -68,6 +68,8 @@ FACTORIES = {
     "CAdd": (lambda: nn.CAdd((3,)), x(2, 3)),
     "CAddTable": (lambda: nn.CAddTable(), [x(2, 3), x(2, 3)]),
     "CDivTable": (lambda: nn.CDivTable(), [x(2, 3), x(2, 3) + 3.0]),
+    "CMaxTable": (lambda: nn.CMaxTable(), [x(2, 3), x(2, 3)]),
+    "CMinTable": (lambda: nn.CMinTable(), [x(2, 3), x(2, 3)]),
     "CMul": (lambda: nn.CMul((3,)), x(2, 3)),
     "CMulTable": (lambda: nn.CMulTable(), [x(2, 3), x(2, 3)]),
     "CSubTable": (lambda: nn.CSubTable(), [x(2, 3), x(2, 3)]),
