@@ -1,0 +1,133 @@
+"""Binary TreeLSTM — the ``treeLSTMSentiment`` example's model family.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/example/treeLSTMSentiment``
++ ``nn/BinaryTreeLSTM.scala`` — constituency-tree sentiment with a binary
+TreeLSTM (Tai et al. 2015), scored per-node with ``TreeNNAccuracy``.
+
+TPU-native redesign: the reference recursively walks each tree on the JVM —
+data-dependent recursion that XLA cannot trace. Here a tree is a PADDED
+ARRAY ENCODING in children-before-parent topological order:
+
+    word   (N,) int32   — 1-based token id for leaves, 0 for internal
+    left   (N,) int32   — 1-based node index of left child (0 for leaves)
+    right  (N,) int32   — 1-based node index of right child
+    mask   (N,) f32     — 1 for real nodes, 0 for padding
+
+One ``lax.scan`` walks the node axis, gathering child (h, c) from a state
+buffer — so EVERY tree shape compiles to the same static program, batches
+vmap cleanly, and the whole forest runs as one XLA computation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.nn.init_methods import InitializationMethod, RandomUniform
+from bigdl_tpu.nn.module import AbstractModule
+
+
+class BinaryTreeLSTM(AbstractModule):
+    """Input Table ``[word, left, right]`` each ``(B, N)`` (mask derived
+    from word/left: a node is real if it has a word or children); output
+    ``(B, N, hidden)`` node hidden states in the same node order."""
+
+    def __init__(self, vocab_size: int, embedding_dim: int, hidden_size: int,
+                 init_weight: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.embedding_dim = embedding_dim
+        self.hidden_size = hidden_size
+        self.weight_init = init_weight or RandomUniform(-0.1, 0.1)
+
+    def init_params(self, rng):
+        import jax
+
+        E, D, H = self.vocab_size, self.embedding_dim, self.hidden_size
+        ks = jax.random.split(rng, 6)
+        init = self.weight_init.init
+        return {
+            "embedding": init(ks[0], (E, D)),
+            # leaf transform: word embedding → (i, o, u) gates
+            "w_leaf": init(ks[1], (D, 3 * H)),
+            "b_leaf": init(ks[2], (3 * H,)) * 0,
+            # composition: [h_l, h_r] → (i, o, u, f_l, f_r) gates
+            "w_comp": init(ks[3], (2 * H, 5 * H)),
+            "b_comp": init(ks[4], (5 * H,)) * 0,
+        }
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        word, left, right = (jnp.asarray(t) for t in input)
+        word = word.astype(jnp.int32)
+        left = left.astype(jnp.int32)
+        right = right.astype(jnp.int32)
+        H = self.hidden_size
+
+        def one_tree(word, left, right):
+            N = word.shape[0]
+            is_leaf = (word > 0)
+            # embeddings for leaves (0 row for padding/internal)
+            emb_table = jnp.concatenate(
+                [jnp.zeros((1, params["embedding"].shape[1]),
+                           params["embedding"].dtype), params["embedding"]])
+            emb = emb_table[word]                              # (N, D)
+
+            def step(carry, idx):
+                h_buf, c_buf = carry                           # (N+1, H) each
+                # leaf path
+                iou = emb[idx] @ params["w_leaf"] + params["b_leaf"]
+                i_l = jax.nn.sigmoid(iou[:H])
+                o_l = jax.nn.sigmoid(iou[H:2 * H])
+                u_l = jnp.tanh(iou[2 * H:])
+                c_leaf = i_l * u_l
+                h_leaf = o_l * jnp.tanh(c_leaf)
+                # composition path (children live BEFORE idx in node order;
+                # index 0 of the buffer is the zero state)
+                hl, hr = h_buf[left[idx]], h_buf[right[idx]]
+                cl, cr = c_buf[left[idx]], c_buf[right[idx]]
+                g = jnp.concatenate([hl, hr]) @ params["w_comp"] + params["b_comp"]
+                i_c = jax.nn.sigmoid(g[:H])
+                o_c = jax.nn.sigmoid(g[H:2 * H])
+                u_c = jnp.tanh(g[2 * H:3 * H])
+                f_l = jax.nn.sigmoid(g[3 * H:4 * H])
+                f_r = jax.nn.sigmoid(g[4 * H:])
+                c_comp = i_c * u_c + f_l * cl + f_r * cr
+                h_comp = o_c * jnp.tanh(c_comp)
+
+                leaf = is_leaf[idx]
+                h = jnp.where(leaf, h_leaf, h_comp)
+                c = jnp.where(leaf, c_leaf, c_comp)
+                real = leaf | (left[idx] > 0)
+                h = jnp.where(real, h, 0.0)
+                c = jnp.where(real, c, 0.0)
+                h_buf = h_buf.at[idx + 1].set(h)
+                c_buf = c_buf.at[idx + 1].set(c)
+                return (h_buf, c_buf), h
+
+            zeros = jnp.zeros((N + 1, H))
+            (_, _), hs = jax.lax.scan(step, (zeros, zeros), jnp.arange(N))
+            return hs                                          # (N, H)
+
+        out = jax.vmap(one_tree)(word, left, right)
+        return out, state
+
+    def __repr__(self) -> str:
+        return (f"BinaryTreeLSTM(vocab={self.vocab_size}, "
+                f"emb={self.embedding_dim}, hidden={self.hidden_size})")
+
+
+def TreeLSTMSentiment(vocab_size: int, embedding_dim: int = 128,
+                      hidden_size: int = 128, class_num: int = 5):
+    """The treeLSTMSentiment example net: BinaryTreeLSTM → per-node
+    TimeDistributed(Linear) → LogSoftMax, scored per node."""
+    from bigdl_tpu.nn import LogSoftMax, Sequential, TimeDistributed
+    from bigdl_tpu.nn.linear import Linear
+
+    return (Sequential()
+            .add(BinaryTreeLSTM(vocab_size, embedding_dim, hidden_size))
+            .add(TimeDistributed(Linear(hidden_size, class_num)))
+            .add(LogSoftMax()))

@@ -9,7 +9,7 @@ from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.optim.evaluator import Evaluator, LocalPredictor, Predictor, Validator
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
-    AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, Top5Accuracy,
+    AccuracyResult, Loss, LossResult, MAE, Top1Accuracy, TreeNNAccuracy, Top5Accuracy,
     ValidationMethod, ValidationResult,
 )
 from bigdl_tpu.optim.lbfgs import LBFGS, strong_wolfe
@@ -23,7 +23,7 @@ __all__ = [
     "LocalOptimizer", "Optimizer", "DistriOptimizer", "Trigger",
     "Evaluator", "LocalPredictor", "Predictor", "Validator",
     "AccuracyResult", "Loss", "LossResult", "MAE", "Top1Accuracy",
-    "Top5Accuracy", "ValidationMethod", "ValidationResult",
+    "Top5Accuracy", "TreeNNAccuracy", "ValidationMethod", "ValidationResult",
     "LBFGS", "strong_wolfe", "LarsSGD",
     "Metrics", "L1L2Regularizer", "L1Regularizer", "L2Regularizer",
 ]

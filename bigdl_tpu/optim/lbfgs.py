@@ -13,6 +13,11 @@ is expected to be a jitted loss/grad function — so each of the few dozen
 evaluations per step is a single compiled launch. This mirrors how the
 reference used LBFGS (full-batch, small problems) rather than the
 per-minibatch SGD path.
+
+On TPU, run LBFGS under fp32 matmuls (``jax.default_matmul_precision(
+"highest")`` or jit the feval with that context): the default bf16 matmul
+noise breaks the curvature estimates and strong-Wolfe bracketing that
+quasi-Newton methods rely on.
 """
 
 from __future__ import annotations

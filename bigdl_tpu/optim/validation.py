@@ -92,6 +92,46 @@ class Top5Accuracy(ValidationMethod):
         return AccuracyResult(correct, len(t))
 
 
+class TreeNNAccuracy(ValidationMethod):
+    """Per-node (or root-only) accuracy over tree outputs (reference
+    ``TreeNNAccuracy`` used by treeLSTMSentiment).
+
+    ``output``: (B, N, C) per-node class scores in children-before-parent
+    node order; ``target``: (B, N) 1-based labels, 0 = padding. Root =
+    the LAST labeled node of each tree."""
+
+    def __init__(self, all_nodes: bool = False) -> None:
+        self.all_nodes = all_nodes
+        self.name = f"TreeNNAccuracy(all={all_nodes})"
+
+    def apply(self, output, target) -> AccuracyResult:
+        out = np.asarray(output)
+        t = np.asarray(target).astype(np.int64)
+        if out.ndim == 2:
+            out, t = out[None], np.atleast_2d(t)
+        # tolerate BigDL-style trailing singleton label dims: (B, N, 1)
+        while t.ndim > out.ndim - 1 and t.shape[-1] == 1:
+            t = t[..., 0]
+        if t.shape != out.shape[:-1]:
+            raise ValueError(
+                f"TreeNNAccuracy: target shape {t.shape} does not match "
+                f"output node grid {out.shape[:-1]}")
+        pred = out.argmax(axis=-1) + 1          # 1-based
+        valid = t > 0
+        if self.all_nodes:
+            correct = int(((pred == t) & valid).sum())
+            return AccuracyResult(correct, int(valid.sum()))
+        correct = total = 0
+        for b in range(t.shape[0]):
+            idx = np.nonzero(valid[b])[0]
+            if len(idx) == 0:
+                continue
+            root = idx[-1]
+            total += 1
+            correct += int(pred[b, root] == t[b, root])
+        return AccuracyResult(correct, total)
+
+
 class Loss(ValidationMethod):
     name = "Loss"
 

@@ -1,0 +1,158 @@
+"""BinaryTreeLSTM vs a recursive python oracle + treeLSTMSentiment
+end-to-end (SURVEY.md §2.8 treeLSTMSentiment row)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+
+def _tree_arrays():
+    """((the cat) (sat down)) — 7 nodes, children before parents, 1-based
+    child indices; node 7 is the root."""
+    word = np.array([1, 2, 0, 3, 4, 0, 0], np.int32)   # leaves: the cat sat down
+    left = np.array([0, 0, 1, 0, 0, 4, 3], np.int32)
+    right = np.array([0, 0, 2, 0, 0, 5, 6], np.int32)
+    return word, left, right
+
+
+def _oracle(params, word, left, right, H):
+    """Recursive reference implementation (pure numpy)."""
+    import jax
+
+    emb = np.asarray(params["embedding"])
+    w_leaf, b_leaf = np.asarray(params["w_leaf"]), np.asarray(params["b_leaf"])
+    w_comp, b_comp = np.asarray(params["w_comp"]), np.asarray(params["b_comp"])
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+
+    memo = {}
+
+    def node(i):  # 0-based
+        if i in memo:
+            return memo[i]
+        if word[i] > 0:
+            iou = emb[word[i] - 1] @ w_leaf + b_leaf
+            i_g, o_g, u_g = sig(iou[:H]), sig(iou[H:2 * H]), np.tanh(iou[2 * H:])
+            c = i_g * u_g
+            h = o_g * np.tanh(c)
+        else:
+            hl, cl = node(left[i] - 1)
+            hr, cr = node(right[i] - 1)
+            g = np.concatenate([hl, hr]) @ w_comp + b_comp
+            i_g, o_g = sig(g[:H]), sig(g[H:2 * H])
+            u_g = np.tanh(g[2 * H:3 * H])
+            f_l, f_r = sig(g[3 * H:4 * H]), sig(g[4 * H:])
+            c = i_g * u_g + f_l * cl + f_r * cr
+            h = o_g * np.tanh(c)
+        memo[i] = (h, c)
+        return memo[i]
+
+    return np.stack([node(i)[0] for i in range(len(word))])
+
+
+def test_treelstm_matches_recursive_oracle(rng):
+    from bigdl_tpu.models.treelstm import BinaryTreeLSTM
+
+    H = 6
+    m = BinaryTreeLSTM(vocab_size=10, embedding_dim=5, hidden_size=H)
+    m._ensure_params()
+    word, left, right = _tree_arrays()
+    out = np.asarray(m.forward([word[None], left[None], right[None]]))[0]
+    want = _oracle(m.params, word, left, right, H)
+    assert_close(out, want, atol=1e-5)
+
+
+def test_treelstm_padding_nodes_zero(rng):
+    from bigdl_tpu.models.treelstm import BinaryTreeLSTM
+
+    m = BinaryTreeLSTM(vocab_size=10, embedding_dim=4, hidden_size=5)
+    m._ensure_params()
+    word, left, right = _tree_arrays()
+    # pad to 10 nodes
+    pad = lambda a: np.concatenate([a, np.zeros(3, np.int32)])
+    out = np.asarray(m.forward([pad(word)[None], pad(left)[None],
+                                pad(right)[None]]))[0]
+    assert np.all(out[7:] == 0), "padding nodes must produce zero states"
+    assert np.abs(out[:7]).sum() > 0
+
+
+def test_treenn_accuracy():
+    from bigdl_tpu.optim import TreeNNAccuracy
+
+    # 2 trees, 3 nodes each; root = last labeled node
+    out = np.zeros((2, 3, 4), np.float32)
+    out[0, 2, 1] = 5.0   # tree0 root predicts class 2
+    out[0, 0, 0] = 5.0   # tree0 leaf predicts class 1
+    out[1, 1, 3] = 5.0   # tree1 root (node 1) predicts class 4
+    target = np.array([[1, 0, 2],    # labels: leaf=1, pad, root=2
+                       [3, 4, 0]], np.float32)  # leaf=3, root=4, pad
+    root_acc, n = TreeNNAccuracy().apply(out, target).result()
+    assert n == 2 and root_acc == 1.0
+    all_acc, n_all = TreeNNAccuracy(all_nodes=True).apply(out, target).result()
+    assert n_all == 4
+    assert abs(all_acc - 3 / 4) < 1e-9  # tree1 leaf (class 3) mispredicted
+
+
+def test_treelstm_sentiment_trains(rng):
+    """End-to-end: sentiment of tiny synthetic trees becomes learnable."""
+    import jax
+
+    from bigdl_tpu.models.treelstm import TreeLSTMSentiment
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
+    from bigdl_tpu.optim import LBFGS
+
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(5)  # a well-conditioned init basin for this tiny problem
+    V, N, B = 8, 7, 16
+    model = TreeLSTMSentiment(V, embedding_dim=8, hidden_size=8, class_num=2)
+    model._ensure_params()
+
+    word, left, right = _tree_arrays()
+    words = np.stack([word] * B)
+    # class depends on the first leaf's token id
+    rngs = np.random.RandomState(0)
+    labels = np.zeros((B, N), np.float32)
+    for b in range(B):
+        tok = rngs.randint(1, V + 1)
+        words[b, 0] = tok
+        labels[b, :] = 0
+        labels[b, 6] = 1 + (tok % 2)  # root label only
+    lefts = np.stack([left] * B)
+    rights = np.stack([right] * B)
+
+    crit = TimeDistributedCriterion(ClassNLLCriterion())
+
+    def feval(p):
+        def loss_fn(pp):
+            out, _ = model.apply(pp, [words, lefts, rights], model.state)
+            # mask unlabeled nodes: select root column only
+            root_logp = out[:, 6, :]
+            root_t = labels[:, 6]
+            return ClassNLLCriterion().apply(root_logp, root_t)
+
+        return jax.value_and_grad(loss_fn)(p)
+
+    new_params, losses = LBFGS(max_iter=25).optimize(feval, model.params)
+    assert losses[-1] < losses[0] * 0.5, f"{losses[0]} -> {losses[-1]}"
+
+    model.params = new_params
+    out = np.asarray(model.forward([words, lefts, rights]))
+    from bigdl_tpu.optim import TreeNNAccuracy
+
+    acc, n = TreeNNAccuracy().apply(out, labels).result()
+    assert n == B and acc > 0.8, f"root accuracy {acc}"
+
+
+def test_treenn_accuracy_shape_tolerance():
+    import pytest
+
+    from bigdl_tpu.optim import TreeNNAccuracy
+
+    out = np.zeros((2, 3, 4), np.float32)
+    out[:, :, 1] = 1.0
+    t3 = np.full((2, 3, 1), 2.0, np.float32)  # trailing singleton dim
+    acc, n = TreeNNAccuracy(all_nodes=True).apply(out, t3).result()
+    assert n == 6 and acc == 1.0
+    with pytest.raises(ValueError):
+        TreeNNAccuracy().apply(out, np.zeros((2, 5), np.float32))
