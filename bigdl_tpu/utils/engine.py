@@ -56,6 +56,7 @@ class _EngineSingleton:
     def __init__(self) -> None:
         self._initialized = False
         self._distributed_initialized = False
+        self._default_pool = None
         self._node_number = 1
         self._core_number = 1
         self._engine_type = EngineType.TPU
@@ -147,9 +148,28 @@ class _EngineSingleton:
         if not self._initialized:
             self.init()
 
+    # -- host thread pools (reference Engine.default / Engine.model) -------
+
+    def default_pool(self):
+        """Host IO/comm pool (reference ``Engine.default``); compute has no
+        pool here — XLA owns the chip's threads."""
+        if self._default_pool is None:
+            from bigdl_tpu.utils.thread_pool import ThreadPool
+
+            self._ensure_init()
+            self._default_pool = ThreadPool(max(self._core_number, 1))
+        return self._default_pool
+
+    # reference name kept: Engine.model was the compute pool; host-side it
+    # aliases the same pool (compute threading belongs to XLA)
+    model_pool = default_pool
+
     def reset(self) -> None:
         """Testing hook: forget topology so the next init() re-discovers."""
         self._initialized = False
+        if self._default_pool is not None:  # pool is topology-sized
+            self._default_pool.shutdown()
+            self._default_pool = None
 
     # -- topology accessors ------------------------------------------------
 

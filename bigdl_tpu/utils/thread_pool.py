@@ -1,0 +1,46 @@
+"""ThreadPool — host-side task pool (reference ``utils/ThreadPool.scala``).
+
+Reference role (UNVERIFIED, SURVEY.md §0): wraps a Java executor with
+``invokeAndWait``/``invoke2`` and MKL thread-affinity plumbing; ``Engine``
+owned two of them (``Engine.default`` for IO/comm, ``Engine.model`` for
+compute).
+
+TPU-native: XLA owns compute threads, so the pool exists only for HOST work
+— parallel file IO, decode, checkpoint writes (the C++ prefetch executor in
+``bigdl_tpu/native`` covers the hot input path). The reference call shapes
+(``invoke_and_wait`` over a list of thunks) are preserved on top of
+``concurrent.futures``.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence
+
+
+class ThreadPool:
+    def __init__(self, n_threads: int) -> None:
+        self.n_threads = n_threads
+        self._pool = ThreadPoolExecutor(max_workers=n_threads)
+
+    def invoke_and_wait(self, tasks: Sequence[Callable], timeout: Optional[float] = None):
+        """Run all thunks, block for completion, return results in order
+        (reference ``invokeAndWait``). ``timeout`` is an OVERALL deadline,
+        not per task. Exceptions propagate."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        futures = [self._pool.submit(t) for t in tasks]
+        out = []
+        for f in futures:
+            remaining = (None if deadline is None
+                         else max(deadline - time.monotonic(), 0.0))
+            out.append(f.result(remaining))
+        return out
+
+    def invoke(self, tasks: Sequence[Callable]) -> List[Future]:
+        """Fire-and-return futures (reference ``invoke2``)."""
+        return [self._pool.submit(t) for t in tasks]
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=True)

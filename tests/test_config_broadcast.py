@@ -53,3 +53,20 @@ def test_model_broadcast_places_replicated(rng):
     assert leaves, "no parameters placed"
     for l in leaves:
         assert l.sharding.is_fully_replicated
+
+
+def test_thread_pool_invoke_and_wait():
+    import time
+
+    from bigdl_tpu.utils.thread_pool import ThreadPool
+
+    pool = ThreadPool(4)
+    t0 = time.time()
+    out = pool.invoke_and_wait([lambda i=i: (time.sleep(0.05), i * i)[1]
+                                for i in range(8)])
+    assert out == [i * i for i in range(8)]
+    # parallel (2 waves of 4), comfortably under the 0.4s serial time
+    assert time.time() - t0 < 0.4 * 0.9
+    futs = pool.invoke([lambda: 42])
+    assert futs[0].result() == 42
+    pool.shutdown()

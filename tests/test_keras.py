@@ -215,3 +215,21 @@ def test_merge_concat_axis_semantics(rng):
 
     with pytest.raises(ValueError):
         K.Merge("concat", concat_axis=0)
+
+
+def test_keras_model_serialization_roundtrip(rng, tmp_path):
+    """Keras layers ride the structured serializer like core modules."""
+    from bigdl_tpu.nn import keras as K
+    from bigdl_tpu.nn.module import AbstractModule
+
+    m = (K.Sequential()
+         .add(K.Dense(8, activation="relu", input_shape=(5,)))
+         .add(K.Dense(3, activation="softmax")))
+    m.evaluate()
+    x = rng.randn(4, 5).astype(np.float32)
+    want = np.asarray(m.forward(x))
+    path = str(tmp_path / "keras.bigdl")
+    m.save_module(path)
+    m2 = AbstractModule.load_module(path)
+    m2.evaluate()
+    assert_close(np.asarray(m2.forward(x)), want, atol=1e-6)

@@ -201,3 +201,42 @@ def test_validator_alias():
     from bigdl_tpu.optim.evaluator import Evaluator
 
     assert Validator is Evaluator
+
+
+def test_rmsprop_adagrad_adadelta_trajectories_vs_torch(rng):
+    """Multi-step trajectory parity vs torch.optim on a quadratic."""
+    import jax.numpy as jnp
+    import torch
+
+    from bigdl_tpu.optim import Adadelta, Adagrad, RMSprop
+
+    A = rng.randn(6, 6).astype(np.float32)
+    A = (A @ A.T / 6 + np.eye(6)).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    x0 = rng.randn(6).astype(np.float32)
+
+    def grad_np(x):
+        return A @ x - b
+
+    cases = [
+        (RMSprop(learning_rate=0.01, decay_rate=0.9, epsilon=1e-8),
+         lambda p: torch.optim.RMSprop([p], lr=0.01, alpha=0.9, eps=1e-8)),
+        (Adagrad(learning_rate=0.05),
+         lambda p: torch.optim.Adagrad([p], lr=0.05, eps=1e-10)),
+        (Adadelta(decay_rate=0.9, epsilon=1e-6),
+         lambda p: torch.optim.Adadelta([p], lr=1.0, rho=0.9, eps=1e-6)),
+    ]
+    for ours, theirs in cases:
+        params = {"x": jnp.asarray(x0)}
+        state = ours.init_state(params)
+        pt = torch.from_numpy(x0.copy()).requires_grad_(True)
+        topt = theirs(pt)
+        for _ in range(12):
+            g = {"x": jnp.asarray(grad_np(np.asarray(params["x"])))}
+            params, state = ours.update(g, state, params)
+            topt.zero_grad()
+            pt.grad = torch.from_numpy(grad_np(pt.detach().numpy()))
+            topt.step()
+        np.testing.assert_allclose(
+            np.asarray(params["x"]), pt.detach().numpy(), atol=2e-3,
+            err_msg=type(ours).__name__)
