@@ -345,6 +345,26 @@ def _convert_layer(ltype: str, layer: Dict, lblobs, L) -> Tuple[Any, int]:
         return L["Sigmoid"](), None
     if ltype == "Softmax":
         return L["SoftMax"](), None
+    if ltype == "Flatten":
+        from bigdl_tpu.nn.shape_ops import Reshape
+
+        fp = _one(layer, "flatten_param", {})
+        if _one(fp, "axis", 1) != 1 or _one(fp, "end_axis", -1) != -1:
+            raise NotImplementedError(
+                "Flatten with non-default axis/end_axis is unsupported")
+        return Reshape([-1], batch_mode=True), None
+    if ltype == "AbsVal":
+        from bigdl_tpu.nn.misc import Abs
+
+        return Abs(), None
+    if ltype == "Power":
+        from bigdl_tpu.nn.misc import Power
+
+        p = _one(layer, "power_param", {})
+        # caffe Power = (shift + scale*x)^power — exactly our Power module
+        return Power(float(_one(p, "power", 1.0)),
+                     scale=float(_one(p, "scale", 1.0)),
+                     shift=float(_one(p, "shift", 0.0))), None
     if ltype == "Dropout":
         p = _one(layer, "dropout_param", {})
         return L["Dropout"](float(_one(p, "dropout_ratio", 0.5))), None

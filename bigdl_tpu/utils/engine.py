@@ -54,9 +54,12 @@ class _EngineSingleton:
     """Process-wide runtime state. Mirrors ``object Engine``."""
 
     def __init__(self) -> None:
+        import threading
+
         self._initialized = False
         self._distributed_initialized = False
         self._default_pool = None
+        self._pool_lock = threading.Lock()
         self._node_number = 1
         self._core_number = 1
         self._engine_type = EngineType.TPU
@@ -157,7 +160,9 @@ class _EngineSingleton:
             from bigdl_tpu.utils.thread_pool import ThreadPool
 
             self._ensure_init()
-            self._default_pool = ThreadPool(max(self._core_number, 1))
+            with self._pool_lock:  # concurrent first calls race otherwise
+                if self._default_pool is None:
+                    self._default_pool = ThreadPool(max(self._core_number, 1))
         return self._default_pool
 
     # reference name kept: Engine.model was the compute pool; host-side it

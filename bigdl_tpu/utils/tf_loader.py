@@ -220,6 +220,40 @@ def _lower(node):
         return O.Maximum()
     if op == "Rsqrt":
         return O.Rsqrt()
+    if op == "AddN":
+        from bigdl_tpu.nn.shape_ops import CAddTable
+
+        return CAddTable()
+    if op == "Neg":
+        from bigdl_tpu.nn.layers_extra import Negative
+
+        return Negative()
+    if op == "Softplus":
+        from bigdl_tpu.nn.activations import SoftPlus
+
+        return SoftPlus()
+    if op == "LeakyRelu":
+        from bigdl_tpu.nn.activations import LeakyReLU
+
+        alpha = (node.attr["alpha"].f if "alpha" in node.attr
+                 else 0.2)  # 0.0 is a valid (plain-ReLU) alpha
+        return LeakyReLU(alpha)
+    if op == "Exp":
+        from bigdl_tpu.nn.misc import Exp
+
+        return Exp()
+    if op == "Log":
+        from bigdl_tpu.nn.misc import Log
+
+        return Log()
+    if op == "Sqrt":
+        from bigdl_tpu.nn.misc import Sqrt
+
+        return Sqrt()
+    if op == "Square":
+        from bigdl_tpu.nn.misc import Square
+
+        return Square()
     if op == "Softmax":
         return O.Softmax()
     if op == "Relu":

@@ -238,3 +238,24 @@ def test_caffe_pooling_round_mode_fidelity(rng, tmp_path):
     got = np.asarray(g.forward(x))
     assert got.shape == want.shape
     assert_close(got, want, atol=1e-5)
+
+
+def test_caffe_flatten_power_absval(rng):
+    from bigdl_tpu.utils.caffe_loader import load_caffe
+
+    fw = (rng.randn(3, 8) * 0.3).astype(np.float32)
+    prototxt = """
+    input: "data"
+    layer { name: "abs1" type: "AbsVal" bottom: "data" top: "abs1" }
+    layer { name: "pw" type: "Power" bottom: "abs1" top: "pw"
+            power_param { power: 2.0 scale: 0.5 shift: 1.0 } }
+    layer { name: "flat" type: "Flatten" bottom: "pw" top: "flat" }
+    layer { name: "ip" type: "InnerProduct" bottom: "flat" top: "ip"
+            inner_product_param { num_output: 3 bias_term: false } }
+    """
+    model = _layer("ip", [fw])
+    g = load_caffe(prototxt, model)
+    x = rng.randn(4, 2, 2, 2).astype(np.float32)
+    got = np.asarray(g.forward(x))
+    want = ((np.abs(x) * 0.5 + 1.0) ** 2).reshape(4, 8) @ fw.T
+    assert_close(got, want, atol=1e-4)

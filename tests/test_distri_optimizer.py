@@ -125,3 +125,37 @@ def test_batch_not_divisible_raises():
     opt.retry_times = 1
     with pytest.raises(ValueError, match="divide"):
         opt.optimize()
+
+
+def test_distri_mixed_precision_partitioned():
+    """bf16 compute + partitioned-DP on the 8-device mesh: trains, fp32
+    master shards preserved."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+    rng = np.random.RandomState(1)
+    samples = [Sample((rng.randn(6) * 0.3 + np.eye(3)[i % 3].repeat(2) * 2
+                       ).astype(np.float32), np.int32(i % 3 + 1))
+               for i in range(64)]
+    m = (Sequential().add(Linear(6, 16)).add(ReLU())
+         .add(Linear(16, 3)).add(LogSoftMax()))
+    opt = Optimizer(model=m, dataset=DataSet.distributed(samples),
+                    criterion=ClassNLLCriterion(), batch_size=32,
+                    parameter_mode="partitioned", compress="bf16", mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(Trigger.max_iteration(20))
+    opt.set_compute_dtype("bf16")
+    trained = opt.optimize()
+    ws, _ = trained.parameters()
+    assert all(np.asarray(w).dtype == np.float32 for w in ws)
+    xs = np.stack([np.asarray(s.features[0]) for s in samples])
+    ys = np.asarray([int(np.asarray(s.labels[0])) for s in samples])
+    acc = (np.asarray(trained.evaluate().forward(xs)).argmax(-1) + 1 == ys).mean()
+    assert acc > 0.8, f"distri bf16 training failed, acc={acc}"

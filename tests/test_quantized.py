@@ -99,3 +99,17 @@ def test_quantize_descends_into_wrappers(rng):
     assert isinstance(q.modules[0].layer, QuantizedLinear)
     got = np.asarray(q.forward(x))
     assert _rel_err(got, want) < 0.1
+
+
+def test_quantize_vgg_smoke(rng):
+    """Quantize a real zoo model (VGG-CIFAR); argmax agreement stays high."""
+    from bigdl_tpu.models.vgg import VggForCifar10
+
+    m = VggForCifar10(10, has_dropout=False)
+    m._ensure_params()
+    m.evaluate()
+    x = rng.rand(8, 3, 32, 32).astype(np.float32)
+    want = np.asarray(m.forward(x)).argmax(-1)
+    q = m.quantize()
+    got = np.asarray(q.forward(x)).argmax(-1)
+    assert (got == want).mean() >= 0.75

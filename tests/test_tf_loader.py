@@ -112,3 +112,20 @@ def test_import_depthwise_and_pad(rng):
     in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
     g = load_tf(gd, [in_name], [gd.node[-1].name])
     assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_import_addn_and_unary_ops(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    def net(x):
+        a = tf.nn.softplus(x)
+        b = tf.nn.leaky_relu(x, alpha=0.1)
+        c = tf.exp(-tf.square(x))
+        return tf.add_n([a, b, c])
+
+    x = rng.randn(3, 5).astype(np.float32)
+    gd, frozen = _freeze(net, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
