@@ -1,0 +1,46 @@
+"""Image-classification example: classify an image folder with a zoo model.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``example/imageclassification`` —
+loads a trained model and predicts over an image directory.
+
+    python -m bigdl_tpu.examples.imageclassification \
+        --model ck/model --folder ./images -b 32
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    from bigdl_tpu.examples.loadmodel import load_any
+
+    p = argparse.ArgumentParser(description="classify an image folder")
+    p.add_argument("--model", required=True, help="model snapshot path")
+    p.add_argument("--modelType", default="bigdl",
+                   choices=["bigdl", "caffe", "tf"])
+    p.add_argument("--caffeDefPath", default=None)
+    p.add_argument("--tfInputs", default="input")
+    p.add_argument("--tfOutputs", default="output")
+    p.add_argument("-f", "--folder", required=True,
+                   help="class-per-subdir image directory")
+    p.add_argument("--imageSize", type=int, default=224)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.dataset.image import image_folder_samples
+    from bigdl_tpu.optim.evaluator import Predictor
+
+    model = load_any(args)
+    samples = image_folder_samples(args.folder, image_size=args.imageSize)
+    X = np.stack([np.asarray(s.features[0]) for s in samples])
+    preds = Predictor(model.evaluate()).predict_class(X, args.batchSize)
+    for s, c in zip(samples, preds):
+        print(f"class {int(c)}  (true label {int(np.asarray(s.labels[0]))})")
+    return preds
+
+
+if __name__ == "__main__":
+    main()

@@ -21,3 +21,32 @@ def test_udfpredictor_end_to_end():
 
     labels = udfpredictor.main([])
     assert labels == [1, 2], f"udf misclassified: {labels}"
+
+
+def test_imageclassification_example(tmp_path, rng):
+    from PIL import Image
+
+    from bigdl_tpu.examples import imageclassification
+    from bigdl_tpu.nn import Linear, Sequential, SoftMax
+    from bigdl_tpu.nn.shape_ops import Reshape
+
+    # tiny image folder: 2 classes x 3 images
+    for cls in ("cats", "dogs"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(str(d / f"{i}.png"))
+
+    m = (Sequential().add(Reshape([8 * 8 * 3], batch_mode=True))
+         .add(Linear(8 * 8 * 3, 2)).add(SoftMax()))
+    m._ensure_params()
+    mp = str(tmp_path / "m.bigdl")
+    m.save_module(mp)
+
+    preds = imageclassification.main([
+        "--model", mp, "-f", str(tmp_path / "imgs"), "--imageSize", "8",
+        "-b", "4",
+    ])
+    assert len(preds) == 6
+    assert set(int(p) for p in preds) <= {1, 2}
