@@ -31,12 +31,12 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     from bigdl_tpu.dataset.image import image_folder_samples
-    from bigdl_tpu.optim.evaluator import Predictor
 
     model = load_any(args)
     samples = image_folder_samples(args.folder, image_size=args.imageSize)
     X = np.stack([np.asarray(s.features[0]) for s in samples])
-    preds = Predictor(model.evaluate()).predict_class(X, args.batchSize)
+    # the canonical serving API (handles eval-mode switching internally)
+    preds = model.predict_class(X, batch_size=args.batchSize)
     for s, c in zip(samples, preds):
         print(f"class {int(c)}  (true label {int(np.asarray(s.labels[0]))})")
     return preds

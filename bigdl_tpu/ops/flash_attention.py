@@ -158,6 +158,18 @@ def _pad_seq(x, block):
     return x
 
 
+def _out_struct(shape, dtype, *refs):
+    """ShapeDtypeStruct carrying the UNION of the operands' varying-manual-
+    axes sets, so pallas_call type-checks inside shard_map (check_vma) even
+    when operands vary over different axes."""
+    vma = frozenset()
+    for ref in refs:
+        vma = vma | (getattr(jax.typeof(ref), "vma", None) or frozenset())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
     bh, t, d = q3.shape
     tp = q3.shape[1] + (-q3.shape[1]) % block
@@ -178,8 +190,8 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
             pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tp, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32),
+            _out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
+            _out_struct((bh, tp, 1), jnp.float32, q3, k3, v3),
         ],
         interpret=interpret,
     )(qp, kp, vp)
@@ -203,7 +215,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
         grid=(bh, tp // block),
         in_specs=[blk(d), full(d), full(d), blk(d), blk(1), blk(1)],
         out_specs=blk(d),
-        out_shape=jax.ShapeDtypeStruct((bh, tp, d), q3.dtype),
+        out_shape=_out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
 
@@ -213,8 +225,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
         grid=(bh, tp // block),
         in_specs=[full(d), blk(d), blk(d), full(d), full(1), full(1)],
         out_specs=[blk(d), blk(d)],
-        out_shape=[jax.ShapeDtypeStruct((bh, tp, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, tp, d), v3.dtype)],
+        out_shape=[_out_struct((bh, tp, d), k3.dtype, q3, k3, v3),
+                   _out_struct((bh, tp, d), v3.dtype, q3, k3, v3)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
     return dq[:, :t], dk[:, :k3.shape[1]], dv[:, :v3.shape[1]]
@@ -243,15 +255,10 @@ def _flash_vjp_bwd(scale, causal, block, interpret, res, do3):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block: int = 128,
-                    interpret: Optional[bool] = None):
-    """Fused attention over (B, T, H, D) tensors; differentiable.
-
-    Drop-in for ``bigdl_tpu.parallel.ring_attention.attention`` with
-    O(T) memory. ``block`` is the VMEM tile length (MXU-aligned, 128).
-    ``interpret=None`` auto-selects Pallas interpreter mode off-TPU.
-    """
+def _bthd_plumbing(q, k, v, scale, interpret):
+    """Shared layout/default handling: (B,T,H,D) API ↔ (B*H,T,D) kernels.
+    Returns (q3, k3, v3, scale, interpret, from3) where from3 restores the
+    public layout."""
     if interpret is None:
         interpret = _auto_interpret()
     b, t, h, d = q.shape
@@ -261,6 +268,37 @@ def flash_attention(q, k, v, causal: bool = False,
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
-    o3 = _flash(to3(q), to3(k), to3(v), float(scale), bool(causal),
-                int(block), bool(interpret))
-    return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    def from3(o3):
+        return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return to3(q), to3(k), to3(v), float(scale), bool(interpret), from3
+
+
+def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
+                             block: int = 128,
+                             interpret: Optional[bool] = None):
+    """Forward-only fused attention returning ``(out, lse)`` — the
+    per-query log-sum-exp lets callers merge partial attention blocks with
+    the online-softmax rule (ring attention's flash path). Non-causal.
+    ``out``: (B, T, H, D); ``lse``: (B, H, T) float32.
+    """
+    b, t, h, d = q.shape
+    q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
+        q, k, v, scale, interpret)
+    o3, lse = _flash_fwd(q3, k3, v3, scale, False, int(block), interpret)
+    return from3(o3), lse[..., 0].reshape(b, h, t)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention over (B, T, H, D) tensors; differentiable.
+
+    Drop-in for ``bigdl_tpu.parallel.ring_attention.attention`` with
+    O(T) memory. ``block`` is the VMEM tile length (MXU-aligned, 128).
+    ``interpret=None`` auto-selects Pallas interpreter mode off-TPU.
+    """
+    q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
+        q, k, v, scale, interpret)
+    return from3(_flash(q3, k3, v3, scale, bool(causal), int(block),
+                        interpret))

@@ -53,13 +53,101 @@ def attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, use_flash: bool = False):
     """Blockwise ring attention inside a ``shard_map`` over ``axis_name``.
 
     q/k/v: this chip's sequence shard, (B, T_local, H, D); the global
     sequence is the concatenation over the mesh axis in axis-index order.
     Returns the (B, T_local, H, D) attention output for the local Q block.
+
+    ``use_flash=True`` (non-causal only) computes each K/V block with the
+    Pallas flash kernel and merges blocks by their log-sum-exp — the
+    forward never materializes a (T, T) score block, so T_local can grow
+    to the kernel's O(T) memory limit. Gradients run the einsum ring
+    (remat-style recomputation), so the path stays fully differentiable.
     """
+    if use_flash:
+        if causal:
+            raise ValueError(
+                "ring_attention(use_flash=True) supports causal=False only; "
+                "the causal path uses the einsum ring")
+        sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+        return _get_ring_flash()(q, k, v, axis_name, float(sc))
+    return _ring_einsum(q, k, v, axis_name, causal, scale)
+
+
+def _ring_flash_impl(q, k, v, axis_name: str, scale: float):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigdl_tpu.ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    B, T, H, D = q.shape
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+
+    def body(_, carry):
+        kb, vb, m, l, o_acc = carry
+        o_i, lse_i = flash_attention_with_lse(q, kb, vb, scale)
+        m_new = jnp.maximum(m, lse_i)
+        corr = jnp.exp(m - m_new)          # rescale old accumulators
+        w = jnp.exp(lse_i - m_new)         # this block's weight
+        wq = w.transpose(0, 2, 1)[..., None]        # (B, T, H, 1)
+        cq = corr.transpose(0, 2, 1)[..., None]
+        o_acc = o_acc * cq + o_i.astype(jnp.float32) * wq
+        l = l * corr + w
+        # the last rotation is dead but keeps carry types uniform,
+        # matching the einsum ring's loop shape
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return kb, vb, m_new, l, o_acc
+
+    _, _, _, l, o_acc = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    lq = l.transpose(0, 2, 1)[..., None]
+    return (o_acc / jnp.maximum(lq, 1e-20)).astype(q.dtype)
+
+
+_RING_FLASH = None
+
+
+def _get_ring_flash():
+    """Build the custom-vjp-wrapped flash ring lazily (keeps this module's
+    no-jax-at-import convention)."""
+    global _RING_FLASH
+    if _RING_FLASH is not None:
+        return _RING_FLASH
+    import functools
+
+    import jax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+    def ring_flash(q, k, v, axis_name, scale):
+        return _ring_flash_impl(q, k, v, axis_name, scale)
+
+    def fwd(q, k, v, axis_name, scale):
+        return _ring_flash_impl(q, k, v, axis_name, scale), (q, k, v)
+
+    def bwd(axis_name, scale, res, ct):
+        # backward = vjp of the einsum ring (recomputes — the remat trade)
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _ring_einsum(q_, k_, v_, axis_name, False,
+                                            scale),
+            q, k, v)
+        return vjp(ct)
+
+    ring_flash.defvjp(fwd, bwd)
+    _RING_FLASH = ring_flash
+    return ring_flash
+
+
+def _ring_einsum(q, k, v, axis_name: str, causal: bool = False,
+                 scale: Optional[float] = None):
+    """The einsum-based ring (differentiable; materializes one (T, T)
+    score block per step)."""
     import jax.numpy as jnp
     from jax import lax
 
