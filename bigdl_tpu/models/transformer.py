@@ -1,0 +1,199 @@
+"""Transformer language model — the long-context flagship family.
+
+No reference counterpart (SURVEY.md §5.7: the reference predates
+transformers; its sequence story ends at ``Recurrent``). This family is the
+showcase for the framework's TPU-native extensions working together:
+
+* :class:`MultiHeadAttention` — Pallas flash kernels locally, ring/Ulysses
+  sequence parallelism across chips (``sequence_parallel=``, ``sp_axis=``);
+* :class:`Remat` — gradient checkpointing per block for deep stacks;
+* mixed precision (``Optimizer.set_compute_dtype``) and the full
+  DP/TP/PP/EP planes of ``bigdl_tpu.parallel`` for scale-out.
+
+Built entirely from existing framework modules — the point is that a
+transformer is just another ``Sequential`` here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.nn.attention import MultiHeadAttention
+from bigdl_tpu.nn.containers import Remat, Sequential
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import AbstractModule, TensorModule
+
+
+class LayerNorm(TensorModule):
+    """Per-token layer normalization (transformer-standard; the reference's
+    BatchNormalization normalizes over the batch instead)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-5) -> None:
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.eps = eps
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        return {"weight": jnp.ones((self.hidden_size,), jnp.float32),
+                "bias": jnp.zeros((self.hidden_size,), jnp.float32)}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        xf = input.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+        out = (xf - mean) / jnp.sqrt(var + self.eps)
+        out = out * params["weight"] + params["bias"]
+        return out.astype(input.dtype), state
+
+
+class PositionEmbedding(TensorModule):
+    """Learned absolute positions added to token embeddings (module-level so
+    the structured serializer can resolve it on load)."""
+
+    def __init__(self, max_len: int, hidden_size: int) -> None:
+        super().__init__()
+        self.max_len = max_len
+        self.hidden_size = hidden_size
+
+    def init_params(self, rng):
+        import jax
+
+        return {"pos": 0.02 * jax.random.normal(
+            rng, (self.max_len, self.hidden_size))}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        T = input.shape[1]
+        return input + params["pos"][:T], state
+
+
+class TransformerBlock(AbstractModule):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    def __init__(self, hidden_size: int, n_heads: int, mlp_ratio: int = 4,
+                 causal: bool = True, sequence_parallel: Optional[str] = None,
+                 sp_axis: str = "seq") -> None:
+        super().__init__()
+        self.ln1 = LayerNorm(hidden_size)
+        self.attn = MultiHeadAttention(
+            hidden_size, n_heads, causal=causal,
+            sequence_parallel=sequence_parallel, sp_axis=sp_axis)
+        self.ln2 = LayerNorm(hidden_size)
+        self.fc1 = Linear(hidden_size, mlp_ratio * hidden_size)
+        self.fc2 = Linear(mlp_ratio * hidden_size, hidden_size)
+
+    def sub_modules(self):
+        return [self.ln1, self.attn, self.ln2, self.fc1, self.fc2]
+
+    def _keys(self):
+        return {m: f"{i}:{m.name}" for i, m in enumerate(self.sub_modules())}
+
+    def init_params(self, rng):
+        import jax
+
+        keys = self._keys()
+        ks = jax.random.split(rng, len(keys))
+        return {keys[m]: m.init_params(k) for m, k in zip(keys, ks)}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        keys = self._keys()
+
+        def run(m, x, r=None):
+            out, _ = m.apply(params[keys[m]], x, {}, training=training, rng=r)
+            return out
+
+        h, _ = self.ln1.apply(params[keys[self.ln1]], input)
+        x = input + run(self.attn, h, rng)
+        h, _ = self.ln2.apply(params[keys[self.ln2]], x)
+        h = jax.nn.gelu(run(self.fc1, h))
+        return x + run(self.fc2, h), state
+
+
+def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
+                  n_layers: int = 4, max_len: int = 1024,
+                  mlp_ratio: int = 4, causal: bool = True,
+                  remat: bool = False,
+                  sequence_parallel: Optional[str] = None,
+                  sp_axis: str = "seq") -> Sequential:
+    """GPT-style decoder LM over 1-based token ids ``(B, T)`` →
+    per-position log-probs ``(B, T, vocab)``.
+
+    ``remat=True`` checkpoints each block (long-context memory);
+    ``sequence_parallel="ring"|"ulysses"`` shards the sequence axis across
+    the ``sp_axis`` mesh dimension inside a ``shard_map``.
+    """
+    from bigdl_tpu.nn.activations import LogSoftMax
+    from bigdl_tpu.nn.misc import LookupTable
+
+    model = Sequential()
+    model.add(LookupTable(vocab_size, hidden_size))
+    model.add(PositionEmbedding(max_len, hidden_size))
+    for _ in range(n_layers):
+        block = TransformerBlock(hidden_size, n_heads, mlp_ratio, causal,
+                                 sequence_parallel, sp_axis)
+        model.add(Remat(block) if remat else block)
+    model.add(LayerNorm(hidden_size))
+    model.add(Linear(hidden_size, vocab_size))
+    model.add(LogSoftMax())
+    return model
+
+
+def train_main(argv=None):
+    """Train a small TransformerLM on a synthetic (or ``-f`` text) corpus —
+    mirrors the rnn/PTB main but on the transformer family."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models.utils import run_training, train_parser
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion, TimeDistributedCriterion
+    from bigdl_tpu.optim.optim_method import Adam
+
+    p = train_parser("Transformer language model", batch_size=16,
+                     learning_rate=3e-3, max_epoch=2)
+    p.add_argument("--vocab", type=int, default=100)
+    p.add_argument("--seqLen", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--remat", action="store_true")
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(0)
+    samples = []
+    if args.folder:
+        from bigdl_tpu.dataset.text import (
+            Dictionary, SequenceWindower, simple_tokenize,
+        )
+
+        with open(args.folder) as f:
+            tokens = simple_tokenize(f.read())
+        d = Dictionary([tokens])
+        vocab = d.vocab_size()
+        ids = [d.get_index(t) + 1 for t in tokens]
+        for ls in SequenceWindower(args.seqLen)(iter([ids])):
+            samples.append(Sample(np.asarray(ls.data, np.float32),
+                                  np.asarray(ls.labels, np.float32)))
+    else:
+        vocab = args.vocab
+        for _ in range(args.synthetic):
+            toks = [int(rng.integers(1, vocab + 1))]
+            for _ in range(args.seqLen):
+                toks.append(1 + (toks[-1] + int(rng.integers(0, 3))) % vocab)
+            arr = np.asarray(toks, np.float32)
+            samples.append(Sample(arr[:-1], arr[1:]))
+
+    model = TransformerLM(vocab, hidden_size=args.hidden, n_heads=args.heads,
+                          n_layers=args.layers, max_len=args.seqLen,
+                          remat=args.remat)
+    crit = TimeDistributedCriterion(ClassNLLCriterion())
+    return run_training(model, samples, crit, args,
+                        optim_method=Adam(learning_rate=args.learningRate))
+
+
+if __name__ == "__main__":
+    train_main()

@@ -79,7 +79,13 @@ class MultiHeadAttention(TensorModule):
         k = split(proj(params["wk"], input))
         v = split(proj(params["wv"], input))
         if self.sequence_parallel == "ring":
-            out = ring_attention(q, k, v, self.sp_axis, causal=self.causal)
+            # non-causal ring rides the Pallas flash blocks when allowed
+            ring_flash = (not self.causal) and (
+                self.use_flash == "always"
+                or (self.use_flash == "auto"
+                    and jax.default_backend() == "tpu"))
+            out = ring_attention(q, k, v, self.sp_axis, causal=self.causal,
+                                 use_flash=ring_flash)
         elif self.sequence_parallel == "ulysses":
             out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal)
         elif self.use_flash == "always" or (

@@ -84,10 +84,13 @@ def _ring_flash_impl(q, k, v, axis_name: str, scale: float):
 
     n = lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    B, T, H, D = q.shape
-    o0 = jnp.zeros((B, T, H, D), jnp.float32)
-    m0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
+    # accumulators derived FROM q so they inherit q's device-varying axes —
+    # otherwise the fori_loop carry types mismatch under shard_map's vma
+    # tracking (same workaround as _ring_einsum)
+    base = jnp.sum(q.astype(jnp.float32) * 0.0, axis=-1).transpose(0, 2, 1)
+    o0 = q.astype(jnp.float32) * 0.0          # (B, T, H, D)
+    m0 = base - jnp.inf                       # (B, H, T)
+    l0 = base
 
     def body(_, carry):
         kb, vb, m, l, o_acc = carry

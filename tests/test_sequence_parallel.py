@@ -159,9 +159,10 @@ def test_ring_attention_flash_matches_dense(rng, grad):
     q, k, v = _qkv(rng)
     mesh = _mesh()
 
-    # check_vma=False: the Pallas interpreter can't yet type mixed-vma
-    # dynamic_slice operands (upstream JAX limitation; compiled TPU mode
-    # passes the check — see the flash-ring drive script)
+    # check_vma=False: the Pallas INTERPRETER (used off-TPU) can't type
+    # mixed-vma dynamic_slice operands (upstream JAX limitation). The ring
+    # math itself is vma-correct (accumulators derive from q); compiled
+    # multi-chip TPU runs are not exercisable in this single-chip sandbox.
     ring = jax.jit(jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, "seq", causal=False,
                                        use_flash=True),
