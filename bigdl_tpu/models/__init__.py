@@ -10,10 +10,16 @@ from bigdl_tpu.models.alexnet import AlexNet, AlexNet_OWT
 from bigdl_tpu.models.autoencoder import Autoencoder
 from bigdl_tpu.models.textclassifier import TextClassifier
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
+from bigdl_tpu.models.transformer import (
+    LayerNorm, PositionEmbedding, TransformerBlock, TransformerLM,
+)
+from bigdl_tpu.models.treelstm import BinaryTreeLSTM, TreeLSTMSentiment
 
 __all__ = [
     "LeNet5", "VggForCifar10", "Vgg_16", "Vgg_19", "ResNet",
     "Inception_v1", "Inception_v1_NoAuxClassifier", "Inception_Layer_v1",
     "AlexNet", "AlexNet_OWT", "Autoencoder",
     "TextClassifier", "PTBModel", "SimpleRNN",
+    "TransformerLM", "TransformerBlock", "LayerNorm", "PositionEmbedding",
+    "BinaryTreeLSTM", "TreeLSTMSentiment",
 ]

@@ -19,7 +19,7 @@ from __future__ import annotations
 from typing import Optional
 
 from bigdl_tpu.nn.attention import MultiHeadAttention
-from bigdl_tpu.nn.containers import Remat, Sequential
+from bigdl_tpu.nn.containers import Container, Remat, Sequential
 from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.module import AbstractModule, TensorModule
 
@@ -52,12 +52,17 @@ class LayerNorm(TensorModule):
 
 class PositionEmbedding(TensorModule):
     """Learned absolute positions added to token embeddings (module-level so
-    the structured serializer can resolve it on load)."""
+    the structured serializer can resolve it on load). ``sp_axis`` makes it
+    shard-aware: inside a shard_map over that axis each chip holds a
+    T_local sequence slice, and positions offset by ``axis_index * T_local``
+    so they stay GLOBAL (matching ring attention's causal offsets)."""
 
-    def __init__(self, max_len: int, hidden_size: int) -> None:
+    def __init__(self, max_len: int, hidden_size: int,
+                 sp_axis: Optional[str] = None) -> None:
         super().__init__()
         self.max_len = max_len
         self.hidden_size = hidden_size
+        self.sp_axis = sp_axis
 
     def init_params(self, rng):
         import jax
@@ -67,11 +72,18 @@ class PositionEmbedding(TensorModule):
 
     def apply(self, params, input, state=None, training=False, rng=None):
         T = input.shape[1]
-        return input + params["pos"][:T], state
+        if self.sp_axis is None:
+            return input + params["pos"][:T], state
+        import jax.lax as lax
+
+        start = lax.axis_index(self.sp_axis) * T
+        pos = lax.dynamic_slice_in_dim(params["pos"], start, T)
+        return input + pos, state
 
 
-class TransformerBlock(AbstractModule):
-    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x))."""
+class TransformerBlock(Container):
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)). A ``Container`` so
+    the child-key/init plumbing is the tested shared scheme."""
 
     def __init__(self, hidden_size: int, n_heads: int, mlp_ratio: int = 4,
                  causal: bool = True, sequence_parallel: Optional[str] = None,
@@ -84,34 +96,21 @@ class TransformerBlock(AbstractModule):
         self.ln2 = LayerNorm(hidden_size)
         self.fc1 = Linear(hidden_size, mlp_ratio * hidden_size)
         self.fc2 = Linear(mlp_ratio * hidden_size, hidden_size)
-
-    def sub_modules(self):
-        return [self.ln1, self.attn, self.ln2, self.fc1, self.fc2]
-
-    def _keys(self):
-        return {m: f"{i}:{m.name}" for i, m in enumerate(self.sub_modules())}
-
-    def init_params(self, rng):
-        import jax
-
-        keys = self._keys()
-        ks = jax.random.split(rng, len(keys))
-        return {keys[m]: m.init_params(k) for m, k in zip(keys, ks)}
+        for m in (self.ln1, self.attn, self.ln2, self.fc1, self.fc2):
+            self.add(m)
 
     def apply(self, params, input, state=None, training=False, rng=None):
         import jax
 
-        keys = self._keys()
-
-        def run(m, x, r=None):
-            out, _ = m.apply(params[keys[m]], x, {}, training=training, rng=r)
+        def run(i, x, r=None):
+            m = self.modules[i]
+            out, _ = m.apply(params[self._child_key(i)], x, {},
+                             training=training, rng=r)
             return out
 
-        h, _ = self.ln1.apply(params[keys[self.ln1]], input)
-        x = input + run(self.attn, h, rng)
-        h, _ = self.ln2.apply(params[keys[self.ln2]], x)
-        h = jax.nn.gelu(run(self.fc1, h))
-        return x + run(self.fc2, h), state
+        x = input + run(1, run(0, input), rng)        # attn(ln1(x))
+        h = jax.nn.gelu(run(3, run(2, x)))            # fc1(ln2(x))
+        return x + run(4, h), state
 
 
 def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
@@ -132,7 +131,9 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
 
     model = Sequential()
     model.add(LookupTable(vocab_size, hidden_size))
-    model.add(PositionEmbedding(max_len, hidden_size))
+    model.add(PositionEmbedding(
+        max_len, hidden_size,
+        sp_axis=sp_axis if sequence_parallel else None))
     for _ in range(n_layers):
         block = TransformerBlock(hidden_size, n_heads, mlp_ratio, causal,
                                  sequence_parallel, sp_axis)
@@ -178,6 +179,8 @@ def train_main(argv=None):
         for ls in SequenceWindower(args.seqLen)(iter([ids])):
             samples.append(Sample(np.asarray(ls.data, np.float32),
                                   np.asarray(ls.labels, np.float32)))
+        if not samples:
+            raise ValueError(f"{args.folder}: corpus shorter than --seqLen")
     else:
         vocab = args.vocab
         for _ in range(args.synthetic):

@@ -78,19 +78,16 @@ class MultiHeadAttention(TensorModule):
         q = split(proj(params["wq"], input))
         k = split(proj(params["wk"], input))
         v = split(proj(params["wv"], input))
+        # one flash-eligibility policy for every dispatch branch
+        flash_ok = self.use_flash == "always" or (
+            self.use_flash == "auto" and jax.default_backend() == "tpu")
         if self.sequence_parallel == "ring":
             # non-causal ring rides the Pallas flash blocks when allowed
-            ring_flash = (not self.causal) and (
-                self.use_flash == "always"
-                or (self.use_flash == "auto"
-                    and jax.default_backend() == "tpu"))
             out = ring_attention(q, k, v, self.sp_axis, causal=self.causal,
-                                 use_flash=ring_flash)
+                                 use_flash=flash_ok and not self.causal)
         elif self.sequence_parallel == "ulysses":
             out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal)
-        elif self.use_flash == "always" or (
-                self.use_flash == "auto"
-                and jax.default_backend() == "tpu"):
+        elif flash_ok:
             from bigdl_tpu.ops import flash_attention
 
             out = flash_attention(q, k, v, causal=self.causal)
