@@ -76,6 +76,11 @@ class PositionEmbedding(TensorModule):
             return input + params["pos"][:T], state
         import jax.lax as lax
 
+        n_shards = lax.psum(1, self.sp_axis)  # static axis size
+        if n_shards * T > self.max_len:
+            raise ValueError(
+                f"global sequence {n_shards * T} exceeds max_len "
+                f"{self.max_len} (dynamic_slice would silently clamp)")
         start = lax.axis_index(self.sp_axis) * T
         pos = lax.dynamic_slice_in_dim(params["pos"], start, T)
         return input + pos, state

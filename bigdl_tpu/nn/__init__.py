@@ -38,6 +38,7 @@ from bigdl_tpu.nn.criterion import (
     DistKLDivCriterion,
 )
 from bigdl_tpu.nn.criterion_extra import (
+    ClassSimplexCriterion, CosineProximityCriterion, SoftMarginCriterion,
     CosineDistanceCriterion, CosineEmbeddingCriterion,
     DiceCoefficientCriterion, GaussianCriterion, HingeEmbeddingCriterion,
     KLDCriterion, L1Cost, MarginRankingCriterion, MultiCriterion,
@@ -48,6 +49,8 @@ from bigdl_tpu.nn.init_methods import (
     RandomNormal, Xavier, MsraFiller, BilinearFiller,
 )
 from bigdl_tpu.nn.layers_extra import (
+    Bilinear, GaussianDropout, GaussianNoise, HardShrink, HardSigmoid,
+    SoftShrink, TanhShrink,
     Cosine, CosineDistance, DotProduct, Euclidean, GaussianSampler,
     GradientReversal, Index, L1Penalty, LogSigmoid, Masking, Negative,
     NarrowTable, PairwiseDistance, Replicate, RReLU, Scale, SelectTable,

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import numpy as np
+
 from bigdl_tpu.nn.criterion import AbstractCriterion
 
 
@@ -277,3 +279,65 @@ class CosineDistanceCriterion(AbstractCriterion):
         t = jnp.asarray(target)
         per = 1.0 - cosine_similarity(input, t)
         return _mean_or_sum(jnp.sum(per), self.size_average, per.shape[0])
+
+
+class SoftMarginCriterion(AbstractCriterion):
+    """Two-class logistic loss over ±1 targets:
+    ``mean(log(1 + exp(-y·x)))`` (reference ``SoftMarginCriterion``)."""
+
+    def __init__(self, size_average: bool = True) -> None:
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        import jax
+        import jax.numpy as jnp
+
+        t = jnp.asarray(target)
+        # log(1 + exp(-z)) == -log_sigmoid(z), stable for large |z|
+        per = -jax.nn.log_sigmoid(t * input)
+        return _mean_or_sum(jnp.sum(per), self.size_average, per.size)
+
+
+class CosineProximityCriterion(AbstractCriterion):
+    """``-mean(cos(input, target))`` (reference keras-era
+    ``CosineProximityCriterion``)."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        from bigdl_tpu.nn.layers_extra import cosine_similarity
+
+        return -jnp.mean(cosine_similarity(input, jnp.asarray(target)))
+
+
+class ClassSimplexCriterion(AbstractCriterion):
+    """MSE against simplex-embedded class targets (reference
+    ``ClassSimplexCriterion``): each class maps to a vertex of a regular
+    (nClasses-1)-simplex; the loss is the squared distance to the target
+    vertex."""
+
+    def __init__(self, n_classes: int, size_average: bool = True) -> None:
+        super().__init__()
+        assert n_classes > 1
+        self.n_classes = n_classes
+        self.size_average = size_average
+        self._simplex = self._build_simplex(n_classes)
+
+    @staticmethod
+    def _build_simplex(n: int) -> np.ndarray:
+        # closed form: identity minus centroid, row-normalized — n unit
+        # vectors with equal pairwise angles (a regular simplex in R^n)
+        eye = np.eye(n, dtype=np.float32)
+        v = eye - eye.mean(axis=0, keepdims=True)
+        v /= np.linalg.norm(v, axis=1, keepdims=True)
+        return v
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        t = jnp.asarray(target).astype(jnp.int32).reshape(-1) - 1
+        tv = jnp.asarray(self._simplex)[t]          # (N, n_classes)
+        diff = input - tv
+        loss = jnp.sum(diff * diff)
+        return _mean_or_sum(loss, self.size_average, input.size)

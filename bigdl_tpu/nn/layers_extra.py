@@ -612,3 +612,120 @@ class SpatialUpSamplingBilinear(TensorModule):
         out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
                + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
         return (out[0] if squeeze else out), state
+
+
+class HardSigmoid(TensorModule):
+    """clip(0.2x + 0.5, 0, 1) (reference keras-era ``HardSigmoid``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.clip(0.2 * input + 0.5, 0.0, 1.0), state
+
+
+class TanhShrink(TensorModule):
+    """x - tanh(x) (reference ``TanhShrink``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return input - jnp.tanh(input), state
+
+
+class SoftShrink(TensorModule):
+    """Soft shrinkage (reference ``SoftShrink``)."""
+
+    def __init__(self, the_lambda: float = 0.5) -> None:
+        super().__init__()
+        self.the_lambda = the_lambda
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        lam = self.the_lambda
+        return jnp.where(input > lam, input - lam,
+                         jnp.where(input < -lam, input + lam, 0.0)), state
+
+
+class HardShrink(TensorModule):
+    """Hard shrinkage (reference ``HardShrink``)."""
+
+    def __init__(self, the_lambda: float = 0.5) -> None:
+        super().__init__()
+        self.the_lambda = the_lambda
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        lam = self.the_lambda
+        return jnp.where(jnp.abs(input) > lam, input, 0.0), state
+
+
+class GaussianNoise(TensorModule):
+    """Additive N(0, stddev²) noise in training (reference keras-era
+    ``GaussianNoise``); identity at inference."""
+
+    def __init__(self, stddev: float) -> None:
+        super().__init__()
+        self.stddev = stddev
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        if not training or rng is None:
+            return input, state
+        import jax
+
+        return input + self.stddev * jax.random.normal(
+            rng, input.shape, input.dtype), state
+
+
+class GaussianDropout(TensorModule):
+    """Multiplicative 1+N(0, rate/(1-rate)) noise in training (reference
+    keras-era ``GaussianDropout``); identity at inference."""
+
+    def __init__(self, rate: float) -> None:
+        super().__init__()
+        assert 0.0 <= rate < 1.0
+        self.rate = rate
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        if not training or rng is None or self.rate == 0.0:
+            return input, state
+        import jax
+
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, input.shape, input.dtype)
+        return input * noise, state
+
+
+class Bilinear(AbstractModule):
+    """Two-input bilinear form: ``out_k = x1ᵀ W_k x2 + b_k`` over a Table
+    ``[x1 (N,d1), x2 (N,d2)]`` (reference ``nn/Bilinear.scala``)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True,
+                 init_weight: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.weight_init = init_weight or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": self.weight_init.init(
+            k1, (self.output_size, self.input_size1, self.input_size2))}
+        if self.bias_res:
+            p["bias"] = self.weight_init.init(k2, (self.output_size,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x1, x2 = input
+        out = jnp.einsum("ni,oij,nj->no", x1, params["weight"], x2)
+        if self.bias_res:
+            out = out + params["bias"]
+        return out, state

@@ -156,3 +156,51 @@ def test_cosine_distance_criterion(rng):
                              * np.linalg.norm(t, axis=-1))
     want = (1 - cos).mean()
     assert abs(CosineDistanceCriterion().forward(x, t) - want) < 1e-5
+
+
+def test_softmargin_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import SoftMarginCriterion
+
+    x = rng.randn(4, 6).astype(np.float32)
+    y = np.sign(rng.randn(4, 6)).astype(np.float32)
+    loss = SoftMarginCriterion().forward(x, y)
+    t = torch.nn.SoftMarginLoss()(torch.from_numpy(x), torch.from_numpy(y))
+    assert abs(loss - float(t)) < 1e-5
+
+
+def test_cosine_proximity(rng):
+    from bigdl_tpu.nn import CosineProximityCriterion
+
+    x = rng.randn(5, 8).astype(np.float32)
+    t = rng.randn(5, 8).astype(np.float32)
+    cos = (x * t).sum(-1) / (np.linalg.norm(x, axis=-1)
+                             * np.linalg.norm(t, axis=-1))
+    assert abs(CosineProximityCriterion().forward(x, t) + cos.mean()) < 1e-5
+
+
+def test_class_simplex_criterion(rng):
+    from bigdl_tpu.nn import ClassSimplexCriterion
+
+    C = 4
+    crit = ClassSimplexCriterion(C)
+    # vertices are unit-norm with equal pairwise dot products
+    v = crit._simplex
+    norms = np.linalg.norm(v, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-6)
+    dots = v @ v.T
+    off = dots[~np.eye(C, dtype=bool)]
+    assert np.allclose(off, off[0], atol=1e-6)
+    # loss is zero exactly at the target vertex
+    y = np.array([2.0])
+    assert crit.forward(v[1][None], y) < 1e-10
+    assert crit.forward(np.zeros((1, C), np.float32), y) > 0
+
+
+def test_softmargin_stable_large_logits():
+    from bigdl_tpu.nn import SoftMarginCriterion
+
+    loss = SoftMarginCriterion().forward(
+        np.array([[100.0]], np.float32), np.array([[-1.0]], np.float32))
+    assert np.isfinite(loss) and abs(loss - 100.0) < 1e-3

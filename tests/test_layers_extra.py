@@ -239,3 +239,63 @@ def test_negative(rng):
 
     x = rng.randn(2, 3).astype(np.float32)
     assert_close(np.asarray(Negative().forward(x)), -x)
+
+
+def test_shrink_family_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import HardShrink, HardSigmoid, SoftShrink, TanhShrink
+
+    x = rng.randn(3, 7).astype(np.float32)
+    tx = torch.from_numpy(x)
+    assert_close(np.asarray(TanhShrink().forward(x)),
+                 torch.nn.Tanhshrink()(tx).numpy(), atol=1e-6)
+    assert_close(np.asarray(SoftShrink(0.3).forward(x)),
+                 torch.nn.Softshrink(0.3)(tx).numpy(), atol=1e-6)
+    assert_close(np.asarray(HardShrink(0.3).forward(x)),
+                 torch.nn.Hardshrink(0.3)(tx).numpy(), atol=1e-6)
+    # keras hard_sigmoid: clip(0.2x+0.5, 0, 1)
+    assert_close(np.asarray(HardSigmoid().forward(x)),
+                 np.clip(0.2 * x + 0.5, 0, 1), atol=1e-6)
+
+
+def test_gaussian_noise_dropout(rng):
+    from bigdl_tpu.nn import GaussianDropout, GaussianNoise
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(9)
+    x = np.ones((2000, 4), np.float32)
+    gn = GaussianNoise(0.5)
+    gn._ensure_params()
+    gn.training()
+    out = np.asarray(gn.forward(x))
+    assert abs((out - x).std() - 0.5) < 0.05
+    gn.evaluate()
+    assert_close(np.asarray(gn.forward(x)), x)
+
+    gd = GaussianDropout(0.2)
+    gd._ensure_params()
+    gd.training()
+    out = np.asarray(gd.forward(x))
+    assert abs(out.mean() - 1.0) < 0.05
+    gd.evaluate()
+    assert_close(np.asarray(gd.forward(x)), x)
+
+
+def test_bilinear_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import Bilinear
+
+    m = Bilinear(5, 6, 3)
+    m._ensure_params()
+    x1 = rng.randn(4, 5).astype(np.float32)
+    x2 = rng.randn(4, 6).astype(np.float32)
+    out = np.asarray(m.forward([x1, x2]))
+
+    tb = torch.nn.Bilinear(5, 6, 3)
+    with torch.no_grad():
+        tb.weight.copy_(torch.from_numpy(np.asarray(m.params["weight"])))
+        tb.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    want = tb(torch.from_numpy(x1), torch.from_numpy(x2)).detach().numpy()
+    assert_close(out, want, atol=1e-4)

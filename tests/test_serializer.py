@@ -113,6 +113,13 @@ FACTORIES = {
     "Power": (lambda: nn.Power(2.0), np.abs(x(2, 3)) + 0.1),
     "ReLU": (lambda: nn.ReLU(), x(2, 3)),
     "ReLU6": (lambda: nn.ReLU6(), x(2, 3)),
+    "Bilinear": (lambda: nn.Bilinear(4, 5, 3), [x(2, 4), x(2, 5)]),
+    "GaussianDropout": (lambda: nn.GaussianDropout(0.3), x(2, 3)),
+    "GaussianNoise": (lambda: nn.GaussianNoise(0.5), x(2, 3)),
+    "HardShrink": (lambda: nn.HardShrink(), x(2, 3)),
+    "HardSigmoid": (lambda: nn.HardSigmoid(), x(2, 3)),
+    "SoftShrink": (lambda: nn.SoftShrink(), x(2, 3)),
+    "TanhShrink": (lambda: nn.TanhShrink(), x(2, 3)),
     "Cosine": (lambda: nn.Cosine(4, 3), x(2, 4)),
     "CosineDistance": (lambda: nn.CosineDistance(), [x(2, 4), x(2, 4)]),
     "DotProduct": (lambda: nn.DotProduct(), [x(2, 4), x(2, 4)]),

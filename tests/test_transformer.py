@@ -125,3 +125,26 @@ def test_transformer_serialization_roundtrip(rng, tmp_path):
     m2 = AbstractModule.load_module(path)
     m2.evaluate()
     assert_close(np.asarray(m2.forward(ids)), want, atol=1e-6)
+
+
+def test_transformer_lm_remat_wiring(rng):
+    """TransformerLM(remat=True): the Sequential/Remat key plumbing trains."""
+    import jax
+
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(6)
+    m = TransformerLM(16, hidden_size=16, n_heads=2, n_layers=2, max_len=8,
+                      remat=True)
+    m._ensure_params()
+    ids = (rng.randint(1, 17, size=(2, 8))).astype(np.float32)
+    out = np.asarray(m.forward(ids))
+    assert out.shape == (2, 8, 16) and np.all(np.isfinite(out))
+
+    g = jax.grad(lambda p: (m.apply(p, ids, m.state, training=True,
+                                    rng=jax.random.PRNGKey(0))[0] ** 2).sum())(
+        m.params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert leaves and all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+    assert any(np.abs(np.asarray(l)).sum() > 0 for l in leaves)
