@@ -1,0 +1,88 @@
+"""Language-model example: train the PTB RNN LM, then generate with beam
+search.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``example/languagemodel`` — trains the
+``models/rnn`` PTB model on a tokenized corpus. This example adds the
+decode-side story: after training, the LM drives ``SequenceBeamSearch``
+(one compiled ``lax.scan``) to generate continuations.
+
+    python -m bigdl_tpu.examples.languagemodel --synthetic 256 --maxEpoch 1 \
+        --beam 4 --genLen 12
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def lm_step_fn(model):
+    """Build ``symbols_to_logits(params_ignored, tokens, carry)`` from a
+    trained ``PTBModel``-shaped Sequential (LookupTable → N×Recurrent(cell)
+    → TimeDistributed(Linear) → LogSoftMax), any ``num_layers``."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import LookupTable, MultiRNNCell, Recurrent, TimeDistributed
+
+    lookup = model.modules[0]
+    assert isinstance(lookup, LookupTable), "PTBModel-shaped model expected"
+    recs = [(i, m) for i, m in enumerate(model.modules)
+            if isinstance(m, Recurrent)]
+    td_i, td = next((i, m) for i, m in enumerate(model.modules)
+                    if isinstance(m, TimeDistributed))
+
+    p = model.params
+    lookup_p = p[model._child_key(0)]
+    cell_ps = [p[model._child_key(i)][m._key()] for i, m in recs]
+    lin_p = p[model._child_key(td_i)][td._key()]
+
+    # drive the whole stack as one cell (params re-keyed to the stack's
+    # naming so MultiRNNCell.step can dispatch)
+    stack = MultiRNNCell([m.cell for _, m in recs])
+    stack_p = {stack._key(i, c): cp
+               for i, (c, cp) in enumerate(zip(stack.cells, cell_ps))}
+
+    def step(params, tokens, carry):
+        # beam tokens are 0-based class indices; word id = token + 1, and the
+        # embedding row for word id w is w - 1 — so the row IS the token
+        emb = jnp.take(lookup_p["weight"], tokens, axis=0)
+        out, new_carry = stack.step(stack_p, emb, carry)
+        logits = jnp.matmul(out, lin_p["weight"].T) + lin_p["bias"]
+        return logits, new_carry
+
+    return step, stack
+
+
+def main(argv=None):
+    import jax
+
+    from bigdl_tpu.models.rnn import train_main
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    import argparse
+
+    p = argparse.ArgumentParser(description="LM train + beam-search generate")
+    p.add_argument("--beam", type=int, default=4)
+    p.add_argument("--genLen", type=int, default=12)
+    p.add_argument("--sos", type=int, default=1)
+    known, rest = p.parse_known_args(argv)
+
+    model = train_main(rest)
+    step, cell = lm_step_fn(model)
+    vocab = model.modules[0].n_index
+
+    K = known.beam
+    carry0 = jax.tree_util.tree_map(
+        lambda x: np.tile(np.asarray(x), (K,) + (1,) * (np.asarray(x).ndim - 1)),
+        cell.init_carry(1))
+    seqs, scores = beam_search(
+        step, None, carry0, 1, K, vocab, known.genLen,
+        sos_id=known.sos - 1, eos_id=vocab + 7, alpha=0.6)  # eos unreachable
+    for k in range(K):
+        # report 1-based word ids (class index + 1)
+        ids = " ".join(str(int(t) + 1) for t in np.asarray(seqs)[0, k])
+        print(f"beam {k}  score {float(np.asarray(scores)[0, k]):8.3f}  {ids}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

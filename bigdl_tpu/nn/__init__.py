@@ -29,7 +29,7 @@ from bigdl_tpu.nn.misc import (
 from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, BiRecurrent,
-    RecurrentDecoder, TimeDistributed,
+    RecurrentDecoder, TimeDistributed, MultiRNNCell,
 )
 from bigdl_tpu.nn.criterion import (
     AbstractCriterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,
@@ -59,6 +59,24 @@ from bigdl_tpu.nn.layers_extra import (
     Threshold, VolumetricAveragePooling, VolumetricConvolution,
     VolumetricMaxPooling,
 )
+from bigdl_tpu.nn.layers_more import (
+    Pack, Tile, Reverse, InferReshape, BifurcateSplitTable, MixtureTable,
+    MaskedSelect, DenseToSparse, SReLU, Maxout, TemporalMaxPooling,
+    UpSampling1D, UpSampling3D, Cropping2D, Cropping3D,
+    VolumetricFullConvolution, LocallyConnected1D, LocallyConnected2D,
+    SpatialShareConvolution, SpatialSeparableConvolution,
+    SpatialDropout1D, SpatialDropout2D, SpatialDropout3D,
+    SpatialWithinChannelLRN, SpatialSubtractiveNormalization,
+    SpatialDivisiveNormalization, SpatialContrastiveNormalization,
+    NegativeEntropyPenalty,
+)
+from bigdl_tpu.nn.criterion_more import (
+    L1HingeEmbeddingCriterion, PoissonCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    KullbackLeiblerDivergenceCriterion, CategoricalCrossEntropy,
+    TimeDistributedMaskCriterion,
+)
+from bigdl_tpu.nn.beam_search import SequenceBeamSearch, beam_search
 from bigdl_tpu.nn.sparse import SparseLinear, SparseJoinTable
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear, QuantizedSpatialConvolution, Quantizer,

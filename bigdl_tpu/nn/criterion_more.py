@@ -1,0 +1,126 @@
+"""Third criterion batch (SURVEY.md §2.2 "~30 criterions" inventory).
+
+Reference (UNVERIFIED, SURVEY.md §0): one class per file under
+``.../bigdl/nn/`` — ``L1HingeEmbeddingCriterion``, ``PoissonCriterion``,
+``TimeDistributedMaskCriterion``, plus the keras-heritage regression losses
+(``MeanAbsolutePercentageCriterion``, ``MeanSquaredLogarithmicCriterion``,
+``KullbackLeiblerDivergenceCriterion``, ``CategoricalCrossEntropy``).
+
+All are pure scalar ``apply(input, target)`` functions (jit-fusable into the
+train step); ``backward`` = ``jax.grad`` via the base class.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.nn.criterion import AbstractCriterion
+
+_EPS = 1e-7
+
+
+class L1HingeEmbeddingCriterion(AbstractCriterion):
+    """Table input ``[x1, x2]`` with target ±1: L1 distance ``d`` between the
+    pair; loss ``d`` for similar pairs (y=1), ``max(0, margin − d)`` for
+    dissimilar (y=−1) (reference ``nn/L1HingeEmbeddingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 1.0) -> None:
+        super().__init__()
+        self.margin = margin
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        x1, x2 = input
+        d = jnp.sum(jnp.abs(x1 - x2))
+        y = jnp.reshape(target, ())
+        return jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+
+
+class PoissonCriterion(AbstractCriterion):
+    """Poisson regression NLL ``mean(pred − target·log(pred))`` (reference
+    ``nn/PoissonCriterion.scala``)."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        return jnp.mean(input - target * jnp.log(jnp.maximum(input, _EPS)))
+
+
+class MeanAbsolutePercentageCriterion(AbstractCriterion):
+    """``100 · mean(|t − p| / clamp(|t|, eps))`` (reference
+    ``nn/MeanAbsolutePercentageCriterion.scala``)."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        diff = jnp.abs(target - input) / jnp.maximum(jnp.abs(target), _EPS)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(AbstractCriterion):
+    """``mean((log(t+1) − log(p+1))²)`` with inputs clamped to ≥ eps
+    (reference ``nn/MeanSquaredLogarithmicCriterion.scala``)."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        lp = jnp.log(jnp.maximum(input, _EPS) + 1.0)
+        lt = jnp.log(jnp.maximum(target, _EPS) + 1.0)
+        return jnp.mean((lt - lp) ** 2)
+
+
+class KullbackLeiblerDivergenceCriterion(AbstractCriterion):
+    """Keras-style KL divergence ``mean_rows Σ t·log(t/p)`` with both sides
+    clipped to [eps, 1] (reference
+    ``nn/KullbackLeiblerDivergenceCriterion.scala``). Distinct from
+    ``DistKLDivCriterion`` (log-prob input) and ``KLDCriterion`` (VAE prior)."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        p = jnp.clip(input, _EPS, 1.0)
+        t = jnp.clip(target, _EPS, 1.0)
+        per_row = jnp.sum(t * jnp.log(t / p), axis=-1)
+        return jnp.mean(per_row)
+
+
+class CategoricalCrossEntropy(AbstractCriterion):
+    """Cross entropy over PROBABILITY input with one-hot targets (reference
+    ``nn/CategoricalCrossEntropy.scala``, keras heritage) — unlike
+    ``ClassNLLCriterion`` (log-prob + class-index target)."""
+
+    def apply(self, input, target):
+        import jax.numpy as jnp
+
+        p = jnp.clip(input, _EPS, 1.0 - _EPS)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        return jnp.mean(-jnp.sum(target * jnp.log(p), axis=-1))
+
+
+class TimeDistributedMaskCriterion(AbstractCriterion):
+    """Per-timestep criterion that MASKS padded steps — steps whose target
+    equals ``padding_value`` contribute nothing, and the mean divides by the
+    number of real steps (reference ``nn/TimeDistributedMaskCriterion.scala``).
+
+    TPU-native: instead of slicing per step, the wrapped criterion is vmapped
+    over (batch·time) and multiplied by the mask — static shapes, one fused
+    reduction."""
+
+    def __init__(self, critrn: AbstractCriterion,
+                 padding_value: int = 0) -> None:
+        super().__init__()
+        self.critrn = critrn
+        self.padding_value = padding_value
+
+    def apply(self, input, target):
+        import jax
+        import jax.numpy as jnp
+
+        b, t = input.shape[0], input.shape[1]
+        flat_in = input.reshape((b * t,) + input.shape[2:])
+        flat_tg = target.reshape((b * t,) + target.shape[2:])
+        per = jax.vmap(lambda i, g: self.critrn.apply(i[None], g[None]))(
+            flat_in, flat_tg)
+        mask_nd = (flat_tg != self.padding_value)
+        mask = mask_nd if mask_nd.ndim == 1 else mask_nd.reshape(b * t, -1).any(axis=-1)
+        mask = mask.astype(per.dtype)
+        return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)

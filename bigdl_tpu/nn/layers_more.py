@@ -1,0 +1,864 @@
+"""Third layer-breadth batch (SURVEY.md §2.2 "~150 layers" inventory).
+
+Reference (UNVERIFIED, SURVEY.md §0): one class per file under
+``.../bigdl/nn/`` — table/shape utilities (``Pack``, ``Tile``, ``Reverse``,
+``InferReshape``, ``BifurcateSplitTable``, ``MixtureTable``,
+``MaskedSelect``), keras-heritage activations (``SReLU``, ``Maxout``),
+unshared/locally-connected and separable convolutions
+(``LocallyConnected1D/2D``, ``SpatialSeparableConvolution``,
+``SpatialShareConvolution``), volumetric transposed convolution,
+temporal pooling, up-sampling/cropping, channel-wise dropout
+(``SpatialDropout1D/2D/3D``), and the LeCun-era local normalization family
+(``SpatialWithinChannelLRN``, ``SpatialSubtractiveNormalization``,
+``SpatialDivisiveNormalization``, ``SpatialContrastiveNormalization``).
+
+TPU-native notes: everything stays statically shaped for XLA except
+``MaskedSelect``/``DenseToSparse`` which are host-side by nature (their
+output shape is data-dependent); locally-connected layers lower to
+``conv_general_dilated_patches`` + one einsum (a single MXU contraction
+instead of the reference's per-position gemm loop); the normalization
+family lowers to ``lax.conv_general_dilated`` with SAME-style coverage
+correction so it fuses under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.nn.init_methods import (
+    InitializationMethod, RandomUniform, Xavier, Zeros,
+)
+from bigdl_tpu.nn.module import AbstractModule, TensorModule
+from bigdl_tpu.nn.shape_ops import _axis
+
+
+# ---------------------------------------------------------------------------
+# table / shape utilities
+# ---------------------------------------------------------------------------
+
+class Pack(AbstractModule):
+    """Stack a table of same-shaped tensors along a new 1-based ``dim``
+    (reference ``nn/Pack.scala``)."""
+
+    def __init__(self, dim: int = 1) -> None:
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        seq = input if isinstance(input, (list, tuple)) else [input]
+        return jnp.stack(list(seq), axis=self.dim - 1), state
+
+
+class Tile(AbstractModule):
+    """Concatenate ``copies`` copies of the input along 1-based ``dim``
+    (reference ``nn/Tile.scala``)."""
+
+    def __init__(self, dim: int = 1, copies: int = 2) -> None:
+        super().__init__()
+        self.dim = dim
+        self.copies = copies
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.dim, input.ndim)
+        return jnp.concatenate([input] * self.copies, axis=ax), state
+
+
+class Reverse(AbstractModule):
+    """Flip the input along 1-based ``dim`` (reference ``nn/Reverse.scala``;
+    used by ``BiRecurrent`` for the backward leg)."""
+
+    def __init__(self, dim: int = 1) -> None:
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.flip(input, axis=_axis(self.dim, input.ndim)), state
+
+
+class InferReshape(AbstractModule):
+    """Reshape with inference: ``-1`` infers one dim, ``0`` copies the input's
+    dim at the same position (reference ``nn/InferReshape.scala``).
+    ``batch_mode=True`` preserves the leading batch dim."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False) -> None:
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def _target(self, in_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        body = in_shape[1:] if self.batch_mode else in_shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(body[i])
+            else:
+                out.append(s)  # -1 handled by reshape itself
+        if self.batch_mode:
+            return (in_shape[0],) + tuple(out)
+        return tuple(out)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.reshape(input, self._target(input.shape)), state
+
+
+class BifurcateSplitTable(AbstractModule):
+    """Split a tensor into two halves along 1-based ``dim`` → table of two
+    (reference ``nn/BifurcateSplitTable.scala``)."""
+
+    def __init__(self, dim: int = 1) -> None:
+        super().__init__()
+        self.dim = dim
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        ax = _axis(self.dim, input.ndim)
+        n = input.shape[ax]
+        assert n % 2 == 0, "BifurcateSplitTable needs an even dim"
+        a, b = jnp.split(input, 2, axis=ax)
+        return [a, b], state
+
+
+class MixtureTable(AbstractModule):
+    """Mixture-of-experts combine: table ``[gater (B,E), experts]`` where
+    experts is a table of E tensors ``(B, ...)`` or one tensor ``(B, E, ...)``;
+    output = gate-weighted sum over experts (reference ``nn/MixtureTable.scala``).
+
+    TPU-native: the table form stacks once and contracts with an einsum —
+    XLA turns it into a single fused reduce, no per-expert loop.
+    """
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        gater, experts = input[0], input[1]
+        if isinstance(experts, (list, tuple)):
+            experts = jnp.stack(list(experts), axis=1)  # (B, E, ...)
+        g = gater.reshape(gater.shape + (1,) * (experts.ndim - 2))
+        return jnp.sum(g * experts, axis=1), state
+
+
+class MaskedSelect(AbstractModule):
+    """Table ``[x, mask]`` → 1-D tensor of the elements where mask is nonzero
+    (reference ``nn/MaskedSelect.scala``).
+
+    Output shape is data-dependent, so this is a HOST-side op (outside jit) —
+    the same boundary the reference drew by running it on the JVM heap.
+    """
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, mask = input
+        xh = np.asarray(x)
+        mh = np.asarray(mask).astype(bool)
+        return jnp.asarray(xh[mh]), state
+
+
+class DenseToSparse(AbstractModule):
+    """Convert a dense tensor to the fixed-capacity COO ``SparseTensor``
+    (reference ``nn/DenseToSparse.scala``). Host-side: nnz is data-dependent;
+    pass ``capacity`` to pre-pad for a downstream jitted sparse layer."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        super().__init__()
+        self.capacity = capacity
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        from bigdl_tpu.tensor.sparse import SparseTensor
+
+        return SparseTensor.from_dense(np.asarray(input), self.capacity), state
+
+
+# ---------------------------------------------------------------------------
+# parameterized activations
+# ---------------------------------------------------------------------------
+
+class SReLU(TensorModule):
+    """S-shaped ReLU (reference ``nn/SReLU.scala``, keras heritage):
+
+    ``f(x) = t_r + a_r (x - t_r)`` for ``x >= t_r``; ``x`` in the middle band;
+    ``t_l + a_l (x - t_l)`` for ``x <= t_l`` — all four thresholds/slopes
+    learned per-feature, with ``shared_axes`` collapsing broadcast axes."""
+
+    def __init__(self, shape: Sequence[int],
+                 shared_axes: Optional[Sequence[int]] = None) -> None:
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+        self.shared_axes = tuple(shared_axes or ())
+
+    def _param_shape(self) -> Tuple[int, ...]:
+        return tuple(
+            1 if (i + 1) in self.shared_axes else s
+            for i, s in enumerate(self.shape)
+        )
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        shp = self._param_shape()
+        k = Xavier().init(rng, shp).astype(jnp.float32)
+        return {
+            "t_left": jnp.zeros(shp, jnp.float32),
+            "a_left": jnp.full(shp, 0.0, jnp.float32),
+            "t_right": k,
+            "a_right": jnp.ones(shp, jnp.float32),
+        }
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        out = jnp.where(input >= tr, tr + ar * (input - tr), input)
+        out = jnp.where(input <= tl, tl + al * (input - tl), out)
+        return out, state
+
+
+class Maxout(TensorModule):
+    """Maxout feature layer (reference ``nn/Maxout.scala``): a Linear to
+    ``output_size * maxout_number`` followed by max over each pool — one MXU
+    gemm + a reshape/reduce that XLA fuses."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.with_bias = with_bias
+        self.weight_init = init_weight or Xavier()
+        self.bias_init = init_bias or Zeros()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        n = self.output_size * self.maxout_number
+        p = {"weight": self.weight_init.init(k1, (n, self.input_size))}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (n,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        h = jnp.matmul(input, params["weight"].T)
+        if self.with_bias:
+            h = h + params["bias"]
+        h = h.reshape(h.shape[:-1] + (self.output_size, self.maxout_number))
+        return jnp.max(h, axis=-1), state
+
+
+# ---------------------------------------------------------------------------
+# temporal pooling / up-sampling / cropping
+# ---------------------------------------------------------------------------
+
+class TemporalMaxPooling(TensorModule):
+    """Max pooling over the time axis of ``(B, T, F)`` / ``(T, F)`` input
+    (reference ``nn/TemporalMaxPooling.scala``)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None) -> None:
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input
+        out = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, self.k_w, 1),
+            window_strides=(1, self.d_w, 1),
+            padding="VALID",
+        )
+        return (out[0] if squeeze else out), state
+
+
+class UpSampling1D(TensorModule):
+    """Repeat each timestep ``length`` times: ``(B, T, F) → (B, T*length, F)``
+    (reference ``nn/UpSampling1D.scala``)."""
+
+    def __init__(self, length: int = 2) -> None:
+        super().__init__()
+        self.length = length
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.repeat(input, self.length, axis=-2), state
+
+
+class UpSampling3D(TensorModule):
+    """Nearest-neighbor volumetric up-sampling of NCDHW input by integer
+    factors (reference ``nn/UpSampling3D.scala``)."""
+
+    def __init__(self, size: Sequence[int] = (2, 2, 2)) -> None:
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        out = input
+        for i, f in enumerate(self.size):
+            out = jnp.repeat(out, f, axis=out.ndim - 3 + i)
+        return out, state
+
+
+class Cropping2D(TensorModule):
+    """Crop rows/cols off NCHW input: ``height_crop=(top, bottom)``,
+    ``width_crop=(left, right)`` (reference ``nn/Cropping2D.scala``)."""
+
+    def __init__(self, height_crop: Sequence[int] = (0, 0),
+                 width_crop: Sequence[int] = (0, 0),
+                 data_format: str = "NCHW") -> None:
+        super().__init__()
+        self.hc = tuple(height_crop)
+        self.wc = tuple(width_crop)
+        assert data_format in ("NCHW", "NHWC")
+        self.data_format = data_format
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        (t, b), (l, r) = self.hc, self.wc
+        h_ax = -3 if self.data_format == "NHWC" else -2
+        w_ax = -2 if self.data_format == "NHWC" else -1
+        idx = [slice(None)] * input.ndim
+        idx[h_ax] = slice(t, input.shape[h_ax] - b)
+        idx[w_ax] = slice(l, input.shape[w_ax] - r)
+        return input[tuple(idx)], state
+
+
+class Cropping3D(TensorModule):
+    """Crop the three spatial dims of NCDHW input (reference
+    ``nn/Cropping3D.scala``)."""
+
+    def __init__(self, dim1_crop: Sequence[int] = (0, 0),
+                 dim2_crop: Sequence[int] = (0, 0),
+                 dim3_crop: Sequence[int] = (0, 0)) -> None:
+        super().__init__()
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        idx = [slice(None)] * input.ndim
+        for i, (lo, hi) in enumerate(self.crops):
+            ax = input.ndim - 3 + i
+            idx[ax] = slice(lo, input.shape[ax] - hi)
+        return input[tuple(idx)], state
+
+
+# ---------------------------------------------------------------------------
+# convolution variants
+# ---------------------------------------------------------------------------
+
+class VolumetricFullConvolution(TensorModule):
+    """3-D transposed convolution over NCDHW input (reference
+    ``nn/VolumetricFullConvolution.scala``) — conv with lhs dilation, the
+    gradient-of-conv formulation (mirrors ``SpatialFullConvolution``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.k = (k_t, k_h, k_w)
+        self.d = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        kt, kh, kw = self.k
+        w_shape = (self.n_input_plane, self.n_output_plane // self.n_group,
+                   kt, kh, kw)
+        p = {"weight": self.weight_init.init(k1, w_shape)}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k2, (self.n_output_plane,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        g = self.n_group
+        kt, kh, kw = self.k
+        w = params["weight"]
+        in_pl = w.shape[0]
+        w = w.reshape(g, in_pl // g, -1, kt, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(-1, in_pl // g, kt, kh, kw)
+        w = w[:, :, ::-1, ::-1, ::-1]
+        pads = tuple(
+            (k - 1 - p, k - 1 - p + a)
+            for k, p, a in zip(self.k, self.pad, self.adj)
+        )
+        out = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=self.d, feature_group_count=g,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None, None]
+        return (out[0] if squeeze else out), state
+
+
+class LocallyConnected2D(TensorModule):
+    """Unshared convolution: a distinct kernel per output position
+    (reference ``nn/LocallyConnected2D.scala``).
+
+    TPU-native: patches via ``conv_general_dilated_patches`` then ONE einsum
+    ``(N,K,P) × (P,O,K) → (N,O,P)`` — a single batched MXU contraction in
+    place of the reference's per-position gemm loop."""
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.input_width = input_width
+        self.input_height = input_height
+        self.n_output_plane = n_output_plane
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.with_bias = with_bias
+        self.weight_init = init_weight or Xavier()
+        self.bias_init = init_bias or Zeros()
+        self.out_h = (input_height + 2 * pad_h - kernel_h) // stride_h + 1
+        self.out_w = (input_width + 2 * pad_w - kernel_w) // stride_w + 1
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        npos = self.out_h * self.out_w
+        kdim = self.n_input_plane * self.kernel_h * self.kernel_w
+        p = {"weight": self.weight_init.init(
+            k1, (npos, self.n_output_plane, kdim))}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(
+                k2, (self.n_output_plane, self.out_h, self.out_w))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernel_h, self.kernel_w),
+            (self.stride_h, self.stride_w),
+            ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # (N, C*kh*kw, oh, ow)
+        n = patches.shape[0]
+        k = patches.shape[1]
+        patches = patches.reshape(n, k, -1)                   # (N, K, P)
+        out = jnp.einsum("nkp,pok->nop", patches, params["weight"])
+        out = out.reshape(n, self.n_output_plane, self.out_h, self.out_w)
+        if self.with_bias:
+            out = out + params["bias"][None]
+        return (out[0] if squeeze else out), state
+
+
+class LocallyConnected1D(TensorModule):
+    """Unshared temporal convolution over ``(B, T, F)`` input (reference
+    ``nn/LocallyConnected1D.scala``); weight per output frame."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.with_bias = with_bias
+        self.weight_init = init_weight or Xavier()
+        self.bias_init = init_bias or Zeros()
+        self.out_t = (n_input_frame - kernel_w) // stride_w + 1
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        kdim = self.input_frame_size * self.kernel_w
+        p = {"weight": self.weight_init.init(
+            k1, (self.out_t, self.output_frame_size, kdim))}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(
+                k2, (self.out_t, self.output_frame_size))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input            # (B, T, F)
+        # one patch-extraction op (feature-major (F, k) flattened channels),
+        # then a single batched MXU contraction — no per-position slicing
+        patches = lax.conv_general_dilated_patches(
+            jnp.swapaxes(x, 1, 2), (self.kernel_w,), (self.stride_w,),
+            "VALID", dimension_numbers=("NCH", "OIH", "NCH"),
+        )                                                 # (B, F*k, oT)
+        patches = jnp.swapaxes(patches, 1, 2)             # (B, P, K)
+        out = jnp.einsum("bpk,pok->bpo", patches, params["weight"])
+        if self.with_bias:
+            out = out + params["bias"][None]
+        return (out[0] if squeeze else out), state
+
+
+class SpatialShareConvolution(TensorModule):
+    """Reference ``nn/SpatialShareConvolution.scala`` — numerically identical
+    to ``SpatialConvolution``; the reference variant only shares its im2col
+    buffers across clones. With XLA there are no such buffers, so this is the
+    same MXU convolution (kept as its own class for API parity)."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__()
+        from bigdl_tpu.nn.conv import SpatialConvolution
+
+        self._conv = SpatialConvolution(*args, **kwargs)
+        # mirror attrs for repr/introspection parity
+        self.n_input_plane = self._conv.n_input_plane
+        self.n_output_plane = self._conv.n_output_plane
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        self._conv.set_init_method(weight_init, bias_init)
+        return self
+
+    def init_params(self, rng):
+        return self._conv.init_params(rng)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return self._conv.apply(params, input, state, training, rng)
+
+
+class SpatialSeparableConvolution(TensorModule):
+    """Depthwise-separable convolution (reference
+    ``nn/SpatialSeparableConvolution.scala``): depthwise conv with
+    ``depth_multiplier`` channels per input plane, then a 1×1 pointwise conv.
+    Lowers to two ``conv_general_dilated`` calls — the depthwise leg uses
+    ``feature_group_count = n_input_channel`` (XLA's native depthwise path)."""
+
+    def __init__(self, n_input_channel: int, n_output_channel: int,
+                 depth_multiplier: int, k_w: int, k_h: int,
+                 s_w: int = 1, s_h: int = 1, p_w: int = 0, p_h: int = 0,
+                 with_bias: bool = True,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        self.n_input_channel = n_input_channel
+        self.n_output_channel = n_output_channel
+        self.depth_multiplier = depth_multiplier
+        self.k = (k_h, k_w)
+        self.s = (s_h, s_w)
+        self.p = (p_h, p_w)
+        self.with_bias = with_bias
+        self.weight_init = init_weight or Xavier()
+        self.bias_init = init_bias or Zeros()
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2, k3 = jax.random.split(rng, 3)
+        kh, kw = self.k
+        depth_w = self.weight_init.init(
+            k1, (self.n_input_channel * self.depth_multiplier, 1, kh, kw))
+        point_w = self.weight_init.init(
+            k2, (self.n_output_channel,
+                 self.n_input_channel * self.depth_multiplier, 1, 1))
+        p = {"depth_weight": depth_w, "point_weight": point_w}
+        if self.with_bias:
+            p["bias"] = self.bias_init.init(k3, (self.n_output_channel,))
+        return p
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        ph, pw = self.p
+        out = lax.conv_general_dilated(
+            x, params["depth_weight"], window_strides=self.s,
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_input_channel,
+        )
+        out = lax.conv_general_dilated(
+            out, params["point_weight"], window_strides=(1, 1),
+            padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.with_bias:
+            out = out + params["bias"][None, :, None, None]
+        return (out[0] if squeeze else out), state
+
+
+# ---------------------------------------------------------------------------
+# channel-wise dropout
+# ---------------------------------------------------------------------------
+
+class _SpatialDropoutNd(TensorModule):
+    """Shared core: drop whole feature maps (noise broadcast over the spatial
+    axes) — the reference's SpatialDropout family."""
+
+    n_spatial = 2
+
+    def __init__(self, init_p: float = 0.5) -> None:
+        super().__init__()
+        self.p = init_p
+
+    def _noise_shape(self, shape):
+        raise NotImplementedError
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return input, state
+        import jax
+
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, self._noise_shape(input.shape))
+        return input * mask / keep, state
+
+
+class SpatialDropout1D(_SpatialDropoutNd):
+    """Drop whole channels of ``(B, T, C)`` input (reference
+    ``nn/SpatialDropout1D.scala``; keras convention — channels last)."""
+
+    def _noise_shape(self, shape):
+        return shape[:-2] + (1, shape[-1])
+
+
+class SpatialDropout2D(_SpatialDropoutNd):
+    """Drop whole feature maps of NCHW input (reference
+    ``nn/SpatialDropout2D.scala``)."""
+
+    def __init__(self, init_p: float = 0.5, data_format: str = "NCHW") -> None:
+        super().__init__(init_p)
+        assert data_format in ("NCHW", "NHWC")
+        self.data_format = data_format
+
+    def _noise_shape(self, shape):
+        if self.data_format == "NCHW":
+            return shape[:-2] + (1, 1)
+        return shape[:-3] + (1, 1, shape[-1])
+
+
+class SpatialDropout3D(_SpatialDropoutNd):
+    """Drop whole feature volumes of NCDHW input (reference
+    ``nn/SpatialDropout3D.scala``)."""
+
+    def __init__(self, init_p: float = 0.5, data_format: str = "NCDHW") -> None:
+        super().__init__(init_p)
+        assert data_format in ("NCDHW", "NDHWC")
+        self.data_format = data_format
+
+    def _noise_shape(self, shape):
+        if self.data_format == "NCDHW":
+            return shape[:-3] + (1, 1, 1)
+        return shape[:-4] + (1, 1, 1, shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# local normalization family
+# ---------------------------------------------------------------------------
+
+def _local_mean_conv(x, kernel2d, n_channels):
+    """Weighted local mean over ALL channels with border-coverage correction.
+
+    Returns ``(mean_map (N,1,H,W), coef (1,1,H,W))`` where coef is the
+    fraction of kernel mass inside the image at each position — dividing by
+    it reproduces the reference's edge handling.
+    """
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    kh, kw = kernel2d.shape
+    # kernel normalized so full-coverage response is the mean across c,h,w
+    k = kernel2d / (jnp.sum(kernel2d) * n_channels)
+    w = jnp.broadcast_to(k, (1, n_channels, kh, kw)).astype(x.dtype)
+    pad = ((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2))
+    mean = lax.conv_general_dilated(
+        x, w, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ones = jnp.ones((1, n_channels) + x.shape[-2:], x.dtype)
+    coef = lax.conv_general_dilated(
+        ones, w, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return mean, coef
+
+
+class SpatialWithinChannelLRN(TensorModule):
+    """Within-channel local response normalization (reference
+    ``nn/SpatialWithinChannelLRN.scala``, caffe ``WITHIN_CHANNEL``):
+    ``out = x / (1 + alpha/size² · Σ_window x²)^beta`` per channel."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75) -> None:
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        s = self.size
+        pad = ((s // 2, (s - 1) // 2), (s // 2, (s - 1) // 2))
+        sq_sum = lax.reduce_window(
+            x * x, 0.0, lax.add, (1, 1, s, s), (1, 1, 1, 1),
+            ((0, 0), (0, 0)) + pad,
+        )
+        out = x / (1.0 + (self.alpha / (s * s)) * sq_sum) ** self.beta
+        return (out[0] if squeeze else out), state
+
+
+class SpatialSubtractiveNormalization(TensorModule):
+    """Subtract the kernel-weighted local mean (over all channels) from each
+    pixel, with border-coverage correction (reference
+    ``nn/SpatialSubtractiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None) -> None:
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        k = np.asarray(kernel if kernel is not None else np.ones((9, 9)),
+                       np.float32)
+        if k.ndim == 1:  # separable 1-D kernel → outer product
+            k = np.outer(k, k)
+        self.kernel = k
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        mean, coef = _local_mean_conv(
+            x, jnp.asarray(self.kernel), self.n_input_plane)
+        out = x - mean / coef
+        return (out[0] if squeeze else out), state
+
+
+class SpatialDivisiveNormalization(TensorModule):
+    """Divide by the kernel-weighted local standard deviation, thresholded
+    from below by its per-image mean (reference
+    ``nn/SpatialDivisiveNormalization.scala``; Jarrett et al.'s
+    ``v = x / max(mean(σ), σ_local)``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4) -> None:
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        k = np.asarray(kernel if kernel is not None else np.ones((9, 9)),
+                       np.float32)
+        if k.ndim == 1:
+            k = np.outer(k, k)
+        self.kernel = k
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        var, coef = _local_mean_conv(
+            x * x, jnp.asarray(self.kernel), self.n_input_plane)
+        local_std = jnp.sqrt(jnp.maximum(var / coef, 0.0))
+        # sub-threshold stds are REPLACED by thresval (the reference's
+        # Threshold(threshold, thresval) guard), then clamped from below by
+        # the per-image mean std (Jarrett et al.'s max(mean σ, σ_local))
+        local_std = jnp.where(local_std > self.threshold, local_std,
+                              self.thresval)
+        mean_std = jnp.mean(local_std, axis=(1, 2, 3), keepdims=True)
+        out = x / jnp.maximum(local_std, mean_std)
+        return (out[0] if squeeze else out), state
+
+
+class SpatialContrastiveNormalization(TensorModule):
+    """Subtractive then divisive normalization with one kernel (reference
+    ``nn/SpatialContrastiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4) -> None:
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(
+            n_input_plane, kernel, threshold, thresval)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        out, state = self.sub.apply(params, input, state, training, rng)
+        return self.div.apply(params, out, state, training, rng)
+
+
+# ---------------------------------------------------------------------------
+# penalty layers
+# ---------------------------------------------------------------------------
+
+class NegativeEntropyPenalty(TensorModule):
+    """Identity forward; backward adds the gradient of
+    ``beta · Σ p log p`` (negative entropy) — encourages high-entropy
+    probability outputs (reference ``nn/NegativeEntropyPenalty.scala``)."""
+
+    def __init__(self, beta: float = 0.01) -> None:
+        super().__init__()
+        self.beta = beta
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        beta = self.beta
+
+        @jax.custom_vjp
+        def pen(x):
+            return x
+
+        def fwd(x):
+            return x, x
+
+        def bwd(x, ct):
+            return (ct + beta * (jnp.log(jnp.maximum(x, 1e-12)) + 1.0),)
+
+        pen.defvjp(fwd, bwd)
+        return pen(input), state

@@ -57,6 +57,31 @@ class Cell(AbstractModule):
             for _ in range(self.carry_len)
         )
 
+    @property
+    def input_dropout_p(self) -> float:
+        """Dropout applied to the sequence INPUT by the driving Recurrent."""
+        return self.p
+
+    def dropout_specs(self):
+        """Variational h-dropout specs ``[(p, hidden_size), ...]`` — one per
+        recurrent sub-unit; the driving ``Recurrent`` samples one mask per
+        spec per sequence and hands them to :meth:`mask_carry`."""
+        return [(self.p, self.hidden_size)]
+
+    def mask_carry(self, carry, h_masks):
+        """Apply per-sequence recurrent-leg masks (aligned with
+        :meth:`dropout_specs`) to the hidden state(s)."""
+        m = h_masks[0]
+        if m is None:
+            return carry
+        return (carry[0] * m,) + tuple(carry[1:])
+
+    def with_masks(self, h_masks):
+        """Return the step function with extra per-sequence dropout masks
+        bound (beyond what :meth:`mask_carry` applies). Plain cells have
+        none; ``MultiRNNCell`` binds its inter-layer input masks here."""
+        return self.step_pre
+
     def step(self, params, x_t, carry):
         raise NotImplementedError
 
@@ -287,29 +312,42 @@ class Recurrent(AbstractModule):
         cell, cp = self.cell, params[self._key()]
         batch = input.shape[0]
         x = input
-        h_mask = None
-        p = getattr(cell, "p", 0.0)
-        if training and p > 0.0 and rng is not None:
+        h_masks = None
+        p_in = getattr(cell, "input_dropout_p", getattr(cell, "p", 0.0))
+        specs = cell.dropout_specs()
+        if training and rng is not None and (
+                p_in > 0.0 or any(p_h > 0.0 for p_h, _ in specs)):
             # variational dropout (one mask per sequence, shared across
-            # timesteps) on the input and on the recurrent h connection —
-            # the role of the reference cells' dropout `p`
-            k_in, k_h = jax.random.split(rng)
-            keep = 1.0 - p
-            in_mask = jax.random.bernoulli(
-                k_in, keep, (batch, 1) + x.shape[2:]
-            ).astype(x.dtype) / keep
-            x = x * in_mask
-            h_mask = jax.random.bernoulli(
-                k_h, keep, (batch, cell.hidden_size)
-            ).astype(x.dtype) / keep
+            # timesteps) on the input and on each recurrent h connection —
+            # the role of the reference cells' dropout `p`; a stacked
+            # MultiRNNCell contributes one spec (and one mask) per sub-cell
+            ks = jax.random.split(rng, len(specs) + 1)
+            if p_in > 0.0:
+                keep = 1.0 - p_in
+                in_mask = jax.random.bernoulli(
+                    ks[0], keep, (batch, 1) + x.shape[2:]
+                ).astype(x.dtype) / keep
+                x = x * in_mask
+            masks = []
+            for k_h, (p_h, h_sz) in zip(ks[1:], specs):
+                if p_h > 0.0:
+                    keep = 1.0 - p_h
+                    masks.append(jax.random.bernoulli(
+                        k_h, keep, (batch, h_sz)).astype(x.dtype) / keep)
+                else:
+                    masks.append(None)
+            if any(m is not None for m in masks):
+                h_masks = masks
         pre = cell.precompute_input(cp, x)           # (B, T, ...)
         pre_t = jnp.swapaxes(pre, 0, 1)              # (T, B, ...)
         carry0 = cell.init_carry(batch)
 
+        stepf = cell.with_masks(h_masks) if h_masks is not None else cell.step_pre
+
         def body(carry, p_t):
-            if h_mask is not None:
-                carry = (carry[0] * h_mask,) + tuple(carry[1:])
-            out, new_carry = cell.step_pre(cp, p_t, carry)
+            if h_masks is not None:
+                carry = cell.mask_carry(carry, h_masks)
+            out, new_carry = stepf(cp, p_t, carry)
             return new_carry, out
 
         # reverse mode scans from the last timestep; lax.scan stacks each
@@ -459,3 +497,108 @@ class TimeDistributed(TensorModule):
         )
         out = out.reshape((b, t) + out.shape[1:])
         return out, {self._key(): s}
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells run as ONE cell (reference ``nn/MultiRNNCell.scala``):
+    each sub-cell's output feeds the next; the combined carry is the
+    concatenation of all sub-carries, so the whole stack unrolls inside a
+    single ``lax.scan`` (one fused compiled loop instead of nested ones)."""
+
+    def __init__(self, cells: List[Cell]) -> None:
+        super().__init__(cells[-1].hidden_size)
+        self.cells = list(cells)
+        self.carry_len = sum(c.carry_len for c in self.cells)
+
+    def sub_modules(self) -> List[AbstractModule]:
+        return list(self.cells)
+
+    def _key(self, i: int, c: Cell) -> str:
+        return f"{i}:{c.name}"
+
+    def init_params(self, rng):
+        import jax
+
+        keys = jax.random.split(rng, len(self.cells))
+        return {
+            self._key(i, c): c.init_params(k)
+            for i, (c, k) in enumerate(zip(self.cells, keys))
+        }
+
+    def init_carry(self, batch_size: int):
+        out = []
+        for c in self.cells:
+            out.extend(c.init_carry(batch_size))
+        return tuple(out)
+
+    @property
+    def input_dropout_p(self) -> float:
+        # the sequence input feeds the FIRST sub-cell
+        return self.cells[0].p
+
+    def _n_h_specs(self) -> int:
+        return sum(len(c.dropout_specs()) for c in self.cells)
+
+    def dropout_specs(self):
+        # recurrent-leg specs per sub-cell, then inter-layer INPUT specs:
+        # sub-cell i>0's p also drops its input connection (the previous
+        # cell's per-step output, sized to that cell's hidden) — matching
+        # the reference cells whose p drops the w_ih leg
+        out = []
+        for c in self.cells:
+            out.extend(c.dropout_specs())
+        for i in range(1, len(self.cells)):
+            out.append((self.cells[i].p, self.cells[i - 1].hidden_size))
+        return out
+
+    def mask_carry(self, carry, h_masks):
+        new = list(carry)
+        idx = 0
+        mi = 0
+        for c in self.cells:
+            sub = tuple(new[idx: idx + c.carry_len])
+            n = len(c.dropout_specs())
+            sub = c.mask_carry(sub, h_masks[mi: mi + n])
+            new[idx: idx + c.carry_len] = list(sub)
+            idx += c.carry_len
+            mi += n
+        return tuple(new)
+
+    def with_masks(self, h_masks):
+        in_masks = h_masks[self._n_h_specs():]
+
+        def stepf(params, pre_t, carry):
+            return self._run_stack(params, pre_t, carry, in_masks)
+
+        return stepf
+
+    def precompute_input(self, params, x):
+        # hoist the FIRST sub-cell's fused input gemm over the whole
+        # sequence (one MXU matmul outside the scan); later sub-cells
+        # consume the previous cell's per-step output, so they step inside
+        c0 = self.cells[0]
+        return c0.precompute_input(params[self._key(0, c0)], x)
+
+    def step_pre(self, params, pre_t, carry):
+        return self._run_stack(params, pre_t, carry, None)
+
+    def _run_stack(self, params, pre_t, carry, in_masks):
+        new = []
+        h = pre_t
+        idx = 0
+        for i, c in enumerate(self.cells):
+            sub = carry[idx: idx + c.carry_len]
+            idx += c.carry_len
+            if i == 0:
+                h, nc = c.step_pre(params[self._key(0, c)], h, tuple(sub))
+            else:
+                if in_masks is not None and in_masks[i - 1] is not None:
+                    h = h * in_masks[i - 1]
+                h, nc = c.step(params[self._key(i, c)], h, tuple(sub))
+            new.extend(nc)
+        return h, tuple(new)
+
+    def step(self, params, x_t, carry):
+        c0 = self.cells[0]
+        pre = c0.precompute_input(params[self._key(0, c0)], x_t)
+        return self.step_pre(params, pre, carry)

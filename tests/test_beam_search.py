@@ -1,0 +1,152 @@
+"""SequenceBeamSearch: exactness vs brute force, greedy parity, eos pooling
+(SURVEY.md §2.2 inventory; the reference tests its beam search against fixed
+transformer fixtures — here the oracle is exhaustive enumeration)."""
+
+import numpy as np
+
+from tests.oracle import assert_close
+
+
+def _markov_step(table):
+    """Step whose logits depend only on the previous token (carry = dummy)."""
+    import jax.numpy as jnp
+
+    def step(params, tokens, carry):
+        return jnp.asarray(table)[tokens], carry
+
+    return step
+
+
+def test_beam_search_exhaustive_matches_brute_force(rng):
+    """With beam = V^(L-1) and no reachable eos, the search is exhaustive —
+    its best score must equal the brute-force max over all V^L sequences."""
+    import jax
+
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    V, L = 4, 4
+    K = V ** (L - 1)
+    table = rng.randn(V, V).astype(np.float32)
+    logp = np.log(np.exp(table) / np.exp(table).sum(-1, keepdims=True))
+    sos = 0
+
+    seqs, scores = jax.jit(
+        lambda c: beam_search(
+            _markov_step(table), None, c, 1, K, V, L,
+            sos_id=sos, eos_id=V + 7, alpha=0.0),
+    )(np.zeros((K, 1), np.float32))
+
+    # brute force over all V^L sequences
+    best = -np.inf
+    best_seq = None
+    for idx in np.ndindex(*([V] * L)):
+        s, prev = 0.0, sos
+        for t in idx:
+            s += logp[prev, t]
+            prev = t
+        if s > best:
+            best, best_seq = s, idx
+    assert_close(float(scores[0, 0]), best, atol=1e-4)
+    assert tuple(np.asarray(seqs)[0, 0]) == best_seq
+
+
+def test_greedy_beam_matches_argmax_rollout(rng):
+    import jax
+
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    V, L = 6, 5
+    table = rng.randn(V, V).astype(np.float32)
+    seqs, _ = jax.jit(
+        lambda c: beam_search(
+            _markov_step(table), None, c, 2, 1, V, L,
+            sos_id=1, eos_id=V + 7),
+    )(np.zeros((2, 1), np.float32))
+
+    tok, want = 1, []
+    for _ in range(L):
+        tok = int(np.argmax(table[tok]))
+        want.append(tok)
+    assert list(np.asarray(seqs)[0, 0]) == want
+    assert list(np.asarray(seqs)[1, 0]) == want  # batch rows independent
+
+
+def test_wider_beam_never_worse(rng):
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    V, L = 5, 6
+    table = rng.randn(V, V).astype(np.float32)
+    scores = {}
+    for K in (1, 2, 4):
+        _, sc = beam_search(
+            _markov_step(table), None, np.zeros((K, 1), np.float32),
+            1, K, V, L, sos_id=0, eos_id=V + 7)
+        scores[K] = float(np.asarray(sc)[0, 0])
+    assert scores[2] >= scores[1] - 1e-6
+    assert scores[4] >= scores[2] - 1e-6
+
+
+def test_eos_finishes_and_outranks(rng):
+    """A sequence that hits eos early with high probability must land in the
+    finished pool and beat unfinished alternatives."""
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    V = 4
+    eos = 3
+    # from sos(=1): token 2 is great; from 2: eos is overwhelming
+    table = np.full((V, V), -5.0, np.float32)
+    table[1, 2] = 5.0
+    table[2, eos] = 8.0
+    seqs, scores = beam_search(
+        _markov_step(table), None, np.zeros((2, 1), np.float32),
+        1, 2, V, 6, sos_id=1, eos_id=eos, alpha=0.6)
+    top = list(np.asarray(seqs)[0, 0])
+    assert top[0] == 2 and top[1] == eos
+    assert np.isfinite(float(np.asarray(scores)[0, 0]))
+
+
+def test_module_facade_tiles_carry(rng):
+    from bigdl_tpu.nn.beam_search import SequenceBeamSearch
+
+    V = 5
+    table = rng.randn(V, V).astype(np.float32)
+    m = SequenceBeamSearch(_markov_step(table), vocab_size=V, beam_size=3,
+                           decode_length=4, sos_id=0, eos_id=V + 7)
+    seqs, scores = m.forward(np.zeros((3, 2), np.float32))  # batch of 3
+    assert np.asarray(seqs).shape == (3, 3, 4)
+    assert np.asarray(scores).shape == (3, 3)
+    # rows identical (same table, same start)
+    assert np.array_equal(np.asarray(seqs)[0], np.asarray(seqs)[1])
+
+
+def test_beam_search_carry_follows_parent(rng):
+    """Carry gathering: a counting carry must equal the number of steps for
+    every surviving beam (parents propagate their state)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    V, L, K = 4, 3, 2
+
+    def step(params, tokens, carry):
+        logits = jnp.asarray(rng.randn(V, V).astype(np.float32))[tokens]
+        return logits, carry + 1.0
+
+    seqs, scores = beam_search(step, None, np.zeros((K,), np.float32),
+                               1, K, V, L, sos_id=0, eos_id=V + 7)
+    assert np.asarray(seqs).shape == (1, K, L)
+
+
+def test_padding_value_blanks_after_eos():
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    V, eos = 4, 3
+    table = np.full((V, V), -5.0, np.float32)
+    table[1, 2] = 5.0
+    table[2, eos] = 8.0
+    seqs, _ = beam_search(_markov_step(table), None,
+                          np.zeros((2, 1), np.float32), 1, 2, V, 6,
+                          sos_id=1, eos_id=eos, alpha=0.6, padding_value=0)
+    top = list(np.asarray(seqs)[0, 0])
+    assert top[:2] == [2, eos]
+    assert top[2:] == [0, 0, 0, 0]  # padded, not sos-filled

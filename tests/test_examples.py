@@ -50,3 +50,17 @@ def test_imageclassification_example(tmp_path, rng):
     ])
     assert len(preds) == 6
     assert set(int(p) for p in preds) <= {1, 2}
+
+
+def test_languagemodel_example_beam_generation(capsys):
+    from bigdl_tpu.examples.languagemodel import main
+
+    model = main(["--synthetic", "48", "--maxEpoch", "1", "--batchSize", "16",
+                  "--vocab", "30", "--seqLen", "8", "--hidden", "16",
+                  "--beam", "3", "--genLen", "5"])
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.startswith("beam ")]
+    assert len(lines) == 3
+    # generated ids must be in-vocabulary (1-based; eos id 0 unreachable)
+    toks = [int(t) for t in lines[0].split()[4:]]
+    assert len(toks) == 5 and all(1 <= t <= 30 for t in toks)

@@ -1,0 +1,496 @@
+"""Third layer/criterion breadth batch vs torch oracles / closed forms
+(SURVEY.md §2.2 inventory; §4 oracle pattern)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+
+# ---------------------------------------------------------------------------
+# table / shape utilities
+# ---------------------------------------------------------------------------
+
+def test_pack_tile_reverse(rng):
+    from bigdl_tpu.nn import Pack, Reverse, Tile
+
+    a = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(2, 3).astype(np.float32)
+    assert_close(np.asarray(Pack(1).forward([a, b])), np.stack([a, b], 0))
+    assert_close(np.asarray(Pack(2).forward([a, b])), np.stack([a, b], 1))
+    assert_close(np.asarray(Tile(2, 3).forward(a)),
+                 np.concatenate([a, a, a], 1))
+    assert_close(np.asarray(Reverse(1).forward(a)), a[::-1])
+
+
+def test_infer_reshape():
+    from bigdl_tpu.nn import InferReshape
+
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    assert InferReshape([-1, 4]).forward(x).shape == (6, 4)
+    assert InferReshape([0, -1]).forward(x).shape == (2, 12)
+    assert InferReshape([-1], batch_mode=True).forward(x).shape == (2, 12)
+
+
+def test_bifurcate_split_mixture(rng):
+    from bigdl_tpu.nn import BifurcateSplitTable, MixtureTable
+
+    x = rng.randn(3, 8).astype(np.float32)
+    a, b = BifurcateSplitTable(2).forward(x)
+    assert_close(np.asarray(a), x[:, :4])
+    assert_close(np.asarray(b), x[:, 4:])
+
+    gate = np.abs(rng.randn(3, 2)).astype(np.float32)
+    e1 = rng.randn(3, 5).astype(np.float32)
+    e2 = rng.randn(3, 5).astype(np.float32)
+    want = gate[:, :1] * e1 + gate[:, 1:] * e2
+    assert_close(np.asarray(MixtureTable().forward([gate, [e1, e2]])), want,
+                 atol=1e-5)
+    stacked = np.stack([e1, e2], axis=1)
+    assert_close(np.asarray(MixtureTable().forward([gate, stacked])), want,
+                 atol=1e-5)
+
+
+def test_masked_select_dense_to_sparse(rng):
+    from bigdl_tpu.nn import DenseToSparse, MaskedSelect
+
+    x = rng.randn(3, 4).astype(np.float32)
+    mask = (x > 0).astype(np.float32)
+    out = np.asarray(MaskedSelect().forward([x, mask]))
+    assert_close(out, x[x > 0])
+
+    sp = DenseToSparse().forward(np.array([[0.0, 2.0], [3.0, 0.0]]))
+    assert sp.shape == (2, 2)
+    assert_close(np.asarray(sp.to_dense()), [[0.0, 2.0], [3.0, 0.0]])
+
+
+# ---------------------------------------------------------------------------
+# parameterized activations
+# ---------------------------------------------------------------------------
+
+def test_srelu_piecewise():
+    from bigdl_tpu.nn import SReLU
+    import jax.numpy as jnp
+
+    m = SReLU(shape=(4,))
+    m._ensure_params()
+    m.params = {
+        "t_left": jnp.full((4,), -1.0),
+        "a_left": jnp.full((4,), 0.5),
+        "t_right": jnp.full((4,), 1.0),
+        "a_right": jnp.full((4,), 2.0),
+    }
+    x = np.array([[-3.0, -1.0, 0.0, 3.0]], np.float32)
+    out = np.asarray(m.forward(np.broadcast_to(x, (1, 4))))
+    # below: t_l + a_l(x - t_l) = -1 + .5(-3+1) = -2 ; mid: identity;
+    # above: t_r + a_r(x - t_r) = 1 + 2(3-1) = 5
+    assert_close(out, [[-2.0, -1.0, 0.0, 5.0]])
+
+
+def test_srelu_shared_axes_shapes():
+    from bigdl_tpu.nn import SReLU
+
+    m = SReLU(shape=(3, 5, 5), shared_axes=(2, 3))
+    m._ensure_params()
+    assert m.params["t_left"].shape == (3, 1, 1)
+    out = m.forward(np.random.randn(2, 3, 5, 5).astype(np.float32))
+    assert out.shape == (2, 3, 5, 5)
+
+
+def test_maxout(rng):
+    from bigdl_tpu.nn import Maxout
+
+    m = Maxout(6, 4, 3)
+    m._ensure_params()
+    x = rng.randn(2, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    w = np.asarray(m.params["weight"])
+    b = np.asarray(m.params["bias"])
+    want = (x @ w.T + b).reshape(2, 4, 3).max(-1)
+    assert_close(out, want, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# temporal pooling / up-sampling / cropping
+# ---------------------------------------------------------------------------
+
+def test_temporal_max_pooling_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import TemporalMaxPooling
+
+    x = rng.randn(2, 10, 5).astype(np.float32)
+    out = np.asarray(TemporalMaxPooling(3, 2).forward(x))
+    want = torch.nn.MaxPool1d(3, 2)(
+        torch.from_numpy(x).transpose(1, 2)).transpose(1, 2).numpy()
+    assert_close(out, want)
+    # 2-D (no batch) path
+    out2 = np.asarray(TemporalMaxPooling(3, 2).forward(x[0]))
+    assert_close(out2, want[0])
+
+
+def test_upsampling_1d_3d_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import UpSampling1D, UpSampling3D
+
+    x = rng.randn(2, 4, 3).astype(np.float32)  # (B, T, F)
+    out = np.asarray(UpSampling1D(2).forward(x))
+    want = torch.nn.Upsample(scale_factor=2, mode="nearest")(
+        torch.from_numpy(x).transpose(1, 2)).transpose(1, 2).numpy()
+    assert_close(out, want)
+
+    v = rng.randn(2, 3, 2, 3, 4).astype(np.float32)  # NCDHW
+    out3 = np.asarray(UpSampling3D((2, 2, 2)).forward(v))
+    want3 = torch.nn.Upsample(scale_factor=2, mode="nearest")(
+        torch.from_numpy(v)).numpy()
+    assert_close(out3, want3)
+
+
+def test_cropping(rng):
+    from bigdl_tpu.nn import Cropping2D, Cropping3D
+
+    x = rng.randn(2, 3, 8, 9).astype(np.float32)
+    out = np.asarray(Cropping2D((1, 2), (3, 1)).forward(x))
+    assert_close(out, x[:, :, 1:6, 3:8])
+
+    v = rng.randn(2, 3, 6, 7, 8).astype(np.float32)
+    out3 = np.asarray(Cropping3D((1, 1), (2, 0), (0, 3)).forward(v))
+    assert_close(out3, v[:, :, 1:5, 2:, :5])
+
+
+# ---------------------------------------------------------------------------
+# convolution variants
+# ---------------------------------------------------------------------------
+
+def test_volumetric_full_convolution_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import VolumetricFullConvolution
+
+    m = VolumetricFullConvolution(3, 4, 2, 3, 3, d_t=2, d_w=1, d_h=2,
+                                  pad_t=1, pad_w=1, pad_h=0)
+    m._ensure_params()
+    x = rng.randn(2, 3, 4, 5, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+
+    ref = torch.nn.ConvTranspose3d(3, 4, (2, 3, 3), stride=(2, 2, 1),
+                                   padding=(1, 0, 1))
+    with torch.no_grad():
+        ref.weight.copy_(torch.from_numpy(np.asarray(m.params["weight"])))
+        ref.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    want = ref(torch.from_numpy(x)).detach().numpy()
+    assert_close(out, want, atol=1e-4)
+
+
+def test_locally_connected_2d_matches_unshared_conv(rng):
+    from bigdl_tpu.nn import LocallyConnected2D
+
+    m = LocallyConnected2D(2, 6, 5, 3, kernel_w=3, kernel_h=2,
+                           stride_w=1, stride_h=1)
+    m._ensure_params()
+    x = rng.randn(1, 2, 5, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (1, 3, m.out_h, m.out_w)
+
+    w = np.asarray(m.params["weight"])   # (P, O, C*kh*kw)
+    b = np.asarray(m.params["bias"])
+    want = np.zeros_like(out)
+    for oy in range(m.out_h):
+        for ox in range(m.out_w):
+            patch = x[0, :, oy:oy + 2, ox:ox + 3].reshape(-1)
+            want[0, :, oy, ox] = w[oy * m.out_w + ox] @ patch + b[:, oy, ox]
+    assert_close(out, want, atol=1e-4)
+
+
+def test_locally_connected_1d(rng):
+    from bigdl_tpu.nn import LocallyConnected1D
+
+    m = LocallyConnected1D(7, 4, 3, kernel_w=3, stride_w=2)
+    m._ensure_params()
+    x = rng.randn(2, 7, 4).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, m.out_t, 3)
+
+    w = np.asarray(m.params["weight"])
+    b = np.asarray(m.params["bias"])
+    for p in range(m.out_t):
+        # patch channels are feature-major: (F, k) flattened
+        patch = x[:, p * 2:p * 2 + 3].transpose(0, 2, 1).reshape(2, -1)
+        assert_close(out[:, p], patch @ w[p].T + b[p], atol=1e-4)
+
+
+def test_separable_and_share_convolution_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import (
+        SpatialSeparableConvolution, SpatialShareConvolution,
+    )
+
+    m = SpatialSeparableConvolution(3, 8, 2, 3, 3, p_w=1, p_h=1)
+    m._ensure_params()
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+
+    depth = torch.nn.Conv2d(3, 6, 3, padding=1, groups=3, bias=False)
+    point = torch.nn.Conv2d(6, 8, 1)
+    with torch.no_grad():
+        depth.weight.copy_(torch.from_numpy(np.asarray(m.params["depth_weight"])))
+        point.weight.copy_(torch.from_numpy(np.asarray(m.params["point_weight"])))
+        point.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    want = point(depth(torch.from_numpy(x))).detach().numpy()
+    assert_close(out, want, atol=1e-4)
+
+    s = SpatialShareConvolution(3, 5, 3, 3, 1, 1, 1, 1)
+    s._ensure_params()
+    ref = torch.nn.Conv2d(3, 5, 3, padding=1)
+    with torch.no_grad():
+        ref.weight.copy_(torch.from_numpy(np.asarray(s.params["weight"])))
+        ref.bias.copy_(torch.from_numpy(np.asarray(s.params["bias"])))
+    assert_close(np.asarray(s.forward(x)),
+                 ref(torch.from_numpy(x)).detach().numpy(), atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# channel-wise dropout
+# ---------------------------------------------------------------------------
+
+def test_spatial_dropout_masks_whole_channels():
+    import jax
+
+    from bigdl_tpu.nn import SpatialDropout1D, SpatialDropout2D, SpatialDropout3D
+
+    rng_key = jax.random.PRNGKey(0)
+    x2 = np.ones((2, 4, 5, 5), np.float32)
+    m2 = SpatialDropout2D(0.5)
+    out2, _ = m2.apply({}, x2, training=True, rng=rng_key)
+    out2 = np.asarray(out2)
+    # each (b, c) map is uniformly 0 or 2 (inverted-dropout scaling)
+    per_map = out2.reshape(2, 4, -1)
+    assert all(len(np.unique(m)) == 1 for b in per_map for m in b)
+    assert set(np.unique(out2)) <= {0.0, 2.0}
+
+    x1 = np.ones((2, 6, 3), np.float32)
+    out1, _ = SpatialDropout1D(0.5).apply({}, x1, training=True, rng=rng_key)
+    out1 = np.asarray(out1)
+    assert all(len(np.unique(out1[b, :, c])) == 1
+               for b in range(2) for c in range(3))
+
+    x3 = np.ones((1, 4, 2, 3, 3), np.float32)
+    out3, _ = SpatialDropout3D(0.5).apply({}, x3, training=True, rng=rng_key)
+    out3 = np.asarray(out3)
+    assert all(len(np.unique(out3[0, c])) == 1 for c in range(4))
+
+    # eval mode: identity
+    assert_close(np.asarray(SpatialDropout2D(0.5).evaluate().forward(x2)), x2)
+
+
+# ---------------------------------------------------------------------------
+# local normalization family
+# ---------------------------------------------------------------------------
+
+def test_within_channel_lrn():
+    from bigdl_tpu.nn import SpatialWithinChannelLRN
+
+    x = np.full((1, 2, 6, 6), 2.0, np.float32)
+    out = np.asarray(SpatialWithinChannelLRN(3, alpha=1.0, beta=0.75).forward(x))
+    # interior: sum over 3x3 window of x^2 = 36 -> 2 / (1 + 36/9)^0.75
+    want = 2.0 / (1.0 + 36.0 / 9.0) ** 0.75
+    assert_close(out[0, :, 2:4, 2:4], np.full((2, 2, 2), want), atol=1e-5)
+
+
+def test_subtractive_normalization_constant_image_is_zero():
+    from bigdl_tpu.nn import SpatialSubtractiveNormalization
+
+    x = np.full((1, 3, 8, 8), 5.0, np.float32)
+    out = np.asarray(SpatialSubtractiveNormalization(3).forward(x))
+    # coverage correction makes the local mean exactly 5 everywhere,
+    # including corners — so the output is identically 0
+    assert_close(out, np.zeros_like(x), atol=1e-4)
+
+
+def test_divisive_normalization_scale_invariance(rng):
+    from bigdl_tpu.nn import SpatialDivisiveNormalization
+
+    x = rng.randn(1, 1, 10, 10).astype(np.float32)
+    m = SpatialDivisiveNormalization(1)
+    a = np.asarray(m.forward(x))
+    b = np.asarray(m.forward(x * 10.0))
+    # dividing by the local std cancels a global scale
+    assert_close(a, b, atol=1e-3)
+
+
+def test_contrastive_normalization_runs(rng):
+    from bigdl_tpu.nn import SpatialContrastiveNormalization
+
+    x = rng.randn(2, 1, 9, 9).astype(np.float32)
+    out = np.asarray(SpatialContrastiveNormalization(1).forward(x))
+    assert out.shape == x.shape
+    assert np.all(np.isfinite(out))
+
+
+# ---------------------------------------------------------------------------
+# penalty layers + MultiRNNCell
+# ---------------------------------------------------------------------------
+
+def test_negative_entropy_penalty_gradient():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import NegativeEntropyPenalty
+
+    m = NegativeEntropyPenalty(beta=0.1)
+    p = np.array([0.2, 0.3, 0.5], np.float32)
+
+    def f(x):
+        out, _ = m.apply({}, x)
+        return jnp.sum(out * 2.0)
+
+    g = np.asarray(jax.grad(f)(p))
+    want = 2.0 + 0.1 * (np.log(p) + 1.0)
+    assert_close(g, want, atol=1e-5)
+    # forward is identity
+    assert_close(np.asarray(m.forward(p)), p)
+
+
+def test_multi_rnn_cell_matches_stacked_recurrents(rng):
+    from bigdl_tpu.nn import GRU, LSTM, MultiRNNCell, Recurrent
+
+    c1, c2 = LSTM(4, 6), GRU(6, 5)
+    stack = MultiRNNCell([c1, c2])
+    r = Recurrent().add(stack)
+    r._ensure_params()
+    x = rng.randn(3, 7, 4).astype(np.float32)
+    out = np.asarray(r.forward(x))
+    assert out.shape == (3, 7, 5)
+
+    # equivalent two-layer unroll with the same params
+    sp = r.params[r._key()]
+    r1, r2 = Recurrent().add(c1), Recurrent().add(c2)
+    r1.params = {r1._key(): sp[stack._key(0, c1)]}
+    r2.params = {r2._key(): sp[stack._key(1, c2)]}
+    mid = r1.forward(x)
+    want = np.asarray(r2.forward(mid))
+    assert_close(out, want, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# criterions
+# ---------------------------------------------------------------------------
+
+def test_poisson_criterion_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.nn import PoissonCriterion
+
+    pred = np.abs(rng.randn(4, 3)).astype(np.float32) + 0.1
+    tgt = np.abs(rng.randn(4, 3)).astype(np.float32)
+    loss = PoissonCriterion().forward(pred, tgt)
+    want = torch.nn.PoissonNLLLoss(log_input=False, full=False)(
+        torch.from_numpy(pred), torch.from_numpy(tgt)).item()
+    assert abs(loss - want) < 1e-5
+
+
+def test_l1_hinge_embedding_criterion(rng):
+    from bigdl_tpu.nn import L1HingeEmbeddingCriterion
+
+    x1 = rng.randn(5).astype(np.float32)
+    x2 = rng.randn(5).astype(np.float32)
+    d = np.abs(x1 - x2).sum()
+    c = L1HingeEmbeddingCriterion(margin=2.0)
+    assert abs(c.forward([x1, x2], np.float32(1)) - d) < 1e-5
+    assert abs(c.forward([x1, x2], np.float32(-1)) - max(0.0, 2.0 - d)) < 1e-5
+
+
+def test_keras_regression_criterions(rng):
+    from bigdl_tpu.nn import (
+        CategoricalCrossEntropy, KullbackLeiblerDivergenceCriterion,
+        MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    )
+
+    p = np.abs(rng.randn(4, 3)).astype(np.float32) + 0.1
+    t = np.abs(rng.randn(4, 3)).astype(np.float32) + 0.1
+
+    mape = MeanAbsolutePercentageCriterion().forward(p, t)
+    assert abs(mape - 100 * np.mean(np.abs(t - p) / np.abs(t))) < 1e-2
+
+    msle = MeanSquaredLogarithmicCriterion().forward(p, t)
+    assert abs(msle - np.mean((np.log(t + 1) - np.log(p + 1)) ** 2)) < 1e-5
+
+    probs = np.float32([[0.7, 0.2, 0.1], [0.3, 0.3, 0.4]])
+    tgts = np.float32([[1, 0, 0], [0, 0, 1]])
+    cce = CategoricalCrossEntropy().forward(probs, tgts)
+    assert abs(cce - np.mean([-np.log(0.7), -np.log(0.4)])) < 1e-4
+
+    kl = KullbackLeiblerDivergenceCriterion().forward(probs, probs)
+    assert abs(kl) < 1e-6
+
+
+def test_time_distributed_mask_criterion():
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedMaskCriterion
+
+    logp = np.log(np.float32([
+        [[0.7, 0.3], [0.6, 0.4], [0.5, 0.5]],
+        [[0.9, 0.1], [0.2, 0.8], [0.5, 0.5]],
+    ]))
+    # last step of each row padded (class 0 = padding)
+    tgt = np.float32([[1, 2, 0], [1, 2, 0]])
+    c = TimeDistributedMaskCriterion(ClassNLLCriterion(), padding_value=0)
+    loss = c.forward(logp, tgt)
+    want = -np.mean([np.log(0.7), np.log(0.4), np.log(0.9), np.log(0.8)])
+    assert abs(loss - want) < 1e-5
+
+
+def test_multi_rnn_cell_subcell_dropout_applied(rng):
+    """Code-review regression: sub-cell variational dropout must fire (two
+    training forwards differ) and eval mode must be deterministic."""
+    import jax
+
+    from bigdl_tpu.nn import GRU, LSTM, MultiRNNCell, Recurrent
+
+    stack = MultiRNNCell([LSTM(3, 4, p=0.5), GRU(4, 5, p=0.5)])
+    r = Recurrent().add(stack)
+    r._ensure_params()
+    x = rng.randn(4, 6, 3).astype(np.float32)
+
+    out1, _ = r.apply(r.params, x, training=True, rng=jax.random.PRNGKey(1))
+    out2, _ = r.apply(r.params, x, training=True, rng=jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+    ev1, _ = r.apply(r.params, x, training=False)
+    ev2, _ = r.apply(r.params, x, training=False)
+    assert_close(np.asarray(ev1), np.asarray(ev2))
+    assert np.asarray(ev1).shape == (4, 6, 5)
+
+
+def test_divisive_normalization_thresval_replaces_low_std():
+    from bigdl_tpu.nn import SpatialDivisiveNormalization
+
+    # tiny amplitude -> local sqrt(E[x²]) ≈ 1e-6 < threshold everywhere ->
+    # every position's std is REPLACED by thresval=1.5 -> out = x / 1.5
+    x = np.full((1, 1, 9, 9), 1e-6, np.float32)
+    out = np.asarray(
+        SpatialDivisiveNormalization(1, threshold=1e-4, thresval=1.5)
+        .forward(x))
+    assert_close(out, x / 1.5, atol=1e-9)
+
+
+def test_multi_rnn_cell_interlayer_dropout(rng):
+    """Sub-cell i>0's p must mask its INPUT leg too: with p=0 on cell 0 and
+    p→1 on cell 1, cell 1 sees (almost surely) only zeros from cell 0."""
+    import jax
+
+    from bigdl_tpu.nn import MultiRNNCell, Recurrent, RnnCell
+
+    c0, c1 = RnnCell(3, 4), RnnCell(4, 4)
+    c1.p = 0.9999
+    stack = MultiRNNCell([c0, c1])
+    r = Recurrent().add(stack)
+    r._ensure_params()
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    out, _ = r.apply(r.params, x, training=True, rng=jax.random.PRNGKey(0))
+    # with the input leg ~fully masked, cell 1 behaves like zero-input rnn:
+    # output = tanh(b) rolled through its recurrence, identical across batch
+    # rows even though x differs
+    o = np.asarray(out)
+    assert_close(o[0], o[1], atol=1e-5)

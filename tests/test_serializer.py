@@ -187,6 +187,51 @@ FACTORIES = {
     "Transpose": (lambda: nn.Transpose([(0, 1)]), x(2, 3)),
     "Unsqueeze": (lambda: nn.Unsqueeze(1), x(2, 3)),
     "View": (lambda: nn.View(6), x(2, 2, 3)),
+    "Pack": (lambda: nn.Pack(1), [x(2, 3), x(2, 3)]),
+    "Tile": (lambda: nn.Tile(2, 2), x(2, 3)),
+    "Reverse": (lambda: nn.Reverse(1), x(2, 3)),
+    "InferReshape": (lambda: nn.InferReshape([-1, 4]), x(2, 2, 4)),
+    "BifurcateSplitTable": (lambda: nn.BifurcateSplitTable(2), None),
+    "MixtureTable": (lambda: nn.MixtureTable(),
+                     [np.abs(x(2, 2)), [x(2, 3), x(2, 3)]]),
+    "MaskedSelect": (lambda: nn.MaskedSelect(),
+                     [x(2, 3), np.ones((2, 3), np.float32)]),
+    "DenseToSparse": (lambda: nn.DenseToSparse(capacity=8), None),
+    "SReLU": (lambda: nn.SReLU((3,)), x(2, 3)),
+    "Maxout": (lambda: nn.Maxout(4, 3, 2), x(2, 4)),
+    "TemporalMaxPooling": (lambda: nn.TemporalMaxPooling(2), x(2, 6, 3)),
+    "UpSampling1D": (lambda: nn.UpSampling1D(2), x(2, 4, 3)),
+    "UpSampling3D": (lambda: nn.UpSampling3D((2, 2, 2)), x(1, 2, 2, 3, 3)),
+    "Cropping2D": (lambda: nn.Cropping2D((1, 1), (1, 1)), x(2, 3, 5, 5)),
+    "Cropping3D": (lambda: nn.Cropping3D((1, 0), (0, 1), (1, 1)),
+                   x(1, 2, 4, 4, 4)),
+    "VolumetricFullConvolution": (
+        lambda: nn.VolumetricFullConvolution(2, 3, 2, 2, 2), x(1, 2, 3, 3, 3)),
+    "LocallyConnected1D": (lambda: nn.LocallyConnected1D(6, 4, 3, 3),
+                           x(2, 6, 4)),
+    "LocallyConnected2D": (
+        lambda: nn.LocallyConnected2D(2, 5, 5, 3, 3, 3), x(2, 2, 5, 5)),
+    "SpatialShareConvolution": (
+        lambda: nn.SpatialShareConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        x(2, 3, 5, 5)),
+    "SpatialSeparableConvolution": (
+        lambda: nn.SpatialSeparableConvolution(3, 4, 2, 3, 3, p_w=1, p_h=1),
+        x(2, 3, 5, 5)),
+    "SpatialDropout1D": (lambda: nn.SpatialDropout1D(0.5), x(2, 4, 3)),
+    "SpatialDropout2D": (lambda: nn.SpatialDropout2D(0.5), x(2, 3, 4, 4)),
+    "SpatialDropout3D": (lambda: nn.SpatialDropout3D(0.5), x(1, 2, 3, 3, 3)),
+    "SpatialWithinChannelLRN": (lambda: nn.SpatialWithinChannelLRN(3),
+                                x(2, 3, 5, 5)),
+    "SpatialSubtractiveNormalization": (
+        lambda: nn.SpatialSubtractiveNormalization(3), x(2, 3, 6, 6)),
+    "SpatialDivisiveNormalization": (
+        lambda: nn.SpatialDivisiveNormalization(3), x(2, 3, 6, 6)),
+    "SpatialContrastiveNormalization": (
+        lambda: nn.SpatialContrastiveNormalization(3), x(2, 3, 6, 6)),
+    "NegativeEntropyPenalty": (lambda: nn.NegativeEntropyPenalty(0.1),
+                               np.abs(x(2, 3)) + 0.1),
+    "MultiRNNCell": (lambda: nn.MultiRNNCell([nn.LSTM(3, 4), nn.GRU(4, 3)]),
+                     None),
 }
 
 # abstract/base/helper classes with no standalone forward semantics,
@@ -194,6 +239,10 @@ FACTORIES = {
 EXEMPT = {
     "AbstractModule", "TensorModule", "Container", "Module",
     "Cell", "StaticGraph", "ModuleNode", "Input",
+    # wraps a caller-supplied symbols_to_logits closure — inherently not
+    # round-trippable (the reference serializes its transformer-bound
+    # variant by reconstructing that closure from the bound model)
+    "SequenceBeamSearch",
 }
 
 
