@@ -1,0 +1,3 @@
+"""``bigdl.dataset.news20`` equivalent (``get_news20``/``get_glove_w2v``)."""
+
+from bigdl_tpu.dataset.news20 import get_news20, get_glove_w2v  # noqa: F401

@@ -5,6 +5,7 @@ from bigdl_tpu.models.vgg import VggForCifar10, Vgg_16, Vgg_19
 from bigdl_tpu.models.resnet import ResNet
 from bigdl_tpu.models.inception import (
     Inception_v1, Inception_v1_NoAuxClassifier, Inception_Layer_v1,
+    Inception_v2, Inception_Layer_v2,
 )
 from bigdl_tpu.models.alexnet import AlexNet, AlexNet_OWT
 from bigdl_tpu.models.autoencoder import Autoencoder
@@ -18,6 +19,7 @@ from bigdl_tpu.models.treelstm import BinaryTreeLSTM, TreeLSTMSentiment
 __all__ = [
     "LeNet5", "VggForCifar10", "Vgg_16", "Vgg_19", "ResNet",
     "Inception_v1", "Inception_v1_NoAuxClassifier", "Inception_Layer_v1",
+    "Inception_v2", "Inception_Layer_v2",
     "AlexNet", "AlexNet_OWT", "Autoencoder",
     "TextClassifier", "PTBModel", "SimpleRNN",
     "TransformerLM", "TransformerBlock", "LayerNorm", "PositionEmbedding",

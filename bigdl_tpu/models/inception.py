@@ -100,9 +100,163 @@ def Inception_v1_NoAuxClassifier(class_num: int = 1000,
     return model
 
 
-# The aux-classifier training variant shares the same main tower; the two
-# auxiliary heads only change the training loss. Parity alias:
-Inception_v1 = Inception_v1_NoAuxClassifier
+def _aux_head(input_size: int, class_num: int, name_prefix: str) -> Sequential:
+    """GoogLeNet auxiliary classifier: 5x5/3 avgpool → 1x1 conv(128) →
+    fc(1024) → Dropout(0.7) → fc(classes) → LogSoftMax (reference
+    ``Inception.scala`` — loss1/loss2 towers)."""
+    s = Sequential()
+    s.add(SpatialAveragePooling(5, 5, 3, 3).set_name(name_prefix + "ave_pool"))
+    _conv_relu(s, input_size, 128, 1, 1, name=name_prefix + "conv")
+    s.add(Reshape([128 * 4 * 4], batch_mode=True))
+    s.add(Linear(128 * 4 * 4, 1024, init_weight=Xavier(), init_bias=Zeros())
+          .set_name(name_prefix + "fc"))
+    s.add(ReLU(True))
+    s.add(Dropout(0.7).set_name(name_prefix + "drop_fc"))
+    s.add(Linear(1024, class_num, init_weight=Xavier(), init_bias=Zeros())
+          .set_name(name_prefix + "classifier"))
+    s.add(LogSoftMax().set_name(name_prefix + "loss"))
+    return s
+
+
+def Inception_v1(class_num: int = 1000, has_dropout: bool = True) -> Sequential:
+    """Training GoogLeNet WITH the two auxiliary classifiers (reference
+    ``Inception.scala`` — ``Inception_v1``). Output is a flat table
+    ``[main, aux@4d, aux@4a]``; train with
+    ``ParallelCriterion(repeat_target=True).add(ClassNLLCriterion(), 1.0)
+    .add(ClassNLLCriterion(), 0.3).add(ClassNLLCriterion(), 0.3)``."""
+    from bigdl_tpu.nn import ConcatTable, FlattenTable
+
+    feature1 = Sequential()
+    _conv_relu(feature1, 3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2")
+    feature1.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+    feature1.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("pool1/norm1"))
+    _conv_relu(feature1, 64, 64, 1, 1, name="conv2/3x3_reduce")
+    _conv_relu(feature1, 64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
+    feature1.add(SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("conv2/norm2"))
+    feature1.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2"))
+    feature1.add(Inception_Layer_v1(192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"))
+    feature1.add(Inception_Layer_v1(256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"))
+    feature1.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool3/3x3_s2"))
+    feature1.add(Inception_Layer_v1(480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"))
+
+    output1 = _aux_head(512, class_num, "loss1/")
+
+    feature2 = Sequential()
+    feature2.add(Inception_Layer_v1(512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"))
+    feature2.add(Inception_Layer_v1(512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"))
+    feature2.add(Inception_Layer_v1(512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"))
+
+    output2 = _aux_head(528, class_num, "loss2/")
+
+    output3 = Sequential()
+    output3.add(Inception_Layer_v1(528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"))
+    output3.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool4/3x3_s2"))
+    output3.add(Inception_Layer_v1(832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"))
+    output3.add(Inception_Layer_v1(832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    if has_dropout:
+        output3.add(Dropout(0.4).set_name("pool5/drop_7x7_s1"))
+    output3.add(Reshape([1024], batch_mode=True))
+    output3.add(Linear(1024, class_num, init_weight=Xavier(), init_bias=Zeros())
+                .set_name("loss3/classifier"))
+    output3.add(LogSoftMax().set_name("loss3/loss3"))
+
+    main = Sequential().add(feature2).add(
+        ConcatTable().add(output3).add(output2))
+    model = Sequential().add(feature1).add(
+        ConcatTable().add(main).add(output1)).add(FlattenTable())
+    return model
+
+
+# ---------------------------------------------------------------------------
+# Inception-v2 (BN-Inception, Ioffe & Szegedy 2015)
+# ---------------------------------------------------------------------------
+
+def _conv_bn_relu(seq: Sequential, n_in, n_out, kw, kh, sw=1, sh=1,
+                  pw=0, ph=0, name: str = "") -> Sequential:
+    from bigdl_tpu.nn import SpatialBatchNormalization
+
+    seq.add(SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                               init_weight=Xavier(), init_bias=Zeros())
+            .set_name(name))
+    seq.add(SpatialBatchNormalization(n_out, 1e-3).set_name(name + "/bn"))
+    seq.add(ReLU(True))
+    return seq
+
+
+def Inception_Layer_v2(input_size: int, config, name_prefix: str = "") -> Concat:
+    """BN-inception block (reference ``Inception.scala`` —
+    ``Inception_Layer_v2``): branches 1x1 | 1x1→3x3 | 1x1→3x3→3x3 (double) |
+    pool→proj, every conv followed by BatchNorm+ReLU. ``config[0][0] == 0``
+    marks a stride-2 reduction block (no 1x1 branch, un-projected maxpool);
+    ``config[3]`` is ``(pool_type, proj)`` with pool_type "avg"|"max"."""
+    c = [list(branch) for branch in config]
+    out1 = int(c[0][0])
+    stride2 = out1 == 0
+    s = 2 if stride2 else 1
+    concat = Concat(2)
+
+    if not stride2:
+        concat.add(_conv_bn_relu(Sequential(), input_size, out1, 1, 1,
+                                 name=name_prefix + "1x1"))
+
+    r3, o3 = c[1]
+    b2 = _conv_bn_relu(Sequential(), input_size, r3, 1, 1,
+                       name=name_prefix + "3x3_reduce")
+    _conv_bn_relu(b2, r3, o3, 3, 3, s, s, 1, 1, name=name_prefix + "3x3")
+    concat.add(b2)
+
+    rd, od = c[2]
+    b3 = _conv_bn_relu(Sequential(), input_size, rd, 1, 1,
+                       name=name_prefix + "double3x3_reduce")
+    _conv_bn_relu(b3, rd, od, 3, 3, 1, 1, 1, 1, name=name_prefix + "double3x3a")
+    _conv_bn_relu(b3, od, od, 3, 3, s, s, 1, 1, name=name_prefix + "double3x3b")
+    concat.add(b3)
+
+    pool_type, proj = c[3][0], int(c[3][1])
+    b4 = Sequential()
+    if stride2:
+        b4.add(SpatialMaxPooling(3, 3, 2, 2).ceil()
+               .set_name(name_prefix + "pool"))
+    elif pool_type == "avg":
+        b4.add(SpatialAveragePooling(3, 3, 1, 1, 1, 1, ceil_mode=True)
+               .set_name(name_prefix + "pool"))
+    else:
+        b4.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil()
+               .set_name(name_prefix + "pool"))
+    if proj:
+        _conv_bn_relu(b4, input_size, proj, 1, 1,
+                      name=name_prefix + "pool_proj")
+    concat.add(b4)
+    return concat
+
+
+def Inception_v2(class_num: int = 1000) -> Sequential:
+    """BN-Inception main tower (reference ``Inception.scala`` —
+    ``Inception_v2``); the standard BN-GoogLeNet config table."""
+    model = Sequential()
+    _conv_bn_relu(model, 3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2")
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool1/3x3_s2"))
+    _conv_bn_relu(model, 64, 64, 1, 1, name="conv2/3x3_reduce")
+    _conv_bn_relu(model, 64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil().set_name("pool2/3x3_s2"))
+
+    model.add(Inception_Layer_v2(192, [[64], [64, 64], [64, 96], ["avg", 32]], "inception_3a/"))
+    model.add(Inception_Layer_v2(256, [[64], [64, 96], [64, 96], ["avg", 64]], "inception_3b/"))
+    model.add(Inception_Layer_v2(320, [[0], [128, 160], [64, 96], ["max", 0]], "inception_3c/"))
+    model.add(Inception_Layer_v2(576, [[224], [64, 96], [96, 128], ["avg", 128]], "inception_4a/"))
+    model.add(Inception_Layer_v2(576, [[192], [96, 128], [96, 128], ["avg", 128]], "inception_4b/"))
+    model.add(Inception_Layer_v2(576, [[160], [128, 160], [128, 160], ["avg", 96]], "inception_4c/"))
+    model.add(Inception_Layer_v2(576, [[96], [128, 192], [160, 192], ["avg", 96]], "inception_4d/"))
+    model.add(Inception_Layer_v2(576, [[0], [128, 192], [192, 256], ["max", 0]], "inception_4e/"))
+    model.add(Inception_Layer_v2(1024, [[352], [192, 320], [160, 224], ["avg", 128]], "inception_5a/"))
+    model.add(Inception_Layer_v2(1024, [[352], [192, 320], [192, 224], ["max", 128]], "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1).set_name("pool5/7x7_s1"))
+    model.add(Reshape([1024], batch_mode=True))
+    model.add(Linear(1024, class_num, init_weight=Xavier(), init_bias=Zeros())
+              .set_name("loss3/classifier"))
+    model.add(LogSoftMax().set_name("loss3/loss3"))
+    return model
 
 
 def train_main(argv=None):

@@ -50,11 +50,39 @@ class TestShapes:
         assert abs(_n_params(params) - 25.56e6) < 0.2e6
 
     def test_inception_v1(self):
-        model = Inception_v1(1000)
+        from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+
+        model = Inception_v1_NoAuxClassifier(1000)
         params, out = _forward(model, (1, 3, 224, 224))
         assert out.shape == (1, 1000)
         # GoogLeNet main tower ≈ 7.0M params (incl. classifier)
         assert 5.5e6 < _n_params(params) < 8.0e6
+
+    def test_inception_v1_aux_classifiers(self):
+        from bigdl_tpu.nn import ClassNLLCriterion, ParallelCriterion
+
+        model = Inception_v1(1000)
+        params, outs = _forward(model, (1, 3, 224, 224))
+        # flat table: [main, aux@4d, aux@4a], each (1, 1000) log-probs
+        assert isinstance(outs, list) and len(outs) == 3
+        assert all(np.asarray(o).shape == (1, 1000) for o in outs)
+        # aux towers add ~6M params over the 7M main tower
+        assert 12e6 < _n_params(params) < 15e6
+        crit = (ParallelCriterion(repeat_target=True)
+                .add(ClassNLLCriterion(), 1.0)
+                .add(ClassNLLCriterion(), 0.3)
+                .add(ClassNLLCriterion(), 0.3))
+        loss = crit.forward(outs, np.asarray([7.0]))
+        assert np.isfinite(loss) and loss > 0
+
+    def test_inception_v2_bn(self):
+        from bigdl_tpu.models import Inception_v2
+
+        model = Inception_v2(1000)
+        params, out = _forward(model, (1, 3, 224, 224))
+        assert out.shape == (1, 1000)
+        # BN-GoogLeNet ≈ 11.3M params
+        assert 10e6 < _n_params(params) < 13e6
 
     def test_alexnet_owt(self):
         model = AlexNet_OWT(1000)

@@ -138,3 +138,34 @@ def test_simple_rnn_variant(rng):
     ids = rng.randint(1, 10, size=(2, 4)).astype(np.float32)
     out = model.forward(ids)
     assert out.shape == (2, 4, 9)
+
+
+def test_news20_synthetic_and_glove(tmp_path):
+    from bigdl_tpu.dataset.news20 import get_news20, glove_dict
+
+    texts = get_news20(str(tmp_path / "none"), n_per_class=3)
+    assert len(texts) == 20 * 3
+    labels = {l for _, l in texts}
+    assert labels == set(range(1, 21))
+    assert all(isinstance(t, str) and t for t, _ in texts)
+
+    w2v = glove_dict(str(tmp_path / "noglove"), dim=50)
+    assert all(v.shape == (50,) for v in w2v.values())
+    # corpus keywords are covered by the embedding vocabulary
+    assert "topic0word0" in w2v and "common3" in w2v
+
+
+def test_news20_reads_expanded_tree(tmp_path):
+    import os
+
+    from bigdl_tpu.dataset.news20 import get_news20
+
+    tree = tmp_path / "20news-18828"
+    for group in ("alt.atheism", "sci.space"):
+        d = tree / group
+        d.mkdir(parents=True)
+        for i in range(2):
+            (d / f"{i}").write_text(f"message {i} of {group}")
+    texts = get_news20(str(tmp_path))
+    assert len(texts) == 4
+    assert {l for _, l in texts} == {1, 2}
