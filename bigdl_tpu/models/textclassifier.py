@@ -86,11 +86,37 @@ def train_main(argv=None):
     p.add_argument("--seqLen", type=int, default=50)
     p.add_argument("--classNum", type=int, default=5)
     p.add_argument("--embeddingDim", type=int, default=64)
+    p.add_argument("--news20", action="store_true",
+                   help="use the news20 + GloVe pipeline (the reference's "
+                        "default: pre-embedded input, no LookupTable)")
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(0)
     samples = []
     vocab, class_num = args.vocab, args.classNum
+    if args.news20:
+        # reference pyspark textclassifier.py pipeline: tokenize → GloVe
+        # embed on the host → (seq, dim) float features
+        from bigdl_tpu.dataset.news20 import get_news20, glove_dict
+
+        texts = get_news20(args.folder or "/tmp/news20")
+        w2v = glove_dict(dim=args.embeddingDim)
+        zero = np.zeros((args.embeddingDim,), np.float32)
+        class_num = max(l for _, l in texts)
+        for text, label in texts:
+            toks = simple_tokenize(text)[: args.seqLen]
+            mat = np.stack([w2v.get(t, zero) for t in toks]) if toks else \
+                np.zeros((1, args.embeddingDim), np.float32)
+            if mat.shape[0] < args.seqLen:
+                mat = np.concatenate(
+                    [mat, np.zeros((args.seqLen - mat.shape[0],
+                                    args.embeddingDim), np.float32)])
+            samples.append(Sample(mat.astype(np.float32), np.int32(label)))
+        model = TextClassifier(class_num, embedding_dim=args.embeddingDim,
+                               embedding_input=True)
+        return run_training(model, samples, ClassNLLCriterion(), args,
+                            optim_method=Adagrad(
+                                learning_rate=args.learningRate))
     if args.folder:
         classes = sorted(d for d in os.listdir(args.folder)
                          if os.path.isdir(os.path.join(args.folder, d)))

@@ -282,3 +282,414 @@ class Softmax(TensorModule):
         import jax
 
         return jax.nn.softmax(input, axis=-1), state
+
+
+# ---------------------------------------------------------------------------
+# extended op set (the rest of the reference's ~100 nn/ops classes)
+# ---------------------------------------------------------------------------
+
+class _Unary(TensorModule):
+    def op(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return self.op(input), state
+
+
+class Minimum(_Binary):
+    def op(self, a, b):
+        import jax.numpy as jnp
+
+        return jnp.minimum(a, b)
+
+
+class Pow(_Binary):
+    def op(self, a, b):
+        return a ** b
+
+
+class FloorDiv(_Binary):
+    def op(self, a, b):
+        import jax.numpy as jnp
+
+        return jnp.floor_divide(a, b)
+
+
+class FloorMod(_Binary):
+    def op(self, a, b):
+        import jax.numpy as jnp
+
+        return jnp.mod(a, b)
+
+
+class SquaredDifference(_Binary):
+    def op(self, a, b):
+        return (a - b) * (a - b)
+
+
+class Greater(_Binary):
+    def op(self, a, b):
+        return a > b
+
+
+class GreaterEqual(_Binary):
+    def op(self, a, b):
+        return a >= b
+
+
+class Less(_Binary):
+    def op(self, a, b):
+        return a < b
+
+
+class LessEqual(_Binary):
+    def op(self, a, b):
+        return a <= b
+
+
+class Equal(_Binary):
+    def op(self, a, b):
+        return a == b
+
+
+class NotEqual(_Binary):
+    def op(self, a, b):
+        return a != b
+
+
+class LogicalAnd(_Binary):
+    def op(self, a, b):
+        import jax.numpy as jnp
+
+        return jnp.logical_and(a, b)
+
+
+class LogicalOr(_Binary):
+    def op(self, a, b):
+        import jax.numpy as jnp
+
+        return jnp.logical_or(a, b)
+
+
+class LogicalNot(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(x)
+
+
+class Abs(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.abs(x)
+
+
+class Floor(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.floor(x)
+
+
+class Ceil(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.ceil(x)
+
+
+class Round(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.round(x)
+
+
+class Sign(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.sign(x)
+
+
+class Elu(_Unary):
+    def op(self, x):
+        import jax
+
+        return jax.nn.elu(x)
+
+
+class Selu(_Unary):
+    def op(self, x):
+        import jax
+
+        return jax.nn.selu(x)
+
+
+class Erf(_Unary):
+    def op(self, x):
+        import jax
+
+        return jax.scipy.special.erf(x)
+
+
+class Reciprocal(_Unary):
+    def op(self, x):
+        return 1.0 / x
+
+
+class Cast(_Unary):
+    """TF Cast; dtype resolved at import from the DstT attr."""
+
+    def __init__(self, dtype) -> None:
+        super().__init__()
+        self.dtype = dtype
+
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x).astype(self.dtype)
+
+
+class Transpose(AbstractModule):
+    """TF Transpose: [x, perm]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, perm = input
+        return jnp.transpose(x, tuple(int(p) for p in np.asarray(perm))), state
+
+
+class TileOp(AbstractModule):
+    """TF Tile: [x, multiples]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, mult = input
+        return jnp.tile(x, tuple(int(m) for m in np.asarray(mult))), state
+
+
+class SliceOp(AbstractModule):
+    """TF Slice: [x, begin, size] (size −1 = to the end)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x, begin, size = input
+        begin = [int(b) for b in np.asarray(begin)]
+        size = [int(s) for s in np.asarray(size)]
+        idx = tuple(
+            slice(b, None if s == -1 else b + s)
+            for b, s in zip(begin, size)
+        )
+        return x[idx], state
+
+
+class StridedSlice(AbstractModule):
+    """TF StridedSlice: [x, begin, end, strides] honoring all five masks
+    (begin/end/ellipsis/new-axis/shrink)."""
+
+    def __init__(self, begin_mask: int = 0, end_mask: int = 0,
+                 shrink_axis_mask: int = 0, new_axis_mask: int = 0,
+                 ellipsis_mask: int = 0) -> None:
+        super().__init__()
+        self.begin_mask = begin_mask
+        self.end_mask = end_mask
+        self.shrink_axis_mask = shrink_axis_mask
+        self.new_axis_mask = new_axis_mask
+        self.ellipsis_mask = ellipsis_mask
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x, begin, end, strides = input
+        begin = [int(b) for b in np.asarray(begin)]
+        end = [int(e) for e in np.asarray(end)]
+        strides = [int(s) for s in np.asarray(strides)]
+        idx = []
+        for i in range(len(begin)):
+            if (self.new_axis_mask >> i) & 1:
+                idx.append(None)          # np.newaxis
+            elif (self.ellipsis_mask >> i) & 1:
+                idx.append(Ellipsis)
+            elif (self.shrink_axis_mask >> i) & 1:
+                idx.append(begin[i])
+            else:
+                b = None if (self.begin_mask >> i) & 1 else begin[i]
+                e = None if (self.end_mask >> i) & 1 else end[i]
+                idx.append(slice(b, e, strides[i]))
+        return x[tuple(idx)], state
+
+
+class PackOp(AbstractModule):
+    """TF Pack/Stack: N inputs → stacked along axis."""
+
+    def __init__(self, axis: int = 0) -> None:
+        super().__init__()
+        self.axis = axis
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        xs = input if isinstance(input, (list, tuple)) else [input]
+        return jnp.stack(list(xs), axis=self.axis), state
+
+
+class Unpack(AbstractModule):
+    """TF Unpack/Unstack: tensor → table of slices along axis."""
+
+    def __init__(self, axis: int = 0, num: Optional[int] = None) -> None:
+        super().__init__()
+        self.axis = axis
+        self.num = num
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        n = self.num or input.shape[self.axis]
+        parts = jnp.split(input, n, axis=self.axis)
+        return [jnp.squeeze(p, self.axis) for p in parts], state
+
+
+class SplitOp(AbstractModule):
+    """TF Split: [axis, x] → table of num_split equal parts."""
+
+    def __init__(self, num_split: int) -> None:
+        super().__init__()
+        self.num_split = num_split
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        axis, x = input
+        return list(jnp.split(x, self.num_split, int(np.asarray(axis)))), state
+
+
+class SplitV(AbstractModule):
+    """TF SplitV: [x, size_splits, axis] → table of uneven parts."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, sizes, axis = input
+        sizes = [int(s) for s in np.asarray(sizes)]
+        cuts = list(np.cumsum(sizes[:-1]))
+        return list(jnp.split(x, cuts, int(np.asarray(axis)))), state
+
+
+class Fill(AbstractModule):
+    """TF Fill: [dims, value]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        dims, value = input
+        shape = tuple(int(d) for d in np.asarray(dims))
+        return jnp.full(shape, value), state
+
+
+class Select(AbstractModule):
+    """TF Select/SelectV2: [cond, a, b]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        cond, a, b = input
+        return jnp.where(cond, a, b), state
+
+
+class ClipByValue(AbstractModule):
+    """TF ClipByValue: [x, lo, hi]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, lo, hi = input
+        return jnp.clip(x, lo, hi), state
+
+
+class _Reduce(AbstractModule):
+    """Shared [x, axes] reduction with keep_dims."""
+
+    def __init__(self, keep_dims: bool = False) -> None:
+        super().__init__()
+        self.keep_dims = keep_dims
+
+    def red(self, x, axes, keepdims):
+        raise NotImplementedError
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        x, axes = input
+        axes = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+        return self.red(x, axes, self.keep_dims), state
+
+
+class Sum(_Reduce):
+    def red(self, x, axes, keepdims):
+        import jax.numpy as jnp
+
+        return jnp.sum(x, axis=axes, keepdims=keepdims)
+
+
+class Max(_Reduce):
+    def red(self, x, axes, keepdims):
+        import jax.numpy as jnp
+
+        return jnp.max(x, axis=axes, keepdims=keepdims)
+
+
+class Min(_Reduce):
+    def red(self, x, axes, keepdims):
+        import jax.numpy as jnp
+
+        return jnp.min(x, axis=axes, keepdims=keepdims)
+
+
+class Prod(_Reduce):
+    def red(self, x, axes, keepdims):
+        import jax.numpy as jnp
+
+        return jnp.prod(x, axis=axes, keepdims=keepdims)
+
+
+class ArgMax(AbstractModule):
+    """TF ArgMax: [x, axis] → int indices."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, axis = input
+        return jnp.argmax(x, int(np.asarray(axis))), state
+
+
+class DepthToSpace(TensorModule):
+    """NHWC DepthToSpace with block size b: (N,H,W,C·b²) → (N,H·b,W·b,C)."""
+
+    def __init__(self, block_size: int) -> None:
+        super().__init__()
+        self.b = block_size
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        n, h, w, c = input.shape
+        b = self.b
+        x = input.reshape(n, h, w, b, b, c // (b * b))
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, h * b, w * b, c // (b * b)), state
+
+
+class SpaceToDepth(TensorModule):
+    """NHWC SpaceToDepth with block size b: (N,H·b,W·b,C) → (N,H,W,C·b²)."""
+
+    def __init__(self, block_size: int) -> None:
+        super().__init__()
+        self.b = block_size
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        n, hb, wb, c = input.shape
+        b = self.b
+        x = input.reshape(n, hb // b, b, wb // b, b, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, hb // b, wb // b, c * b * b), state

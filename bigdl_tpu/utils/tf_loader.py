@@ -50,6 +50,10 @@ def _ksize(node) -> List[int]:
 # ops whose ONLY job is passthrough
 _IDENTITY_OPS = {"Identity", "StopGradient", "CheckNumerics", "PlaceholderWithDefault"}
 
+# table-returning ops: consumers address their results by port ("name:1");
+# the loader inserts a SelectTable per referenced port
+_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "Unstack"}
+
 # weight-slot positions per op: input indices that, when fed by a Const,
 # should become trainable ParameterOps rather than frozen ConstOps
 _TRAINABLE_SLOTS = {
@@ -94,6 +98,21 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
         # so constants attach to the first real input node as a dummy dep
         return mod
 
+    port_nodes: Dict[tuple, ModuleNode] = {}
+
+    def build_port(name: str, port: int) -> ModuleNode:
+        base = build(name)
+        if nodes[strip(name)].op not in _MULTI_OUTPUT_OPS:
+            return base
+        key = (strip(name), port)
+        if key not in port_nodes:
+            from bigdl_tpu.nn import SelectTable
+
+            sel = SelectTable(port + 1)  # 1-based
+            sel.set_name(f"{strip(name)}:{port}")
+            port_nodes[key] = sel.inputs(base)
+        return port_nodes[key]
+
     def build(name: str) -> ModuleNode:
         name = strip(name)
         if name in built:
@@ -126,6 +145,7 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
             if inp.startswith("^"):
                 continue  # control edge
             iname = strip(inp)
+            port = int(inp.split(":")[1]) if ":" in inp else 0
             src = nodes[iname]
             # resolve through identity chains for const-ness detection
             seen = set()
@@ -138,7 +158,7 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
                 const_mods.append((i, const_feed(src.name, op, i)))
                 preds.append(None)  # placeholder, filled below
             else:
-                preds.append(build(iname))
+                preds.append(build_port(iname, port))
 
         mod = _lower(node)
         mod.set_name(name)
@@ -272,8 +292,111 @@ def _lower(node):
         from bigdl_tpu.nn.activations import Sigmoid
 
         return Sigmoid()
+    if op == "Minimum":
+        return O.Minimum()
+    if op == "Pow":
+        return O.Pow()
+    if op == "FloorDiv":
+        return O.FloorDiv()
+    if op == "FloorMod":
+        return O.FloorMod()
+    if op == "SquaredDifference":
+        return O.SquaredDifference()
+    if op == "Greater":
+        return O.Greater()
+    if op == "GreaterEqual":
+        return O.GreaterEqual()
+    if op == "Less":
+        return O.Less()
+    if op == "LessEqual":
+        return O.LessEqual()
+    if op == "Equal":
+        return O.Equal()
+    if op == "NotEqual":
+        return O.NotEqual()
+    if op == "LogicalAnd":
+        return O.LogicalAnd()
+    if op == "LogicalOr":
+        return O.LogicalOr()
+    if op == "LogicalNot":
+        return O.LogicalNot()
+    if op == "Abs":
+        return O.Abs()
+    if op == "Floor":
+        return O.Floor()
+    if op == "Ceil":
+        return O.Ceil()
+    if op == "Round":
+        return O.Round()
+    if op == "Sign":
+        return O.Sign()
+    if op == "Elu":
+        return O.Elu()
+    if op == "Selu":
+        return O.Selu()
+    if op == "Erf":
+        return O.Erf()
+    if op == "Reciprocal":
+        return O.Reciprocal()
+    if op == "Cast":
+        return O.Cast(_np_dtype(node.attr["DstT"].type))
+    if op == "Transpose":
+        return O.Transpose()
+    if op == "Tile":
+        return O.TileOp()
+    if op == "Slice":
+        return O.SliceOp()
+    if op == "StridedSlice":
+        return O.StridedSlice(node.attr["begin_mask"].i,
+                              node.attr["end_mask"].i,
+                              node.attr["shrink_axis_mask"].i,
+                              node.attr["new_axis_mask"].i,
+                              node.attr["ellipsis_mask"].i)
+    if op in ("Pack", "Stack"):
+        return O.PackOp(node.attr["axis"].i)
+    if op in ("Unpack", "Unstack"):
+        return O.Unpack(node.attr["axis"].i, node.attr["num"].i or None)
+    if op == "Split":
+        return O.SplitOp(node.attr["num_split"].i)
+    if op == "SplitV":
+        return O.SplitV()
+    if op == "Fill":
+        return O.Fill()
+    if op in ("Select", "SelectV2"):
+        return O.Select()
+    if op == "ClipByValue":
+        return O.ClipByValue()
+    if op == "Sum":
+        return O.Sum(node.attr["keep_dims"].b)
+    if op == "Max":
+        return O.Max(node.attr["keep_dims"].b)
+    if op == "Min":
+        return O.Min(node.attr["keep_dims"].b)
+    if op == "Prod":
+        return O.Prod(node.attr["keep_dims"].b)
+    if op == "ArgMax":
+        return O.ArgMax()
+    if op == "DepthToSpace":
+        return O.DepthToSpace(node.attr["block_size"].i)
+    if op == "SpaceToDepth":
+        return O.SpaceToDepth(node.attr["block_size"].i)
     raise NotImplementedError(
         f"TF op {op!r} (node {node.name!r}) has no bigdl_tpu lowering yet")
+
+
+def _np_dtype(tf_enum: int):
+    """TF DataType enum → numpy dtype (the slots imported graphs cast to)."""
+    table = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+             5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
+             14: "bfloat16", 19: np.float16, 22: np.uint32, 23: np.uint64}
+    if tf_enum not in table:
+        raise NotImplementedError(f"Cast to TF dtype enum {tf_enum}")
+    dt = table[tf_enum]
+    if dt == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return dt
 
 
 class TensorflowLoader:

@@ -132,3 +132,17 @@ def test_alexnet_and_autoencoder_mains():
                                  "--synthetic", "32"])
     ws, _ = m2.parameters()
     assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_textclassifier_news20_glove_pipeline():
+    """The reference's default pipeline: news20 texts embedded with GloVe on
+    the host, BiRecurrent LSTM over pre-embedded input."""
+    from bigdl_tpu.models import textclassifier
+
+    model = textclassifier.train_main([
+        "--news20", "--maxEpoch", "1", "--batchSize", "16",
+        "--seqLen", "12", "--embeddingDim", "32", "--synthetic", "0",
+    ])
+    ws, _ = model.parameters()
+    import numpy as np
+    assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)

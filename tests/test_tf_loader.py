@@ -129,3 +129,74 @@ def test_import_addn_and_unary_ops(rng):
     in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
     g = load_tf(gd, [in_name], [gd.node[-1].name])
     assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_import_extended_op_soup(rng):
+    """Differential test over the extended op set: transpose, strided
+    slicing, reductions, comparisons/select, clip, pow, cast."""
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    def soup(x):
+        t = tf.transpose(x, [0, 2, 1])                    # Transpose
+        s = t[:, 1:4:2, :]                                # StridedSlice
+        r = tf.reduce_sum(s, axis=2, keepdims=True)       # Sum
+        m = tf.reduce_max(s, axis=1)                      # Max
+        c = tf.where(m > 0.0, m, -m)                      # Greater + Select
+        p = tf.pow(tf.abs(c) + 1.0, 2.0)                  # Abs/Pow
+        q = tf.clip_by_value(p, 0.5, 4.0)                 # ClipByValue
+        f = tf.floor(q) + tf.math.ceil(q) - tf.round(q)   # Floor/Ceil/Round
+        cast = tf.cast(tf.cast(f, tf.int32), tf.float32)  # Cast chain
+        return cast + tf.reduce_min(r, axis=[1, 2])[:, None]  # Min
+
+    x = rng.randn(2, 5, 6).astype(np.float32)
+    gd, frozen = _freeze(soup, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_import_split_multi_output(rng):
+    """Multi-output Split: consumers address ports via SelectTable."""
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    def f(x):
+        a, b, c = tf.split(x, 3, axis=1)                  # Split, 3 ports
+        return a * 1.0 + b * 2.0 + c * 3.0
+
+    x = rng.randn(4, 9).astype(np.float32)
+    gd, frozen = _freeze(f, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-5)
+
+
+def test_import_stack_unstack_tile(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    def f(x):
+        rows = tf.unstack(x, axis=1)                      # Unpack, ports
+        s = tf.stack([rows[0], rows[2]], axis=1)          # Pack
+        return tf.tile(s, [1, 2, 1])                      # Tile
+
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    gd, frozen = _freeze(f, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-5)
+
+
+def test_import_elu_selu_erf_minimum(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    def f(x):
+        return tf.minimum(tf.nn.elu(x), tf.nn.selu(x)) + tf.math.erf(x)
+
+    x = rng.randn(3, 7).astype(np.float32)
+    gd, frozen = _freeze(f, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
