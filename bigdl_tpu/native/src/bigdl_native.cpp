@@ -258,4 +258,40 @@ void bigdl_loader_destroy(bigdl_loader* L) {
   delete L;
 }
 
+
+int64_t bigdl_recs_index(const uint8_t* buf, int64_t size, int64_t n_max,
+                         int32_t* labels, int64_t* offsets, int64_t* lengths) {
+  if (size < 4 || std::memcmp(buf, "RECS", 4) != 0) return -1;
+  int64_t pos = 4;
+  int64_t n = 0;
+  auto read_varint = [&](uint64_t* out) -> bool {
+    uint64_t result = 0;
+    int shift = 0;
+    while (pos < size) {
+      uint8_t b = buf[pos++];
+      result |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) {
+        *out = result;
+        return true;
+      }
+      shift += 7;
+      if (shift > 63) return false;  // varint overflow
+    }
+    return false;  // truncated
+  };
+  while (pos < size) {
+    uint64_t label, len;
+    if (!read_varint(&label)) return -1;
+    if (!read_varint(&len)) return -1;
+    if (pos + (int64_t)len > size) return -1;  // truncated payload
+    if (n >= n_max) return -2;
+    labels[n] = (int32_t)label;
+    offsets[n] = pos;
+    lengths[n] = (int64_t)len;
+    pos += (int64_t)len;
+    ++n;
+  }
+  return n;
+}
+
 }  // extern "C"

@@ -38,6 +38,19 @@ void bigdl_decode_cifar(const uint8_t* records, int32_t n,
                         uint8_t* images, int32_t* labels, int32_t label_base,
                         int32_t n_threads);
 
+// ---- record-shard indexing ----
+
+// Index a RECS shard held in memory: buf starts at the 4-byte "RECS" magic;
+// records follow as [varint label][varint payload_len][payload]. Fills
+// labels[i], offsets[i] (payload byte offset from buf start) and lengths[i]
+// for up to n_max records. Returns the record count, -1 on malformed data
+// (bad magic / truncated record / varint overflow), or -2 when the shard
+// holds more than n_max records (call again with a larger capacity).
+// One sequential scan — varint chains can't be split — but ~two orders of
+// magnitude faster than a Python byte loop on multi-GB shards.
+int64_t bigdl_recs_index(const uint8_t* buf, int64_t size, int64_t n_max,
+                         int32_t* labels, int64_t* offsets, int64_t* lengths);
+
 // ---- prefetch executor ----
 // A bounded ring of batch slots filled by the worker pool; Python pushes
 // raw-record jobs (data is copied in) and pops completed float32 batches.

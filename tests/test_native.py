@@ -112,3 +112,57 @@ def test_pipeline_train_stream_deterministic_rng():
         np.testing.assert_allclose(a.input, b.input, atol=1e-5)
         np.testing.assert_array_equal(a.target, b.target)
     it1.close()
+
+
+def test_recs_index_matches_python_reader(tmp_path):
+    import numpy as np
+    import pytest
+
+    from bigdl_tpu import native
+    from bigdl_tpu.dataset import seqfile
+
+    if not native.is_available():
+        pytest.skip(native.unavailable_reason())
+
+    rng = np.random.default_rng(0)
+    recs = [(int(rng.integers(0, 1000)),
+             rng.integers(0, 256, size=int(rng.integers(1, 300)))
+             .astype(np.uint8).tobytes())
+            for _ in range(400)]
+    paths = seqfile.write_shards(recs, str(tmp_path), n_shards=3)
+
+    for p in paths:
+        buf = np.fromfile(p, np.uint8)
+        labels, offsets, lengths = native.recs_index(buf)
+        # python reference reader (force the fallback branch)
+        with open(p, "rb") as f:
+            assert f.read(4) == seqfile.MAGIC
+            want = []
+            while True:
+                lab = seqfile._read_varint(f)
+                if lab is None:
+                    break
+                ln = seqfile._read_varint(f)
+                want.append((lab, f.read(ln)))
+        assert len(want) == len(labels)
+        raw = buf.tobytes()
+        for i, (lab, payload) in enumerate(want):
+            assert labels[i] == lab
+            assert raw[offsets[i]:offsets[i] + lengths[i]] == payload
+
+
+def test_recs_index_rejects_malformed(tmp_path):
+    import numpy as np
+    import pytest
+
+    from bigdl_tpu import native
+
+    if not native.is_available():
+        pytest.skip(native.unavailable_reason())
+
+    with pytest.raises(ValueError):
+        native.recs_index(np.frombuffer(b"NOPE" + b"\x00" * 10, np.uint8))
+    # truncated payload: declares 100 bytes, provides 2
+    bad = b"RECS" + bytes([5]) + bytes([100]) + b"\x01\x02"
+    with pytest.raises(ValueError):
+        native.recs_index(np.frombuffer(bad, np.uint8))
