@@ -1,0 +1,57 @@
+"""ML-pipeline example: DLClassifier inside a feature pipeline.
+
+Reference (UNVERIFIED, SURVEY.md §0): ``example/MLPipeline`` /
+``dlframes`` — wraps a BigDL model as a Spark-ML estimator
+(``DLClassifier``) so it composes with feature transformers and a
+train/evaluate pipeline. Same story here with the sklearn-style
+``dlframes`` API: standardize → DLClassifier(MLP) → accuracy.
+
+    python -m bigdl_tpu.examples.mlpipeline --samples 512 --maxEpoch 4
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(argv=None):
+    from bigdl_tpu.dlframes import DLClassifier
+    from bigdl_tpu.nn import ClassNLLCriterion, Linear, LogSoftMax, ReLU, Sequential
+
+    p = argparse.ArgumentParser(description="DLClassifier pipeline example")
+    p.add_argument("--samples", type=int, default=512)
+    p.add_argument("--features", type=int, default=20)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--maxEpoch", type=int, default=4)
+    p.add_argument("--batchSize", type=int, default=64)
+    args = p.parse_args(argv)
+
+    # synthetic blobs: class c centered at c-dependent offset
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((args.classes, args.features)) * 2.0
+    y = rng.integers(1, args.classes + 1, size=args.samples)  # 1-based
+    X = centers[y - 1] + rng.standard_normal(
+        (args.samples, args.features)).astype(np.float32)
+
+    # pipeline stage 1: standardize (host feature transformer)
+    mu, sd = X.mean(0), X.std(0) + 1e-6
+    Xs = ((X - mu) / sd).astype(np.float32)
+
+    model = (Sequential()
+             .add(Linear(args.features, 32)).add(ReLU())
+             .add(Linear(32, args.classes)).add(LogSoftMax()))
+    clf = (DLClassifier(model, ClassNLLCriterion(), [args.features])
+           .set_batch_size(args.batchSize)
+           .set_max_epoch(args.maxEpoch)
+           .set_learning_rate(0.05))
+    fitted = clf.fit(Xs, y.astype(np.int32))
+    pred = fitted.transform(Xs)
+    acc = float((pred == y).mean())
+    print(f"pipeline accuracy: {acc:.3f} over {args.samples} samples")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

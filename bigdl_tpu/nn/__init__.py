@@ -68,7 +68,7 @@ from bigdl_tpu.nn.layers_more import (
     SpatialDropout1D, SpatialDropout2D, SpatialDropout3D,
     SpatialWithinChannelLRN, SpatialSubtractiveNormalization,
     SpatialDivisiveNormalization, SpatialContrastiveNormalization,
-    NegativeEntropyPenalty,
+    NegativeEntropyPenalty, SpatialConvolutionMap,
 )
 from bigdl_tpu.nn.criterion_more import (
     L1HingeEmbeddingCriterion, PoissonCriterion,
@@ -77,7 +77,7 @@ from bigdl_tpu.nn.criterion_more import (
     TimeDistributedMaskCriterion,
 )
 from bigdl_tpu.nn.beam_search import SequenceBeamSearch, beam_search
-from bigdl_tpu.nn.sparse import SparseLinear, SparseJoinTable
+from bigdl_tpu.nn.sparse import SparseLinear, SparseJoinTable, LookupTableSparse
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear, QuantizedSpatialConvolution, Quantizer,
 )

@@ -862,3 +862,90 @@ class NegativeEntropyPenalty(TensorModule):
 
         pen.defvjp(fwd, bwd)
         return pen(input), state
+
+
+# ---------------------------------------------------------------------------
+# connection-table convolution
+# ---------------------------------------------------------------------------
+
+class SpatialConvolutionMap(TensorModule):
+    """Convolution over an explicit input→output plane connection table
+    (reference ``nn/SpatialConvolutionMap.scala``, Torq heritage): one
+    ``(kH, kW)`` kernel per table row, output plane o = Σ kernels whose row
+    maps into o.
+
+    TPU-native: the per-connection kernels scatter once into a dense
+    ``(O, I, kH, kW)`` weight with zeros at non-connections (scatter indices
+    are static), and the whole layer is ONE MXU convolution — no per-plane
+    accumulation loop.
+    """
+
+    def __init__(self, conn_table, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        table = np.asarray(conn_table, np.int32)
+        assert table.ndim == 2 and table.shape[1] == 2, "conn_table is (K, 2)"
+        self.conn_table = table  # 1-based (in_plane, out_plane) rows
+        self.n_input_plane = int(table[:, 0].max())
+        self.n_output_plane = int(table[:, 1].max())
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.weight_init = init_weight or Xavier()
+        self.bias_init = init_bias or Zeros()
+
+    # reference table builders
+    @staticmethod
+    def full(n_in: int, n_out: int) -> np.ndarray:
+        return np.array([(i + 1, o + 1) for o in range(n_out)
+                         for i in range(n_in)], np.int32)
+
+    @staticmethod
+    def one_to_one(n: int) -> np.ndarray:
+        return np.array([(i + 1, i + 1) for i in range(n)], np.int32)
+
+    @staticmethod
+    def random(n_in: int, n_out: int, fan_in: int, seed: int = 0) -> np.ndarray:
+        rng = np.random.RandomState(seed)
+        rows = []
+        for o in range(n_out):
+            for i in rng.choice(n_in, size=fan_in, replace=False):
+                rows.append((i + 1, o + 1))
+        return np.array(rows, np.int32)
+
+    def init_params(self, rng):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        k = self.conn_table.shape[0]
+        return {
+            "weight": self.weight_init.init(
+                k1, (k, self.kernel_h, self.kernel_w)),
+            "bias": self.bias_init.init(k2, (self.n_output_plane,)),
+        }
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 3
+        x = input[None] if squeeze else input
+        o_idx = self.conn_table[:, 1] - 1
+        i_idx = self.conn_table[:, 0] - 1
+        dense = jnp.zeros(
+            (self.n_output_plane, self.n_input_plane,
+             self.kernel_h, self.kernel_w), params["weight"].dtype,
+        ).at[o_idx, i_idx].add(params["weight"])
+        out = lax.conv_general_dilated(
+            x, dense, (self.stride_h, self.stride_w),
+            ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        out = out + params["bias"][None, :, None, None]
+        return (out[0] if squeeze else out), state

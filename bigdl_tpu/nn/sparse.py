@@ -70,3 +70,57 @@ class SparseJoinTable(AbstractModule):
 
     def __repr__(self) -> str:
         return f"SparseJoinTable(dim={self.dimension})"
+
+
+class LookupTableSparse(AbstractModule):
+    """Embedding bag over sparse id rows (reference
+    ``nn/LookupTableSparse.scala``): input is a ``SparseTensor`` of 1-based
+    ids shaped (batch, max_ids) — optionally a table with a second
+    ``SparseTensor`` of per-id weights — reduced per row by ``combiner``
+    ("sum" | "mean" | "sqrtn", the TF embedding_lookup_sparse semantics the
+    reference mirrors).
+
+    TPU-native: gather + ``segment_sum`` over the fixed COO capacity —
+    static shapes, no densification; id 0 = padding slot contributes zero.
+    """
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 init_weight: Optional[InitializationMethod] = None) -> None:
+        super().__init__()
+        assert combiner in ("sum", "mean", "sqrtn"), combiner
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.weight_init = init_weight or RandomUniform()
+
+    def init_params(self, rng):
+        return {"weight": self.weight_init.init(
+            rng, (self.n_index, self.n_output))}
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(input, (list, tuple)):
+            ids_sp, w_sp = input[0], input[1]
+            weights = w_sp.values
+        else:
+            ids_sp, weights = input, None
+        assert isinstance(ids_sp, SparseTensor), (
+            "LookupTableSparse wants a SparseTensor of ids")
+        rows = ids_sp.indices[0]
+        ids = ids_sp.values.astype(jnp.int32)
+        valid = (ids > 0).astype(params["weight"].dtype)
+        w = valid if weights is None else weights * valid
+        emb = params["weight"][jnp.maximum(ids - 1, 0)]     # (cap, dim)
+        contrib = emb * w[:, None]
+        batch = ids_sp.shape[0]
+        out = jax.ops.segment_sum(contrib, rows, num_segments=batch)
+        if self.combiner == "sum":
+            return out, state
+        if self.combiner == "mean":
+            denom = jax.ops.segment_sum(w, rows, num_segments=batch)
+        else:  # sqrtn
+            denom = jnp.sqrt(
+                jax.ops.segment_sum(w * w, rows, num_segments=batch))
+        return out / jnp.maximum(denom, 1e-12)[:, None], state

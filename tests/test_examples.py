@@ -64,3 +64,10 @@ def test_languagemodel_example_beam_generation(capsys):
     # generated ids must be in-vocabulary (1-based; eos id 0 unreachable)
     toks = [int(t) for t in lines[0].split()[4:]]
     assert len(toks) == 5 and all(1 <= t <= 30 for t in toks)
+
+
+def test_mlpipeline_example_learns():
+    from bigdl_tpu.examples.mlpipeline import main
+
+    acc = main(["--samples", "256", "--maxEpoch", "5", "--batchSize", "64"])
+    assert acc > 0.7  # separable blobs: must beat chance (1/3) by a margin

@@ -494,3 +494,73 @@ def test_multi_rnn_cell_interlayer_dropout(rng):
     # rows even though x differs
     o = np.asarray(out)
     assert_close(o[0], o[1], atol=1e-5)
+
+
+def test_spatial_convolution_map_vs_dense_conv(rng):
+    """full() table must equal a plain SpatialConvolution with the same
+    (rearranged) kernels; one_to_one() equals per-channel depthwise conv."""
+    import torch
+
+    from bigdl_tpu.nn import SpatialConvolutionMap
+
+    table = SpatialConvolutionMap.full(3, 4)
+    m = SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+    m._ensure_params()
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+
+    # dense conv with kernels scattered per the table
+    w = np.zeros((4, 3, 3, 3), np.float32)
+    for k, (i, o) in enumerate(np.asarray(table)):
+        w[o - 1, i - 1] += np.asarray(m.params["weight"])[k]
+    ref = torch.nn.Conv2d(3, 4, 3, padding=1)
+    with torch.no_grad():
+        ref.weight.copy_(torch.from_numpy(w))
+        ref.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    assert_close(out, ref(torch.from_numpy(x)).detach().numpy(), atol=1e-4)
+
+    one = SpatialConvolutionMap(SpatialConvolutionMap.one_to_one(3), 3, 3,
+                                pad_w=1, pad_h=1)
+    one._ensure_params()
+    out1 = np.asarray(one.forward(x))
+    dw = torch.nn.Conv2d(3, 3, 3, padding=1, groups=3)
+    with torch.no_grad():
+        dw.weight.copy_(torch.from_numpy(
+            np.asarray(one.params["weight"])[:, None]))
+        dw.bias.copy_(torch.from_numpy(np.asarray(one.params["bias"])))
+    assert_close(out1, dw(torch.from_numpy(x)).detach().numpy(), atol=1e-4)
+
+    rnd = SpatialConvolutionMap.random(6, 4, fan_in=2)
+    assert rnd.shape == (8, 2) and rnd[:, 0].max() <= 6
+
+
+def test_lookup_table_sparse_combiners(rng):
+    import jax
+
+    from bigdl_tpu.nn import LookupTableSparse
+    from bigdl_tpu.tensor.sparse import SparseTensor
+
+    # batch of 3 rows: ids (1-based), row 2 has one id, row 3 empty
+    ids = np.array([[1, 3, 0], [2, 0, 0], [0, 0, 0]], np.float32)
+    sp = SparseTensor.from_dense(ids, capacity=6)
+
+    m = LookupTableSparse(5, 4, combiner="sum")
+    m._ensure_params()
+    emb = np.asarray(m.params["weight"])
+    out = np.asarray(m.forward(sp))
+    want = np.stack([emb[0] + emb[2], emb[1], np.zeros(4)])
+    assert_close(out, want, atol=1e-5)
+
+    mean = LookupTableSparse(5, 4, combiner="mean")
+    mean.params = {"weight": m.params["weight"]}
+    outm = np.asarray(mean.forward(sp))
+    assert_close(outm[0], (emb[0] + emb[2]) / 2, atol=1e-5)
+    assert_close(outm[1], emb[1], atol=1e-5)
+
+    # weighted sqrtn: weights 2 and 1 on row 0
+    w_dense = np.array([[2.0, 1.0, 0], [1.0, 0, 0], [0, 0, 0]], np.float32)
+    wsp = SparseTensor.from_dense(w_dense, capacity=6)
+    sq = LookupTableSparse(5, 4, combiner="sqrtn")
+    sq.params = {"weight": m.params["weight"]}
+    outs = np.asarray(sq.forward([sp, wsp]))
+    assert_close(outs[0], (2 * emb[0] + emb[2]) / np.sqrt(5.0), atol=1e-5)

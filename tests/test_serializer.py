@@ -49,6 +49,13 @@ def _sparse_input():
     return SparseTensor.from_dense(dense, capacity=10)
 
 
+def _sparse_ids():
+    from bigdl_tpu.tensor import SparseTensor
+
+    ids = np.array([[1, 3, 0], [2, 5, 0]], np.float32)
+    return SparseTensor.from_dense(ids, capacity=8)
+
+
 def _graph():
     inp = nn.Input()
     a = nn.Linear(4, 4).inputs(inp)
@@ -232,6 +239,11 @@ FACTORIES = {
                                np.abs(x(2, 3)) + 0.1),
     "MultiRNNCell": (lambda: nn.MultiRNNCell([nn.LSTM(3, 4), nn.GRU(4, 3)]),
                      None),
+    "SpatialConvolutionMap": (
+        lambda: nn.SpatialConvolutionMap(
+            nn.SpatialConvolutionMap.full(2, 3), 3, 3, pad_w=1, pad_h=1),
+        x(2, 2, 5, 5)),
+    "LookupTableSparse": (lambda: nn.LookupTableSparse(6, 4), _sparse_ids()),
 }
 
 # abstract/base/helper classes with no standalone forward semantics,
