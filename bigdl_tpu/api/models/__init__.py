@@ -1,0 +1,1 @@
+"""``bigdl.models`` equivalent (pyspark zoo namespace)."""

@@ -1,0 +1,7 @@
+"""``bigdl.models.lenet.lenet5`` equivalent — ``build_model(class_num)``."""
+
+from bigdl_tpu.models.lenet import LeNet5
+
+
+def build_model(class_num: int):
+    return LeNet5(class_num)

@@ -1,0 +1,15 @@
+"""``bigdl.models.textclassifier`` equivalent — ``build_model`` plus the
+news20/GloVe helpers the pyspark script imports."""
+
+from bigdl_tpu.dataset.news20 import get_glove_w2v, get_news20  # noqa: F401
+from bigdl_tpu.models.textclassifier import TextClassifier
+
+
+def build_model(class_num: int, token_length: int = 200,
+                sequence_len: int = 500, encoder: str = "lstm",
+                encoder_output_dim: int = 128):
+    """pyspark signature (token_length = embedding dim); the lstm/gru
+    encoder choice maps onto the BiRecurrent LSTM classifier front."""
+    return TextClassifier(class_num, embedding_dim=token_length,
+                          hidden_size=encoder_output_dim,
+                          embedding_input=True)

@@ -185,3 +185,68 @@ def test_lstm_gru_gradient_parity(rng):
     tout.backward(torch.from_numpy(g))
     assert_close(out, tout.detach().numpy(), atol=1e-4)
     assert_close(gin, xt.grad.numpy(), atol=1e-4)
+
+
+def test_separable_conv_gradients_vs_torch(rng):
+    """Depthwise+pointwise gradients (input, both weights, bias) vs torch."""
+    from bigdl_tpu.nn import SpatialSeparableConvolution
+
+    m = SpatialSeparableConvolution(3, 5, 2, 3, 3, p_w=1, p_h=1)
+    m._ensure_params()
+    x = rng.randn(2, 3, 7, 7).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    g = rng.randn(*out.shape).astype(np.float32)
+    gin = np.asarray(m.backward(x, g))
+
+    depth = torch.nn.Conv2d(3, 6, 3, padding=1, groups=3, bias=False)
+    point = torch.nn.Conv2d(6, 5, 1)
+    with torch.no_grad():
+        depth.weight.copy_(torch.from_numpy(np.asarray(m.params["depth_weight"])))
+        point.weight.copy_(torch.from_numpy(np.asarray(m.params["point_weight"])))
+        point.bias.copy_(torch.from_numpy(np.asarray(m.params["bias"])))
+    xt = torch.from_numpy(x).requires_grad_(True)
+    tout = point(depth(xt))
+    tout.backward(torch.from_numpy(g))
+    assert_close(out, tout.detach().numpy(), atol=1e-4)
+    assert_close(gin, xt.grad.numpy(), atol=1e-4)
+    assert_close(np.asarray(m.grad_params["depth_weight"]),
+                 depth.weight.grad.numpy(), atol=1e-3)
+    assert_close(np.asarray(m.grad_params["point_weight"]),
+                 point.weight.grad.numpy(), atol=1e-3)
+    assert_close(np.asarray(m.grad_params["bias"]),
+                 point.bias.grad.numpy(), atol=1e-3)
+
+
+def test_maxout_srelu_convmap_finite_diff(rng):
+    """Finite-difference gradient checks for layers without a torch twin
+    (the nn/GradientChecker.scala pattern)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import Maxout, SpatialConvolutionMap, SReLU
+    from tests.oracle import finite_diff_grad
+
+    cases = []
+    mx = Maxout(4, 3, 2)
+    mx._ensure_params()
+    cases.append((mx, rng.randn(2, 4).astype(np.float32)))
+    sr = SReLU((5,))
+    sr._ensure_params()
+    cases.append((sr, rng.randn(3, 5).astype(np.float32) * 2))
+    cm = SpatialConvolutionMap(
+        SpatialConvolutionMap.random(3, 2, fan_in=2, seed=1), 3, 3,
+        pad_w=1, pad_h=1)
+    cm._ensure_params()
+    cases.append((cm, rng.randn(1, 3, 5, 5).astype(np.float32)))
+
+    for m, x in cases:
+        def loss(xx, m=m):
+            out, _ = m.apply(m.params, jnp.asarray(xx, jnp.float32))
+            return float(jnp.sum(out * out))
+
+        g_analytic = np.asarray(jax.grad(
+            lambda xx: jnp.sum(m.apply(m.params, xx)[0] ** 2))(
+            jnp.asarray(x)))
+        g_numeric = finite_diff_grad(loss, x.astype(np.float64), eps=1e-3)
+        assert_close(g_analytic, g_numeric, atol=2e-2, rtol=2e-2,
+                     msg=type(m).__name__)

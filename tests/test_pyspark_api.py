@@ -52,3 +52,17 @@ def test_jtensor_roundtrip(rng):
     a = rng.randn(3, 4).astype(np.float32)
     jt = JTensor.from_ndarray(a)
     np.testing.assert_array_equal(jt.to_ndarray(), a)
+
+
+def test_models_namespace_shims():
+    from bigdl_tpu.api.models.lenet.lenet5 import build_model as lenet
+    from bigdl_tpu.api.models.textclassifier.textclassifier import (
+        build_model as txt,
+    )
+    import numpy as np
+
+    m = lenet(10)
+    assert m.forward(np.zeros((2, 28, 28), np.float32)).shape == (2, 10)
+    t = txt(5, token_length=16, encoder_output_dim=8)
+    out = t.forward(np.zeros((2, 7, 16), np.float32))
+    assert out.shape == (2, 5)
