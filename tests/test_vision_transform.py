@@ -1,0 +1,117 @@
+"""transform.vision.image pipeline (SURVEY.md §2.5 later-0.x vision path)."""
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_close
+
+
+def _img(rng, h=12, w=10):
+    return (rng.rand(h, w, 3) * 255).astype(np.float32)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(7)
+
+
+def test_geometry_chain(rng):
+    from bigdl_tpu.transform.vision.image import (
+        CenterCrop, ImageFrame, Resize,
+    )
+
+    frame = ImageFrame.array([_img(rng), _img(rng)], labels=[1, 2])
+    out = frame.transform(Resize(16, 16) >> CenterCrop(8, 8))
+    mats = out.get_image()
+    assert all(m.shape == (8, 8, 3) for m in mats)
+    assert out.get_label() == [1, 2]
+
+
+def test_random_crop_and_flip_deterministic(rng):
+    from bigdl_tpu.transform.vision.image import HFlip, ImageFrame, RandomCrop
+
+    img = _img(rng)
+    frame = ImageFrame.array([img], seed=3)
+    a = frame.transform(RandomCrop(6, 6)).get_image()[0]
+    b = frame.transform(RandomCrop(6, 6)).get_image()[0]
+    assert_close(a, b)  # same seed, same crop
+
+    flipped = ImageFrame.array([img]).transform(HFlip()).get_image()[0]
+    assert_close(flipped, img[:, ::-1])
+
+
+def test_photometric_ops(rng):
+    from bigdl_tpu.transform.vision.image import (
+        Brightness, ChannelNormalize, ChannelOrder, Contrast, ImageFeature,
+        PixelNormalizer, Saturation,
+    )
+
+    img = _img(rng)
+    r = np.random.RandomState(0)
+    out = Brightness(10, 10).apply_feature(ImageFeature(img), r).mat()
+    assert_close(out, img + 10.0, atol=1e-4)
+    out = Contrast(2.0, 2.0).apply_feature(ImageFeature(img), r).mat()
+    assert_close(out, img * 2.0, atol=1e-3)
+    out = Saturation(0.0, 0.0).apply_feature(ImageFeature(img), r).mat()
+    assert_close(out, np.broadcast_to(img.mean(2, keepdims=True), img.shape),
+                 atol=1e-3)
+    out = ChannelOrder().apply_feature(ImageFeature(img), r).mat()
+    assert_close(out, img[:, :, ::-1])
+    out = ChannelNormalize(1.0, 2.0, 3.0, 2.0, 2.0, 2.0).apply_feature(
+        ImageFeature(img), r).mat()
+    assert_close(out, (img - [1, 2, 3]) / 2.0, atol=1e-4)
+    out = PixelNormalizer(img).apply_feature(ImageFeature(img), r).mat()
+    assert_close(out, np.zeros_like(img))
+
+
+def test_expand_and_random_transformer(rng):
+    from bigdl_tpu.transform.vision.image import (
+        Expand, HFlip, ImageFeature, RandomTransformer,
+    )
+
+    img = _img(rng)
+    r = np.random.RandomState(1)
+    out = Expand(2.0).apply_feature(ImageFeature(img), r).mat()
+    assert out.shape[0] >= img.shape[0] and out.shape[1] >= img.shape[1]
+
+    # p=0 never applies, p=1 always applies
+    same = RandomTransformer(HFlip(), 0.0).apply_feature(
+        ImageFeature(img), np.random.RandomState(0)).mat()
+    assert_close(same, img)
+    flip = RandomTransformer(HFlip(), 1.0).apply_feature(
+        ImageFeature(img), np.random.RandomState(0)).mat()
+    assert_close(flip, img[:, ::-1])
+
+
+def test_to_sample_pipeline_end_to_end(rng, tmp_path):
+    from PIL import Image
+
+    from bigdl_tpu.transform.vision.image import (
+        CenterCrop, ChannelNormalize, ImageFrame, ImageFrameToSample,
+        MatToTensor, Resize,
+    )
+
+    # write a tiny image directory and run the read→aug→sample pipeline
+    for i in range(3):
+        arr = (np.random.RandomState(i).rand(20, 24, 3) * 255).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"im{i}.png")
+    frame = ImageFrame.read(str(tmp_path))
+    assert len(frame) == 3
+    pipeline = (Resize(16, 16) >> CenterCrop(8, 8)
+                >> ChannelNormalize(120.0, 120.0, 120.0, 60.0, 60.0, 60.0)
+                >> MatToTensor() >> ImageFrameToSample(target_keys=None))
+    out = frame.transform(pipeline)
+    samples = out.get_sample()
+    assert len(samples) == 3
+    feat = np.asarray(samples[0].features[0] if isinstance(
+        samples[0].features, list) else samples[0].features)
+    assert feat.shape == (3, 8, 8)
+
+
+def test_aspect_scale(rng):
+    from bigdl_tpu.transform.vision.image import AspectScale, ImageFeature
+
+    img = _img(rng, h=10, w=20)
+    out = AspectScale(5).apply_feature(
+        ImageFeature(img), np.random.RandomState(0)).mat()
+    assert out.shape[0] == 5 and out.shape[1] == 10  # short side → 5
