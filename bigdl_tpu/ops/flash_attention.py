@@ -37,64 +37,88 @@ def _auto_interpret() -> bool:
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, kv_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, kv_len):
+    """Grid (BH, n_q, n_k) — the KV axis is a GRID dimension, so only one
+    (block_q, d) q tile and one (block_k, d) k/v tile are VMEM-resident per
+    step (O(block²) VMEM at any T); the online-softmax state lives in
+    scratch that persists across the inner kv steps."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # (BQ, D)
-    bq, d = q.shape
-    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (BQ, BK)
-        cols = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
+    # causal: key blocks entirely above the diagonal contribute nothing
+    needed = True
+    if causal:
+        needed = kj * bk <= (qi + 1) * bq - 1
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale        # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)                # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         mask = cols < kv_len
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
         s = jnp.where(mask, s, _NEG_INF)
+        m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
 
-    n_kb = k_ref.shape[1] // block_k
-    if causal:  # skip key blocks entirely above the diagonal
-        n_kb = jnp.minimum(n_kb, pl.cdiv((qi + 1) * bq, block_k))
-    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[...] + jnp.log(l_safe)).astype(jnp.float32)
 
 
 # --------------------------------------------------------------- backward
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_k, kv_len):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, kv_len):
+    """Grid (BH, n_q, n_k): dq accumulates in scratch across kv steps."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)                  # (BQ, D)
-    lse = lse_ref[0]                                    # (BQ, 1)
-    delta = delta_ref[0]                                # (BQ, 1)
-    bq, d = q.shape
-    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-    dq = jnp.zeros((bq, d), jnp.float32)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    needed = True
+    if causal:
+        needed = kj * bk <= (qi + 1) * bq - 1
+
+    @pl.when(needed)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        do = do_ref[0].astype(jnp.float32)              # (BQ, D)
+        lse = lse_ref[0]                                # (BQ, 1)
+        delta = delta_ref[0]                            # (BQ, 1)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        cols = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         mask = cols < kv_len
         if causal:
             mask = jnp.logical_and(mask, cols <= rows)
@@ -102,49 +126,60 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         p = jnp.exp(s - lse)                            # (BQ, BK)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
 
-    n_kb = k_ref.shape[1] // block_k
-    if causal:
-        n_kb = jnp.minimum(n_kb, pl.cdiv((qi + 1) * bq, block_k))
-    dq = jax.lax.fori_loop(0, n_kb, body, dq)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, q_len):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal):
+    """Grid (BH, n_k, n_q): dk/dv accumulate in scratch across query steps.
+    Padded query rows are safe: q and delta are zero-padded so ds and do
+    vanish there."""
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                    # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    bk, d = k.shape
-    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-    dk = jnp.zeros((bk, d), jnp.float32)
-    dv = jnp.zeros((bk, d), jnp.float32)
+    qj = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+    @pl.when(qj == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    needed = True
+    if causal:  # query blocks entirely above the diagonal contribute 0
+        needed = (qj + 1) * bq - 1 >= ki * bk
+
+    @pl.when(needed)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)                # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
         qs = q * scale
         s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)  # (BQ, BK)
-        rows = i * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, 1), 0)
+        rows = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             s = jnp.where(cols <= rows, s, _NEG_INF)
-        p = jnp.exp(s - lse)                            # rows beyond q_len: do=0
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse)
+        dv_scr[...] = dv_scr[...] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + jnp.dot(ds.T, qs, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] = dk_scr[...] + jnp.dot(
+            ds.T, qs, preferred_element_type=jnp.float32)
 
-    n_qb = q_ref.shape[1] // block_q
-    start = (ki * bk) // block_q if causal else 0  # rows above diag: ds == 0
-    dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk, dv))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qj == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ----------------------------------------------------------- host wrappers
@@ -171,27 +206,31 @@ def _out_struct(shape, dtype, *refs):
 
 
 def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, t, d = q3.shape
-    tp = q3.shape[1] + (-q3.shape[1]) % block
+    tp = t + (-t) % block
     qp, kp, vp = (_pad_seq(x, block) for x in (q3, k3, v3))
     kv_len = k3.shape[1]
-    grid = (bh, tp // block)
+    kp_len = kp.shape[1]
+    # grid: kv axis INNERmost so the scratch softmax state carries across it
+    grid = (bh, tp // block, kp_len // block)
+    qblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
+    kblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_k=block, kv_len=kv_len),
+                          kv_len=kv_len),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, kp.shape[1], d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, vp.shape[1], d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0)),
-        ],
+        in_specs=[qblk(d), kblk(d), kblk(d)],
+        out_specs=[qblk(d), qblk(1)],
         out_shape=[
             _out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
             _out_struct((bh, tp, 1), jnp.float32, q3, k3, v3),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, d), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp)
@@ -199,6 +238,8 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
 
 
 def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, t, d = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)             # (BH, T, 1)
@@ -206,27 +247,33 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
     lsep = jnp.pad(lse, ((0, 0), (0, qp.shape[1] - t), (0, 0)))
     deltap = jnp.pad(delta, ((0, 0), (0, qp.shape[1] - t), (0, 0)))
     tp = qp.shape[1]
-    full = lambda n: pl.BlockSpec((1, tp, n), lambda b, i: (b, 0, 0))
-    blk = lambda n: pl.BlockSpec((1, block, n), lambda b, i: (b, i, 0))
+    kp_len = kp.shape[1]
+    qblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
+    kblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=block, kv_len=k3.shape[1]),
-        grid=(bh, tp // block),
-        in_specs=[blk(d), full(d), full(d), blk(d), blk(1), blk(1)],
-        out_specs=blk(d),
+                          kv_len=k3.shape[1]),
+        grid=(bh, tp // block, kp_len // block),
+        in_specs=[qblk(d), kblk(d), kblk(d), qblk(d), qblk(1), qblk(1)],
+        out_specs=qblk(d),
         out_shape=_out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
 
+    # dk/dv: key axis is the carried (outer-block) dim, queries innermost
+    kblk2 = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
+    qblk2 = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block, q_len=t),
-        grid=(bh, tp // block),
-        in_specs=[full(d), blk(d), blk(d), full(d), full(1), full(1)],
-        out_specs=[blk(d), blk(d)],
-        out_shape=[_out_struct((bh, tp, d), k3.dtype, q3, k3, v3),
-                   _out_struct((bh, tp, d), v3.dtype, q3, k3, v3)],
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid=(bh, kp_len // block, tp // block),
+        in_specs=[qblk2(d), kblk2(d), kblk2(d), qblk2(d), qblk2(1), qblk2(1)],
+        out_specs=[kblk2(d), kblk2(d)],
+        out_shape=[_out_struct((bh, kp_len, d), k3.dtype, q3, k3, v3),
+                   _out_struct((bh, kp_len, d), v3.dtype, q3, k3, v3)],
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                        pltpu.VMEM((block, d), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
     return dq[:, :t], dk[:, :k3.shape[1]], dv[:, :v3.shape[1]]
