@@ -663,6 +663,340 @@ class Tensor:
             RNG.next_key(), p, self.data.shape).astype(self.data.dtype)
         return self
 
+    # -- elementwise math breadth (DenseTensorMath parity batch 2) ---------
+
+    def _el(self, fn) -> "Tensor":
+        self.data = fn(self.data)
+        return self
+
+    def _np_el(self, name: str) -> "Tensor":
+        import jax.numpy as jnp
+
+        return self._el(getattr(jnp, name))
+
+    def sin(self):
+        return self._np_el("sin")
+
+    def cos(self):
+        return self._np_el("cos")
+
+    def tan(self):
+        return self._np_el("tan")
+
+    def asin(self):
+        return self._np_el("arcsin")
+
+    def acos(self):
+        return self._np_el("arccos")
+
+    def atan(self):
+        return self._np_el("arctan")
+
+    def sinh(self):
+        return self._np_el("sinh")
+
+    def cosh(self):
+        return self._np_el("cosh")
+
+    def expm1(self):
+        return self._np_el("expm1")
+
+    def log1p(self):
+        return self._np_el("log1p")
+
+    def square(self):
+        return self._el(lambda x: x * x)
+
+    def reciprocal(self):
+        return self._el(lambda x: 1.0 / x)
+
+    def rsqrt(self):
+        import jax.lax as lax
+
+        return self._el(lax.rsqrt)
+
+    def erf(self):
+        import jax
+
+        return self._el(jax.scipy.special.erf)
+
+    def erfc(self):
+        import jax
+
+        return self._el(jax.scipy.special.erfc)
+
+    def atan2(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.arctan2(self.data, _unwrap(other))
+        return self
+
+    def lerp(self, other, weight: float) -> "Tensor":
+        o = _unwrap(other)
+        self.data = self.data + weight * (o - self.data)
+        return self
+
+    def fmod(self, value) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.fmod(self.data, _unwrap(value))
+        return self
+
+    def remainder(self, value) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.remainder(self.data, _unwrap(value))
+        return self
+
+    def cpow(self, other) -> "Tensor":
+        self.data = self.data ** _unwrap(other)
+        return self
+
+    def ne(self, other):
+        return Tensor((self.data != _unwrap(other)))
+
+    def any_true(self) -> bool:
+        return bool(np.asarray(self.data).any())
+
+    def all_true(self) -> bool:
+        return bool(np.asarray(self.data).all())
+
+    # -- reductions / scans ------------------------------------------------
+
+    def cumprod(self, dim: int = 1) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.cumprod(self.data, axis=_resolve_dim(dim, self.data.ndim))
+        return self
+
+    def median(self, dim: Optional[int] = None):
+        """No dim: scalar median (lower of the two for even counts, torch
+        convention). With 1-based dim: (values, 1-based indices)."""
+        import jax.numpy as jnp
+
+        if dim is None:
+            flat = jnp.sort(self.data.reshape(-1))
+            return Tensor(flat[(flat.shape[0] - 1) // 2])
+        ax = _resolve_dim(dim, self.data.ndim)
+        n = self.data.shape[ax]
+        srt = jnp.sort(self.data, axis=ax)
+        idx = jnp.argsort(self.data, axis=ax)
+        take = (n - 1) // 2
+        val = jnp.take(srt, take, axis=ax)
+        ind = jnp.take(idx, take, axis=ax)
+        return Tensor(val), Tensor(ind + 1)
+
+    def kthvalue(self, k: int, dim: int = -1):
+        """k-th smallest (1-based k) along 1-based dim → (values, indices)."""
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        srt = jnp.sort(self.data, axis=ax)
+        idx = jnp.argsort(self.data, axis=ax)
+        return (Tensor(jnp.take(srt, k - 1, axis=ax)),
+                Tensor(jnp.take(idx, k - 1, axis=ax) + 1))
+
+    def dist(self, other, norm: float = 2.0) -> float:
+        import jax.numpy as jnp
+
+        d = jnp.abs(self.data - _unwrap(other)) ** norm
+        return float(jnp.sum(d) ** (1.0 / norm))
+
+    def max_all(self) -> float:
+        return float(np.asarray(self.data).max())
+
+    def min_all(self) -> float:
+        return float(np.asarray(self.data).min())
+
+    def sum_all(self) -> float:
+        return float(np.asarray(self.data).sum())
+
+    # -- linear algebra ----------------------------------------------------
+
+    def trace(self) -> float:
+        import jax.numpy as jnp
+
+        return float(jnp.trace(self.data))
+
+    def diag(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.diag(self.data))
+
+    def tril(self, k: int = 0) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.tril(self.data, k))
+
+    def triu(self, k: int = 0) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.triu(self.data, k))
+
+    def ger(self, vec1, vec2) -> "Tensor":
+        """Outer product accumulate: self += vec1 ⊗ vec2."""
+        import jax.numpy as jnp
+
+        self.data = self.data + jnp.outer(_unwrap(vec1), _unwrap(vec2))
+        return self
+
+    def cross(self, other, dim: int = -1) -> "Tensor":
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        return Tensor(jnp.cross(self.data, _unwrap(other), axis=ax))
+
+    def mv(self, mat, vec) -> "Tensor":
+        import jax.numpy as jnp
+
+        self.data = jnp.matmul(_unwrap(mat), _unwrap(vec))
+        return self
+
+    def addbmm(self, alpha, mat1, mat2) -> "Tensor":
+        """self += alpha * Σ_b mat1[b] @ mat2[b]."""
+        import jax.numpy as jnp
+
+        prod = jnp.einsum("bij,bjk->ik", _unwrap(mat1), _unwrap(mat2))
+        self.data = self.data + alpha * prod
+        return self
+
+    def renorm(self, p: float, dim: int, max_norm: float) -> "Tensor":
+        """Clamp the p-norm of every slice along 1-based ``dim``."""
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        moved = jnp.moveaxis(self.data, ax, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-12), 1.0)
+        flat = flat * scale[:, None]
+        self.data = jnp.moveaxis(flat.reshape(moved.shape), 0, ax)
+        return self
+
+    def conv2(self, kernel, mode: str = "V") -> "Tensor":
+        """2-D cross-correlation-free convolution (kernel flipped), "V"alid
+        or "F"ull — the reference DenseTensorConv role."""
+        return self._conv2(kernel, mode, flip=True)
+
+    def xcorr2(self, kernel, mode: str = "V") -> "Tensor":
+        """2-D cross-correlation, "V"alid or "F"ull."""
+        return self._conv2(kernel, mode, flip=False)
+
+    def _conv2(self, kernel, mode, flip):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        k = jnp.asarray(_unwrap(kernel))
+        if flip:
+            k = k[::-1, ::-1]
+        kh, kw = k.shape
+        pad = ((kh - 1, kh - 1), (kw - 1, kw - 1)) if mode == "F" else "VALID"
+        out = lax.conv_general_dilated(
+            self.data[None, None], k[None, None], (1, 1), pad,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return Tensor(out[0, 0])
+
+    # -- index ops ---------------------------------------------------------
+
+    def nonzero(self) -> "Tensor":
+        """(nnz, ndim) 1-based coordinates (host-side; data-dependent shape)."""
+        idx = np.nonzero(np.asarray(self.data))
+        return Tensor(np.stack(idx, axis=1) + 1)
+
+    def index_add(self, dim: int, index, src) -> "Tensor":
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        ids = jnp.asarray(_unwrap(index), jnp.int32) - 1  # 1-based
+        moved = jnp.moveaxis(self.data, ax, 0)
+        srcm = jnp.moveaxis(jnp.asarray(_unwrap(src)), ax, 0)
+        moved = moved.at[ids].add(srcm)
+        self.data = jnp.moveaxis(moved, 0, ax)
+        return self
+
+    def index_copy(self, dim: int, index, src) -> "Tensor":
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        ids = jnp.asarray(_unwrap(index), jnp.int32) - 1
+        moved = jnp.moveaxis(self.data, ax, 0)
+        srcm = jnp.moveaxis(jnp.asarray(_unwrap(src)), ax, 0)
+        moved = moved.at[ids].set(srcm)
+        self.data = jnp.moveaxis(moved, 0, ax)
+        return self
+
+    def index_fill(self, dim: int, index, value) -> "Tensor":
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        ids = jnp.asarray(_unwrap(index), jnp.int32) - 1
+        moved = jnp.moveaxis(self.data, ax, 0)
+        moved = moved.at[ids].set(value)
+        self.data = jnp.moveaxis(moved, 0, ax)
+        return self
+
+    def masked_copy(self, mask, src) -> "Tensor":
+        """Copy src values (taken in order) into the masked slots —
+        host-side like the reference (data-dependent gather order)."""
+        dense = np.asarray(self.data).copy()
+        m = np.asarray(_unwrap(mask)).astype(bool)
+        vals = np.asarray(_unwrap(src)).reshape(-1)
+        dense[m] = vals[: int(m.sum())]
+        self.data = type(self)(dense).data
+        return self
+
+    def unfold(self, dim: int, size: int, step: int) -> "Tensor":
+        """Sliding windows along 1-based dim: new trailing axis of length
+        ``size`` (torch semantics)."""
+        import jax.numpy as jnp
+
+        ax = _resolve_dim(dim, self.data.ndim)
+        n = self.data.shape[ax]
+        starts = list(range(0, n - size + 1, step))
+        slabs = [jnp.take(self.data, jnp.arange(s, s + size), axis=ax)
+                 for s in starts]
+        # windows stack on axis ax; window elements move to the END (torch)
+        stacked = jnp.stack(slabs, axis=ax)
+        self.data = jnp.moveaxis(stacked, ax + 1, -1)
+        return self
+
+    def permute(self, *dims: int) -> "Tensor":
+        order = tuple(_resolve_dim(d, self.data.ndim) for d in dims)
+        import jax.numpy as jnp
+
+        self.data = jnp.transpose(self.data, order)
+        return self
+
+    def resize_as(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        o = _unwrap(other)
+        self.data = jnp.zeros(o.shape, self.data.dtype)
+        return self
+
+    def is_same_size_as(self, other) -> bool:
+        return tuple(self.data.shape) == tuple(_unwrap(other).shape)
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def linspace(start: float, stop: float, n: int) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.linspace(start, stop, n))
+
+    @staticmethod
+    def logspace(start: float, stop: float, n: int) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.logspace(start, stop, n))
+
+    @staticmethod
+    def range(start: float, stop: float, step: float = 1.0) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.arange(start, stop + step * 0.5, step))
+
     def __repr__(self) -> str:
         return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"
 

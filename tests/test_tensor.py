@@ -171,3 +171,204 @@ def test_tensor_random_fills():
     arr = np.asarray(t)
     assert set(np.unique(arr)) <= {0.0, 1.0}
     assert abs(arr.mean() - 0.3) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# breadth batch 2 (trig/scan/linalg/index families) vs torch oracles
+# ---------------------------------------------------------------------------
+
+def test_elementwise_trig_exp_family_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    x = (rng.randn(3, 4) * 0.5).astype(np.float32)
+    tx = torch.from_numpy(x)
+    for name, tfn in [("sin", torch.sin), ("cos", torch.cos),
+                      ("tan", torch.tan), ("asin", torch.asin),
+                      ("acos", torch.acos), ("atan", torch.atan),
+                      ("sinh", torch.sinh), ("cosh", torch.cosh),
+                      ("expm1", torch.expm1), ("erf", torch.erf),
+                      ("erfc", torch.erfc), ("rsqrt", None),
+                      ("log1p", None), ("square", None),
+                      ("reciprocal", torch.reciprocal)]:
+        arg = np.abs(x) + 0.1 if name in ("rsqrt", "log1p", "reciprocal") else x
+        t = Tensor(arg.copy())
+        got = np.asarray(getattr(t, name)().data)
+        if tfn is not None and name not in ("rsqrt", "log1p"):
+            want = tfn(torch.from_numpy(arg)).numpy()
+        elif name == "rsqrt":
+            want = 1.0 / np.sqrt(arg)
+        elif name == "log1p":
+            want = np.log1p(arg)
+        elif name == "square":
+            want = arg * arg
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5,
+                                   err_msg=name)
+
+
+def test_lerp_fmod_atan2_dist(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32) + 2.0
+    got = np.asarray(Tensor(a.copy()).lerp(b, 0.3).data)
+    np.testing.assert_allclose(
+        got, torch.lerp(torch.from_numpy(a), torch.from_numpy(b), 0.3),
+        atol=1e-6)
+    got = np.asarray(Tensor(a.copy()).fmod(2.0).data)
+    np.testing.assert_allclose(got, np.fmod(a, 2.0), atol=1e-6)
+    got = np.asarray(Tensor(a.copy()).atan2(b).data)
+    np.testing.assert_allclose(got, np.arctan2(a, b), atol=1e-6)
+    d = Tensor(a).dist(b, 2.0)
+    assert abs(d - np.linalg.norm((a - b).ravel())) < 1e-4
+
+
+def test_reductions_scans_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    x = rng.randn(4, 5).astype(np.float32)
+    tx = torch.from_numpy(x)
+    np.testing.assert_allclose(np.asarray(Tensor(x.copy()).cumprod(2).data),
+                               torch.cumprod(tx, 1).numpy(), atol=1e-5)
+    # median along dim 2 (1-based) — torch returns lower median
+    vals, idx = Tensor(x).median(2)
+    tv, ti = torch.median(tx, dim=1)
+    np.testing.assert_allclose(np.asarray(vals.data), tv.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx.data) - 1, ti.numpy())
+    vals, idx = Tensor(x).kthvalue(2, 2)
+    tv, ti = torch.kthvalue(tx, 2, dim=1)
+    np.testing.assert_allclose(np.asarray(vals.data), tv.numpy(), atol=1e-6)
+    m = Tensor(x).median()
+    assert abs(float(np.asarray(m.data)) - torch.median(tx).item()) < 1e-6
+    assert abs(Tensor(x).sum_all() - x.sum()) < 1e-4
+    assert Tensor(x).max_all() == x.max()
+
+
+def test_linalg_batch_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    m = rng.randn(4, 4).astype(np.float32)
+    tm = torch.from_numpy(m)
+    assert abs(Tensor(m).trace() - np.trace(m)) < 1e-5
+    np.testing.assert_allclose(np.asarray(Tensor(m).diag().data), np.diag(m))
+    np.testing.assert_allclose(np.asarray(Tensor(m).tril(0).data),
+                               torch.tril(tm).numpy())
+    np.testing.assert_allclose(np.asarray(Tensor(m).triu(1).data),
+                               torch.triu(tm, 1).numpy())
+
+    v1 = rng.randn(4).astype(np.float32)
+    v2 = rng.randn(5).astype(np.float32)
+    acc = Tensor(np.zeros((4, 5), np.float32)).ger(v1, v2)
+    np.testing.assert_allclose(np.asarray(acc.data), np.outer(v1, v2),
+                               atol=1e-6)
+
+    b1 = rng.randn(3, 4, 5).astype(np.float32)
+    b2 = rng.randn(3, 5, 6).astype(np.float32)
+    out = Tensor(np.zeros((4, 6), np.float32)).addbmm(1.0, b1, b2)
+    np.testing.assert_allclose(
+        np.asarray(out.data),
+        torch.addbmm(torch.zeros(4, 6), torch.from_numpy(b1),
+                     torch.from_numpy(b2)).numpy(), atol=1e-4)
+
+    r = Tensor((rng.randn(3, 8) * 5).astype(np.float32))
+    before = np.asarray(r.data).copy()
+    r.renorm(2.0, 1, 1.0)
+    norms = np.linalg.norm(np.asarray(r.data), axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+    want = torch.renorm(torch.from_numpy(before), 2, 0, 1.0).numpy()
+    np.testing.assert_allclose(np.asarray(r.data), want, atol=1e-4)
+
+
+def test_conv2_xcorr2_vs_scipy_style(rng):
+    from bigdl_tpu.tensor import Tensor
+
+    img = rng.randn(6, 7).astype(np.float32)
+    ker = rng.randn(3, 3).astype(np.float32)
+    got = np.asarray(Tensor(img).xcorr2(ker).data)
+    want = np.zeros((4, 5), np.float32)
+    for i in range(4):
+        for j in range(5):
+            want[i, j] = (img[i:i + 3, j:j + 3] * ker).sum()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    got = np.asarray(Tensor(img).conv2(ker).data)
+    want = np.zeros((4, 5), np.float32)
+    fk = ker[::-1, ::-1]
+    for i in range(4):
+        for j in range(5):
+            want[i, j] = (img[i:i + 3, j:j + 3] * fk).sum()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+    assert Tensor(img).conv2(ker, "F").data.shape == (8, 9)
+
+
+def test_index_family(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    x = rng.randn(5, 3).astype(np.float32)
+    src = rng.randn(2, 3).astype(np.float32)
+    idx = np.array([2, 4], np.int64)  # 1-based
+
+    got = np.asarray(Tensor(x.copy()).index_add(1, idx.astype(np.float32),
+                                                src).data)
+    want = torch.from_numpy(x.copy()).index_add(
+        0, torch.from_numpy(idx - 1), torch.from_numpy(src)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    got = np.asarray(Tensor(x.copy()).index_copy(1, idx.astype(np.float32),
+                                                 src).data)
+    want = x.copy()
+    want[idx - 1] = src
+    np.testing.assert_allclose(got, want)
+
+    got = np.asarray(Tensor(x.copy()).index_fill(1, idx.astype(np.float32),
+                                                 7.0).data)
+    want = x.copy()
+    want[idx - 1] = 7.0
+    np.testing.assert_allclose(got, want)
+
+    nz = np.asarray(Tensor(np.float32([[0, 1], [2, 0]])).nonzero().data)
+    np.testing.assert_array_equal(nz, [[1, 2], [2, 1]])  # 1-based coords
+
+    mc = Tensor(np.zeros((2, 2), np.float32)).masked_copy(
+        np.float32([[1, 0], [0, 1]]), np.float32([5, 6]))
+    np.testing.assert_allclose(np.asarray(mc.data), [[5, 0], [0, 6]])
+
+
+def test_unfold_permute_vs_torch(rng):
+    import torch
+
+    from bigdl_tpu.tensor import Tensor
+
+    x = rng.randn(2, 8).astype(np.float32)
+    got = np.asarray(Tensor(x).unfold(2, 3, 2).data)
+    want = torch.from_numpy(x).unfold(1, 3, 2).numpy()
+    np.testing.assert_allclose(got, want)
+
+    y = rng.randn(2, 3, 4).astype(np.float32)
+    got = np.asarray(Tensor(y).permute(3, 1, 2).data)
+    np.testing.assert_allclose(got, y.transpose(2, 0, 1))
+
+
+def test_constructors_and_meta(rng):
+    from bigdl_tpu.tensor import Tensor
+
+    np.testing.assert_allclose(np.asarray(Tensor.linspace(0, 1, 5).data),
+                               np.linspace(0, 1, 5), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(Tensor.range(1, 5, 2).data),
+                               [1, 3, 5])
+    a, b = Tensor(np.zeros((2, 3))), Tensor(np.ones((2, 3)))
+    assert a.is_same_size_as(b)
+    c = Tensor(np.ones((4,), np.float32)).resize_as(b)
+    assert c.data.shape == (2, 3)
+    assert Tensor(np.float32([1, 0])).any_true()
+    assert not Tensor(np.float32([1, 0])).all_true()
+    ne = Tensor(np.float32([1, 2])).ne(np.float32([1, 3]))
+    np.testing.assert_array_equal(np.asarray(ne.data), [False, True])
