@@ -236,6 +236,53 @@ class _EngineSingleton:
         dev_array = np.asarray(devices).reshape(axis_sizes)
         return Mesh(dev_array, tuple(axis_names))
 
+    def hybrid_mesh(
+        self,
+        ici_axis_names: Sequence[str] = ("data",),
+        ici_axis_sizes: Optional[Sequence[int]] = None,
+        dcn_axis_name: str = "dcn",
+        num_slices: Optional[int] = None,
+        devices=None,
+    ):
+        """Two-level multi-slice mesh: a leading DCN axis across pod slices
+        and ICI axes within each slice.
+
+        Lay data parallelism on ``dcn_axis_name`` and model/sequence/expert
+        axes on the ICI axes — then every heavy collective (psum_scatter,
+        all_gather, all_to_all) stays on ICI links and only the small
+        cross-slice gradient reduction rides DCN. Slices are detected from
+        ``device.slice_index`` when exposed (real multi-slice TPU jobs);
+        pass ``num_slices`` explicitly to partition a flat device list
+        (CPU simulation).
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        slice_ids = [getattr(d, "slice_index", None) for d in devices]
+        if num_slices is None:
+            num_slices = (len({s for s in slice_ids})
+                          if slice_ids and slice_ids[0] is not None else 1)
+        n = len(devices)
+        if n % num_slices:
+            raise ValueError(f"{n} devices do not split into {num_slices} slices")
+        per_slice = n // num_slices
+        if ici_axis_sizes is None:
+            ici_axis_sizes = [per_slice] + [1] * (len(ici_axis_names) - 1)
+        if int(np.prod(ici_axis_sizes)) != per_slice:
+            raise ValueError(
+                f"ici_axis_sizes {tuple(ici_axis_sizes)} do not cover the "
+                f"{per_slice} devices of one slice")
+        if slice_ids and slice_ids[0] is not None and num_slices > 1:
+            # group devices so each leading-axis row is one physical slice
+            order = sorted(range(n), key=lambda i: (slice_ids[i],
+                                                    getattr(devices[i], "id", i)))
+            devices = [devices[i] for i in order]
+        dev = np.asarray(devices).reshape([num_slices] + list(ici_axis_sizes))
+        return Mesh(dev, (dcn_axis_name, *ici_axis_names))
+
     # -- misc --------------------------------------------------------------
 
     def set_seed(self, seed: int) -> None:

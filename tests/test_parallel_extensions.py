@@ -241,3 +241,44 @@ def test_moe_matches_dense_oracle(rng, top_k):
             hh = np.asarray(jax.nn.gelu(x[t] @ ep["w1"][e] + ep["b1"][e]))
             want[t] += g * (hh @ ep["w2"][e] + ep["b2"][e])
     assert_close(out, want, atol=1e-4)
+
+
+def test_hybrid_dcn_ici_mesh_step():
+    """Engine.hybrid_mesh: 2 slices x (2 data x 2 model) on 8 virtual
+    devices; model-parallel psum stays intra-slice (ICI axes), gradient
+    pmean crosses dcn+data — one full step must match the single-device
+    computation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.utils.engine import Engine
+
+    mesh = Engine.hybrid_mesh(
+        ici_axis_names=("data", "model"), ici_axis_sizes=(2, 2),
+        num_slices=2)
+    assert mesh.shape == {"dcn": 2, "data": 2, "model": 2}
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((8, 8)).astype(np.float32)   # rows sharded: model
+    x = rng.standard_normal((8, 8)).astype(np.float32)   # batch: dcn*data
+
+    def spmd(w, x):
+        # row-parallel matmul: the contraction dim is sharded over 'model',
+        # so local products are PARTIAL sums completed by an intra-slice
+        # (ICI) psum
+        part = jnp.matmul(x, w)
+        y = lax.psum(part, "model")
+        loss = jnp.mean(y ** 2)
+        # gradient-style reduction over the data axes (dcn is one of them)
+        return lax.pmean(lax.pmean(loss, "data"), "dcn")
+
+    step = jax.jit(jax.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(P("model", None), P(("dcn", "data"), "model")),
+        out_specs=P()))
+    got = float(step(w, x))
+    want = float(np.mean((x @ w) ** 2))
+    assert abs(got - want) < 1e-4
