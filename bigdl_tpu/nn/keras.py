@@ -562,3 +562,411 @@ class Model(KerasLayer):
 
     def compute_output_shape(self, input_shape):
         return self.output_shape
+
+
+# ---------------------------------------------------------------------------
+# breadth batch 2 (reference nn/keras layer inventory)
+# ---------------------------------------------------------------------------
+
+class SimpleRNN(_KerasRecurrent):
+    def _cell(self, input_size):
+        from bigdl_tpu.nn.recurrent import RnnCell
+
+        return RnnCell(input_size, self.output_dim)
+
+
+class Bidirectional(KerasLayer):
+    """Wrap a keras recurrent layer spec in a BiRecurrent (reference
+    ``nn/keras/Bidirectional.scala``); ``merge_mode`` "concat" | "sum"."""
+
+    def __init__(self, layer: _KerasRecurrent, merge_mode: str = "concat",
+                 input_shape=None) -> None:
+        super().__init__(input_shape or layer.input_shape)
+        assert layer.return_sequences, (
+            "Bidirectional requires return_sequences=True (reference rule)")
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.recurrent import BiRecurrent
+
+        merge = "concat" if self.merge_mode == "concat" else "add"
+        return BiRecurrent(merge=merge).add(self.layer._cell(input_shape[-1]))
+
+    def compute_output_shape(self, input_shape):
+        h = self.layer.output_dim
+        if self.merge_mode == "concat":
+            h *= 2
+        return (input_shape[0], h)
+
+
+class TimeDistributed(KerasLayer):
+    """Apply an inner keras layer to every timestep (reference
+    ``nn/keras/TimeDistributed.scala``)."""
+
+    def __init__(self, layer: KerasLayer, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.layer = layer
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.recurrent import TimeDistributed as CoreTD
+
+        self.layer.build(tuple(input_shape[1:]))
+        return CoreTD(self.layer._core)
+
+    def compute_output_shape(self, input_shape):
+        inner = self.layer.compute_output_shape(tuple(input_shape[1:]))
+        return (input_shape[0],) + tuple(inner)
+
+
+class Convolution1D(KerasLayer):
+    """Temporal convolution over (steps, input_dim) input (reference
+    ``nn/keras/Convolution1D.scala``); ``border_mode`` "valid" | "same"."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 bias: bool = True, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample_length
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import TemporalConvolution
+        from bigdl_tpu.nn.shape_ops import Padding
+
+        core = TemporalConvolution(input_shape[-1], self.nb_filter,
+                                   self.filter_length, self.subsample)
+        if self.border_mode == "same":
+            pad = self.filter_length - 1
+            seq = _containers.Sequential()
+            # symmetric time padding before the valid conv
+            seq.add(Padding(1, -(pad // 2), 2))
+            seq.add(Padding(1, pad - pad // 2, 2))
+            seq.add(core)
+            return _maybe_activation(seq, self.activation)
+        return _maybe_activation(core, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        t = input_shape[0]
+        if self.border_mode == "valid":
+            t = (t - self.filter_length) // self.subsample + 1
+        else:
+            t = (t + self.subsample - 1) // self.subsample
+        return (t, self.nb_filter)
+
+
+class SeparableConvolution2D(KerasLayer):
+    """Depthwise-separable conv over NCHW (reference
+    ``nn/keras/SeparableConvolution2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, depth_multiplier: int = 1,
+                 border_mode: str = "valid", subsample=(1, 1),
+                 bias: bool = True, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.depth_multiplier = depth_multiplier
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def _pads(self):
+        if self.border_mode == "same":
+            return (self.nb_col // 2, self.nb_row // 2)
+        return (0, 0)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import SpatialSeparableConvolution
+
+        pw, ph = self._pads()
+        core = SpatialSeparableConvolution(
+            input_shape[0], self.nb_filter, self.depth_multiplier,
+            self.nb_col, self.nb_row, self.subsample[1], self.subsample[0],
+            pw, ph, with_bias=self.bias)
+        return _maybe_activation(core, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        pw, ph = self._pads()
+        oh = (h + 2 * ph - self.nb_row) // self.subsample[0] + 1
+        ow = (w + 2 * pw - self.nb_col) // self.subsample[1] + 1
+        return (self.nb_filter, oh, ow)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, bias: bool = True,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample = subsample_length
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import LocallyConnected1D as Core
+
+        core = Core(input_shape[0], input_shape[1], self.nb_filter,
+                    self.filter_length, self.subsample, with_bias=self.bias)
+        return _maybe_activation(core, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        t = (input_shape[0] - self.filter_length) // self.subsample + 1
+        return (t, self.nb_filter)
+
+
+class LocallyConnected2D(KerasLayer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), bias: bool = True,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.subsample = tuple(subsample)
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import LocallyConnected2D as Core
+
+        c, h, w = input_shape
+        core = Core(c, w, h, self.nb_filter, self.nb_col, self.nb_row,
+                    self.subsample[1], self.subsample[0],
+                    with_bias=self.bias)
+        return _maybe_activation(core, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        oh = (h - self.nb_row) // self.subsample[0] + 1
+        ow = (w - self.nb_col) // self.subsample[1] + 1
+        return (self.nb_filter, oh, ow)
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.cropping = tuple(cropping)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.shape_ops import Narrow
+
+        lo, hi = self.cropping
+        return Narrow(2, lo, input_shape[0] - lo - hi)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0] - sum(self.cropping),) + tuple(input_shape[1:])
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, heightCrop=(0, 0), widthCrop=(0, 0),
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.hc, self.wc = tuple(heightCrop), tuple(widthCrop)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import Cropping2D as Core
+
+        return Core(self.hc, self.wc)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        return (c, h - sum(self.hc), w - sum(self.wc))
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, dim1Crop=(0, 0), dim2Crop=(0, 0), dim3Crop=(0, 0),
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.crops = (tuple(dim1Crop), tuple(dim2Crop), tuple(dim3Crop))
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import Cropping3D as Core
+
+        return Core(*self.crops)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        (a, b), (e, f), (g, k) = self.crops
+        return (c, d - a - b, h - e - f, w - g - k)
+
+
+class Permute(KerasLayer):
+    """Permute the non-batch dims (1-based dims, reference
+    ``nn/keras/Permute.scala``)."""
+
+    def __init__(self, dims: Sequence[int], input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.dims = tuple(dims)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.module import TensorModule
+
+        perm = self.dims
+
+        class _Permute(TensorModule):
+            def apply(self, params, input, state=None, training=False,
+                      rng=None):
+                import jax.numpy as jnp
+
+                order = (0,) + tuple(p for p in perm)
+                return jnp.transpose(input, order), state
+
+        return _Permute()
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[d - 1] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    """(features,) → (n, features) (reference ``nn/keras/RepeatVector.scala``)."""
+
+    def __init__(self, n: int, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.n = n
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import Replicate
+
+        return Replicate(self.n, 1)
+
+    def compute_output_shape(self, input_shape):
+        return (self.n,) + tuple(input_shape)
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim: int, nb_feature: int = 4, bias: bool = True,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import Maxout
+
+        return Maxout(input_shape[-1], self.output_dim, self.nb_feature,
+                      with_bias=self.bias)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta: float = 1.0, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.theta = theta
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import Threshold
+
+        return Threshold(self.theta, 0.0)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class SReLU(KerasLayer):
+    def __init__(self, shared_axes=None, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.shared_axes = shared_axes
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import SReLU as Core
+
+        return Core(tuple(input_shape), self.shared_axes)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class _IdentityShaped(KerasLayer):
+    """Shared base for shape-preserving wrappers."""
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class GaussianNoise(_IdentityShaped):
+    def __init__(self, sigma: float, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.sigma = sigma
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import GaussianNoise as Core
+
+        return Core(self.sigma)
+
+
+class GaussianDropout(_IdentityShaped):
+    def __init__(self, p: float, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import GaussianDropout as Core
+
+        return Core(self.p)
+
+
+class SpatialDropout1D(_IdentityShaped):
+    def __init__(self, p: float = 0.5, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import SpatialDropout1D as Core
+
+        return Core(self.p)
+
+
+class SpatialDropout2D(_IdentityShaped):
+    def __init__(self, p: float = 0.5, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import SpatialDropout2D as Core
+
+        return Core(self.p)
+
+
+class Masking(_IdentityShaped):
+    def __init__(self, mask_value: float = 0.0, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.mask_value = mask_value
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import Masking as Core
+
+        return Core(self.mask_value)
+
+
+class LeakyReLU(_IdentityShaped):
+    def __init__(self, alpha: float = 0.3, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.alpha = alpha
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.activations import LeakyReLU as Core
+
+        return Core(self.alpha)
+
+
+class ELU(_IdentityShaped):
+    def __init__(self, alpha: float = 1.0, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.alpha = alpha
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.activations import ELU as Core
+
+        return Core(self.alpha)

@@ -233,3 +233,93 @@ def test_keras_model_serialization_roundtrip(rng, tmp_path):
     m2 = AbstractModule.load_module(path)
     m2.evaluate()
     assert_close(np.asarray(m2.forward(x)), want, atol=1e-6)
+
+
+def test_keras_breadth_batch2_shapes_and_numerics(rng):
+    from bigdl_tpu.nn import keras as K
+
+    # Convolution1D valid + same
+    m = (K.Sequential()
+         .add(K.Convolution1D(8, 3, activation="relu", input_shape=(10, 4)))
+         .add(K.Convolution1D(6, 3, border_mode="same")))
+    x = rng.randn(2, 10, 4).astype(np.float32)
+    out = m.forward(x)
+    assert out.shape == (2, 8, 6)
+    assert m.get_output_shape() == (8, 6)
+
+    # SeparableConvolution2D same-mode
+    s = K.Sequential().add(K.SeparableConvolution2D(
+        5, 3, 3, depth_multiplier=2, border_mode="same",
+        input_shape=(3, 8, 8)))
+    assert s.forward(rng.randn(2, 3, 8, 8).astype(np.float32)).shape == (2, 5, 8, 8)
+
+    # LocallyConnected1D/2D
+    l1 = K.Sequential().add(K.LocallyConnected1D(4, 3, input_shape=(7, 5)))
+    assert l1.forward(rng.randn(2, 7, 5).astype(np.float32)).shape == (2, 5, 4)
+    l2 = K.Sequential().add(K.LocallyConnected2D(3, 2, 2, input_shape=(2, 5, 6)))
+    assert l2.forward(rng.randn(1, 2, 5, 6).astype(np.float32)).shape == (1, 3, 4, 5)
+
+
+def test_keras_bidirectional_and_timedistributed(rng):
+    from bigdl_tpu.nn import keras as K
+
+    b = K.Sequential().add(K.Bidirectional(
+        K.LSTM(6, return_sequences=True), merge_mode="concat",
+        input_shape=(5, 3)))
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    assert b.forward(x).shape == (2, 5, 12)
+
+    bsum = K.Sequential().add(K.Bidirectional(
+        K.GRU(6, return_sequences=True), merge_mode="sum",
+        input_shape=(5, 3)))
+    assert bsum.forward(x).shape == (2, 5, 6)
+
+    td = K.Sequential().add(K.TimeDistributed(
+        K.Dense(4), input_shape=(5, 3)))
+    assert td.forward(x).shape == (2, 5, 4)
+
+
+def test_keras_shape_utils_and_activations(rng):
+    from bigdl_tpu.nn import keras as K
+
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    p = K.Sequential().add(K.Permute((2, 3, 1), input_shape=(3, 4, 5)))
+    out = np.asarray(p.forward(x))
+    assert_close(out, x.transpose(0, 2, 3, 1))
+
+    rv = K.Sequential().add(K.RepeatVector(4, input_shape=(6,)))
+    v = rng.randn(2, 6).astype(np.float32)
+    out = np.asarray(rv.forward(v))
+    assert out.shape == (2, 4, 6)
+    assert_close(out[:, 0], v)
+
+    c1 = K.Sequential().add(K.Cropping1D((1, 2), input_shape=(8, 3)))
+    assert c1.forward(rng.randn(2, 8, 3).astype(np.float32)).shape == (2, 5, 3)
+    c2 = K.Sequential().add(K.Cropping2D((1, 1), (2, 0), input_shape=(3, 6, 7)))
+    assert c2.forward(rng.randn(2, 3, 6, 7).astype(np.float32)).shape == (2, 3, 4, 5)
+    c3 = K.Sequential().add(K.Cropping3D((1, 0), (0, 1), (1, 1),
+                                         input_shape=(2, 4, 4, 5)))
+    assert c3.forward(
+        rng.randn(1, 2, 4, 4, 5).astype(np.float32)).shape == (1, 2, 3, 3, 3)
+
+    t = K.Sequential().add(K.ThresholdedReLU(1.0, input_shape=(4,)))
+    got = np.asarray(t.forward(np.float32([[0.5, 1.5, -2.0, 3.0]])))
+    assert_close(got, [[0.0, 1.5, 0.0, 3.0]])
+
+    md = K.Sequential().add(K.MaxoutDense(3, 4, input_shape=(5,)))
+    assert md.forward(rng.randn(2, 5).astype(np.float32)).shape == (2, 3)
+
+    sr = K.Sequential().add(K.SReLU(input_shape=(4,)))
+    assert sr.forward(rng.randn(2, 4).astype(np.float32)).shape == (2, 4)
+
+    for cls in (K.GaussianNoise, K.GaussianDropout, K.SpatialDropout1D):
+        layer = cls(0.5, input_shape=(6, 3)) if cls is not K.GaussianNoise \
+            else cls(0.3, input_shape=(6, 3))
+        m = K.Sequential().add(layer)
+        m.evaluate()
+        xx = rng.randn(2, 6, 3).astype(np.float32)
+        assert_close(np.asarray(m.forward(xx)), xx)  # eval mode = identity
+
+    lr = K.Sequential().add(K.LeakyReLU(0.1, input_shape=(3,)))
+    got = np.asarray(lr.forward(np.float32([[-1.0, 0.0, 2.0]])))
+    assert_close(got, [[-0.1, 0.0, 2.0]], atol=1e-6)
