@@ -19,6 +19,7 @@ from bigdl_tpu.nn.activations import (
 from bigdl_tpu.nn.shape_ops import (
     Reshape, View, Select, Narrow, Squeeze, Unsqueeze, Transpose, Contiguous,
     Padding, CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
+    CAveTable,
     JoinTable, SplitTable,
     FlattenTable,
 )

@@ -273,3 +273,13 @@ class FlattenTable(TensorModule):
 
         rec(input)
         return flat, state
+
+
+class CAveTable(TensorModule):
+    """Elementwise average over a Table (reference ``nn/CAveTable.scala``)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import functools
+        import operator
+
+        return functools.reduce(operator.add, input) / len(input), state

@@ -76,6 +76,7 @@ FACTORIES = {
     "CAddTable": (lambda: nn.CAddTable(), [x(2, 3), x(2, 3)]),
     "CDivTable": (lambda: nn.CDivTable(), [x(2, 3), x(2, 3) + 3.0]),
     "CMaxTable": (lambda: nn.CMaxTable(), [x(2, 3), x(2, 3)]),
+    "CAveTable": (lambda: nn.CAveTable(), [x(2, 3), x(2, 3)]),
     "CMinTable": (lambda: nn.CMinTable(), [x(2, 3), x(2, 3)]),
     "CMul": (lambda: nn.CMul((3,)), x(2, 3)),
     "CMulTable": (lambda: nn.CMulTable(), [x(2, 3), x(2, 3)]),
