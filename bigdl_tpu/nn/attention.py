@@ -82,9 +82,11 @@ class MultiHeadAttention(TensorModule):
         flash_ok = self.use_flash == "always" or (
             self.use_flash == "auto" and jax.default_backend() == "tpu")
         if self.sequence_parallel == "ring":
-            # non-causal ring rides the Pallas flash blocks when allowed
+            # ring rides the Pallas flash blocks when allowed (causal mode
+            # uses the striped-causal merge: causal diagonal + LSE-nulled
+            # future blocks)
             out = ring_attention(q, k, v, self.sp_axis, causal=self.causal,
-                                 use_flash=flash_ok and not self.causal)
+                                 use_flash=flash_ok)
         elif self.sequence_parallel == "ulysses":
             out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal)
         elif flash_ok:

@@ -30,6 +30,16 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30  # finite sentinel: keeps exp() well-defined for masked rows
 
 
+def _maybe_when(pred):
+    """``pl.when`` that executes inline for a concrete ``True`` predicate —
+    the causal block-skip uses traced predicates, which the Pallas HLO
+    interpreter's vma checking rejects inside shard_map, so interpret mode
+    runs every block unconditionally (correctness comes from the mask)."""
+    if pred is True:
+        return lambda f: f()
+    return pl.when(pred)
+
+
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -38,7 +48,7 @@ def _auto_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, kv_len):
+                *, scale, causal, kv_len, skip):
     """Grid (BH, n_q, n_k) — the KV axis is a GRID dimension, so only one
     (block_q, d) q tile and one (block_k, d) k/v tile are VMEM-resident per
     step (O(block²) VMEM at any T); the online-softmax state lives in
@@ -57,10 +67,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     # causal: key blocks entirely above the diagonal contribute nothing
     needed = True
-    if causal:
+    if causal and skip:
         needed = kj * bk <= (qi + 1) * bq - 1
 
-    @pl.when(needed)
+    @_maybe_when(needed)
     def _step():
         # dots run on the INPUT dtype (bf16 stays on the fast MXU path)
         # with f32 accumulation; softmax state is always f32
@@ -94,7 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, kv_len):
+               dq_scr, *, scale, causal, kv_len, skip):
     """Grid (BH, n_q, n_k): dq accumulates in scratch across kv steps."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -107,10 +117,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
 
     needed = True
-    if causal:
+    if causal and skip:
         needed = kj * bk <= (qi + 1) * bq - 1
 
-    @pl.when(needed)
+    @_maybe_when(needed)
     def _step():
         q = q_ref[0]
         do = do_ref[0]                                  # (BQ, D)
@@ -137,7 +147,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, skip):
     """Grid (BH, n_k, n_q): dk/dv accumulate in scratch across query steps.
     Padded query rows are safe: q and delta are zero-padded so ds and do
     vanish there."""
@@ -153,10 +163,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
 
     needed = True
-    if causal:  # query blocks entirely above the diagonal contribute 0
+    if causal and skip:  # query blocks entirely above the diagonal contribute 0
         needed = (qj + 1) * bq - 1 >= ki * bk
 
-    @pl.when(needed)
+    @_maybe_when(needed)
     def _step():
         k = k_ref[0]                                    # (BK, D)
         v = v_ref[0]
@@ -222,7 +232,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
     kblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          kv_len=kv_len),
+                          kv_len=kv_len, skip=not interpret),
         grid=grid,
         in_specs=[qblk(d), kblk(d), kblk(d)],
         out_specs=[qblk(d), qblk(1)],
@@ -256,7 +266,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          kv_len=k3.shape[1]),
+                          kv_len=k3.shape[1], skip=not interpret),
         grid=(bh, tp // block, kp_len // block),
         in_specs=[qblk(d), kblk(d), kblk(d), qblk(d), qblk(1), qblk(1)],
         out_specs=qblk(d),
@@ -269,7 +279,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
     kblk2 = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
     qblk2 = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          skip=not interpret),
         grid=(bh, kp_len // block, tp // block),
         in_specs=[qblk2(d), kblk2(d), kblk2(d), qblk2(d), qblk2(1), qblk2(1)],
         out_specs=[kblk2(d), kblk2(d)],
@@ -335,10 +346,12 @@ def _auto_block(t_max: int) -> int:
 
 def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
                              block: Optional[int] = None,
-                             interpret: Optional[bool] = None):
+                             interpret: Optional[bool] = None,
+                             causal: bool = False):
     """Forward-only fused attention returning ``(out, lse)`` — the
     per-query log-sum-exp lets callers merge partial attention blocks with
-    the online-softmax rule (ring attention's flash path). Non-causal.
+    the online-softmax rule (ring attention's flash path; ``causal=True``
+    for the diagonal block of a causal ring).
     ``out``: (B, T, H, D); ``lse``: (B, H, T) float32.
     """
     b, t, h, d = q.shape
@@ -346,7 +359,8 @@ def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
         block = _auto_block(max(q.shape[1], k.shape[1]))
     q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
         q, k, v, scale, interpret)
-    o3, lse = _flash_fwd(q3, k3, v3, scale, False, int(block), interpret)
+    o3, lse = _flash_fwd(q3, k3, v3, scale, bool(causal), int(block),
+                         interpret)
     return from3(o3), lse[..., 0].reshape(b, h, t)
 
 

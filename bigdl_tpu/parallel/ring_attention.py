@@ -60,41 +60,51 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     sequence is the concatenation over the mesh axis in axis-index order.
     Returns the (B, T_local, H, D) attention output for the local Q block.
 
-    ``use_flash=True`` (non-causal only) computes each K/V block with the
-    Pallas flash kernel and merges blocks by their log-sum-exp — the
-    forward never materializes a (T, T) score block, so T_local can grow
-    to the kernel's O(T) memory limit. Gradients run the einsum ring
-    (remat-style recomputation), so the path stays fully differentiable.
+    ``use_flash=True`` computes each K/V block with the Pallas flash kernel
+    and merges blocks by their log-sum-exp — the forward never materializes
+    a (T, T) score block, so T_local can grow to the kernel's O(T) memory
+    limit. Causal mode runs the diagonal block through the causal kernel
+    and nulls future-originated blocks via their LSE (striped-causal ring).
+    Gradients run the einsum ring (remat-style recomputation), so the path
+    stays fully differentiable.
     """
     if use_flash:
-        if causal:
-            raise ValueError(
-                "ring_attention(use_flash=True) supports causal=False only; "
-                "the causal path uses the einsum ring")
         sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        return _get_ring_flash()(q, k, v, axis_name, float(sc))
+        return _get_ring_flash()(q, k, v, axis_name, float(sc), bool(causal))
     return _ring_einsum(q, k, v, axis_name, causal, scale)
 
 
-def _ring_flash_impl(q, k, v, axis_name: str, scale: float):
+def _ring_flash_impl(q, k, v, axis_name: str, scale: float, causal: bool):
     import jax.numpy as jnp
     from jax import lax
 
     from bigdl_tpu.ops.flash_attention import flash_attention_with_lse
 
     n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    # accumulators derived FROM q so they inherit q's device-varying axes —
-    # otherwise the fori_loop carry types mismatch under shard_map's vma
-    # tracking (same workaround as _ring_einsum)
-    base = jnp.sum(q.astype(jnp.float32) * 0.0, axis=-1).transpose(0, 2, 1)
-    o0 = q.astype(jnp.float32) * 0.0          # (B, T, H, D)
-    m0 = base - jnp.inf                       # (B, H, T)
-    l0 = base
 
-    def body(_, carry):
-        kb, vb, m, l, o_acc = carry
+    # step 0 is always the DIAGONAL block (kv originated here): causal mode
+    # runs it through the causal kernel; every later rotation holds a block
+    # strictly from another rank, handled non-causally and nulled (via -inf
+    # LSE) when it originates in this chip's future
+    o0, lse0 = flash_attention_with_lse(q, k, v, scale, causal=causal)
+    m0 = lse0                                  # (B, H, T)
+    l0 = jnp.ones_like(lse0)                   # exp(lse0 - m0)
+    o_acc0 = o0.astype(jnp.float32)
+    kb0 = lax.ppermute(k, axis_name, perm)
+    vb0 = lax.ppermute(v, axis_name, perm)
+
+    # the ring length is static — a Python unroll keeps exactly one pallas
+    # lowering shape per call site (a traced fori_loop mixing the causal and
+    # non-causal kernel variants trips jax's closed-call lowering cache)
+    kb, vb, m, l, o_acc = kb0, vb0, m0, l0, o_acc0
+    for step in range(1, n):
         o_i, lse_i = flash_attention_with_lse(q, kb, vb, scale)
+        if causal:
+            src = (my - step) % n
+            lse_i = jnp.where(src < my, lse_i,
+                              jnp.full_like(lse_i, -jnp.inf))
         m_new = jnp.maximum(m, lse_i)
         corr = jnp.exp(m - m_new)          # rescale old accumulators
         w = jnp.exp(lse_i - m_new)         # this block's weight
@@ -102,13 +112,10 @@ def _ring_flash_impl(q, k, v, axis_name: str, scale: float):
         cq = corr.transpose(0, 2, 1)[..., None]
         o_acc = o_acc * cq + o_i.astype(jnp.float32) * wq
         l = l * corr + w
-        # the last rotation is dead but keeps carry types uniform,
-        # matching the einsum ring's loop shape
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        return kb, vb, m_new, l, o_acc
-
-    _, _, _, l, o_acc = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+        m = m_new
+        if step < n - 1:                   # last rotation would be dead
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
     lq = l.transpose(0, 2, 1)[..., None]
     return (o_acc / jnp.maximum(lq, 1e-20)).astype(q.dtype)
 
@@ -126,18 +133,18 @@ def _get_ring_flash():
 
     import jax
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-    def ring_flash(q, k, v, axis_name, scale):
-        return _ring_flash_impl(q, k, v, axis_name, scale)
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+    def ring_flash(q, k, v, axis_name, scale, causal):
+        return _ring_flash_impl(q, k, v, axis_name, scale, causal)
 
-    def fwd(q, k, v, axis_name, scale):
-        return _ring_flash_impl(q, k, v, axis_name, scale), (q, k, v)
+    def fwd(q, k, v, axis_name, scale, causal):
+        return _ring_flash_impl(q, k, v, axis_name, scale, causal), (q, k, v)
 
-    def bwd(axis_name, scale, res, ct):
+    def bwd(axis_name, scale, causal, res, ct):
         # backward = vjp of the einsum ring (recomputes — the remat trade)
         q, k, v = res
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _ring_einsum(q_, k_, v_, axis_name, False,
+            lambda q_, k_, v_: _ring_einsum(q_, k_, v_, axis_name, causal,
                                             scale),
             q, k, v)
         return vjp(ct)
