@@ -693,3 +693,127 @@ class SpaceToDepth(TensorModule):
         x = input.reshape(n, hb // b, b, wb // b, b, c)
         x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
         return x.reshape(n, hb // b, wb // b, c * b * b), state
+
+
+class GatherV2(AbstractModule):
+    """TF GatherV2: [params, indices, axis]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        p, idx, axis = input
+        return jnp.take(p, jnp.asarray(idx, jnp.int32),
+                        axis=int(np.asarray(axis))), state
+
+
+class OneHot(AbstractModule):
+    """TF OneHot: [indices, depth, on_value, off_value]; axis attr."""
+
+    def __init__(self, axis: int = -1) -> None:
+        super().__init__()
+        self.axis = axis
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        idx, depth, on, off = input
+        oh = jax.nn.one_hot(jnp.asarray(idx, jnp.int32),
+                            int(np.asarray(depth)), axis=self.axis)
+        return oh * on + (1.0 - oh) * off, state
+
+
+class BatchMatMul(AbstractModule):
+    """TF BatchMatMul(V2) with adjoint flags."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False) -> None:
+        super().__init__()
+        self.adj_x = adj_x
+        self.adj_y = adj_y
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        a, b = input
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), state
+
+
+class Cumsum(AbstractModule):
+    """TF Cumsum: [x, axis] with exclusive/reverse attrs."""
+
+    def __init__(self, exclusive: bool = False, reverse: bool = False) -> None:
+        super().__init__()
+        self.exclusive = exclusive
+        self.reverse = reverse
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, axis = input
+        ax = int(np.asarray(axis))
+        if self.reverse:
+            x = jnp.flip(x, ax)
+        out = jnp.cumsum(x, axis=ax)
+        if self.exclusive:
+            out = out - x
+        if self.reverse:
+            out = jnp.flip(out, ax)
+        return out, state
+
+
+class RangeOp(AbstractModule):
+    """TF Range: [start, limit, delta]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        start, limit, delta = (np.asarray(v) for v in input)
+        return jnp.arange(float(start), float(limit), float(delta)), state
+
+
+class ZerosLike(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(x)
+
+
+class OnesLike(_Unary):
+    def op(self, x):
+        import jax.numpy as jnp
+
+        return jnp.ones_like(x)
+
+
+class Shape(TensorModule):
+    """TF Shape — static under XLA, returned as a constant vector."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.asarray(input.shape, jnp.int32), state
+
+
+class LogSoftmax(TensorModule):
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+
+        return jax.nn.log_softmax(input, axis=-1), state
+
+
+class TopKV2(AbstractModule):
+    """TF TopKV2: [x, k] → table [values, indices] (multi-output ports)."""
+
+    def __init__(self, sorted: bool = True) -> None:
+        super().__init__()
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        x, k = input
+        vals, idx = lax.top_k(x, int(np.asarray(k)))
+        return [vals, idx], state

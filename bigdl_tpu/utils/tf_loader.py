@@ -52,7 +52,7 @@ _IDENTITY_OPS = {"Identity", "StopGradient", "CheckNumerics", "PlaceholderWithDe
 
 # table-returning ops: consumers address their results by port ("name:1");
 # the loader inserts a SelectTable per referenced port
-_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "Unstack"}
+_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "Unstack", "TopKV2", "TopK"}
 
 # weight-slot positions per op: input indices that, when fed by a Const,
 # should become trainable ParameterOps rather than frozen ConstOps
@@ -380,6 +380,26 @@ def _lower(node):
         return O.DepthToSpace(node.attr["block_size"].i)
     if op == "SpaceToDepth":
         return O.SpaceToDepth(node.attr["block_size"].i)
+    if op == "GatherV2":
+        return O.GatherV2()
+    if op == "OneHot":
+        return O.OneHot(node.attr["axis"].i if "axis" in node.attr else -1)
+    if op in ("BatchMatMul", "BatchMatMulV2"):
+        return O.BatchMatMul(node.attr["adj_x"].b, node.attr["adj_y"].b)
+    if op == "Cumsum":
+        return O.Cumsum(node.attr["exclusive"].b, node.attr["reverse"].b)
+    if op == "Range":
+        return O.RangeOp()
+    if op == "ZerosLike":
+        return O.ZerosLike()
+    if op == "OnesLike":
+        return O.OnesLike()
+    if op == "Shape":
+        return O.Shape()
+    if op == "LogSoftmax":
+        return O.LogSoftmax()
+    if op in ("TopKV2", "TopK"):
+        return O.TopKV2()
     raise NotImplementedError(
         f"TF op {op!r} (node {node.name!r}) has no bigdl_tpu lowering yet")
 

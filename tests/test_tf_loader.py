@@ -200,3 +200,29 @@ def test_import_elu_selu_erf_minimum(rng):
     in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
     g = load_tf(gd, [in_name], [gd.node[-1].name])
     assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_import_gather_onehot_bmm_cumsum_topk(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    table = rng.randn(10, 4).astype(np.float32)
+
+    def f(x):
+        idx = tf.argmax(x, axis=1)                       # ArgMax
+        g = tf.gather(tf.constant(table), idx)           # GatherV2
+        oh = tf.one_hot(idx, 6, on_value=2.0, off_value=-1.0)  # OneHot
+        bm = tf.matmul(x[:, None, :], x[:, :, None])     # BatchMatMulV2
+        cs = tf.cumsum(x, axis=1, exclusive=True)        # Cumsum
+        vals, _ = tf.math.top_k(x, k=3)                  # TopKV2 port 0
+        z = tf.zeros_like(x) + tf.ones_like(x)           # Zeros/OnesLike
+        return (tf.reduce_sum(g, 1) + tf.reduce_sum(oh, 1)
+                + bm[:, 0, 0] + tf.reduce_sum(cs, 1)
+                + tf.reduce_sum(vals, 1) + tf.reduce_sum(z, 1)
+                + tf.reduce_sum(tf.nn.log_softmax(x), 1))
+
+    x = rng.randn(5, 6).astype(np.float32)
+    gd, frozen = _freeze(f, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
