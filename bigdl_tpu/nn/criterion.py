@@ -216,15 +216,23 @@ class TimeDistributedCriterion(AbstractCriterion):
         self.dimension = dimension
 
     def apply(self, input, target):
+        import jax
         import jax.numpy as jnp
 
         ax = self.dimension - 1
         steps = input.shape[ax]
-        total = 0.0
-        for t in range(steps):
-            xi = jnp.take(input, t, axis=ax)
-            ti = jnp.take(target, t, axis=ax) if target.ndim > ax else target
-            total = total + self.critrn.apply(xi, ti)
+        # vmap over the time axis — ONE traced criterion instead of a
+        # steps-times unrolled Python loop (at T=2048 the unroll dominated
+        # trace/compile time)
+        xs = jnp.moveaxis(input, ax, 0)
+        # the target is per-step when it carries the time axis (same length
+        # at ``ax``); otherwise one shared target for every step
+        if target.ndim > ax and target.shape[ax] == steps:
+            ts = jnp.moveaxis(target, ax, 0)
+            per = jax.vmap(self.critrn.apply)(xs, ts)
+        else:
+            per = jax.vmap(lambda x: self.critrn.apply(x, target))(xs)
+        total = jnp.sum(per)
         return total / steps if self.size_average else total
 
 

@@ -103,3 +103,28 @@ def test_gradient_check_crossentropy(rng):
     g = np.asarray(crit.backward(x.astype(np.float32), t))
     g_fd = finite_diff_grad(lambda xx: float(crit.apply(xx.astype(np.float32), t)), x)
     assert_close(g, g_fd, atol=1e-3)
+
+
+def test_time_distributed_criterion_vmap_matches_loop(rng):
+    """The vmapped form must equal the per-step loop semantics exactly."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import ClassNLLCriterion, MSECriterion, TimeDistributedCriterion
+
+    logp = np.log(np.abs(rng.randn(3, 5, 4)).astype(np.float32) + 0.1)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    tgt = rng.randint(1, 5, size=(3, 5)).astype(np.float32)
+
+    c = TimeDistributedCriterion(ClassNLLCriterion(), size_average=True)
+    got = c.forward(logp, tgt)
+    want = np.mean([ClassNLLCriterion().forward(logp[:, t], tgt[:, t])
+                    for t in range(5)])
+    assert abs(got - want) < 1e-5
+
+    # shared (time-less) target branch
+    x = rng.randn(3, 4, 6).astype(np.float32)
+    shared = rng.randn(3, 6).astype(np.float32)
+    c2 = TimeDistributedCriterion(MSECriterion(), size_average=False)
+    got = c2.forward(x, shared)
+    want = sum(MSECriterion().forward(x[:, t], shared) for t in range(4))
+    assert abs(got - want) < 1e-4
