@@ -93,9 +93,10 @@ def read_shard(path: str) -> Iterator[Tuple[int, bytes]]:
                 labels, offsets, lengths = native.recs_index(buf)
             except ValueError as e:
                 raise ValueError(f"{path}: {e}") from None
-            data = buf.tobytes()
+            # per-record bytes come straight off the mmap-able array — no
+            # whole-shard second copy
             for lab, off, ln in zip(labels, offsets, lengths):
-                yield int(lab), data[off:off + ln]
+                yield int(lab), buf[off:off + ln].tobytes()
             return
     except OSError:
         pass  # no toolchain — fall through to the Python reader

@@ -99,8 +99,10 @@ def train_main(argv=None):
         # embed on the host → (seq, dim) float features
         from bigdl_tpu.dataset.news20 import get_news20, glove_dict
 
-        texts = get_news20(args.folder or "/tmp/news20")
-        w2v = glove_dict(dim=args.embeddingDim)
+        data_dir = args.folder or "/tmp/news20"
+        texts = get_news20(data_dir)
+        w2v = glove_dict(source_dir=os.path.join(data_dir, "glove.6B"),
+                         dim=args.embeddingDim)
         zero = np.zeros((args.embeddingDim,), np.float32)
         class_num = max(l for _, l in texts)
         for text, label in texts:

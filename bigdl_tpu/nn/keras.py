@@ -760,7 +760,8 @@ class Cropping1D(KerasLayer):
         from bigdl_tpu.nn.shape_ops import Narrow
 
         lo, hi = self.cropping
-        return Narrow(2, lo, input_shape[0] - lo - hi)
+        # Narrow's offset is 1-based (reference convention)
+        return Narrow(2, lo + 1, input_shape[0] - lo - hi)
 
     def compute_output_shape(self, input_shape):
         return (input_shape[0] - sum(self.cropping),) + tuple(input_shape[1:])

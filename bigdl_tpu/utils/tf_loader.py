@@ -70,9 +70,9 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
             generated_backward: bool = True) -> Graph:
     """Build a :class:`Graph` from a frozen GraphDef.
 
-    ``inputs``/``outputs``: TF node names (``"x"`` or ``"scope/x:0"`` — the
-    port suffix is ignored; multi-output ops are not supported here, matching
-    the reference loader's main path).
+    ``inputs``/``outputs``: TF node names (``"x"`` or ``"scope/x:N"``).
+    Multi-output ops (Split/SplitV/Unpack/TopK) are addressed by their port
+    suffix — consumers and graph outputs get a per-port ``SelectTable``.
     """
     gd = _load_graph_def(graph_def_or_path)
     nodes: Dict[str, object] = {n.name: n for n in gd.node}
@@ -131,7 +131,9 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
                 f"Placeholder {name!r} is not listed in inputs={input_names}")
 
         if op in _IDENTITY_OPS:
-            mn = build(node.input[0])
+            src = node.input[0]
+            src_port = int(src.split(":")[1]) if ":" in src else 0
+            mn = build_port(strip(src), src_port)
             built[name] = mn
             return mn
 
@@ -179,7 +181,10 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
     # roots first so const anchoring has an input available
     for n in input_names:
         build(n)
-    out_nodes = [build(n) for n in output_names]
+    out_nodes = []
+    for n in outputs:
+        port = int(str(n).split(":")[1]) if ":" in str(n) else 0
+        out_nodes.append(build_port(strip(str(n)), port))
     g = Graph(graph_inputs if len(graph_inputs) > 1 else graph_inputs[0],
               out_nodes if len(out_nodes) > 1 else out_nodes[0])
     return g

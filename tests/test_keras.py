@@ -323,3 +323,18 @@ def test_keras_shape_utils_and_activations(rng):
     lr = K.Sequential().add(K.LeakyReLU(0.1, input_shape=(3,)))
     got = np.asarray(lr.forward(np.float32([[-1.0, 0.0, 2.0]])))
     assert_close(got, [[-0.1, 0.0, 2.0]], atol=1e-6)
+
+
+def test_cropping1d_values(rng):
+    """Code-review regression: crop VALUES, not just shape (1-based Narrow)."""
+    from bigdl_tpu.nn import keras as K
+
+    x = rng.randn(2, 8, 3).astype(np.float32)
+    out = np.asarray(K.Sequential()
+                     .add(K.Cropping1D((1, 2), input_shape=(8, 3)))
+                     .forward(x))
+    assert_close(out, x[:, 1:6])
+    out0 = np.asarray(K.Sequential()
+                      .add(K.Cropping1D((0, 3), input_shape=(8, 3)))
+                      .forward(x))
+    assert_close(out0, x[:, 0:5])

@@ -226,3 +226,32 @@ def test_import_gather_onehot_bmm_cumsum_topk(rng):
     in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
     g = load_tf(gd, [in_name], [gd.node[-1].name])
     assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_identity_and_output_preserve_ports(rng):
+    """Code-review regression: port suffixes survive Identity chains and can
+    name graph outputs directly."""
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    def f(x):
+        parts = tf.unstack(x, axis=1)
+        mid = tf.identity(parts[1])          # Identity over port 1
+        return tf.nn.relu(mid)
+
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    gd, frozen = _freeze(f, tf.constant(x))
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    g = load_tf(gd, [in_name], [gd.node[-1].name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-6)
+
+    # a ported OUTPUT name: ask for split's second part directly
+    def f2(x):
+        a, b = tf.split(x, 2, axis=1)
+        return a + 0.0, b + 0.0  # keep both alive
+
+    gd2, _ = _freeze(f2, tf.constant(x))
+    in2 = [n.name for n in gd2.node if n.op == "Placeholder"][0]
+    split = [n.name for n in gd2.node if n.op == "SplitV" or n.op == "Split"][0]
+    g2 = load_tf(gd2, [in2], [split + ":1"])
+    assert_close(np.asarray(g2.forward(x)), x[:, 2:], atol=1e-6)
