@@ -429,3 +429,35 @@ class TensorflowLoader:
     outputs)`` (reference ``Module.loadTF``)."""
 
     load = staticmethod(load_tf)
+
+
+class TFSession:
+    """Limited training-graph support (reference ``utils/tf/Session.scala``).
+
+    The reference could drive simple TF TRAINING graphs; the analog here is
+    that an imported (frozen) graph stays fully trainable — every Const
+    feeding a weight slot was promoted to a trainable ``ParameterOp`` — so a
+    Session wraps the imported ``Graph`` with the Optimizer plumbing for
+    fine-tuning:
+
+        sess = TFSession(graph_def, inputs=["x"], outputs=["logits"])
+        model = sess.model                      # trainable bigdl_tpu Graph
+        sess.train(samples, criterion, batch_size=32, end_trigger=...)
+    """
+
+    def __init__(self, graph_def_or_path, inputs, outputs) -> None:
+        self.model = load_tf(graph_def_or_path, inputs, outputs)
+
+    def train(self, samples, criterion, batch_size: int = 32,
+              end_trigger=None, optim_method=None):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.optim.optim_method import SGD
+        from bigdl_tpu.optim.optimizer import Optimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        opt = Optimizer(
+            model=self.model, dataset=DataSet.array(list(samples)),
+            criterion=criterion, batch_size=batch_size,
+            end_trigger=end_trigger or Trigger.max_epoch(1))
+        opt.set_optim_method(optim_method or SGD(learning_rate=0.01))
+        return opt.optimize()

@@ -255,3 +255,41 @@ def test_identity_and_output_preserve_ports(rng):
     split = [n.name for n in gd2.node if n.op == "SplitV" or n.op == "Split"][0]
     g2 = load_tf(gd2, [in2], [split + ":1"])
     assert_close(np.asarray(g2.forward(x)), x[:, 2:], atol=1e-6)
+
+
+def test_session_finetunes_imported_graph(rng):
+    """§2.7 'limited training-graph support': an imported frozen graph keeps
+    trainable weights — fine-tuning through TFSession reduces the loss."""
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.utils.tf_loader import TFSession
+
+    w1 = tf.Variable(rng.randn(6, 16).astype(np.float32) * 0.3)
+    b1 = tf.Variable(np.zeros(16, np.float32))
+    w2 = tf.Variable(rng.randn(16, 3).astype(np.float32) * 0.3)
+    b2 = tf.Variable(np.zeros(3, np.float32))
+
+    def mlp(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.log_softmax(tf.matmul(h, w2) + b2)
+
+    x0 = rng.randn(4, 6).astype(np.float32)
+    gd, _ = _freeze(mlp, tf.constant(x0))
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    sess = TFSession(gd, [in_name], [gd.node[-1].name])
+
+    # separable synthetic task
+    centers = rng.randn(3, 6).astype(np.float32) * 2
+    labels = rng.randint(1, 4, size=96)
+    X = centers[labels - 1] + 0.3 * rng.randn(96, 6).astype(np.float32)
+    samples = [Sample(X[i], np.int32(labels[i])) for i in range(96)]
+
+    crit = ClassNLLCriterion()
+    before = crit.forward(sess.model.forward(X[:32]), labels[:32].astype(np.float32))
+    sess.train(samples, ClassNLLCriterion(), batch_size=32,
+               end_trigger=Trigger.max_epoch(8),
+               optim_method=SGD(learning_rate=0.2))
+    after = crit.forward(sess.model.forward(X[:32]), labels[:32].astype(np.float32))
+    assert after < before * 0.7, (before, after)
