@@ -14,6 +14,8 @@ from bigdl_tpu.optim import Adam, Optimizer, SGD, Top1Accuracy, Trigger
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from tests.oracle import assert_close
 
+pytestmark = pytest.mark.integration
+
 
 def _dist_mnist(n, batch):
     samples = load_samples("/nonexistent", "train", synthetic_count=n)

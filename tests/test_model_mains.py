@@ -4,6 +4,9 @@ mains with option parsers) — smoke-trained on tiny synthetic data."""
 import os
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.integration  # SURVEY §4 tag-split: heavy suite
 
 
 def test_lenet_train_and_test_main(tmp_path):

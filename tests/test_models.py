@@ -9,6 +9,8 @@ from bigdl_tpu.models import (
     Vgg_16,
 )
 
+pytestmark = pytest.mark.integration  # SURVEY §4 tag-split: heavy suite
+
 
 def _forward(model, shape, seed=0):
     import jax
