@@ -380,3 +380,37 @@ def flash_attention(q, k, v, causal: bool = False,
         q, k, v, scale, interpret)
     return from3(_flash(q3, k3, v3, scale, bool(causal), int(block),
                         interpret))
+
+
+def flash_attention_block_grads(q, k, v, o, lse, do,
+                                scale: Optional[float] = None,
+                                block: Optional[int] = None,
+                                interpret: Optional[bool] = None,
+                                causal: bool = False):
+    """Per-block backward against GLOBAL softmax statistics — the ring
+    backward's building block.
+
+    ``q/o/do``: (B, Tq, H, D); ``k/v``: (B, Tk, H, D); ``lse``: (B, H, Tq)
+    — the log-sum-exp of the FULL (all-blocks) softmax, so the block's
+    probabilities ``exp(s − lse)`` are the true global ones and block
+    gradients sum exactly across blocks. Returns ``(dq, dk, dv)`` shaped
+    like q/k/v.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if block is None:
+        block = _auto_block(max(tq, tk))
+    q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
+        q, k, v, scale, interpret)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o3, do3 = to3(o), to3(do)
+    lse3 = lse.reshape(b * h, tq, 1)
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse3, do3, scale,
+                               bool(causal), int(block), interpret)
+    dq = from3(dq3)
+    dk = dk3.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    dv = dv3.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv

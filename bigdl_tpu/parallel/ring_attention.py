@@ -61,12 +61,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     Returns the (B, T_local, H, D) attention output for the local Q block.
 
     ``use_flash=True`` computes each K/V block with the Pallas flash kernel
-    and merges blocks by their log-sum-exp — the forward never materializes
+    and merges blocks by their log-sum-exp — NEITHER direction materializes
     a (T, T) score block, so T_local can grow to the kernel's O(T) memory
     limit. Causal mode runs the diagonal block through the causal kernel
     and nulls future-originated blocks via their LSE (striped-causal ring).
-    Gradients run the einsum ring (remat-style recomputation), so the path
-    stays fully differentiable.
+    The backward is a flash-block ring too: per-block FlashAttention-2
+    gradients against the saved global log-sum-exp, with dk/dv accumulators
+    travelling around the ring back to their block's home rank.
     """
     if use_flash:
         sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -116,8 +117,60 @@ def _ring_flash_impl(q, k, v, axis_name: str, scale: float, causal: bool):
         if step < n - 1:                   # last rotation would be dead
             kb = lax.ppermute(kb, axis_name, perm)
             vb = lax.ppermute(vb, axis_name, perm)
-    lq = l.transpose(0, 2, 1)[..., None]
-    return (o_acc / jnp.maximum(lq, 1e-20)).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-20)
+    lq = l_safe.transpose(0, 2, 1)[..., None]
+    out = (o_acc / lq).astype(q.dtype)
+    lse_global = m + jnp.log(l_safe)                # (B, H, T)
+    return out, lse_global
+
+
+def _ring_flash_bwd_impl(q, k, v, o, lse, do, axis_name: str, scale: float,
+                         causal: bool):
+    """Flash-block ring backward: O(T_local) memory like the forward.
+
+    dq accumulates locally; dk/dv accumulators TRAVEL with their K/V block
+    around the ring (n total rotations bring them home). Each block pair's
+    gradients are computed against the GLOBAL lse, so the per-block
+    contributions sum exactly — no recomputation of the (T, T) scores.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigdl_tpu.ops.flash_attention import flash_attention_block_grads
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq, dk_acc, dv_acc = flash_attention_block_grads(
+        q, k, v, o, lse, do, scale, causal=causal)
+    dq = dq.astype(jnp.float32)
+    kb = lax.ppermute(k, axis_name, perm)
+    vb = lax.ppermute(v, axis_name, perm)
+    dk_acc = lax.ppermute(dk_acc.astype(jnp.float32), axis_name, perm)
+    dv_acc = lax.ppermute(dv_acc.astype(jnp.float32), axis_name, perm)
+
+    for step in range(1, n):
+        src = (my - step) % n
+        dq_i, dk_i, dv_i = flash_attention_block_grads(
+            q, kb, vb, o, lse, do, scale, causal=False)
+        if causal:
+            allowed = (src < my).astype(jnp.float32)
+            dq_i = dq_i * allowed
+            dk_i = dk_i * allowed
+            dv_i = dv_i * allowed
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_acc = dk_acc + dk_i.astype(jnp.float32)
+        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+        # rotate every step: after n total rotations the travelling dk/dv
+        # accumulators arrive back at their K/V block's home rank
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
 
 
 _RING_FLASH = None
@@ -135,19 +188,19 @@ def _get_ring_flash():
 
     @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
     def ring_flash(q, k, v, axis_name, scale, causal):
-        return _ring_flash_impl(q, k, v, axis_name, scale, causal)
+        out, _ = _ring_flash_impl(q, k, v, axis_name, scale, causal)
+        return out
 
     def fwd(q, k, v, axis_name, scale, causal):
-        return _ring_flash_impl(q, k, v, axis_name, scale, causal), (q, k, v)
+        out, lse = _ring_flash_impl(q, k, v, axis_name, scale, causal)
+        return out, (q, k, v, out, lse)
 
     def bwd(axis_name, scale, causal, res, ct):
-        # backward = vjp of the einsum ring (recomputes — the remat trade)
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _ring_einsum(q_, k_, v_, axis_name, causal,
-                                            scale),
-            q, k, v)
-        return vjp(ct)
+        # flash-block ring backward against the saved global lse — O(T_loc)
+        # memory like the forward (no (T, T) score recomputation)
+        q, k, v, out, lse = res
+        return _ring_flash_bwd_impl(q, k, v, out, lse, ct, axis_name, scale,
+                                    causal)
 
     ring_flash.defvjp(fwd, bwd)
     _RING_FLASH = ring_flash
