@@ -318,8 +318,8 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def _bthd_plumbing(q, k, v, scale, interpret):
     """Shared layout/default handling: (B,T,H,D) API ↔ (B*H,T,D) kernels.
-    Returns (q3, k3, v3, scale, interpret, from3) where from3 restores the
-    public layout."""
+    Returns (q3, k3, v3, scale, interpret, from3, to3): from3 restores the
+    public layout, to3 maps further (B,T,H,D) operands (o, do) down."""
     if interpret is None:
         interpret = _auto_interpret()
     b, t, h, d = q.shape
@@ -332,7 +332,8 @@ def _bthd_plumbing(q, k, v, scale, interpret):
     def from3(o3):
         return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
-    return to3(q), to3(k), to3(v), float(scale), bool(interpret), from3
+    return (to3(q), to3(k), to3(v), float(scale), bool(interpret), from3,
+            to3)
 
 
 def _auto_block(t_max: int) -> int:
@@ -357,7 +358,7 @@ def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
     b, t, h, d = q.shape
     if block is None:
         block = _auto_block(max(q.shape[1], k.shape[1]))
-    q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
+    q3, k3, v3, scale, interpret, from3, _ = _bthd_plumbing(
         q, k, v, scale, interpret)
     o3, lse = _flash_fwd(q3, k3, v3, scale, bool(causal), int(block),
                          interpret)
@@ -376,7 +377,7 @@ def flash_attention(q, k, v, causal: bool = False,
     """
     if block is None:
         block = _auto_block(max(q.shape[1], k.shape[1]))
-    q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
+    q3, k3, v3, scale, interpret, from3, _ = _bthd_plumbing(
         q, k, v, scale, interpret)
     return from3(_flash(q3, k3, v3, scale, bool(causal), int(block),
                         interpret))
@@ -400,12 +401,8 @@ def flash_attention_block_grads(q, k, v, o, lse, do,
     tk = k.shape[1]
     if block is None:
         block = _auto_block(max(tq, tk))
-    q3, k3, v3, scale, interpret, from3 = _bthd_plumbing(
+    q3, k3, v3, scale, interpret, from3, to3 = _bthd_plumbing(
         q, k, v, scale, interpret)
-
-    def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
     o3, do3 = to3(o), to3(do)
     lse3 = lse.reshape(b * h, tq, 1)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse3, do3, scale,

@@ -155,17 +155,22 @@ def _ring_flash_bwd_impl(q, k, v, o, lse, do, axis_name: str, scale: float,
         dq_i, dk_i, dv_i = flash_attention_block_grads(
             q, kb, vb, o, lse, do, scale, causal=False)
         if causal:
-            allowed = (src < my).astype(jnp.float32)
-            dq_i = dq_i * allowed
-            dk_i = dk_i * allowed
-            dv_i = dv_i * allowed
+            # future blocks were EXCLUDED from the global softmax, so their
+            # p = exp(s − lse_global) is unbounded (can overflow to inf):
+            # null them with a NaN-safe select, never a multiply-by-zero
+            allowed = src < my
+            zero = jnp.zeros((), jnp.float32)
+            dq_i = jnp.where(allowed, dq_i, zero)
+            dk_i = jnp.where(allowed, dk_i, zero)
+            dv_i = jnp.where(allowed, dv_i, zero)
         dq = dq + dq_i.astype(jnp.float32)
         dk_acc = dk_acc + dk_i.astype(jnp.float32)
         dv_acc = dv_acc + dv_i.astype(jnp.float32)
-        # rotate every step: after n total rotations the travelling dk/dv
-        # accumulators arrive back at their K/V block's home rank
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
+        # the travelling dk/dv accumulators rotate every step (n total hops
+        # bring them home); kb/vb are dead after the last compute
+        if step < n - 1:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
         dk_acc = lax.ppermute(dk_acc, axis_name, perm)
         dv_acc = lax.ppermute(dv_acc, axis_name, perm)
 
