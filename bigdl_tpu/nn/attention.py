@@ -88,7 +88,8 @@ class MultiHeadAttention(TensorModule):
             out = ring_attention(q, k, v, self.sp_axis, causal=self.causal,
                                  use_flash=flash_ok)
         elif self.sequence_parallel == "ulysses":
-            out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal)
+            out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal,
+                                    use_flash=flash_ok)
         elif flash_ok:
             from bigdl_tpu.ops import flash_attention
 

@@ -266,10 +266,13 @@ def _ring_einsum(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      use_flash: bool = False):
     """All-to-all sequence parallelism inside a ``shard_map``: re-shard
-    (B, T_local, H, D) → (B, T_global, H_local, D), attend densely, and
-    re-shard back. Requires H divisible by the axis size."""
+    (B, T_local, H, D) → (B, T_global, H_local, D), attend per head group,
+    and re-shard back. Requires H divisible by the axis size.
+    ``use_flash=True`` runs the per-head-group attention through the Pallas
+    flash kernel (O(T) memory over the FULL gathered sequence)."""
     from jax import lax
 
     n = lax.axis_size(axis_name)
@@ -286,5 +289,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                               tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = _local_attention(qg, kg, vg, scale, causal)
+    if use_flash:
+        from bigdl_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    else:
+        out = _local_attention(qg, kg, vg, scale, causal)
     return heads_to_seq(out)

@@ -269,3 +269,28 @@ def test_causal_flash_ring_bwd_no_nan_with_large_logits(rng):
     grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
     for g in grads:
         assert np.isfinite(np.asarray(g)).all(), "NaN/inf in ring grads"
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_dense(rng, causal):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.parallel.ring_attention import ulysses_attention
+
+    q, k, v = _qkv(rng, H=8)
+    mesh = _mesh()
+    uly = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "seq", causal=causal,
+                                          use_flash=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"), check_vma=False))
+    out = np.asarray(uly(q, k, v))
+    want = _reference_attention(q, k, v, causal)
+    assert_close(out, want, atol=1e-3)
+
+    # differentiable
+    g = jax.grad(lambda q: jnp.sum(uly(q, k, v) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
