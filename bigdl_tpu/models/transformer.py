@@ -205,3 +205,133 @@ def train_main(argv=None):
 
 if __name__ == "__main__":
     train_main()
+
+
+def make_decode_step(model: Sequential):
+    """KV-cached incremental decoding for a trained :func:`TransformerLM`.
+
+    Returns ``(step_fn, init_carry)``:
+
+    * ``init_carry(batch) -> carry`` — per-layer K/V caches
+      ``(batch, max_len, heads, head_dim)`` plus a position counter;
+    * ``step_fn(params_ignored, tokens, carry) -> (logprobs, carry)`` —
+      one token per call, attention reads the cache (O(1) new compute per
+      step instead of re-running the full prefix). The signature matches
+      ``SequenceBeamSearch``/:func:`bigdl_tpu.nn.beam_search.beam_search`;
+      beam parent-gathering permutes whole cache rows, and the position
+      counter is uniform across rows, so lockstep decoding stays exact.
+
+    Tokens are 0-based class indices (logit column c ↔ 1-based word id
+    c+1), matching the LM's LogSoftMax output columns.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigdl_tpu.nn.misc import LookupTable
+
+    model._ensure_params()
+    P = model.params
+    mods = model.modules
+    assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
+    lookup_w = P[model._child_key(0)]["weight"]
+    posemb = mods[1]
+    pos_w = P[model._child_key(1)]["pos"]
+    max_len = posemb.max_len
+
+    blocks = []
+    for i, m in enumerate(mods):
+        inner, bp = m, P[model._child_key(i)]
+        if isinstance(m, Remat):
+            inner, bp = m.modules[0], bp[m._child_key(0)]
+        if isinstance(inner, TransformerBlock):
+            blocks.append((inner, bp))
+    lnf, lnf_p = mods[-3], P[model._child_key(len(mods) - 3)]
+    lin_p = P[model._child_key(len(mods) - 2)]
+
+    attn0 = blocks[0][0].attn
+    heads, hd = attn0.n_heads, attn0.head_dim
+    scale = hd ** -0.5
+
+    def init_carry(batch: int):
+        carry = {"pos": jnp.zeros((batch,), jnp.int32)}
+        for i in range(len(blocks)):
+            carry[f"k{i}"] = jnp.zeros((batch, max_len, heads, hd),
+                                       jnp.float32)
+            carry[f"v{i}"] = jnp.zeros((batch, max_len, heads, hd),
+                                       jnp.float32)
+        return carry
+
+    def _proj(p, x):
+        return jnp.matmul(x, p["weight"].T) + p["bias"]
+
+    def step(params, tokens, carry):
+        n = tokens.shape[0]
+        t = carry["pos"][0]                      # uniform across rows
+        x = jnp.take(lookup_w, jnp.clip(tokens, 0, lookup_w.shape[0] - 1),
+                     axis=0)                     # (N, Hid)
+        x = x + lax.dynamic_index_in_dim(pos_w, t, keepdims=False)
+        new_carry = dict(carry)
+        for i, (blk, bp) in enumerate(blocks):
+            h, _ = blk.ln1.apply(bp[blk._child_key(0)], x[:, None])
+            h = h[:, 0]
+            ap = bp[blk._child_key(1)]
+            q = _proj(ap["wq"], h).reshape(n, heads, hd)
+            k_new = _proj(ap["wk"], h).reshape(n, heads, hd)
+            v_new = _proj(ap["wv"], h).reshape(n, heads, hd)
+            kc = lax.dynamic_update_slice_in_dim(
+                new_carry[f"k{i}"], k_new[:, None].astype(jnp.float32), t, 1)
+            vc = lax.dynamic_update_slice_in_dim(
+                new_carry[f"v{i}"], v_new[:, None].astype(jnp.float32), t, 1)
+            new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
+            s = jnp.einsum("nhd,nlhd->nhl", q * scale, kc)
+            valid = jnp.arange(max_len)[None, None, :] <= t
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("nhl,nlhd->nhd", p, vc).reshape(n, heads * hd)
+            x = x + _proj(ap["wo"], ctx)
+            h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x[:, None])
+            h2 = h2[:, 0]
+            mlp = _proj(bp[blk._child_key(4)],
+                        jax.nn.gelu(_proj(bp[blk._child_key(3)], h2)))
+            x = x + mlp
+        xf, _ = lnf.apply(lnf_p, x[:, None])
+        logits = _proj(lin_p, xf[:, 0])
+        new_carry["pos"] = carry["pos"] + 1
+        return jax.nn.log_softmax(logits, axis=-1), new_carry
+
+    return step, init_carry
+
+
+def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
+                  decode_length: int = 32, eos_id: int = -1,
+                  alpha: float = 0.6):
+    """Beam-search continuation of a prompt with the KV-cached decoder.
+
+    ``prompt_ids``: (P,) 1-based word ids for ONE prompt (decode several
+    prompts with separate calls — beam_search's sos is scalar). Returns
+    ``(sequences (beam, decode_length) of 1-based ids, scores (beam,))``.
+    ``eos_id`` is a 1-based id, or -1 for none.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.nn.beam_search import beam_search
+
+    step, init_carry = make_decode_step(model)
+    prompt = [int(t) for t in prompt_ids]
+    assert prompt, "need a non-empty prompt"
+    K = beam_size
+    carry = init_carry(K)
+    # prime the cache with the prompt (every beam identical)
+    for tok in prompt[:-1]:
+        toks = jnp.full((K,), tok - 1, jnp.int32)
+        _, carry = step(None, toks, carry)
+    vocab = model.modules[0].n_index
+    seqs, scores = beam_search(
+        step, None, carry, 1, K, vocab, decode_length,
+        sos_id=prompt[-1] - 1,
+        eos_id=(eos_id - 1) if eos_id > 0 else vocab + 7,
+        alpha=alpha, padding_value=-1)
+    out = np.asarray(seqs)[0] + 1            # back to 1-based ids
+    return out, np.asarray(scores)[0]
