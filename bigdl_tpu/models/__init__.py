@@ -13,7 +13,7 @@ from bigdl_tpu.models.textclassifier import TextClassifier
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
 from bigdl_tpu.models.transformer import (
     LayerNorm, PositionEmbedding, TransformerBlock, TransformerLM,
-    beam_generate, make_decode_step,
+    beam_generate, generate, make_decode_step,
 )
 from bigdl_tpu.models.treelstm import BinaryTreeLSTM, TreeLSTMSentiment
 
@@ -24,6 +24,6 @@ __all__ = [
     "AlexNet", "AlexNet_OWT", "Autoencoder",
     "TextClassifier", "PTBModel", "SimpleRNN",
     "TransformerLM", "TransformerBlock", "LayerNorm", "PositionEmbedding",
-    "beam_generate", "make_decode_step",
+    "beam_generate", "generate", "make_decode_step",
     "BinaryTreeLSTM", "TreeLSTMSentiment",
 ]

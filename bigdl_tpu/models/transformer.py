@@ -335,3 +335,41 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
         alpha=alpha, padding_value=-1)
     out = np.asarray(seqs)[0] + 1            # back to 1-based ids
     return out, np.asarray(scores)[0]
+
+
+def generate(model: Sequential, prompt_ids, length: int = 32,
+             temperature: float = 1.0, top_k: int = 0, seed: int = 0):
+    """Sampled (or greedy) continuation with the KV-cached decoder.
+
+    ``temperature=0`` is greedy argmax; ``top_k > 0`` restricts sampling to
+    the k most likely tokens. Returns (length,) 1-based word ids.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    step, init_carry = make_decode_step(model)
+    prompt = [int(t) for t in prompt_ids]
+    assert prompt, "need a non-empty prompt"
+    carry = init_carry(1)
+    for tok in prompt[:-1]:
+        _, carry = step(None, jnp.asarray([tok - 1], jnp.int32), carry)
+
+    key = jax.random.PRNGKey(seed)
+    tok = jnp.asarray([prompt[-1] - 1], jnp.int32)
+    out = []
+    for i in range(length):
+        logp, carry = step(None, tok, carry)
+        logits = logp[0]
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits)
+        else:
+            logits = logits / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(logits, top_k)[0][-1]
+                logits = jnp.where(logits >= kth, logits, -1e30)
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits)
+        tok = nxt[None].astype(jnp.int32)
+        out.append(int(nxt) + 1)             # back to 1-based ids
+    return np.asarray(out, np.int32)

@@ -207,3 +207,27 @@ def test_beam_generate_transformer(rng):
     assert np.isfinite(scores).all()
     # best-first ordering
     assert scores[0] >= scores[1] >= scores[2]
+
+
+def test_generate_greedy_and_sampled(rng):
+    from bigdl_tpu.models.transformer import TransformerLM, generate
+
+    V = 13
+    model = TransformerLM(V, hidden_size=16, n_heads=2, n_layers=1,
+                          max_len=20)
+    model._ensure_params()
+    model.evaluate()
+    g1 = generate(model, [2, 5], length=6, temperature=0.0)
+    g2 = generate(model, [2, 5], length=6, temperature=0.0)
+    assert (g1 == g2).all()                    # greedy is deterministic
+    assert ((g1 >= 1) & (g1 <= V)).all()
+    s1 = generate(model, [2, 5], length=6, temperature=1.0, top_k=4, seed=1)
+    assert ((s1 >= 1) & (s1 <= V)).all()
+    # greedy must follow the argmax of the cached log-probs step by step
+    from bigdl_tpu.models.transformer import make_decode_step
+    import jax.numpy as jnp
+    step, init_carry = make_decode_step(model)
+    carry = init_carry(1)
+    _, carry = step(None, jnp.asarray([1]), carry)   # prompt token 2
+    logp, _ = step(None, jnp.asarray([4]), carry)    # prompt token 5
+    assert g1[0] == int(np.argmax(np.asarray(logp)[0])) + 1
