@@ -382,6 +382,7 @@ class Optimizer:
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
         seen_this_epoch = 0
+        next_ready = None            # (inp, tgt, bsz) placed ahead of time
         epoch_start = time.time()
 
         while not self.end_when(state):
@@ -394,14 +395,20 @@ class Optimizer:
                         self._profile.get("active"):
                     jax.profiler.stop_trace()
                     self._profile["active"] = False
-            batch: MiniBatch = next(data_iter)
-            bsz = batch.size()
+            # input pipelining: the NEXT batch is fetched/placed while the
+            # dispatched (async) step still runs on the device; float(loss)
+            # is the only host sync point
+            if next_ready is None:
+                b = next(data_iter)
+                next_ready = (*place_batch(b), b.size())
+            inp, tgt, bsz = next_ready
             t0 = time.time()
             rng = jax.random.fold_in(base_key, state["neval"])
-            inp, tgt = place_batch(batch)
             params, opt_state, model_state, loss = step(
                 params, opt_state, model_state, rng, inp, tgt,
             )
+            b = next(data_iter)          # overlaps device compute
+            next_ready = (*place_batch(b), b.size())
             loss_f = float(loss)
             dt = time.time() - t0
             self.metrics.add("computing time", dt)
