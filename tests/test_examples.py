@@ -71,3 +71,16 @@ def test_mlpipeline_example_learns():
 
     acc = main(["--samples", "256", "--maxEpoch", "5", "--batchSize", "64"])
     assert acc > 0.7  # separable blobs: must beat chance (1/3) by a margin
+
+
+def test_transformer_generation_example(capsys):
+    from bigdl_tpu.examples.transformergeneration import main
+
+    main(["--synthetic", "32", "--maxEpoch", "1", "--batchSize", "16",
+          "--vocab", "20", "--seqLen", "12", "--hidden", "16",
+          "--layers", "1", "--heads", "2",
+          "--beam", "2", "--genLen", "4", "--topK", "4"])
+    out = capsys.readouterr().out
+    assert "greedy :" in out and "sampled:" in out
+    beams = [l for l in out.splitlines() if l.startswith("beam ")]
+    assert len(beams) == 2
