@@ -300,7 +300,9 @@ def make_decode_step(model: Sequential):
         new_carry["pos"] = carry["pos"] + 1
         return jax.nn.log_softmax(logits, axis=-1), new_carry
 
-    return step, init_carry
+    # shapes are static across steps: compile once, reuse every token
+    # (composes with beam_search's lax.scan — jit-of-jit inlines)
+    return jax.jit(step), init_carry
 
 
 def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
@@ -321,6 +323,12 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
     step, init_carry = make_decode_step(model)
     prompt = [int(t) for t in prompt_ids]
     assert prompt, "need a non-empty prompt"
+    max_len = model.modules[1].max_len
+    if len(prompt) - 1 + decode_length > max_len:
+        raise ValueError(
+            f"prompt ({len(prompt)}) + decode_length ({decode_length}) "
+            f"exceeds the model's max_len {max_len} — the cache position "
+            "would silently clamp (same guard as PositionEmbedding)")
     K = beam_size
     carry = init_carry(K)
     # prime the cache with the prompt (every beam identical)
@@ -351,6 +359,12 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
     step, init_carry = make_decode_step(model)
     prompt = [int(t) for t in prompt_ids]
     assert prompt, "need a non-empty prompt"
+    max_len = model.modules[1].max_len
+    if len(prompt) - 1 + length > max_len:
+        raise ValueError(
+            f"prompt ({len(prompt)}) + length ({length}) exceeds the "
+            f"model's max_len {max_len} — the cache position would "
+            "silently clamp (same guard as PositionEmbedding)")
     carry = init_carry(1)
     for tok in prompt[:-1]:
         _, carry = step(None, jnp.asarray([tok - 1], jnp.int32), carry)

@@ -407,8 +407,12 @@ class Optimizer:
             params, opt_state, model_state, loss = step(
                 params, opt_state, model_state, rng, inp, tgt,
             )
-            b = next(data_iter)          # overlaps device compute
-            next_ready = (*place_batch(b), b.size())
+            try:
+                b = next(data_iter)      # overlaps device compute
+                next_ready = (*place_batch(b), b.size())
+            except StopIteration:
+                # finite custom iterators: end_when decides at the loop top
+                next_ready = None
             loss_f = float(loss)
             dt = time.time() - t0
             self.metrics.add("computing time", dt)

@@ -104,3 +104,38 @@ def test_mixed_precision_trains(rng):
     assert all(np.asarray(w).dtype == np.float32 for w in ws)
     pred = np.asarray(trained.evaluate().forward(np.stack(xs))).argmax(-1) + 1
     assert (pred == np.asarray(ys)).mean() > 0.8
+
+
+def test_optimizer_handles_finite_train_iterator():
+    """Regression for input pipelining: a custom dataset whose train
+    iterator is FINITE must finish cleanly (no StopIteration escape)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.dataset import AbstractDataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+    from bigdl_tpu.nn import Linear, MSECriterion, Sequential
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.standard_normal(4).astype(np.float32),
+                      rng.standard_normal(2).astype(np.float32))
+               for _ in range(32)]
+
+    class FiniteDataSet(AbstractDataSet):
+        def size(self):
+            return len(samples)
+
+        def data(self, train):
+            # exactly TWO epochs worth, then exhausted — not infinite
+            batcher = SampleToMiniBatch(8)
+            return batcher(iter(samples * 2))
+
+    opt = Optimizer(model=Sequential().add(Linear(4, 2)),
+                    dataset=FiniteDataSet(),
+                    criterion=MSECriterion(), batch_size=8,
+                    end_trigger=Trigger.max_epoch(2))
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    model = opt.optimize()   # must not raise StopIteration
+    ws, _ = model.parameters()
+    assert all(np.isfinite(np.asarray(w)).all() for w in ws)

@@ -231,3 +231,20 @@ def test_generate_greedy_and_sampled(rng):
     _, carry = step(None, jnp.asarray([1]), carry)   # prompt token 2
     logp, _ = step(None, jnp.asarray([4]), carry)    # prompt token 5
     assert g1[0] == int(np.argmax(np.asarray(logp)[0])) + 1
+
+
+def test_generate_rejects_overlong_decode(rng):
+    """Regression: decoding past max_len must raise, not silently clamp."""
+    from bigdl_tpu.models.transformer import (
+        TransformerLM, beam_generate, generate,
+    )
+
+    model = TransformerLM(9, hidden_size=16, n_heads=2, n_layers=1, max_len=8)
+    model._ensure_params()
+    with pytest.raises(ValueError, match="max_len"):
+        generate(model, [1, 2, 3], length=10)
+    with pytest.raises(ValueError, match="max_len"):
+        beam_generate(model, [1, 2], beam_size=2, decode_length=8)
+    # exactly at the limit is fine
+    out = generate(model, [1, 2, 3], length=6, temperature=0.0)
+    assert out.shape == (6,)
