@@ -1,0 +1,75 @@
+"""Engine-layer utilities: Table/T(), File, RandomGenerator, LoggerFilter
+(SURVEY.md §2.6 rows)."""
+
+import logging
+import os
+
+import numpy as np
+
+
+def test_table_reference_semantics():
+    from bigdl_tpu.utils.table import T, Table
+
+    t = T(10, 20, 30)                 # 1-based integer keys
+    assert t[1] == 10 and t[2] == 20 and t[3] == 30
+    assert len(t) == 3 and t.length() == 3
+    assert 2 in t and 7 not in t
+
+    t["epoch"] = 4                    # string keys (optimMethod state style)
+    assert t("epoch") == 4            # call-style access
+    assert t.get("missing", -1) == -1
+    assert t.get_or_update("neval", 0) == 0
+    assert t["neval"] == 0
+
+    t.insert(40)                      # appends at next free int index
+    assert t[4] == 40
+    t.remove(4)
+    assert 4 not in t
+    t2 = Table().update({"a": 1})
+    assert t2["a"] == 1
+    assert list(T(1, 2)) == [1, 2]    # iterates values
+
+
+def test_file_save_load_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from bigdl_tpu.utils.file_io import File
+
+    obj = {"weights": jnp.arange(6.0).reshape(2, 3), "epoch": 3,
+           "nested": {"lr": 0.1}}
+    path = str(tmp_path / "snap.bigdl")
+    File.save(obj, path)
+    back = File.load(path)
+    np.testing.assert_allclose(np.asarray(back["weights"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert back["epoch"] == 3 and back["nested"]["lr"] == 0.1
+
+    # over_write guard (reference File.save(obj, path, overWrite))
+    import pytest
+
+    with pytest.raises(Exception):
+        File.save(obj, path, over_write=False)
+    File.save(obj, path, over_write=True)
+
+
+def test_random_generator_determinism():
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(123)
+    a = RNG.next_key()
+    RNG.set_seed(123)
+    b = RNG.next_key()
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    c = RNG.next_key()
+    assert not np.array_equal(np.asarray(b), np.asarray(c))
+
+
+def test_logger_filter_redirects(tmp_path):
+    from bigdl_tpu.utils.logger_filter import LoggerFilter
+
+    LoggerFilter.redirect_spark_info_logs(log_dir=str(tmp_path))
+    noisy = logging.getLogger("jax._src.dispatch")
+    noisy.info("very verbose backend chatter")
+    logging.getLogger("bigdl_tpu").info("stays on console")
+    logfile = os.path.join(str(tmp_path), "bigdl.log")
+    assert os.path.exists(logfile)
