@@ -245,6 +245,8 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
             pltpu.VMEM((block, 1), jnp.float32),
             pltpu.VMEM((block, d), jnp.float32),
         ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
     return o[:, :t], lse[:, :t]
@@ -272,6 +274,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
         out_specs=qblk(d),
         out_shape=_out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
 
@@ -288,6 +292,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
                    _out_struct((bh, kp_len, d), v3.dtype, q3, k3, v3)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                         pltpu.VMEM((block, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)
     return dq[:, :t], dk[:, :k3.shape[1]], dv[:, :v3.shape[1]]
