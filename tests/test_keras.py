@@ -338,3 +338,36 @@ def test_cropping1d_values(rng):
                       .add(K.Cropping1D((0, 3), input_shape=(8, 3)))
                       .forward(x))
     assert_close(out0, x[:, 0:5])
+
+
+def test_keras_batch2_serialization_roundtrip(rng, tmp_path):
+    """The new wrappers ride the structured serializer like core modules."""
+    from bigdl_tpu.nn import keras as K
+    from bigdl_tpu.nn.module import AbstractModule
+
+    m = (K.Sequential()
+         .add(K.Convolution1D(6, 3, activation="relu", input_shape=(10, 4)))
+         .add(K.MaxoutDense(5, 3))
+         .add(K.SReLU())
+         .add(K.Dense(4)))
+    m.evaluate()
+    x = rng.randn(2, 10, 4).astype(np.float32)
+    want = np.asarray(m.forward(x))
+    path = str(tmp_path / "keras2.bigdl")
+    m.save_module(path)
+    m2 = AbstractModule.load_module(path)
+    m2.evaluate()
+    assert_close(np.asarray(m2.forward(x)), want, atol=1e-6)
+
+    b = (K.Sequential()
+         .add(K.Bidirectional(K.LSTM(5, return_sequences=True),
+                              input_shape=(6, 3)))
+         .add(K.TimeDistributed(K.Dense(2))))
+    b.evaluate()
+    xb = rng.randn(2, 6, 3).astype(np.float32)
+    wantb = np.asarray(b.forward(xb))
+    pathb = str(tmp_path / "keras2b.bigdl")
+    b.save_module(pathb)
+    b2 = AbstractModule.load_module(pathb)
+    b2.evaluate()
+    assert_close(np.asarray(b2.forward(xb)), wantb, atol=1e-6)
