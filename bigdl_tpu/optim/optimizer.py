@@ -114,7 +114,12 @@ class Optimizer:
                        backend: str = "pickle") -> "Optimizer":
         """``backend="pickle"`` writes the reference-style model/optimMethod
         snapshot pair; ``backend="orbax"`` writes an orbax PyTree checkpoint
-        (tensor-store format, the TPU-ecosystem standard — SURVEY.md §5.4)."""
+        (tensor-store format, the TPU-ecosystem standard — SURVEY.md §5.4).
+
+        Accepts both reference argument orders: Scala ``(path, trigger)``
+        and pyspark ``(checkpoint_trigger, checkpoint_path)``."""
+        if isinstance(path, Trigger):          # pyspark order
+            path, trigger = trigger, path
         if backend not in ("pickle", "orbax"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.checkpoint_path = path

@@ -66,3 +66,42 @@ def test_models_namespace_shims():
     t = txt(5, token_length=16, encoder_output_dim=8)
     out = t.forward(np.zeros((2, 7, 16), np.float32))
     assert out.shape == (2, 5)
+
+
+def test_reference_style_summaries_checkpoint_validation(rng, tmp_path):
+    """The fuller pyspark surface: TrainSummary/ValidationSummary,
+    set_checkpoint(EveryEpoch), set_validation — imports swapped only."""
+    from bigdl_tpu.api.nn.criterion import MSECriterion
+    from bigdl_tpu.api.nn.layer import Linear, Sequential
+    from bigdl_tpu.api.optim.optimizer import (
+        EveryEpoch, Loss, MaxEpoch, Optimizer, SGD, TrainSummary,
+        ValidationSummary,
+    )
+    from bigdl_tpu.api.util.common import Sample
+
+    w = rng.randn(3, 1).astype(np.float32)
+    samples = []
+    for _ in range(48):
+        x = rng.randn(3).astype(np.float32)
+        samples.append(Sample.from_ndarray(x, (x @ w).astype(np.float32)))
+
+    model = Sequential().add(Linear(3, 1))
+    optimizer = Optimizer(model=model, dataset=samples,
+                          criterion=MSECriterion(), batch_size=16,
+                          end_trigger=MaxEpoch(4))
+    optimizer.set_optim_method(SGD(learning_rate=0.1))
+    ts = TrainSummary(str(tmp_path), "run1")
+    vs = ValidationSummary(str(tmp_path), "run1")
+    optimizer.set_train_summary(ts)
+    optimizer.set_val_summary(vs)
+    optimizer.set_validation(EveryEpoch(), samples, [Loss(MSECriterion())],
+                             batch_size=16)
+    optimizer.set_checkpoint(EveryEpoch(), str(tmp_path / "ckpt"))
+    optimizer.optimize()
+
+    losses = ts.read_scalar("Loss")
+    assert len(losses) >= 4 and losses[-1][1] < losses[0][1]
+    vals = vs.read_scalar("Loss")
+    assert len(vals) >= 2
+    import os
+    assert any(f.startswith("model") for f in os.listdir(tmp_path / "ckpt"))
