@@ -131,9 +131,20 @@ class Optimizer:
         self.overwrite_checkpoint = True
         return self
 
-    def set_validation(self, trigger: Trigger, dataset,
-                       methods: Sequence[ValidationMethod],
-                       batch_size: Optional[int] = None) -> "Optimizer":
+    def set_validation(self, trigger, dataset=None, methods=None,
+                       batch_size: Optional[int] = None,
+                       # pyspark keyword names
+                       val_rdd=None, val_method=None) -> "Optimizer":
+        """Scala order ``(trigger, dataset, methods, batch_size)``; the
+        pyspark order ``set_validation(batch_size, val_rdd, trigger,
+        val_method)`` is also accepted (detected by an int first arg)."""
+        if isinstance(trigger, int):            # pyspark positional order
+            batch_size, dataset, trigger, methods = (
+                trigger, dataset, methods, batch_size)
+        if val_rdd is not None:
+            dataset = val_rdd
+        if val_method is not None:
+            methods = val_method
         self.validation_trigger = trigger
         self.validation_dataset = _ensure_dataset(dataset, batch_size)
         self.validation_methods = list(methods)

@@ -105,3 +105,26 @@ def test_reference_style_summaries_checkpoint_validation(rng, tmp_path):
     assert len(vals) >= 2
     import os
     assert any(f.startswith("model") for f in os.listdir(tmp_path / "ckpt"))
+
+
+def test_set_validation_pyspark_positional_order(rng):
+    """pyspark scripts call set_validation(batch_size, val_rdd, trigger,
+    val_method) — the int-first order must work verbatim."""
+    from bigdl_tpu.api.nn.criterion import MSECriterion
+    from bigdl_tpu.api.nn.layer import Linear, Sequential
+    from bigdl_tpu.api.optim.optimizer import (
+        EveryEpoch, Loss, MaxEpoch, Optimizer, SGD,
+    )
+    from bigdl_tpu.api.util.common import Sample
+
+    samples = [Sample.from_ndarray(rng.randn(3).astype(np.float32),
+                                   rng.randn(1).astype(np.float32))
+               for _ in range(24)]
+    opt = Optimizer(model=Sequential().add(Linear(3, 1)), dataset=samples,
+                    criterion=MSECriterion(), batch_size=8,
+                    end_trigger=MaxEpoch(2))
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    opt.set_validation(8, samples, EveryEpoch(), [Loss(MSECriterion())])
+    model = opt.optimize()
+    ws, _ = model.parameters()
+    assert all(np.isfinite(np.asarray(w)).all() for w in ws)
