@@ -292,6 +292,20 @@ class AbstractModule:
         m._ensure_params()
         return m
 
+    @staticmethod
+    def load_caffe_model(def_path: str, model_path=None, match_all=True):
+        """Reference ``Module.loadCaffeModel(defPath, modelPath)``."""
+        from bigdl_tpu.utils.caffe_loader import load_caffe
+
+        return load_caffe(def_path, model_path, match_all)
+
+    @staticmethod
+    def load_tf(path, inputs, outputs):
+        """Reference ``Module.loadTF(path, inputs, outputs)``."""
+        from bigdl_tpu.utils.tf_loader import load_tf
+
+        return load_tf(path, inputs, outputs)
+
     def __getstate__(self):
         d = dict(self.__dict__)
         # grads and cached activations are not part of a snapshot

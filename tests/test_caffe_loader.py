@@ -259,3 +259,12 @@ def test_caffe_flatten_power_absval(rng):
     got = np.asarray(g.forward(x))
     want = ((np.abs(x) * 0.5 + 1.0) ** 2).reshape(4, 8) @ fw.T
     assert_close(got, want, atol=1e-4)
+
+
+def test_module_level_interop_entrypoints(tmp_path, rng):
+    """Reference entry points Module.loadCaffeModel / Module.loadTF exist on
+    the Module base (snake_case)."""
+    from bigdl_tpu.nn import Module
+
+    assert callable(Module.load_caffe_model)
+    assert callable(Module.load_tf)
