@@ -52,16 +52,20 @@ def main() -> None:
     y = jax.device_put(np.random.default_rng(1)
                        .integers(1, 1001, size=(batch,)).astype(np.int32))  # 1-based labels
 
-    # compile + warmup
+    # compile + warmup; the trailing float() matters — on this PJRT
+    # transport block_until_ready can resolve before device work drains
     params, opt_state, model_state, loss = step(
         params, opt_state, model_state, rng, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
     for _ in range(2):
         params, opt_state, model_state, loss = step(
             params, opt_state, model_state, rng, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
 
-    iters = 10
+    # 40 iterations amortize the transport's ~135 ms fixed host-readback
+    # cost (measured, benchmarks/PERF_ANALYSIS_r2.md); at 10 iterations the
+    # readback alone depressed the round-1 number by ~9%
+    iters = 40
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, model_state, loss = step(

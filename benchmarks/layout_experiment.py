@@ -1,0 +1,202 @@
+"""Layout experiment: NCHW vs NHWC ResNet-50 train step on one TPU chip.
+
+Round-1 verdict flagged the framework's NCHW dimension numbers as the top
+throughput suspect (TPU wants channels on the 128-lane minor dim; XLA:TPU
+inserts transposes to fix up NCHW convs). This is the measurement that
+decides whether the framework grows an internal NHWC compute layout: a
+minimal raw-JAX ResNet-50 doing the SAME per-step work as bench.py (bf16
+forward/backward, fp32 BN batch stats + running-stat update, CE loss,
+momentum+weight-decay SGD) in both layouts.
+
+Run: python benchmarks/layout_experiment.py [--batch 256] [--iters 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import numpy as np
+
+
+def make_resnet50(layout: str):
+    """Returns (init_fn, step_fn) for a bottleneck ResNet-50."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    assert layout in ("NCHW", "NHWC")
+    if layout == "NCHW":
+        dn = ("NCHW", "OIHW", "NCHW")
+        caxis = 1
+        spatial = (2, 3)
+
+        def wshape(o, i, k):
+            return (o, i, k, k)
+        def pool_dims(k, s):
+            return (1, 1, k, k), (1, 1, s, s)
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        caxis = 3
+        spatial = (1, 2)
+
+        def wshape(o, i, k):
+            return (k, k, i, o)
+        def pool_dims(k, s):
+            return (1, k, k, 1), (1, s, s, 1)
+
+    cfg = [(64, 3), (128, 4), (256, 6), (512, 3)]
+
+    def init(key):
+        params, state = {}, {}
+
+        def conv_init(key, o, i, k):
+            fan_in = i * k * k
+            return (jax.random.normal(key, wshape(o, i, k), jnp.float32)
+                    * np.sqrt(2.0 / fan_in))
+
+        idx = 0
+
+        def nk():
+            nonlocal idx
+            idx += 1
+            return jax.random.fold_in(key, idx)
+
+        def add_bn(name, c, zero=False):
+            params[name + "_g"] = (jnp.zeros if zero else jnp.ones)((c,), jnp.float32)
+            params[name + "_b"] = jnp.zeros((c,), jnp.float32)
+            state[name + "_m"] = jnp.zeros((c,), jnp.float32)
+            state[name + "_v"] = jnp.ones((c,), jnp.float32)
+
+        params["stem"] = conv_init(nk(), 64, 3, 7)
+        add_bn("stem", 64)
+        n_in = 64
+        for si, (planes, count) in enumerate(cfg):
+            for bi in range(count):
+                p = f"s{si}b{bi}"
+                params[p + "_c1"] = conv_init(nk(), planes, n_in, 1)
+                add_bn(p + "_1", planes)
+                params[p + "_c2"] = conv_init(nk(), planes, planes, 3)
+                add_bn(p + "_2", planes)
+                params[p + "_c3"] = conv_init(nk(), planes * 4, planes, 1)
+                add_bn(p + "_3", planes * 4, zero=True)
+                if bi == 0:
+                    params[p + "_sc"] = conv_init(nk(), planes * 4, n_in, 1)
+                    add_bn(p + "_sc", planes * 4)
+                n_in = planes * 4
+        params["fc_w"] = jax.random.normal(nk(), (2048, 1000), jnp.float32) * 0.01
+        params["fc_b"] = jnp.zeros((1000,), jnp.float32)
+        return params, state
+
+    def conv(x, w, stride, pad):
+        return lax.conv_general_dilated(
+            x, w, (stride, stride),
+            ((pad, pad), (pad, pad)) if isinstance(pad, int) else pad,
+            dimension_numbers=dn)
+
+    def bn(x, p, s, name, training):
+        g, b = p[name + "_g"], p[name + "_b"]
+        if training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0,) + spatial)
+            var = jnp.maximum(jnp.mean(xf * xf, axis=(0,) + spatial) - mean * mean, 0.0)
+            n = x.shape[0] * x.shape[spatial[0]] * x.shape[spatial[1]]
+            s[name + "_m"] = 0.9 * s[name + "_m"] + 0.1 * mean
+            s[name + "_v"] = 0.9 * s[name + "_v"] + 0.1 * var * (n / (n - 1))
+        else:
+            mean, var = s[name + "_m"], s[name + "_v"]
+        inv = (g / jnp.sqrt(var + 1e-5)).astype(x.dtype)
+        bias = (b - mean * g / jnp.sqrt(var + 1e-5)).astype(x.dtype)
+        shape = [1] * 4
+        shape[caxis] = x.shape[caxis]
+        return x * inv.reshape(shape) + bias.reshape(shape)
+
+    def forward(p, s, x, training):
+        s = dict(s)
+        x = conv(x, p["stem"], 2, 3)
+        x = jax.nn.relu(bn(x, p, s, "stem", training))
+        wd, ws = pool_dims(3, 2)
+        x = lax.reduce_window(x, -jnp.inf, lax.max, wd, ws,
+                              [(0, 0), (0, 0), (1, 1), (1, 1)] if caxis == 1
+                              else [(0, 0), (1, 1), (1, 1), (0, 0)])
+        for si, (planes, count) in enumerate(cfg):
+            for bi in range(count):
+                pfx = f"s{si}b{bi}"
+                stride = 2 if (si > 0 and bi == 0) else 1
+                r = conv(x, p[pfx + "_c1"], 1, 0)
+                r = jax.nn.relu(bn(r, p, s, pfx + "_1", training))
+                r = conv(r, p[pfx + "_c2"], stride, 1)
+                r = jax.nn.relu(bn(r, p, s, pfx + "_2", training))
+                r = conv(r, p[pfx + "_c3"], 1, 0)
+                r = bn(r, p, s, pfx + "_3", training)
+                if bi == 0:
+                    sc = conv(x, p[pfx + "_sc"], stride, 0)
+                    sc = bn(sc, p, s, pfx + "_sc", training)
+                else:
+                    sc = x
+                x = jax.nn.relu(r + sc)
+        x = jnp.mean(x, axis=spatial)
+        logits = x.astype(jnp.float32) @ p["fc_w"] + p["fc_b"]
+        return logits, s
+
+    def step(params, mom, state, x, y):
+        def loss_fn(p):
+            pb = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), p)
+            logits, new_s = forward(pb, state, x.astype(jnp.bfloat16), True)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.take_along_axis(logp, y[:, None], 1).mean(), new_s
+
+        (loss, new_s), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_m = {}, {}
+        for k in params:
+            g = grads[k].astype(jnp.float32) + 1e-4 * params[k]
+            m = 0.9 * mom[k] + g
+            new_m[k] = m
+            new_p[k] = params[k] - 0.1 * m
+        new_s = {k: v.astype(jnp.float32) for k, v in new_s.items()}
+        return new_p, new_m, new_s, loss
+
+    return init, step
+
+
+def run(layout: str, batch: int, iters: int) -> float:
+    import jax
+
+    init, step = make_resnet50(layout)
+    params, state = init(jax.random.PRNGKey(0))
+    mom = jax.tree_util.tree_map(lambda a: np.zeros_like(a), params)
+    shape = (batch, 3, 224, 224) if layout == "NCHW" else (batch, 224, 224, 3)
+    x = jax.device_put(np.random.default_rng(0)
+                       .standard_normal(shape).astype(np.float32))
+    y = jax.device_put(np.random.default_rng(1)
+                       .integers(0, 1000, size=(batch,)).astype(np.int32))
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+    # float() readback, not block_until_ready: on this PJRT transport the
+    # latter can resolve before device work drains (see bench.py)
+    params, mom, state, loss = jstep(params, mom, state, x, y)
+    float(loss)
+    for _ in range(2):
+        params, mom, state, loss = jstep(params, mom, state, x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mom, state, loss = jstep(params, mom, state, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=40)
+    ap.add_argument("--layouts", default="NCHW,NHWC")
+    args = ap.parse_args()
+    for layout in args.layouts.split(","):
+        ips = run(layout, args.batch, args.iters)
+        print(f"{layout} batch={args.batch}: {ips:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()
