@@ -8,7 +8,7 @@ minimal raw-JAX ResNet-50 doing the SAME per-step work as bench.py (bf16
 forward/backward, fp32 BN batch stats + running-stat update, CE loss,
 momentum+weight-decay SGD) in both layouts.
 
-Run: python benchmarks/layout_experiment.py [--batch 256] [--iters 10]
+Run: python benchmarks/layout_experiment.py [--batch 256] [--iters 40]
 """
 
 from __future__ import annotations

@@ -32,7 +32,9 @@ def _batches(data, batch_size: int):
     if hasattr(data, "data") and callable(getattr(data, "data")):  # DataSet
         from bigdl_tpu.optim.optimizer import _ensure_dataset
 
-        yield from _ensure_dataset(data, batch_size).data(train=False)
+        # evaluation scores EVERY record — keep the trailing partial batch
+        yield from _ensure_dataset(
+            data, batch_size, drop_remainder=False).data(train=False)
         return
     items = list(data) if not isinstance(data, (list, tuple)) else data
     if items and isinstance(items[0], Sample):
@@ -42,6 +44,41 @@ def _batches(data, batch_size: int):
         arr = np.asarray(items, np.float32)
         for i in range(0, len(arr), batch_size):
             yield MiniBatch(arr[i:i + batch_size])
+
+
+def make_sharded_eval_step(model, mesh):
+    """Jitted forward with the batch sharded over the mesh's ``data`` axis
+    and params/state replicated — the one construction shared by
+    :class:`Evaluator` and ``DistriOptimizer``'s in-training validation."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sh = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    return jax.jit(make_eval_step(model),
+                   in_shardings=(rep, rep, batch_sh), out_shardings=batch_sh)
+
+
+def pad_shard_call(step, n_dev: int, params, model_state, inp):
+    """Run a mesh-sharded eval ``step`` on a batch whose row count may not
+    divide the ``data`` axis: pad rows (repeating row 0) to a multiple of
+    ``n_dev``, call, trim the outputs back. Shared by :class:`Evaluator`
+    and ``DistriOptimizer``'s in-training validation path."""
+    n = np.asarray(inp).shape[0] if not isinstance(inp, (list, tuple)) \
+        else np.asarray(inp[0]).shape[0]
+    pad = (-n) % n_dev
+    if not pad:
+        return step(params, model_state, inp)
+
+    def pad_rows(x):
+        x = np.asarray(x)
+        return np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
+
+    inp = ([pad_rows(v) for v in inp]
+           if isinstance(inp, (list, tuple)) else pad_rows(inp))
+    out = step(params, model_state, inp)
+    return ([o[:n] for o in out]
+            if isinstance(out, (list, tuple)) else out[:n])
 
 
 class Evaluator:
@@ -57,35 +94,14 @@ class Evaluator:
         import jax
 
         if self._step is None:
-            fn = make_eval_step(self.model)
             if self.mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                batch_sh = NamedSharding(self.mesh, P("data"))
-                rep = NamedSharding(self.mesh, P())
-                self._step = jax.jit(
-                    fn, in_shardings=(rep, rep, batch_sh), out_shardings=batch_sh
-                )
+                self._step = make_sharded_eval_step(self.model, self.mesh)
             else:
-                self._step = jax.jit(fn)
+                self._step = jax.jit(make_eval_step(self.model))
         if self.mesh is not None:
-            # a ragged final batch can't shard N ways — pad rows to the mesh
-            # size (repeating row 0) and trim the outputs back
+            # a ragged final batch can't shard N ways — pad to the mesh size
             n_dev = int(np.prod(list(self.mesh.shape.values())))
-            n = np.asarray(inp).shape[0] if not isinstance(inp, (list, tuple)) \
-                else np.asarray(inp[0]).shape[0]
-            pad = (-n) % n_dev
-            if pad:
-                def pad_rows(x):
-                    x = np.asarray(x)
-                    return np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
-
-                inp = ([pad_rows(v) for v in inp]
-                       if isinstance(inp, (list, tuple)) else pad_rows(inp))
-                out = self._step(params, model_state, inp)
-                trim = lambda o: o[:n]
-                return ([trim(o) for o in out]
-                        if isinstance(out, (list, tuple)) else trim(out))
+            return pad_shard_call(self._step, n_dev, params, model_state, inp)
         return self._step(params, model_state, inp)
 
     def test(self, dataset, methods: Sequence[ValidationMethod],

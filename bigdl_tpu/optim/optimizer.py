@@ -35,7 +35,8 @@ from bigdl_tpu.optim.validation import ValidationMethod
 logger = logging.getLogger("bigdl_tpu")
 
 
-def _ensure_dataset(dataset, batch_size: Optional[int]) -> AbstractDataSet:
+def _ensure_dataset(dataset, batch_size: Optional[int],
+                    drop_remainder: bool = True) -> AbstractDataSet:
     if dataset is None:
         raise ValueError(
             "Optimizer requires a dataset (pass dataset=...; a raw Sample "
@@ -52,7 +53,8 @@ def _ensure_dataset(dataset, batch_size: Optional[int]) -> AbstractDataSet:
         # yielding MiniBatch (Scala-style transformer chain) passes through.
         probe = next(iter(dataset.data(train=False)), None)
         if isinstance(probe, Sample):
-            dataset = dataset.transform(SampleToMiniBatch(batch_size))
+            dataset = dataset.transform(
+                SampleToMiniBatch(batch_size, drop_remainder=drop_remainder))
     return dataset
 
 
@@ -146,7 +148,11 @@ class Optimizer:
         if val_method is not None:
             methods = val_method
         self.validation_trigger = trigger
-        self.validation_dataset = _ensure_dataset(dataset, batch_size)
+        # keep the trailing partial batch: validation must score EVERY
+        # record (reference Evaluator semantics); the mesh eval path pads
+        # ragged batches to the data axis and trims the outputs
+        self.validation_dataset = _ensure_dataset(dataset, batch_size,
+                                                  drop_remainder=False)
         self.validation_methods = list(methods)
         return self
 
@@ -474,9 +480,10 @@ class Optimizer:
                 epoch_start = time.time()
 
             if self.validation_trigger is not None and self.validation_trigger(state):
-                score = self._run_validation(
-                    self._ckpt_params_to_host(params), model_state, state
-                )
+                # device-layout params: DistriOptimizer overrides
+                # _eval_forward to evaluate SHARDED over the mesh instead of
+                # gathering to host and wasting N-1 chips (SURVEY §3.3)
+                score = self._run_validation(params, model_state, state)
                 if score is not None:
                     state["score"] = score
             if self.checkpoint_trigger is not None and self.checkpoint_trigger(state):
