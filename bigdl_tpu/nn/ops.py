@@ -817,3 +817,121 @@ class TopKV2(AbstractModule):
         x, k = input
         vals, idx = lax.top_k(x, int(np.asarray(k)))
         return [vals, idx], state
+
+
+# ----------------------------------------------------------------------------
+# Control flow (reference nn/ops control-flow set — SURVEY §2.2:
+# Switch/Merge/Enter/Exit/NextIteration/LoopCond). TPU-native lowering:
+# a TF v1 while frame collapses to ONE ``lax.while_loop`` (TFWhile below,
+# assembled by the loader's frame extractor); a v1 cond's Switch/Merge pair
+# lowers to compute-both-branches + ``jnp.where`` select (valid for the
+# pure dataflow graphs the loader imports — no side effects to gate).
+# ----------------------------------------------------------------------------
+
+
+class SwitchOp(AbstractModule):
+    """TF Switch: [data, pred] → table (output_false, output_true).
+
+    Dataflow lowering: both ports carry ``data``; the branch selection
+    happens at the matching :class:`CondMerge` (select semantics). The
+    dead-branch suppression of TF's executor is unnecessary here — both
+    branches are pure and XLA DCEs whichever the consumer ignores."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        data, _pred = input
+        return [data, data], state
+
+
+class CondMerge(AbstractModule):
+    """TF Merge under a cond region: [false_value, true_value, pred] →
+    ``jnp.where(pred, true_value, false_value)`` (the loader routes the
+    controlling Switch predicate in as the third input)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        false_v, true_v, pred = input
+        return jnp.where(pred, true_v, false_v), state
+
+
+class TFWhile(AbstractModule):
+    """A whole TF while-loop (v1 Enter/Merge/Switch/Exit/NextIteration/
+    LoopCond frame, or a v2 functional ``While``) as one ``lax.while_loop``.
+
+    ``cond_fn(carry, consts) -> bool`` and ``body_fn(carry, consts) ->
+    carry`` are built by the loader's GraphDef interpreter; ``input`` is the
+    table of loop-variable initial values (the Enter inputs) followed by
+    ``n_consts`` loop-invariant values (``Enter(is_constant=true)``)."""
+
+    def __init__(self, cond_fn, body_fn, n_vars: int, n_consts: int = 0) -> None:
+        super().__init__()
+        self.cond_fn = cond_fn
+        self.body_fn = body_fn
+        self.n_vars = n_vars
+        self.n_consts = n_consts
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        vals = tuple(input) if isinstance(input, (list, tuple)) else (input,)
+        # loop carry must be jax types with stable dtypes across iterations
+        vals = tuple(jnp.asarray(v) for v in vals)
+        carry, consts = vals[: self.n_vars], vals[self.n_vars:]
+        out = lax.while_loop(
+            lambda c: self.cond_fn(c, consts),
+            lambda c: self.body_fn(c, consts),
+            carry,
+        )
+        # always a table: consumers address loop vars by port (SelectTable)
+        return list(out), state
+
+
+class TFCond(AbstractModule):
+    """TF v2 functional If/StatelessIf as ``lax.cond``: input table
+    ``[pred, *branch_args]``; ``then_fn(args)``/``else_fn(args)`` return
+    the branch output tuple (built by the loader's FunctionDef
+    interpreter)."""
+
+    def __init__(self, then_fn, else_fn, n_out: int) -> None:
+        super().__init__()
+        self.then_fn = then_fn
+        self.else_fn = else_fn
+        self.n_out = n_out
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        vals = tuple(input) if isinstance(input, (list, tuple)) else (input,)
+        pred, *args = vals
+        args = tuple(jnp.asarray(a) for a in args)
+        out = lax.cond(jnp.asarray(pred).reshape(()),
+                       self.then_fn, self.else_fn, args)
+        # always a table: consumers address branch outputs by port
+        return list(out), state
+
+
+# structural v1 frame ops: standalone they are identity (the loader's frame
+# extractor consumes them before lowering; these exist so a hand-built
+# graph of raw control-flow nodes still loads)
+class EnterOp(AbstractModule):
+    def __init__(self, frame_name: str = "", is_constant: bool = False) -> None:
+        super().__init__()
+        self.frame_name = frame_name
+        self.is_constant = is_constant
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        return input, state
+
+
+class ExitOp(EnterOp):
+    pass
+
+
+class NextIterationOp(EnterOp):
+    pass
+
+
+class LoopCondOp(EnterOp):
+    pass

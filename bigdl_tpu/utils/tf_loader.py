@@ -52,7 +52,15 @@ _IDENTITY_OPS = {"Identity", "StopGradient", "CheckNumerics", "PlaceholderWithDe
 
 # table-returning ops: consumers address their results by port ("name:1");
 # the loader inserts a SelectTable per referenced port
-_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "Unstack", "TopKV2", "TopK"}
+_MULTI_OUTPUT_OPS = {"Split", "SplitV", "Unpack", "Unstack", "TopKV2", "TopK",
+                     "Switch", "RefSwitch", "While", "StatelessWhile",
+                     "If", "StatelessIf"}
+
+# v1 control-flow structural ops (reference nn/ops control flow — SURVEY
+# §2.2); consumed by the while-frame extractor / cond pattern-matcher below
+_CONTROL_FLOW_OPS = {"Enter", "RefEnter", "Merge", "RefMerge", "Switch",
+                     "RefSwitch", "Exit", "RefExit", "NextIteration",
+                     "RefNextIteration", "LoopCond"}
 
 # weight-slot positions per op: input indices that, when fed by a Const,
 # should become trainable ParameterOps rather than frozen ConstOps
@@ -79,6 +87,14 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
     strip = lambda name: name.split(":")[0].lstrip("^")
     input_names = [strip(n) for n in inputs]
     output_names = [strip(n) for n in outputs]
+
+    # control flow: v1 while frames (Enter/Merge/Switch/Exit/NextIteration/
+    # LoopCond) collapse to lax.while_loop; v2 functional While/If use the
+    # FunctionDef library; v1 cond Switch/Merge pairs lower to select
+    fns = ({f.signature.name: f for f in gd.library.function}
+           if gd.HasField("library") else {})
+    frames = _extract_while_frames(nodes)
+    evaluator = _GraphEval(nodes, fns, frames)
 
     built: Dict[str, ModuleNode] = {}
     graph_inputs: List[ModuleNode] = []
@@ -137,46 +153,140 @@ def load_tf(graph_def_or_path, inputs: Sequence[str], outputs: Sequence[str],
             built[name] = mn
             return mn
 
+        if op in ("Exit", "RefExit"):
+            if name not in frames:
+                raise NotImplementedError(
+                    f"Exit {name!r} reachable from the requested outputs "
+                    "but its while frame could not be extracted (pruned or "
+                    "malformed v1 loop)")
+            mn = build_frame_exit(node)
+            built[name] = mn
+            return mn
+
+        if op in ("Merge", "RefMerge"):
+            mn = build_cond_merge(node)
+            built[name] = mn
+            return mn
+
+        if op in ("While", "StatelessWhile"):
+            cond_fn, body_fn = _function_while_fns(node, fns)
+            mod = O.TFWhile(cond_fn, body_fn, n_vars=len(node.input))
+            mod.set_name(name)
+            preds = [build_operand(inp, op) for inp in node.input
+                     if not inp.startswith("^")]
+            mn = mod.inputs(*preds)
+            built[name] = mn
+            return mn
+
+        if op in ("If", "StatelessIf"):
+            then_fn, else_fn, n_out = _function_if_fns(node, fns)
+            mod = O.TFCond(then_fn, else_fn, n_out)
+            mod.set_name(name)
+            preds = [build_operand(inp, op) for inp in node.input
+                     if not inp.startswith("^")]
+            mn = mod.inputs(*preds)
+            built[name] = mn
+            return mn
+
         if op == "Const":
             raise ValueError(
                 f"Const {name!r} used outside a recognized operand slot")
 
         preds: List[ModuleNode] = []
-        const_mods: List[tuple] = []
-        for i, inp in enumerate(node.input):
+        slot = 0
+        for inp in node.input:
             if inp.startswith("^"):
                 continue  # control edge
-            iname = strip(inp)
-            port = int(inp.split(":")[1]) if ":" in inp else 0
-            src = nodes[iname]
-            # resolve through identity chains for const-ness detection
-            seen = set()
-            while src.op in _IDENTITY_OPS and src.input:
-                if src.name in seen:
-                    break
-                seen.add(src.name)
-                src = nodes[strip(src.input[0])]
-            if src.op == "Const" and iname not in input_names:
-                const_mods.append((i, const_feed(src.name, op, i)))
-                preds.append(None)  # placeholder, filled below
-            else:
-                preds.append(build_port(iname, port))
+            preds.append(build_operand(inp, op, slot=slot))
+            slot += 1
 
         mod = _lower(node)
         mod.set_name(name)
-
-        # wire constants: each const module becomes a node fed by the first
-        # real predecessor (dummy dep to keep the DAG rooted at inputs)
-        anchor = next((p for p in preds if p is not None), None)
-        for i, cmod in const_mods:
-            if anchor is None:
-                # op with only-const operands: anchor on the graph input
-                anchor = graph_inputs[0] if graph_inputs else build(input_names[0])
-            preds[i] = cmod.inputs(anchor)
-
         mn = mod.inputs(*preds)
         built[name] = mn
         return mn
+
+    def build_operand(ref: str, consumer_op: str, slot: int = -1) -> ModuleNode:
+        """Resolve one operand ref ("name", "name:port"): Const sources
+        become (anchored) ConstOp/ParameterOp nodes, everything else builds
+        through the DAG."""
+        iname = strip(ref)
+        port = int(ref.split(":")[1]) if ":" in ref else 0
+        src = nodes[iname]
+        seen = set()
+        while src.op in _IDENTITY_OPS and src.input:
+            if src.name in seen:
+                break
+            seen.add(src.name)
+            src = nodes[strip(src.input[0])]
+        if src.op == "Const" and iname not in input_names:
+            cmod = const_feed(src.name, consumer_op, slot)
+            anchor = graph_inputs[0] if graph_inputs else build(input_names[0])
+            return cmod.inputs(anchor)
+        return build_port(iname, port)
+
+    def build_frame_exit(exit_node) -> ModuleNode:
+        """v1 while frame → ONE TFWhile (lax.while_loop) node; each Exit is
+        a SelectTable port on it."""
+        fr = frames[exit_node.name]
+        key = ("__frame__", fr.frame_name)
+        if key not in port_nodes:
+            mod = fr.make_module(evaluator)
+            mod.set_name(f"{fr.frame_name}/while")
+            preds = [build_operand(v["enter"].input[0], "Enter")
+                     for v in fr.vars]
+            preds += [build_operand(e.input[0], "Enter")
+                      for e in fr.const_enters]
+            port_nodes[key] = mod.inputs(*preds)
+        idx = fr.exit_index(exit_node.name)
+        pkey = ("__frame_exit__", fr.frame_name, idx)
+        if pkey not in port_nodes:
+            from bigdl_tpu.nn import SelectTable
+
+            sel = SelectTable(idx + 1)  # 1-based
+            sel.set_name(exit_node.name)
+            port_nodes[pkey] = sel.inputs(port_nodes[key])
+        return port_nodes[pkey]
+
+    def build_cond_merge(merge_node) -> ModuleNode:
+        """v1 cond: Merge(false_branch, true_branch) → select on the
+        controlling Switch predicate (compute-both-branches lowering)."""
+        refs = [i for i in merge_node.input if not i.startswith("^")]
+        if any(nodes[strip(r)].op in ("Enter", "RefEnter") for r in refs):
+            raise NotImplementedError(
+                f"Merge {merge_node.name!r} belongs to a while frame but "
+                "was reached outside frame extraction")
+        if len(refs) != 2:
+            raise NotImplementedError(
+                f"cond Merge {merge_node.name!r} with {len(refs)} branches")
+        # the controlling predicate is the one BOTH branches are gated by,
+        # with opposite ports — nested conds contribute their inner
+        # predicate to one branch only, so first-Switch-found would pick
+        # the wrong gate
+        traces = [set(_trace_all_switches(nodes, r)) for r in refs]
+        pairs = {
+            pred: b0
+            for (b0, pred) in traces[0]
+            if (1 - b0, pred) in traces[1] and (b0, pred) not in traces[1]
+        }
+        if not pairs:
+            raise NotImplementedError(
+                f"cond Merge {merge_node.name!r}: no predicate gates both "
+                "branches with opposite ports")
+        if len(pairs) > 1:
+            raise NotImplementedError(
+                f"cond Merge {merge_node.name!r}: ambiguous controlling "
+                f"predicates {sorted(pairs)}")
+        (pred_ref, b0), = pairs.items()
+        false_ref = refs[0] if b0 == 0 else refs[1]
+        true_ref = refs[1] if b0 == 0 else refs[0]
+        mod = O.CondMerge()
+        mod.set_name(merge_node.name)
+        return mod.inputs(
+            build_operand(false_ref, "Merge"),
+            build_operand(true_ref, "Merge"),
+            build_operand(pred_ref, "Merge"),
+        )
 
     # roots first so const anchoring has an input available
     for n in input_names:
@@ -200,6 +310,314 @@ def _load_graph_def(graph_def_or_path):
             gd.ParseFromString(f.read())
         return gd
     return graph_def_or_path  # already a GraphDef
+
+
+# -- control-flow machinery ---------------------------------------------------
+
+def _split_ref(ref: str):
+    """Tensor ref → (node_name, port). Handles "name", "name:1" and the
+    FunctionDef form "name:output_name:k"."""
+    ref = ref.lstrip("^")
+    parts = ref.split(":")
+    if len(parts) == 1:
+        return parts[0], 0
+    if len(parts) == 2:
+        return parts[0], int(parts[1]) if parts[1].isdigit() else 0
+    return parts[0], int(parts[-1])
+
+
+def _trace_all_switches(nodes, ref, out=None, seen=None, _depth=0):
+    """Walk a cond branch backwards collecting every (port, predicate_ref)
+    of Switches crossed. v1 cond creates a SEPARATE Switch per captured
+    tensor, all sharing one predicate — so gating is identified by
+    predicate, not switch identity. Traversal continues THROUGH a Switch's
+    data input (nested conds stack gates) and follows control edges
+    (const-only branches are anchored by a control dep on the branch's
+    switch pivot)."""
+    if out is None:
+        out, seen = [], set()
+    if _depth > 512:
+        return out
+    name, port = _split_ref(ref)
+    if (name, port) in seen:
+        return out
+    seen.add((name, port))
+    node = nodes.get(name)
+    if node is None:
+        return out
+    if node.op in ("Switch", "RefSwitch"):
+        out.append((port, _resolve_identity(nodes, node.input[1])))
+        _trace_all_switches(nodes, node.input[0], out, seen, _depth + 1)
+        return out
+    for i in node.input:
+        _trace_all_switches(nodes, i, out, seen, _depth + 1)
+    return out
+
+
+def _resolve_identity(nodes, ref: str) -> str:
+    """Canonicalize a ref through Identity chains (v1 cond routes the same
+    predicate both directly and via a ``pred_id`` Identity)."""
+    seen = set()
+    while True:
+        name, port = _split_ref(ref)
+        node = nodes.get(name)
+        if node is None or node.op not in _IDENTITY_OPS or not node.input \
+                or name in seen:
+            return f"{name}:{port}" if port else name
+        seen.add(name)
+        ref = node.input[0]
+
+
+def _extract_while_frames(nodes):
+    """Group v1 Enter nodes by frame_name and resolve each frame's loop
+    structure; returns {exit_node_name: _WhileFrame}."""
+    by_frame: Dict[str, list] = {}
+    for n in nodes.values():
+        if n.op in ("Enter", "RefEnter"):
+            by_frame.setdefault(
+                n.attr["frame_name"].s.decode(), []).append(n)
+    consumers: Dict[str, list] = {}
+    if by_frame:
+        for n in nodes.values():
+            for i in n.input:
+                iname, _ = _split_ref(i)
+                consumers.setdefault(iname, []).append(n)
+    out: Dict[str, "_WhileFrame"] = {}
+    for fname, enters in by_frame.items():
+        try:
+            fr = _WhileFrame(fname, enters, nodes, consumers)
+        except NotImplementedError:
+            # dead / freeze-pruned frame (e.g. leftover training control
+            # flow): tolerate at load time — it only matters if one of its
+            # Exits is actually reachable from the requested outputs, and
+            # then build() fails loudly on the unmatched Exit
+            continue
+        for v in fr.vars:
+            if v["exit"] is not None:
+                out[v["exit"].name] = fr
+    return out
+
+
+class _WhileFrame:
+    """One v1 while frame: per loop var the Enter→Merge→Switch→(Exit,
+    body→NextIteration) diamond, plus loop-invariant constant Enters."""
+
+    def __init__(self, frame_name, enters, nodes, consumers):
+        self.frame_name = frame_name
+        self.const_enters = [e for e in enters if e.attr["is_constant"].b]
+        self.vars = []
+        loopcond = None
+        for e in enters:
+            if e.attr["is_constant"].b:
+                continue
+            merge = next((c for c in consumers.get(e.name, ())
+                          if c.op in ("Merge", "RefMerge")), None)
+            if merge is None:
+                raise NotImplementedError(
+                    f"while frame {frame_name!r}: Enter {e.name!r} "
+                    "has no Merge consumer")
+            switch = next((c for c in consumers.get(merge.name, ())
+                           if c.op in ("Switch", "RefSwitch")), None)
+            if switch is None:
+                raise NotImplementedError(
+                    f"while frame {frame_name!r}: Merge {merge.name!r} "
+                    "has no Switch consumer")
+            exit_ = next((c for c in consumers.get(switch.name, ())
+                          if c.op in ("Exit", "RefExit")), None)
+            ni = nodes[_split_ref(merge.input[1])[0]]
+            self.vars.append({"enter": e, "merge": merge, "switch": switch,
+                              "exit": exit_, "next": ni})
+            if loopcond is None:
+                loopcond = nodes[_split_ref(switch.input[1])[0]]
+        if loopcond is None or loopcond.op != "LoopCond":
+            raise NotImplementedError(
+                f"while frame {frame_name!r}: no LoopCond found")
+        self.loopcond = loopcond
+
+    def exit_index(self, exit_name: str) -> int:
+        for i, v in enumerate(self.vars):
+            if v["exit"] is not None and v["exit"].name == exit_name:
+                return i
+        raise KeyError(exit_name)
+
+    def make_module(self, evaluator: "_GraphEval"):
+        """Build the TFWhile module: cond evaluates the LoopCond predicate
+        subgraph with loop vars fed at the Merges; body evaluates the
+        NextIteration inputs with loop vars fed at Switch:1."""
+        import jax.numpy as jnp
+
+        cond_target = self.loopcond.input[0]
+        body_targets = [v["next"].input[0] for v in self.vars]
+        merges = [v["merge"].name for v in self.vars]
+        switches = [v["switch"].name for v in self.vars]
+        const_names = [e.name for e in self.const_enters]
+
+        def feeds_for(carry, consts, keys):
+            feeds = dict(zip(keys, carry))
+            feeds.update(zip(const_names, consts))
+            return feeds
+
+        def cond_fn(carry, consts):
+            (pred,) = evaluator.eval(
+                [cond_target], feeds_for(carry, consts, merges))
+            return jnp.asarray(pred).reshape(())
+
+        def body_fn(carry, consts):
+            outs = evaluator.eval(
+                body_targets,
+                feeds_for(carry, consts, [f"{s}:1" for s in switches]))
+            # lax.while_loop needs a dtype-stable carry (TF guarantees
+            # loop-var dtypes; weak-typed consts would otherwise drift)
+            return tuple(jnp.asarray(o).astype(c.dtype)
+                         for o, c in zip(outs, carry))
+
+        return O.TFWhile(cond_fn, body_fn, n_vars=len(self.vars),
+                         n_consts=len(self.const_enters))
+
+
+# FunctionDef multi-output ops: output_arg name → port base (the common
+# cases; single-output ops resolve to port 0 automatically)
+_FN_OUTPUT_NAMES = {
+    "Switch": ("output_false", "output_true"),
+    "TopKV2": ("values", "indices"),
+    "TopK": ("values", "indices"),
+}
+
+
+class _GraphEval:
+    """Functional interpreter for a GraphDef/FunctionDef node set — reuses
+    the ``_lower`` op table so control-flow bodies execute the exact same
+    lowering as the surrounding Graph. Used to build lax.while_loop /
+    lax.cond callables for TFWhile/TFCond.
+
+    Limitation: Consts INSIDE a control-flow body (e.g. weights of a
+    MatMul in a loop) import as frozen values, not trainable ParameterOps
+    — the loop is one opaque module to the surrounding Graph. Fine-tuning
+    reaches everything outside control flow, matching the reference's
+    frozen-import scope."""
+
+    def __init__(self, nodes, fns, frames):
+        self.nodes = nodes
+        self.fns = fns or {}
+        self.frames = frames or {}
+
+    def eval(self, targets, feeds):
+        env = dict(feeds)
+
+        def get(ref):
+            name, port = _split_ref(ref)
+            parts = ref.lstrip("^").split(":")
+            if len(parts) == 3 and not parts[1].isdigit():
+                node = self.nodes.get(name)
+                if node is not None and node.op in _FN_OUTPUT_NAMES:
+                    base = _FN_OUTPUT_NAMES[node.op].index(parts[1])
+                    port = base + int(parts[2])
+            key = f"{name}:{port}"
+            if key in env:
+                return env[key]
+            if port == 0 and name in env:
+                return env[name]
+            out = self._node(self.nodes[name], get)
+            if isinstance(out, (list, tuple)):
+                for i, v in enumerate(out):
+                    env[f"{name}:{i}"] = v
+                return out[port]
+            env[name] = out
+            return out
+
+        return [get(t) for t in targets]
+
+    def _node(self, node, get):
+        op = node.op
+        if op == "Const":
+            # plain numpy, NOT jnp: inside a while_loop/cond trace
+            # jnp.asarray stages the constant as a tracer, which breaks
+            # ops needing static operands (Gather axis, Reshape shape, …)
+            return _const_value(node)
+        if op in _IDENTITY_OPS or op in (
+                "Enter", "RefEnter", "NextIteration", "RefNextIteration",
+                "LoopCond", "Exit", "RefExit"):
+            # inside an extracted frame these are pass-through; a NESTED
+            # frame's Exit evaluates the inner loop recursively
+            if op in ("Exit", "RefExit") and node.name in self.frames:
+                fr = self.frames[node.name]
+                mod = fr.make_module(self)
+                ins = [get(v["enter"].input[0]) for v in fr.vars]
+                ins += [get(e.input[0]) for e in fr.const_enters]
+                out, _ = mod.apply({}, ins)
+                return out[fr.exit_index(node.name)]
+            return get(node.input[0])
+        if op in ("While", "StatelessWhile"):
+            cond_fn, body_fn = _function_while_fns(node, self.fns)
+            ins = [get(i) for i in node.input if not i.startswith("^")]
+            out, _ = O.TFWhile(cond_fn, body_fn, len(ins)).apply({}, ins)
+            return out
+        if op in ("If", "StatelessIf"):
+            then_fn, else_fn, n_out = _function_if_fns(node, self.fns)
+            ins = [get(i) for i in node.input if not i.startswith("^")]
+            out, _ = O.TFCond(then_fn, else_fn, n_out).apply({}, ins)
+            return out
+        if op == "Merge":
+            raise NotImplementedError(
+                f"Merge {node.name!r} reached by the subgraph interpreter "
+                "(cond-in-loop-body is not supported)")
+        ins = [get(i) for i in node.input if not i.startswith("^")]
+        mod = _lower(node)
+        out, _ = mod.apply({}, ins if len(ins) != 1 else ins[0], None)
+        return out
+
+
+def _function_eval(fdef, fns):
+    """FunctionDef → callable(args_tuple) -> outputs tuple."""
+    nodes = {n.name: n for n in fdef.node_def}
+    arg_names = [a.name for a in fdef.signature.input_arg]
+    targets = [fdef.ret[a.name] for a in fdef.signature.output_arg]
+    ev = _GraphEval(nodes, fns, {})
+
+    def run(args):
+        feeds = dict(zip(arg_names, args))
+        return tuple(ev.eval(targets, feeds))
+
+    return run
+
+
+def _function_while_fns(node, fns):
+    """v2 functional While: cond/body FunctionDefs → (cond_fn, body_fn)
+    with the TFWhile (carry, consts) signature (no consts — v2 carries
+    invariants through the loop vars)."""
+    import jax.numpy as jnp
+
+    cond_run = _function_eval(fns[node.attr["cond"].func.name], fns)
+    body_run = _function_eval(fns[node.attr["body"].func.name], fns)
+
+    def cond_fn(carry, consts):
+        return jnp.asarray(cond_run(carry)[0]).reshape(())
+
+    def body_fn(carry, consts):
+        outs = body_run(carry)
+        return tuple(jnp.asarray(o).astype(c.dtype)
+                     for o, c in zip(outs, carry))
+
+    return cond_fn, body_fn
+
+
+def _function_if_fns(node, fns):
+    """v2 functional If: then/else FunctionDefs → branch callables."""
+    import jax.numpy as jnp
+
+    then_f = fns[node.attr["then_branch"].func.name]
+    else_f = fns[node.attr["else_branch"].func.name]
+    then_run = _function_eval(then_f, fns)
+    else_run = _function_eval(else_f, fns)
+    n_out = len(then_f.signature.output_arg)
+
+    def mk(run):
+        def branch(args):
+            outs = run(args)
+            return tuple(jnp.asarray(o) for o in outs)
+        return branch
+
+    return mk(then_run), mk(else_run), n_out
 
 
 def _lower(node):
@@ -405,6 +823,18 @@ def _lower(node):
         return O.LogSoftmax()
     if op in ("TopKV2", "TopK"):
         return O.TopKV2()
+    if op in ("Switch", "RefSwitch"):
+        return O.SwitchOp()
+    if op in ("Enter", "RefEnter"):
+        return O.EnterOp(node.attr["frame_name"].s.decode()
+                         if "frame_name" in node.attr else "",
+                         node.attr["is_constant"].b)
+    if op in ("Exit", "RefExit"):
+        return O.ExitOp()
+    if op in ("NextIteration", "RefNextIteration"):
+        return O.NextIterationOp()
+    if op == "LoopCond":
+        return O.LoopCondOp()
     raise NotImplementedError(
         f"TF op {op!r} (node {node.name!r}) has no bigdl_tpu lowering yet")
 
