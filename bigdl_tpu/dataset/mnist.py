@@ -77,6 +77,44 @@ def read_data_sets(data_dir: str, kind: str = "train",
     return _synthetic_digits(synthetic_count, seed)
 
 
+def write_idx_files(data_dir: str, images: np.ndarray, labels: np.ndarray,
+                    kind: str = "train") -> None:
+    """Write (N,28,28) uint8 images + uint8 labels as real MNIST idx files
+    (the exact format ``read_data_sets`` parses). Used by the
+    accuracy-parity harness to exercise the real-file reader path and by
+    users converting their own digit datasets."""
+    os.makedirs(data_dir, exist_ok=True)
+    prefix = "train" if kind == "train" else "t10k"
+    images = np.asarray(images)
+    labels = np.asarray(labels)
+    if images.dtype != np.uint8 or labels.dtype != np.uint8:
+        raise ValueError(
+            f"idx files store uint8; got images {images.dtype}, labels "
+            f"{labels.dtype} — scale to [0, 255] and cast explicitly")
+    if images.ndim != 3:
+        raise ValueError(f"images must be (N, rows, cols); got {images.shape}")
+    n, rows, cols = images.shape
+    if len(labels) != n:
+        raise ValueError(f"{n} images but {len(labels)} labels")
+    images = np.ascontiguousarray(images)
+    labels = np.ascontiguousarray(labels)
+    with open(os.path.join(data_dir, f"{prefix}-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols))
+        f.write(images.tobytes())
+    with open(os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+
+
+def generate_idx_dataset(data_dir: str, n_train: int = 4096,
+                         n_test: int = 1024, seed: int = 7) -> None:
+    """Generate a deterministic LEARNABLE digit dataset as real idx files
+    on disk (train + t10k pairs) — the in-env stand-in for downloading
+    MNIST (zero egress), feeding the real reader path end to end."""
+    write_idx_files(data_dir, *_synthetic_digits(n_train, seed), "train")
+    write_idx_files(data_dir, *_synthetic_digits(n_test, seed + 6), "test")
+
+
 def load_samples(data_dir: str, kind: str = "train", **kw) -> List[Sample]:
     """Samples with (1,28,28) float features and 1-based labels, the shape
     the reference LeNet pipeline produces."""

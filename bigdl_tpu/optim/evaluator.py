@@ -37,6 +37,9 @@ def _batches(data, batch_size: int):
             data, batch_size, drop_remainder=False).data(train=False)
         return
     items = list(data) if not isinstance(data, (list, tuple)) else data
+    if items and isinstance(items[0], MiniBatch):
+        yield from items
+        return
     if items and isinstance(items[0], Sample):
         for i in range(0, len(items), batch_size):
             yield stack_samples(items[i:i + batch_size])

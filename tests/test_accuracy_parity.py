@@ -1,0 +1,171 @@
+"""Accuracy-parity harness — the north star's second clause (BASELINE.json
+"top-1 parity"; round-1 verdict missing #2; reference
+``models/lenet/Train.scala`` + ``optim/Top1Accuracy``).
+
+A deterministic learnable digit dataset is written as REAL idx files on
+disk (exercising the real MNIST reader, not the synthetic fallback),
+LeNet-5 trains end-to-end through the real Optimizer harness to a fixed
+Top-1 bar, and an architecturally identical torch model — same initial
+weights, same batch stream, same SGD — must land within a documented
+tolerance of the same final accuracy."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.integration
+
+BATCH = 128
+STEPS = 160          # 5 epochs of 4096 samples
+LR = 0.1
+ACCURACY_BAR = 0.97  # convergence contract
+PARITY_TOL = 0.02    # |jax - torch| final Top-1, documented tolerance
+
+
+@pytest.fixture(scope="module")
+def idx_dir(tmp_path_factory):
+    from bigdl_tpu.dataset.mnist import generate_idx_dataset
+
+    d = tmp_path_factory.mktemp("mnist_idx")
+    generate_idx_dataset(str(d), n_train=4096, n_test=1024, seed=7)
+    return str(d)
+
+
+def _train_stream(idx_dir, n_batches):
+    """The deterministic batch stream both frameworks train on."""
+    from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+    from bigdl_tpu.dataset.image import GreyImgNormalizer
+    from bigdl_tpu.dataset.mnist import TRAIN_MEAN, TRAIN_STD, load_samples
+
+    samples = load_samples(idx_dir, "train", synthetic_fallback=False)
+    assert len(samples) == 4096  # real files were read
+    ds = (DataSet.array(samples, seed=11)
+          .transform(GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD))
+          .transform(SampleToMiniBatch(BATCH)))
+    it = ds.data(train=True)
+    return [next(it) for _ in range(n_batches)]
+
+
+def _val_arrays(idx_dir):
+    from bigdl_tpu.dataset.image import GreyImgNormalizer
+    from bigdl_tpu.dataset.mnist import TRAIN_MEAN, TRAIN_STD, load_samples
+
+    samples = load_samples(idx_dir, "test", synthetic_fallback=False)
+    norm = GreyImgNormalizer(TRAIN_MEAN, TRAIN_STD)
+    xs = np.stack([np.asarray(s.feature()) for s in norm(iter(samples))])
+    ys = np.array([int(s.label()) for s in samples], np.int64)  # 1-based
+    return xs.astype(np.float32), ys
+
+
+def _named_params(model):
+    """name → param dict for the four weighted LeNet layers."""
+    out = {}
+
+    def walk(mods, params):
+        for i, m in enumerate(mods):
+            key = next((k for k in params if k.split(":")[0] == str(i)), None)
+            if key is None:
+                continue
+            sub = params[key]
+            if m.sub_modules():
+                walk(m.sub_modules(), sub)
+            elif isinstance(sub, dict) and sub:
+                out[m.name or key] = sub
+
+    walk(model.sub_modules(), model.params)
+    return out
+
+
+def test_lenet_convergence_and_torch_parity(idx_dir):
+    import torch
+    import torch.nn as tnn
+
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.evaluator import Evaluator
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(42)
+    model = LeNet5(10)
+    model._ensure_params()
+    init = _named_params(model)
+    assert set(init) == {"conv1_5x5", "conv2_5x5", "fc1", "fc2"}
+    init_np = {k: {kk: np.array(vv) for kk, vv in v.items()}
+               for k, v in init.items()}
+
+    batches = _train_stream(idx_dir, STEPS)
+
+    # --- bigdl_tpu: the real Optimizer harness over the same stream ------
+    opt = Optimizer(model=model, dataset=DataSet.array(batches),
+                    criterion=ClassNLLCriterion(),
+                    end_trigger=Trigger.max_iteration(STEPS))
+    opt.set_optim_method(SGD(learning_rate=LR))
+    trained = opt.optimize()
+
+    # the exact order the optimizer consumed (deterministic seed-0 stream)
+    it = DataSet.array(batches).data(train=True)
+    torch_order = [next(it) for _ in range(STEPS)]
+
+    xs, ys = _val_arrays(idx_dir)
+    res = Evaluator(trained).test(
+        [b for b in _as_minibatches(xs, ys)], [Top1Accuracy()], BATCH)[0]
+    jax_acc, n_scored = res.result()
+    assert n_scored == len(ys)
+    assert jax_acc >= ACCURACY_BAR, f"Top-1 {jax_acc:.4f} < {ACCURACY_BAR}"
+
+    # --- torch: identical arch, identical init, identical batches --------
+    tmodel = tnn.Sequential(
+        tnn.Conv2d(1, 6, 5), tnn.Tanh(), tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(6, 12, 5), tnn.Tanh(), tnn.MaxPool2d(2, 2),
+        tnn.Flatten(),
+        tnn.Linear(12 * 4 * 4, 100), tnn.Tanh(),
+        tnn.Linear(100, 10), tnn.LogSoftmax(dim=1),
+    ).double()
+    with torch.no_grad():
+        pairs = [(0, "conv1_5x5"), (3, "conv2_5x5"), (7, "fc1"), (9, "fc2")]
+        for ti, name in pairs:
+            tmodel[ti].weight.copy_(
+                torch.from_numpy(init_np[name]["weight"]).double())
+            tmodel[ti].bias.copy_(
+                torch.from_numpy(init_np[name]["bias"]).double())
+
+    topt = torch.optim.SGD(tmodel.parameters(), lr=LR)
+    lossf = tnn.NLLLoss()
+    for b in torch_order:
+        x = torch.from_numpy(np.asarray(b.get_input())).double()
+        y = torch.from_numpy(
+            np.asarray(b.get_target()).astype(np.int64) - 1)  # 0-based
+        topt.zero_grad()
+        loss = lossf(tmodel(x), y)
+        loss.backward()
+        topt.step()
+
+    with torch.no_grad():
+        pred = tmodel(torch.from_numpy(xs).double()).argmax(1).numpy()
+    torch_acc = float((pred == ys - 1).mean())
+
+    assert abs(jax_acc - torch_acc) <= PARITY_TOL, (
+        f"final Top-1 parity broken: jax {jax_acc:.4f} vs "
+        f"torch {torch_acc:.4f} (tol {PARITY_TOL})")
+
+
+def _as_minibatches(xs, ys):
+    from bigdl_tpu.dataset.sample import MiniBatch
+
+    for i in range(0, len(xs), BATCH):
+        yield MiniBatch(xs[i:i + BATCH], ys[i:i + BATCH].astype(np.float32))
+
+
+def test_real_reader_roundtrip(idx_dir):
+    """The files on disk parse back bit-identically through the real
+    reader (writer/reader contract)."""
+    from bigdl_tpu.dataset.mnist import (
+        _synthetic_digits, read_data_sets,
+    )
+
+    imgs, labels = read_data_sets(idx_dir, "train", synthetic_fallback=False)
+    want_imgs, want_labels = _synthetic_digits(4096, 7)
+    assert imgs.shape == (4096, 28, 28) and imgs.dtype == np.uint8
+    np.testing.assert_array_equal(imgs, want_imgs)
+    np.testing.assert_array_equal(labels, want_labels)
