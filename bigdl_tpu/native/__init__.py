@@ -62,7 +62,7 @@ def _load():
     i64 = ctypes.c_int64
     c_i64p = ctypes.POINTER(i64)
     lib.bigdl_recs_index.restype = i64
-    lib.bigdl_recs_index.argtypes = [c_u8p, i64, i64, c_i32p, c_i64p, c_i64p]
+    lib.bigdl_recs_index.argtypes = [c_u8p, i64, i64, c_i64p, c_i64p, c_i64p]
     _lib = lib
     return _lib
 
@@ -213,7 +213,7 @@ class NativeLoader:
 def recs_index(buf: np.ndarray):
     """Index a RECS shard buffer (uint8, starting at the magic).
 
-    Returns ``(labels int32[n], offsets int64[n], lengths int64[n])``.
+    Returns ``(labels int64[n], offsets int64[n], lengths int64[n])``.
     Raises ValueError on malformed data. Grows capacity and retries when the
     first guess undershoots (the C side returns -2 in that case).
     """
@@ -225,12 +225,12 @@ def recs_index(buf: np.ndarray):
     buf = np.ascontiguousarray(buf, np.uint8)
     cap = max(1024, buf.size // 64)  # ≥16 B/record heuristic first guess
     while True:
-        labels = np.empty(cap, np.int32)
+        labels = np.empty(cap, np.int64)
         offsets = np.empty(cap, np.int64)
         lengths = np.empty(cap, np.int64)
         n = lib.bigdl_recs_index(
             _u8(buf), ctypes.c_int64(buf.size), ctypes.c_int64(cap),
-            _i32(labels),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         if n == -1:

@@ -260,7 +260,7 @@ void bigdl_loader_destroy(bigdl_loader* L) {
 
 
 int64_t bigdl_recs_index(const uint8_t* buf, int64_t size, int64_t n_max,
-                         int32_t* labels, int64_t* offsets, int64_t* lengths) {
+                         int64_t* labels, int64_t* offsets, int64_t* lengths) {
   if (size < 4 || std::memcmp(buf, "RECS", 4) != 0) return -1;
   int64_t pos = 4;
   int64_t n = 0;
@@ -285,7 +285,9 @@ int64_t bigdl_recs_index(const uint8_t* buf, int64_t size, int64_t n_max,
     if (!read_varint(&len)) return -1;
     if (pos + (int64_t)len > size) return -1;  // truncated payload
     if (n >= n_max) return -2;
-    labels[n] = (int32_t)label;
+    // full varint width: the pure-Python reader yields the whole value,
+    // so a >=2^31 label must decode identically on both paths
+    labels[n] = (int64_t)label;
     offsets[n] = pos;
     lengths[n] = (int64_t)len;
     pos += (int64_t)len;

@@ -49,7 +49,7 @@ void bigdl_decode_cifar(const uint8_t* records, int32_t n,
 // One sequential scan — varint chains can't be split — but ~two orders of
 // magnitude faster than a Python byte loop on multi-GB shards.
 int64_t bigdl_recs_index(const uint8_t* buf, int64_t size, int64_t n_max,
-                         int32_t* labels, int64_t* offsets, int64_t* lengths);
+                         int64_t* labels, int64_t* offsets, int64_t* lengths);
 
 // ---- prefetch executor ----
 // A bounded ring of batch slots filled by the worker pool; Python pushes

@@ -112,16 +112,29 @@ class Optimizer:
         self.end_when = trigger
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger,
-                       backend: str = "pickle") -> "Optimizer":
+    def set_checkpoint(self, path: str = None, trigger: Trigger = None,
+                       backend: str = "pickle",
+                       # pyspark keyword names
+                       checkpoint_trigger: Trigger = None,
+                       checkpoint_path: str = None) -> "Optimizer":
         """``backend="pickle"`` writes the reference-style model/optimMethod
         snapshot pair; ``backend="orbax"`` writes an orbax PyTree checkpoint
         (tensor-store format, the TPU-ecosystem standard — SURVEY.md §5.4).
 
-        Accepts both reference argument orders: Scala ``(path, trigger)``
-        and pyspark ``(checkpoint_trigger, checkpoint_path)``."""
-        if isinstance(path, Trigger):          # pyspark order
+        Accepts both reference dialects: Scala ``(path, trigger)``, pyspark
+        positional ``(checkpoint_trigger, checkpoint_path)``, and the
+        pyspark keyword names ``checkpoint_trigger=``/``checkpoint_path=``
+        (same aliasing policy as ``set_validation``'s val_rdd/val_method)."""
+        if isinstance(path, Trigger):          # pyspark positional order
             path, trigger = trigger, path
+        # keyword overrides AFTER the swap: a positional Trigger mixed with
+        # checkpoint_path= (natural pyspark mix) keeps its trigger
+        if checkpoint_trigger is not None:
+            trigger = checkpoint_trigger
+        if checkpoint_path is not None:
+            path = checkpoint_path
+        if path is None or trigger is None:
+            raise ValueError("set_checkpoint needs both a path and a trigger")
         if backend not in ("pickle", "orbax"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.checkpoint_path = path
@@ -429,12 +442,25 @@ class Optimizer:
             params, opt_state, model_state, loss = step(
                 params, opt_state, model_state, rng, inp, tgt,
             )
-            try:
-                b = next(data_iter)      # overlaps device compute
-                next_ready = (*place_batch(b), b.size())
-            except StopIteration:
-                # finite custom iterators: end_when decides at the loop top
+            # prefetch overlaps device compute — but only when the loop
+            # will actually run again, so finite/shared iterators never
+            # lose a batch to a discarded prefetch. The speculative state
+            # mirrors the counter updates below; loss-triggered stops
+            # can't be predicted pre-sync and may still prefetch once.
+            spec = dict(state)
+            spec["neval"] += 1
+            spec["epoch_finished"] = seen_this_epoch + bsz >= epoch_size
+            if spec["epoch_finished"]:
+                spec["epoch"] += 1
+            if self.end_when.peek(spec):
                 next_ready = None
+            else:
+                try:
+                    b = next(data_iter)      # overlaps device compute
+                    next_ready = (*place_batch(b), b.size())
+                except StopIteration:
+                    # finite custom iterators: end_when decides at loop top
+                    next_ready = None
             loss_f = float(loss)
             dt = time.time() - t0
             self.metrics.add("computing time", dt)

@@ -12,17 +12,28 @@ from typing import Callable
 
 
 class Trigger:
-    def __init__(self, fn: Callable[[dict], bool]) -> None:
+    def __init__(self, fn: Callable[[dict], bool],
+                 peek_fn: Callable[[dict], bool] = None) -> None:
         self._fn = fn
+        self._peek = peek_fn or fn
 
     def __call__(self, state) -> bool:
         return self._fn(state)
 
+    def peek(self, state) -> bool:
+        """Side-effect-free evaluation: would the trigger fire on this
+        state? Stateful triggers (every_epoch) must NOT consume their
+        one-shot latch here — the optimizer peeks at a speculative
+        post-step state to decide whether to prefetch the next batch."""
+        return self._peek(state)
+
     def and_(self, other: "Trigger") -> "Trigger":
-        return Trigger(lambda s: self(s) and other(s))
+        return Trigger(lambda s: self(s) and other(s),
+                       lambda s: self.peek(s) and other.peek(s))
 
     def or_(self, other: "Trigger") -> "Trigger":
-        return Trigger(lambda s: self(s) or other(s))
+        return Trigger(lambda s: self(s) or other(s),
+                       lambda s: self.peek(s) or other.peek(s))
 
     # -- factories ---------------------------------------------------------
 
@@ -38,13 +49,16 @@ class Trigger:
     def every_epoch() -> "Trigger":
         holder = {"last": None}
 
+        def would_fire(s):
+            return s["epoch"] != holder["last"] and s.get("epoch_finished", False)
+
         def fn(s):
-            if s["epoch"] != holder["last"] and s.get("epoch_finished", False):
+            if would_fire(s):
                 holder["last"] = s["epoch"]
                 return True
             return False
 
-        return Trigger(fn)
+        return Trigger(fn, would_fire)
 
     @staticmethod
     def several_iteration(interval: int) -> "Trigger":

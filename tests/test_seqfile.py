@@ -1,5 +1,6 @@
 """SeqFile-style sharded ingestion (SURVEY.md §2.5 SeqFileFolder row)."""
 
+import pytest
 import numpy as np
 
 from tests.oracle import assert_close
@@ -94,3 +95,34 @@ def test_transformer_chain_and_training(tmp_path):
     trained = opt.optimize()
     ws, _ = trained.parameters()
     assert all(np.all(np.isfinite(np.asarray(w))) for w in ws)
+
+
+def test_recs_index_label_beyond_int32():
+    """Native indexer must decode varint labels >= 2^31 identically to the
+    pure-Python reader (round-1 advisor finding: the C side truncated to
+    int32)."""
+    import numpy as np
+
+    from bigdl_tpu import native
+
+    if not native.is_available():
+        pytest.skip("native library unavailable")
+
+    def varint(x):
+        out = bytearray()
+        while True:
+            b = x & 0x7F
+            x >>= 7
+            out.append(b | (0x80 if x else 0))
+            if not x:
+                return bytes(out)
+
+    big = 2 ** 33 + 5
+    buf = bytearray(b"RECS")
+    buf += varint(big) + varint(3) + b"abc"
+    buf += varint(7) + varint(1) + b"z"
+    labels, offsets, lengths = native.recs_index(
+        np.frombuffer(bytes(buf), np.uint8))
+    assert labels.dtype == np.int64
+    assert list(labels) == [big, 7]
+    assert list(lengths) == [3, 1]
