@@ -30,16 +30,6 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30  # finite sentinel: keeps exp() well-defined for masked rows
 
 
-def _maybe_when(pred):
-    """``pl.when`` that executes inline for a concrete ``True`` predicate —
-    the causal block-skip uses traced predicates, which the Pallas HLO
-    interpreter's vma checking rejects inside shard_map, so interpret mode
-    runs every block unconditionally (correctness comes from the mask)."""
-    if pred is True:
-        return lambda f: f()
-    return pl.when(pred)
-
-
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -47,17 +37,30 @@ def _auto_interpret() -> bool:
 # ---------------------------------------------------------------- forward
 
 
+def _dot_nt(a, b):
+    """a @ b.T without materializing the transpose: dot_general contracting
+    the trailing (lane) dims — the layout Mosaic feeds the MXU directly."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, kv_len, skip):
+                *, scale, causal, kv_len, kp_len, skip):
     """Grid (BH, n_q, n_k) — the KV axis is a GRID dimension, so only one
     (block_q, d) q tile and one (block_k, d) k/v tile are VMEM-resident per
     step (O(block²) VMEM at any T); the online-softmax state lives in
-    scratch that persists across the inner kv steps."""
+    scratch that persists across the inner kv steps.
+
+    Interior blocks skip ALL masking work (statically when the sequence is
+    unpadded and non-causal; via a separate unmasked pl.when branch for
+    causal blocks fully below the diagonal) — the iota/compare/select chain
+    on a block² tile otherwise rivals the softmax itself in VPU time."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
+    padded = kp_len != kv_len  # static: does any key block need a tail mask?
 
     @pl.when(kj == 0)
     def _init():
@@ -70,20 +73,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     if causal and skip:
         needed = kj * bk <= (qi + 1) * bq - 1
 
-    @_maybe_when(needed)
-    def _step():
-        # dots run on the INPUT dtype (bf16 stays on the fast MXU path)
-        # with f32 accumulation; softmax state is always f32
-        q = q_ref[0]                                    # (BQ, D)
-        k = k_ref[0]                                    # (BK, D)
-        v = v_ref[0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-        mask = cols < kv_len
-        if causal:
-            mask = jnp.logical_and(mask, cols <= rows)
-        s = jnp.where(mask, s, _NEG_INF)
+    def _accumulate(s):
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -91,7 +81,48 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+
+    def _scores():
+        # dots run on the INPUT dtype (bf16 stays on the fast MXU path)
+        # with f32 accumulation; softmax state is always f32
+        return _dot_nt(q_ref[0], k_ref[0]) * scale
+
+    def _masked_step():
+        s = _scores()
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        if padded:
+            mask = cols < kv_len
+            if causal:
+                mask = jnp.logical_and(mask, cols <= rows)
+        else:
+            mask = cols <= rows
+        _accumulate(jnp.where(mask, s, _NEG_INF))
+
+    if not skip:
+        # interpret mode: traced pl.when predicates are rejected inside
+        # shard_map — run one unconditional step (mask when anything at
+        # all needs masking)
+        if causal or padded:
+            _masked_step()
+        else:
+            _accumulate(_scores())
+    elif not causal and not padded:
+        _accumulate(_scores())
+    elif not causal:  # padded, non-causal: only the LAST key block is masked
+        pl.when(kj < n_k - 1)(lambda: _accumulate(_scores()))
+        pl.when(kj == n_k - 1)(_masked_step)
+    else:
+        # causal: full (entirely below-diagonal, untouched by padding)
+        # blocks take the unmasked path; diagonal/tail blocks pay the mask
+        full_below = (kj + 1) * bk - 1 <= qi * bq
+        if padded:
+            full_below = jnp.logical_and(full_below, kj < n_k - 1)
+        pl.when(full_below)(lambda: _accumulate(_scores()))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(full_below)))(
+            _masked_step)
 
     @pl.when(kj == n_k - 1)
     def _finish():
@@ -104,13 +135,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, kv_len, skip):
-    """Grid (BH, n_q, n_k): dq accumulates in scratch across kv steps."""
+               dq_scr, *, scale, causal, kv_len, kp_len, skip):
+    """Grid (BH, n_q, n_k): dq accumulates in scratch across kv steps.
+    Same masked/unmasked step split as the forward kernel."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
+    padded = kp_len != kv_len
 
     @pl.when(kj == 0)
     def _init():
@@ -120,26 +153,44 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     if causal and skip:
         needed = kj * bk <= (qi + 1) * bq - 1
 
-    @_maybe_when(needed)
-    def _step():
+    def _step(with_mask):
         q = q_ref[0]
         do = do_ref[0]                                  # (BQ, D)
         lse = lse_ref[0]                                # (BQ, 1)
         delta = delta_ref[0]                            # (BQ, 1)
         k = k_ref[0]
         v = v_ref[0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-        mask = cols < kv_len
-        if causal:
-            mask = jnp.logical_and(mask, cols <= rows)
-        s = jnp.where(mask, s, _NEG_INF)
+        s = _dot_nt(q, k) * scale
+        if with_mask:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+            if padded:
+                mask = cols < kv_len
+                if causal:
+                    mask = jnp.logical_and(mask, cols <= rows)
+            else:
+                mask = cols <= rows
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                            # (BQ, BK) f32
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dp = _dot_nt(do, v)
         ds = p * (dp - delta)
         dq_scr[...] = dq_scr[...] + jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    if not skip:
+        _step(causal or padded)
+    elif not causal and not padded:
+        _step(False)
+    elif not causal:
+        pl.when(kj < n_k - 1)(lambda: _step(False))
+        pl.when(kj == n_k - 1)(lambda: _step(True))
+    else:
+        full_below = (kj + 1) * bk - 1 <= qi * bq
+        if padded:
+            full_below = jnp.logical_and(full_below, kj < n_k - 1)
+        pl.when(full_below)(lambda: _step(False))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(full_below)))(
+            lambda: _step(True))
 
     @pl.when(kj == n_k - 1)
     def _finish():
@@ -166,26 +217,39 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal and skip:  # query blocks entirely above the diagonal contribute 0
         needed = (qj + 1) * bq - 1 >= ki * bk
 
-    @_maybe_when(needed)
-    def _step():
+    def _step(with_mask):
         k = k_ref[0]                                    # (BK, D)
         v = v_ref[0]
         q = q_ref[0]
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        rows = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-        if causal:
+        s = _dot_nt(q, k) * scale
+        if with_mask:
+            rows = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
             s = jnp.where(cols <= rows, s, _NEG_INF)
         p = jnp.exp(s - lse)
-        dv_scr[...] = dv_scr[...] + jnp.dot(
-            p.T.astype(do.dtype), do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = _dot_nt(do, v)
         ds = p * (dp - delta)
-        dk_scr[...] = dk_scr[...] + jnp.dot(
-            ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32)
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if not skip:
+        _step(causal)
+    elif not causal:
+        _step(False)
+    else:
+        # query block entirely BELOW the diagonal (all rows >= all cols):
+        # no causal mask needed
+        full_below = qj * bq >= (ki + 1) * bk - 1
+        pl.when(full_below)(lambda: _step(False))
+        pl.when(jnp.logical_and(needed, jnp.logical_not(full_below)))(
+            lambda: _step(True))
 
     @pl.when(qj == n_q - 1)
     def _finish():
@@ -232,7 +296,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
     kblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          kv_len=kv_len, skip=not interpret),
+                          kv_len=kv_len, kp_len=kp_len, skip=not interpret),
         grid=grid,
         in_specs=[qblk(d), kblk(d), kblk(d)],
         out_specs=[qblk(d), qblk(1)],
@@ -268,7 +332,8 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          kv_len=k3.shape[1], skip=not interpret),
+                          kv_len=k3.shape[1], kp_len=kp_len,
+                          skip=not interpret),
         grid=(bh, tp // block, kp_len // block),
         in_specs=[qblk(d), kblk(d), kblk(d), qblk(d), qblk(1), qblk(1)],
         out_specs=qblk(d),

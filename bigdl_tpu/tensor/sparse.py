@@ -83,6 +83,76 @@ class SparseTensor:
     def astype(self, dtype) -> "SparseTensor":
         return SparseTensor(self.indices, self.values.astype(dtype), self.shape)
 
+    # -- math (reference SparseTensorMath surface; all jit-safe: fixed
+    # capacity in, fixed capacity or dense out) ---------------------------
+
+    def t(self) -> "SparseTensor":
+        """2-D transpose: swap index rows (no data movement)."""
+        assert self.dim() == 2
+        import jax.numpy as jnp
+
+        return SparseTensor(jnp.flip(self.indices, axis=0), self.values,
+                            self.shape[::-1])
+
+    def mul(self, scalar) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * scalar, self.shape)
+
+    def div(self, scalar) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values / scalar, self.shape)
+
+    def sum(self, dim: Optional[int] = None):
+        """Scalar total, or reduce OVER the 1-based ``dim`` (same dim
+        semantics as the dense ``Tensor.sum``): the 2-D result is the
+        dense vector indexed by the OTHER axis."""
+        import jax
+        import jax.numpy as jnp
+
+        if dim is None:
+            return jnp.sum(self.values)
+        assert self.dim() == 2, "dim-reduction implemented for 2-D"
+        kept = 1 - (dim - 1)
+        return jax.ops.segment_sum(self.values, self.indices[kept],
+                                   num_segments=self.shape[kept])
+
+    def narrow(self, dim: int, start: int, length: int) -> "SparseTensor":
+        """1-based narrow along ``dim`` (reference mini-batch slicing).
+        Jit-safe: out-of-range slots are zeroed in place (capacity kept)."""
+        import jax.numpy as jnp
+
+        d = dim - 1
+        s0 = start - 1
+        keep = jnp.logical_and(self.indices[d] >= s0,
+                               self.indices[d] < s0 + length)
+        values = jnp.where(keep, self.values, 0)
+        idx = self.indices.at[d].add(jnp.where(keep, -s0, -self.indices[d]))
+        shape = list(self.shape)
+        shape[d] = length
+        return SparseTensor(idx, values, shape)
+
+    def cmul_dense(self, dense) -> "SparseTensor":
+        """Elementwise multiply by a dense tensor (stays sparse)."""
+        return SparseTensor(self.indices,
+                            self.values * dense[tuple(self.indices)],
+                            self.shape)
+
+    def vdot(self, dense) -> Any:
+        """⟨self, dense⟩ — sum of values times gathered dense entries."""
+        import jax.numpy as jnp
+
+        return jnp.sum(self.values * dense[tuple(self.indices)])
+
+    def mm(self, dense):
+        """``self (B, D) @ dense (D, O)`` → dense (B, O)."""
+        return sparse_dense_matmul(self, dense)
+
+    def mv(self, vec):
+        """``self (B, D) @ vec (D,)`` → dense (B,)."""
+        return sparse_dense_matmul(self, vec[:, None])[:, 0]
+
+    def add_to_dense(self, dense):
+        """``dense + self`` as a dense tensor (scatter-add)."""
+        return dense.at[tuple(self.indices)].add(self.values)
+
     def __repr__(self) -> str:
         return (f"SparseTensor(shape={self.shape}, capacity="
                 f"{int(self.values.shape[0])})")
@@ -101,6 +171,25 @@ def sparse_dense_matmul(sp: SparseTensor, dense):
     rows, cols = sp.indices[0], sp.indices[1]
     contrib = sp.values[:, None] * dense[cols]          # (cap, O)
     return jax.ops.segment_sum(contrib, rows, num_segments=sp.shape[0])
+
+
+def sparse_addmm(beta, c, alpha, sp: SparseTensor, dense):
+    """``beta * c + alpha * (sp @ dense)`` (reference
+    ``SparseTensorMath.addmm``)."""
+    return beta * c + alpha * sparse_dense_matmul(sp, dense)
+
+
+def sparse_addmv(beta, y, alpha, sp: SparseTensor, x):
+    """``beta * y + alpha * (sp @ x)`` (reference
+    ``SparseTensorMath.addmv``)."""
+    return beta * y + alpha * sp.mv(x)
+
+
+def dense_sparse_matmul(dense, sp: SparseTensor):
+    """``dense (N, B) @ sp (B, D)`` → dense (N, D) — via the transpose
+    identity ``(spᵀ @ denseᵀ)ᵀ`` so one segment-sum kernel serves both
+    orientations (reference SparseTensorBLAS dense×sparse path)."""
+    return sparse_dense_matmul(sp.t(), dense.T).T
 
 
 def sparse_join(tensors: Sequence[SparseTensor], dim: int = 2) -> SparseTensor:
