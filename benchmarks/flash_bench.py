@@ -76,18 +76,23 @@ def main():
                 return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
             return jax.grad(loss, argnums=(0, 1, 2))
 
-        row = [None, None, None, None]
-        try:
-            row[0] = bench(d_fwd, (q, k, v), iters)
-        except Exception:
-            pass
-        row[1] = bench(f_fwd, (q, k, v), iters)
-        try:
-            row[2] = bench(mk_loss(d_fwd), (q, k, v), max(3, iters // 3))
-        except Exception:
-            pass
-        row[3] = bench(mk_loss(f_fwd), (q, k, v), max(3, iters // 3))
-        fmt = lambda x: f"{x*1e3:9.2f}ms" if x is not None else "      OOM "
+        def run(fn, it):
+            """Dense may legitimately OOM at long T; anything else must be
+            visible, not silently folded into the OOM column."""
+            try:
+                return bench(fn, (q, k, v), it)
+            except Exception as e:
+                kind = type(e).__name__
+                msg = str(e)
+                if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower():
+                    return "OOM"
+                return kind[:9]
+
+        row = [run(d_fwd, iters), run(f_fwd, iters),
+               run(mk_loss(d_fwd), max(3, iters // 3)),
+               run(mk_loss(f_fwd), max(3, iters // 3))]
+        fmt = lambda x: (f"{x*1e3:9.2f}ms" if isinstance(x, float)
+                         else f"{x:>10} ")
         print(f"{t:>6} {'':>7} {fmt(row[0])} {fmt(row[1])} "
               f"{fmt(row[2])} {fmt(row[3])}", flush=True)
 

@@ -53,6 +53,17 @@ class DistriOptimizer(Optimizer):
                  parameter_mode: str = "partitioned",
                  compress: Optional[str] = None,
                  mesh=None, **kw) -> None:
+        # reference semantics: batchSize is GLOBAL. In a multi-process
+        # (pod) run each process's dataset shard batches 1/n_proc of it.
+        if batch_size is not None:
+            import jax
+
+            n_proc = jax.process_count()
+            if batch_size % max(n_proc, 1):
+                raise ValueError(
+                    f"global batch {batch_size} must divide the "
+                    f"{n_proc}-process topology")
+            batch_size //= max(n_proc, 1)
         super().__init__(model, dataset, criterion, batch_size, end_trigger, **kw)
         if parameter_mode not in ("partitioned", "allreduce"):
             raise ValueError(f"unknown parameter_mode {parameter_mode!r}")
@@ -264,22 +275,50 @@ class DistriOptimizer(Optimizer):
             step, dev_params, opt_state = self._build_allreduce_step(mesh, params)
 
         batch_sharding = NamedSharding(mesh, P("data"))
+        n_proc = jax.process_count()
 
         def place_batch(batch: MiniBatch):
-            def put(x):
-                if isinstance(x, (list, tuple)):
-                    return [jax.device_put(v, batch_sharding) for v in x]
+            def put1(x):
+                if n_proc > 1:
+                    # each process holds ITS rows of the global batch —
+                    # assemble the global array from process-local shards
+                    # (the pod analog of the reference's per-executor
+                    # partition feed)
+                    return jax.make_array_from_process_local_data(
+                        batch_sharding, np.asarray(x))
                 return jax.device_put(x, batch_sharding)
 
+            def put(x):
+                if isinstance(x, (list, tuple)):
+                    return [put1(v) for v in x]
+                return put1(x)
+
             inp, tgt = batch.get_input(), batch.get_target()
-            if batch.size() % self._n_devices != 0:
+            if (batch.size() * n_proc) % self._n_devices != 0:
                 raise ValueError(
-                    f"global batch {batch.size()} must divide the "
+                    f"global batch {batch.size() * n_proc} must divide the "
                     f"{self._n_devices}-chip data axis"
                 )
             return put(inp), put(tgt)
 
         return step, place_batch, dev_params, opt_state, model_state
+
+    def _run_validation(self, params, model_state, state):
+        """Pod runs: validation batches are process-local and per-process
+        DIFFERENT, so they cannot feed the global-mesh eval step — gather
+        params to host ONCE and let each process score its own shard with
+        the local eval step; the per-method results merge globally in the
+        base loop (ValidationResult.merge_across_processes)."""
+        import jax
+
+        if jax.process_count() > 1:
+            params = self._ckpt_params_to_host(params)
+            self._mh_eval = True
+            try:
+                return super()._run_validation(params, model_state, state)
+            finally:
+                self._mh_eval = False
+        return super()._run_validation(params, model_state, state)
 
     def _eval_forward(self, params, model_state, inp):
         """Sharded in-training validation: batch split over the ``data``
@@ -289,6 +328,9 @@ class DistriOptimizer(Optimizer):
         program (one all_gather over ICI), never on the host."""
         import jax
         from jax.sharding import PartitionSpec as P
+
+        if getattr(self, "_mh_eval", False):
+            return Optimizer._eval_forward(self, params, model_state, inp)
 
         from bigdl_tpu.optim.evaluator import (
             make_sharded_eval_step, pad_shard_call,

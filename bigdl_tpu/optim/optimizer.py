@@ -338,6 +338,9 @@ class Optimizer:
         for m, r in zip(self.validation_methods, totals):
             if r is None:
                 continue
+            # pod runs: every process scored its own validation shard;
+            # merge to the GLOBAL result (reference driver-side reduce)
+            r = r.merge_across_processes()
             val, _ = r.result()
             logger.info("validation [%s] epoch %d iter %d: %s",
                         m.name, state["epoch"], state["neval"], r)

@@ -14,14 +14,33 @@ import numpy as np
 
 
 class ValidationResult:
+    #: numeric accumulator fields, in constructor order — the generic
+    #: cross-process merge (pod validation) sums them over all processes
+    _fields = ()
+
     def result(self):
         raise NotImplementedError
 
     def __add__(self, other):
         raise NotImplementedError
 
+    def merge_across_processes(self) -> "ValidationResult":
+        """Sum this result's accumulators over every JAX process (the
+        executor→driver reduce of reference ``ValidationResult.merge``,
+        as one small all-gather). No-op in single-process runs."""
+        import jax
+
+        if jax.process_count() == 1 or not self._fields:
+            return self
+        from jax.experimental import multihost_utils
+
+        states = multihost_utils.process_allgather(
+            np.asarray([getattr(self, f) for f in self._fields], np.float64))
+        return type(self)(*np.sum(states, axis=0).tolist())
+
 
 class AccuracyResult(ValidationResult):
+    _fields = ("correct", "count")
     def __init__(self, correct: int, count: int) -> None:
         self.correct = int(correct)
         self.count = int(count)
@@ -39,6 +58,8 @@ class AccuracyResult(ValidationResult):
 
 
 class LossResult(ValidationResult):
+    _fields = ("loss", "count")
+
     def __init__(self, loss: float, count: int) -> None:
         self.loss = float(loss)
         self.count = int(count)

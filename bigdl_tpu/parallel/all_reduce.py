@@ -92,7 +92,13 @@ class AllReduceParameter:
         return flat.reshape(self.n, self.shard_size)
 
     def to_full(self, shards) -> Any:
-        """Host: stacked shards → params pytree."""
+        """Host: stacked shards → params pytree. In a multi-process run the
+        stacked array spans non-addressable devices — gather every
+        process's shards first (the pod analog of getWeights to driver)."""
+        if getattr(shards, "is_fully_addressable", True) is False:
+            from jax.experimental import multihost_utils
+
+            shards = multihost_utils.process_allgather(shards, tiled=True)
         flat = np.asarray(shards).reshape(-1)[: self.total_size]
         return self._unravel(flat)
 

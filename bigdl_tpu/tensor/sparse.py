@@ -110,6 +110,8 @@ class SparseTensor:
         if dim is None:
             return jnp.sum(self.values)
         assert self.dim() == 2, "dim-reduction implemented for 2-D"
+        if dim not in (1, 2):
+            raise ValueError(f"invalid 1-based dim {dim} for 2-D sparse")
         kept = 1 - (dim - 1)
         return jax.ops.segment_sum(self.values, self.indices[kept],
                                    num_segments=self.shape[kept])
@@ -124,7 +126,10 @@ class SparseTensor:
         keep = jnp.logical_and(self.indices[d] >= s0,
                                self.indices[d] < s0 + length)
         values = jnp.where(keep, self.values, 0)
-        idx = self.indices.at[d].add(jnp.where(keep, -s0, -self.indices[d]))
+        # dropped slots reset to index 0 on EVERY dim (the module's padding
+        # invariant), live slots shift by the narrow offset on dim d only
+        idx = jnp.where(keep[None, :], self.indices, 0)
+        idx = idx.at[d].add(jnp.where(keep, -s0, 0))
         shape = list(self.shape)
         shape[d] = length
         return SparseTensor(idx, values, shape)

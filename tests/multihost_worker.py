@@ -1,0 +1,89 @@
+"""Worker for the 2-process multi-host integration test (the closest
+in-env analog of a pod: two JAX processes, 4 virtual CPU devices each,
+one global 8-device data mesh over a localhost coordinator).
+
+Usage: python tests/multihost_worker.py <process_id> <coord_port> <out_dir>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    out_dir = sys.argv[3]
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import numpy as np
+
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init_distributed(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2, process_id=pid,
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(17)
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("data",))
+
+    # identical GLOBAL data on both processes; DataSet.distributed takes
+    # this process's shard (reference RDD partitioning)
+    rs = np.random.RandomState(0)
+    samples = [
+        Sample(rs.rand(1, 28, 28).astype(np.float32), np.float32(i % 10 + 1))
+        for i in range(128)
+    ]
+    ds = DataSet.distributed(samples)
+
+    model = LeNet5(10)
+    opt = Optimizer(
+        model=model, dataset=ds, criterion=ClassNLLCriterion(),
+        batch_size=32, end_trigger=Trigger.max_iteration(3),
+        parameter_mode="partitioned", mesh=mesh,
+    )
+    opt.set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
+
+    # pod validation: each process holds HALF the 100-sample val set; the
+    # logged result must be the MERGED global count (driver-side reduce)
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout, force=True)
+    from bigdl_tpu.optim import Top1Accuracy
+
+    val = [Sample(rs.rand(1, 28, 28).astype(np.float32),
+                  np.float32(i % 10 + 1)) for i in range(100)]
+    opt.set_validation(Trigger.several_iteration(3),
+                       DataSet.distributed(val), [Top1Accuracy()],
+                       batch_size=32)
+    trained = opt.optimize()
+
+    ws, _ = trained.parameters()
+    flat = np.concatenate([np.asarray(w).reshape(-1) for w in ws])
+    np.save(os.path.join(out_dir, f"params_{pid}.npy"), flat)
+    print(f"worker {pid}: OK, {flat.size} params, "
+          f"norm {np.linalg.norm(flat):.6f}")
+
+
+if __name__ == "__main__":
+    main()

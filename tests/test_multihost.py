@@ -1,0 +1,58 @@
+"""2-process jax.distributed pod analog (round-1 verdict next-step #10;
+reference anchor: Engine.init topology validation + SURVEY §5.8 multi-slice
+note). Spawns two worker processes with 4 virtual CPU devices each, a
+localhost coordinator, and one global 8-device data mesh; each runs the
+full DistriOptimizer partitioned path on its OWN data shard and must end
+with bit-identical parameters."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.integration
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distri_optimizer(tmp_path):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        # pod validation merged globally: 2 x 50-sample shards -> count=100
+        assert "count=100" in out, f"worker {pid} output:\n{out[-3000:]}"
+
+    p0 = np.load(tmp_path / "params_0.npy")
+    p1 = np.load(tmp_path / "params_1.npy")
+    assert p0.shape == p1.shape and p0.size > 10_000
+    np.testing.assert_array_equal(p0, p1)
+    # and training actually moved the params (not a frozen no-op)
+    assert float(np.abs(p0).sum()) > 0
