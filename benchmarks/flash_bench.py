@@ -76,21 +76,24 @@ def main():
                 return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
             return jax.grad(loss, argnums=(0, 1, 2))
 
-        def run(fn, it):
-            """Dense may legitimately OOM at long T; anything else must be
-            visible, not silently folded into the OOM column."""
+        def run(fn, it, guard):
+            """Dense may legitimately OOM at long T (guard=True shows OOM /
+            the error name); flash failures must CRASH the benchmark —
+            masking a kernel regression as a table cell would fake the
+            'flash wins, dense OOMs' headline."""
+            if not guard:
+                return bench(fn, (q, k, v), it)
             try:
                 return bench(fn, (q, k, v), it)
             except Exception as e:
-                kind = type(e).__name__
                 msg = str(e)
                 if "RESOURCE_EXHAUSTED" in msg or "memory" in msg.lower():
                     return "OOM"
-                return kind[:9]
+                return type(e).__name__[:9]
 
-        row = [run(d_fwd, iters), run(f_fwd, iters),
-               run(mk_loss(d_fwd), max(3, iters // 3)),
-               run(mk_loss(f_fwd), max(3, iters // 3))]
+        row = [run(d_fwd, iters, True), run(f_fwd, iters, False),
+               run(mk_loss(d_fwd), max(3, iters // 3), True),
+               run(mk_loss(f_fwd), max(3, iters // 3), False)]
         fmt = lambda x: (f"{x*1e3:9.2f}ms" if isinstance(x, float)
                          else f"{x:>10} ")
         print(f"{t:>6} {'':>7} {fmt(row[0])} {fmt(row[1])} "

@@ -303,6 +303,22 @@ class DistriOptimizer(Optimizer):
 
         return step, place_batch, dev_params, opt_state, model_state
 
+    def set_validation(self, trigger, dataset=None, methods=None,
+                       batch_size=None, **kw):
+        """Same GLOBAL batch-size semantics as training: in a pod each
+        process evaluates 1/n_proc-sized local batches of it."""
+        import jax
+
+        n_proc = jax.process_count()
+        if batch_size is not None and n_proc > 1:
+            if batch_size % n_proc:
+                raise ValueError(
+                    f"global validation batch {batch_size} must divide the "
+                    f"{n_proc}-process topology")
+            batch_size //= n_proc
+        return super().set_validation(trigger, dataset, methods,
+                                      batch_size, **kw)
+
     def _run_validation(self, params, model_state, state):
         """Pod runs: validation batches are process-local and per-process
         DIFFERENT, so they cannot feed the global-mesh eval step — gather

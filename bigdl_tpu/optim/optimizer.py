@@ -334,14 +334,24 @@ class Optimizer:
             for i, m in enumerate(self.validation_methods):
                 r = m.apply(out, tgt)
                 totals[i] = r if totals[i] is None else totals[i] + r
+        import jax as _jax
+
+        multi = _jax.process_count() > 1
         score = None
         for m, r in zip(self.validation_methods, totals):
             if r is None:
-                continue
+                if not multi:
+                    continue
+                # the merge below is a COLLECTIVE: a process whose shard
+                # yielded no batches must still participate or the pod
+                # deadlocks — contribute a zero accumulator
+                r = m.empty_result()
             # pod runs: every process scored its own validation shard;
             # merge to the GLOBAL result (reference driver-side reduce)
             r = r.merge_across_processes()
-            val, _ = r.result()
+            val, n_scored = r.result()
+            if multi and n_scored == 0:
+                continue  # no process had data for this method
             logger.info("validation [%s] epoch %d iter %d: %s",
                         m.name, state["epoch"], state["neval"], r)
             if self.validation_summary is not None:

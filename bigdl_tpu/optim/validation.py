@@ -78,6 +78,16 @@ class LossResult(ValidationResult):
 
 class ValidationMethod:
     name = "ValidationMethod"
+    #: result type with a (0, 0) zero accumulator — pod validation needs an
+    #: empty result from processes whose shard produced no batches, so the
+    #: cross-process merge collective runs on EVERY process (no deadlock)
+    _result_cls = None
+
+    def empty_result(self) -> ValidationResult:
+        if self._result_cls is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} needs _result_cls for pod merges")
+        return self._result_cls(0, 0)
 
     def apply(self, output, target) -> ValidationResult:
         raise NotImplementedError
@@ -90,6 +100,7 @@ class ValidationMethod:
 
 class Top1Accuracy(ValidationMethod):
     name = "Top1Accuracy"
+    _result_cls = AccuracyResult
 
     def apply(self, output, target) -> AccuracyResult:
         out = np.asarray(output)
@@ -102,6 +113,7 @@ class Top1Accuracy(ValidationMethod):
 
 class Top5Accuracy(ValidationMethod):
     name = "Top5Accuracy"
+    _result_cls = AccuracyResult
 
     def apply(self, output, target) -> AccuracyResult:
         out = np.asarray(output)
@@ -120,6 +132,8 @@ class TreeNNAccuracy(ValidationMethod):
     ``output``: (B, N, C) per-node class scores in children-before-parent
     node order; ``target``: (B, N) 1-based labels, 0 = padding. Root =
     the LAST labeled node of each tree."""
+
+    _result_cls = AccuracyResult
 
     def __init__(self, all_nodes: bool = False) -> None:
         self.all_nodes = all_nodes
@@ -154,6 +168,7 @@ class TreeNNAccuracy(ValidationMethod):
 
 
 class Loss(ValidationMethod):
+    _result_cls = LossResult
     name = "Loss"
 
     def __init__(self, criterion=None) -> None:
@@ -169,6 +184,7 @@ class Loss(ValidationMethod):
 
 
 class MAE(ValidationMethod):
+    _result_cls = LossResult
     name = "MAE"
 
     def apply(self, output, target) -> LossResult:

@@ -1437,7 +1437,50 @@ class Tensor:
 
         return Tensor(jnp.eye(n, m))
 
+    def logical_and(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.logical_and(self.data, _unwrap(other)))
+
+    def logical_or(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.logical_or(self.data, _unwrap(other)))
+
+    def logical_xor(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.logical_xor(self.data, _unwrap(other)))
+
+    def logical_not(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.logical_not(self.data))
+
+    def count_nonzero(self) -> int:
+        return int(np.count_nonzero(np.asarray(self.data)))
+
+    def mode(self, dim: int = 1) -> "Tensor":
+        """Most frequent value along 1-based ``dim`` (host-side; the
+        reference's mode is an eager reduction too)."""
+        import jax.numpy as jnp
+
+        d = _resolve_dim(dim, self.data.ndim)
+        host = np.asarray(self.data)
+
+        def mode1(v):
+            vals, counts = np.unique(v, return_counts=True)
+            return vals[np.argmax(counts)]
+
+        return Tensor(jnp.asarray(np.apply_along_axis(mode1, d, host)))
+
     # reference-name aliases
+    def repeat(self, *reps: int) -> "Tensor":
+        return self.repeat_tensor(*reps)
+
+    def clip(self, min_v, max_v) -> "Tensor":
+        return self.clamp(min_v, max_v)
+
     def outer(self, other) -> "Tensor":
         """Outer product of two vectors (non-accumulating, unlike ger)."""
         import jax.numpy as jnp

@@ -23,6 +23,14 @@ jax.config.update("jax_platforms", "cpu")
 # fp32 matmuls for oracle-parity tests
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# persistent compilation cache: most fast-loop wall time is XLA recompiles
+# of the same programs run-over-run (this box has ONE core) — warm runs
+# skip them (round-1 verdict weak #6: iteration-speed tax)
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.expanduser("~"), ".cache",
+                               "bigdl_tpu_test_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
+
 import numpy as np
 import pytest
 

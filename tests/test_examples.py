@@ -1,6 +1,7 @@
 """Example programs (SURVEY.md §2.8 example/* rows)."""
 
 import numpy as np
+import pytest
 
 
 def test_loadmodel_bigdl_roundtrip(tmp_path, rng):
@@ -73,6 +74,7 @@ def test_mlpipeline_example_learns():
     assert acc > 0.7  # separable blobs: must beat chance (1/3) by a margin
 
 
+@pytest.mark.integration
 def test_transformer_generation_example(capsys):
     from bigdl_tpu.examples.transformergeneration import main
 

@@ -1,6 +1,7 @@
 """int8 quantized path vs float originals (SURVEY.md §2.2 quantized row)."""
 
 import numpy as np
+import pytest
 
 
 def _rel_err(a, b):
@@ -71,6 +72,7 @@ def test_module_quantize_graph(rng):
     assert _rel_err(got, want) < 0.1
 
 
+@pytest.mark.integration
 def test_quantized_lenet_accuracy_preserved(rng):
     """End-to-end: quantized LeNet agrees with float LeNet on argmax for
     the overwhelming majority of inputs."""
@@ -101,6 +103,7 @@ def test_quantize_descends_into_wrappers(rng):
     assert _rel_err(got, want) < 0.1
 
 
+@pytest.mark.integration
 def test_quantize_vgg_smoke(rng):
     """Quantize a real zoo model (VGG-CIFAR); argmax agreement stays high."""
     from bigdl_tpu.models.vgg import VggForCifar10

@@ -67,6 +67,7 @@ def test_ulysses_attention_matches_dense(rng, causal):
     assert_close(out, want, atol=1e-4)
 
 
+@pytest.mark.integration
 def test_ring_attention_differentiable(rng):
     """The SP loss must differentiate cleanly (training path)."""
     import jax
@@ -148,6 +149,7 @@ def test_mha_trains(rng):
 
 
 @pytest.mark.parametrize("grad", [False, True])
+@pytest.mark.integration
 def test_ring_attention_flash_matches_dense(rng, grad):
     """Flash-block ring (lse merge fwd, flash-block bwd) vs dense oracle."""
     import jax
@@ -191,6 +193,7 @@ def test_ring_attention_flash_matches_dense(rng, grad):
         assert_close(np.asarray(a), np.asarray(b), atol=1e-3)
 
 
+@pytest.mark.integration
 def test_causal_flash_ring_matches_dense(rng):
     """Striped-causal flash ring (causal diagonal kernel + LSE-nulled future
     blocks) vs single-device dense causal attention — forward AND gradients."""

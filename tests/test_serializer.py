@@ -331,6 +331,7 @@ def test_legacy_pickle_graph_roundtrip(tmp_path):
     assert_close(before, after, atol=1e-6, rtol=1e-6)
 
 
+@pytest.mark.integration
 def test_resnet_roundtrip(tmp_path):
     """End-to-end: a real zoo Graph model round-trips bit-exact."""
     from bigdl_tpu.models.resnet import ResNet

@@ -222,7 +222,7 @@ def test_random_family_deterministic():
 def test_method_count_bar():
     """The round-1 verdict asked for >=220 facade methods."""
     methods = [m for m in dir(Tensor)
-               if not m.startswith("__") and callable(getattr(Tensor, m))]
+               if not m.startswith("_") and callable(getattr(Tensor, m))]
     assert len(methods) >= 215, len(methods)
 
 
@@ -231,3 +231,19 @@ def test_outer_non_accumulating():
     b = Tensor(np.array([3.0, 4.0, 5.0], np.float32))
     assert_close(a.outer(b).to_numpy(),
                  np.outer([1.0, 2.0], [3.0, 4.0, 5.0]))
+
+
+def test_logical_mode_countnonzero():
+    a = Tensor(np.array([1.0, 0.0, 2.0], np.float32))
+    b = Tensor(np.array([0.0, 0.0, 3.0], np.float32))
+    assert list(np.asarray(a.logical_and(b).data)) == [False, False, True]
+    assert list(np.asarray(a.logical_or(b).data)) == [True, False, True]
+    assert list(np.asarray(a.logical_xor(b).data)) == [True, False, False]
+    assert list(np.asarray(a.logical_not().data)) == [False, True, False]
+    assert a.count_nonzero() == 2
+    m = Tensor(np.array([[1.0, 2.0, 2.0], [3.0, 3.0, 4.0]], np.float32))
+    assert_close(m.mode(2).to_numpy(), np.array([2.0, 3.0], np.float32))
+    assert_close(a.clip(0.5, 1.5).to_numpy(),
+                 np.array([1.0, 0.5, 1.5], np.float32))
+    t = Tensor(np.arange(2.0, dtype=np.float32))
+    assert t.repeat(3).size() == (6,)

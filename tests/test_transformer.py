@@ -26,6 +26,7 @@ def test_transformer_lm_shapes_and_causality(rng):
     assert np.abs(out[:, -1] - out2[:, -1]).max() > 1e-6
 
 
+@pytest.mark.integration
 def test_transformer_remat_identical(rng):
     """Remat(block) computes EXACTLY what the bare block computes (forward
     and gradient) — verified by sharing one block's params across both."""
@@ -127,6 +128,7 @@ def test_transformer_serialization_roundtrip(rng, tmp_path):
     assert_close(np.asarray(m2.forward(ids)), want, atol=1e-6)
 
 
+@pytest.mark.integration
 def test_transformer_lm_remat_wiring(rng):
     """TransformerLM(remat=True): the Sequential/Remat key plumbing trains."""
     import jax
@@ -174,6 +176,7 @@ def test_kv_cached_decode_matches_full_forward(rng):
                      msg=f"position {t}")
 
 
+@pytest.mark.integration
 def test_kv_cached_decode_with_remat_blocks(rng):
     from bigdl_tpu.models.transformer import TransformerLM, make_decode_step
 

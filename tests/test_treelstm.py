@@ -93,6 +93,7 @@ def test_treenn_accuracy():
     assert abs(all_acc - 3 / 4) < 1e-9  # tree1 leaf (class 3) mispredicted
 
 
+@pytest.mark.integration
 def test_treelstm_sentiment_trains(rng):
     """End-to-end: sentiment of tiny synthetic trees becomes learnable."""
     import jax
