@@ -33,8 +33,15 @@ class MultiHeadAttention(TensorModule):
         super().__init__()
         if hidden_size % n_heads:
             raise ValueError(f"hidden {hidden_size} % heads {n_heads} != 0")
-        if sequence_parallel not in (None, "ring", "ulysses"):
+        if sequence_parallel not in (None, "ring", "striped_ring", "ulysses"):
             raise ValueError(f"unknown sequence_parallel {sequence_parallel!r}")
+        if sequence_parallel == "striped_ring" and not causal:
+            raise ValueError("striped_ring is a causal-only schedule — "
+                             "use 'ring' for bidirectional attention")
+        if sequence_parallel == "striped_ring" and use_flash == "never":
+            raise ValueError("striped_ring has no non-flash path — it IS "
+                             "a Pallas-kernel schedule; use 'ring' with "
+                             "use_flash='never'")
         if use_flash not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_flash {use_flash!r}")
         self.hidden_size = hidden_size
@@ -87,6 +94,17 @@ class MultiHeadAttention(TensorModule):
             # future blocks)
             out = ring_attention(q, k, v, self.sp_axis, causal=self.causal,
                                  use_flash=flash_ok)
+        elif self.sequence_parallel == "striped_ring":
+            # balanced causal schedule: the SEQUENCE MUST BE IN STRIPE
+            # LAYOUT (parallel.stripe_sequence on the global batch before
+            # sharding; unstripe after the model) — every ring step then
+            # does exactly half a block of useful work instead of a full
+            # block with half discarded
+            from bigdl_tpu.parallel.ring_attention import (
+                striped_ring_attention,
+            )
+
+            out = striped_ring_attention(q, k, v, self.sp_axis)
         elif self.sequence_parallel == "ulysses":
             out = ulysses_attention(q, k, v, self.sp_axis, causal=self.causal,
                                     use_flash=flash_ok)

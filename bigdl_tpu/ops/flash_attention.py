@@ -44,7 +44,8 @@ def _dot_nt(a, b):
                                preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_ref, k_ref, v_ref, off_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
                 *, scale, causal, kv_len, kp_len, skip):
     """Grid (BH, n_q, n_k) — the KV axis is a GRID dimension, so only one
     (block_q, d) q tile and one (block_k, d) k/v tile are VMEM-resident per
@@ -61,6 +62,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
     padded = kp_len != kv_len  # static: does any key block need a tail mask?
+    # diagonal offset: 0 = standard causal (col <= row), -1 = STRICT causal
+    # (col < row) — striped ring attention's future-originated blocks.
+    # full-block read, not [0, 0]: the HLO interpreter's vma check rejects
+    # a dynamic_slice of a device-varying operand with invariant indices
+    off = jnp.reshape(off_ref[...], ())
 
     @pl.when(kj == 0)
     def _init():
@@ -68,10 +74,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    # causal: key blocks entirely above the diagonal contribute nothing
+    # causal: key blocks entirely above the (offset) diagonal contribute
+    # nothing
     needed = True
     if causal and skip:
-        needed = kj * bk <= (qi + 1) * bq - 1
+        needed = kj * bk <= (qi + 1) * bq - 1 + off
 
     def _accumulate(s):
         m = m_scr[...]
@@ -96,9 +103,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         if padded:
             mask = cols < kv_len
             if causal:
-                mask = jnp.logical_and(mask, cols <= rows)
+                mask = jnp.logical_and(mask, cols <= rows + off)
         else:
-            mask = cols <= rows
+            mask = cols <= rows + off
         _accumulate(jnp.where(mask, s, _NEG_INF))
 
     if not skip:
@@ -117,7 +124,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     else:
         # causal: full (entirely below-diagonal, untouched by padding)
         # blocks take the unmasked path; diagonal/tail blocks pay the mask
-        full_below = (kj + 1) * bk - 1 <= qi * bq
+        full_below = (kj + 1) * bk - 1 <= qi * bq + off
         if padded:
             full_below = jnp.logical_and(full_below, kj < n_k - 1)
         pl.when(full_below)(lambda: _accumulate(_scores()))
@@ -134,8 +141,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 # --------------------------------------------------------------- backward
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, kv_len, kp_len, skip):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, off_ref,
+               dq_ref, dq_scr, *, scale, causal, kv_len, kp_len, skip):
     """Grid (BH, n_q, n_k): dq accumulates in scratch across kv steps.
     Same masked/unmasked step split as the forward kernel."""
     qi = pl.program_id(1)
@@ -144,6 +151,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
     padded = kp_len != kv_len
+    off = jnp.reshape(off_ref[...], ())
 
     @pl.when(kj == 0)
     def _init():
@@ -151,7 +159,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     needed = True
     if causal and skip:
-        needed = kj * bk <= (qi + 1) * bq - 1
+        needed = kj * bk <= (qi + 1) * bq - 1 + off
 
     def _step(with_mask):
         q = q_ref[0]
@@ -167,9 +175,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             if padded:
                 mask = cols < kv_len
                 if causal:
-                    mask = jnp.logical_and(mask, cols <= rows)
+                    mask = jnp.logical_and(mask, cols <= rows + off)
             else:
-                mask = cols <= rows
+                mask = cols <= rows + off
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                            # (BQ, BK) f32
         dp = _dot_nt(do, v)
@@ -185,7 +193,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         pl.when(kj < n_k - 1)(lambda: _step(False))
         pl.when(kj == n_k - 1)(lambda: _step(True))
     else:
-        full_below = (kj + 1) * bk - 1 <= qi * bq
+        full_below = (kj + 1) * bk - 1 <= qi * bq + off
         if padded:
             full_below = jnp.logical_and(full_below, kj < n_k - 1)
         pl.when(full_below)(lambda: _step(False))
@@ -197,7 +205,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, off_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, skip):
     """Grid (BH, n_k, n_q): dk/dv accumulate in scratch across query steps.
     Padded query rows are safe: q and delta are zero-padded so ds and do
@@ -207,6 +215,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     n_q = pl.num_programs(2)
     bk = k_ref.shape[1]
     bq = q_ref.shape[1]
+    off = jnp.reshape(off_ref[...], ())
 
     @pl.when(qj == 0)
     def _init():
@@ -215,7 +224,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     needed = True
     if causal and skip:  # query blocks entirely above the diagonal contribute 0
-        needed = (qj + 1) * bq - 1 >= ki * bk
+        needed = (qj + 1) * bq - 1 + off >= ki * bk
 
     def _step(with_mask):
         k = k_ref[0]                                    # (BK, D)
@@ -228,7 +237,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if with_mask:
             rows = qj * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
             cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
-            s = jnp.where(cols <= rows, s, _NEG_INF)
+            s = jnp.where(cols <= rows + off, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -246,7 +255,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         # query block entirely BELOW the diagonal (all rows >= all cols):
         # no causal mask needed
-        full_below = qj * bq >= (ki + 1) * bk - 1
+        full_below = qj * bq + off >= (ki + 1) * bk - 1
         pl.when(full_below)(lambda: _step(False))
         pl.when(jnp.logical_and(needed, jnp.logical_not(full_below)))(
             lambda: _step(True))
@@ -282,27 +291,38 @@ def _out_struct(shape, dtype, *refs):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
+def _off_arr(causal_offset):
+    """Diagonal-offset operand: (1, 1) int32, 0 unless given (a possibly
+    TRACED scalar — striped ring passes src-vs-rank dependent offsets)."""
+    if causal_offset is None:
+        return jnp.zeros((1, 1), jnp.int32)
+    return jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block, interpret,
+               causal_offset=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q3.shape
     tp = t + (-t) % block
     qp, kp, vp = (_pad_seq(x, block) for x in (q3, k3, v3))
+    off = _off_arr(causal_offset)
     kv_len = k3.shape[1]
     kp_len = kp.shape[1]
     # grid: kv axis INNERmost so the scratch softmax state carries across it
     grid = (bh, tp // block, kp_len // block)
     qblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
     kblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
+    oblk = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, kp_len=kp_len, skip=not interpret),
         grid=grid,
-        in_specs=[qblk(d), kblk(d), kblk(d)],
+        in_specs=[qblk(d), kblk(d), kblk(d), oblk],
         out_specs=[qblk(d), qblk(1)],
         out_shape=[
-            _out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
-            _out_struct((bh, tp, 1), jnp.float32, q3, k3, v3),
+            _out_struct((bh, tp, d), q3.dtype, q3, k3, v3, off),
+            _out_struct((bh, tp, 1), jnp.float32, q3, k3, v3, off),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, 1), jnp.float32),
@@ -312,37 +332,41 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret):
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(qp, kp, vp, off)
     return o[:, :t], lse[:, :t]
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret,
+               causal_offset=None):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)             # (BH, T, 1)
     qp, kp, vp, dop = (_pad_seq(x, block) for x in (q3, k3, v3, do3))
+    off = _off_arr(causal_offset)
     lsep = jnp.pad(lse, ((0, 0), (0, qp.shape[1] - t), (0, 0)))
     deltap = jnp.pad(delta, ((0, 0), (0, qp.shape[1] - t), (0, 0)))
     tp = qp.shape[1]
     kp_len = kp.shape[1]
     qblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
     kblk = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, j, 0))
+    oblk = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           kv_len=k3.shape[1], kp_len=kp_len,
                           skip=not interpret),
         grid=(bh, tp // block, kp_len // block),
-        in_specs=[qblk(d), kblk(d), kblk(d), qblk(d), qblk(1), qblk(1)],
+        in_specs=[qblk(d), kblk(d), kblk(d), qblk(d), qblk(1), qblk(1),
+                  oblk],
         out_specs=qblk(d),
-        out_shape=_out_struct((bh, tp, d), q3.dtype, q3, k3, v3),
+        out_shape=_out_struct((bh, tp, d), q3.dtype, q3, k3, v3, off),
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, off)
 
     # dk/dv: key axis is the carried (outer-block) dim, queries innermost
     kblk2 = lambda n: pl.BlockSpec((1, block, n), lambda b, i, j: (b, i, 0))
@@ -351,16 +375,17 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret):
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           skip=not interpret),
         grid=(bh, kp_len // block, tp // block),
-        in_specs=[qblk2(d), kblk2(d), kblk2(d), qblk2(d), qblk2(1), qblk2(1)],
+        in_specs=[qblk2(d), kblk2(d), kblk2(d), qblk2(d), qblk2(1), qblk2(1),
+                  oblk],
         out_specs=[kblk2(d), kblk2(d)],
-        out_shape=[_out_struct((bh, kp_len, d), k3.dtype, q3, k3, v3),
-                   _out_struct((bh, kp_len, d), v3.dtype, q3, k3, v3)],
+        out_shape=[_out_struct((bh, kp_len, d), k3.dtype, q3, k3, v3, off),
+                   _out_struct((bh, kp_len, d), v3.dtype, q3, k3, v3, off)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                         pltpu.VMEM((block, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, off)
     return dq[:, :t], dk[:, :k3.shape[1]], dv[:, :v3.shape[1]]
 
 
@@ -419,11 +444,15 @@ def _auto_block(t_max: int) -> int:
 def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
                              block: Optional[int] = None,
                              interpret: Optional[bool] = None,
-                             causal: bool = False):
+                             causal: bool = False,
+                             causal_offset=None):
     """Forward-only fused attention returning ``(out, lse)`` — the
     per-query log-sum-exp lets callers merge partial attention blocks with
     the online-softmax rule (ring attention's flash path; ``causal=True``
     for the diagonal block of a causal ring).
+    ``causal_offset`` shifts the diagonal: -1 = strict causal
+    (``col < row``), as striped ring attention needs for blocks from
+    later-ranked stripes; may be a traced scalar.
     ``out``: (B, T, H, D); ``lse``: (B, H, T) float32.
     """
     b, t, h, d = q.shape
@@ -432,7 +461,7 @@ def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
     q3, k3, v3, scale, interpret, from3, _ = _bthd_plumbing(
         q, k, v, scale, interpret)
     o3, lse = _flash_fwd(q3, k3, v3, scale, bool(causal), int(block),
-                         interpret)
+                         interpret, causal_offset=causal_offset)
     return from3(o3), lse[..., 0].reshape(b, h, t)
 
 
@@ -458,7 +487,8 @@ def flash_attention_block_grads(q, k, v, o, lse, do,
                                 scale: Optional[float] = None,
                                 block: Optional[int] = None,
                                 interpret: Optional[bool] = None,
-                                causal: bool = False):
+                                causal: bool = False,
+                                causal_offset=None):
     """Per-block backward against GLOBAL softmax statistics — the ring
     backward's building block.
 
@@ -477,7 +507,8 @@ def flash_attention_block_grads(q, k, v, o, lse, do,
     o3, do3 = to3(o), to3(do)
     lse3 = lse.reshape(b * h, tq, 1)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse3, do3, scale,
-                               bool(causal), int(block), interpret)
+                               bool(causal), int(block), interpret,
+                               causal_offset=causal_offset)
     dq = from3(dq3)
     dk = dk3.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
     dv = dv3.reshape(b, h, tk, d).transpose(0, 2, 1, 3)

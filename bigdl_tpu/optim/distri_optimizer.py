@@ -306,16 +306,32 @@ class DistriOptimizer(Optimizer):
     def set_validation(self, trigger, dataset=None, methods=None,
                        batch_size=None, **kw):
         """Same GLOBAL batch-size semantics as training: in a pod each
-        process evaluates 1/n_proc-sized local batches of it."""
+        process evaluates 1/n_proc-sized local batches of it. Handles both
+        the Scala order and the pyspark int-first order BEFORE dividing."""
         import jax
 
         n_proc = jax.process_count()
-        if batch_size is not None and n_proc > 1:
-            if batch_size % n_proc:
-                raise ValueError(
-                    f"global validation batch {batch_size} must divide the "
-                    f"{n_proc}-process topology")
-            batch_size //= n_proc
+        if n_proc > 1:
+            def divide(bs):
+                if bs % n_proc:
+                    raise ValueError(
+                        f"global validation batch {bs} must divide the "
+                        f"{n_proc}-process topology")
+                return bs // n_proc
+
+            if isinstance(trigger, int):      # pyspark positional order
+                trigger = divide(trigger)
+            elif batch_size is not None:
+                batch_size = divide(batch_size)
+            # the pod merge collective needs a zero accumulator from
+            # empty-shard processes — fail EARLY and on every process if a
+            # custom method can't provide one (a late failure on one
+            # process would hang the others in the all-gather)
+            for m in list(methods or []) + list(kw.get("val_method") or []):
+                if getattr(m, "_result_cls", None) is None:
+                    raise ValueError(
+                        f"{type(m).__name__} needs _result_cls set for pod "
+                        "validation (see ValidationMethod.empty_result)")
         return super().set_validation(trigger, dataset, methods,
                                       batch_size, **kw)
 

@@ -7,7 +7,8 @@ from bigdl_tpu.parallel.broadcast import ModelBroadcast
 from bigdl_tpu.parallel.moe import mlp_expert, moe_layer, top_k_gating
 from bigdl_tpu.parallel.pipeline import gpipe, microbatch, stack_stage_params
 from bigdl_tpu.parallel.ring_attention import (
-    attention, ring_attention, ulysses_attention,
+    attention, ring_attention, stripe_sequence, striped_ring_attention,
+    ulysses_attention, unstripe_sequence,
 )
 from bigdl_tpu.parallel.tensor_parallel import (
     column_parallel_linear, row_parallel_linear, tp_attention, tp_mlp,
@@ -15,7 +16,8 @@ from bigdl_tpu.parallel.tensor_parallel import (
 
 __all__ = [
     "AllReduceParameter", "flatten_params", "ModelBroadcast",
-    "attention", "ring_attention", "ulysses_attention",
+    "attention", "ring_attention", "stripe_sequence",
+    "striped_ring_attention", "ulysses_attention", "unstripe_sequence",
     "column_parallel_linear", "row_parallel_linear", "tp_mlp", "tp_attention",
     "gpipe", "microbatch", "stack_stage_params",
     "moe_layer", "top_k_gating", "mlp_expert",

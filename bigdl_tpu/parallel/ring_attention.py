@@ -71,11 +71,36 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     """
     if use_flash:
         sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        return _get_ring_flash()(q, k, v, axis_name, float(sc), bool(causal))
+        return _get_ring_flash()(q, k, v, axis_name, float(sc), bool(causal),
+                                 "contiguous")
     return _ring_einsum(q, k, v, axis_name, causal, scale)
 
 
-def _ring_flash_impl(q, k, v, axis_name: str, scale: float, causal: bool):
+def _ring_step_spec(schedule: str, causal: bool):
+    """Per-step block policy shared by BOTH flash-ring schedules; returns
+    ``spec(step, src, my) -> (causal_flag, causal_offset, keep_pred)``:
+
+    * ``contiguous``: step 0 is the diagonal block (causal kernel); later
+      rotations run non-causal and, in causal mode, blocks from this
+      chip's future are nulled via ``keep_pred`` (-inf LSE / zero grads).
+    * ``striped``: EVERY rotation runs the causal kernel — inclusive
+      diagonal for stripes from earlier ranks, strict (offset -1) for
+      later ones — so no block is computed then discarded.
+    """
+    if schedule == "striped":
+        def spec(step, src, my):
+            import jax.numpy as jnp
+
+            return True, jnp.where(src <= my, 0, -1), None
+    else:
+        def spec(step, src, my):
+            if step == 0:
+                return causal, None, None
+            return False, None, (src < my) if causal else None
+    return spec
+
+
+def _ring_flash_impl(q, k, v, axis_name: str, scale: float, spec):
     import jax.numpy as jnp
     from jax import lax
 
@@ -85,47 +110,41 @@ def _ring_flash_impl(q, k, v, axis_name: str, scale: float, causal: bool):
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # step 0 is always the DIAGONAL block (kv originated here): causal mode
-    # runs it through the causal kernel; every later rotation holds a block
-    # strictly from another rank, handled non-causally and nulled (via -inf
-    # LSE) when it originates in this chip's future
-    o0, lse0 = flash_attention_with_lse(q, k, v, scale, causal=causal)
-    m0 = lse0                                  # (B, H, T)
-    l0 = jnp.ones_like(lse0)                   # exp(lse0 - m0)
-    o_acc0 = o0.astype(jnp.float32)
-    kb0 = lax.ppermute(k, axis_name, perm)
-    vb0 = lax.ppermute(v, axis_name, perm)
-
     # the ring length is static — a Python unroll keeps exactly one pallas
-    # lowering shape per call site (a traced fori_loop mixing the causal and
-    # non-causal kernel variants trips jax's closed-call lowering cache)
-    kb, vb, m, l, o_acc = kb0, vb0, m0, l0, o_acc0
-    for step in range(1, n):
-        o_i, lse_i = flash_attention_with_lse(q, kb, vb, scale)
-        if causal:
-            src = (my - step) % n
-            lse_i = jnp.where(src < my, lse_i,
-                              jnp.full_like(lse_i, -jnp.inf))
-        m_new = jnp.maximum(m, lse_i)
-        corr = jnp.exp(m - m_new)          # rescale old accumulators
-        w = jnp.exp(lse_i - m_new)         # this block's weight
-        wq = w.transpose(0, 2, 1)[..., None]        # (B, T, H, 1)
-        cq = corr.transpose(0, 2, 1)[..., None]
-        o_acc = o_acc * cq + o_i.astype(jnp.float32) * wq
-        l = l * corr + w
-        m = m_new
+    # lowering shape per (causal-variant) call site (a traced fori_loop
+    # mixing kernel variants trips jax's closed-call lowering cache)
+    m = l = o_acc = None
+    kb, vb = k, v
+    for step in range(n):
+        src = (my - step) % n
+        causal_s, off, keep = spec(step, src, my)
+        o_i, lse_i = flash_attention_with_lse(q, kb, vb, scale,
+                                              causal=causal_s,
+                                              causal_offset=off)
+        if keep is not None:
+            lse_i = jnp.where(keep, lse_i, jnp.full_like(lse_i, -jnp.inf))
+        if step == 0:
+            m, l = lse_i, jnp.ones_like(lse_i)
+            o_acc = o_i.astype(jnp.float32)
+        else:
+            m_new = jnp.maximum(m, lse_i)
+            corr = jnp.exp(m - m_new)      # rescale old accumulators
+            w = jnp.exp(lse_i - m_new)     # this block's weight
+            o_acc = (o_acc * corr.transpose(0, 2, 1)[..., None]
+                     + o_i.astype(jnp.float32)
+                     * w.transpose(0, 2, 1)[..., None])
+            l = l * corr + w
+            m = m_new
         if step < n - 1:                   # last rotation would be dead
             kb = lax.ppermute(kb, axis_name, perm)
             vb = lax.ppermute(vb, axis_name, perm)
     l_safe = jnp.maximum(l, 1e-20)
-    lq = l_safe.transpose(0, 2, 1)[..., None]
-    out = (o_acc / lq).astype(q.dtype)
-    lse_global = m + jnp.log(l_safe)                # (B, H, T)
-    return out, lse_global
+    out = (o_acc / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l_safe)                 # lse_global (B, H, T)
 
 
 def _ring_flash_bwd_impl(q, k, v, o, lse, do, axis_name: str, scale: float,
-                         causal: bool):
+                         spec):
     """Flash-block ring backward: O(T_local) memory like the forward.
 
     dq accumulates locally; dk/dv accumulators TRAVEL with their K/V block
@@ -142,30 +161,30 @@ def _ring_flash_bwd_impl(q, k, v, o, lse, do, axis_name: str, scale: float,
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    dq, dk_acc, dv_acc = flash_attention_block_grads(
-        q, k, v, o, lse, do, scale, causal=causal)
-    dq = dq.astype(jnp.float32)
-    kb = lax.ppermute(k, axis_name, perm)
-    vb = lax.ppermute(v, axis_name, perm)
-    dk_acc = lax.ppermute(dk_acc.astype(jnp.float32), axis_name, perm)
-    dv_acc = lax.ppermute(dv_acc.astype(jnp.float32), axis_name, perm)
-
-    for step in range(1, n):
+    dq = dk_acc = dv_acc = None
+    kb, vb = k, v
+    for step in range(n):
         src = (my - step) % n
+        causal_s, off, keep = spec(step, src, my)
         dq_i, dk_i, dv_i = flash_attention_block_grads(
-            q, kb, vb, o, lse, do, scale, causal=False)
-        if causal:
-            # future blocks were EXCLUDED from the global softmax, so their
+            q, kb, vb, o, lse, do, scale, causal=causal_s,
+            causal_offset=off)
+        if keep is not None:
+            # excluded blocks never entered the global softmax, so their
             # p = exp(s − lse_global) is unbounded (can overflow to inf):
-            # null them with a NaN-safe select, never a multiply-by-zero
-            allowed = src < my
+            # null with a NaN-safe select, never a multiply-by-zero
             zero = jnp.zeros((), jnp.float32)
-            dq_i = jnp.where(allowed, dq_i, zero)
-            dk_i = jnp.where(allowed, dk_i, zero)
-            dv_i = jnp.where(allowed, dv_i, zero)
-        dq = dq + dq_i.astype(jnp.float32)
-        dk_acc = dk_acc + dk_i.astype(jnp.float32)
-        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+            dq_i = jnp.where(keep, dq_i, zero)
+            dk_i = jnp.where(keep, dk_i, zero)
+            dv_i = jnp.where(keep, dv_i, zero)
+        if step == 0:
+            dq = dq_i.astype(jnp.float32)
+            dk_acc = dk_i.astype(jnp.float32)
+            dv_acc = dv_i.astype(jnp.float32)
+        else:
+            dq = dq + dq_i.astype(jnp.float32)
+            dk_acc = dk_acc + dk_i.astype(jnp.float32)
+            dv_acc = dv_acc + dv_i.astype(jnp.float32)
         # the travelling dk/dv accumulators rotate every step (n total hops
         # bring them home); kb/vb are dead after the last compute
         if step < n - 1:
@@ -183,7 +202,9 @@ _RING_FLASH = None
 
 def _get_ring_flash():
     """Build the custom-vjp-wrapped flash ring lazily (keeps this module's
-    no-jax-at-import convention)."""
+    no-jax-at-import convention). One core serves both schedules; the
+    per-step policy is selected by the static ``schedule``/``causal``
+    nondiff args."""
     global _RING_FLASH
     if _RING_FLASH is not None:
         return _RING_FLASH
@@ -191,25 +212,63 @@ def _get_ring_flash():
 
     import jax
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-    def ring_flash(q, k, v, axis_name, scale, causal):
-        out, _ = _ring_flash_impl(q, k, v, axis_name, scale, causal)
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+    def ring_flash(q, k, v, axis_name, scale, causal, schedule):
+        out, _ = _ring_flash_impl(q, k, v, axis_name, scale,
+                                  _ring_step_spec(schedule, causal))
         return out
 
-    def fwd(q, k, v, axis_name, scale, causal):
-        out, lse = _ring_flash_impl(q, k, v, axis_name, scale, causal)
+    def fwd(q, k, v, axis_name, scale, causal, schedule):
+        out, lse = _ring_flash_impl(q, k, v, axis_name, scale,
+                                    _ring_step_spec(schedule, causal))
         return out, (q, k, v, out, lse)
 
-    def bwd(axis_name, scale, causal, res, ct):
+    def bwd(axis_name, scale, causal, schedule, res, ct):
         # flash-block ring backward against the saved global lse — O(T_loc)
         # memory like the forward (no (T, T) score recomputation)
         q, k, v, out, lse = res
         return _ring_flash_bwd_impl(q, k, v, out, lse, ct, axis_name, scale,
-                                    causal)
+                                    _ring_step_spec(schedule, causal))
 
     ring_flash.defvjp(fwd, bwd)
     _RING_FLASH = ring_flash
     return ring_flash
+
+
+def stripe_sequence(x, n: int):
+    """Global (B, T, ...) → striped layout: token t moves to stripe t % n,
+    local slot t // n, so a contiguous n-way shard over axis 1 gives rank r
+    the stripe {r, r+n, r+2n, ...} (Brandon et al., striped attention).
+    Requires T % n == 0."""
+    b, t = x.shape[0], x.shape[1]
+    assert t % n == 0, f"T {t} not divisible by {n} stripes"
+    rest = x.shape[2:]
+    return (x.reshape((b, t // n, n) + rest)
+            .swapaxes(1, 2)
+            .reshape((b, t) + rest))
+
+
+def unstripe_sequence(x, n: int):
+    """Inverse of :func:`stripe_sequence`."""
+    b, t = x.shape[0], x.shape[1]
+    rest = x.shape[2:]
+    return (x.reshape((b, n, t // n) + rest)
+            .swapaxes(1, 2)
+            .reshape((b, t) + rest))
+
+
+def striped_ring_attention(q, k, v, axis_name: str,
+                           scale: Optional[float] = None):
+    """CAUSAL ring attention over STRIPED sequence shards — the balanced
+    schedule the round-1 advisor asked for: every rotation computes a
+    diagonal-masked block (offset 0 for earlier-ranked stripes, -1 strict
+    for later-ranked ones), so ~half the block FLOPs of the contiguous
+    causal ring are simply never issued instead of being computed and
+    nulled. Shards must be in stripe layout (:func:`stripe_sequence` on
+    the global batch before sharding; :func:`unstripe_sequence` after).
+    Differentiable; flash kernels both directions."""
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _get_ring_flash()(q, k, v, axis_name, float(sc), True, "striped")
 
 
 def _ring_einsum(q, k, v, axis_name: str, causal: bool = False,
