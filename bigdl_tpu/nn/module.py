@@ -165,6 +165,92 @@ class AbstractModule:
         gs = jax.tree_util.tree_leaves(self.grad_params)
         return ws, gs
 
+    def get_weights(self) -> List[Any]:
+        """Weights as a list of numpy arrays (pyspark ``get_weights``).
+        Materializes weights only — no gradient buffers."""
+        import jax
+        import numpy as _np
+
+        self._materialize_params()
+        return [_np.asarray(w) for w in jax.tree_util.tree_leaves(self.params)]
+
+    def set_weights(self, weights) -> "AbstractModule":
+        """Assign weights from a list in ``get_weights`` order (pyspark
+        ``set_weights``)."""
+        import jax
+        import numpy as _np
+
+        self._materialize_params()
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        if len(weights) != len(leaves):
+            raise ValueError(
+                f"set_weights got {len(weights)} arrays for "
+                f"{len(leaves)} parameter leaves")
+        new = []
+        for old, w in zip(leaves, weights):
+            w = _np.asarray(w)
+            if tuple(w.shape) != tuple(old.shape):
+                raise ValueError(
+                    f"set_weights shape mismatch: {w.shape} vs {old.shape}")
+            new.append(w.astype(old.dtype))
+        self.params = jax.tree_util.tree_unflatten(treedef, new)
+        return self
+
+    # -- freezing (reference Graph.freeze/unfreeze: transfer learning) -----
+    # tri-state per module: None = inherit from parent, True/False explicit
+    # (an explicit False OVERRIDES a frozen ancestor, so the classic
+    # `model.freeze(); model.unfreeze("head")` flow trains the head)
+
+    def freeze(self, *names: str) -> "AbstractModule":
+        """Stop training this module (no names) or the named sub-modules:
+        their gradients are zeroed and their weights restored bit-identical
+        after every optimizer update. (Optimizer slots of frozen leaves
+        still step with zero gradients — e.g. momentum decays toward 0 —
+        only the WEIGHTS are guaranteed untouched.)"""
+        self._set_frozen(True, names)
+        return self
+
+    def unfreeze(self, *names: str) -> "AbstractModule":
+        """With names: explicitly unfreeze those sub-modules (overriding
+        frozen ancestors). Without names: clear EVERY freeze flag in the
+        whole tree."""
+        if not names:
+            def clear(mod):
+                mod._frozen = None
+                for sub in mod.sub_modules() or []:
+                    clear(sub)
+
+            clear(self)
+            return self
+        self._set_frozen(False, names)
+        return self
+
+    def _set_frozen(self, value, names) -> None:
+        if not names:
+            self._frozen = value
+            return
+        found = set()
+
+        def walk(mod):
+            if mod.name in names:
+                mod._frozen = value
+                found.add(mod.name)
+            for sub in mod.sub_modules() or []:
+                walk(sub)
+
+        walk(self)
+        missing = set(names) - found
+        if missing:
+            raise ValueError(f"freeze/unfreeze: no sub-module named "
+                             f"{sorted(missing)}")
+
+    def frozen_flag(self):
+        """None (inherit) / True / False — see freeze()."""
+        return getattr(self, "_frozen", None)
+
+    def is_frozen(self) -> bool:
+        return bool(getattr(self, "_frozen", None))
+
     def get_parameters(self):
         """One flattened (weight, grad) vector pair.
 

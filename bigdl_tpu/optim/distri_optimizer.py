@@ -39,8 +39,9 @@ import numpy as np
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.train_step import (
-    apply_module_regularizers, cast_floats, clip_by_global_norm, clip_by_value,
-    resolve_dtype, restore_dtypes,
+    apply_frozen, apply_module_regularizers, cast_floats, clip_by_global_norm,
+    clip_by_value, frozen_mask_tree, resolve_dtype, restore_dtypes,
+    zero_frozen_grads,
 )
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter
 
@@ -135,6 +136,19 @@ class DistriOptimizer(Optimizer):
         model, criterion, optim = self.model, self.criterion, self.optim_method
         from bigdl_tpu.optim.train_step import regularizer_loss
 
+        # frozen layers (Module.freeze) as a flat mask over the parameter
+        # shards, same layout/padding as init_shards
+        frozen_tree = frozen_mask_tree(model, params)
+        if frozen_tree is None:
+            frozen_flat = None
+        else:
+            mask_leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda p, f: np.full(np.shape(p), bool(f)),
+                params, frozen_tree))
+            flat = np.concatenate([m.ravel() for m in mask_leaves])
+            flat = np.pad(flat, (0, arp.padded_size - flat.size))
+            frozen_flat = jnp.asarray(flat.reshape(n, arp.shard_size))
+
         def spmd(shards, opt_state, model_state, rng, inputs, targets):
             my_shard = shards[0]  # (shard_size,) — this chip's partition
             # per-device slice of the stacked opt state (leading axis 1)
@@ -170,8 +184,14 @@ class DistriOptimizer(Optimizer):
                 loss = loss / loss_scale
                 gshard = gshard / loss_scale
             gshard = gshard / n  # sum of per-shard means -> global mean
+            if frozen_flat is not None:
+                # this device's slice of the flat frozen mask
+                fr = frozen_flat[lax.axis_index("data")]
+                gshard = jnp.where(fr, 0.0, gshard)
             gshard = self._clip_shard(gshard)
             new_shard, new_opt = optim.update(gshard, opt_local, my_shard)
+            if frozen_flat is not None:
+                new_shard = jnp.where(fr, my_shard, new_shard)
             new_opt = jax.tree_util.tree_map(lambda x: x[None], new_opt)
             loss = lax.pmean(loss, "data")
             new_ms = self._pmean_state(new_ms, "data")
@@ -209,6 +229,8 @@ class DistriOptimizer(Optimizer):
         model, criterion, optim = self.model, self.criterion, self.optim_method
         compute_dtype = resolve_dtype(self.compute_dtype)
         loss_scale = self.loss_scale
+        # hoisted once: the mask only depends on static module flags
+        frozen = frozen_mask_tree(model, params)
 
         def spmd(params, opt_state, model_state, rng, inputs, targets):
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
@@ -243,7 +265,11 @@ class DistriOptimizer(Optimizer):
                 grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
             grads = lax.pmean(grads, "data")
             grads = self._grad_hooks(grads, params)
+            if frozen is not None:
+                grads = zero_frozen_grads(frozen, grads)
             new_params, new_opt = optim.update(grads, opt_state, params)
+            if frozen is not None:
+                new_params = apply_frozen(frozen, new_params, params)
             loss = lax.pmean(loss, "data")
             new_ms = self._pmean_state(new_ms, "data")
             return new_params, new_opt, new_ms, loss

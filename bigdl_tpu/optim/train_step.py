@@ -74,6 +74,23 @@ def clip_by_value(grads, min_v: float, max_v: float):
     return jax.tree_util.tree_map(lambda g: jnp.clip(g, min_v, max_v), grads)
 
 
+def child_for_key(module, key):
+    """Resolve a params-dict key to the owning sub-module, or None when the
+    key is one of ``module``'s own parameter leaves. Container/Graph keys
+    are "{i}:{name}" — the ONE place that convention is parsed (the
+    regularizer and frozen-mask walks both route through here)."""
+    subs = module.sub_modules()
+    if not subs:
+        return None
+    try:
+        idx = int(str(key).split(":", 1)[0])
+    except (ValueError, IndexError):
+        return None
+    if idx < len(subs):
+        return subs[idx]
+    return None
+
+
 def apply_module_regularizers(model, params, grads):
     """Apply per-layer regularizers (reference: inside accGradParameters).
 
@@ -98,20 +115,63 @@ def apply_module_regularizers(model, params, grads):
             for key in getattr(module, keys_attr, default_keys):
                 if key in p:
                     out[key] = reg.grad_update(p[key], g[key])
-        subs = module.sub_modules()
-        if subs:
-            # container keys are "{i}:{name}" (containers) or graph keys
-            for key in p:
-                idx = None
-                try:
-                    idx = int(key.split(":", 1)[0])
-                except (ValueError, IndexError):
-                    pass
-                if idx is not None and idx < len(subs):
-                    out[key] = walk(subs[idx], p[key], g[key])
+        for key in p:
+            child = child_for_key(module, key)
+            if child is not None and isinstance(p[key], dict):
+                out[key] = walk(child, p[key], g[key])
         return out
 
     return walk(model, params, grads)
+
+
+def frozen_mask_tree(model, params):
+    """Pytree of python bools mirroring ``params``: True where the owning
+    module is frozen (``Module.freeze`` — reference transfer-learning
+    freeze). Tri-state inheritance: a module's explicit flag overrides the
+    inherited one, so ``model.freeze(); model.unfreeze("head")`` trains
+    the head. Returns None when nothing is frozen, so the hot path pays
+    zero cost."""
+    import jax
+
+    found = [False]
+
+    def mark(module, p, inherited):
+        flag = module.frozen_flag()
+        frozen = inherited if flag is None else flag
+        if not isinstance(p, dict):
+            found[0] = found[0] or frozen
+            return frozen
+        out = {}
+        for key, v in p.items():
+            child = child_for_key(module, key)
+            if child is not None and isinstance(v, dict):
+                out[key] = mark(child, v, frozen)
+            else:
+                if frozen and jax.tree_util.tree_leaves(v):
+                    found[0] = True
+                out[key] = jax.tree_util.tree_map(lambda _: frozen, v)
+        return out
+
+    mask = mark(model, params, False)
+    return mask if found[0] else None
+
+
+def apply_frozen(mask, new_params, old_params):
+    """Restore frozen leaves after the optimizer update — zeroed grads
+    alone would still let in-optimizer weight decay move them."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda f, newp, oldp: oldp if f else newp,
+        mask, new_params, old_params)
+
+
+def zero_frozen_grads(mask, grads):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda f, g: jnp.zeros_like(g) if f else g, mask, grads)
 
 
 def regularizer_loss(model, params):
@@ -195,6 +255,9 @@ def make_train_step(
             loss = loss / loss_scale
             grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
         grads = apply_module_regularizers(model, params, grads)
+        frozen = frozen_mask_tree(model, params)
+        if frozen is not None:
+            grads = zero_frozen_grads(frozen, grads)
         if grad_transform is not None:
             grads = grad_transform(grads)
         if grad_clip:
@@ -204,6 +267,8 @@ def make_train_step(
                 lo, hi = grad_clip["constant"]
                 grads = clip_by_value(grads, lo, hi)
         new_params, new_opt = optim_method.update(grads, opt_state, params)
+        if frozen is not None:
+            new_params = apply_frozen(frozen, new_params, params)
         return new_params, new_opt, new_ms, loss
 
     return step
