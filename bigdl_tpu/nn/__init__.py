@@ -63,6 +63,7 @@ from bigdl_tpu.nn.layers_extra import (
 from bigdl_tpu.nn.layers_more import (
     Pack, Tile, Reverse, InferReshape, BifurcateSplitTable, MixtureTable,
     MaskedSelect, DenseToSparse, SReLU, Maxout, TemporalMaxPooling,
+    TemporalAveragePooling, VolumetricZeroPadding,
     UpSampling1D, UpSampling3D, Cropping2D, Cropping3D,
     VolumetricFullConvolution, LocallyConnected1D, LocallyConnected2D,
     SpatialShareConvolution, SpatialSeparableConvolution,

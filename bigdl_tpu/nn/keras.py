@@ -971,3 +971,313 @@ class ELU(_IdentityShaped):
         from bigdl_tpu.nn.activations import ELU as Core
 
         return Core(self.alpha)
+
+
+# -- round-2 widening: 1D/3D pooling family, padding/upsampling, 3D conv ----
+# (reference keras1 API rows — BigDL's keras-1.2 layer set)
+
+
+class _Pooling1D(KerasLayer):
+    """(steps, dim) input; border_mode 'valid' | 'same'."""
+
+    def __init__(self, pool_length: int = 2, stride: Optional[int] = None,
+                 border_mode: str = "valid", input_shape=None) -> None:
+        super().__init__(input_shape)
+        if border_mode not in ("valid", "same"):
+            raise ValueError(
+                f"border_mode must be 'valid' or 'same', got {border_mode!r}")
+        self.pool_length = pool_length
+        self.stride = stride if stride is not None else pool_length
+        self.border_mode = border_mode
+
+    def _core_cls(self):
+        raise NotImplementedError
+
+    def build_core(self, input_shape):
+        return self._core_cls()(self.pool_length, self.stride,
+                                pad_mode=self.border_mode.upper())
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        if self.border_mode == "same":
+            return (-(-steps // self.stride), dim)
+        return ((steps - self.pool_length) // self.stride + 1, dim)
+
+
+class MaxPooling1D(_Pooling1D):
+    def _core_cls(self):
+        from bigdl_tpu.nn.layers_more import TemporalMaxPooling
+
+        return TemporalMaxPooling
+
+
+class AveragePooling1D(_Pooling1D):
+    def _core_cls(self):
+        from bigdl_tpu.nn.layers_more import TemporalAveragePooling
+
+        return TemporalAveragePooling
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    """(steps, dim) → (dim,)."""
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.misc import Max
+
+        return Max(1, n_input_dims=2)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[1],)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.misc import Mean
+
+        return Mean(1, n_input_dims=2)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[1],)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    """(C, H, W) → (C,) — one full-window max pool (input shape is known
+    at build, so the window IS the image, mirroring
+    GlobalAveragePooling2D's single-pass core)."""
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.containers import Sequential
+        from bigdl_tpu.nn.pooling import SpatialMaxPooling
+        from bigdl_tpu.nn.shape_ops import Reshape
+
+        c, h, w = input_shape
+        return (Sequential()
+                .add(SpatialMaxPooling(w, h))
+                .add(Reshape([c], batch_mode=True)))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class _Pooling3D(KerasLayer):
+    """(C, D, H, W) input; border_mode 'valid' only (reference keras1
+    Pooling3D contract)."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode: str = "valid", input_shape=None) -> None:
+        super().__init__(input_shape)
+        if border_mode != "valid":
+            raise ValueError(
+                "Pooling3D supports only border_mode='valid' (reference "
+                "keras1 contract)")
+        self.pool_size = tuple(pool_size)
+        self.strides = (tuple(strides) if strides is not None
+                        else self.pool_size)
+
+    def _core_cls(self):
+        raise NotImplementedError
+
+    def build_core(self, input_shape):
+        kt, kh, kw = self.pool_size
+        dt, dh, dw = self.strides
+        return self._core_cls()(kt, kw, kh, dt, dw, dh)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        (kt, kh, kw), (dt, dh, dw) = self.pool_size, self.strides
+        return (c, (d - kt) // dt + 1, (h - kh) // dh + 1,
+                (w - kw) // dw + 1)
+
+
+class MaxPooling3D(_Pooling3D):
+    def _core_cls(self):
+        from bigdl_tpu.nn.layers_extra import VolumetricMaxPooling
+
+        return VolumetricMaxPooling
+
+
+class AveragePooling3D(_Pooling3D):
+    def _core_cls(self):
+        from bigdl_tpu.nn.layers_extra import VolumetricAveragePooling
+
+        return VolumetricAveragePooling
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    """(C, D, H, W) → (C,)."""
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.containers import Sequential
+        from bigdl_tpu.nn.misc import Max
+
+        return (Sequential()
+                .add(Max(4, n_input_dims=4))
+                .add(Max(3, n_input_dims=3))
+                .add(Max(2, n_input_dims=2)))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.containers import Sequential
+        from bigdl_tpu.nn.misc import Mean
+
+        return (Sequential()
+                .add(Mean(4, n_input_dims=4))
+                .add(Mean(3, n_input_dims=3))
+                .add(Mean(2, n_input_dims=2)))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class ZeroPadding1D(KerasLayer):
+    """(steps, dim): pad ``padding`` zero timesteps on each side."""
+
+    def __init__(self, padding: int = 1, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.padding = padding
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.containers import Sequential
+        from bigdl_tpu.nn.shape_ops import Padding
+
+        return (Sequential()
+                .add(Padding(1, -self.padding, 2))
+                .add(Padding(1, self.padding, 2)))
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (steps + 2 * self.padding, dim)
+
+
+class ZeroPadding3D(KerasLayer):
+    """(C, D, H, W): symmetric zero padding on the three spatial dims."""
+
+    def __init__(self, padding=(1, 1, 1), input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.padding = tuple(padding)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import VolumetricZeroPadding
+
+        pt, ph, pw = self.padding
+        return VolumetricZeroPadding(pt, ph, pw)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        pt, ph, pw = self.padding
+        return (c, d + 2 * pt, h + 2 * ph, w + 2 * pw)
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length: int = 2, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.length = length
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import UpSampling1D as Core
+
+        return Core(self.length)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        return (steps * self.length, dim)
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.size = tuple(size)
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import UpSampling3D as Core
+
+        return Core(self.size)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        ft, fh, fw = self.size
+        return (c, d * ft, h * fh, w * fw)
+
+
+class SpatialDropout3D(_IdentityShaped):
+    def __init__(self, p: float = 0.5, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.p = p
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_more import SpatialDropout3D as Core
+
+        return Core(self.p)
+
+
+class Convolution3D(KerasLayer):
+    """(C, D, H, W) input; border_mode 'valid' only (reference keras1
+    Convolution3D contract)."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, subsample=(1, 1, 1),
+                 border_mode: str = "valid", activation=None,
+                 bias: bool = True, input_shape=None) -> None:
+        super().__init__(input_shape)
+        if border_mode != "valid":
+            raise ValueError(
+                "Convolution3D supports only border_mode='valid' "
+                "(reference keras1 contract)")
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.subsample = tuple(subsample)
+        self.activation = activation
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import VolumetricConvolution
+
+        kt, kh, kw = self.kernel
+        dt, dh, dw = self.subsample
+        return _maybe_activation(
+            VolumetricConvolution(
+                input_shape[0], self.nb_filter, kt, kw, kh, dt, dw, dh,
+                with_bias=self.bias),
+            self.activation)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        (kt, kh, kw), (dt, dh, dw) = self.kernel, self.subsample
+        return (self.nb_filter, (d - kt) // dt + 1, (h - kh) // dh + 1,
+                (w - kw) // dw + 1)
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed convolution, (C, H, W) input (reference keras1
+    Deconvolution2D over SpatialFullConvolution)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), activation=None, bias: bool = True,
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.subsample = tuple(subsample)
+        self.activation = activation
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.conv import SpatialFullConvolution
+
+        return _maybe_activation(
+            SpatialFullConvolution(
+                input_shape[0], self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0],
+                no_bias=not self.bias),
+            self.activation)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        sh, sw = self.subsample
+        return (self.nb_filter, (h - 1) * sh + self.nb_row,
+                (w - 1) * sw + self.nb_col)

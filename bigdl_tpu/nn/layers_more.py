@@ -268,12 +268,19 @@ class Maxout(TensorModule):
 
 class TemporalMaxPooling(TensorModule):
     """Max pooling over the time axis of ``(B, T, F)`` / ``(T, F)`` input
-    (reference ``nn/TemporalMaxPooling.scala``)."""
+    (reference ``nn/TemporalMaxPooling.scala``). ``pad_mode="SAME"`` is the
+    keras border_mode="same" extension (TF-style same padding)."""
 
-    def __init__(self, k_w: int, d_w: Optional[int] = None) -> None:
+    # class-level default: snapshots saved before pad_mode existed restore
+    # via __new__ + attribute dict and must keep loading (VALID behavior)
+    pad_mode = "VALID"
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None,
+                 pad_mode: str = "VALID") -> None:
         super().__init__()
         self.k_w = k_w
         self.d_w = d_w or k_w
+        self.pad_mode = pad_mode
 
     def apply(self, params, input, state=None, training=False, rng=None):
         import jax.lax as lax
@@ -285,8 +292,67 @@ class TemporalMaxPooling(TensorModule):
             x, -jnp.inf, lax.max,
             window_dimensions=(1, self.k_w, 1),
             window_strides=(1, self.d_w, 1),
-            padding="VALID",
+            padding=self.pad_mode,
         )
+        return (out[0] if squeeze else out), state
+
+
+class TemporalAveragePooling(TensorModule):
+    """Average pooling over the time axis of ``(B, T, F)`` / ``(T, F)``
+    input — the 1-D analog of ``SpatialAveragePooling`` (keras
+    AveragePooling1D's core). SAME mode EXCLUDES padding from the divisor
+    at clipped edge windows, matching Keras-1.2/TF semantics."""
+
+    pad_mode = "VALID"  # back-compat default for pre-pad_mode snapshots
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None,
+                 pad_mode: str = "VALID") -> None:
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+        self.pad_mode = pad_mode
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 2
+        x = input[None] if squeeze else input
+        sums = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, self.k_w, 1),
+            window_strides=(1, self.d_w, 1),
+            padding=self.pad_mode,
+        )
+        if self.pad_mode == "SAME":
+            counts = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add,
+                window_dimensions=(1, self.k_w, 1),
+                window_strides=(1, self.d_w, 1),
+                padding="SAME",
+            )
+            out = sums / counts
+        else:
+            out = sums / float(self.k_w)
+        return (out[0] if squeeze else out), state
+
+
+class VolumetricZeroPadding(TensorModule):
+    """Zero-pad the three spatial dims of (N, C, D, H, W) input
+    (reference ``nn/VolumetricZeroPadding? — keras ZeroPadding3D core``;
+    symmetric ``(pad_t, pad_h, pad_w)``)."""
+
+    def __init__(self, pad_t: int = 1, pad_h: int = 1, pad_w: int = 1) -> None:
+        super().__init__()
+        self.pads = (pad_t, pad_h, pad_w)
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        squeeze = input.ndim == 4
+        x = input[None] if squeeze else input
+        widths = [(0, 0), (0, 0)] + [(p, p) for p in self.pads]
+        out = jnp.pad(x, widths)
         return (out[0] if squeeze else out), state
 
 

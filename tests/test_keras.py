@@ -1,6 +1,7 @@
 """Keras-style API: shape inference + parity with hand-built core models
 (SURVEY.md §2.2 keras row)."""
 
+import pytest
 import numpy as np
 
 from tests.oracle import assert_close
@@ -371,3 +372,114 @@ def test_keras_batch2_serialization_roundtrip(rng, tmp_path):
     b2 = AbstractModule.load_module(pathb)
     b2.evaluate()
     assert_close(np.asarray(b2.forward(xb)), wantb, atol=1e-6)
+
+
+def test_keras_pooling_padding_family_round2(rng):
+    """Round-2 widening: 1D/3D pooling (+ global variants), ZeroPadding1D/3D,
+    UpSampling1D/3D, SpatialDropout3D, Convolution3D, Deconvolution2D —
+    shapes AND numerics against numpy oracles."""
+    from bigdl_tpu.nn import keras as K
+
+    x1 = rng.randn(2, 10, 4).astype(np.float32)          # (B, steps, dim)
+
+    mp = K.Sequential().add(K.MaxPooling1D(2, input_shape=(10, 4)))
+    out = np.asarray(mp.forward(x1))
+    assert out.shape == (2, 5, 4) and mp.get_output_shape() == (5, 4)
+    np.testing.assert_allclose(out, x1.reshape(2, 5, 2, 4).max(2), atol=1e-6)
+
+    ap = K.Sequential().add(K.AveragePooling1D(2, input_shape=(10, 4)))
+    np.testing.assert_allclose(np.asarray(ap.forward(x1)),
+                               x1.reshape(2, 5, 2, 4).mean(2), atol=1e-6)
+
+    mps = K.Sequential().add(K.MaxPooling1D(3, 2, border_mode="same",
+                                            input_shape=(10, 4)))
+    assert np.asarray(mps.forward(x1)).shape == (2, 5, 4)
+
+    gm = K.Sequential().add(K.GlobalMaxPooling1D(input_shape=(10, 4)))
+    np.testing.assert_allclose(np.asarray(gm.forward(x1)), x1.max(1),
+                               atol=1e-6)
+    ga = K.Sequential().add(K.GlobalAveragePooling1D(input_shape=(10, 4)))
+    np.testing.assert_allclose(np.asarray(ga.forward(x1)), x1.mean(1),
+                               atol=1e-6)
+
+    zp1 = K.Sequential().add(K.ZeroPadding1D(2, input_shape=(10, 4)))
+    out = np.asarray(zp1.forward(x1))
+    assert out.shape == (2, 14, 4)
+    np.testing.assert_allclose(out[:, 2:12], x1, atol=1e-6)
+    assert np.all(out[:, :2] == 0) and np.all(out[:, 12:] == 0)
+
+    up1 = K.Sequential().add(K.UpSampling1D(3, input_shape=(10, 4)))
+    np.testing.assert_allclose(np.asarray(up1.forward(x1)),
+                               np.repeat(x1, 3, axis=1), atol=1e-6)
+
+    x2 = rng.randn(2, 3, 6, 8).astype(np.float32)        # (B, C, H, W)
+    g2 = K.Sequential().add(K.GlobalMaxPooling2D(input_shape=(3, 6, 8)))
+    np.testing.assert_allclose(np.asarray(g2.forward(x2)), x2.max((2, 3)),
+                               atol=1e-6)
+
+    x3 = rng.randn(2, 3, 4, 6, 8).astype(np.float32)     # (B, C, D, H, W)
+    mp3 = K.Sequential().add(K.MaxPooling3D((2, 2, 2),
+                                            input_shape=(3, 4, 6, 8)))
+    out = np.asarray(mp3.forward(x3))
+    assert out.shape == (2, 3, 2, 3, 4)
+    np.testing.assert_allclose(
+        out, x3.reshape(2, 3, 2, 2, 3, 2, 4, 2).max((3, 5, 7)), atol=1e-6)
+    ap3 = K.Sequential().add(K.AveragePooling3D((2, 2, 2),
+                                                input_shape=(3, 4, 6, 8)))
+    np.testing.assert_allclose(
+        np.asarray(ap3.forward(x3)),
+        x3.reshape(2, 3, 2, 2, 3, 2, 4, 2).mean((3, 5, 7)), atol=1e-6)
+    gm3 = K.Sequential().add(K.GlobalMaxPooling3D(input_shape=(3, 4, 6, 8)))
+    np.testing.assert_allclose(np.asarray(gm3.forward(x3)),
+                               x3.max((2, 3, 4)), atol=1e-6)
+    ga3 = K.Sequential().add(
+        K.GlobalAveragePooling3D(input_shape=(3, 4, 6, 8)))
+    np.testing.assert_allclose(np.asarray(ga3.forward(x3)),
+                               x3.mean((2, 3, 4)), atol=1e-5)
+
+    zp3 = K.Sequential().add(K.ZeroPadding3D((1, 2, 1),
+                                             input_shape=(3, 4, 6, 8)))
+    out = np.asarray(zp3.forward(x3))
+    assert out.shape == (2, 3, 6, 10, 10)
+    np.testing.assert_allclose(out[:, :, 1:5, 2:8, 1:9], x3, atol=1e-6)
+
+    up3 = K.Sequential().add(K.UpSampling3D((2, 1, 2),
+                                            input_shape=(3, 4, 6, 8)))
+    assert np.asarray(up3.forward(x3)).shape == (2, 3, 8, 6, 16)
+
+    sd3 = K.Sequential().add(K.SpatialDropout3D(0.5, input_shape=(3, 4, 6, 8)))
+    sd3.evaluate()                      # inference: identity
+    np.testing.assert_allclose(np.asarray(sd3.forward(x3)), x3, atol=1e-6)
+
+    c3 = K.Sequential().add(K.Convolution3D(5, 2, 3, 3, activation="relu",
+                                            input_shape=(3, 4, 6, 8)))
+    out = np.asarray(c3.forward(x3))
+    assert out.shape == (2, 5, 3, 4, 6) and (out >= 0).all()
+    assert c3.get_output_shape() == (5, 3, 4, 6)
+
+    d2 = K.Sequential().add(K.Deconvolution2D(4, 3, 3, subsample=(2, 2),
+                                              input_shape=(3, 5, 5)))
+    out = np.asarray(d2.forward(x2[:, :, :5, :5]))
+    assert out.shape == (2, 4, 11, 11)
+    assert d2.get_output_shape() == (4, 11, 11)
+
+    with pytest.raises(ValueError, match="valid"):
+        K.MaxPooling3D(border_mode="same", input_shape=(3, 4, 6, 8))
+    with pytest.raises(ValueError, match="valid"):
+        K.Convolution3D(4, 2, 2, 2, border_mode="same")
+
+
+def test_average_pooling1d_same_excludes_padding(rng):
+    """SAME-mode edge windows divide by the ACTUAL element count
+    (Keras/TF semantics), not the full window size."""
+    from bigdl_tpu.nn import keras as K
+
+    x = np.arange(10, dtype=np.float32).reshape(1, 10, 1)
+    ap = K.Sequential().add(K.AveragePooling1D(3, 2, border_mode="same",
+                                               input_shape=(10, 1)))
+    out = np.asarray(ap.forward(x)).reshape(-1)
+    # windows (TF SAME, k=3 s=2): [0,1,2],[2,3,4],[4,5,6],[6,7,8],[8,9]
+    np.testing.assert_allclose(out, [1.0, 3.0, 5.0, 7.0, 8.5], atol=1e-6)
+
+    with pytest.raises(ValueError, match="border_mode"):
+        K.MaxPooling1D(2, border_mode="SAME")

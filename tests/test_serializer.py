@@ -208,6 +208,10 @@ FACTORIES = {
     "SReLU": (lambda: nn.SReLU((3,)), x(2, 3)),
     "Maxout": (lambda: nn.Maxout(4, 3, 2), x(2, 4)),
     "TemporalMaxPooling": (lambda: nn.TemporalMaxPooling(2), x(2, 6, 3)),
+    "TemporalAveragePooling": (lambda: nn.TemporalAveragePooling(2),
+                               x(2, 6, 3)),
+    "VolumetricZeroPadding": (lambda: nn.VolumetricZeroPadding(1, 1, 1),
+                              x(1, 2, 2, 3, 3)),
     "UpSampling1D": (lambda: nn.UpSampling1D(2), x(2, 4, 3)),
     "UpSampling3D": (lambda: nn.UpSampling3D((2, 2, 2)), x(1, 2, 2, 3, 3)),
     "Cropping2D": (lambda: nn.Cropping2D((1, 1), (1, 1)), x(2, 3, 5, 5)),
