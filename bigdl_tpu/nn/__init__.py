@@ -29,7 +29,8 @@ from bigdl_tpu.nn.misc import (
 )
 from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.recurrent import (
-    Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, BiRecurrent,
+    Cell, ConvLSTMPeephole, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent,
+    BiRecurrent,
     RecurrentDecoder, TimeDistributed, MultiRNNCell,
 )
 from bigdl_tpu.nn.criterion import (

@@ -325,8 +325,10 @@ class TemporalAveragePooling(TensorModule):
             padding=self.pad_mode,
         )
         if self.pad_mode == "SAME":
+            # counts depend only on the time axis — O(T), broadcast over
+            # batch/features in the division
             counts = lax.reduce_window(
-                jnp.ones_like(x), 0.0, lax.add,
+                jnp.ones((1, x.shape[1], 1), x.dtype), 0.0, lax.add,
                 window_dimensions=(1, self.k_w, 1),
                 window_strides=(1, self.d_w, 1),
                 padding="SAME",

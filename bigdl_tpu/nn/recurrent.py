@@ -57,6 +57,12 @@ class Cell(AbstractModule):
             for _ in range(self.carry_len)
         )
 
+    def init_carry_for(self, x):
+        """Zero carry shaped for sequence input ``x`` (B, T, ...). Default
+        delegates to :meth:`init_carry`; spatial cells (ConvLSTM) override
+        to size the state from x's spatial dims."""
+        return self.init_carry(x.shape[0])
+
     @property
     def input_dropout_p(self) -> float:
         """Dropout applied to the sequence INPUT by the driving Recurrent."""
@@ -100,7 +106,7 @@ class Cell(AbstractModule):
     def apply(self, params, input, state=None, training=False, rng=None):
         x_t, carry = input[0], tuple(input[1:])
         if not carry:
-            carry = self.init_carry(x_t.shape[0])
+            carry = self.init_carry_for(x_t)
         out, new_carry = self.step(params, x_t, carry)
         return [out, *new_carry], state
 
@@ -234,6 +240,119 @@ class LSTMPeephole(LSTM):
         return new_h, (new_h, new_c)
 
 
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM with per-channel peepholes over (B, C, H, W)
+    frames (reference ``nn/ConvLSTMPeephole.scala`` — the precipitation-
+    nowcasting ConvLSTM). Gates are SAME-padded convolutions of the input
+    frame and the hidden state; state (h, c) is (B, n_output, H, W).
+
+    Drive with ``Recurrent`` over (B, T, C, H, W) sequences; the input-leg
+    conv of ALL four gates is hoisted over the whole sequence as one
+    batched conv (the conv analog of the fused-gemm ``_FusedInputCell``)."""
+
+    carry_len = 2  # (h, c)
+
+    def __init__(self, input_size: int, output_size: int,
+                 kernel_i: int = 3, kernel_c: int = 3,
+                 stride: int = 1, p: float = 0.0,
+                 with_peephole: bool = True,
+                 w_regularizer=None, u_regularizer=None,
+                 b_regularizer=None) -> None:
+        super().__init__(output_size)
+        if stride != 1:
+            raise ValueError("ConvLSTMPeephole: state recurrence needs "
+                             "stride 1 (reference contract)")
+        self.input_size = input_size
+        self.output_size = output_size
+        self.kernel_i = kernel_i     # input-to-gate kernel
+        self.kernel_c = kernel_c     # hidden-to-gate kernel
+        self.p = p
+        self.with_peephole = with_peephole
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init_params(self, rng):
+        import jax
+
+        k = jax.random.split(rng, 3)
+        u = RandomUniform()
+        O, I = self.output_size, self.input_size
+        p = {
+            "w_ih": u.init(k[0], (4 * O, I, self.kernel_i, self.kernel_i)),
+            "w_hh": u.init(k[1], (4 * O, O, self.kernel_c, self.kernel_c)),
+            "b_ih": Zeros().init(k[2], (4 * O,)),
+        }
+        if self.with_peephole:
+            kp = jax.random.split(jax.random.fold_in(rng, 1), 3)
+            for name, key in zip(("w_pi", "w_pf", "w_po"), kp):
+                p[name] = u.init(key, (O, 1, 1))  # per-channel peephole
+        return p
+
+    def _conv(self, x, w, b=None):
+        import jax.lax as lax
+
+        out = lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    def init_carry_for(self, x):
+        import jax.numpy as jnp
+
+        spatial = x.shape[-2:]
+        return tuple(
+            jnp.zeros((x.shape[0], self.output_size) + spatial, jnp.float32)
+            for _ in range(self.carry_len))
+
+    def init_carry(self, batch_size: int):
+        raise ValueError(
+            "ConvLSTMPeephole state needs the frame's spatial dims — drive "
+            "it through Recurrent (which uses init_carry_for)")
+
+    def dropout_specs(self):
+        # variational masks are per-(batch, channel); broadcast over H, W
+        return [(self.p, self.output_size)]
+
+    def mask_carry(self, carry, h_masks):
+        m = h_masks[0]
+        if m is None:
+            return carry
+        return (carry[0] * m[:, :, None, None],) + tuple(carry[1:])
+
+    def precompute_input(self, params, x):
+        """(B, T, C, H, W): fold T into the batch for ONE gate conv."""
+        b, t = x.shape[:2]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        pre = self._conv(flat, params["w_ih"], params["b_ih"])
+        return pre.reshape((b, t) + pre.shape[1:])
+
+    def step_pre(self, params, pre_t, carry):
+        import jax
+        import jax.numpy as jnp
+
+        h, c = carry
+        gates = pre_t + self._conv(h, params["w_hh"])
+        i, f, g, o = jnp.split(gates, 4, axis=1)     # channel axis
+        if self.with_peephole:
+            i = i + params["w_pi"][None] * c
+            f = f + params["w_pf"][None] * c
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        new_c = f * c + i * g
+        if self.with_peephole:
+            o = o + params["w_po"][None] * new_c
+        o = jax.nn.sigmoid(o)
+        new_h = o * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    def step(self, params, x_t, carry):
+        return self.step_pre(
+            params, self._conv(x_t, params["w_ih"], params["b_ih"]), carry)
+
+
 class GRU(_FusedInputCell):
     """GRU cell (reference ``nn/GRU.scala``). Gate order r, z, n; separate
     input/hidden biases so the candidate gate matches torch:
@@ -340,7 +459,7 @@ class Recurrent(AbstractModule):
                 h_masks = masks
         pre = cell.precompute_input(cp, x)           # (B, T, ...)
         pre_t = jnp.swapaxes(pre, 0, 1)              # (T, B, ...)
-        carry0 = cell.init_carry(batch)
+        carry0 = cell.init_carry_for(x)
 
         stepf = cell.with_masks(h_masks) if h_masks is not None else cell.step_pre
 
@@ -451,8 +570,7 @@ class RecurrentDecoder(AbstractModule):
         import jax.numpy as jnp
 
         cell, cp = self.cell, params[self._key()]
-        batch = input.shape[0]
-        carry0 = cell.init_carry(batch)
+        carry0 = cell.init_carry_for(input)
 
         def body(loop_carry, _):
             x_t, carry = loop_carry
@@ -529,6 +647,14 @@ class MultiRNNCell(Cell):
         out = []
         for c in self.cells:
             out.extend(c.init_carry(batch_size))
+        return tuple(out)
+
+    def init_carry_for(self, x):
+        # spatial cells (ConvLSTM) size their state from x's spatial dims,
+        # which stride-1 stacks preserve layer to layer
+        out = []
+        for c in self.cells:
+            out.extend(c.init_carry_for(x))
         return tuple(out)
 
     @property

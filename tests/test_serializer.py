@@ -208,6 +208,9 @@ FACTORIES = {
     "SReLU": (lambda: nn.SReLU((3,)), x(2, 3)),
     "Maxout": (lambda: nn.Maxout(4, 3, 2), x(2, 4)),
     "TemporalMaxPooling": (lambda: nn.TemporalMaxPooling(2), x(2, 6, 3)),
+    "ConvLSTMPeephole": (
+        lambda: nn.Recurrent().add(nn.ConvLSTMPeephole(2, 3)),
+        x(1, 2, 2, 4, 4)),
     "TemporalAveragePooling": (lambda: nn.TemporalAveragePooling(2),
                                x(2, 6, 3)),
     "VolumetricZeroPadding": (lambda: nn.VolumetricZeroPadding(1, 1, 1),
