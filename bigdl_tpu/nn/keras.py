@@ -1281,3 +1281,35 @@ class Deconvolution2D(KerasLayer):
         sh, sw = self.subsample
         return (self.nb_filter, (h - 1) * sh + self.nb_row,
                 (w - 1) * sw + self.nb_col)
+
+
+class ConvLSTM2D(KerasLayer):
+    """Convolutional LSTM over (T, C, H, W) sequences (keras1 ConvLSTM2D
+    over the ConvLSTMPeephole core; square kernel, stride 1)."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 return_sequences: bool = False,
+                 with_peephole: bool = True, input_shape=None) -> None:
+        super().__init__(input_shape)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+        self.with_peephole = with_peephole
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.recurrent import ConvLSTMPeephole, Recurrent
+        from bigdl_tpu.nn.shape_ops import Select
+
+        t, c, h, w = input_shape
+        rec = Recurrent().add(ConvLSTMPeephole(
+            c, self.nb_filter, self.nb_kernel, self.nb_kernel,
+            with_peephole=self.with_peephole))
+        if self.return_sequences:
+            return rec
+        return _containers.Sequential().add(rec).add(Select(2, -1))
+
+    def compute_output_shape(self, input_shape):
+        t, c, h, w = input_shape
+        if self.return_sequences:
+            return (t, self.nb_filter, h, w)
+        return (self.nb_filter, h, w)
