@@ -315,14 +315,17 @@ class _KerasRecurrent(KerasLayer):
         self.output_dim = output_dim
         self.return_sequences = return_sequences
 
-    def _cell(self, input_size):
+    def _cell(self, input_shape):
+        """Build the recurrent cell from the FULL (unbatched) input shape —
+        vector cells use ``input_shape[-1]``, spatial cells (ConvLSTM2D)
+        the channel/spatial dims."""
         raise NotImplementedError
 
     def build_core(self, input_shape):
         from bigdl_tpu.nn.recurrent import Recurrent
         from bigdl_tpu.nn.shape_ops import Select
 
-        rec = Recurrent().add(self._cell(input_shape[-1]))
+        rec = Recurrent().add(self._cell(input_shape))
         if self.return_sequences:
             return rec
         return _containers.Sequential().add(rec).add(Select(2, -1))
@@ -334,17 +337,17 @@ class _KerasRecurrent(KerasLayer):
 
 
 class LSTM(_KerasRecurrent):
-    def _cell(self, input_size):
+    def _cell(self, input_shape):
         from bigdl_tpu.nn.recurrent import LSTM as CoreLSTM
 
-        return CoreLSTM(input_size, self.output_dim)
+        return CoreLSTM(input_shape[-1], self.output_dim)
 
 
 class GRU(_KerasRecurrent):
-    def _cell(self, input_size):
+    def _cell(self, input_shape):
         from bigdl_tpu.nn.recurrent import GRU as CoreGRU
 
-        return CoreGRU(input_size, self.output_dim)
+        return CoreGRU(input_shape[-1], self.output_dim)
 
 
 class ZeroPadding2D(KerasLayer):
@@ -569,10 +572,10 @@ class Model(KerasLayer):
 # ---------------------------------------------------------------------------
 
 class SimpleRNN(_KerasRecurrent):
-    def _cell(self, input_size):
+    def _cell(self, input_shape):
         from bigdl_tpu.nn.recurrent import RnnCell
 
-        return RnnCell(input_size, self.output_dim)
+        return RnnCell(input_shape[-1], self.output_dim)
 
 
 class Bidirectional(KerasLayer):
@@ -591,7 +594,7 @@ class Bidirectional(KerasLayer):
         from bigdl_tpu.nn.recurrent import BiRecurrent
 
         merge = "concat" if self.merge_mode == "concat" else "add"
-        return BiRecurrent(merge=merge).add(self.layer._cell(input_shape[-1]))
+        return BiRecurrent(merge=merge).add(self.layer._cell(input_shape))
 
     def compute_output_shape(self, input_shape):
         h = self.layer.output_dim
@@ -1283,30 +1286,30 @@ class Deconvolution2D(KerasLayer):
                 (w - 1) * sw + self.nb_col)
 
 
-class ConvLSTM2D(KerasLayer):
+class ConvLSTM2D(_KerasRecurrent):
     """Convolutional LSTM over (T, C, H, W) sequences (keras1 ConvLSTM2D
-    over the ConvLSTMPeephole core; square kernel, stride 1)."""
+    over the ConvLSTMPeephole core). Positional dialect matches the file's
+    Convolution2D convention: ``(nb_filter, nb_row, nb_col)``; the core is
+    square-kernel, so nb_row must equal nb_col."""
 
-    def __init__(self, nb_filter: int, nb_kernel: int,
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
                  return_sequences: bool = False,
                  with_peephole: bool = True, input_shape=None) -> None:
-        super().__init__(input_shape)
+        if nb_row != nb_col:
+            raise ValueError(
+                f"ConvLSTM2D kernel must be square, got {nb_row}x{nb_col}")
+        super().__init__(nb_filter, return_sequences, input_shape)
         self.nb_filter = nb_filter
-        self.nb_kernel = nb_kernel
-        self.return_sequences = return_sequences
+        self.nb_kernel = nb_row
         self.with_peephole = with_peephole
 
-    def build_core(self, input_shape):
-        from bigdl_tpu.nn.recurrent import ConvLSTMPeephole, Recurrent
-        from bigdl_tpu.nn.shape_ops import Select
+    def _cell(self, input_shape):
+        from bigdl_tpu.nn.recurrent import ConvLSTMPeephole
 
         t, c, h, w = input_shape
-        rec = Recurrent().add(ConvLSTMPeephole(
+        return ConvLSTMPeephole(
             c, self.nb_filter, self.nb_kernel, self.nb_kernel,
-            with_peephole=self.with_peephole))
-        if self.return_sequences:
-            return rec
-        return _containers.Sequential().add(rec).add(Select(2, -1))
+            with_peephole=self.with_peephole)
 
     def compute_output_shape(self, input_shape):
         t, c, h, w = input_shape

@@ -491,15 +491,21 @@ def test_keras_conv_lstm_2d(rng):
 
     x = rng.randn(2, 3, 2, 5, 6).astype(np.float32)
     RNG.set_seed(21)
-    m = K.Sequential().add(K.ConvLSTM2D(4, 3, return_sequences=True,
+    m = K.Sequential().add(K.ConvLSTM2D(4, 3, 3, return_sequences=True,
                                         input_shape=(3, 2, 5, 6)))
     out = np.asarray(m.forward(x))
     assert out.shape == (2, 3, 4, 5, 6)
     assert m.get_output_shape() == (3, 4, 5, 6)
 
-    last = K.Sequential().add(K.ConvLSTM2D(4, 3, input_shape=(3, 2, 5, 6)))
+    last = K.Sequential().add(K.ConvLSTM2D(4, 3, 3, input_shape=(3, 2, 5, 6)))
     last._ensure_params()
     last.set_weights(m.get_weights())  # identical params, any key tree
     out2 = np.asarray(last.forward(x))
     assert out2.shape == (2, 4, 5, 6)
     np.testing.assert_allclose(out2, out[:, -1], atol=1e-6)
+
+
+def test_conv_lstm_2d_rejects_rect_kernel():
+    from bigdl_tpu.nn import keras as K
+    with pytest.raises(ValueError, match="square"):
+        K.ConvLSTM2D(4, 3, 5)
