@@ -1316,3 +1316,87 @@ class ConvLSTM2D(_KerasRecurrent):
         if self.return_sequences:
             return (t, self.nb_filter, h, w)
         return (self.nb_filter, h, w)
+
+
+class AtrousConvolution2D(KerasLayer):
+    """Dilated 2-D convolution, CHW input (keras1 AtrousConvolution2D over
+    SpatialDilatedConvolution; border_mode 'valid' only, the keras1
+    contract)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), atrous_rate=(1, 1), activation=None,
+                 bias: bool = True, border_mode: str = "valid",
+                 input_shape=None) -> None:
+        super().__init__(input_shape)
+        if border_mode != "valid":
+            raise ValueError("AtrousConvolution2D supports only "
+                             "border_mode='valid' (keras1 contract)")
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.subsample = tuple(subsample)
+        self.atrous_rate = tuple(atrous_rate)
+        self.activation = activation
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import SpatialDilatedConvolution
+
+        return _maybe_activation(
+            SpatialDilatedConvolution(
+                input_shape[0], self.nb_filter, self.nb_col, self.nb_row,
+                self.subsample[1], self.subsample[0], 0, 0,
+                self.atrous_rate[1], self.atrous_rate[0],
+                with_bias=self.bias),
+            self.activation)
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        (sh, sw), (rh, rw) = self.subsample, self.atrous_rate
+        eff_h = (self.nb_row - 1) * rh + 1
+        eff_w = (self.nb_col - 1) * rw + 1
+        return (self.nb_filter, (h - eff_h) // sh + 1, (w - eff_w) // sw + 1)
+
+
+class AtrousConvolution1D(KerasLayer):
+    """Dilated 1-D convolution over (steps, dim) input (keras1
+    AtrousConvolution1D; 'valid' only). Runs as a height-1 dilated 2-D
+    conv exactly like the reference's implementation."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 subsample_length: int = 1, atrous_rate: int = 1,
+                 activation=None, bias: bool = True,
+                 border_mode: str = "valid", input_shape=None) -> None:
+        super().__init__(input_shape)
+        if border_mode != "valid":
+            raise ValueError("AtrousConvolution1D supports only "
+                             "border_mode='valid' (keras1 contract)")
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.subsample_length = subsample_length
+        self.atrous_rate = atrous_rate
+        self.activation = activation
+        self.bias = bias
+
+    def build_core(self, input_shape):
+        from bigdl_tpu.nn.layers_extra import SpatialDilatedConvolution
+        from bigdl_tpu.nn.shape_ops import Transpose, Unsqueeze, Squeeze
+
+        steps, dim = input_shape
+        conv = SpatialDilatedConvolution(
+            dim, self.nb_filter, 1, self.filter_length,
+            1, self.subsample_length, 0, 0, 1, self.atrous_rate,
+            with_bias=self.bias)
+        # (B, steps, dim) -> (B, dim, steps, 1) -> conv -> back
+        core = (_containers.Sequential()
+                .add(Transpose([(2, 3)]))           # (B, dim, steps)
+                .add(Unsqueeze(4))                  # (B, dim, steps, 1)
+                .add(conv)
+                .add(Squeeze(4))                    # (B, F, steps')
+                .add(Transpose([(2, 3)])))          # (B, steps', F)
+        return _maybe_activation(core, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        steps, dim = input_shape
+        eff = (self.filter_length - 1) * self.atrous_rate + 1
+        return ((steps - eff) // self.subsample_length + 1, self.nb_filter)

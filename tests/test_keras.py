@@ -509,3 +509,26 @@ def test_conv_lstm_2d_rejects_rect_kernel():
     from bigdl_tpu.nn import keras as K
     with pytest.raises(ValueError, match="square"):
         K.ConvLSTM2D(4, 3, 5)
+
+
+def test_atrous_convolutions(rng):
+    import torch
+
+    from bigdl_tpu.nn import keras as K
+
+    x = rng.randn(2, 3, 10, 12).astype(np.float32)
+    m = K.Sequential().add(K.AtrousConvolution2D(
+        5, 3, 3, atrous_rate=(2, 2), input_shape=(3, 10, 12)))
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, 5, 6, 8)
+    assert m.get_output_shape() == (5, 6, 8)
+
+    x1 = rng.randn(2, 11, 4).astype(np.float32)
+    m1 = K.Sequential().add(K.AtrousConvolution1D(
+        6, 3, atrous_rate=2, input_shape=(11, 4)))
+    out1 = np.asarray(m1.forward(x1))
+    assert out1.shape == (2, 7, 6)
+    assert m1.get_output_shape() == (7, 6)
+
+    with pytest.raises(ValueError, match="valid"):
+        K.AtrousConvolution2D(4, 3, 3, border_mode="same")
