@@ -523,6 +523,17 @@ def test_atrous_convolutions(rng):
     assert out.shape == (2, 5, 6, 8)
     assert m.get_output_shape() == (5, 6, 8)
 
+    # numeric parity vs torch dilated conv with the SAME weights
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(m.params)
+    w = next(np.asarray(l) for l in leaves if np.ndim(l) == 4)
+    b = next(np.asarray(l) for l in leaves if np.ndim(l) == 1)
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w),
+        torch.from_numpy(b), dilation=2).numpy()
+    np.testing.assert_allclose(out, want, atol=2e-4)
+
     x1 = rng.randn(2, 11, 4).astype(np.float32)
     m1 = K.Sequential().add(K.AtrousConvolution1D(
         6, 3, atrous_rate=2, input_shape=(11, 4)))
