@@ -935,3 +935,175 @@ class NextIterationOp(EnterOp):
 
 class LoopCondOp(EnterOp):
     pass
+
+
+# round-2 widening: image-resize / padding / space-batch ops common in
+# frozen inference graphs (segmentation, detection, dilated-conv graphs)
+
+
+class PadV2(AbstractModule):
+    """TF PadV2: [x, paddings, constant_value]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, pads, value = input
+        pads = [(int(a), int(b)) for a, b in np.asarray(pads)]
+        return jnp.pad(x, pads, constant_values=np.asarray(value).item()), state
+
+
+class MirrorPad(AbstractModule):
+    """TF MirrorPad: [x, paddings]; mode REFLECT or SYMMETRIC."""
+
+    def __init__(self, mode: str = "REFLECT") -> None:
+        super().__init__()
+        self.mode = mode.lower()
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, pads = input
+        pads = [(int(a), int(b)) for a, b in np.asarray(pads)]
+        return jnp.pad(x, pads, mode=self.mode), state
+
+
+class ResizeBilinear(AbstractModule):
+    """TF ResizeBilinear: [images NHWC, size (2,)]; static size."""
+
+    def __init__(self, align_corners: bool = False,
+                 half_pixel_centers: bool = False) -> None:
+        super().__init__()
+        self.align_corners = align_corners
+        self.half_pixel_centers = half_pixel_centers
+
+    def _coords(self, out_n, in_n, dtype):
+        import jax.numpy as jnp
+
+        out_idx = jnp.arange(out_n, dtype=dtype)
+        if self.align_corners and out_n > 1:
+            return out_idx * ((in_n - 1) / (out_n - 1))
+        scale = in_n / out_n
+        if self.half_pixel_centers:
+            return jnp.maximum((out_idx + 0.5) * scale - 0.5, 0.0)
+        return out_idx * scale
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, size = input
+        h_out, w_out = (int(v) for v in np.asarray(size))
+        n, h_in, w_in, c = x.shape
+        dtype = jnp.float32
+
+        def interp(x, coords, axis):
+            lo = jnp.floor(coords).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, x.shape[axis] - 1)
+            frac = (coords - lo).astype(x.dtype)
+            shape = [1] * x.ndim
+            shape[axis] = -1
+            frac = frac.reshape(shape)
+            return (jnp.take(x, lo, axis=axis) * (1 - frac)
+                    + jnp.take(x, hi, axis=axis) * frac)
+
+        x = interp(x, self._coords(h_out, h_in, dtype), 1)
+        x = interp(x, self._coords(w_out, w_in, dtype), 2)
+        return x, state
+
+
+class ResizeNearestNeighbor(ResizeBilinear):
+    """TF ResizeNearestNeighbor: [images NHWC, size]."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, size = input
+        h_out, w_out = (int(v) for v in np.asarray(size))
+        n, h_in, w_in, c = x.shape
+
+        def pick(coords, in_n):
+            if self.align_corners:
+                return jnp.round(coords).astype(jnp.int32).clip(0, in_n - 1)
+            return jnp.floor(coords).astype(jnp.int32).clip(0, in_n - 1)
+
+        hc = pick(self._coords(h_out, h_in, jnp.float32), h_in)
+        wc = pick(self._coords(w_out, w_in, jnp.float32), w_in)
+        return jnp.take(jnp.take(x, hc, axis=1), wc, axis=2), state
+
+
+class SpaceToBatchND(AbstractModule):
+    """TF SpaceToBatchND: [x, block_shape, paddings] — the op TF emits
+    around convs with dilation (atrous wrappers)."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, block, pads = input
+        block = [int(b) for b in np.asarray(block)]
+        pads = np.asarray(pads)
+        widths = [(0, 0)] + [(int(a), int(b)) for a, b in pads]
+        widths += [(0, 0)] * (x.ndim - len(widths))
+        x = jnp.pad(x, widths)
+        n = x.shape[0]
+        spatial = x.shape[1:1 + len(block)]
+        rest = x.shape[1 + len(block):]
+        # (N, s1/b1, b1, s2/b2, b2, ..., rest) -> blocks to batch
+        shape = [n]
+        for s, b in zip(spatial, block):
+            shape += [s // b, b]
+        x = x.reshape(shape + list(rest))
+        block_axes = [2 + 2 * i for i in range(len(block))]
+        keep_axes = [1 + 2 * i for i in range(len(block))]
+        perm = (block_axes + [0] + keep_axes
+                + list(range(1 + 2 * len(block), x.ndim)))
+        x = x.transpose(perm)
+        out_spatial = [s // b for s, b in zip(spatial, block)]
+        return x.reshape([n * int(np.prod(block))] + out_spatial
+                         + list(rest)), state
+
+
+class BatchToSpaceND(AbstractModule):
+    """TF BatchToSpaceND: [x, block_shape, crops] — inverse of
+    SpaceToBatchND."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        x, block, crops = input
+        block = [int(b) for b in np.asarray(block)]
+        crops = np.asarray(crops)
+        nb = int(np.prod(block))
+        n = x.shape[0] // nb
+        spatial = x.shape[1:1 + len(block)]
+        rest = x.shape[1 + len(block):]
+        x = x.reshape(block + [n] + list(spatial) + list(rest))
+        nd = len(block)
+        perm = [nd]
+        for i in range(nd):
+            perm += [nd + 1 + i, i]
+        perm += list(range(2 * nd + 1, x.ndim))
+        x = x.transpose(perm)
+        x = x.reshape([n] + [s * b for s, b in zip(spatial, block)]
+                      + list(rest))
+        slices = [slice(None)]
+        for (lo, hi), s, b in zip(crops, spatial, block):
+            slices.append(slice(int(lo), s * b - int(hi)))
+        return x[tuple(slices)], state
+
+
+class RankOp(AbstractModule):
+    """TF Rank: static ndim as int32 scalar."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.ndim(input) if not hasattr(input, "ndim")
+                           else input.ndim, jnp.int32), state
+
+
+class SizeOp(AbstractModule):
+    """TF Size: static element count as int32 scalar."""
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.asarray(int(np.prod(input.shape)), jnp.int32), state

@@ -649,6 +649,28 @@ def _lower(node):
         return O.ConcatV2()
     if op == "Pad":
         return O.Pad()
+    if op == "PadV2":
+        return O.PadV2()
+    if op == "MirrorPad":
+        return O.MirrorPad(node.attr["mode"].s.decode())
+    if op == "ResizeBilinear":
+        return O.ResizeBilinear(
+            node.attr["align_corners"].b,
+            node.attr["half_pixel_centers"].b
+            if "half_pixel_centers" in node.attr else False)
+    if op == "ResizeNearestNeighbor":
+        return O.ResizeNearestNeighbor(
+            node.attr["align_corners"].b,
+            node.attr["half_pixel_centers"].b
+            if "half_pixel_centers" in node.attr else False)
+    if op == "SpaceToBatchND":
+        return O.SpaceToBatchND()
+    if op == "BatchToSpaceND":
+        return O.BatchToSpaceND()
+    if op == "Rank":
+        return O.RankOp()
+    if op == "Size":
+        return O.SizeOp()
     if op == "Mean":
         return O.Mean(node.attr["keep_dims"].b)
     if op in ("Add", "AddV2"):

@@ -293,3 +293,72 @@ def test_session_finetunes_imported_graph(rng):
                optim_method=SGD(learning_rate=0.2))
     after = crit.forward(sess.model.forward(X[:32]), labels[:32].astype(np.float32))
     assert after < before * 0.7, (before, after)
+
+
+def test_import_resize_pad_ops(rng):
+    """Round-2 op widening: ResizeBilinear/NearestNeighbor, MirrorPad,
+    PadV2 — differential vs live TF."""
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    x = rng.rand(2, 5, 7, 3).astype(np.float32)
+
+    def fn(t):
+        t = tf.raw_ops.MirrorPad(input=t,
+                                 paddings=[[0, 0], [1, 1], [2, 2], [0, 0]],
+                                 mode="REFLECT")
+        t = tf.raw_ops.PadV2(input=t,
+                             paddings=[[0, 0], [1, 0], [0, 1], [0, 0]],
+                             constant_values=0.5)
+        t = tf.raw_ops.ResizeBilinear(images=t, size=[10, 14])
+        return tf.raw_ops.ResizeNearestNeighbor(images=t, size=[5, 7])
+
+    gd, frozen = _freeze(fn, x)
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    out_name = [n.name for n in gd.node if n.name == "Identity"
+                or n.name.endswith("/Identity")][-1]
+    g = load_tf(gd, [in_name], [out_name])
+    got = np.asarray(g.forward(x))
+    assert_close(got, want, atol=1e-5)
+
+
+def test_import_space_to_batch_dilated_conv(rng):
+    """SpaceToBatchND/BatchToSpaceND — the pattern TF emits for dilated
+    convolutions — round-trips through a real atrous conv graph."""
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    x = rng.rand(1, 12, 12, 2).astype(np.float32)
+    k = tf.constant(rng.randn(3, 3, 2, 4).astype(np.float32) * 0.3)
+
+    def fn(t):
+        # TF lowers dilation>1 conv into SpaceToBatchND/conv/BatchToSpaceND
+        return tf.nn.atrous_conv2d(t, k, rate=2, padding="SAME")
+
+    gd, frozen = _freeze(fn, x)
+    ops = {n.op for n in gd.node}
+    assert "SpaceToBatchND" in ops and "BatchToSpaceND" in ops, ops
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    out_name = [n.name for n in gd.node if n.name == "Identity"
+                or n.name.endswith("/Identity")][-1]
+    g = load_tf(gd, [in_name], [out_name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_import_rank_size(rng):
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    x = rng.rand(2, 3, 4).astype(np.float32)
+
+    def fn(t):
+        r = tf.cast(tf.raw_ops.Rank(input=t), tf.float32)
+        s = tf.cast(tf.raw_ops.Size(input=t), tf.float32)
+        return tf.reduce_sum(t) + r * 100.0 + s
+
+    gd, frozen = _freeze(fn, x)
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    out_name = [n.name for n in gd.node if n.name == "Identity"
+                or n.name.endswith("/Identity")][-1]
+    g = load_tf(gd, [in_name], [out_name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
