@@ -994,6 +994,9 @@ class ResizeBilinear(AbstractModule):
         h_out, w_out = (int(v) for v in np.asarray(size))
         n, h_in, w_in, c = x.shape
         dtype = jnp.float32
+        # TF ResizeBilinear always interpolates and returns float32, even
+        # for integer (uint8 image) inputs
+        x = x.astype(jnp.float32)
 
         def interp(x, coords, axis):
             lo = jnp.floor(coords).astype(jnp.int32)

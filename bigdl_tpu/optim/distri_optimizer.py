@@ -338,17 +338,18 @@ class DistriOptimizer(Optimizer):
 
         n_proc = jax.process_count()
         if n_proc > 1:
-            def divide(bs):
-                if bs % n_proc:
+            # normalize the pyspark positional order (batch_size, val_rdd,
+            # trigger, val_method) to Scala order BEFORE dividing/checking
+            # — the base class does this same int-first swap
+            if isinstance(trigger, int):
+                batch_size, dataset, trigger, methods = (
+                    trigger, dataset, methods, batch_size)
+            if batch_size is not None:
+                if batch_size % n_proc:
                     raise ValueError(
-                        f"global validation batch {bs} must divide the "
-                        f"{n_proc}-process topology")
-                return bs // n_proc
-
-            if isinstance(trigger, int):      # pyspark positional order
-                trigger = divide(trigger)
-            elif batch_size is not None:
-                batch_size = divide(batch_size)
+                        f"global validation batch {batch_size} must divide "
+                        f"the {n_proc}-process topology")
+                batch_size //= n_proc
             # the pod merge collective needs a zero accumulator from
             # empty-shard processes — fail EARLY and on every process if a
             # custom method can't provide one (a late failure on one

@@ -199,3 +199,37 @@ def test_validation_runs_sharded_on_mesh(mode):
     assert isinstance(out.sharding, NamedSharding)
     assert out.sharding.spec == P("data")
     assert len(out.sharding.device_set) == 8
+
+
+def test_pod_set_validation_pyspark_order():
+    """Pod-mode set_validation must survive the pyspark positional order
+    (batch_size, val_rdd, trigger, val_method) — round-2 review finding:
+    the _result_cls pre-check ran before the int-first swap."""
+    from unittest import mock
+
+    from bigdl_tpu.dataset.sample import Sample
+
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(1, 28, 28).astype(np.float32), np.float32(1))
+               for _ in range(8)]
+
+    with mock.patch("jax.process_count", return_value=2):
+        opt = DistriOptimizer(model=LeNet5(10),
+                              dataset=DistributedDataSet(samples),
+                              criterion=ClassNLLCriterion(), batch_size=4)
+        opt.set_validation(256, DistributedDataSet(samples),
+                           Trigger.every_epoch(), [Top1Accuracy()])
+        # global 256 / 2 processes -> local batches of 128
+        probe = next(iter(opt.validation_dataset.data(train=False)))
+        assert probe.size() <= 128
+
+        with pytest.raises(ValueError, match="divide"):
+            opt.set_validation(255, DistributedDataSet(samples),
+                               Trigger.every_epoch(), [Top1Accuracy()])
+
+        class NoCls(Top1Accuracy):
+            _result_cls = None
+
+        with pytest.raises(ValueError, match="_result_cls"):
+            opt.set_validation(256, DistributedDataSet(samples),
+                               Trigger.every_epoch(), [NoCls()])

@@ -362,3 +362,17 @@ def test_import_rank_size(rng):
                 or n.name.endswith("/Identity")][-1]
     g = load_tf(gd, [in_name], [out_name])
     assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_resize_bilinear_uint8_returns_float(rng):
+    """TF semantics: bilinear resize interpolates integer images and
+    returns float32."""
+    from bigdl_tpu.nn.ops import ResizeBilinear
+
+    img = (rng.rand(1, 2, 2, 1) * 255).astype(np.uint8)
+    out, _ = ResizeBilinear().apply({}, [img, np.array([4, 4])])
+    out = np.asarray(out)
+    assert out.dtype == np.float32
+    want = tf.raw_ops.ResizeBilinear(images=tf.constant(img),
+                                     size=[4, 4]).numpy()
+    assert_close(out, want, atol=1e-4)
