@@ -1110,3 +1110,92 @@ class SizeOp(AbstractModule):
         import jax.numpy as jnp
 
         return jnp.asarray(int(np.prod(input.shape)), jnp.int32), state
+
+
+class _Elementwise(TensorModule):
+    """One-jnp-function elementwise op (Sin/Cos/Log1p/... family)."""
+
+    _fn = None
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return getattr(jnp, self._fn)(input), state
+
+
+class Sin(_Elementwise):
+    _fn = "sin"
+
+
+class Cos(_Elementwise):
+    _fn = "cos"
+
+
+class Tan(_Elementwise):
+    _fn = "tan"
+
+
+class Asin(_Elementwise):
+    _fn = "arcsin"
+
+
+class Acos(_Elementwise):
+    _fn = "arccos"
+
+
+class Atan(_Elementwise):
+    _fn = "arctan"
+
+
+class Sinh(_Elementwise):
+    _fn = "sinh"
+
+
+class Cosh(_Elementwise):
+    _fn = "cosh"
+
+
+class Log1p(_Elementwise):
+    _fn = "log1p"
+
+
+class Expm1(_Elementwise):
+    _fn = "expm1"
+
+
+class IsNan(_Elementwise):
+    _fn = "isnan"
+
+
+class IsInf(_Elementwise):
+    _fn = "isinf"
+
+
+class IsFinite(_Elementwise):
+    _fn = "isfinite"
+
+
+class LRN(AbstractModule):
+    """TF LRN over NHWC input (depth_radius window on the channel axis) —
+    the TF dialect of the core SpatialCrossMapLRN (which is NCHW and uses
+    size = 2*radius+1 with alpha pre-divided)."""
+
+    def __init__(self, depth_radius: int = 5, bias: float = 1.0,
+                 alpha: float = 1.0, beta: float = 0.5) -> None:
+        super().__init__()
+        self.depth_radius = depth_radius
+        self.bias = bias
+        self.alpha = alpha
+        self.beta = beta
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax.lax as lax
+
+        r = self.depth_radius
+        window_sum = lax.reduce_window(
+            input * input, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, 2 * r + 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (r, r)),
+        )
+        return input / (self.bias + self.alpha * window_sum) ** self.beta, state

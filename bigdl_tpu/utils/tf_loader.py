@@ -671,6 +671,14 @@ def _lower(node):
         return O.RankOp()
     if op == "Size":
         return O.SizeOp()
+    if op in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
+              "Log1p", "Expm1", "IsNan", "IsInf", "IsFinite"):
+        return getattr(O, op)()
+    if op == "LRN":
+        return O.LRN(node.attr["depth_radius"].i or 5,
+                     node.attr["bias"].f or 1.0,
+                     node.attr["alpha"].f or 1.0,
+                     node.attr["beta"].f or 0.5)
     if op == "Mean":
         return O.Mean(node.attr["keep_dims"].b)
     if op in ("Add", "AddV2"):

@@ -376,3 +376,27 @@ def test_resize_bilinear_uint8_returns_float(rng):
     want = tf.raw_ops.ResizeBilinear(images=tf.constant(img),
                                      size=[4, 4]).numpy()
     assert_close(out, want, atol=1e-4)
+
+
+def test_import_elementwise_family_and_lrn(rng):
+    """Trig/log1p/isfinite family + TF LRN — differential vs live TF."""
+    from bigdl_tpu.utils.tf_loader import load_tf
+
+    x = (rng.rand(2, 4, 5, 6).astype(np.float32) - 0.5) * 0.9
+
+    def fn(t):
+        t = tf.sin(t) + tf.cos(t) * tf.atan(t) + tf.math.log1p(tf.abs(t))
+        t = t + tf.asin(tf.clip_by_value(t * 0.1, -0.9, 0.9))
+        t = t + tf.math.expm1(t * 0.1) + tf.sinh(t * 0.1) * tf.cosh(t * 0.1)
+        t = tf.nn.local_response_normalization(
+            t, depth_radius=2, bias=1.5, alpha=0.3, beta=0.6)
+        return tf.where(tf.math.is_finite(t), t, tf.zeros_like(t))
+
+    gd, frozen = _freeze(fn, x)
+    assert any(n.op == "LRN" for n in gd.node)
+    want = frozen(tf.constant(x))[0].numpy()
+    in_name = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    out_name = [n.name for n in gd.node if n.name == "Identity"
+                or n.name.endswith("/Identity")][-1]
+    g = load_tf(gd, [in_name], [out_name])
+    assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
