@@ -398,6 +398,54 @@ def _convert_layer(ltype: str, layer: Dict, lblobs, L) -> Tuple[Any, int]:
         if op in ("PROD", 0):
             return L["CMulTable"](), None
         raise NotImplementedError(f"Eltwise op {op}")
+    if ltype == "Deconvolution":
+        kw, kh, sw, sh, pw, ph = _conv_geometry(p_conv)
+        n_out = int(_one(p_conv, "num_output"))
+        group = int(_one(p_conv, "group", 1))
+        bias = bool(_one(p_conv, "bias_term", True))
+        if not lblobs:
+            raise ValueError("Deconvolution needs caffemodel blobs for "
+                             "sizing (weight blob is (in, out/g, kh, kw))")
+        n_in = int(lblobs[0].shape[0])
+        from bigdl_tpu.nn.conv import SpatialFullConvolution
+
+        return SpatialFullConvolution(
+            n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
+            no_bias=not bias), n_out
+    if ltype == "PReLU":
+        p = _one(layer, "prelu_param", {})
+        shared = bool(_one(p, "channel_shared", False))
+        from bigdl_tpu.nn.activations import PReLU
+
+        n = 0 if shared else int(np.asarray(lblobs[0]).size) if lblobs else 0
+        return PReLU(n), None
+    if ltype == "ELU":
+        p = _one(layer, "elu_param", {})
+        from bigdl_tpu.nn.activations import ELU
+
+        return ELU(float(_one(p, "alpha", 1.0))), None
+    if ltype == "Exp":
+        from bigdl_tpu.nn.misc import Exp
+
+        return Exp(), None
+    if ltype == "Log":
+        from bigdl_tpu.nn.misc import Log
+
+        return Log(), None
+    if ltype == "BNLL":
+        from bigdl_tpu.nn.activations import SoftPlus
+
+        return SoftPlus(), None
+    if ltype == "Reshape":
+        p = _one(layer, "reshape_param", {})
+        shape = _one(p, "shape", {})
+        dims = [int(d) for d in (shape.get("dim") or [])]
+        from bigdl_tpu.nn.shape_ops import Reshape
+
+        # caffe dim 0 = keep; leading 0 is the batch dim in deploy nets
+        if dims and dims[0] == 0:
+            return Reshape([d for d in dims[1:]], batch_mode=True), None
+        return Reshape(dims), None
     if ltype in ("Accuracy", "SoftmaxWithLoss", "Silence"):
         return None, None  # train/eval-only layers: skipped in deploy graphs
     raise NotImplementedError(f"Caffe layer type {ltype!r} unsupported")
@@ -417,6 +465,13 @@ def _install_weights(graph, pending, match_all: bool) -> None:
             p["weight"] = lblobs[0].astype(np.float32)
             if len(lblobs) > 1 and "bias" in p:
                 p["bias"] = lblobs[1].astype(np.float32)
+        elif cls == "SpatialFullConvolution":
+            # caffe deconv blob is (in, out/g, kh, kw) — our layout exactly
+            p["weight"] = lblobs[0].astype(np.float32)
+            if len(lblobs) > 1 and "bias" in p:
+                p["bias"] = lblobs[1].astype(np.float32)
+        elif cls == "PReLU":
+            p["weight"] = np.asarray(lblobs[0], np.float32).reshape(-1)
         elif cls == "Linear":
             p["weight"] = lblobs[0].reshape(p["weight"].shape).astype(np.float32)
             if len(lblobs) > 1 and "bias" in p:

@@ -268,3 +268,40 @@ def test_module_level_interop_entrypoints(tmp_path, rng):
 
     assert callable(Module.load_caffe_model)
     assert callable(Module.load_tf)
+
+
+def test_load_caffe_deconv_prelu_elu(rng):
+    """Round-2 widening: Deconvolution (FCN-style), PReLU, ELU, BNLL,
+    Exp/Log — against a torch oracle for the weighted layers."""
+    import torch
+
+    from bigdl_tpu.utils.caffe_loader import load_caffe
+
+    dw = (rng.randn(3, 2, 4, 4) * 0.2).astype(np.float32)  # (in, out, kh, kw)
+    db = rng.randn(2).astype(np.float32) * 0.1
+    pw = np.abs(rng.randn(2)).astype(np.float32) * 0.3
+
+    prototxt = """
+    name: "fcn-ish"
+    input: "data"
+    layer { name: "up" type: "Deconvolution" bottom: "data" top: "up"
+            convolution_param { num_output: 2 kernel_size: 4 stride: 2
+                                pad: 1 } }
+    layer { name: "prelu" type: "PReLU" bottom: "up" top: "up" }
+    layer { name: "elu" type: "ELU" bottom: "up" top: "elu"
+            elu_param { alpha: 0.7 } }
+    layer { name: "bnll" type: "BNLL" bottom: "elu" top: "out" }
+    """
+    model_bytes = _layer("up", [dw, db]) + _layer("prelu", [pw])
+    g = load_caffe(prototxt, model_bytes, match_all=False)
+
+    x = rng.rand(2, 3, 6, 6).astype(np.float32)
+    got = np.asarray(g.forward(x))
+
+    t = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(dw), torch.from_numpy(db),
+        stride=2, padding=1)
+    t = torch.nn.functional.prelu(t, torch.from_numpy(pw))
+    t = torch.nn.functional.elu(t, alpha=0.7)
+    want = torch.nn.functional.softplus(t).numpy()
+    assert_close(got, want, atol=1e-4)
