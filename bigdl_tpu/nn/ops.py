@@ -1014,7 +1014,10 @@ class ResizeBilinear(AbstractModule):
 
 
 class ResizeNearestNeighbor(ResizeBilinear):
-    """TF ResizeNearestNeighbor: [images NHWC, size]."""
+    """TF ResizeNearestNeighbor: [images NHWC, size]. TF's NN kernel uses
+    ITS OWN scalers (not the bilinear ones): half_pixel_centers →
+    floor((out+0.5)·scale) with no −0.5 shift, align_corners → round half
+    AWAY from zero of out·(in−1)/(out−1)."""
 
     def apply(self, params, input, state=None, training=False, rng=None):
         import jax.numpy as jnp
@@ -1023,13 +1026,20 @@ class ResizeNearestNeighbor(ResizeBilinear):
         h_out, w_out = (int(v) for v in np.asarray(size))
         n, h_in, w_in, c = x.shape
 
-        def pick(coords, in_n):
-            if self.align_corners:
-                return jnp.round(coords).astype(jnp.int32).clip(0, in_n - 1)
-            return jnp.floor(coords).astype(jnp.int32).clip(0, in_n - 1)
+        def pick(out_n, in_n):
+            out_idx = jnp.arange(out_n, dtype=jnp.float32)
+            if self.align_corners and out_n > 1:
+                coords = out_idx * ((in_n - 1) / (out_n - 1))
+                # roundf semantics: half away from zero (coords >= 0 here)
+                idx = jnp.floor(coords + 0.5)
+            elif self.half_pixel_centers:
+                idx = jnp.floor((out_idx + 0.5) * (in_n / out_n))
+            else:
+                idx = jnp.floor(out_idx * (in_n / out_n))
+            return idx.astype(jnp.int32).clip(0, in_n - 1)
 
-        hc = pick(self._coords(h_out, h_in, jnp.float32), h_in)
-        wc = pick(self._coords(w_out, w_in, jnp.float32), w_in)
+        hc = pick(h_out, h_in)
+        wc = pick(w_out, w_in)
         return jnp.take(jnp.take(x, hc, axis=1), wc, axis=2), state
 
 

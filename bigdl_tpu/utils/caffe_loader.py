@@ -442,9 +442,17 @@ def _convert_layer(ltype: str, layer: Dict, lblobs, L) -> Tuple[Any, int]:
         dims = [int(d) for d in (shape.get("dim") or [])]
         from bigdl_tpu.nn.shape_ops import Reshape
 
-        # caffe dim 0 = keep; leading 0 is the batch dim in deploy nets
+        # caffe dim 0 = copy-from-bottom; the leading one is the batch dim
         if dims and dims[0] == 0:
+            if 0 in dims[1:]:
+                raise NotImplementedError(
+                    "Caffe Reshape with non-leading dim:0 (copy-from-"
+                    "bottom) needs the bottom shape; not supported")
             return Reshape([d for d in dims[1:]], batch_mode=True), None
+        if 0 in dims:
+            raise NotImplementedError(
+                "Caffe Reshape with non-leading dim:0 (copy-from-bottom) "
+                "needs the bottom shape; not supported")
         return Reshape(dims), None
     if ltype in ("Accuracy", "SoftmaxWithLoss", "Silence"):
         return None, None  # train/eval-only layers: skipped in deploy graphs

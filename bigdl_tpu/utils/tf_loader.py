@@ -675,10 +675,12 @@ def _lower(node):
               "Log1p", "Expm1", "IsNan", "IsInf", "IsFinite"):
         return getattr(O, op)()
     if op == "LRN":
-        return O.LRN(node.attr["depth_radius"].i or 5,
-                     node.attr["bias"].f or 1.0,
-                     node.attr["alpha"].f or 1.0,
-                     node.attr["beta"].f or 0.5)
+        # presence checks, not truthiness: zero-valued attrs are legal
+        return O.LRN(
+            node.attr["depth_radius"].i if "depth_radius" in node.attr else 5,
+            node.attr["bias"].f if "bias" in node.attr else 1.0,
+            node.attr["alpha"].f if "alpha" in node.attr else 1.0,
+            node.attr["beta"].f if "beta" in node.attr else 0.5)
     if op == "Mean":
         return O.Mean(node.attr["keep_dims"].b)
     if op in ("Add", "AddV2"):

@@ -400,3 +400,23 @@ def test_import_elementwise_family_and_lrn(rng):
                 or n.name.endswith("/Identity")][-1]
     g = load_tf(gd, [in_name], [out_name])
     assert_close(np.asarray(g.forward(x)), want, atol=1e-4)
+
+
+def test_resize_nearest_flag_conventions(rng):
+    """TF NN-resize scalers differ per flag — check all three conventions
+    against live TF."""
+    from bigdl_tpu.nn.ops import ResizeNearestNeighbor
+
+    img = rng.rand(1, 4, 6, 2).astype(np.float32)
+    for ac, hp in ((False, False), (True, False), (False, True)):
+        want = tf.raw_ops.ResizeNearestNeighbor(
+            images=tf.constant(img), size=[2, 3], align_corners=ac,
+            half_pixel_centers=hp).numpy()
+        got, _ = ResizeNearestNeighbor(ac, hp).apply(
+            {}, [img, np.array([2, 3])])
+        assert_close(np.asarray(got), want, atol=0), (ac, hp)
+    up = tf.raw_ops.ResizeNearestNeighbor(
+        images=tf.constant(img), size=[6, 9], align_corners=True).numpy()
+    got, _ = ResizeNearestNeighbor(True, False).apply(
+        {}, [img, np.array([6, 9])])
+    assert_close(np.asarray(got), up, atol=0)
