@@ -543,3 +543,47 @@ def test_atrous_convolutions(rng):
 
     with pytest.raises(ValueError, match="valid"):
         K.AtrousConvolution2D(4, 3, 3, border_mode="same")
+
+
+def test_keras_round2_layers_serialization_roundtrip(rng, tmp_path):
+    """The round-2 wrappers (pooling family, ConvLSTM2D, Convolution3D,
+    atrous) ride the structured serializer."""
+    from bigdl_tpu.nn import keras as K
+    from bigdl_tpu.nn.module import AbstractModule
+
+    m = (K.Sequential()
+         .add(K.ConvLSTM2D(3, 3, 3, return_sequences=True,
+                           input_shape=(3, 2, 6, 6)))
+         .add(K.TimeDistributed(K.Flatten()))
+         .add(K.MaxPooling1D(3))
+         .add(K.GlobalAveragePooling1D()))
+    m.evaluate()
+    x = rng.randn(2, 3, 2, 6, 6).astype(np.float32)
+    want = np.asarray(m.forward(x))
+    path = str(tmp_path / "keras_r2.bigdl")
+    m.save_module(path)
+    m2 = AbstractModule.load_module(path)
+    m2.evaluate()
+    assert_close(np.asarray(m2.forward(x)), want, atol=1e-6)
+
+    c3 = (K.Sequential()
+          .add(K.Convolution3D(4, 2, 2, 2, input_shape=(2, 4, 6, 6)))
+          .add(K.GlobalMaxPooling3D())
+          .add(K.Dense(3)))
+    c3.evaluate()
+    x3 = rng.randn(2, 2, 4, 6, 6).astype(np.float32)
+    want3 = np.asarray(c3.forward(x3))
+    c3.save_module(str(tmp_path / "keras_c3.bigdl"))
+    c3b = AbstractModule.load_module(str(tmp_path / "keras_c3.bigdl"))
+    c3b.evaluate()
+    assert_close(np.asarray(c3b.forward(x3)), want3, atol=1e-6)
+
+    a2 = K.Sequential().add(K.AtrousConvolution2D(
+        4, 3, 3, atrous_rate=(2, 2), input_shape=(3, 10, 10)))
+    a2.evaluate()
+    xa = rng.randn(1, 3, 10, 10).astype(np.float32)
+    wanta = np.asarray(a2.forward(xa))
+    a2.save_module(str(tmp_path / "keras_a2.bigdl"))
+    a2b = AbstractModule.load_module(str(tmp_path / "keras_a2.bigdl"))
+    a2b.evaluate()
+    assert_close(np.asarray(a2b.forward(xa)), wanta, atol=1e-6)
