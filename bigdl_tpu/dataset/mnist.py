@@ -40,9 +40,12 @@ def _read_idx_labels(path: str) -> np.ndarray:
         return np.frombuffer(f.read(), np.uint8)
 
 
-def _synthetic_digits(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+def _synthetic_digits(n: int, seed: int,
+                      noise: float = 25.0) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic learnable stand-in: each class is a distinct 28x28
-    blob pattern plus noise."""
+    blob pattern plus noise (``noise`` = std in 0..255 pixel units; high
+    values make the accuracy-parity harness land below 100%, a sharper
+    parity signal)."""
     rng = np.random.RandomState(seed)
     protos = np.zeros((10, 28, 28), np.float32)
     proto_rng = np.random.RandomState(1234)
@@ -53,7 +56,7 @@ def _synthetic_digits(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
             protos[c] += np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)
     protos = protos / protos.max(axis=(1, 2), keepdims=True) * 255.0
     labels = rng.randint(0, 10, n)
-    imgs = protos[labels] + rng.randn(n, 28, 28).astype(np.float32) * 25.0
+    imgs = protos[labels] + rng.randn(n, 28, 28).astype(np.float32) * noise
     return np.clip(imgs, 0, 255).astype(np.uint8), labels.astype(np.uint8)
 
 
@@ -107,12 +110,15 @@ def write_idx_files(data_dir: str, images: np.ndarray, labels: np.ndarray,
 
 
 def generate_idx_dataset(data_dir: str, n_train: int = 4096,
-                         n_test: int = 1024, seed: int = 7) -> None:
+                         n_test: int = 1024, seed: int = 7,
+                         noise: float = 25.0) -> None:
     """Generate a deterministic LEARNABLE digit dataset as real idx files
     on disk (train + t10k pairs) — the in-env stand-in for downloading
     MNIST (zero egress), feeding the real reader path end to end."""
-    write_idx_files(data_dir, *_synthetic_digits(n_train, seed), "train")
-    write_idx_files(data_dir, *_synthetic_digits(n_test, seed + 6), "test")
+    write_idx_files(data_dir, *_synthetic_digits(n_train, seed, noise),
+                    "train")
+    write_idx_files(data_dir, *_synthetic_digits(n_test, seed + 6, noise),
+                    "test")
 
 
 def load_samples(data_dir: str, kind: str = "train", **kw) -> List[Sample]:
