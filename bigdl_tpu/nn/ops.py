@@ -844,8 +844,16 @@ class SwitchOp(AbstractModule):
 
 class CondMerge(AbstractModule):
     """TF Merge under a cond region: [false_value, true_value, pred] →
-    ``jnp.where(pred, true_value, false_value)`` (the loader routes the
-    controlling Switch predicate in as the third input)."""
+    ``jnp.where(pred, true_v, false_v)`` (the loader routes the
+    controlling Switch predicate in as the third input).
+
+    Limitation: both branches are COMPUTED (select, not ``lax.cond``), so
+    if the dead branch produces NaN/inf intermediates (e.g. a div-by-zero
+    the cond was guarding), gradients through the imported graph can pick
+    up NaN via the ``0 * NaN`` cotangent path even though the forward is
+    clean. Graphs that need dead-branch gradient suppression should import
+    through the v2 functional path (:class:`TFCond` lowers to
+    ``lax.cond``, which differentiates only the live branch)."""
 
     def apply(self, params, input, state=None, training=False, rng=None):
         import jax.numpy as jnp

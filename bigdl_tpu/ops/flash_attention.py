@@ -432,6 +432,14 @@ def _bthd_plumbing(q, k, v, scale, interpret):
             to3)
 
 
+def _check_causal_offset(causal, causal_offset):
+    if causal_offset is not None and not causal:
+        raise ValueError(
+            "causal_offset requires causal=True — the non-causal kernel "
+            "branches apply no mask, so the offset would be silently "
+            "ignored")
+
+
 def _auto_block(t_max: int) -> int:
     """Pick the VMEM tile length: as large as the scoped-VMEM budget allows
     (the block² f32 score tile caps at 1024 → 4 MB) — big tiles amortize
@@ -455,6 +463,7 @@ def flash_attention_with_lse(q, k, v, scale: Optional[float] = None,
     later-ranked stripes; may be a traced scalar.
     ``out``: (B, T, H, D); ``lse``: (B, H, T) float32.
     """
+    _check_causal_offset(causal, causal_offset)
     b, t, h, d = q.shape
     if block is None:
         block = _auto_block(max(q.shape[1], k.shape[1]))
@@ -498,6 +507,7 @@ def flash_attention_block_grads(q, k, v, o, lse, do,
     gradients sum exactly across blocks. Returns ``(dq, dk, dv)`` shaped
     like q/k/v.
     """
+    _check_causal_offset(causal, causal_offset)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if block is None:

@@ -447,7 +447,14 @@ class Optimizer:
             # dispatched (async) step still runs on the device; float(loss)
             # is the only host sync point
             if next_ready is None:
-                b = next(data_iter)
+                try:
+                    b = next(data_iter)
+                except StopIteration:
+                    logger.warning(
+                        "data iterator exhausted before end_when fired — "
+                        "a directly-constructed stateful Trigger without a "
+                        "side-effect-free peek_fn can cause this; stopping")
+                    break
                 next_ready = (*place_batch(b), b.size())
             inp, tgt, bsz = next_ready
             t0 = time.time()

@@ -12,6 +12,15 @@ from typing import Callable
 
 
 class Trigger:
+    """``fn(state) -> bool`` decides firing; ``peek_fn`` must be a
+    SIDE-EFFECT-FREE predictor of ``fn``. The optimizer calls ``peek`` on a
+    speculative post-step state to decide batch prefetch, so a stateful
+    ``fn`` used as its own peek (the default) would consume its latch on a
+    state that never becomes real. Factories below supply correct peeks;
+    directly-constructed stateful Triggers must pass ``peek_fn``
+    explicitly (the optimizer also guards the loop-top ``next()`` so a
+    wrong peek degrades to a clean stop, not a crash)."""
+
     def __init__(self, fn: Callable[[dict], bool],
                  peek_fn: Callable[[dict], bool] = None) -> None:
         self._fn = fn

@@ -8,6 +8,8 @@ import pytest
 
 from tests.oracle import assert_close
 from bigdl_tpu.ops import flash_attention
+from bigdl_tpu.ops.flash_attention import (flash_attention_block_grads,
+                                           flash_attention_with_lse)
 from bigdl_tpu.parallel.ring_attention import attention
 
 
@@ -105,3 +107,13 @@ def test_flash_cross_attention_different_kv_len():
     grads = jax.grad(lambda k: jnp.sum(flash_attention(q, k, v) ** 2))(k)
     assert grads.shape == k.shape
     assert np.isfinite(np.asarray(grads)).all()
+
+
+def test_causal_offset_without_causal_raises():
+    """ADVICE r2: causal_offset with causal=False was silently ignored."""
+    q = jnp.zeros((1, 8, 1, 4))
+    with pytest.raises(ValueError, match="causal_offset requires"):
+        flash_attention_with_lse(q, q, q, causal_offset=-1)
+    lse = jnp.zeros((1, 1, 8))
+    with pytest.raises(ValueError, match="causal_offset requires"):
+        flash_attention_block_grads(q, q, q, q, lse, q, causal_offset=-1)
