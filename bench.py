@@ -40,7 +40,21 @@ def main() -> None:
     criterion = CrossEntropyCriterion()
     optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
 
-    step = jax.jit(make_train_step(model, criterion, optim,
+    # BIGDL_CONV_FUSION=1 selects the NHWC fused lowering
+    # (bigdl_tpu/nn/tpu_fusion.py; BIGDL_PALLAS_MIN_C picks per-edge
+    # kernels). Measured r3: the XLA NCHW program still wins end-to-end
+    # (2486 vs 2437 img/s — benchmarks/PERF_ANALYSIS_r3.md), so the
+    # default stays unfused; the pass exists as the engine's lowering
+    # experiment surface.
+    import os
+
+    run_model = model
+    if os.environ.get("BIGDL_CONV_FUSION", "") not in ("", "0", "false"):
+        from bigdl_tpu.nn.tpu_fusion import maybe_fuse
+
+        run_model = maybe_fuse(model)
+
+    step = jax.jit(make_train_step(run_model, criterion, optim,
                                    compute_dtype=jnp.bfloat16),
                    donate_argnums=(0, 1))
     params, model_state = jax.device_put(model.params), model.state

@@ -1,0 +1,155 @@
+"""Fused BN→ReLU→1×1-conv (Pallas) vs XLA unfused chain, per ResNet edge.
+
+Round-2 verdict item #1: attack the measured traffic gap — the
+``maximum_add_fusion`` elementwise passes (BN-normalize+ReLU between convs)
+cost a full read+write of the activation because XLA cannot prologue-fuse
+them into the consuming conv (PERF_ANALYSIS_r2.md). This experiment times
+the Pallas fused edge (bigdl_tpu/ops/fused_conv.py) against XLA's best
+unfused equivalent.
+
+Methodology: a single edge in isolation is UNMEASURABLE fairly — with only
+a scalar consumed, XLA legally skips HBM writes (and slices backward
+computations) that a real network forces, while the opaque Pallas kernel
+always pays them. So each measurement is a TWO-edge chain
+(C→K→C, the second edge's batch stats coming from the first edge's
+epilogue stats), ending in a mean-centered second-moment loss — every
+intermediate has a stats barrier or a downstream consumer, exactly like
+the real bottleneck stack. Grad outputs are consumed by full reductions.
+The end-to-end decider remains bench.py with the fused model.
+
+Run: python benchmarks/fused_conv_experiment.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def bench(fn, args, iters, repeats=3, inner=6):
+    """``inner`` chained executions inside ONE jit (scalar data dependency
+    serializes them) amortize the transport's ~1.4 ms dispatch / ~135 ms
+    readback. Every output leaf is consumed by a FULL reduction — a
+    single-element read would let XLA slice-sink whole backward passes."""
+    import jax
+    import jax.numpy as jnp
+
+    def chained(*a):
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(inner):
+            out = fn(a[0] + acc.astype(a[0].dtype), *a[1:])
+            acc = sum(jnp.sum(l.astype(jnp.float32))
+                      for l in jax.tree_util.tree_leaves(out)) * 1e-30
+        return acc
+
+    jf = jax.jit(chained)
+    float(jf(*args))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = jf(*args)
+        float(o)
+        best = min(best, (time.perf_counter() - t0) / (iters * inner))
+    return best
+
+
+EPS = 1e-5
+
+# ResNet-50 bottleneck conv3 edges at batch 256 (M = N·H·W): stage → (M, C, K)
+SHAPES = [
+    ("s1 56² 64→256", 256 * 56 * 56, 64, 256),
+    ("s2 28² 128→512", 256 * 28 * 28, 128, 512),
+    ("s3 14² 256→1024", 256 * 14 * 14, 256, 1024),
+    ("s4 7² 512→2048", 256 * 7 * 7, 512, 2048),
+]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops import fused_conv as fc
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    def stats_to_mv(zstats, m):
+        mean = zstats[0] / m
+        var = jnp.maximum(zstats[1] / m - mean * mean, 0.0)
+        return mean, var
+
+    def loss_of(z2):
+        z32 = z2.astype(jnp.float32)
+        mu = jnp.mean(z32)
+        return jnp.mean((z32 - mu) ** 2)
+
+    print(f"{'edge-chain':>18} {'dir':>5} {'xla ms':>8} {'fused ms':>9} "
+          f"{'speedup':>8}")
+    tot_x = tot_f = tot_xb = tot_fb = 0.0
+    for name, m, c, k in SHAPES:
+        key = jax.random.PRNGKey(0)
+        ks = jax.random.split(key, 7)
+        x = jax.random.normal(ks[0], (m, c), jnp.bfloat16)
+        w1 = jax.random.normal(ks[1], (c, k), jnp.bfloat16) * 0.05
+        w2 = jax.random.normal(ks[2], (k, c), jnp.bfloat16) * 0.05
+        g1 = jax.random.normal(ks[3], (c,), jnp.float32) * 0.5 + 1.0
+        b1 = jax.random.normal(ks[4], (c,), jnp.float32) * 0.1
+        g2 = jax.random.normal(ks[5], (k,), jnp.float32) * 0.5 + 1.0
+        b2 = jax.random.normal(ks[6], (k,), jnp.float32) * 0.1
+
+        def xla_fwd(x, g1, b1, w1, g2, b2, w2):
+            xm = x.astype(jnp.float32).mean(0)
+            xv = x.astype(jnp.float32).var(0)
+            inv1 = jax.lax.rsqrt(xv + EPS)
+            y1 = jnp.maximum((x.astype(jnp.float32) - xm) * inv1 * g1 + b1,
+                             0.0).astype(jnp.bfloat16)
+            z1 = y1 @ w1
+            z1m = z1.astype(jnp.float32).mean(0)
+            z1v = z1.astype(jnp.float32).var(0)
+            inv2 = jax.lax.rsqrt(z1v + EPS)
+            y2 = jnp.maximum((z1.astype(jnp.float32) - z1m) * inv2 * g2 + b2,
+                             0.0).astype(jnp.bfloat16)
+            z2 = y2 @ w2
+            return loss_of(z2)
+
+        def fused_fwd(x, g1, b1, w1, g2, b2, w2):
+            sg = jax.lax.stop_gradient
+            xm = sg(x.astype(jnp.float32).mean(0))
+            xv = sg(x.astype(jnp.float32).var(0))
+            z1, z1stats = fc.bn_relu_conv1x1(x, g1, b1, xm, xv, w1,
+                                             None, EPS, False)
+            z1m, z1v = stats_to_mv(z1stats, m)
+            z2, _ = fc.bn_relu_conv1x1(z1, g2, b2, z1m, z1v, w2,
+                                       None, EPS, False)
+            return loss_of(z2)
+
+        argv = (x, g1, b1, w1, g2, b2, w2)
+        tx = bench(xla_fwd, argv, args.iters)
+        tf = bench(fused_fwd, argv, args.iters)
+        print(f"{name:>18} {'fwd':>5} {tx*1e3:8.3f} {tf*1e3:9.3f} "
+              f"{tx/tf:7.2f}x", flush=True)
+
+        def xla_fb(*a):
+            return jax.value_and_grad(xla_fwd, argnums=tuple(range(7)))(*a)
+
+        def fused_fb(*a):
+            return jax.value_and_grad(fused_fwd, argnums=tuple(range(7)))(*a)
+
+        txb = bench(xla_fb, argv, max(args.iters // 2, 3))
+        tfb = bench(fused_fb, argv, max(args.iters // 2, 3))
+        print(f"{name:>18} {'f+b':>5} {txb*1e3:8.3f} {tfb*1e3:9.3f} "
+              f"{txb/tfb:7.2f}x", flush=True)
+        tot_x += tx
+        tot_f += tf
+        tot_xb += txb
+        tot_fb += tfb
+    print(f"{'TOTAL':>18} {'fwd':>5} {tot_x*1e3:8.3f} {tot_f*1e3:9.3f} "
+          f"{tot_x/tot_f:7.2f}x")
+    print(f"{'TOTAL':>18} {'f+b':>5} {tot_xb*1e3:8.3f} {tot_fb*1e3:9.3f} "
+          f"{tot_xb/tot_fb:7.2f}x")
+
+
+if __name__ == "__main__":
+    main()

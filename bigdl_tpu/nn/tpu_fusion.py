@@ -1,0 +1,510 @@
+"""TPU fusion pass — an alternate NHWC lowering of a ``Graph`` model.
+
+Reference precedent (UNVERIFIED, SURVEY.md §0): the mkldnn engine —
+``.../bigdl/nn/mkldnn/*`` is a parallel layer world the engine selects for
+``EngineType.MklDnn``, with its own blocked layouts and conv+ReLU/BN/sum
+fusion (``SpatialConvolution.setReLU/setSum``). ``FusedGraph`` is the
+TPU-engine analog: SAME params/state pytrees as the wrapped ``Graph``
+(checkpoints, serializer and optimizer state interop unchanged), different
+execution.
+
+What it does:
+
+* Executes the DAG **channels-last** (NHWC): XLA:TPU conv performance is
+  layout-neutral (benchmarks/layout_experiment.py), but channels-last makes
+  a 1×1 conv a plain (N·H·W, C)×(C, K) matmul over contiguous rows — the
+  shape the Pallas fused kernels need. Modules without an NHWC adapter run
+  via transpose→module.apply→transpose fallback (correct for any graph,
+  fast for none — the adapter table covers the ResNet/VGG family).
+* Pattern-matches **BN→ReLU→1×1 conv** edges (optionally through the
+  residual ``CAddTable``) and lowers each to one
+  :func:`bigdl_tpu.ops.fused_conv.bn_relu_conv1x1` call — the activation
+  between BN and conv is never materialized in HBM (PERF_ANALYSIS_r2.md:
+  the ``maximum_add_fusion`` passes XLA cannot prologue-fuse).
+* Threads the kernels' per-channel ``Σz/Σz²`` epilogue stats into the next
+  BN (fused or not), so no separate stats pass re-reads a Pallas output.
+* Preserves BN running-stat semantics exactly (biased batch var for
+  normalize, unbiased in the running buffer, ``r = (1−m)r + m·batch``).
+
+Use :func:`maybe_fuse` to wrap a model when the engine enables conv fusion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from bigdl_tpu.nn.activations import ReLU
+from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.containers import Sequential
+from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.nn.module import AbstractModule, Identity
+from bigdl_tpu.nn.normalization import SpatialBatchNormalization
+from bigdl_tpu.nn.pooling import SpatialAveragePooling, SpatialMaxPooling
+from bigdl_tpu.nn.shape_ops import CAddTable
+
+
+def _pallas_min_c() -> int:
+    """Per-edge lowering threshold. Isolated 2-edge chains favor the
+    Pallas kernel at C ≥ 128, but in a full model every custom-call
+    boundary forces XLA to relayout operands to the default layout
+    (PERF_ANALYSIS_r3.md: +20 ms/step of copies), so the default keeps
+    every edge on the XLA dot. Env override: BIGDL_PALLAS_MIN_C=128
+    re-enables the kernels for layout-clean workloads/experiments."""
+    import os
+
+    return int(os.environ.get("BIGDL_PALLAS_MIN_C", str(1 << 30)))
+
+
+class _PNode:
+    """Primitive node of the expanded DAG: a leaf module + its params path
+    (graph key, then container child keys) + predecessor _PNodes."""
+
+    __slots__ = ("module", "path", "preds", "is_input")
+
+    def __init__(self, module, path, preds, is_input=False):
+        self.module = module
+        self.path = path
+        self.preds: List[_PNode] = preds
+        self.is_input = is_input
+
+
+def _expand(graph: Graph):
+    """Graph topo → primitive DAG (Sequentials flattened, params paths
+    recorded). Non-Sequential containers stay opaque primitives."""
+    node_out: Dict[int, _PNode] = {}
+    pnodes: List[_PNode] = []
+
+    def expand_module(module, path, preds):
+        if isinstance(module, Sequential) and len(module.modules) > 0:
+            cur = preds
+            last = None
+            for i, child in enumerate(module.modules):
+                last = expand_module(child, path + (module._child_key(i),),
+                                     cur)
+                cur = [last]
+            return last
+        p = _PNode(module, path, preds)
+        pnodes.append(p)
+        return p
+
+    input_pn = {}
+    for node in graph.topo:
+        nid = id(node)
+        if node in graph.input_nodes:
+            p = _PNode(node.module, (), [], is_input=True)
+            pnodes.append(p)
+            node_out[nid] = p
+            input_pn[nid] = p
+            continue
+        preds = [node_out[id(q)] for q in node.prev]
+        key = graph._module_keys[id(node.module)]
+        node_out[nid] = expand_module(node.module, (key,), preds)
+    outs = [node_out[id(n)] for n in graph.output_nodes]
+    ins = [input_pn[id(n)] for n in graph.input_nodes]
+    return pnodes, ins, outs
+
+
+def _is_fusable_conv(m) -> bool:
+    return (isinstance(m, SpatialConvolution)
+            and m.kernel_w == 1 and m.kernel_h == 1
+            and m.stride_w == 1 and m.stride_h == 1
+            and m.pad_w == 0 and m.pad_h == 0
+            and m.n_group == 1 and not m.with_bias)
+
+
+class _FusedEdge:
+    """One lowered BN→ReLU→conv1×1 edge (optionally through CAddTable)."""
+
+    __slots__ = ("bn", "relu", "conv", "add", "residual_src", "want_y")
+
+    def __init__(self, bn, relu, conv, add=None, residual_src=None,
+                 want_y=False):
+        self.bn = bn
+        self.relu = relu
+        self.conv = conv
+        self.add = add
+        self.residual_src = residual_src
+        self.want_y = want_y
+
+
+def _tree_get(tree, path):
+    for k in path:
+        tree = tree.get(k, {}) if isinstance(tree, dict) else {}
+    return tree
+
+
+def _tree_set(tree, path, value):
+    for k in path[:-1]:
+        tree = tree.setdefault(k, {})
+    tree[path[-1]] = value
+
+
+class FusedGraph(AbstractModule):
+    """Drop-in wrapper: same params/state pytrees as ``graph``, NHWC fused
+    execution. Falls back per-module (with transposes) for anything the
+    adapter table doesn't cover, so output parity holds for any graph."""
+
+    def __init__(self, graph: Graph) -> None:
+        super().__init__()
+        self.graph = graph
+        self.name = graph.name
+        self._build_plan()
+
+    # -- params/state interop: pure delegation -------------------------
+    def init_params(self, rng):
+        return self.graph.init_params(rng)
+
+    def init_state(self):
+        return self.graph.init_state()
+
+    def sub_modules(self):
+        return self.graph.sub_modules()
+
+    # -- plan ----------------------------------------------------------
+    def _build_plan(self) -> None:
+        pnodes, ins, outs = _expand(self.graph)
+        self._pnodes, self._ins, self._outs = pnodes, ins, outs
+        consumers: Dict[int, int] = {}
+        for p in pnodes:
+            for q in p.preds:
+                consumers[id(q)] = consumers.get(id(q), 0) + 1
+        for o in outs:
+            consumers[id(o)] = consumers.get(id(o), 0) + 1
+
+        order = {id(p): i for i, p in enumerate(pnodes)}
+        consumed: Dict[int, _FusedEdge] = {}  # nid -> owning edge
+        edges: Dict[int, _FusedEdge] = {}     # conv nid -> edge
+
+        for conv in pnodes:
+            if not _is_fusable_conv(conv.module) or len(conv.preds) != 1:
+                continue
+            relu = conv.preds[0]
+            if not isinstance(relu.module, ReLU) or id(relu) in consumed:
+                continue
+            if len(relu.preds) != 1:
+                continue
+            src = relu.preds[0]
+            want_y = consumers.get(id(relu), 0) > 1 or relu in outs
+            if want_y:
+                # y's other consumers must run after the conv produces it
+                later = all(order[id(p)] > order[id(conv)]
+                            for p in pnodes
+                            if any(q is relu for q in p.preds)
+                            and p is not conv)
+                if not later:
+                    continue
+            bn = add = residual = None
+            if isinstance(src.module, SpatialBatchNormalization):
+                if consumers.get(id(src), 0) != 1 or len(src.preds) != 1:
+                    continue
+                bn = src
+            elif isinstance(src.module, CAddTable) and len(src.preds) == 2:
+                if consumers.get(id(src), 0) != 1:
+                    continue
+                cand = src.preds[0]
+                if (isinstance(cand.module, SpatialBatchNormalization)
+                        and consumers.get(id(cand), 0) == 1
+                        and len(cand.preds) == 1
+                        and id(cand) not in consumed):
+                    bn, add, residual = cand, src, src.preds[1]
+                else:
+                    continue
+            else:
+                continue
+            if id(bn) in consumed or id(relu) in consumed:
+                continue
+            edge = _FusedEdge(bn, relu, conv, add=add,
+                              residual_src=residual, want_y=want_y)
+            edges[id(conv)] = edge
+            consumed[id(bn)] = edge
+            consumed[id(relu)] = edge
+            if add is not None:
+                consumed[id(add)] = edge
+        self._edges = edges
+        self._consumed = consumed
+
+    # -- execution ------------------------------------------------------
+    def apply(self, params, input, state=None, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.ops.fused_conv import bn_relu_conv1x1
+
+        state = state or {}
+        new_state = jax.tree_util.tree_map(lambda x: x, state)  # deep-ish copy
+        if not isinstance(new_state, dict):
+            new_state = dict(state)
+
+        def pstate(p):
+            return _tree_get(state, p.path)
+
+        def set_state(p, s):
+            _tree_set(new_state, p.path, s)
+
+        def pparams(p):
+            return _tree_get(params, p.path)
+
+        values: Dict[int, Any] = {}
+        stats: Dict[int, Any] = {}  # nid -> (2, C) f32 epilogue stats
+
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+        for pn, v in zip(self._ins, inputs):
+            if v.ndim == 4:  # NCHW boundary -> NHWC internal
+                v = jnp.transpose(v, (0, 2, 3, 1))
+            values[id(pn)] = v
+
+        def batch_stats(x_nhwc, nid, use_cache):
+            """(mean, var) per channel. A fused producer's epilogue stats
+            (``use_cache``) are stop-gradient'd — ONLY the Pallas edge's
+            custom VJP may consume them, because it re-derives the
+            stats-backward terms itself. Every other consumer needs the
+            differentiable jnp reduction (standard autodiff owns the
+            correction), which XLA fuses into an XLA producer's epilogue."""
+            m = x_nhwc.size // x_nhwc.shape[-1]
+            if use_cache and nid in stats:
+                st = stats[nid]
+                mean = st[0] / m
+                var = jnp.maximum(st[1] / m - mean * mean, 0.0)
+                return mean, var, m
+            xf = x_nhwc.astype(jnp.float32)
+            axes = tuple(range(x_nhwc.ndim - 1))
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean,
+                              0.0)
+            return mean, var, m
+
+        def bn_mv(bnode, x_val, src_nid, use_cache=False):
+            """mean/var for this BN + running-stats update (exact
+            BatchNormalization semantics: biased var to normalize, unbiased
+            in the buffer)."""
+            bnmod = bnode.module
+            st = pstate(bnode)
+            if training:
+                mean, var, n = batch_stats(x_val, src_nid, use_cache)
+                unbiased = var * (n / max(n - 1, 1))
+                mom = bnmod.momentum
+                set_state(bnode, {
+                    "running_mean": (1 - mom) * st["running_mean"]
+                    + mom * mean,
+                    "running_var": (1 - mom) * st["running_var"]
+                    + mom * unbiased,
+                })
+            else:
+                mean, var = st["running_mean"], st["running_var"]
+                set_state(bnode, st)
+            return mean, var
+
+        def run_fused(edge):
+            bnode = edge.bn
+            src_nid = id(bnode.preds[0])
+            x_val = values[src_nid]
+            n, h, w_, c = x_val.shape
+            use_pallas = c >= _pallas_min_c()
+            mean, var = bn_mv(bnode, x_val, src_nid, use_cache=use_pallas)
+            bn_p = pparams(bnode)
+            gamma = bn_p.get("weight", jnp.ones((c,), jnp.float32))
+            beta = bn_p.get("bias", jnp.zeros((c,), jnp.float32))
+            w4 = pparams(edge.conv)["weight"]          # OIHW (K, C, 1, 1)
+            w2 = w4[:, :, 0, 0].T                      # (C, K)
+            k = w2.shape[1]
+            # per-edge lowering (measured, benchmarks/fused_conv_experiment
+            # + PERF_ANALYSIS_r3.md): the Pallas kernel wins isolated
+            # chains at C >= 128, but in-model its custom-call boundaries
+            # force layout copies — the default threshold keeps every edge
+            # on the XLA dot (override: BIGDL_PALLAS_MIN_C).
+            if use_pallas:
+                # (N,H,W,C) -> (N·H, W, C) is a FREE view of the tiled
+                # layout; a 2-D flatten would physically repack HBM
+                residual = None
+                if edge.residual_src is not None:
+                    residual = values[id(edge.residual_src)] \
+                        .reshape(n * h, w_, c)
+                out = bn_relu_conv1x1(
+                    x_val.reshape(n * h, w_, c), gamma, beta,
+                    jax.lax.stop_gradient(mean.astype(jnp.float32)),
+                    jax.lax.stop_gradient(var.astype(jnp.float32)),
+                    w2, residual, bnode.module.eps, edge.want_y)
+                stats[id(edge.conv)] = out[1]
+                values[id(edge.conv)] = out[0].reshape(n, h, w_, k)
+                if edge.want_y:
+                    values[id(edge.relu)] = out[2].reshape(n, h, w_, c)
+            else:
+                # 4-D end to end (a reshape of a TPU-tiled NHWC array is a
+                # physical repack), elementwise in the INPUT dtype
+                # (module-BN discipline: f32 intermediates double the HBM
+                # bytes of saved residuals and backward cotangents)
+                inv = (1.0 / jnp.sqrt(var + bnode.module.eps))
+                scale = (inv * gamma).astype(x_val.dtype)
+                shift = (beta - mean * inv * gamma).astype(x_val.dtype)
+                p = x_val * scale + shift
+                if edge.residual_src is not None:
+                    p = p + values[id(edge.residual_src)]
+                y4 = jnp.maximum(p, 0.0)
+                z4 = jax.lax.dot_general(
+                    y4, w2.astype(y4.dtype),
+                    dimension_numbers=(((3,), (0,)), ((), ())))
+                values[id(edge.conv)] = z4
+                if edge.want_y:
+                    values[id(edge.relu)] = y4
+            set_state(edge.relu, {})
+            set_state(edge.conv, {})
+            if edge.add is not None:
+                set_state(edge.add, {})
+
+        def run_prim(p):
+            args = [values[id(q)] for q in p.preds]
+            x = args[0] if len(args) == 1 else args
+            m = p.module
+            if isinstance(m, SpatialConvolution) and x.ndim == 4 \
+                    and m.n_group == 1:
+                values[id(p)] = _conv_nhwc(m, pparams(p), x)
+                set_state(p, pstate(p))
+            elif isinstance(m, SpatialBatchNormalization) and x.ndim == 4:
+                mean, var = bn_mv(p, x, id(p.preds[0]))
+                bn_p = pparams(p)
+                inv = (1.0 / jnp.sqrt(var + m.eps)).astype(x.dtype)
+                out = (x - mean.astype(x.dtype)) * inv
+                if m.affine:
+                    out = out * bn_p["weight"].astype(x.dtype) \
+                        + bn_p["bias"].astype(x.dtype)
+                values[id(p)] = out
+            elif isinstance(m, (SpatialMaxPooling, SpatialAveragePooling)) \
+                    and x.ndim == 4:
+                values[id(p)] = _pool_nhwc(m, x)
+                set_state(p, pstate(p))
+            elif isinstance(m, (ReLU, CAddTable, Identity)) or \
+                    type(m).__name__ in _AGNOSTIC:
+                out, st = m.apply(pparams(p), x, pstate(p),
+                                  training=training, rng=None)
+                values[id(p)] = out
+                set_state(p, st)
+            else:
+                # correct-for-anything fallback: hand the module NCHW
+                def to_nchw(v):
+                    return jnp.transpose(v, (0, 3, 1, 2)) \
+                        if hasattr(v, "ndim") and v.ndim == 4 else v
+
+                def to_nhwc(v):
+                    return jnp.transpose(v, (0, 2, 3, 1)) \
+                        if hasattr(v, "ndim") and v.ndim == 4 else v
+
+                xin = [to_nchw(v) for v in args]
+                xin = xin[0] if len(xin) == 1 else xin
+                out, st = m.apply(pparams(p), xin, pstate(p),
+                                  training=training, rng=None)
+                values[id(p)] = to_nhwc(out)
+                set_state(p, st)
+
+        for p in self._pnodes:
+            if p.is_input:
+                continue
+            if id(p) in self._edges:
+                run_fused(self._edges[id(p)])
+                continue
+            if id(p) in self._consumed:
+                continue  # produced by its owning fused edge
+            run_prim(p)
+
+        def out_val(p):
+            v = values[id(p)]
+            if hasattr(v, "ndim") and v.ndim == 4:
+                v = jnp.transpose(v, (0, 3, 1, 2))  # back to NCHW boundary
+            return v
+
+        outs = [out_val(p) for p in self._outs]
+        single = getattr(self.graph, "_single_output", True)
+        return (outs[0] if single else outs), new_state
+
+    def __repr__(self) -> str:
+        return f"FusedGraph({len(self._edges)} fused edges, {self.graph!r})"
+
+
+# Modules whose apply is layout-indifferent on NHWC values. Reshape/View
+# are here for the conv-zoo pattern only — they follow global pooling, where
+# the spatial dims are already 1×1 and NHWC flatten equals NCHW flatten. A
+# Reshape over real spatial extent is layout-sensitive; such a graph must
+# not be wrapped (parity tests catch it loudly).
+_AGNOSTIC = {
+    "ReLU", "ReLU6", "Tanh", "Sigmoid", "Dropout", "CAddTable", "CMulTable",
+    "Identity", "LogSoftMax", "Linear", "Reshape", "View",
+}
+
+
+def _conv_nhwc(m: SpatialConvolution, params, x):
+    import jax
+    import jax.lax as lax
+
+    if (m.kernel_w == 1 and m.kernel_h == 1 and m.pad_w == 0
+            and m.pad_h == 0 and m.n_group == 1):
+        # 1×1 conv as a dot contracting C on the 4-D value — XLA
+        # prologue/epilogue fuses elementwise neighbors into a dot but NOT
+        # into a convolution op (measured: the dot form is 1.5-2.4×
+        # faster, PERF_ANALYSIS_r3.md); a stride just slices rows first.
+        # No reshape: that would physically repack the tiled NHWC layout.
+        if m.stride_h != 1 or m.stride_w != 1:
+            x = x[:, ::m.stride_h, ::m.stride_w, :]
+        w2 = params["weight"][:, :, 0, 0].T            # (C, K)
+        out = jax.lax.dot_general(
+            x, w2.astype(x.dtype),
+            dimension_numbers=(((3,), (0,)), ((), ())))
+    else:
+        out = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(m.stride_h, m.stride_w),
+            padding=m._padding(),
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+            feature_group_count=m.n_group,
+        )
+    if m.with_bias:
+        out = out + params["bias"][None, None, None, :]
+    return out
+
+
+def _pool_nhwc(m, x):
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    ph, pw = m._pads(x.shape[1], x.shape[2])
+    if isinstance(m, SpatialMaxPooling):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, m.kh, m.kw, 1),
+            window_strides=(1, m.dh, m.dw, 1),
+            padding=((0, 0), ph, pw, (0, 0)),
+        )
+    # average pooling (mirrors SpatialAveragePooling.apply)
+    if m.global_pooling:
+        kh, kw = x.shape[1], x.shape[2]
+    else:
+        kh, kw = m.kh, m.kw
+    saved = (m.kh, m.kw)
+    m.kh, m.kw = kh, kw
+    ph, pw = m._pads(x.shape[1], x.shape[2])
+    m.kh, m.kw = saved
+    sums = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, m.dh, m.dw, 1),
+        padding=((0, 0), ph, pw, (0, 0)),
+    )
+    if not m.divide:
+        return sums
+    if m.count_include_pad:
+        return sums / float(kh * kw)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, m.dh, m.dw, 1),
+        padding=((0, 0), ph, pw, (0, 0)),
+    )
+    return sums / counts
+
+
+def maybe_fuse(model):
+    """Wrap a Graph in FusedGraph when it contains at least one fusable
+    edge; otherwise return it unchanged. The TPU-engine entry point."""
+    if not isinstance(model, Graph):
+        return model
+    fused = FusedGraph(model)
+    return fused if fused._edges else model

@@ -3,11 +3,13 @@
 Reference role (UNVERIFIED, SURVEY.md §0/§2.1): the reference's native math
 backends (MKL/MKL-DNN JNI) provide fast kernels under the generic layer
 API. On TPU, XLA covers that role for gemms/convs; this package holds the
-Pallas kernels for the ops XLA doesn't schedule optimally — currently
-flash attention (fused online-softmax attention, linear memory in sequence
-length).
+Pallas kernels for the ops XLA doesn't schedule optimally — flash
+attention (fused online-softmax attention, linear memory in sequence
+length) and the fused BN→ReLU→1×1-conv training edge (prologue fusion XLA
+cannot do across a batch-stats barrier).
 """
 
 from bigdl_tpu.ops.flash_attention import flash_attention
+from bigdl_tpu.ops.fused_conv import bn_relu_conv1x1
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "bn_relu_conv1x1"]

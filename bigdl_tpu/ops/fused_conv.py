@@ -1,0 +1,575 @@
+"""Fused BN-apply → ReLU → 1×1-conv Pallas kernels (TPU lowering choice).
+
+Reference (UNVERIFIED, SURVEY.md §0): the mkldnn engine precedent —
+``.../bigdl/nn/mkldnn/SpatialConvolution.scala`` fuses ReLU/BN/sum into the
+conv primitive when the engine is mkldnn (``setReLU``/``setSum`` fusion
+flags); this module is the TPU-engine analog.
+
+Why this exists (benchmarks/PERF_ANALYSIS_r2.md): in BN **training**, the
+normalize+ReLU pass cannot fuse into the *producing* conv under XLA:TPU —
+normalization needs the complete batch statistics, which only exist after
+every output tile of the producer is done (the measured
+``maximum_add_fusion`` passes at ~0.7 TFLOP/s / 83% HBM). But it CAN fuse
+into the *consuming* conv's prologue: by the time the next conv runs, the
+stats are a tiny (C,) vector. ResNet bottleneck 3×3→BN→ReLU→1×1 edges are
+exactly this shape, with the 1×1 conv a plain matmul over M = N·H·W rows —
+so the whole edge becomes one Pallas matmul with an elementwise prologue,
+and the ReLU input tensor is never materialized in HBM.
+
+Operand form: every big tensor is ``(G, R, C)`` — G row groups of R rows.
+A channels-last activation ``(N, H, W, C)`` enters as ``(N·H, W, C)``,
+which is a FREE view of the tiled NHWC layout (TPU tiling touches only the
+last two dims); flattening all the way to ``(M, C)`` would physically
+repack HBM (the measured 35 ms/step "data formatting" disaster of the
+first integration attempt). The per-tile ``(bg·R, C)`` flatten happens in
+VMEM, where relayout shuffles are ~free. Plain ``(M, C)`` operands are
+accepted too and viewed as ``(M/bm, bm, C)``.
+
+The op also emits ``sum(z)``/``sum(z²)`` per output channel from the matmul
+epilogue (f32), so the *next* BN's batch stats need no extra pass over z —
+mirroring XLA's conv-epilogue stats fusion (``multiply_reduce_fusion``).
+
+Backward is the full BN-*train* backward (batch statistics are functions of
+x): with p = x̂·γ + β (+ r), y = relu(p), z = y·W and incoming dz,
+
+    dp = (dz @ Wᵀ) ⊙ 1[p > 0]          (+ any extra cotangent on y)
+    dβ = Σ_M dp        dγ = Σ_M dp ⊙ x̂
+    dx = (γ/σ) · (dp − dβ/M − x̂ · dγ/M)
+    dW = yᵀ @ dz       dr = dp
+
+The two reductions live in the dgrad kernel's epilogue; the (M,C)-sized
+``dp`` is the only backward intermediate materialized (XLA materializes the
+same-sized dy *and* runs a separate masked-scale pass). ``mean``/``var``
+inputs are treated as *values* (their gradient contribution is the
+−dβ/M − x̂·dγ/M correction above, i.e. already inside dx); callers must
+pass stats computed from the same ``x``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _is_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def _auto_interpret() -> bool:
+    return not _is_tpu()
+
+
+_VMEM_BUDGET = 10 * 1024 * 1024  # leave headroom under the 16M scoped limit
+
+
+def _pick_div(n: int, target: int, unit: int = 1) -> int:
+    """Largest divisor of n that is ≤ target and a multiple of ``unit``;
+    falls back through smaller multiples, then to 1/n."""
+    for k in range(target // unit, 0, -1):
+        if n % (unit * k) == 0:
+            return unit * k
+    return n
+
+
+def _pick_bk(k: int, target: int = 512) -> int:
+    for cand in (target, 256, 128):
+        if k % cand == 0:
+            return cand
+    return k
+
+
+def _rows_cap(bytes_per_row: int, fixed_bytes: int, target: int) -> int:
+    cap = max((_VMEM_BUDGET - fixed_bytes) // max(bytes_per_row, 1), 128)
+    return min(target, cap)
+
+
+def _as_grc(x, rows_target: int):
+    """View x as (G, R, C) row groups: free for both 2-D (M, C) and 3-D
+    (G0, R, C) inputs. Returns (x3, bg, n_groups_per_block_grid)."""
+    if x.ndim == 3:
+        g, r, c = x.shape
+        bg = _pick_div(g, max(rows_target // r, 1))
+        return x, bg
+    m, c = x.shape
+    bm = _pick_div(m, rows_target, unit=128)
+    return x.reshape(m // bm, bm, c), 1
+
+
+def _pack_factor(m: int, c: int) -> int:
+    """Lane packing (2-D path only): C below the 128-lane width wastes half
+    (or more) of every VMEM tile and DMA burst. Viewing (M, C) as
+    (M/f, f·C) with a block-diagonal weight restores full lanes."""
+    f = 128 // c if (c < 128 and 128 % c == 0) else 1
+    while f > 1 and m % f:
+        f //= 2
+    return max(f, 1)
+
+
+def _block_diag_w(w, f: int):
+    """(C, K) → (f·C, f·K) with f copies of w on the diagonal."""
+    c, k = w.shape
+    eye = jnp.eye(f, dtype=w.dtype)
+    return (eye[:, None, :, None] * w[None, :, None, :]).reshape(f * c, f * k)
+
+
+def _tile_vec(v, f: int):
+    return jnp.tile(v.reshape(1, -1), (f, 1)).reshape(-1)
+
+
+def _esize(x) -> int:
+    return 2 if x.dtype == jnp.bfloat16 else 4
+
+
+def _flat(ref):
+    """(bg, R, C) block → (bg·R, C) rows — a VMEM relayout, not HBM."""
+    s = ref.shape
+    return ref[...].reshape(-1, s[-1])
+
+
+# ---------------------------------------------------------------------------
+# forward: z = relu(x*scale + shift (+ r)) @ w, with per-channel z stats
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, s_ref, b_ref, w_ref, r_ref, z_ref, zstat_ref, y_ref,
+                y_s, stat_s, *, n_mt: int, with_residual: bool,
+                want_y: bool):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        p = _flat(x_ref).astype(jnp.float32) * s_ref[0] + b_ref[0]
+        if with_residual:
+            p = p + _flat(r_ref).astype(jnp.float32)
+        y = jnp.maximum(p, 0.0)
+        y_s[...] = y.astype(y_s.dtype)
+        if want_y:
+            y_ref[...] = y.astype(y_ref.dtype).reshape(y_ref.shape)
+
+    z32 = jnp.dot(y_s[...], w_ref[0], preferred_element_type=jnp.float32)
+    z_ref[...] = z32.astype(z_ref.dtype).reshape(z_ref.shape)
+
+    part = jnp.stack([jnp.sum(z32, axis=0), jnp.sum(z32 * z32, axis=0)])
+
+    @pl.when(i == 0)
+    def _():
+        stat_s[j] = part
+
+    @pl.when(i > 0)
+    def _():
+        stat_s[j] = stat_s[j] + part
+
+    @pl.when(i == n_mt - 1)
+    def _():
+        zstat_ref[0] = stat_s[j]
+
+
+def fused_scale_relu_matmul(x, scale, shift, w, residual=None,
+                            want_y: bool = False,
+                            out_dtype=None,
+                            bk: Optional[int] = None,
+                            interpret: Optional[bool] = None):
+    """``z = relu(x·scale + shift (+ residual)) @ w`` in one HBM pass.
+
+    x: (M, C) or (G, R, C); scale/shift: (C,) f32 (pre-folded BN: γ/σ and
+    β − μγ/σ); w: (C, K). Returns ``(z, zstats[, y])`` with ``zstats``
+    (2, K) f32 = per-channel ``[Σz, Σz²]`` from the matmul epilogue;
+    z/y mirror x's rank. ``want_y`` additionally materializes the
+    post-ReLU activation (for edges whose activation has a second
+    consumer, e.g. the block-join feeding both the next conv and the next
+    shortcut — the kernel then saves the re-read, not the write).
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    c = x.shape[-1]
+    k = w.shape[1]
+    if x.ndim == 2:
+        m = x.shape[0]
+        f = _pack_factor(m, c)
+        if f > 1:
+            out = fused_scale_relu_matmul(
+                x.reshape(m // f, f * c), _tile_vec(scale, f),
+                _tile_vec(shift, f), _block_diag_w(w, f),
+                residual=None if residual is None
+                else residual.reshape(m // f, f * c),
+                want_y=want_y, out_dtype=out_dtype, bk=bk,
+                interpret=interpret)
+            z = out[0].reshape(m, k)
+            zstat = out[1].reshape(2, f, k).sum(1)
+            if want_y:
+                return z, zstat, out[2].reshape(m, c)
+            return z, zstat
+    bk = bk or _pick_bk(k)
+    es = _esize(x)
+    per_row = (es * c * (2 + 1
+                         + (2 if residual is not None else 0)
+                         + (2 if want_y else 0))
+               + es * bk * 2)
+    x3, bg = _as_grc(x, _rows_cap(per_row, 2 * es * c * bk, 1024))
+    g, r, _ = x3.shape
+    rows = bg * r
+    n_mt, n_kt = g // bg, k // bk
+    with_residual = residual is not None
+    r3 = residual.reshape(x3.shape) if with_residual else \
+        jnp.zeros((1, 1, c), x.dtype)
+    out_dtype = out_dtype or x.dtype
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    rspec = (pl.BlockSpec((bg, r, c), lambda i, j: (i, 0, 0))
+             if with_residual else
+             pl.BlockSpec((1, 1, c), lambda i, j: (0, 0, 0)))
+    kernel = functools.partial(_fwd_kernel, n_mt=n_mt,
+                               with_residual=with_residual, want_y=want_y)
+    z, zstat, y = pl.pallas_call(
+        kernel,
+        grid=(n_mt, n_kt),
+        in_specs=[
+            pl.BlockSpec((bg, r, c), lambda i, j: (i, 0, 0)),   # x
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),          # scale
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),          # shift
+            pl.BlockSpec((1, c, bk), lambda i, j: (0, 0, j)),   # w
+            rspec,                                              # residual
+        ],
+        out_specs=[
+            pl.BlockSpec((bg, r, bk), lambda i, j: (i, 0, j)),  # z
+            pl.BlockSpec((1, 2, bk), lambda i, j: (0, 0, j)),   # zstats
+            (pl.BlockSpec((bg, r, c), lambda i, j: (i, 0, 0))
+             if want_y else
+             pl.BlockSpec((1, 1, c), lambda i, j: (0, 0, 0))),  # y
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, r, k), out_dtype),
+            jax.ShapeDtypeStruct((1, 2, k), jnp.float32),
+            jax.ShapeDtypeStruct((g, r, c) if want_y else (1, 1, c),
+                                 out_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, c), jnp.bfloat16
+                       if x.dtype == jnp.bfloat16 else jnp.float32),
+            pltpu.VMEM((n_kt, 2, bk), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(x3, scale.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, c).astype(jnp.float32), w[None], r3)
+    zstat = zstat[0]
+    if x.ndim == 2:
+        z = z.reshape(x.shape[0], k)
+        if want_y:
+            return z, zstat, y.reshape(x.shape)
+        return z, zstat
+    if want_y:
+        return z, zstat, y
+    return z, zstat
+
+
+# ---------------------------------------------------------------------------
+# backward kernel 1 (dgrad): dp = (dz @ wᵀ) ⊙ relu-mask, plus q1/q2
+# ---------------------------------------------------------------------------
+
+
+def _dgrad_kernel(dz_ref, w_ref, x_ref, s_ref, b_ref, r_ref, g_ref,
+                  mu_ref, is_ref, dp_ref, q_ref, q_s, *,
+                  n_mt: int, with_residual: bool, with_extra: bool):
+    i = pl.program_id(0)
+
+    dy = jax.lax.dot_general(
+        _flat(dz_ref), w_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if with_extra:
+        dy = dy + _flat(g_ref).astype(jnp.float32)
+    x32 = _flat(x_ref).astype(jnp.float32)
+    p = x32 * s_ref[0] + b_ref[0]
+    if with_residual:
+        p = p + _flat(r_ref).astype(jnp.float32)
+    dp = jnp.where(p > 0.0, dy, 0.0)
+    dp_ref[...] = dp.astype(dp_ref.dtype).reshape(dp_ref.shape)
+
+    xhat = (x32 - mu_ref[0]) * is_ref[0]
+    part = jnp.stack([jnp.sum(dp, axis=0), jnp.sum(dp * xhat, axis=0)])
+
+    @pl.when(i == 0)
+    def _():
+        q_s[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        q_s[...] = q_s[...] + part
+
+    @pl.when(i == n_mt - 1)
+    def _():
+        q_ref[0] = q_s[...]
+
+
+def fused_dgrad(dz, w, x, scale, shift, mean, inv_std, residual=None,
+                extra_dy=None, interpret: Optional[bool] = None):
+    """``dp = (dz@wᵀ [+ extra_dy]) ⊙ 1[p>0]`` with epilogue reductions
+    ``q = (Σ dp, Σ dp·x̂)`` — dβ/dγ and the BN-train dx correction terms,
+    all in the one pass that reads dz. dz: (M, K)/(G, R, K); x & friends:
+    (M, C)/(G, R, C); dp mirrors x's rank."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    k = dz.shape[-1]
+    c = w.shape[0]
+    if x.ndim == 2:
+        m = x.shape[0]
+        f = _pack_factor(m, c)
+        if f > 1:
+            dp, q = fused_dgrad(
+                dz.reshape(m // f, f * k), _block_diag_w(w, f),
+                x.reshape(m // f, f * c), _tile_vec(scale, f),
+                _tile_vec(shift, f), _tile_vec(mean, f),
+                _tile_vec(inv_std, f),
+                residual=None if residual is None
+                else residual.reshape(m // f, f * c),
+                extra_dy=None if extra_dy is None
+                else extra_dy.reshape(m // f, f * c),
+                interpret=interpret)
+            return dp.reshape(m, c), q.reshape(2, f, c).sum(1)
+    es = _esize(x)
+    per_row = es * (k * 2 + c * (2 + 2
+                                 + (2 if residual is not None else 0)
+                                 + (2 if extra_dy is not None else 0)))
+    x3, bg = _as_grc(x, _rows_cap(per_row, 2 * es * c * k, 512))
+    g, r, _ = x3.shape
+    dz3 = dz.reshape(g, r, k)
+    n_mt = g // bg
+    with_residual = residual is not None
+    with_extra = extra_dy is not None
+    r3 = residual.reshape(g, r, c) if with_residual else \
+        jnp.zeros((1, 1, c), x.dtype)
+    g3 = extra_dy.reshape(g, r, c) if with_extra else \
+        jnp.zeros((1, 1, c), x.dtype)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    big = lambda: pl.BlockSpec((bg, r, c), lambda i: (i, 0, 0))
+    small = lambda: pl.BlockSpec((1, 1, c), lambda i: (0, 0, 0))
+    vec = lambda: pl.BlockSpec((1, c), lambda i: (0, 0))
+    kernel = functools.partial(_dgrad_kernel, n_mt=n_mt,
+                               with_residual=with_residual,
+                               with_extra=with_extra)
+    dp, q = pl.pallas_call(
+        kernel,
+        grid=(n_mt,),
+        in_specs=[
+            pl.BlockSpec((bg, r, k), lambda i: (i, 0, 0)),      # dz
+            pl.BlockSpec((1, c, k), lambda i: (0, 0, 0)),       # w
+            big(),                                              # x
+            vec(), vec(),                                       # scale, shift
+            big() if with_residual else small(),                # residual
+            big() if with_extra else small(),                   # extra_dy
+            vec(), vec(),                                       # mean, inv_std
+        ],
+        out_specs=[
+            pl.BlockSpec((bg, r, c), lambda i: (i, 0, 0)),      # dp
+            pl.BlockSpec((1, 2, c), lambda i: (0, 0, 0)),       # q
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, r, c), x.dtype),
+            jax.ShapeDtypeStruct((1, 2, c), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, c), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(dz3, w[None], x3,
+      scale.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, c).astype(jnp.float32),
+      r3, g3,
+      mean.reshape(1, c).astype(jnp.float32),
+      inv_std.reshape(1, c).astype(jnp.float32))
+    q = q[0]
+    if x.ndim == 2:
+        return dp.reshape(x.shape), q
+    return dp, q
+
+
+# ---------------------------------------------------------------------------
+# backward kernel 2 (wgrad): dW = yᵀ @ dz with y recomputed in the prologue
+# ---------------------------------------------------------------------------
+
+
+def _wgrad_kernel(x_ref, s_ref, b_ref, r_ref, dz_ref, dw_ref, acc_s, *,
+                  n_mt: int, with_residual: bool):
+    i = pl.program_id(0)
+    p = _flat(x_ref).astype(jnp.float32) * s_ref[0] + b_ref[0]
+    if with_residual:
+        p = p + _flat(r_ref).astype(jnp.float32)
+    y = jnp.maximum(p, 0.0).astype(dz_ref.dtype)
+    part = jax.lax.dot_general(
+        y, _flat(dz_ref),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == 0)
+    def _():
+        acc_s[...] = part
+
+    @pl.when(i > 0)
+    def _():
+        acc_s[...] = acc_s[...] + part
+
+    @pl.when(i == n_mt - 1)
+    def _():
+        dw_ref[0] = acc_s[...].astype(dw_ref.dtype)
+
+
+def fused_wgrad(x, scale, shift, dz, residual=None, out_dtype=jnp.float32,
+                interpret: Optional[bool] = None):
+    """``dW = relu(x·scale+shift(+r))ᵀ @ dz`` — the activation is recomputed
+    from x on the fly (never stored), so the forward needn't keep y."""
+    if interpret is None:
+        interpret = _auto_interpret()
+    c = x.shape[-1]
+    k = dz.shape[-1]
+    if x.ndim == 2:
+        m = x.shape[0]
+        f = _pack_factor(m, c)
+        if f > 1:
+            dw2 = fused_wgrad(
+                x.reshape(m // f, f * c), _tile_vec(scale, f),
+                _tile_vec(shift, f), dz.reshape(m // f, f * k),
+                residual=None if residual is None
+                else residual.reshape(m // f, f * c),
+                out_dtype=out_dtype, interpret=interpret)
+            # true dW is the sum of the diagonal (C, K) blocks
+            dw4 = dw2.reshape(f, c, f, k)
+            idx = jnp.arange(f)
+            return dw4[idx, :, idx, :].sum(0)
+    es = _esize(x)
+    per_row = es * (k * 2 + c * (2
+                                 + (2 if residual is not None else 0)))
+    x3, bg = _as_grc(x, _rows_cap(per_row, 4 * c * k, 512))
+    g, r, _ = x3.shape
+    dz3 = dz.reshape(g, r, k)
+    n_mt = g // bg
+    with_residual = residual is not None
+    r3 = residual.reshape(g, r, c) if with_residual else \
+        jnp.zeros((1, 1, c), x.dtype)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_wgrad_kernel, n_mt=n_mt,
+                               with_residual=with_residual)
+    dw = pl.pallas_call(
+        kernel,
+        grid=(n_mt,),
+        in_specs=[
+            pl.BlockSpec((bg, r, c), lambda i: (i, 0, 0)),      # x
+            pl.BlockSpec((1, c), lambda i: (0, 0)),             # scale
+            pl.BlockSpec((1, c), lambda i: (0, 0)),             # shift
+            (pl.BlockSpec((bg, r, c), lambda i: (i, 0, 0))
+             if with_residual else
+             pl.BlockSpec((1, 1, c), lambda i: (0, 0, 0))),     # residual
+            pl.BlockSpec((bg, r, k), lambda i: (i, 0, 0)),      # dz
+        ],
+        out_specs=pl.BlockSpec((1, c, k), lambda i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, c, k), out_dtype),
+        scratch_shapes=[pltpu.VMEM((c, k), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x3, scale.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, c).astype(jnp.float32), r3, dz3)
+    return dw[0]
+
+
+# ---------------------------------------------------------------------------
+# the differentiable op: BN(train, batch stats) → ReLU → 1×1 conv
+# ---------------------------------------------------------------------------
+
+
+def bn_relu_conv1x1(x, gamma, beta, mean, var, w, residual=None,
+                    eps: float = 1e-5, want_y: bool = False):
+    """Differentiable fused edge over channels-last views.
+
+    x: (M, C) or (G, R, C) pre-BN activations (pass an NHWC activation as
+    ``x4.reshape(N·H, W, C)`` — a free view; a full 2-D flatten physically
+    repacks the tiled layout); mean/var: the *batch* stats of x over all
+    rows (pass running stats at inference); w: (C, K); residual: shaped
+    like x or None. Returns ``(z, zstats)`` or ``(z, zstats, y)`` — see
+    :func:`fused_scale_relu_matmul`. Gradients implement the full BN-train
+    backward (mean/var receive zeros; their chain-rule contribution is the
+    q1/q2 correction inside dx — callers MUST pass stats of this same x).
+
+    ``zstats`` is returned under ``stop_gradient``: it exists so the NEXT
+    fused edge can form its batch stats without re-reading z, and that edge
+    owns the stats' chain-rule contribution (its own q1/q2 correction on
+    dz) — so no gradient may also flow through zstats, or it would be
+    double-counted.
+    """
+    out = _bn_relu_conv1x1_vjp(x, gamma, beta, mean, var, w, residual,
+                               eps, want_y)
+    return (out[0], jax.lax.stop_gradient(out[1]), *out[2:])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _bn_relu_conv1x1_vjp(x, gamma, beta, mean, var, w, residual,
+                         eps: float = 1e-5, want_y: bool = False):
+    return _fwd(x, gamma, beta, mean, var, w, residual, eps, want_y)
+
+
+def _fold(gamma, beta, mean, var, eps):
+    inv_std = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = gamma.astype(jnp.float32) * inv_std
+    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+    return scale, shift, inv_std
+
+
+def _fwd(x, gamma, beta, mean, var, w, residual, eps, want_y):
+    scale, shift, _ = _fold(gamma, beta, mean, var, eps)
+    return fused_scale_relu_matmul(x, scale, shift, w, residual,
+                                   want_y=want_y)
+
+
+def _fwd_rule(x, gamma, beta, mean, var, w, residual, eps, want_y):
+    out = _fwd(x, gamma, beta, mean, var, w, residual, eps, want_y)
+    return out, (x, gamma, beta, mean, var, w, residual)
+
+
+def _bwd_rule(eps, want_y, res, cts):
+    x, gamma, beta, mean, var, w, residual = res
+    if want_y:
+        dz, _dzstat, dy_extra = cts
+    else:
+        dz, _dzstat = cts
+        dy_extra = None
+    scale, shift, inv_std = _fold(gamma, beta, mean, var, eps)
+    c = x.shape[-1]
+    m = x.size // c
+
+    dp, q = fused_dgrad(dz.astype(x.dtype), w, x, scale, shift,
+                        mean, inv_std, residual=residual,
+                        extra_dy=dy_extra)
+    dbeta, dgamma = q[0], q[1]
+    # BN-train dx: (γ/σ)(dp − dβ/M − x̂·dγ/M) — one XLA elementwise pass
+    # (fusable with neighbors); x̂ recomputed from x. The per-channel
+    # factors downcast to the data dtype (module-BN discipline: f32
+    # intermediates would double this pass's HBM bytes).
+    xhat = (x - mean.astype(x.dtype)) * inv_std.astype(x.dtype)
+    dx = (scale.astype(x.dtype)
+          * (dp - (dbeta / m).astype(x.dtype)
+             - xhat * (dgamma / m).astype(x.dtype)))
+    dw = fused_wgrad(x, scale, shift, dz.astype(x.dtype), residual=residual,
+                     out_dtype=w.dtype)
+    dresidual = dp if residual is not None else None
+    zeros = lambda a: jnp.zeros_like(a)
+    return (dx, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype),
+            zeros(mean), zeros(var), dw, dresidual)
+
+
+_bn_relu_conv1x1_vjp.defvjp(_fwd_rule, _bwd_rule)

@@ -451,9 +451,11 @@ class Optimizer:
                     b = next(data_iter)
                 except StopIteration:
                     logger.warning(
-                        "data iterator exhausted before end_when fired — "
-                        "a directly-constructed stateful Trigger without a "
-                        "side-effect-free peek_fn can cause this; stopping")
+                        "data iterator exhausted before end_when fired; "
+                        "stopping. (Possible causes: the iterator yields "
+                        "fewer batches than dataset.size() implies, or a "
+                        "directly-constructed stateful Trigger without a "
+                        "side-effect-free peek_fn.)")
                     break
                 next_ready = (*place_batch(b), b.size())
             inp, tgt, bsz = next_ready
