@@ -422,6 +422,35 @@ class DistriOptimizer(Optimizer):
             return self._arp.to_full(params)
         return params
 
+    def _ckpt_opt_state_to_host(self, opt_state):
+        """Partitioned mode: every opt-state leaf is (n, ...) sharded over
+        'data' — in a pod those arrays span non-addressable devices, so
+        gather each to a full host copy (the slot analog of to_full)."""
+        import jax
+
+        if self.parameter_mode != "partitioned":
+            return opt_state
+
+        def to_host(leaf):
+            if getattr(leaf, "is_fully_addressable", True) is False and \
+                    not getattr(leaf, "is_fully_replicated", False):
+                from jax.experimental import multihost_utils
+
+                return multihost_utils.process_allgather(leaf, tiled=True)
+            return np.asarray(leaf)
+
+        return jax.tree_util.tree_map(to_host, opt_state)
+
+    def _opt_state_to_device(self, opt_state):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.parameter_mode != "partitioned":
+            return opt_state
+        sh = NamedSharding(self.mesh(), P("data"))
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(np.asarray(leaf), sh), opt_state)
+
     def _host_params_to_device(self, params):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P

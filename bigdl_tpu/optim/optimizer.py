@@ -249,6 +249,7 @@ class Optimizer:
                 "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
                 "epoch": np.int64(state["epoch"]),
                 "neval": np.int64(state["neval"]),
+                "seen": np.int64(state.get("seen", 0)),
             }
             ckptr.save(target, blob, force=True)
             return
@@ -265,6 +266,7 @@ class Optimizer:
                 "opt_state": opt_state,
                 "epoch": state["epoch"],
                 "neval": state["neval"],
+                "seen": state.get("seen", 0),
             },
             os.path.join(self.checkpoint_path, f"optimMethod{tag}"),
             over_write=True,
@@ -364,11 +366,15 @@ class Optimizer:
             sched.record_score(score)
         return score
 
-    def optimize(self):
+    def optimize(self, resume: bool = False):
+        """``resume=True`` restarts from the latest checkpoint under
+        ``set_checkpoint``'s path before the first attempt — the pod
+        restart-after-kill entry point (within-process failures always
+        retry from checkpoint regardless)."""
         last_err = None
         for attempt in range(self.retry_times):
             try:
-                return self._optimize_once(resume=attempt > 0)
+                return self._optimize_once(resume=resume or attempt > 0)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # bounded retry from checkpoint (§5.3)
@@ -404,6 +410,12 @@ class Optimizer:
     def _host_params_to_device(self, params):
         return params
 
+    def _ckpt_opt_state_to_host(self, opt_state):
+        return opt_state
+
+    def _opt_state_to_device(self, opt_state):
+        return opt_state
+
     def _optimize_once(self, resume: bool = False):
         import jax
 
@@ -418,9 +430,10 @@ class Optimizer:
                 mblob, oblob = snap
                 params = self._host_params_to_device(mblob["params"])
                 model_state = mblob.get("state", mblob.get("model_state"))
-                opt_state = oblob["opt_state"]
+                opt_state = self._opt_state_to_device(oblob["opt_state"])
                 state["epoch"] = oblob["epoch"]
                 state["neval"] = oblob["neval"]
+                state["seen"] = oblob.get("seen", 0)
                 logger.info("resumed from checkpoint at iteration %d", state["neval"])
 
         from bigdl_tpu.utils.random_gen import RNG
@@ -430,6 +443,16 @@ class Optimizer:
         data_iter = self.dataset.data(train=True)
         epoch_size = self.dataset.size()
         seen_this_epoch = 0
+        if resume and state["neval"] > 1:
+            # replay the deterministic stream up to the checkpointed
+            # position so the continued trajectory consumes exactly the
+            # batches an uninterrupted run would (epochs reshuffle by
+            # epoch index, so full epochs must be replayed, not skipped)
+            target = (state["epoch"] - 1) * epoch_size + state.get("seen", 0)
+            skipped = 0
+            while skipped < target:
+                skipped += next(data_iter).size()
+            seen_this_epoch = state.get("seen", 0)
         next_ready = None            # (inp, tgt, bsz) placed ahead of time
         epoch_start = time.time()
 
@@ -491,6 +514,7 @@ class Optimizer:
             state["neval"] += 1
             self.optim_method.state["neval"] = state["neval"]
             seen_this_epoch += bsz
+            state["seen"] = seen_this_epoch
 
             if self.train_summary is not None:
                 self.train_summary.add_scalar("Loss", loss_f, state["neval"] - 1)
@@ -525,6 +549,7 @@ class Optimizer:
                 state["epoch"] += 1
                 self.optim_method.state["epoch"] = state["epoch"]
                 seen_this_epoch = 0
+                state["seen"] = 0
                 epoch_start = time.time()
 
             if self.validation_trigger is not None and self.validation_trigger(state):
@@ -536,7 +561,8 @@ class Optimizer:
                     state["score"] = score
             if self.checkpoint_trigger is not None and self.checkpoint_trigger(state):
                 self._checkpoint(
-                    state, self._ckpt_params_to_host(params), model_state, opt_state
+                    state, self._ckpt_params_to_host(params), model_state,
+                    self._ckpt_opt_state_to_host(opt_state),
                 )
 
         if self._profile is not None and self._profile.get("active"):

@@ -77,12 +77,16 @@ def gpipe(fn: Callable, stage_params, microbatches, axis_name: str = "pipe"):
         return recv, outputs
 
     # carries are device-varying (each chip holds different in-flight data);
-    # mark the initial zeros as such for shard_map's replication typing
+    # mark the initial zeros as such for shard_map's replication typing.
+    # Deriving them FROM the input (×0) also inherits whatever OTHER mesh
+    # axes the microbatches vary over (e.g. 'data' on a composed
+    # DP×TP×PP mesh) — fresh zeros would type as replicated there and the
+    # fori_loop carry would mismatch its body.
     pcast = getattr(lax, "pcast", None)
     vary = ((lambda t: pcast(t, axis_name, to="varying")) if pcast is not None
             else (lambda t: lax.pvary(t, axis_name)))
-    recv0 = vary(jnp.zeros(mb_shape, out_dtype))
-    out0 = vary(jnp.zeros((M,) + mb_shape, out_dtype))
+    recv0 = vary((microbatches[0] * 0).astype(out_dtype))
+    out0 = vary((microbatches * 0).astype(out_dtype))
     _, outputs = lax.fori_loop(0, M + n_stages - 1, tick, (recv0, out0))
     # replicate the last stage's outputs to every chip
     outputs = lax.psum(

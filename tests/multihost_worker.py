@@ -13,6 +13,7 @@ def main():
     pid = int(sys.argv[1])
     port = sys.argv[2]
     out_dir = sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "orig"
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -57,26 +58,69 @@ def main():
     ds = DataSet.distributed(samples)
 
     model = LeNet5(10)
+    n_iter = 3 if mode == "orig" else 6
     opt = Optimizer(
         model=model, dataset=ds, criterion=ClassNLLCriterion(),
-        batch_size=32, end_trigger=Trigger.max_iteration(3),
+        batch_size=32, end_trigger=Trigger.max_iteration(n_iter),
         parameter_mode="partitioned", mesh=mesh,
     )
     opt.set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
 
-    # pod validation: each process holds HALF the 100-sample val set; the
-    # logged result must be the MERGED global count (driver-side reduce)
     import logging
 
     logging.basicConfig(level=logging.INFO, stream=sys.stdout, force=True)
-    from bigdl_tpu.optim import Top1Accuracy
 
-    val = [Sample(rs.rand(1, 28, 28).astype(np.float32),
-                  np.float32(i % 10 + 1)) for i in range(100)]
-    opt.set_validation(Trigger.several_iteration(3),
-                       DataSet.distributed(val), [Top1Accuracy()],
-                       batch_size=32)
-    trained = opt.optimize()
+    ckpt = os.path.join(out_dir, f"ckpt_{pid}")
+    every_iter = Trigger(lambda s: True, lambda s: False)
+    if mode == "orig":
+        # pod validation: each process holds HALF the 100-sample val set;
+        # the logged result must be the MERGED global count (driver-side
+        # reduce)
+        from bigdl_tpu.optim import Top1Accuracy
+
+        val = [Sample(rs.rand(1, 28, 28).astype(np.float32),
+                      np.float32(i % 10 + 1)) for i in range(100)]
+        opt.set_validation(Trigger.several_iteration(3),
+                           DataSet.distributed(val), [Top1Accuracy()],
+                           batch_size=32)
+        trained = opt.optimize()
+    elif mode == "straight":
+        trained = opt.optimize()
+    elif mode == "crash":
+        # checkpoint every iteration, then die HARD (os._exit — no python
+        # cleanup, the closest in-env analog of a killed pod worker) at the
+        # top of iteration 4, with 3 steps committed to disk
+        opt.set_checkpoint(ckpt, every_iter)
+
+        def crash_fn(s):
+            if s["neval"] >= 4:
+                sys.stdout.flush()
+                os._exit(3)
+            return False
+
+        opt.set_end_when(Trigger(crash_fn, lambda s: False))
+        opt.optimize()
+        raise AssertionError("crash worker should have _exit'd")
+    elif mode == "resume":
+        # fresh process: restart from this worker's checkpoint and finish
+        opt.set_checkpoint(ckpt, every_iter)
+        trained = opt.optimize(resume=True)
+    elif mode == "retry":
+        # transient in-process failure at iteration 4 on BOTH workers; the
+        # bounded retry reloads the iteration-3 checkpoint and continues
+        opt.set_checkpoint(ckpt, every_iter)
+        fired = {"n": 0}
+
+        def flaky_fn(s):
+            if s["neval"] >= 4 and fired["n"] == 0:
+                fired["n"] = 1
+                raise RuntimeError("injected transient pod failure")
+            return s["neval"] > n_iter
+
+        opt.set_end_when(Trigger(flaky_fn, lambda s: False))
+        trained = opt.optimize()
+    else:
+        raise SystemExit(f"unknown mode {mode}")
 
     ws, _ = trained.parameters()
     flat = np.concatenate([np.asarray(w).reshape(-1) for w in ws])

@@ -24,13 +24,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distri_optimizer(tmp_path):
+def _run_pod(tmp_path, mode, expect_rc=0, timeout=240):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), str(port), str(tmp_path)],
+            [sys.executable, _WORKER, str(pid), str(port), str(tmp_path),
+             mode],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env,
         )
@@ -39,14 +40,22 @@ def test_two_process_distri_optimizer(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert p.returncode == expect_rc, (
+            f"worker {pid} rc={p.returncode} (want {expect_rc}):\n"
+            f"{out[-3000:]}")
+    return outs
+
+
+def test_two_process_distri_optimizer(tmp_path):
+    outs = _run_pod(tmp_path, "orig")
+    for pid, out in enumerate(outs):
         # pod validation merged globally: 2 x 50-sample shards -> count=100
         assert "count=100" in out, f"worker {pid} output:\n{out[-3000:]}"
 
@@ -56,3 +65,43 @@ def test_two_process_distri_optimizer(tmp_path):
     np.testing.assert_array_equal(p0, p1)
     # and training actually moved the params (not a frozen no-op)
     assert float(np.abs(p0).sum()) > 0
+
+
+def test_pod_checkpoint_kill_resume(tmp_path):
+    """Pod durability (round-2 verdict item #4): checkpoint mid-run in
+    partitioned mode, kill both workers hard (os._exit), restart fresh
+    processes that resume from disk — the continued trajectory must land
+    bit-identical to an uninterrupted 6-iteration run."""
+    straight = tmp_path / "straight"
+    straight.mkdir()
+    _run_pod(straight, "straight")
+    ref = np.load(straight / "params_0.npy")
+
+    pod = tmp_path / "pod"
+    pod.mkdir()
+    _run_pod(pod, "crash", expect_rc=3)
+    for pid in (0, 1):
+        assert (pod / f"ckpt_{pid}" / "model").exists(), (
+            "no checkpoint written before the kill")
+    _run_pod(pod, "resume")
+    for pid in (0, 1):
+        out = np.load(pod / f"params_{pid}.npy")
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_pod_bounded_retry(tmp_path):
+    """The §5.3 retry loop in its pod mode: BOTH workers hit an injected
+    transient failure at iteration 4, reload their iteration-3 checkpoints
+    in-process, and must still converge to the uninterrupted-run params."""
+    straight = tmp_path / "straight"
+    straight.mkdir()
+    _run_pod(straight, "straight")
+    ref = np.load(straight / "params_0.npy")
+
+    pod = tmp_path / "pod"
+    pod.mkdir()
+    outs = _run_pod(pod, "retry")
+    for pid, out in enumerate(outs):
+        assert "retrying from checkpoint" in out, out[-2000:]
+        arr = np.load(pod / f"params_{pid}.npy")
+        np.testing.assert_array_equal(arr, ref)
