@@ -7,9 +7,15 @@ Reference (UNVERIFIED, SURVEY.md §0): ``.../bigdl/nn/quantized/`` —
 TPU-native redesign: symmetric per-output-channel weight quantization to
 int8 at conversion time + dynamic per-row activation quantization at run
 time; the inner product runs as a TRUE int8×int8→int32 ``dot_general`` /
-``conv_general_dilated`` (``preferred_element_type=int32``) which XLA lowers
-onto the MXU's native int8 path (2× the bf16 rate on v5e), then one fused
+``conv_general_dilated`` (``preferred_element_type=int32``), then one fused
 rescale back to float. Inference-only, like the reference.
+
+Measured reality check (round 3, ``benchmarks/int8_bench.py`` on v5e):
+XLA does NOT reach the MXU's nominal 2× int8 rate — int8 matmul times at
+~0.85× the bf16 rate (131 TOP/s vs 154 TFLOP/s at 4096³), and with the
+dynamic-quantization passes the end-to-end int8 ResNet-50 inference runs
+at ~0.55× bf16. The path's value on TPU is the 4× weight footprint
+(serving memory), with a measured ≤0.01 top-1 cost — not throughput.
 """
 
 from __future__ import annotations

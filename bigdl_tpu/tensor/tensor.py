@@ -464,6 +464,72 @@ class Tensor:
         m = np.asarray(_unwrap(mask)).astype(bool)
         return np.asarray(self.data)[m]
 
+    # -- round-3 long tail (demand-driven, torch-oracle-tested) ------------
+
+    def all(self) -> bool:
+        import jax.numpy as jnp
+
+        return bool(jnp.all(self.data != 0))
+
+    def any(self) -> bool:
+        import jax.numpy as jnp
+
+        return bool(jnp.any(self.data != 0))
+
+    def topk(self, k: int, dim: Optional[int] = None, largest: bool = True,
+             sorted: bool = True):
+        """(values, 1-based indices) along ``dim`` (1-based; default last),
+        reference ``topk(k, dim, increase, ...)`` with ``largest`` being
+        the torch dialect of ``increase=false``."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        ax = (self.data.ndim if dim is None else dim) - 1
+        x = jnp.moveaxis(self.data, ax, -1)
+        if largest:
+            vals, idx = lax.top_k(x, k)
+        else:
+            vals, idx = lax.top_k(-x, k)
+            vals = -vals
+        return (Tensor(jnp.moveaxis(vals, -1, ax)),
+                Tensor(jnp.moveaxis(idx + 1, -1, ax)))
+
+    def apply_(self, fn) -> "Tensor":
+        """Host-eager elementwise scalar function (reference ``apply1``);
+        facade-only — never inside jit."""
+        import jax.numpy as jnp
+
+        host = np.asarray(self.data)
+        out = np.vectorize(fn, otypes=[host.dtype])(host)
+        self.data = jnp.asarray(out)
+        return self
+
+    def index_fill_(self, dim: int, index, value: float) -> "Tensor":
+        """Fill rows at 1-based ``index`` along 1-based ``dim``."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(_unwrap(index)).astype(jnp.int32).reshape(-1) - 1
+        sl = tuple([slice(None)] * (dim - 1) + [idx])
+        self.data = self.data.at[sl].set(value)
+        return self
+
+    def index_copy_(self, dim: int, index, src) -> "Tensor":
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(_unwrap(index)).astype(jnp.int32).reshape(-1) - 1
+        sl = tuple([slice(None)] * (dim - 1) + [idx])
+        self.data = self.data.at[sl].set(_unwrap(src))
+        return self
+
+    def index_add_(self, dim: int, index, src) -> "Tensor":
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(_unwrap(index)).astype(jnp.int32).reshape(-1) - 1
+        sl = tuple([slice(None)] * (dim - 1) + [idx])
+        self.data = self.data.at[sl].add(_unwrap(src))
+        return self
+
     def top_k(self, k: int, dim: int = -1, increase: bool = False):
         """(values, 1-based indices); ``increase=False`` = largest first
         (reference ``topk``)."""
@@ -1497,6 +1563,16 @@ class Tensor:
 
     def __repr__(self) -> str:
         return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"
+
+
+# Torch-dialect underscore aliases: the reference facade's mutators are
+# already in-place under their plain names (Torch-heritage API); ported
+# user code often uses the torch spellings.
+for _plain in ("abs", "add", "ceil", "clamp", "copy", "div", "exp", "fill",
+               "floor", "log", "masked_fill", "mul", "pow", "round",
+               "squeeze", "sub", "zero"):
+    setattr(Tensor, _plain + "_", getattr(Tensor, _plain))
+del _plain
 
 
 def _tensor_flatten(t: Tensor):

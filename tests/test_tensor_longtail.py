@@ -247,3 +247,54 @@ def test_logical_mode_countnonzero():
                  np.array([1.0, 0.5, 1.5], np.float32))
     t = Tensor(np.arange(2.0, dtype=np.float32))
     assert t.repeat(3).size() == (6,)
+
+
+def test_round3_all_any_topk():
+    t, tt = _pair((3, 5), seed=3)
+    assert t.all() == bool(tt.bool().all())
+    assert Tensor(np.ones((2, 2), np.float32)).all() is True
+    assert Tensor(np.zeros((2, 2), np.float32)).any() is False
+    assert Tensor(np.eye(2, dtype=np.float32)).any() is True
+    v, i = t.topk(3, dim=2)
+    tv, ti = tt.topk(3, dim=1)
+    assert_close(v.data, tv.numpy())
+    np.testing.assert_array_equal(np.asarray(i.data) - 1, ti.numpy())
+    v, i = t.topk(2, dim=1, largest=False)
+    tv, ti = tt.topk(2, dim=0, largest=False)
+    assert_close(v.data, tv.numpy())
+    np.testing.assert_array_equal(np.asarray(i.data) - 1, ti.numpy())
+
+
+def test_round3_apply_and_index_family():
+    t, tt = _pair((4, 3), seed=4)
+    t.apply_(lambda x: x * 2.0 + 1.0)
+    assert_close(t.data, (tt * 2.0 + 1.0).numpy())
+
+    t, tt = _pair((4, 3), seed=5)
+    idx1 = np.array([1, 3], np.int64)           # 1-based facade
+    idx0 = torch.from_numpy(idx1 - 1)
+    t.index_fill_(1, idx1, 7.0)
+    tt.index_fill_(0, idx0, 7.0)
+    assert_close(t.data, tt.numpy())
+
+    t, tt = _pair((4, 3), seed=6)
+    src = np.random.RandomState(9).randn(2, 3).astype(np.float32)
+    t.index_copy_(1, idx1, src)
+    tt.index_copy_(0, idx0, torch.from_numpy(src.copy()))
+    assert_close(t.data, tt.numpy())
+
+    t, tt = _pair((4, 3), seed=7)
+    t.index_add_(1, idx1, src)
+    tt.index_add_(0, idx0, torch.from_numpy(src.copy()))
+    assert_close(t.data, tt.numpy())
+
+
+def test_round3_underscore_aliases():
+    t, tt = _pair((3, 3), seed=8)
+    t.add_(1.0).mul_(2.0).abs_().sqrt().clamp_(0.5, 3.0)
+    ref = ((tt + 1.0) * 2.0).abs().sqrt().clamp(0.5, 3.0)
+    assert_close(t.data, ref.numpy())
+    t.zero_()
+    assert float(np.abs(np.asarray(t.data)).sum()) == 0.0
+    t.fill_(4.0)
+    assert_close(t.data, np.full((3, 3), 4.0, np.float32))
