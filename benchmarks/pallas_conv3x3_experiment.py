@@ -1,0 +1,167 @@
+"""Pallas 3×3 stride-1 conv (tap-shift matmul form) vs XLA's native conv.
+
+PERF_ANALYSIS_r3.md concludes the only open path to the ~3,400 img/s
+ideal-traffic ceiling is replacing the 3×3/7×7 convolutions with Pallas
+too (so no XLA-internal layouts remain and the BN prologue can fuse into
+EVERY conv). This experiment measures the prerequisite: can a hand-written
+Pallas 3×3 conv match XLA's conv emitter at ResNet-50's conv2 shapes?
+
+Kernel form: per image, the spatially zero-padded input lives whole in
+VMEM as flattened (rows, C); each of the 9 taps is a statically-shifted
+row slice matmul'd against its (C, K) weight plane, accumulated in f32 —
+an implicit im2col with no materialization. Grid over batch; weight planes
+stay VMEM-resident across the whole grid.
+
+Run: python benchmarks/pallas_conv3x3_experiment.py [--iters 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+
+def bench(fn, args, iters, repeats=3, inner=6):
+    import jax
+    import jax.numpy as jnp
+
+    def chained(*a):
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(inner):
+            out = fn(a[0] + acc.astype(a[0].dtype), *a[1:])
+            acc = sum(jnp.sum(l.astype(jnp.float32))
+                      for l in jax.tree_util.tree_leaves(out)) * 1e-30
+        return acc
+
+    jf = jax.jit(chained)
+    float(jf(*args))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = jf(*args)
+        float(o)
+        best = min(best, (time.perf_counter() - t0) / (iters * inner))
+    return best
+
+
+def _kernel(x_ref, w_ref, o_ref, acc_s, *, bn, h, w, c, k):
+    import jax.numpy as jnp
+
+    wp2 = w + 2
+    rows = h * wp2
+    for j in range(bn):
+        xf = x_ref[j].reshape((h + 3) * wp2, c)
+        # accumulate through the scratch ref so only ONE f32 partial is
+        # ever live (a pure-value chain kept all 9 on the Mosaic stack
+        # and blew the 16M scoped-VMEM limit)
+        for t, (dy, dx) in enumerate((a, b) for a in range(3)
+                                     for b in range(3)):
+            start = dy * wp2 + dx
+            part = jnp.dot(xf[start:start + rows, :], w_ref[t],
+                           preferred_element_type=jnp.float32)
+            if t == 0:
+                acc_s[...] = part
+            else:
+                acc_s[...] = acc_s[...] + part
+        o_ref[j] = (acc_s[...].reshape(h, wp2, k)[:, :w, :]
+                    .astype(o_ref.dtype))
+
+
+def pallas_conv3x3(x, w9, bn=None, interpret=False):
+    """x: (N, H, W, C) NHWC; w9: (9, C, K) tap-major weight planes.
+    Stride 1, SAME padding. Returns (N, H, W, K)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h, w, c = x.shape
+    k = w9.shape[-1]
+    # zero-pad: 1 left/top, 1 right, 2 bottom rows (the extra bottom row
+    # keeps the largest tap's static slice in bounds)
+    xp = jnp.pad(x, ((0, 0), (1, 2), (1, 1), (0, 0)))
+    if bn is None:
+        # Mosaic materializes the shifted row slices as stack temps, so
+        # the real VMEM need is ~4x the block accounting — budget low
+        per_img = ((h + 3) * (w + 2) * c * 2 * 2        # x block, dbuf
+                   + h * w * k * 2 * 2                  # out block, dbuf
+                   + h * (w + 2) * k * 4                # f32 accum scratch
+                   + 9 * h * (w + 2) * c * 2)           # slice temps
+        bn = max(1, min(n, (6 * 1024 * 1024 - 9 * c * k * 2) // per_img))
+        while n % bn:
+            bn -= 1
+    kern = functools.partial(_kernel, bn=bn, h=h, w=w, c=c, k=k)
+    return pl.pallas_call(
+        kern,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, h + 3, w + 2, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((9, c, k), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, h, w, k), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, k), x.dtype),
+        scratch_shapes=[pltpu.VMEM((h * (w + 2), k), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(xp, w9)
+
+
+SHAPES = [  # ResNet-50 conv2 (3×3) stages, batch 256
+    ("s1 56² 64", 256, 56, 64),
+    ("s2 28² 128", 256, 28, 128),
+    ("s3 14² 256", 256, 14, 256),
+    ("s4 7² 512", 256, 7, 512),
+]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=4)
+    args = ap.parse_args()
+
+    def loss_of(z):
+        z32 = z.astype(jnp.float32)
+        return jnp.mean((z32 - jnp.mean(z32)) ** 2)
+
+    print(f"{'shape':>12} {'xla ms':>8} {'pallas ms':>10} {'ratio':>7} "
+          f"{'xla TF/s':>9} {'pallas TF/s':>11}")
+    for name, n, hw, c in SHAPES:
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (n, hw, hw, c), jnp.bfloat16)
+        w4 = jax.random.normal(key, (3, 3, c, c), jnp.bfloat16) * 0.05
+        w9 = w4.reshape(9, c, c)
+
+        # numerics check once per shape
+        ref = jax.lax.conv_general_dilated(
+            x[:2].astype(jnp.float32), jnp.transpose(w4, (3, 2, 0, 1)
+                                                     ).astype(jnp.float32),
+            (1, 1), "SAME", dimension_numbers=("NHWC", "OIHW", "NHWC"))
+        got = pallas_conv3x3(x[:2], w9)
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref)))
+        assert err < 0.25, f"{name}: numerics off ({err})"  # bf16 matmul tol
+
+        def xla_fwd(x, w4):
+            z = jax.lax.conv_general_dilated(
+                x, jnp.transpose(w4, (3, 2, 0, 1)), (1, 1), "SAME",
+                dimension_numbers=("NHWC", "OIHW", "NHWC"))
+            return loss_of(z)
+
+        def pl_fwd(x, w9):
+            return loss_of(pallas_conv3x3(x, w9))
+
+        tx = bench(xla_fwd, (x, w4), args.iters)
+        tp = bench(pl_fwd, (x, w9), args.iters)
+        fl = 2 * n * hw * hw * c * c * 9
+        print(f"{name:>12} {tx*1e3:8.3f} {tp*1e3:10.3f} {tx/tp:6.2f}x "
+              f"{fl/tx/1e12:9.1f} {fl/tp/1e12:11.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
