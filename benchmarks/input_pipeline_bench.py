@@ -116,7 +116,7 @@ def bench_transfer(images, labels, batch, n_batches):
     return imgs / dt, mb / dt
 
 
-def bench_train(images, labels, batch, iters, device_resident_ref):
+def bench_train(images, labels, batch, iters):
     import jax
     import jax.numpy as jnp
 
@@ -272,7 +272,7 @@ def main():
         print(f"resident : {ref:8.1f} img/s  (device-resident reference)",
               flush=True)
 
-        e2e = bench_train(images, labels, args.batch, args.iters, ref)
+        e2e = bench_train(images, labels, args.batch, args.iters)
         print(f"train    : {e2e:8.1f} img/s  (RECS-fed end to end)",
               flush=True)
 

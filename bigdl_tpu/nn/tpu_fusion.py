@@ -351,7 +351,7 @@ class FusedGraph(AbstractModule):
             if edge.add is not None:
                 set_state(edge.add, {})
 
-        def run_prim(p):
+        def run_prim(p, child_rng):
             args = [values[id(q)] for q in p.preds]
             x = args[0] if len(args) == 1 else args
             m = p.module
@@ -375,7 +375,7 @@ class FusedGraph(AbstractModule):
             elif isinstance(m, (ReLU, CAddTable, Identity)) or \
                     type(m).__name__ in _AGNOSTIC:
                 out, st = m.apply(pparams(p), x, pstate(p),
-                                  training=training, rng=None)
+                                  training=training, rng=child_rng)
                 values[id(p)] = out
                 set_state(p, st)
             else:
@@ -391,11 +391,11 @@ class FusedGraph(AbstractModule):
                 xin = [to_nchw(v) for v in args]
                 xin = xin[0] if len(xin) == 1 else xin
                 out, st = m.apply(pparams(p), xin, pstate(p),
-                                  training=training, rng=None)
+                                  training=training, rng=child_rng)
                 values[id(p)] = to_nhwc(out)
                 set_state(p, st)
 
-        for p in self._pnodes:
+        for i, p in enumerate(self._pnodes):
             if p.is_input:
                 continue
             if id(p) in self._edges:
@@ -403,7 +403,11 @@ class FusedGraph(AbstractModule):
                 continue
             if id(p) in self._consumed:
                 continue  # produced by its owning fused edge
-            run_prim(p)
+            # thread rng like Graph.apply does (Dropout et al. are
+            # identity under rng=None — dropping it would silently
+            # disable them in training)
+            child_rng = None if rng is None else jax.random.fold_in(rng, i)
+            run_prim(p, child_rng)
 
         def out_val(p):
             v = values[id(p)]

@@ -451,7 +451,15 @@ class Optimizer:
             target = (state["epoch"] - 1) * epoch_size + state.get("seen", 0)
             skipped = 0
             while skipped < target:
-                skipped += next(data_iter).size()
+                try:
+                    skipped += next(data_iter).size()
+                except StopIteration:
+                    raise ValueError(
+                        f"cannot resume: the data stream ended after "
+                        f"{skipped} records but the checkpoint was taken "
+                        f"{target} records in — the dataset is smaller (or "
+                        f"differently sized) than the one that wrote the "
+                        f"checkpoint") from None
             seen_this_epoch = state.get("seen", 0)
         next_ready = None            # (inp, tgt, bsz) placed ahead of time
         epoch_start = time.time()

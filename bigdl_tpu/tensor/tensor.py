@@ -1565,12 +1565,21 @@ class Tensor:
         return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"
 
 
-# Torch-dialect underscore aliases: the reference facade's mutators are
-# already in-place under their plain names (Torch-heritage API); ported
-# user code often uses the torch spellings.
+def _squeeze_(self, dim=None):
+    """In-place squeeze (torch dialect) — the plain ``squeeze`` returns a
+    new Tensor, unlike the other facade mutators."""
+    self.data = self.squeeze(dim).data
+    return self
+
+
+Tensor.squeeze_ = _squeeze_
+
+# Torch-dialect underscore aliases: these facade mutators are already
+# in-place under their plain names (Torch-heritage API); ported user code
+# often uses the torch spellings.
 for _plain in ("abs", "add", "ceil", "clamp", "copy", "div", "exp", "fill",
                "floor", "log", "masked_fill", "mul", "pow", "round",
-               "squeeze", "sub", "zero"):
+               "sub", "zero"):
     setattr(Tensor, _plain + "_", getattr(Tensor, _plain))
 del _plain
 

@@ -298,3 +298,9 @@ def test_round3_underscore_aliases():
     assert float(np.abs(np.asarray(t.data)).sum()) == 0.0
     t.fill_(4.0)
     assert_close(t.data, np.full((3, 3), 4.0, np.float32))
+
+
+def test_round3_squeeze_inplace():
+    t = Tensor(np.zeros((2, 1, 3), np.float32))
+    r = t.squeeze_()
+    assert r is t and t.data.shape == (2, 3)

@@ -124,3 +124,20 @@ def test_params_state_trees_identical():
     assert jax.tree_util.tree_structure(pg) == jax.tree_util.tree_structure(pf)
     assert jax.tree_util.tree_structure(g.init_state()) == \
         jax.tree_util.tree_structure(fused.init_state())
+
+
+def test_rng_threads_to_dropout():
+    """Dropout must actually drop under FusedGraph in training (rng=None
+    would silently disable it — review finding r3)."""
+    from bigdl_tpu.nn import Dropout, Graph, Input
+
+    inp = Input()
+    out = Dropout(0.5).inputs(inp)
+    g = Graph(inp, out)
+    g._ensure_params()
+    fused = FusedGraph(g)
+    x = jnp.ones((4, 64), jnp.float32)
+    key = jax.random.PRNGKey(3)
+    y, _ = fused.apply(g.params, x, g.state, training=True, rng=key)
+    dropped = float(jnp.mean((jnp.asarray(y) == 0).astype(jnp.float32)))
+    assert 0.2 < dropped < 0.8, f"dropout inactive under FusedGraph ({dropped})"
