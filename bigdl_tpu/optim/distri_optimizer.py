@@ -21,9 +21,14 @@ collapses into ONE jitted shard_map program over a ``jax.sharding.Mesh``:
 * ``compress="bf16"|"fp16"`` mirrors ``FP16CompressedTensor`` on the
   gradient exchange.
 * BatchNorm running stats are ``pmean``-ed across shards each step.
-
-Straggler gradient-drop (``dropPercentage``) has no SPMD analog — synchronous
-XLA collectives cannot partially complete — and is documented unsupported.
+* ``parameter_mode="blockstore"``: the reference's BlockManager exchange
+  re-created on a host block store ACROSS processes (the DCN boundary),
+  with the ``dropPercentage`` straggler gradient-drop
+  (``set_drop_module_property`` — see ``parallel/block_store.py``).
+  Within a process, gradients still reduce over the local chips with XLA
+  collectives; only the cross-process leg rides the store. This is the
+  fidelity/straggler mode — the SPMD modes remain the performance path
+  (inside one compiled program there is nothing to straggle or drop).
 
 The host driver loop (triggers, checkpoint cadence, bounded retry) is shared
 with LocalOptimizer: exactly the thin loop the reference's driver runs.
@@ -53,7 +58,7 @@ class DistriOptimizer(Optimizer):
                  batch_size: Optional[int] = None, end_trigger=None,
                  parameter_mode: str = "partitioned",
                  compress: Optional[str] = None,
-                 mesh=None, **kw) -> None:
+                 mesh=None, block_store=None, **kw) -> None:
         # reference semantics: batchSize is GLOBAL. In a multi-process
         # (pod) run each process's dataset shard batches 1/n_proc of it.
         if batch_size is not None:
@@ -66,12 +71,43 @@ class DistriOptimizer(Optimizer):
                     f"{n_proc}-process topology")
             batch_size //= max(n_proc, 1)
         super().__init__(model, dataset, criterion, batch_size, end_trigger, **kw)
-        if parameter_mode not in ("partitioned", "allreduce"):
+        if parameter_mode not in ("partitioned", "allreduce", "blockstore"):
             raise ValueError(f"unknown parameter_mode {parameter_mode!r}")
         self.parameter_mode = parameter_mode
         self.compress = compress
         self._mesh = mesh
         self._arp: Optional[AllReduceParameter] = None
+        self._block_store = block_store
+        self._drop_policy = None
+        self._bsp = None
+
+    def set_drop_module_property(self, drop_percentage: float,
+                                 max_drop_percentage: Optional[float] = None,
+                                 batch_size: int = 100,
+                                 warmup_iteration: int = 20) -> "DistriOptimizer":
+        """Reference ``setDropModuleProperty`` (SURVEY §5.3): enable
+        straggler gradient-drop — after ``warmup_iteration`` iterations
+        calibrate arrival-time thresholds over a ``batch_size`` sample
+        window, then stop waiting for late gradient contributions once
+        ``1 - drop_percentage`` arrived (hard cap ``max_drop_percentage``).
+
+        Only meaningful in ``parameter_mode="blockstore"`` — the SPMD modes
+        compile the exchange into one program where partial completion
+        cannot exist (that analysis is unchanged); the blockstore mode is
+        precisely the reference's BlockManager dataflow where drops are
+        well-defined."""
+        if self.parameter_mode != "blockstore":
+            raise ValueError(
+                "gradient drop requires parameter_mode='blockstore' (the "
+                "SPMD modes' collectives cannot partially complete; see "
+                "parallel/block_store.py)")
+        from bigdl_tpu.parallel.block_store import GradientDropPolicy
+
+        self._drop_policy = GradientDropPolicy(
+            drop_percentage, max_drop_percentage,
+            compute_threshold_batch_size=batch_size,
+            warmup_iteration=warmup_iteration)
+        return self
 
     # -- mesh --------------------------------------------------------------
 
@@ -285,15 +321,249 @@ class DistriOptimizer(Optimizer):
         opt_state = optim.init_state(params)
         return step, params, opt_state
 
+    # -- blockstore (DCN) mode --------------------------------------------
+
+    @staticmethod
+    def _float_leaf_pack(tree):
+        """(flat fp32 vector of the float leaves, rebuild(flat) -> tree).
+        Non-float leaves (step counters etc.) pass through untouched —
+        ``ravel_pytree`` can't be used because averaging ints is wrong."""
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        is_f = [np.issubdtype(np.asarray(l).dtype, np.floating)
+                for l in leaves]
+        flats = [np.asarray(l, np.float32).ravel()
+                 for l, f in zip(leaves, is_f) if f]
+        flat = (np.concatenate(flats) if flats
+                else np.zeros((0,), np.float32))
+
+        def rebuild(vec):
+            out, off = [], 0
+            for leaf, f in zip(leaves, is_f):
+                if f:
+                    a = np.asarray(leaf)
+                    out.append(vec[off:off + a.size].reshape(a.shape)
+                               .astype(a.dtype))
+                    off += a.size
+                else:
+                    out.append(leaf)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        return flat, rebuild
+
+    def _build_blockstore_step(self, params):
+        """The reference's BlockManager parameter plane across processes:
+        local chips reduce gradients with XLA collectives (ICI); the
+        cross-process leg (DCN) is putGradients / aggregate-with-drop /
+        sendWeightPartition / getWeights over a host block store. Owners
+        hold optimizer slots for their slice only (the reference kept each
+        partition's optimMethod state on its executor's heap)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.flatten_util import ravel_pytree
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from bigdl_tpu.parallel.block_store import (
+            BlockStoreParameter, default_block_store,
+        )
+
+        n_proc = jax.process_count()
+        pid = jax.process_index()
+        local_devs = jax.local_devices()
+        nl = len(local_devs)
+        model, criterion, optim = self.model, self.criterion, self.optim_method
+        compute_dtype = resolve_dtype(self.compute_dtype)
+        loss_scale = self.loss_scale
+        frozen = frozen_mask_tree(model, params)
+        from bigdl_tpu.optim.train_step import regularizer_loss
+
+        flat0, unravel = ravel_pytree(params)
+        total = int(flat0.shape[0])
+        store = self._block_store
+        if store is None:
+            store = default_block_store()
+        bsp = BlockStoreParameter(
+            store, n_proc, pid, total, compress=self.compress,
+            drop_policy=self._drop_policy)
+        # a retry-from-checkpoint restarts the iteration counter: reap any
+        # blocks a previous attempt left behind so they can't alias the
+        # retried run's same-numbered iterations
+        bsp.sweep_stale(aux_names=("loss", "gnorm2", "mstate"))
+        self._bsp = bsp
+
+        # flat frozen-weight mask in the same padded layout as the shards
+        if frozen is None:
+            frozen_pad = None
+        else:
+            mask_leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda p, f: np.full(np.shape(p), bool(f)), params, frozen))
+            fr = np.concatenate([m.ravel() for m in mask_leaves])
+            frozen_pad = np.pad(fr, (0, bsp.padded_size - fr.size))
+
+        # local gradient program: regularizers are replicated-additive so
+        # they commute with the cross-process mean; clipping must act on
+        # the AGGREGATED gradient and therefore happens owner-side below
+        def local_grad(params, model_state, rng, inputs, targets):
+            def loss_fn(p):
+                p_master, x = p, inputs
+                if compute_dtype is not None:
+                    p = cast_floats(p, compute_dtype)
+                    x = cast_floats(x, compute_dtype)
+                out, new_ms = model.apply(p, x, model_state,
+                                          training=True, rng=rng)
+                if compute_dtype is not None:
+                    out = cast_floats(out, jnp.float32)
+                    new_ms = restore_dtypes(new_ms, model_state)
+                # regularizers act on the fp32 master weights AND must see
+                # the differentiation variable (a closed-over tree would
+                # contribute zero gradient)
+                loss = criterion.apply(out, targets) + regularizer_loss(
+                    model, p_master)
+                return loss * loss_scale, new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if loss_scale != 1.0:
+                loss = loss / loss_scale
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / loss_scale, grads)
+            return grads, new_ms, loss
+
+        if nl > 1:
+            local_mesh = Mesh(np.asarray(local_devs), ("ldata",))
+
+            pcast = getattr(lax, "pcast", None)
+            mark_varying = (
+                (lambda x: pcast(x, "ldata", to="varying"))
+                if pcast is not None
+                else (lambda x: lax.pvary(x, "ldata")))
+
+            def spmd(params, model_state, rng, inputs, targets):
+                rng = jax.random.fold_in(
+                    rng, pid * nl + lax.axis_index("ldata"))
+                params = jax.tree_util.tree_map(mark_varying, params)
+                grads, new_ms, loss = local_grad(
+                    params, model_state, rng, inputs, targets)
+                grads = lax.pmean(grads, "ldata")
+                loss = lax.pmean(loss, "ldata")
+                new_ms = self._pmean_state(new_ms, "ldata")
+                return grads, new_ms, loss
+
+            rep, sh = P(), P("ldata")
+            grad_step = jax.jit(jax.shard_map(
+                spmd, mesh=local_mesh,
+                in_specs=(rep, rep, rep, sh, sh),
+                out_specs=(rep, rep, rep)))
+            batch_sharding = NamedSharding(local_mesh, P("ldata"))
+        else:
+            def one_dev(params, model_state, rng, inputs, targets):
+                rng = jax.random.fold_in(rng, pid)
+                return local_grad(params, model_state, rng, inputs, targets)
+
+            grad_step = jax.jit(one_dev)
+            batch_sharding = None
+
+        upd = jax.jit(lambda g, o, w: optim.update(g, o, w))
+        counter = {"t": 0}
+        l2_clip = self.grad_clip.get("l2_norm")
+        const_clip = self.grad_clip.get("constant")
+        lo_hi = (pid * bsp.shard_size, (pid + 1) * bsp.shard_size)
+
+        def step(params, opt_state, model_state, rng, inp, tgt):
+            t = counter["t"]
+            grads, new_ms, loss = grad_step(params, model_state, rng,
+                                            inp, tgt)
+            gflat = np.asarray(ravel_pytree(grads)[0], np.float32)
+            bsp.put_gradients(t, gflat)
+            if n_proc > 1:  # published early so stragglers' losses flow
+                bsp.publish_aux(t, "loss", np.float32(loss))
+            g_my, n_arrived, dropped = bsp.aggregate_my_partition(t)
+            if dropped:
+                self.metrics.add("dropped gradients", float(len(dropped)))
+            if l2_clip is not None:
+                # global L2 norm needs every owner's partial square sum —
+                # an 8-byte aux exchange (owners are never dropped)
+                bsp.publish_aux(t, "gnorm2",
+                                np.float64(np.sum(g_my.astype(np.float64)
+                                                  ** 2)))
+                parts = bsp.gather_aux(t, "gnorm2", blocking=True)
+                norm = float(np.sqrt(sum(float(v) for v in parts.values())))
+                g_my = g_my * min(1.0, l2_clip / (norm + 1e-6))
+            if const_clip is not None:
+                g_my = np.clip(g_my, const_clip[0], const_clip[1])
+            # my current weight slice, in the padded flat layout
+            wpad = bsp._pad(np.asarray(ravel_pytree(params)[0], np.float32))
+            my_w = wpad[lo_hi[0]:lo_hi[1]]
+            if frozen_pad is not None:
+                fr = frozen_pad[lo_hi[0]:lo_hi[1]]
+                g_my = np.where(fr, 0.0, g_my)
+            new_w, new_opt = upd(jnp.asarray(g_my), opt_state,
+                                 jnp.asarray(my_w))
+            new_w = np.asarray(new_w, np.float32)
+            if frozen_pad is not None:
+                new_w = np.where(fr, my_w, new_w)
+            bsp.publish_weights(t + 1, new_w)
+            wfull = bsp.get_weights(t + 1)
+            new_params = unravel(jnp.asarray(wfull))
+            # BN running stats: average the float leaves across processes
+            # (the pmean the SPMD modes do each step)
+            if n_proc > 1:
+                ms_flat, rebuild = self._float_leaf_pack(new_ms)
+                if ms_flat.size:
+                    bsp.publish_aux(t, "mstate", ms_flat)
+                    gathered = bsp.gather_aux(t, "mstate", blocking=True)
+                    new_ms = rebuild(
+                        np.mean(np.stack(list(gathered.values())), axis=0))
+                losses = bsp.gather_aux(t, "loss", blocking=True)
+                loss = np.float32(np.mean([float(v)
+                                           for v in losses.values()]))
+            counter["t"] = t + 1
+            return new_params, new_opt, new_ms, loss
+
+        # owner's optimizer slots: my slice only (ZeRO-1 by process)
+        wpad0 = bsp._pad(np.asarray(flat0, np.float32))
+        opt_state = optim.init_state(
+            jnp.asarray(wpad0[lo_hi[0]:lo_hi[1]]))
+        return step, params, opt_state, batch_sharding
+
     # -- Optimizer hooks ---------------------------------------------------
 
     def _prepare(self):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        params, model_state = self.model.params, self.model.state
+
+        if self.parameter_mode == "blockstore":
+            step, dev_params, opt_state, batch_sharding = \
+                self._build_blockstore_step(params)
+            self._n_devices = len(jax.local_devices())
+
+            def place_batch_local(batch: MiniBatch):
+                def put1(x):
+                    if batch_sharding is not None:
+                        return jax.device_put(x, batch_sharding)
+                    return jax.device_put(np.asarray(x))
+
+                def put(x):
+                    if isinstance(x, (list, tuple)):
+                        return [put1(v) for v in x]
+                    return put1(x)
+
+                if batch_sharding is not None and \
+                        batch.size() % self._n_devices != 0:
+                    raise ValueError(
+                        f"local batch {batch.size()} must divide the "
+                        f"{self._n_devices}-chip local data axis")
+                return put(batch.get_input()), put(batch.get_target())
+
+            return step, place_batch_local, dev_params, opt_state, model_state
+
         mesh = self.mesh()
         self._n_devices = mesh.devices.size
-        params, model_state = self.model.params, self.model.state
 
         if self.parameter_mode == "partitioned":
             step, dev_params, opt_state = self._build_partitioned_step(mesh, params)
@@ -388,7 +658,11 @@ class DistriOptimizer(Optimizer):
         import jax
         from jax.sharding import PartitionSpec as P
 
-        if getattr(self, "_mh_eval", False):
+        if getattr(self, "_mh_eval", False) or \
+                self.parameter_mode == "blockstore":
+            # blockstore mode keeps full params per process and process-
+            # local validation shards: score locally, merge in the driver
+            # (ValidationResult.merge_across_processes)
             return Optimizer._eval_forward(self, params, model_state, inp)
 
         from bigdl_tpu.optim.evaluator import (

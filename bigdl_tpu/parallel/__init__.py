@@ -3,6 +3,10 @@
 pipeline/sequence/expert parallel extensions the reference lacks."""
 
 from bigdl_tpu.parallel.all_reduce import AllReduceParameter, flatten_params
+from bigdl_tpu.parallel.block_store import (
+    BlockStore, BlockStoreParameter, CoordServiceBlockStore, FsBlockStore,
+    GradientDropPolicy, default_block_store,
+)
 from bigdl_tpu.parallel.broadcast import ModelBroadcast
 from bigdl_tpu.parallel.moe import mlp_expert, moe_layer, top_k_gating
 from bigdl_tpu.parallel.pipeline import gpipe, microbatch, stack_stage_params
@@ -16,6 +20,8 @@ from bigdl_tpu.parallel.tensor_parallel import (
 
 __all__ = [
     "AllReduceParameter", "flatten_params", "ModelBroadcast",
+    "BlockStore", "BlockStoreParameter", "CoordServiceBlockStore",
+    "FsBlockStore", "GradientDropPolicy", "default_block_store",
     "attention", "ring_attention", "stripe_sequence",
     "striped_ring_attention", "ulysses_attention", "unstripe_sequence",
     "column_parallel_linear", "row_parallel_linear", "tp_mlp", "tp_attention",

@@ -9,11 +9,34 @@ import os
 import sys
 
 
+class _DelayedGradientPuts:
+    """Wraps a BlockStore: gradient-block puts from iteration
+    ``first_iter`` on sleep first — a process whose gradient transfers
+    straggle (the BlockManager slow-fetch scenario) after the warmup
+    window calibrated healthy thresholds."""
+
+    def __init__(self, inner, delay_s, first_iter):
+        self._inner, self._delay, self._first = inner, delay_s, first_iter
+
+    def put(self, key, value):
+        import time
+
+        parts = key.split("/")
+        if len(parts) >= 3 and parts[1] == "g" and \
+                int(parts[2]) >= self._first:
+            time.sleep(self._delay)
+        self._inner.put(key, value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def main():
     pid = int(sys.argv[1])
     port = sys.argv[2]
     out_dir = sys.argv[3]
     mode = sys.argv[4] if len(sys.argv) > 4 else "orig"
+    n_procs = int(sys.argv[5]) if len(sys.argv) > 5 else 2
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -30,10 +53,10 @@ def main():
 
     Engine.init_distributed(
         coordinator_address=f"localhost:{port}",
-        num_processes=2, process_id=pid,
+        num_processes=n_procs, process_id=pid,
     )
-    assert jax.process_count() == 2
-    assert len(jax.devices()) == 8, jax.devices()
+    assert jax.process_count() == n_procs
+    assert len(jax.devices()) == 4 * n_procs, jax.devices()
     assert len(jax.local_devices()) == 4
 
     from jax.sharding import Mesh
@@ -46,7 +69,6 @@ def main():
     from bigdl_tpu.utils.random_gen import RNG
 
     RNG.set_seed(17)
-    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("data",))
 
     # identical GLOBAL data on both processes; DataSet.distributed takes
     # this process's shard (reference RDD partitioning)
@@ -59,11 +81,31 @@ def main():
 
     model = LeNet5(10)
     n_iter = 3 if mode == "orig" else 6
-    opt = Optimizer(
-        model=model, dataset=ds, criterion=ClassNLLCriterion(),
-        batch_size=32, end_trigger=Trigger.max_iteration(n_iter),
-        parameter_mode="partitioned", mesh=mesh,
-    )
+    if mode.startswith("blockstore"):
+        # the BlockManager-analog DCN plane: host block store over the
+        # coordination service, straggler gradient-drop in the _drop mode
+        from bigdl_tpu.parallel.block_store import CoordServiceBlockStore
+
+        store = CoordServiceBlockStore()
+        if mode == "blockstore_drop" and pid == n_procs - 1:
+            store = _DelayedGradientPuts(store, delay_s=0.7, first_iter=2)
+        opt = Optimizer(
+            model=model, dataset=ds, criterion=ClassNLLCriterion(),
+            batch_size=16 * n_procs,
+            end_trigger=Trigger.max_iteration(n_iter),
+            parameter_mode="blockstore", block_store=store,
+        )
+        if mode == "blockstore_drop":
+            opt.set_drop_module_property(
+                0.34, batch_size=20, warmup_iteration=2)
+    else:
+        mesh = Mesh(np.asarray(jax.devices()).reshape(4 * n_procs),
+                    ("data",))
+        opt = Optimizer(
+            model=model, dataset=ds, criterion=ClassNLLCriterion(),
+            batch_size=16 * n_procs, end_trigger=Trigger.max_iteration(n_iter),
+            parameter_mode="partitioned", mesh=mesh,
+        )
     opt.set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
 
     import logging
@@ -86,6 +128,9 @@ def main():
         trained = opt.optimize()
     elif mode == "straight":
         trained = opt.optimize()
+    elif mode in ("blockstore", "blockstore_drop"):
+        trained = opt.optimize()
+        print(f"worker {pid}: drops={opt._bsp.dropped_total}")
     elif mode == "crash":
         # checkpoint every iteration, then die HARD (os._exit — no python
         # cleanup, the closest in-env analog of a killed pod worker) at the

@@ -1,0 +1,388 @@
+"""Block-store parameter plane + gradient-drop straggler mitigation
+(reference anchors, UNVERIFIED per SURVEY §0: AllReduceParameter.scala's
+BlockManager exchange; DistriOptimizer.scala dropPercentage/
+computeThresholdbatchSize/warmupIterationNum — SURVEY §5.3).
+
+The exchange logic takes (pid, n_procs) explicitly, so the full
+putGradients → aggregate-with-drop → publish/get weights dataflow is
+driven here with THREADS over one FsBlockStore — the pod test
+(test_multihost.py) re-runs it with real jax.distributed processes over
+the coordination-service store."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.parallel.block_store import (
+    BlockStoreParameter, FsBlockStore, GradientDropPolicy, decode_array,
+    encode_array,
+)
+
+
+# -- codec / store primitives ---------------------------------------------
+
+@pytest.mark.parametrize("arr", [
+    np.arange(7, dtype=np.float32),
+    np.zeros((0,), np.float64),
+    np.random.RandomState(0).rand(3, 4).astype(np.float16),
+    np.array(3.5, np.float32),
+    np.arange(6, dtype=np.int64).reshape(2, 3),
+])
+def test_array_codec_roundtrip(arr):
+    out = decode_array(encode_array(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_fs_store_put_get_delete(tmp_path):
+    st = FsBlockStore(str(tmp_path / "bs"))
+    assert st.try_get("a/b") is None
+    st.put("a/b", b"xyz")
+    assert st.try_get("a/b") == b"xyz"
+    st.put("a/b", b"overwritten")
+    assert st.try_get("a/b") == b"overwritten"
+    st.delete("a/b")
+    st.delete("a/b")  # idempotent
+    assert st.try_get("a/b") is None
+    with pytest.raises(TimeoutError):
+        st.get_blocking("missing", timeout_s=0.05)
+
+
+# -- drop policy -----------------------------------------------------------
+
+def test_drop_policy_warmup_and_threshold():
+    p = GradientDropPolicy(0.3, compute_threshold_batch_size=10,
+                           warmup_iteration=3, min_deadline_s=0.0)
+    assert p.deadline(0) is None            # warmup
+    for d in [0.1] * 7 + [1.0] * 3:
+        p.record(d)
+    assert p.deadline(2) is None            # still warmup
+    dl = p.deadline(3)
+    # 70th percentile of 7x0.1 + 3x1.0 sits in the fast cluster
+    assert dl is not None and dl < 0.5
+    assert p.min_arrivals(3) == 3  # ceil((1-0.3)*3) = ceil(2.1)
+    assert GradientDropPolicy(0.5).min_arrivals(4) == 2
+    assert GradientDropPolicy(0.9).min_arrivals(10) == 1
+
+
+def test_drop_policy_floor_and_validation():
+    p = GradientDropPolicy(0.5, warmup_iteration=0, min_deadline_s=0.25)
+    p.record(0.001)
+    assert p.deadline(1) == 0.25            # floored
+    with pytest.raises(ValueError):
+        GradientDropPolicy(1.0)
+    with pytest.raises(ValueError):
+        GradientDropPolicy(0.5, max_drop_percentage=0.2)
+
+
+# -- threaded exchange ----------------------------------------------------
+
+def _run_exchange(store, n, total, grads_by_pid, w0, n_iters=1,
+                  policies=None, put_delays=None, lr=0.1):
+    """Drive n BlockStoreParameter instances with threads. Each iteration:
+    everyone contributes its gradient, owners aggregate + SGD-update their
+    slice, everyone assembles the new full vector. Returns (final weights
+    per pid, bsp objects)."""
+    results = [None] * n
+    bsps = [None] * n
+    errors = []
+
+    def worker(pid):
+        try:
+            st = store
+            if put_delays and put_delays.get(pid):
+                st = _DelayedStore(store, put_delays[pid])
+            bsp = BlockStoreParameter(
+                st, n, pid, total,
+                drop_policy=policies[pid] if policies else None,
+                timeout_s=30.0)
+            bsps[pid] = bsp
+            w = w0.copy()
+            for t in range(n_iters):
+                g = grads_by_pid[pid](t, w)
+                bsp.put_gradients(t, g)
+                gmy, _, _ = bsp.aggregate_my_partition(t)
+                wpad = bsp._pad(w)
+                lo = pid * bsp.shard_size
+                new_w = wpad[lo:lo + bsp.shard_size] - lr * gmy
+                bsp.publish_weights(t + 1, new_w)
+                w = bsp.get_weights(t + 1)
+            results[pid] = w
+        except Exception as e:  # pragma: no cover - surfaced in assert
+            errors.append((pid, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errors, errors
+    return results, bsps
+
+
+class _DelayedStore:
+    """Simulates a straggling gradient-transfer path (the BlockManager
+    slow-fetch case): puts of gradient blocks from iteration
+    ``first_iter`` on sleep first — stragglers appear AFTER the warmup
+    window calibrated thresholds on healthy iterations, which is the
+    reference's operating assumption."""
+
+    def __init__(self, inner, delay_s, first_iter=1):
+        self._inner, self._delay, self._first = inner, delay_s, first_iter
+
+    def put(self, key, value):
+        parts = key.split("/")
+        if len(parts) >= 3 and parts[1] == "g" and \
+                int(parts[2]) >= self._first:
+            time.sleep(self._delay)
+        self._inner.put(key, value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_threaded_exchange_matches_numpy(tmp_path):
+    """3 contributors, no stragglers: the assembled update must equal the
+    plain numpy mean-gradient SGD step, and every pid must agree."""
+    rs = np.random.RandomState(1)
+    total, n = 103, 3  # deliberately not divisible by n (padding path)
+    w0 = rs.rand(total).astype(np.float32)
+    gs = [rs.rand(total).astype(np.float32) for _ in range(n)]
+    store = FsBlockStore(str(tmp_path / "bs"))
+
+    results, _ = _run_exchange(
+        store, n, total, [lambda t, w, g=g: g for g in gs], w0, n_iters=2)
+
+    # numpy oracle: two SGD steps on the mean gradient
+    w = w0.copy()
+    for _ in range(2):
+        w = w - 0.1 * np.mean(gs, axis=0)
+    for pid in range(n):
+        np.testing.assert_allclose(results[pid], w, rtol=1e-6, atol=1e-6)
+
+
+def test_threaded_exchange_drops_straggler(tmp_path):
+    """pid 2's gradient puts are delayed past the calibrated deadline:
+    owners 0 and 1 must aggregate without it (mean over 2 contributions),
+    while pid 2's own partition still sees all 3. Weights stay identical
+    across pids."""
+    rs = np.random.RandomState(2)
+    total, n = 60, 3
+    w0 = np.zeros(total, np.float32)
+    gs = [np.full(total, float(pid + 1), np.float32) for pid in range(n)]
+
+    store = FsBlockStore(str(tmp_path / "bs"))
+    policies = [GradientDropPolicy(0.34, warmup_iteration=1,
+                                   min_deadline_s=0.15)
+                for _ in range(n)]
+    n_iters = 3  # iter 0 calibrates (no drop), iters 1-2 drop
+    results, bsps = _run_exchange(
+        store, n, total, [lambda t, w, g=g: g for g in gs], w0,
+        n_iters=n_iters, policies=policies, put_delays={2: 1.2})
+
+    # everyone assembled identical weights
+    for pid in range(1, n):
+        np.testing.assert_array_equal(results[0], results[pid])
+
+    # owners 0 and 1 dropped pid 2's contribution in the post-warmup iters
+    assert bsps[0].dropped_total == n_iters - 1
+    assert bsps[1].dropped_total == n_iters - 1
+    assert bsps[2].dropped_total == 0  # its own partition got fast blocks
+
+    # slice-level oracle: partition 0/1 slices saw mean(g0,g1) after
+    # warmup, mean(g0,g1,g2) during it; partition 2 always all three
+    sh = bsps[0].shard_size
+    mean01 = (1.0 + 2.0) / 2
+    mean012 = (1.0 + 2.0 + 3.0) / 3
+    exp = np.zeros(total, np.float32)
+    exp[:sh] -= 0.1 * (mean012 + 2 * mean01)       # partition 0
+    exp[sh:2 * sh] -= 0.1 * (mean012 + 2 * mean01)  # partition 1
+    exp[2 * sh:] -= 0.1 * (3 * mean012)             # partition 2 (no drop)
+    np.testing.assert_allclose(results[0], exp, rtol=1e-6, atol=1e-6)
+
+
+def test_late_blocks_garbage_collected(tmp_path):
+    """A contribution landing after the owner's post-aggregation delete is
+    reaped by the t+2 sweep — no leaked blocks."""
+    store = FsBlockStore(str(tmp_path / "bs"))
+    bsp = BlockStoreParameter(store, 2, 0, 10, timeout_s=5.0)
+    peer = BlockStoreParameter(store, 2, 1, 10, timeout_s=5.0)
+
+    g = np.ones(10, np.float32)
+    for t in range(4):
+        # peer contributes BEFORE owner aggregates at t=0..2
+        peer.put_gradients(t, g * (t + 1))
+        bsp.put_gradients(t, g)
+        bsp.aggregate_my_partition(t)
+        # late duplicate lands AFTER the delete (straggling retransmit)
+        store.put(bsp._gkey(t, 0, 1), encode_array(g[:bsp.shard_size]))
+    # the t=0 and t=1 late blocks were swept by the t=2/t=3 GC pass
+    assert store.try_get(bsp._gkey(0, 0, 1)) is None
+    assert store.try_get(bsp._gkey(1, 0, 1)) is None
+    del peer
+
+
+# -- single-process DistriOptimizer blockstore mode ------------------------
+
+def test_blockstore_mode_trains_and_matches_local(tmp_path):
+    """parameter_mode='blockstore' with one process must track the plain
+    LocalOptimizer trajectory (mean over 1 process = full-batch gradient)
+    and drive the loss down through the real store roundtrip."""
+    import jax
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.random_gen import RNG
+
+    rs = np.random.RandomState(0)
+    samples = [Sample(rs.rand(1, 28, 28).astype(np.float32),
+                      np.float32(i % 10 + 1)) for i in range(64)]
+
+    def train(mode):
+        RNG.set_seed(7)
+        model = LeNet5(10)
+        kw = {}
+        if mode == "blockstore":
+            from bigdl_tpu.parallel.block_store import FsBlockStore
+
+            kw = dict(distributed=True, parameter_mode="blockstore",
+                      block_store=FsBlockStore(str(tmp_path / "bs")))
+        opt = Optimizer(model=model, dataset=DataSet.array(samples),
+                        criterion=ClassNLLCriterion(), batch_size=16,
+                        end_trigger=Trigger.max_iteration(4), **kw)
+        opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+        trained = opt.optimize()
+        ws, _ = trained.parameters()
+        return np.concatenate([np.asarray(w, np.float32).ravel()
+                               for w in ws])
+
+    w_local = train("local")
+    w_bs = train("blockstore")
+    assert w_local.shape == w_bs.shape
+    np.testing.assert_allclose(w_bs, w_local, rtol=5e-4, atol=5e-5)
+
+
+def test_drop_property_requires_blockstore_mode():
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+    opt = DistriOptimizer.__new__(DistriOptimizer)
+    opt.parameter_mode = "partitioned"
+    with pytest.raises(ValueError, match="blockstore"):
+        DistriOptimizer.set_drop_module_property(opt, 0.1)
+
+
+def test_codec_bfloat16():
+    import ml_dtypes
+
+    arr = np.arange(5, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    out = decode_array(encode_array(arr))
+    assert out.dtype == arr.dtype
+    np.testing.assert_array_equal(out.astype(np.float32),
+                                  arr.astype(np.float32))
+
+
+def test_threaded_exchange_bf16_compress(tmp_path):
+    """compress='bf16' (the FP16CompressedTensor analog) must round-trip
+    through the store: the aggregated mean equals the numpy oracle within
+    bf16 quantization error."""
+    rs = np.random.RandomState(3)
+    total, n = 40, 2
+    w0 = rs.rand(total).astype(np.float32)
+    gs = [rs.rand(total).astype(np.float32) for _ in range(n)]
+    store = FsBlockStore(str(tmp_path / "bs"))
+    results = [None] * n
+    errors = []
+
+    def worker(pid):
+        try:
+            bsp = BlockStoreParameter(store, n, pid, total,
+                                      compress="bf16", timeout_s=30.0)
+            bsp.put_gradients(0, gs[pid])
+            gmy, _, _ = bsp.aggregate_my_partition(0)
+            wpad = bsp._pad(w0)
+            lo = pid * bsp.shard_size
+            bsp.publish_weights(1, wpad[lo:lo + bsp.shard_size] - 0.1 * gmy)
+            results[pid] = bsp.get_weights(1)
+        except Exception as e:  # pragma: no cover
+            errors.append((pid, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errors, errors
+    np.testing.assert_array_equal(results[0], results[1])
+    # bf16 has ~3 decimal digits: remote halves quantized, own half exact
+    np.testing.assert_allclose(results[0], w0 - 0.1 * np.mean(gs, axis=0),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_sweep_stale_reaps_previous_attempt(tmp_path):
+    """Retry-from-checkpoint restarts the iteration counter at 0: blocks a
+    previous attempt left behind must be reaped by sweep_stale so they
+    can't alias the retried run's same-numbered iterations."""
+    store = FsBlockStore(str(tmp_path / "bs"))
+    bsp = BlockStoreParameter(store, 2, 0, 10, timeout_s=5.0)
+    g = np.ones(10, np.float32)
+    for t in range(5):  # "previous attempt" reaches iteration 4
+        bsp.put_gradients(t, g)
+        bsp._my_slice_cache = None
+        bsp.publish_weights(t + 1, g[:bsp.shard_size])
+        bsp.publish_aux(t, "loss", np.float32(1.0))
+    assert store.try_get(bsp._gkey(4, 1, 0)) is not None
+
+    fresh = BlockStoreParameter(store, 2, 0, 10, timeout_s=5.0)
+    fresh.sweep_stale(aux_names=("loss",))
+    for t in range(2, 6):
+        assert store.try_get(fresh._gkey(t, 1, 0)) is None, t
+        assert store.try_get(fresh._wkey(t, 0)) is None, t
+    assert store.try_get(f"{fresh.ns}/pos/0") is None
+    # sweeping with no marker is a no-op
+    fresh.sweep_stale()
+
+
+def test_blockstore_mode_applies_regularizer_gradient(tmp_path):
+    """A layer-level L2 regularizer must actually move the weights in
+    blockstore mode (a closed-over pytree in the loss would silently
+    contribute zero gradient): with lr*wd shrinkage and zero data gradient,
+    one step multiplies weights by (1 - lr*wd)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.nn import Identity, Linear, MSECriterion, Sequential
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+    from bigdl_tpu.parallel.block_store import FsBlockStore
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(3)
+    model = Linear(4, 4, with_bias=False,
+                   w_regularizer=L2Regularizer(0.5))
+    model._ensure_params()
+    w_before = np.asarray(
+        jax.tree_util.tree_leaves(model.params)[0]).copy()
+
+    # zero input -> zero data gradient; only the regularizer acts
+    samples = [Sample(np.zeros(4, np.float32), np.zeros(4, np.float32))
+               for _ in range(8)]
+    opt = Optimizer(model=model, dataset=DataSet.distributed(samples),
+                    criterion=MSECriterion(), batch_size=8,
+                    parameter_mode="blockstore",
+                    block_store=FsBlockStore(str(tmp_path / "bs")),
+                    end_trigger=Trigger.max_iteration(1))
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    trained = opt.optimize()
+    w_after = np.asarray(trained.parameters()[0][0])
+    # d/dw (0.5*wd*||w||^2) = wd*w  ->  w' = w(1 - lr*wd) = 0.95*w
+    np.testing.assert_allclose(w_after, w_before * (1 - 0.1 * 0.5),
+                               rtol=1e-5, atol=1e-6)
+
+
+import jax  # noqa: E402  (used by the regularizer test)
