@@ -468,6 +468,7 @@ class DistriOptimizer(Optimizer):
 
         upd = jax.jit(lambda g, o, w: optim.update(g, o, w))
         counter = {"t": 0}
+        cache = {"params_ref": None, "wpad": None}
         l2_clip = self.grad_clip.get("l2_norm")
         const_clip = self.grad_clip.get("constant")
         lo_hi = (pid * bsp.shard_size, (pid + 1) * bsp.shard_size)
@@ -483,6 +484,11 @@ class DistriOptimizer(Optimizer):
             g_my, n_arrived, dropped = bsp.aggregate_my_partition(t)
             if dropped:
                 self.metrics.add("dropped gradients", float(len(dropped)))
+            if frozen_pad is not None:
+                # zero frozen grads BEFORE the norm like the local/SPMD
+                # paths, so l2 clipping sees the same global norm
+                fr = frozen_pad[lo_hi[0]:lo_hi[1]]
+                g_my = np.where(fr, 0.0, g_my)
             if l2_clip is not None:
                 # global L2 norm needs every owner's partial square sum —
                 # an 8-byte aux exchange (owners are never dropped)
@@ -494,12 +500,16 @@ class DistriOptimizer(Optimizer):
                 g_my = g_my * min(1.0, l2_clip / (norm + 1e-6))
             if const_clip is not None:
                 g_my = np.clip(g_my, const_clip[0], const_clip[1])
-            # my current weight slice, in the padded flat layout
-            wpad = bsp._pad(np.asarray(ravel_pytree(params)[0], np.float32))
+            # my current weight slice, in the padded flat layout — reuse
+            # last iteration's assembled vector instead of re-flattening
+            # the whole tree on the host every step (first call and a
+            # post-resume restore pass a fresh tree and recompute)
+            if params is cache["params_ref"]:
+                wpad = cache["wpad"]
+            else:
+                wpad = bsp._pad(
+                    np.asarray(ravel_pytree(params)[0], np.float32))
             my_w = wpad[lo_hi[0]:lo_hi[1]]
-            if frozen_pad is not None:
-                fr = frozen_pad[lo_hi[0]:lo_hi[1]]
-                g_my = np.where(fr, 0.0, g_my)
             new_w, new_opt = upd(jnp.asarray(g_my), opt_state,
                                  jnp.asarray(my_w))
             new_w = np.asarray(new_w, np.float32)
@@ -508,6 +518,10 @@ class DistriOptimizer(Optimizer):
             bsp.publish_weights(t + 1, new_w)
             wfull = bsp.get_weights(t + 1)
             new_params = unravel(jnp.asarray(wfull))
+            cache["params_ref"] = new_params
+            cache["wpad"] = np.concatenate(
+                [wfull, np.zeros(bsp.padded_size - wfull.size, np.float32)]
+            ) if wfull.size != bsp.padded_size else wfull
             # BN running stats: average the float leaves across processes
             # (the pmean the SPMD modes do each step)
             if n_proc > 1:

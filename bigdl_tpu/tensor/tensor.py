@@ -1561,6 +1561,156 @@ class Tensor:
 
     nelement = numel
 
+    # -- round-3b tranche: storage-set, BigDL axpy family, apply variants -
+
+    def set(self, other: Optional["Tensor"] = None) -> "Tensor":
+        """Reference ``set``: rebind this facade to ``other``'s array
+        (``set()`` with no argument empties the tensor). The reference
+        aliases the underlying *storage* so later mutations are shared;
+        this facade's arrays are immutable XLA values (module docstring),
+        so ``set`` shares the current VALUE — each facade then evolves
+        independently. Code that uses set() for buffer reuse (its dominant
+        reference idiom) behaves identically; code that relies on spooky
+        cross-tensor mutation must be restructured."""
+        import jax.numpy as jnp
+
+        if other is None:
+            self.data = jnp.zeros((0,), self.data.dtype)
+        else:
+            self.data = _unwrap(other)
+        return self
+
+    def cadd(self, *args) -> "Tensor":
+        """``cadd(value, y)`` → self += value*y (the reference's axpy
+        spelling, used by its SGD); ``cadd(y)`` → self += y."""
+        if len(args) == 1:
+            self.data = self.data + _unwrap(args[0])
+        else:
+            value, y = args
+            self.data = self.data + value * _unwrap(y)
+        return self
+
+    def csub(self, *args) -> "Tensor":
+        """``csub(value, y)`` → self -= value*y; ``csub(y)`` → self -= y."""
+        if len(args) == 1:
+            self.data = self.data - _unwrap(args[0])
+        else:
+            value, y = args
+            self.data = self.data - value * _unwrap(y)
+        return self
+
+    def tpow(self, value: float) -> "Tensor":
+        """self = value ** self (reference ``tpow``: scalar base raised to
+        each element)."""
+        self.data = value ** self.data
+        return self
+
+    def sum_square(self) -> float:
+        """Reference ``sumSquare()`` — sum of squared elements."""
+        import jax.numpy as jnp
+
+        return float(jnp.sum(jnp.square(
+            self.data.astype(jnp.float32))))
+
+    def add_singleton_dimension(self, dim: int = 1) -> "Tensor":
+        """Reference ``addSingletonDimension``: in-place unsqueeze at
+        1-based ``dim`` (negative dims count from the end)."""
+        if dim < 0:  # normalize: unsqueeze computes axis = dim - 1 itself
+            dim = _resolve_dim(dim, self.data.ndim + 1) + 1
+        self.data = self.unsqueeze(dim).data
+        return self
+
+    def del_singleton_dimension(self, dim: int = 1) -> "Tensor":
+        """Reference ``delSingletonDimension``: in-place squeeze of the
+        1-based ``dim`` (must be size 1; negative dims count from the
+        end)."""
+        d = _resolve_dim(dim, self.data.ndim)
+        if self.data.shape[d] != 1:
+            raise ValueError(
+                f"dim {dim} has size {self.data.shape[d]}, not 1")
+        self.data = self.squeeze(d + 1).data
+        return self
+
+    def get_type(self) -> str:
+        """Reference ``getType()`` — the scalar type tag."""
+        return str(self.data.dtype)
+
+    def is_empty(self) -> bool:
+        return self.n_element() == 0
+
+    def is_scalar(self) -> bool:
+        return self.data.ndim == 0 or tuple(self.data.shape) == (1,)
+
+    def potri(self, uplo: str = "U") -> "Tensor":
+        """Inverse from a Cholesky factor (reference ``potri``; pairs with
+        ``potrf``). ``uplo`` names which triangle of self holds the
+        factor."""
+        import jax.numpy as jnp
+
+        host = np.asarray(self.data, np.float64)  # eager LAPACK-style op:
+        chol = np.triu(host) if uplo == "U" else np.tril(host)
+        a = chol.T @ chol if uplo == "U" else chol @ chol.T
+        return Tensor(jnp.asarray(np.linalg.inv(a),
+                                  dtype=self.data.dtype))
+
+    @staticmethod
+    def rand(*sizes: int, seed: int = 0) -> "Tensor":
+        import jax
+
+        return Tensor(jax.random.uniform(jax.random.PRNGKey(seed), sizes))
+
+    def new(self, *sizes: int) -> "Tensor":
+        """Torch idiom ``t.new(sizes)``: fresh zero tensor, same dtype."""
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros(sizes, self.data.dtype))
+
+    def apply2(self, other, func) -> "Tensor":
+        """Two-tensor apply (reference ``DenseTensorApply.apply2``):
+        self[i] = func(self[i], other[i]) with a host Python function —
+        eager and slow by design, exactly like the reference's JVM
+        fallback loop (`map` is the trait-level spelling)."""
+        a = np.asarray(self.data).copy()
+        b = np.asarray(_unwrap(other))
+        out = np.empty_like(a)
+        for idx in np.ndindex(a.shape):
+            out[idx] = func(a[idx], b[idx])
+        import jax.numpy as jnp
+
+        self.data = jnp.asarray(out)
+        return self
+
+    def apply3(self, t1, t2, func) -> "Tensor":
+        """Three-tensor apply (reference ``DenseTensorApply.apply3``):
+        self[i] = func(t1[i], t2[i])."""
+        a = np.asarray(_unwrap(t1))
+        b = np.asarray(_unwrap(t2))
+        out = np.empty(a.shape, np.asarray(self.data).dtype)
+        for idx in np.ndindex(a.shape):
+            out[idx] = func(a[idx], b[idx])
+        import jax.numpy as jnp
+
+        self.data = jnp.asarray(out)
+        return self
+
+    zip_with = apply3  # reference ``zipWith`` spelling
+
+    def bhistc(self, bins: int = 100, min_v: float = 0.0,
+               max_v: float = 0.0) -> "Tensor":
+        """Per-row histogram of a 2-D tensor (reference ``bhistc``);
+        min==max → use each row's own range, like ``histc``."""
+        import jax.numpy as jnp
+
+        host = np.asarray(self.data)
+        if host.ndim != 2:
+            raise ValueError("bhistc expects a 2-D tensor")
+        rows = []
+        for r in host:
+            lo, hi = (min_v, max_v) if min_v != max_v else (
+                float(r.min()), float(r.max()))
+            rows.append(np.histogram(r, bins=bins, range=(lo, hi))[0])
+        return Tensor(jnp.asarray(np.stack(rows), jnp.float32))
+
     def __repr__(self) -> str:
         return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"
 
@@ -1579,9 +1729,32 @@ Tensor.squeeze_ = _squeeze_
 # often uses the torch spellings.
 for _plain in ("abs", "add", "ceil", "clamp", "copy", "div", "exp", "fill",
                "floor", "log", "masked_fill", "mul", "pow", "round",
-               "sub", "zero"):
+               "sub", "zero",
+               # round-3b batch — all in-place under their plain names
+               "sqrt", "rsqrt", "sin", "cos", "tan", "tanh", "sigmoid",
+               "reciprocal", "erf", "erfc", "trunc", "frac", "lerp",
+               "fmod", "remainder", "uniform", "normal", "bernoulli",
+               "random", "cadd", "csub", "tpow", "cmul", "cdiv",
+               "log2", "log10", "log1p", "expm1", "sign", "neg",
+               "exponential", "cauchy", "geometric", "log_normal"):
     setattr(Tensor, _plain + "_", getattr(Tensor, _plain))
 del _plain
+
+
+def _make_rebinder(name):
+    def rebind(self, *a, **kw):
+        self.data = getattr(self, name)(*a, **kw).data
+        return self
+
+    rebind.__name__ = name + "_"
+    rebind.__doc__ = (f"In-place {name} (torch dialect): the plain "
+                      f"``{name}`` returns a new Tensor.")
+    return rebind
+
+
+for _viewer in ("t", "transpose", "unsqueeze"):
+    setattr(Tensor, _viewer + "_", _make_rebinder(_viewer))
+del _viewer
 
 
 def _tensor_flatten(t: Tensor):

@@ -9,28 +9,6 @@ import os
 import sys
 
 
-class _DelayedGradientPuts:
-    """Wraps a BlockStore: gradient-block puts from iteration
-    ``first_iter`` on sleep first — a process whose gradient transfers
-    straggle (the BlockManager slow-fetch scenario) after the warmup
-    window calibrated healthy thresholds."""
-
-    def __init__(self, inner, delay_s, first_iter):
-        self._inner, self._delay, self._first = inner, delay_s, first_iter
-
-    def put(self, key, value):
-        import time
-
-        parts = key.split("/")
-        if len(parts) >= 3 and parts[1] == "g" and \
-                int(parts[2]) >= self._first:
-            time.sleep(self._delay)
-        self._inner.put(key, value)
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
-
-
 def main():
     pid = int(sys.argv[1])
     port = sys.argv[2]
@@ -86,9 +64,11 @@ def main():
         # coordination service, straggler gradient-drop in the _drop mode
         from bigdl_tpu.parallel.block_store import CoordServiceBlockStore
 
+        from tests.straggler import DelayedGradientPuts
+
         store = CoordServiceBlockStore()
         if mode == "blockstore_drop" and pid == n_procs - 1:
-            store = _DelayedGradientPuts(store, delay_s=0.7, first_iter=2)
+            store = DelayedGradientPuts(store, delay_s=0.7, first_iter=2)
         opt = Optimizer(
             model=model, dataset=ds, criterion=ClassNLLCriterion(),
             batch_size=16 * n_procs,

@@ -1,0 +1,24 @@
+"""Shared straggler-injection helper for the block-store tests: wraps a
+BlockStore so gradient-block puts from iteration ``first_iter`` on sleep
+first — a process whose gradient transfers straggle (the reference's
+BlockManager slow-fetch scenario) AFTER the warmup window calibrated
+thresholds on healthy iterations, which is the reference's operating
+assumption. Used by both the threaded unit tests (test_block_store.py)
+and the real multi-process pod worker (multihost_worker.py)."""
+
+import time
+
+
+class DelayedGradientPuts:
+    def __init__(self, inner, delay_s, first_iter=1):
+        self._inner, self._delay, self._first = inner, delay_s, first_iter
+
+    def put(self, key, value):
+        parts = key.split("/")
+        if len(parts) >= 3 and parts[1] == "g" and \
+                int(parts[2]) >= self._first:
+            time.sleep(self._delay)
+        self._inner.put(key, value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

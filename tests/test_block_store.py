@@ -122,25 +122,7 @@ def _run_exchange(store, n, total, grads_by_pid, w0, n_iters=1,
     return results, bsps
 
 
-class _DelayedStore:
-    """Simulates a straggling gradient-transfer path (the BlockManager
-    slow-fetch case): puts of gradient blocks from iteration
-    ``first_iter`` on sleep first — stragglers appear AFTER the warmup
-    window calibrated thresholds on healthy iterations, which is the
-    reference's operating assumption."""
-
-    def __init__(self, inner, delay_s, first_iter=1):
-        self._inner, self._delay, self._first = inner, delay_s, first_iter
-
-    def put(self, key, value):
-        parts = key.split("/")
-        if len(parts) >= 3 and parts[1] == "g" and \
-                int(parts[2]) >= self._first:
-            time.sleep(self._delay)
-        self._inner.put(key, value)
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
+from tests.straggler import DelayedGradientPuts as _DelayedStore  # noqa: E402
 
 
 def test_threaded_exchange_matches_numpy(tmp_path):

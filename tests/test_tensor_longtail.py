@@ -304,3 +304,153 @@ def test_round3_squeeze_inplace():
     t = Tensor(np.zeros((2, 1, 3), np.float32))
     r = t.squeeze_()
     assert r is t and t.data.shape == (2, 3)
+
+
+# -- round-3b tranche: storage-set, axpy family, apply variants ------------
+
+def test_cadd_csub_vs_torch():
+    t, tt = _pair(seed=10)
+    y, ty = _pair(seed=11)
+    assert_close(t.clone().cadd(0.7, y).data,
+                 tt.clone().add(ty, alpha=0.7).numpy())
+    assert_close(t.clone().csub(0.7, y).data,
+                 tt.clone().sub(ty, alpha=0.7).numpy())
+    assert_close(t.clone().cadd(y).data, (tt + ty).numpy())
+    assert_close(t.clone().csub(y).data, (tt - ty).numpy())
+
+
+def test_tpow_vs_torch():
+    t = Tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    tt = torch.tensor([1.0, 2.0, 3.0])
+    assert_close(t.clone().tpow(2.0).data,
+                 torch.pow(2.0, tt).numpy())
+
+
+def test_sum_square():
+    t, tt = _pair(seed=12)
+    assert abs(t.sum_square() - float((tt ** 2).sum())) < 1e-4
+
+
+def test_set_rebinds_value():
+    t = Tensor(np.zeros((2, 2), np.float32))
+    y = Tensor(np.array([1.0, 2.0], np.float32))
+    assert t.set(y) is t
+    assert_close(t.data, y.data)
+    t.set()
+    assert t.is_empty() and t.n_element() == 0
+
+
+def test_singleton_dimension_roundtrip():
+    t = Tensor(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+    t.add_singleton_dimension(1)
+    assert tuple(t.data.shape) == (1, 3, 4)
+    t.add_singleton_dimension(3)
+    assert tuple(t.data.shape) == (1, 3, 1, 4)
+    t.del_singleton_dimension(3).del_singleton_dimension(1)
+    assert tuple(t.data.shape) == (3, 4)
+    with pytest.raises(ValueError):
+        t.del_singleton_dimension(1)  # size 3, not 1
+    # negative dims count from the end
+    t.add_singleton_dimension(-1)
+    assert tuple(t.data.shape) == (3, 4, 1)
+    t.del_singleton_dimension(-1)
+    assert tuple(t.data.shape) == (3, 4)
+
+
+def test_scalar_meta_predicates():
+    assert Tensor(np.float32(2.0)).is_scalar()
+    assert Tensor(np.array([2.0], np.float32)).is_scalar()
+    assert not Tensor(np.zeros((2,), np.float32)).is_scalar()
+    assert Tensor(np.zeros((0,), np.float32)).is_empty()
+    assert Tensor(np.arange(3.0, dtype=np.float32)).get_type() == "float32"
+
+
+def test_potri_vs_torch():
+    rs = np.random.RandomState(3)
+    m = rs.rand(4, 4).astype(np.float32)
+    a = m @ m.T + 4 * np.eye(4, dtype=np.float32)  # SPD
+    u = np.linalg.cholesky(a).T.astype(np.float32)
+    want = torch.cholesky_inverse(
+        torch.from_numpy(u), upper=True).numpy()
+    assert_close(Tensor(u).potri("U").data, want, rtol=1e-3, atol=1e-4)
+    l = np.linalg.cholesky(a).astype(np.float32)
+    want_l = torch.cholesky_inverse(torch.from_numpy(l)).numpy()
+    assert_close(Tensor(l).potri("L").data, want_l, rtol=1e-3, atol=1e-4)
+
+
+def test_rand_and_new():
+    r = Tensor.rand(100, seed=1)
+    h = np.asarray(r.data)
+    assert h.shape == (100,) and 0.0 <= h.min() and h.max() <= 1.0
+    t = Tensor(np.ones((2,), np.float64))
+    n = t.new(3, 2)
+    assert n.data.shape == (3, 2) and n.data.dtype == t.data.dtype
+    assert float(np.abs(np.asarray(n.data)).sum()) == 0.0
+
+
+def test_apply2_apply3_zip_with():
+    a = Tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b = Tensor(np.array([[10.0, 20.0], [30.0, 40.0]], np.float32))
+    out = a.clone().apply2(b, lambda x, y: x * y + 1)
+    assert_close(out.data, np.array([[11.0, 41.0], [91.0, 161.0]]))
+    z = Tensor(np.zeros((2, 2), np.float32))
+    z.apply3(a, b, lambda x, y: y - x)
+    assert_close(z.data, np.array([[9.0, 18.0], [27.0, 36.0]]))
+    z2 = Tensor(np.zeros((2, 2), np.float32))
+    z2.zip_with(a, b, lambda x, y: max(x, y / 20))
+    assert_close(z2.data, np.maximum(np.asarray(a.data),
+                                     np.asarray(b.data) / 20))
+
+
+def test_bhistc_vs_torch_rows():
+    rs = np.random.RandomState(4)
+    m = rs.rand(3, 50).astype(np.float32)
+    got = np.asarray(Tensor(m).bhistc(bins=8, min_v=0.0, max_v=1.0).data)
+    for i in range(3):
+        want = torch.histc(torch.from_numpy(m[i]), bins=8, min=0.0,
+                           max=1.0).numpy()
+        assert_close(got[i], want)
+    with pytest.raises(ValueError):
+        Tensor(m[0]).bhistc()
+
+
+def test_round3b_inplace_aliases_vs_torch():
+    """The new underscore spellings mutate self and match torch's."""
+    t, tt = _pair(seed=13)
+    t2 = t.clone().abs().add(0.5)      # positive domain
+    tt2 = tt.abs().add(0.5)
+    for name in ("sqrt", "rsqrt", "log", "log2", "log10", "log1p",
+                 "reciprocal", "sign", "trunc", "frac", "neg"):
+        x = t2.clone()
+        ret = getattr(x, name + "_")()
+        assert ret is x, name
+        assert_close(x.data, getattr(tt2.clone(), name + "_")().numpy(),
+                     rtol=1e-4, atol=1e-5, msg=name)
+    for name in ("sin", "cos", "tan", "tanh", "sigmoid", "erf", "erfc"):
+        x = t.clone()
+        getattr(x, name + "_")()
+        assert_close(x.data, getattr(tt.clone(), name + "_")().numpy(),
+                     rtol=1e-4, atol=1e-5, msg=name)
+    x = t.clone()
+    x.fmod_(1.5)
+    assert_close(x.data, tt.clone().fmod_(1.5).numpy())
+    x = t.clone()
+    x.remainder_(1.5)
+    assert_close(x.data, tt.clone().remainder_(1.5).numpy())
+    x = t.clone()
+    x.lerp_(Tensor(np.zeros((3, 4), np.float32)), 0.25)
+    assert_close(x.data,
+                 tt.clone().lerp_(torch.zeros(3, 4), 0.25).numpy())
+
+
+def test_round3b_view_rebinders():
+    t = Tensor(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+    ref = np.asarray(t.data).copy()
+    assert t.t_() is t
+    assert_close(t.data, ref.T)
+    t2 = Tensor(ref.copy())
+    t2.transpose_(1, 2)
+    assert_close(t2.data, ref.T)
+    t3 = Tensor(ref.copy())
+    t3.unsqueeze_(1)
+    assert tuple(t3.data.shape) == (1, 2, 3)
