@@ -4,7 +4,8 @@ from bigdl_tpu.optim.optim_method import (
     LearningRateSchedule, MultiStep, OptimMethod, Plateau, Poly, RMSprop,
     SequentialSchedule, SGD, Step, Warmup,
 )
-from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer
+from bigdl_tpu.optim.optimizer import (LocalOptimizer, Optimizer,
+                                        TrainingPreempted)
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.optim.evaluator import Evaluator, LocalPredictor, Predictor, Validator
 from bigdl_tpu.optim.trigger import Trigger
@@ -21,6 +22,7 @@ __all__ = [
     "LearningRateSchedule", "MultiStep", "OptimMethod", "Plateau", "Poly",
     "RMSprop", "SequentialSchedule", "SGD", "Step", "Warmup",
     "LocalOptimizer", "Optimizer", "DistriOptimizer", "Trigger",
+    "TrainingPreempted",
     "Evaluator", "LocalPredictor", "Predictor", "Validator",
     "AccuracyResult", "Loss", "LossResult", "MAE", "Top1Accuracy",
     "Top5Accuracy", "TreeNNAccuracy", "ValidationMethod", "ValidationResult",

@@ -519,9 +519,7 @@ class DistriOptimizer(Optimizer):
             wfull = bsp.get_weights(t + 1)
             new_params = unravel(jnp.asarray(wfull))
             cache["params_ref"] = new_params
-            cache["wpad"] = np.concatenate(
-                [wfull, np.zeros(bsp.padded_size - wfull.size, np.float32)]
-            ) if wfull.size != bsp.padded_size else wfull
+            cache["wpad"] = bsp._pad(wfull)
             # BN running stats: average the float leaves across processes
             # (the pmean the SPMD modes do each step)
             if n_proc > 1:

@@ -35,6 +35,86 @@ from bigdl_tpu.optim.validation import ValidationMethod
 logger = logging.getLogger("bigdl_tpu")
 
 
+class TrainingPreempted(RuntimeError):
+    """Raised when training stops at an iteration boundary because a
+    preemption signal (SIGTERM) arrived — AFTER a final checkpoint was
+    written. Deliberately not retried by the bounded-retry wrapper: the
+    process is being evicted; the restarted job resumes with
+    ``optimize(resume=True)``."""
+
+
+def _natural_key(s: str):
+    import re
+
+    return [int(p) if p.isdigit() else p for p in re.split(r"(\d+)", str(s))]
+
+
+def _digit_skeleton(s: str) -> str:
+    import re
+
+    return re.sub(r"\d+", "#", str(s))
+
+
+def _adapt_restored_tree(template, restored, what: str, _path: str = ""):
+    """Reconcile a restored checkpoint tree against the live structure.
+
+    A model rebuilt in the same process gets fresh auto-name counters
+    (``Linear13`` where the checkpoint says ``Linear1``), and orbax
+    restores tuples as lists. Walk both trees together: dict levels whose
+    key sets differ are paired in NATURAL-SORT order (numeric runs compare
+    as numbers — i.e. construction order for counter-suffixed names, which
+    plain sorted() would scramble across digit-count boundaries), with the
+    non-digit skeleton of each paired key required to match; sequences
+    pair by position; leaf shapes must agree. Anything else is a real
+    architecture mismatch and raises."""
+    if restored is None:
+        return template
+    where = f"{what}{_path}"
+    if isinstance(template, dict) and isinstance(restored, dict):
+        if len(template) != len(restored):
+            raise ValueError(
+                f"checkpoint {where} has {len(restored)} entries but the "
+                f"model expects {len(template)} — different architecture")
+        if set(template) == set(restored):
+            return {k: _adapt_restored_tree(template[k], restored[k], what,
+                                            f"{_path}/{k}")
+                    for k in template}
+        tk = sorted(template, key=_natural_key)
+        rk = sorted(restored, key=_natural_key)
+        out = {}
+        for a, b in zip(tk, rk):
+            if _digit_skeleton(a) != _digit_skeleton(b):
+                raise ValueError(
+                    f"checkpoint {where} key {b!r} does not correspond to "
+                    f"the model's {a!r} — different architecture")
+            out[a] = _adapt_restored_tree(template[a], restored[b], what,
+                                          f"{_path}/{a}")
+        logger.info(
+            "resume: %s keys differ from the live model (rebuilt module "
+            "auto-names); matched %s in natural order", where, list(rk))
+        return out
+    if isinstance(template, (list, tuple)) and \
+            isinstance(restored, (list, tuple)):
+        if len(template) != len(restored):
+            raise ValueError(
+                f"checkpoint {where} has {len(restored)} entries but the "
+                f"model expects {len(template)} — different architecture")
+        vals = [_adapt_restored_tree(t, r, what, f"{_path}[{i}]")
+                for i, (t, r) in enumerate(zip(template, restored))]
+        return type(template)(vals) if isinstance(template, tuple) else vals
+    if isinstance(template, dict) or isinstance(restored, dict) or \
+            isinstance(template, (list, tuple)) or \
+            isinstance(restored, (list, tuple)):
+        raise ValueError(
+            f"checkpoint {where} container kind does not match the model "
+            "— different architecture")
+    if tuple(np.shape(template)) != tuple(np.shape(restored)):
+        raise ValueError(
+            f"checkpoint {where} has shape {np.shape(restored)} but the "
+            f"model expects {np.shape(template)} — different architecture")
+    return restored
+
+
 def _ensure_dataset(dataset, batch_size: Optional[int],
                     drop_remainder: bool = True) -> AbstractDataSet:
     if dataset is None:
@@ -101,6 +181,9 @@ class Optimizer:
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", "1")
         )
+        self._handle_preemption = False
+        self._preempt_flag = False
+        self._async_ckptr = None
 
     # -- fluent config (reference names, snake_case) -----------------------
 
@@ -135,11 +218,24 @@ class Optimizer:
             path = checkpoint_path
         if path is None or trigger is None:
             raise ValueError("set_checkpoint needs both a path and a trigger")
-        if backend not in ("pickle", "orbax"):
+        if backend not in ("pickle", "orbax", "orbax_async"):
             raise ValueError(f"unknown checkpoint backend {backend!r}")
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.checkpoint_backend = backend
+        return self
+
+    def handle_preemption(self, enabled: bool = True) -> "Optimizer":
+        """TPU-native extension (no reference counterpart — Spark rebuilt
+        lost executors; a preempted TPU slice just dies): when enabled,
+        a SIGTERM during ``optimize()`` finishes the in-flight iteration,
+        writes a final checkpoint (``set_checkpoint`` must be configured),
+        and raises :class:`TrainingPreempted` — which the bounded retry
+        deliberately does NOT swallow. The restarted job continues with
+        ``optimize(resume=True)``. On multi-process pods the scheduler
+        delivers SIGTERM to every process of the slice, so each writes
+        its own shard checkpoint at the same iteration boundary."""
+        self._handle_preemption = bool(enabled)
         return self
 
     def over_write_checkpoint(self) -> "Optimizer":
@@ -236,13 +332,12 @@ class Optimizer:
             return
         tag = "" if self.overwrite_checkpoint else f".{state['neval']}"
         os.makedirs(self.checkpoint_path, exist_ok=True)
-        if self.checkpoint_backend == "orbax":
+        if self.checkpoint_backend in ("orbax", "orbax_async"):
             import jax
             import orbax.checkpoint as ocp
 
             target = os.path.abspath(
                 os.path.join(self.checkpoint_path, f"orbax{tag or '.0'}"))
-            ckptr = ocp.PyTreeCheckpointer()
             blob = {
                 "params": jax.tree_util.tree_map(np.asarray, params),
                 "model_state": jax.tree_util.tree_map(np.asarray, model_state),
@@ -251,7 +346,17 @@ class Optimizer:
                 "neval": np.int64(state["neval"]),
                 "seen": np.int64(state.get("seen", 0)),
             }
-            ckptr.save(target, blob, force=True)
+            if self.checkpoint_backend == "orbax_async":
+                # TPU-ecosystem async save: the write happens on a
+                # background thread while training continues; the only
+                # sync points are back-to-back saves and loop exit
+                if self._async_ckptr is None:
+                    self._async_ckptr = ocp.AsyncCheckpointer(
+                        ocp.PyTreeCheckpointHandler())
+                self._async_ckptr.wait_until_finished()
+                self._async_ckptr.save(target, blob, force=True)
+                return
+            ocp.PyTreeCheckpointer().save(target, blob, force=True)
             return
         File.save(
             # same blob shape as Module.save, so Module.load() can open a
@@ -277,8 +382,11 @@ class Optimizer:
 
         if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
             return None
-        if self.checkpoint_backend == "orbax":
+        if self.checkpoint_backend in ("orbax", "orbax_async"):
             import orbax.checkpoint as ocp
+
+            if self._async_ckptr is not None:
+                self._async_ckptr.wait_until_finished()
 
             def _iteration_of(f):
                 # valid snapshots are "orbax.<iter>"; anything else (orbax
@@ -303,7 +411,8 @@ class Optimizer:
             return (
                 {"params": blob["params"], "model_state": blob["model_state"]},
                 {"opt_state": blob["opt_state"], "epoch": int(blob["epoch"]),
-                 "neval": int(blob["neval"])},
+                 "neval": int(blob["neval"]),
+                 "seen": int(blob.get("seen", 0))},
             )
         models = sorted(
             f for f in os.listdir(self.checkpoint_path) if f.startswith("model")
@@ -375,8 +484,8 @@ class Optimizer:
         for attempt in range(self.retry_times):
             try:
                 return self._optimize_once(resume=resume or attempt > 0)
-            except (KeyboardInterrupt, SystemExit):
-                raise
+            except (KeyboardInterrupt, SystemExit, TrainingPreempted):
+                raise  # eviction is not a transient failure — no retry
             except Exception as e:  # bounded retry from checkpoint (§5.3)
                 last_err = e
                 logger.exception(
@@ -421,6 +530,42 @@ class Optimizer:
 
         self.model.training()
         self.model._ensure_params()
+        prev_sigterm = None
+        if self._handle_preemption:
+            import signal
+
+            if not self.checkpoint_path:
+                raise ValueError(
+                    "handle_preemption() needs set_checkpoint(...) "
+                    "configured — an eviction with nowhere to write the "
+                    "final snapshot would silently lose all progress")
+            self._preempt_flag = False
+
+            def _on_sigterm(signum, frame):
+                logger.warning(
+                    "SIGTERM received: finishing the current iteration, "
+                    "checkpointing, then stopping (TrainingPreempted)")
+                self._preempt_flag = True
+
+            try:  # signal handlers only install from the main thread
+                prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:
+                logger.warning(
+                    "handle_preemption: not on the main thread, SIGTERM "
+                    "hook not installed")
+        try:
+            return self._optimize_loop(resume)
+        finally:
+            if prev_sigterm is not None:
+                import signal
+
+                signal.signal(signal.SIGTERM, prev_sigterm)
+            if self._async_ckptr is not None:
+                self._async_ckptr.wait_until_finished()
+
+    def _optimize_loop(self, resume: bool = False):
+        import jax
+
         step, place_batch, params, opt_state, model_state = self._prepare()
         state = self._state0()
 
@@ -428,9 +573,19 @@ class Optimizer:
             snap = self._latest_checkpoint()
             if snap is not None:
                 mblob, oblob = snap
-                params = self._host_params_to_device(mblob["params"])
-                model_state = mblob.get("state", mblob.get("model_state"))
-                opt_state = self._opt_state_to_device(oblob["opt_state"])
+                # a model rebuilt in the same process gets fresh auto-name
+                # counters ("Linear2" vs the checkpoint's "Linear1"), so
+                # reconcile restored trees against the live structure by
+                # position when only the key names differ
+                restored_params = _adapt_restored_tree(
+                    self.model.params, mblob["params"], "params")
+                params = self._host_params_to_device(restored_params)
+                model_state = _adapt_restored_tree(
+                    model_state, mblob.get("state", mblob.get("model_state")),
+                    "model_state")
+                opt_state = self._opt_state_to_device(_adapt_restored_tree(
+                    self._ckpt_opt_state_to_host(opt_state),
+                    oblob["opt_state"], "opt_state"))
                 state["epoch"] = oblob["epoch"]
                 state["neval"] = oblob["neval"]
                 state["seen"] = oblob.get("seen", 0)
@@ -465,6 +620,16 @@ class Optimizer:
         epoch_start = time.time()
 
         while not self.end_when(state):
+            if self._preempt_flag:
+                self._checkpoint(
+                    state, self._ckpt_params_to_host(params), model_state,
+                    self._ckpt_opt_state_to_host(opt_state),
+                )
+                if self._async_ckptr is not None:
+                    self._async_ckptr.wait_until_finished()
+                raise TrainingPreempted(
+                    f"evicted at iteration {state['neval']}; checkpoint "
+                    f"written to {self.checkpoint_path or '(no path set)'}")
             state["epoch_finished"] = False
             if self._profile is not None:
                 if state["neval"] == self._profile["start"]:

@@ -1668,28 +1668,18 @@ class Tensor:
     def apply2(self, other, func) -> "Tensor":
         """Two-tensor apply (reference ``DenseTensorApply.apply2``):
         self[i] = func(self[i], other[i]) with a host Python function —
-        eager and slow by design, exactly like the reference's JVM
-        fallback loop (`map` is the trait-level spelling)."""
-        a = np.asarray(self.data).copy()
-        b = np.asarray(_unwrap(other))
-        out = np.empty_like(a)
-        for idx in np.ndindex(a.shape):
-            out[idx] = func(a[idx], b[idx])
-        import jax.numpy as jnp
-
-        self.data = jnp.asarray(out)
-        return self
+        ``map`` is the trait-level spelling and provides the kernel."""
+        return self.map(other, func)
 
     def apply3(self, t1, t2, func) -> "Tensor":
         """Three-tensor apply (reference ``DenseTensorApply.apply3``):
         self[i] = func(t1[i], t2[i])."""
-        a = np.asarray(_unwrap(t1))
-        b = np.asarray(_unwrap(t2))
-        out = np.empty(a.shape, np.asarray(self.data).dtype)
-        for idx in np.ndindex(a.shape):
-            out[idx] = func(a[idx], b[idx])
         import jax.numpy as jnp
 
+        a = np.asarray(_unwrap(t1))
+        b = np.asarray(_unwrap(t2))
+        out = np.vectorize(func,
+                           otypes=[np.asarray(self.data).dtype])(a, b)
         self.data = jnp.asarray(out)
         return self
 
