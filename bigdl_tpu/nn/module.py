@@ -392,6 +392,15 @@ class AbstractModule:
 
         return load_tf(path, inputs, outputs)
 
+    @staticmethod
+    def load_keras(json_path: str = None, hdf5_path: str = None):
+        """Reference pyspark ``Model.load_keras(json_path, hdf5_path)``:
+        import a Keras-1.2 architecture (+ HDF5 weights) as a native
+        model (``utils/keras_loader.py``)."""
+        from bigdl_tpu.utils.keras_loader import load_keras
+
+        return load_keras(json_path, hdf5_path)
+
     def __getstate__(self):
         d = dict(self.__dict__)
         # grads and cached activations are not part of a snapshot

@@ -414,8 +414,18 @@ class Optimizer:
                  "neval": int(blob["neval"]),
                  "seen": int(blob.get("seen", 0))},
             )
+        def _snap_iter(f):
+            # numeric ordering: "model.12" must outrank "model.9" (and the
+            # overwrite-mode bare "model" sorts first)
+            try:
+                return float(f[len("model."):] or 0)
+            except ValueError:
+                return -1.0
+
         models = sorted(
-            f for f in os.listdir(self.checkpoint_path) if f.startswith("model")
+            (f for f in os.listdir(self.checkpoint_path)
+             if f.startswith("model")),
+            key=_snap_iter,
         )
         if not models:
             return None
@@ -480,19 +490,34 @@ class Optimizer:
         ``set_checkpoint``'s path before the first attempt — the pod
         restart-after-kill entry point (within-process failures always
         retry from checkpoint regardless)."""
+        if self._handle_preemption and not self.checkpoint_path:
+            # configuration error — validate BEFORE the retry loop so it
+            # isn't pointlessly retried
+            raise ValueError(
+                "handle_preemption() needs set_checkpoint(...) configured "
+                "— an eviction with nowhere to write the final snapshot "
+                "would silently lose all progress")
         last_err = None
-        for attempt in range(self.retry_times):
-            try:
-                return self._optimize_once(resume=resume or attempt > 0)
-            except (KeyboardInterrupt, SystemExit, TrainingPreempted):
-                raise  # eviction is not a transient failure — no retry
-            except Exception as e:  # bounded retry from checkpoint (§5.3)
-                last_err = e
-                logger.exception(
-                    "training attempt %d failed; retrying from checkpoint", attempt
-                )
-                time.sleep(self.retry_interval_s)
-        raise last_err
+        try:
+            for attempt in range(self.retry_times):
+                try:
+                    return self._optimize_once(resume=resume or attempt > 0)
+                except (KeyboardInterrupt, SystemExit, TrainingPreempted):
+                    raise  # eviction is not a transient failure — no retry
+                except Exception as e:  # bounded retry from checkpoint (§5.3)
+                    last_err = e
+                    logger.exception(
+                        "training attempt %d failed; retrying from "
+                        "checkpoint", attempt)
+                    time.sleep(self.retry_interval_s)
+            raise last_err
+        finally:
+            if self._async_ckptr is not None:
+                # release the background save executor (a long-lived
+                # process may construct many Optimizers)
+                self._async_ckptr.wait_until_finished()
+                self._async_ckptr.close()
+                self._async_ckptr = None
 
     # -- subclass hooks ----------------------------------------------------
 
@@ -534,11 +559,6 @@ class Optimizer:
         if self._handle_preemption:
             import signal
 
-            if not self.checkpoint_path:
-                raise ValueError(
-                    "handle_preemption() needs set_checkpoint(...) "
-                    "configured — an eviction with nowhere to write the "
-                    "final snapshot would silently lose all progress")
             self._preempt_flag = False
 
             def _on_sigterm(signum, frame):

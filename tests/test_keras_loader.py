@@ -1,0 +1,227 @@
+"""Keras-1.2 import (reference pyspark ``Model.load_keras`` +
+``bigdl/keras`` converter; SURVEY §4 keras-compat harness). JSON configs
+and HDF5 weight files are hand-written in the keras1 on-disk layout — no
+keras/TF execution anywhere."""
+
+import json
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.keras_loader import load_keras, load_keras_json
+
+torch = pytest.importorskip("torch")
+h5py = pytest.importorskip("h5py")
+
+
+def _write_h5(path, layers):
+    """keras1 layout: root attr layer_names; per-layer group with
+    weight_names."""
+    with h5py.File(path, "w") as f:
+        f.attrs["layer_names"] = [n.encode() for n, _ in layers]
+        for name, arrays in layers:
+            g = f.create_group(name)
+            wnames = [f"{name}_p{i}".encode()
+                      for i in range(len(arrays))]
+            g.attrs["weight_names"] = wnames
+            for wn, arr in zip(wnames, arrays):
+                g[wn.decode()] = arr
+
+
+def _seq_json(layer_entries):
+    return json.dumps({"class_name": "Sequential",
+                       "config": layer_entries})
+
+
+def test_dense_mlp_weights_forward_parity(tmp_path):
+    rs = np.random.RandomState(0)
+    w1 = rs.randn(4, 3).astype(np.float32)   # keras kernel (in, out)
+    b1 = rs.randn(3).astype(np.float32)
+    w2 = rs.randn(3, 2).astype(np.float32)
+    b2 = rs.randn(2).astype(np.float32)
+
+    js = _seq_json([
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "output_dim": 3,
+                    "activation": "relu",
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_2", "output_dim": 2,
+                    "activation": "linear"}},
+    ])
+    (tmp_path / "m.json").write_text(js)
+    _write_h5(tmp_path / "m.h5", [("dense_1", [w1, b1]),
+                                  ("dense_2", [w2, b2])])
+
+    model = load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
+    x = rs.randn(5, 4).astype(np.float32)
+    got = np.asarray(model.forward(x))
+    want = np.maximum(x @ w1 + b1, 0.0) @ w2 + b2
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_conv_th_weights_vs_torch(tmp_path):
+    rs = np.random.RandomState(1)
+    k = rs.randn(2, 1, 3, 3).astype(np.float32)   # th kernel = OIHW
+    b = rs.randn(2).astype(np.float32)
+    js = _seq_json([
+        {"class_name": "Convolution2D",
+         "config": {"name": "conv_1", "nb_filter": 2, "nb_row": 3,
+                    "nb_col": 3, "dim_ordering": "th",
+                    "border_mode": "valid", "activation": "linear",
+                    "batch_input_shape": [None, 1, 5, 5]}},
+        {"class_name": "Flatten", "config": {"name": "flat_1"}},
+    ])
+    (tmp_path / "m.json").write_text(js)
+    _write_h5(tmp_path / "m.h5", [("conv_1", [k, b])])
+    model = load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
+
+    x = rs.randn(2, 1, 5, 5).astype(np.float32)
+    got = np.asarray(model.forward(x))
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(k),
+        torch.from_numpy(b)).numpy().reshape(2, -1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_tf_ordering_transposed_to_chw(tmp_path):
+    """A tf-dim_ordering config must produce the SAME model as its th
+    twin: shapes go to CHW, kernels transpose (r,c,in,out)->OIHW."""
+    rs = np.random.RandomState(2)
+    k_oihw = rs.randn(2, 1, 3, 3).astype(np.float32)
+    k_tf = np.transpose(k_oihw, (2, 3, 1, 0))     # (r, c, in, out)
+    b = rs.randn(2).astype(np.float32)
+
+    def build(ordering, kernel, shape):
+        js = _seq_json([
+            {"class_name": "Convolution2D",
+             "config": {"name": "conv_1", "nb_filter": 2, "nb_row": 3,
+                        "nb_col": 3, "dim_ordering": ordering,
+                        "border_mode": "valid", "activation": "linear",
+                        "batch_input_shape": shape}},
+        ])
+        p = tmp_path / f"{ordering}.json"
+        p.write_text(js)
+        _write_h5(tmp_path / f"{ordering}.h5", [("conv_1", [kernel, b])])
+        return load_keras(str(p), str(tmp_path / f"{ordering}.h5"))
+
+    th = build("th", k_oihw, [None, 1, 5, 5])
+    tf_ = build("tf", k_tf, [None, 5, 5, 1])
+    x = rs.randn(2, 1, 5, 5).astype(np.float32)   # both models eat CHW
+    np.testing.assert_allclose(np.asarray(th.forward(x)),
+                               np.asarray(tf_.forward(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_weights_and_running_stats(tmp_path):
+    rs = np.random.RandomState(3)
+    gamma = rs.rand(4).astype(np.float32) + 0.5
+    beta = rs.randn(4).astype(np.float32)
+    mean = rs.randn(4).astype(np.float32)
+    var = rs.rand(4).astype(np.float32) + 0.5    # keras1 "running_std"
+    js = _seq_json([
+        {"class_name": "BatchNormalization",
+         "config": {"name": "bn_1", "epsilon": 1e-3, "mode": 0,
+                    "batch_input_shape": [None, 4]}},
+    ])
+    (tmp_path / "m.json").write_text(js)
+    _write_h5(tmp_path / "m.h5", [("bn_1", [gamma, beta, mean, var])])
+    model = load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
+    model.evaluate()
+
+    x = rs.randn(6, 4).astype(np.float32)
+    got = np.asarray(model.forward(x))
+    want = (x - mean) / np.sqrt(var + 1e-3) * gamma + beta
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_weights(tmp_path):
+    rs = np.random.RandomState(4)
+    table = rs.randn(10, 3).astype(np.float32)
+    js = _seq_json([
+        {"class_name": "Embedding",
+         "config": {"name": "emb_1", "input_dim": 10, "output_dim": 3,
+                    "batch_input_shape": [None, 5]}},
+    ])
+    (tmp_path / "m.json").write_text(js)
+    _write_h5(tmp_path / "m.h5", [("emb_1", [table])])
+    model = load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
+
+    ids = np.array([[0, 1, 2, 9, 3]], np.int32)   # keras ids are 0-based
+    got = np.asarray(model.forward(ids))
+    np.testing.assert_allclose(got[0], table[ids[0]], rtol=1e-6)
+
+
+def test_functional_model_with_merge():
+    js = json.dumps({
+        "class_name": "Model",
+        "config": {
+            "name": "m",
+            "layers": [
+                {"class_name": "InputLayer", "name": "input_1",
+                 "config": {"name": "input_1",
+                            "batch_input_shape": [None, 4]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "dense_1",
+                 "config": {"name": "dense_1", "output_dim": 3,
+                            "activation": "relu"},
+                 "inbound_nodes": [[["input_1", 0, 0]]]},
+                {"class_name": "Dense", "name": "dense_2",
+                 "config": {"name": "dense_2", "output_dim": 3,
+                            "activation": "tanh"},
+                 "inbound_nodes": [[["input_1", 0, 0]]]},
+                {"class_name": "Merge", "name": "merge_1",
+                 "config": {"name": "merge_1", "mode": "concat",
+                            "concat_axis": -1},
+                 "inbound_nodes": [[["dense_1", 0, 0],
+                                    ["dense_2", 0, 0]]]},
+            ],
+            "input_layers": [["input_1", 0, 0]],
+            "output_layers": [["merge_1", 0, 0]],
+        },
+    })
+    model = load_keras_json(js)
+    out = np.asarray(model.forward(np.ones((2, 4), np.float32)))
+    assert out.shape == (2, 6)
+    # relu half is >= 0; tanh half is in [-1, 1]
+    assert out[:, :3].min() >= 0.0
+    assert np.all(np.abs(out[:, 3:]) <= 1.0)
+
+
+def test_unsupported_layer_and_weights_errors(tmp_path):
+    with pytest.raises(ValueError, match="SomeExotic"):
+        load_keras_json(_seq_json([
+            {"class_name": "SomeExotic",
+             "config": {"name": "x", "batch_input_shape": [None, 4]}}]))
+
+    js = _seq_json([
+        {"class_name": "LSTM",
+         "config": {"name": "lstm_1", "output_dim": 3,
+                    "return_sequences": False,
+                    "batch_input_shape": [None, 7, 4]}},
+    ])
+    (tmp_path / "m.json").write_text(js)
+    _write_h5(tmp_path / "m.h5",
+              [("lstm_1", [np.zeros((4, 3), np.float32)] * 12)])
+    # architecture alone builds fine
+    m = load_keras_json(js)
+    assert np.asarray(m.forward(np.zeros((1, 7, 4), np.float32))).shape \
+        == (1, 3)
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
+
+
+def test_mismatched_json_h5_pair_raises(tmp_path):
+    js = _seq_json([
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "output_dim": 3,
+                    "activation": "linear",
+                    "batch_input_shape": [None, 4]}},
+    ])
+    (tmp_path / "m.json").write_text(js)
+    # h5 written from a model with different auto-names
+    _write_h5(tmp_path / "m.h5",
+              [("dense_7", [np.zeros((4, 3), np.float32),
+                            np.zeros(3, np.float32)])])
+    with pytest.raises(ValueError, match="does not match"):
+        load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))

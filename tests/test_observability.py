@@ -234,6 +234,8 @@ except TrainingPreempted as e:
     line, iters = "", 0
     while time.time() < deadline and iters < 2:
         line = proc.stdout.readline()
+        if line == "" and proc.poll() is not None:
+            break  # child died before the loop started — fail fast
         if "ITER" in line:
             iters += 1
     assert iters == 2, f"loop never started: {line}"
