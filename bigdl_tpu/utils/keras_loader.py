@@ -20,9 +20,12 @@ Scope (documented, enforced with clear errors):
 * weights — Sequential models, for Dense / Convolution1D/2D /
   BatchNormalization (keras1 stored [gamma, beta, running_mean,
   running_std] where ``running_std`` is in fact the running VARIANCE —
-  keras 1.2's ``batch_normalization`` passes it as var) / Embedding.
-  Recurrent-layer weights raise NotImplementedError (gate-layout
-  conversion is model-specific); functional-model weights likewise.
+  keras 1.2's ``batch_normalization`` passes it as var) / Embedding /
+  LSTM / SimpleRNN (gate identity parsed from the keras1 weight NAMES,
+  robust to list ordering). GRU raises: keras1 applies the reset gate
+  before the recurrent matmul, this framework (torch semantics) after —
+  exact import is mathematically impossible. Functional-model weights
+  raise NotImplementedError.
 * ``dim_ordering``: ``"th"`` maps 1:1 (this framework is CHW/NCHW, the
   reference's own convention); ``"tf"`` configs get their input shapes
   and conv kernels transposed to CHW — the loaded model expects CHW
@@ -182,6 +185,18 @@ def _build_functional(config: Dict[str, Any]):
                             cfg.get("dim_ordering", "th"))
             nodes[name] = K.Input(shape)
             continue
+        if len(inbound) != 1:
+            raise _Unsupported(
+                f"layer {name!r} is applied {len(inbound)} times (shared "
+                "keras layer) — load_keras supports single-application "
+                "functional graphs")
+        for ref in inbound[0]:
+            if len(ref) > 1 and (ref[1] != 0 or (len(ref) > 2 and
+                                                 ref[2] != 0)):
+                raise _Unsupported(
+                    f"layer {name!r} consumes node port {ref[1:]} of "
+                    f"{ref[0]!r} — multi-application/multi-output "
+                    "references are not supported")
         srcs = [nodes[ref[0]] for ref in inbound[0]]
         layer = _build_layer(cname, cfg, None)
         nodes[name] = layer(srcs if len(srcs) > 1 else srcs[0])
@@ -214,9 +229,11 @@ _WEIGHTED_CLASSES = frozenset({
     "Embedding", "LSTM", "GRU", "SimpleRNN", "Highway",
 })
 
-def _h5_layer_weights(f) -> Dict[str, List[np.ndarray]]:
+def _h5_layer_weights(f) -> Dict[str, List]:
     """keras1 HDF5 layout: root attr ``layer_names``; one group per layer
-    with attr ``weight_names``."""
+    with attr ``weight_names``. Returns ``(weight_name, array)`` pairs —
+    recurrent-gate conversion keys off the NAMES (``.._W_i``/``.._U_f``),
+    which is robust to keras1's odd list ordering."""
     root = f["model_weights"] if "model_weights" in f else f
     out = {}
     for lname in [n.decode() if isinstance(n, bytes) else n
@@ -224,14 +241,62 @@ def _h5_layer_weights(f) -> Dict[str, List[np.ndarray]]:
         g = root[lname]
         wnames = [n.decode() if isinstance(n, bytes) else n
                   for n in g.attrs.get("weight_names", [])]
-        out[lname] = [np.asarray(g[w]) for w in wnames]
+        out[lname] = [(w, np.asarray(g[w])) for w in wnames]
+    return out
+
+
+def _named_gates(named, kind: str, gates: str) -> Optional[Dict[str, np.ndarray]]:
+    """Pick keras1 recurrent arrays by name suffix ``_{kind}_{gate}``
+    (e.g. ``lstm_1_W_i``); None when any gate is missing."""
+    out = {}
+    for g in gates:
+        hits = [a for n, a in named if n.endswith(f"_{kind}_{g}")]
+        if len(hits) != 1:
+            return None
+        out[g] = hits[0]
     return out
 
 
 def _convert_weights(class_name: str, cfg: Dict[str, Any],
-                     arrays: List[np.ndarray]):
-    """keras1 arrays → (param updates by key, state updates by key)."""
+                     named: List):
+    """keras1 (name, array) pairs → (param updates, state updates)."""
     dim_ordering = cfg.get("dim_ordering", "th")
+    arrays = [a for _, a in named]
+    if class_name == "LSTM":
+        # keras1 LSTM math is the standard cell (ours, torch gate order
+        # i,f,g,o); gate identity parsed from the weight names
+        W = _named_gates(named, "W", "ifco")
+        U = _named_gates(named, "U", "ifco")
+        b = _named_gates(named, "b", "ifco")
+        if not (W and U and b):
+            raise NotImplementedError(
+                "load_keras: LSTM weight names do not follow the keras1 "
+                "_W_i/_U_f/_b_c pattern — cannot identify gates")
+        order = "ifco"  # our fused layout: i, f, g(=keras c), o
+        p = {
+            "w_ih": np.concatenate([W[g].T for g in order]),
+            "w_hh": np.concatenate([U[g].T for g in order]),
+            "b_ih": np.concatenate([b[g] for g in order]),
+            "b_hh": np.zeros(sum(b[g].size for g in order), np.float32),
+        }
+        return p, {}
+    if class_name == "SimpleRNN":
+        Ws = [a for n, a in named if n.endswith("_W")]
+        Us = [a for n, a in named if n.endswith("_U")]
+        bs = [a for n, a in named if n.endswith("_b")]
+        if not (len(Ws) == len(Us) == len(bs) == 1):
+            raise NotImplementedError(
+                "load_keras: SimpleRNN weight names do not follow the "
+                "keras1 _W/_U/_b pattern")
+        return {"w_ih": Ws[0].T, "w_hh": Us[0].T, "b_ih": bs[0],
+                "b_hh": np.zeros(bs[0].size, np.float32)}, {}
+    if class_name == "GRU":
+        raise NotImplementedError(
+            "load_keras: keras1 GRU applies the reset gate BEFORE the "
+            "recurrent matmul (U_h @ (r*h)); this framework's GRU (torch "
+            "semantics) applies it after (r * (U_h @ h)) — the math "
+            "differs, so an exact weight import is impossible. Rebuild "
+            "with LSTM or retrain.")
     if class_name == "Dense":
         p = {"weight": arrays[0].T}
         if len(arrays) > 1:
@@ -285,30 +350,34 @@ def _locate_subdict(tree, key: str):
 
 def _apply_updates(tree, layer_index: int, updates: Dict[str, np.ndarray],
                    anchor: str):
-    """Copy ``tree``, replacing ``updates`` inside layer ``layer_index``'s
-    subtree (keyed ``<index>:<AutoName>`` by the Sequential container)."""
-    import copy
-
-    new = copy.deepcopy(
-        {k: v for k, v in tree.items()}) if isinstance(tree, dict) else tree
+    """Replace ``updates`` inside layer ``layer_index``'s subtree of
+    ``tree`` IN PLACE (keyed ``<index>:<AutoName>`` by the Sequential
+    container) — the caller deep-copies the tree once up front."""
     prefix = f"{layer_index}:"
-    sub_key = next((k for k in new if str(k).startswith(prefix)), None)
+    sub_key = next((k for k in tree if str(k).startswith(prefix)), None)
     if sub_key is None:
         raise ValueError(
             f"load_keras: no parameter subtree for layer {layer_index}")
-    target = _locate_subdict(new[sub_key], anchor)
+    target = _locate_subdict(tree[sub_key], anchor)
     if target is None:
         raise ValueError(
             f"load_keras: could not locate the {anchor!r}-holding params "
             f"of layer {layer_index} unambiguously")
     for k, v in updates.items():
-        if k in target and tuple(np.shape(target[k])) != tuple(v.shape):
+        if k not in target:
+            # inserting an orphan key would "load successfully" while the
+            # layer never reads it (e.g. h5 bias vs bias=false json)
+            raise ValueError(
+                f"load_keras: layer {layer_index} has no parameter {k!r} "
+                f"(built params: {sorted(target)}) — the json/h5 pair "
+                "does not match")
+        if tuple(np.shape(target[k])) != tuple(v.shape):
             raise ValueError(
                 f"load_keras: layer {layer_index} weight {k!r} shape "
                 f"{v.shape} does not match the built model's "
                 f"{np.shape(target[k])}")
         target[k] = v.astype(np.float32)
-    return new
+    return tree
 
 
 def load_keras(json_path: Optional[str] = None,
@@ -335,7 +404,12 @@ def load_keras(json_path: Optional[str] = None,
         by_layer = _h5_layer_weights(f)
 
     model._materialize_params()
-    params, state = model.params, model.state
+    import copy
+
+    # one up-front copy; _apply_updates then mutates in place (a copy per
+    # layer would be O(layers x model size))
+    params = copy.deepcopy(model.params)
+    state = copy.deepcopy(model.state)
     consumed = set()
     for i, entry in enumerate(blob["config"]):
         cname, cfg = entry["class_name"], entry["config"]
