@@ -428,6 +428,46 @@ class AbstractModule:
             if was_training:
                 self.training()
 
+    def predict_image(self, image_frame, output_layer=None,
+                      share_buffer: bool = False,
+                      batch_per_partition: int = 4,
+                      predict_key: str = "predict",
+                      feature_key: str = "floats"):
+        """Reference pyspark ``model.predict_image(image_frame, ...)``
+        (``Predictor.predictImage``): forward every ImageFeature's tensor
+        (``MatToTensor`` output under ``feature_key``) through the model
+        in batches and attach each output to its feature under
+        ``predict_key``. Returns the same frame. ``share_buffer`` is
+        accepted for source compatibility and ignored (XLA owns buffers);
+        ``output_layer`` selection of intermediate nodes is not supported
+        — forward the sub-graph instead."""
+        if output_layer is not None:
+            raise NotImplementedError(
+                "predict_image(output_layer=...) is not supported — build "
+                "a Graph ending at that node and predict with it")
+        feats = image_frame.features
+        missing = [i for i, f in enumerate(feats) if feature_key not in f]
+        if missing:
+            raise ValueError(
+                f"predict_image: features {missing[:5]} have no "
+                f"{feature_key!r} tensor — run MatToTensor (or pass "
+                "feature_key=) first")
+        import numpy as _np
+
+        x = _np.stack([_np.asarray(f[feature_key], _np.float32)
+                       for f in feats])
+        # one batching/eval-mode path for all prediction (Predictor
+        # handles multi-output models and ragged batch tails)
+        out = self.predict(x, batch_size=max(1, int(batch_per_partition)))
+        if isinstance(out, (list, tuple)):   # multi-output Graph
+            for j, f in enumerate(feats):
+                f[predict_key] = [_np.asarray(o)[j] for o in out]
+        else:
+            out = _np.asarray(out)
+            for j, f in enumerate(feats):
+                f[predict_key] = out[j]
+        return image_frame
+
     def to_ir(self, input_shape, dtype=None, training: bool = False):
         """Lower this module to its jaxpr IR for the given input shape.
 

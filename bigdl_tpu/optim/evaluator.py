@@ -129,15 +129,27 @@ class Predictor:
         self._ev = Evaluator(model, mesh=mesh)
         self.model = model
 
+    @staticmethod
+    def _restore_batch(a: np.ndarray, n: int) -> np.ndarray:
+        """Models whose Reshape heads auto-detect the batch dim drop the
+        leading axis on a batch-1 tail — restore it so batches
+        concatenate."""
+        return a[None] if (a.ndim == 0 or a.shape[0] != n) else a
+
     def predict(self, data, batch_size: int = 32):
         self.model.evaluate()
         self.model._ensure_params()
         params, model_state = self.model.params, self.model.state
-        outs = [
-            self._ev._forward(params, model_state, b.get_input())
-            for b in _batches(data, batch_size)
-        ]
-        if outs and isinstance(outs[0], (list, tuple)):  # multi-output model
+        outs = []
+        for b in _batches(data, batch_size):
+            n = b.size()
+            o = self._ev._forward(params, model_state, b.get_input())
+            if isinstance(o, (list, tuple)):  # multi-output model
+                o = [self._restore_batch(np.asarray(x), n) for x in o]
+            else:
+                o = self._restore_batch(np.asarray(o), n)
+            outs.append(o)
+        if outs and isinstance(outs[0], (list, tuple)):
             return [
                 np.concatenate([np.asarray(o[i]) for o in outs], axis=0)
                 for i in range(len(outs[0]))

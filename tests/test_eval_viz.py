@@ -167,3 +167,21 @@ def test_optimizer_writes_summaries(tmp_path, rng):
     assert len(ts.read_scalar("Loss")) == 8  # 4 iters/epoch × 2 epochs
     assert len(ts.read_scalar("LearningRate")) == 8
     assert len(vs.read_scalar("Top1Accuracy")) == 2
+
+
+def test_predictor_ragged_batch_tail():
+    """A batch-1 tail on a Reshape-headed model must not lose its batch
+    axis (pre-existing Predictor bug found via predict_image)."""
+    import numpy as np
+
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(3)
+    m = LeNet5(10)
+    x = np.random.RandomState(0).rand(5, 1, 28, 28).astype(np.float32)
+    out = np.asarray(m.predict(x, batch_size=2))
+    assert out.shape == (5, 10)
+    # per-row parity with the full-batch forward
+    np.testing.assert_allclose(out, np.asarray(m.predict(x, batch_size=5)),
+                               rtol=1e-5, atol=1e-6)

@@ -115,3 +115,64 @@ def test_aspect_scale(rng):
     out = AspectScale(5).apply_feature(
         ImageFeature(img), np.random.RandomState(0)).mat()
     assert out.shape[0] == 5 and out.shape[1] == 10  # short side → 5
+
+
+def test_predict_image_attaches_predictions():
+    """Reference ``model.predict_image(image_frame)``: every ImageFeature
+    gets its forward output under 'predict'; batched outputs must equal
+    one-shot prediction."""
+    import numpy as np
+
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.transform.vision.image import ImageFrame, MatToTensor
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(3)
+    rs = np.random.RandomState(0)
+    mats = [rs.rand(28, 28, 1).astype(np.float32) for _ in range(5)]
+    frame = ImageFrame.array(mats).transform(MatToTensor())
+
+    model = LeNet5(10)
+    out_frame = model.predict_image(frame, batch_per_partition=2)
+    assert out_frame is frame
+    preds = [f["predict"] for f in frame.features]
+    assert all(p.shape == (10,) for p in preds)
+
+    batch = np.stack([f["floats"] for f in frame.features])
+    want = np.asarray(model.predict(batch))
+    np.testing.assert_allclose(np.stack(preds), want, rtol=1e-5,
+                               atol=1e-6)
+
+    # share_buffer accepted; output_layer refused; missing tensors refused
+    model.predict_image(frame, share_buffer=True)
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        model.predict_image(frame, output_layer="conv1")
+    with pytest.raises(ValueError, match="MatToTensor"):
+        model.predict_image(ImageFrame.array(mats))
+
+
+def test_predict_image_multi_output_graph():
+    """Multi-output Graph models attach a list of outputs per feature."""
+    import numpy as np
+
+    from bigdl_tpu.nn import Graph, Input, Linear, Reshape
+    from bigdl_tpu.transform.vision.image import ImageFrame, MatToTensor
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(5)
+    inp = Input()
+    flat = Reshape([12], batch_mode=True).inputs(inp)
+    h1 = Linear(12, 3).inputs(flat)
+    h2 = Linear(12, 2).inputs(flat)
+    model = Graph(inp, [h1, h2])
+
+    rs = np.random.RandomState(1)
+    mats = [rs.rand(2, 2, 3).astype(np.float32) for _ in range(5)]
+    frame = ImageFrame.array(mats).transform(MatToTensor())
+    model.predict_image(frame, batch_per_partition=2)
+    for f in frame.features:
+        preds = f["predict"]
+        assert isinstance(preds, list) and len(preds) == 2
+        assert preds[0].shape == (3,) and preds[1].shape == (2,)
