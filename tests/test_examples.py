@@ -86,3 +86,32 @@ def test_transformer_generation_example(capsys):
     assert "greedy :" in out and "sampled:" in out
     beams = [l for l in out.splitlines() if l.startswith("beam ")]
     assert len(beams) == 2
+
+
+def test_distributed_pod_example_smoke(tmp_path):
+    """The pod-training example end to end in its single-process shape:
+    partitioned DP, async checkpoints, preemption hook armed, validation.
+    Blockstore mode with drop configured also runs."""
+    from bigdl_tpu.examples import distributed_pod
+
+    trained = distributed_pod.main([
+        "-b", "32", "--maxIteration", "6", "--nSamples", "64",
+        "--checkpoint", str(tmp_path / "ck"),
+    ])
+    assert trained is not None
+    import os
+
+    assert any(f.startswith("orbax")
+               for f in os.listdir(str(tmp_path / "ck")))
+
+    import os as _os
+
+    _os.environ["BIGDL_BLOCKSTORE_DIR"] = str(tmp_path / "bs")
+    try:
+        trained = distributed_pod.main([
+            "-b", "32", "--maxIteration", "4", "--nSamples", "64",
+            "--parameterMode", "blockstore", "--dropPercentage", "0.1",
+        ])
+    finally:
+        _os.environ.pop("BIGDL_BLOCKSTORE_DIR", None)
+    assert trained is not None
