@@ -97,6 +97,11 @@ def main(argv=None):
     if args.dropPercentage > 0:
         opt.set_drop_module_property(args.dropPercentage)
     if args.checkpoint:
+        # every rank may be given the SAME durable path (preemption
+        # survival needs shared storage — a preempted VM's local disk is
+        # gone): the Optimizer suffixes it per-rank (proc_<rank>), so
+        # per-rank opt_state shards never race on one orbax target nor
+        # silently restore another rank's same-shaped slice
         opt.set_checkpoint(args.checkpoint, Trigger.several_iteration(5),
                            backend="orbax_async")
         opt.handle_preemption()

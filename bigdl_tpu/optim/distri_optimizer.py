@@ -478,9 +478,19 @@ class DistriOptimizer(Optimizer):
             grads, new_ms, loss = grad_step(params, model_state, rng,
                                             inp, tgt)
             gflat = np.asarray(ravel_pytree(grads)[0], np.float32)
-            bsp.put_gradients(t, gflat)
-            if n_proc > 1:  # published early so stragglers' losses flow
+            # aux scalars (loss, BN state) go out BEFORE the big gradient
+            # blobs: when an owner drops this process's gradient at the
+            # deadline, its loss/BN contribution is already visible, so
+            # the books average over finished models (reference semantics)
+            # instead of blocking behind the very puts that were dropped
+            ms_flat = np.zeros(0, np.float32)
+            ms_rebuild = None
+            if n_proc > 1:
                 bsp.publish_aux(t, "loss", np.float32(loss))
+                ms_flat, ms_rebuild = self._float_leaf_pack(new_ms)
+                if ms_flat.size:
+                    bsp.publish_aux(t, "mstate", ms_flat)
+            bsp.put_gradients(t, gflat)
             g_my, n_arrived, dropped = bsp.aggregate_my_partition(t)
             if dropped:
                 self.metrics.add("dropped gradients", float(len(dropped)))
@@ -520,18 +530,23 @@ class DistriOptimizer(Optimizer):
             new_params = unravel(jnp.asarray(wfull))
             cache["params_ref"] = new_params
             cache["wpad"] = bsp._pad(wfull)
-            # BN running stats: average the float leaves across processes
-            # (the pmean the SPMD modes do each step)
+            # BN running stats / loss: average across processes (the pmean
+            # the SPMD modes do each step). These gathers run AFTER
+            # get_weights(t+1) — a full barrier every live owner passes
+            # only after publishing its aux for t (program order) — so a
+            # non-blocking gather deterministically sees every live
+            # process; averaging over the arrived subset is the fallback
+            # for a peer dying mid-window, not a second straggler wait
             if n_proc > 1:
-                ms_flat, rebuild = self._float_leaf_pack(new_ms)
-                if ms_flat.size:
-                    bsp.publish_aux(t, "mstate", ms_flat)
-                    gathered = bsp.gather_aux(t, "mstate", blocking=True)
-                    new_ms = rebuild(
-                        np.mean(np.stack(list(gathered.values())), axis=0))
-                losses = bsp.gather_aux(t, "loss", blocking=True)
-                loss = np.float32(np.mean([float(v)
-                                           for v in losses.values()]))
+                if ms_rebuild is not None and ms_flat.size:
+                    gathered = bsp.gather_aux(t, "mstate", blocking=False)
+                    if gathered:
+                        new_ms = ms_rebuild(np.mean(
+                            np.stack(list(gathered.values())), axis=0))
+                losses = bsp.gather_aux(t, "loss", blocking=False)
+                if losses:
+                    loss = np.float32(np.mean([float(v)
+                                               for v in losses.values()]))
             counter["t"] = t + 1
             return new_params, new_opt, new_ms, loss
 

@@ -184,6 +184,7 @@ class Optimizer:
         self._handle_preemption = False
         self._preempt_flag = False
         self._async_ckptr = None
+        self._async_pending_marker = None
 
     # -- fluent config (reference names, snake_case) -----------------------
 
@@ -207,7 +208,12 @@ class Optimizer:
         Accepts both reference dialects: Scala ``(path, trigger)``, pyspark
         positional ``(checkpoint_trigger, checkpoint_path)``, and the
         pyspark keyword names ``checkpoint_trigger=``/``checkpoint_path=``
-        (same aliasing policy as ``set_validation``'s val_rdd/val_method)."""
+        (same aliasing policy as ``set_validation``'s val_rdd/val_method).
+
+        On a multi-process pod (``jax.process_count() > 1``) every rank
+        writes/reads ``<path>/proc_<rank>`` — give all ranks the SAME
+        durable path and each keeps its own shard snapshot (see
+        ``_ckpt_dir``)."""
         if isinstance(path, Trigger):          # pyspark positional order
             path, trigger = trigger, path
         # keyword overrides AFTER the swap: a positional Trigger mixed with
@@ -325,19 +331,103 @@ class Optimizer:
             "epoch_finished": False,
         }
 
+    @staticmethod
+    def _pod_rank():
+        """(process_count, process_index); (1, 0) when jax is unavailable
+        (pure-host tooling contexts that never touch a device)."""
+        try:
+            import jax
+
+            return jax.process_count(), jax.process_index()
+        except Exception:
+            return 1, 0
+
+    def _ckpt_dir(self) -> Optional[str]:
+        """Effective checkpoint directory: on a multi-process pod every
+        rank writes its OWN subdirectory (``proc_<rank>``) under the
+        configured path. Ranks given one shared/durable path (the normal
+        preemption-survival setup) must not race on a single orbax target
+        — and in blockstore mode ``opt_state`` is a per-rank shard of
+        IDENTICAL shape, so a rank restoring another rank's slice would
+        corrupt optimizer momentum silently, past any shape check."""
+        if not self.checkpoint_path:
+            return self.checkpoint_path
+        n, rank = self._pod_rank()
+        if n > 1:
+            return os.path.join(self.checkpoint_path, f"proc_{rank}")
+        return self.checkpoint_path
+
+    def _write_latest_marker(self, ckpt_dir: str, neval: int) -> None:
+        """Sidecar recording the newest snapshot's iteration — cheap for
+        peers on a shared path to read at resume time (atomic rename;
+        for async saves it may briefly run ahead of a torn final write,
+        which resume already treats as absent)."""
+        tmp = os.path.join(ckpt_dir, f".LATEST.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(str(int(neval)))
+        os.replace(tmp, os.path.join(ckpt_dir, "LATEST"))
+
+    def _peer_latest_markers(self, exclude_rank=None):
+        """{proc dirname: LATEST iteration} for sibling ranks under the
+        shared checkpoint path; unreadable/pre-sidecar entries skipped."""
+        out = {}
+        try:
+            siblings = os.listdir(self.checkpoint_path)
+        except OSError:
+            return out
+        for d in sorted(siblings):
+            if not d.startswith("proc_") or d == f"proc_{exclude_rank}":
+                continue
+            try:
+                with open(os.path.join(self.checkpoint_path, d,
+                                       "LATEST")) as f:
+                    out[d] = int(f.read().strip())
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def _pod_common_neval(self, own_neval: int) -> int:
+        """On a pod with a SHARED checkpoint path, the iteration every
+        rank must resume from: the minimum of all ranks' LATEST sidecars.
+        Ranks checkpoint independently, so a kill can leave them holding
+        snapshots at different iterations — resuming from mismatched
+        iterations would silently offset the data streams and trip the
+        end trigger at different steps."""
+        if self._pod_rank()[0] <= 1:
+            return own_neval
+        base = self.checkpoint_path
+        try:
+            siblings = sorted(
+                d for d in os.listdir(base)
+                if d.startswith("proc_")
+                and os.path.isdir(os.path.join(base, d)))
+        except OSError:
+            return own_neval
+        if len(siblings) <= 1:       # path not shared — nothing visible
+            return own_neval
+        common = own_neval
+        for d in siblings:
+            try:
+                with open(os.path.join(base, d, "LATEST")) as f:
+                    common = min(common, int(f.read().strip()))
+            except (OSError, ValueError):
+                continue             # pre-sidecar snapshot: can't check
+        return common
+
     def _checkpoint(self, state, params, model_state, opt_state) -> None:
         from bigdl_tpu.utils.file_io import File
 
-        if not self.checkpoint_path:
+        ckpt_dir = self._ckpt_dir()
+        if not ckpt_dir:
             return
         tag = "" if self.overwrite_checkpoint else f".{state['neval']}"
-        os.makedirs(self.checkpoint_path, exist_ok=True)
+        os.makedirs(ckpt_dir, exist_ok=True)
         if self.checkpoint_backend in ("orbax", "orbax_async"):
             import jax
             import orbax.checkpoint as ocp
 
             target = os.path.abspath(
-                os.path.join(self.checkpoint_path, f"orbax{tag or '.0'}"))
+                os.path.join(ckpt_dir, f"orbax{tag or '.0'}"))
             blob = {
                 "params": jax.tree_util.tree_map(np.asarray, params),
                 "model_state": jax.tree_util.tree_map(np.asarray, model_state),
@@ -354,15 +444,22 @@ class Optimizer:
                     self._async_ckptr = ocp.AsyncCheckpointer(
                         ocp.PyTreeCheckpointHandler())
                 self._async_ckptr.wait_until_finished()
+                # previous async save is now durable — only NOW may its
+                # sidecar go out (a marker ahead of a torn in-flight
+                # save would make peers trust an iteration this rank
+                # cannot actually restore)
+                self._flush_async_marker()
                 self._async_ckptr.save(target, blob, force=True)
+                self._async_pending_marker = (ckpt_dir, state["neval"])
                 return
             ocp.PyTreeCheckpointer().save(target, blob, force=True)
+            self._write_latest_marker(ckpt_dir, state["neval"])
             return
         File.save(
             # same blob shape as Module.save, so Module.load() can open a
             # checkpoint snapshot directly (reference resume semantics)
             {"params": params, "state": model_state, "module": self.model},
-            os.path.join(self.checkpoint_path, f"model{tag}"),
+            os.path.join(ckpt_dir, f"model{tag}"),
             over_write=True,
         )
         File.save(
@@ -373,20 +470,77 @@ class Optimizer:
                 "neval": state["neval"],
                 "seen": state.get("seen", 0),
             },
-            os.path.join(self.checkpoint_path, f"optimMethod{tag}"),
+            os.path.join(ckpt_dir, f"optimMethod{tag}"),
             over_write=True,
         )
+        self._write_latest_marker(ckpt_dir, state["neval"])
+
+    def _flush_async_marker(self) -> None:
+        """Write the sidecar for the last CONFIRMED async save. Call only
+        after ``wait_until_finished`` — see ``_checkpoint``."""
+        if self._async_pending_marker is not None:
+            self._write_latest_marker(*self._async_pending_marker)
+            self._async_pending_marker = None
+
+    def _pod_rollback(self, own_neval: int, exists_fn, load_fn):
+        """Reconcile this rank's newest restorable snapshot against the
+        pod-wide common iteration: returns ``load_fn(common)`` when a
+        rollback is needed, ``None`` when the own snapshot stands, and
+        raises LOUDLY when ranks are skewed but the common snapshot is
+        not retained — resuming skewed iterations would silently offset
+        the per-rank data streams and end triggers."""
+        common = self._pod_common_neval(own_neval)
+        if common == own_neval:
+            return None
+        if self.overwrite_checkpoint or not exists_fn(common):
+            raise RuntimeError(
+                f"pod resume: this rank's newest checkpoint is at "
+                f"iteration {own_neval} but the pod-wide common "
+                f"iteration is {common}, and no snapshot for it is "
+                "retained (overwrite mode keeps one). Use "
+                "over-write=False checkpoints on pods, or align the "
+                "per-rank checkpoints manually.")
+        try:
+            result = load_fn(common)
+        except Exception as e:
+            raise RuntimeError(
+                f"pod resume: the pod-common snapshot at iteration "
+                f"{common} exists but is not restorable ({e!r}) — align "
+                "the per-rank checkpoints manually") from e
+        logger.warning(
+            "pod resume: rolled back to the pod-common snapshot at "
+            "iteration %d", common)
+        return result
+
+    def _assert_pod_peers_not_ahead(self):
+        """Guard for the nothing-restorable case: a rank that would start
+        FRESH must not do so silently while pod peers resume from their
+        snapshots (that is the same silent iteration skew `_pod_rollback`
+        exists to stop, through the other door)."""
+        n, rank = self._pod_rank()
+        if n <= 1 or not self.checkpoint_path:
+            return
+        peers = self._peer_latest_markers(exclude_rank=rank)
+        if peers:
+            raise RuntimeError(
+                f"pod resume: this rank (proc_{rank}) has no restorable "
+                f"checkpoint but pod peers do ({peers}) — starting fresh "
+                "would silently skew the pod. Restore this rank's "
+                "snapshot or clear every rank's checkpoints.")
 
     def _latest_checkpoint(self):
         from bigdl_tpu.utils.file_io import File
 
-        if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
+        ckpt_dir = self._ckpt_dir()
+        if not ckpt_dir or not os.path.isdir(ckpt_dir):
+            self._assert_pod_peers_not_ahead()
             return None
         if self.checkpoint_backend in ("orbax", "orbax_async"):
             import orbax.checkpoint as ocp
 
             if self._async_ckptr is not None:
                 self._async_ckptr.wait_until_finished()
+                self._flush_async_marker()
 
             def _iteration_of(f):
                 # valid snapshots are "orbax.<iter>"; anything else (orbax
@@ -397,17 +551,37 @@ class Optimizer:
                     return None
 
             snaps = sorted(
-                (f for f in os.listdir(self.checkpoint_path)
+                (f for f in os.listdir(ckpt_dir)
                  if f.startswith("orbax") and _iteration_of(f) is not None),
                 key=_iteration_of,
             )
             if not snaps:
+                self._assert_pod_peers_not_ahead()
                 return None
-            try:
-                blob = ocp.PyTreeCheckpointer().restore(os.path.abspath(
-                    os.path.join(self.checkpoint_path, snaps[-1])))
-            except Exception:
+            blob = None
+            for snap in reversed(snaps):   # newest first; skip torn ones
+                try:
+                    blob = ocp.PyTreeCheckpointer().restore(os.path.abspath(
+                        os.path.join(ckpt_dir, snap)))
+                    break
+                except Exception:
+                    logger.warning(
+                        "resume: snapshot %s is torn — trying older", snap)
+            if blob is None:
+                self._assert_pod_peers_not_ahead()
                 return None
+
+            def _load(c):
+                return ocp.PyTreeCheckpointer().restore(os.path.abspath(
+                    os.path.join(ckpt_dir, f"orbax.{c}")))
+
+            rb = self._pod_rollback(
+                int(blob["neval"]),
+                lambda c: os.path.isdir(
+                    os.path.join(ckpt_dir, f"orbax.{c}")),
+                _load)
+            if rb is not None:
+                blob = rb
             return (
                 {"params": blob["params"], "model_state": blob["model_state"]},
                 {"opt_state": blob["opt_state"], "epoch": int(blob["epoch"]),
@@ -423,19 +597,39 @@ class Optimizer:
                 return -1.0
 
         models = sorted(
-            (f for f in os.listdir(self.checkpoint_path)
+            (f for f in os.listdir(ckpt_dir)
              if f.startswith("model")),
             key=_snap_iter,
         )
         if not models:
+            self._assert_pod_peers_not_ahead()
             return None
-        tag = models[-1][len("model"):]
-        try:
-            m = File.load(os.path.join(self.checkpoint_path, f"model{tag}"))
-            o = File.load(os.path.join(self.checkpoint_path, f"optimMethod{tag}"))
-            return m, o
-        except Exception:  # torn/partial snapshot — treat as absent
+        m = o = None
+        for f in reversed(models):         # newest first; skip torn ones
+            tag = f[len("model"):]
+            try:
+                m = File.load(os.path.join(ckpt_dir, f"model{tag}"))
+                o = File.load(os.path.join(ckpt_dir, f"optimMethod{tag}"))
+                break
+            except Exception:
+                logger.warning(
+                    "resume: snapshot model%s is torn — trying older", tag)
+                m = o = None
+        if o is None:
+            self._assert_pod_peers_not_ahead()
             return None
+
+        def _load(c):
+            return (File.load(os.path.join(ckpt_dir, f"model.{c}")),
+                    File.load(os.path.join(ckpt_dir, f"optimMethod.{c}")))
+
+        rb = self._pod_rollback(
+            int(o["neval"]),
+            lambda c: os.path.exists(os.path.join(ckpt_dir, f"model.{c}")),
+            _load)
+        if rb is not None:
+            m, o = rb
+        return m, o
 
     def _eval_forward(self, params, model_state, inp):
         import jax
@@ -516,6 +710,7 @@ class Optimizer:
                 # release the background save executor (a long-lived
                 # process may construct many Optimizers)
                 self._async_ckptr.wait_until_finished()
+                self._flush_async_marker()
                 self._async_ckptr.close()
                 self._async_ckptr = None
 
@@ -582,6 +777,7 @@ class Optimizer:
                 signal.signal(signal.SIGTERM, prev_sigterm)
             if self._async_ckptr is not None:
                 self._async_ckptr.wait_until_finished()
+                self._flush_async_marker()
 
     def _optimize_loop(self, resume: bool = False):
         import jax
@@ -647,6 +843,7 @@ class Optimizer:
                 )
                 if self._async_ckptr is not None:
                     self._async_ckptr.wait_until_finished()
+                    self._flush_async_marker()
                 raise TrainingPreempted(
                     f"evicted at iteration {state['neval']}; checkpoint "
                     f"written to {self.checkpoint_path or '(no path set)'}")

@@ -169,30 +169,86 @@ class CoordServiceBlockStore(BlockStore):
                 "(Engine.init_distributed) to have run first")
         self._client = client
         self._prefix = prefix
+        self._self_check()
+
+    def _self_check(self) -> None:
+        """Pin the error-wording contract against the LIVE client at
+        startup: the busy-poll and overwrite-retry paths classify the
+        client's human-readable status text, so a jaxlib that rewords
+        its missing-key/key-exists errors must fail HERE, loudly, not on
+        the first training iteration's poll."""
+        import os as _os
+
+        probe = f"selfcheck/{_os.getpid()}"
+        try:
+            if self.try_get(probe) is not None:     # leftover from a crash
+                self.delete(probe)
+            assert self.try_get(probe) is None      # 'missing' classified
+            self.put(probe, b"x")
+            self.put(probe, b"y")                   # 'exists' -> del+retry
+            assert self.try_get(probe) == b"y"
+            self.delete(probe)
+        except Exception as e:
+            raise RuntimeError(
+                "CoordServiceBlockStore self-check failed — this jaxlib's "
+                "coordination-service error wording is not recognized by "
+                "_classify_status (update its token lists): "
+                f"{e!r}") from e
 
     def _k(self, key: str) -> str:
         return f"{self._prefix}/{key}"
 
+    # The coordination client surfaces gRPC statuses as generic exceptions
+    # whose MESSAGE carries the code — classify on that, so only the two
+    # expected statuses (key exists / key missing) are absorbed and a
+    # genuinely broken client (auth failure, shutdown, serialization)
+    # raises instead of degrading into a silent busy-poll that ends in a
+    # misleading "peer process likely died" timeout. Missing-key wordings
+    # are checked FIRST so "does not exist" can never classify as exists.
+    @staticmethod
+    def _classify_status(exc: BaseException) -> str:
+        """'missing' | 'exists' | 'other'."""
+        msg = str(exc).upper().replace(" ", "_").replace("-", "_")
+        if any(t in msg for t in ("NOT_FOUND", "NOTFOUND",
+                                  "DOES_NOT_EXIST", "DOESN'T_EXIST",
+                                  "NO_SUCH_KEY", "MISSING_KEY")):
+            return "missing"
+        if any(t in msg for t in ("ALREADY_EXISTS", "KEY_EXISTS",
+                                  "DUPLICATE_KEY")):
+            return "exists"
+        return "other"
+
     def put(self, key: str, value: bytes) -> None:
         try:
             self._client.key_value_set_bytes(self._k(key), value)
-        except Exception:
-            # the coordination KV may refuse overwrites — delete + retry
-            # (keys are iteration-unique, so this only fires on retries)
+        except Exception as e:
+            # the coordination KV refuses overwrites — delete + retry.
+            # Every hot-path key is iteration-unique (and the per-step
+            # pos marker deletes-then-puts explicitly), so this only
+            # fires on rare retry collisions
+            if self._classify_status(e) != "exists":
+                logger.error("coordination KV put(%s) failed: %s", key, e)
+                raise
             self.delete(key)
             self._client.key_value_set_bytes(self._k(key), value)
 
     def try_get(self, key: str) -> Optional[bytes]:
         try:
             return self._client.key_value_try_get_bytes(self._k(key))
-        except Exception:
+        except Exception as e:
+            if self._classify_status(e) != "missing":
+                logger.error("coordination KV get(%s) failed: %s", key, e)
+                raise
             return None
 
     def delete(self, key: str) -> None:
         try:
             self._client.key_value_delete(self._k(key))
-        except Exception:
-            pass
+        except Exception as e:
+            if self._classify_status(e) != "missing":
+                logger.error("coordination KV delete(%s) failed: %s",
+                             key, e)
+                raise
 
 
 def default_block_store() -> BlockStore:
@@ -212,9 +268,15 @@ def default_block_store() -> BlockStore:
 
 class GradientDropPolicy:
     """The reference's straggler thresholds (``setDropModuleProperty``):
-    no drops during the first ``warmup_iteration`` iterations; arrival
-    durations from the last ``compute_threshold_batch_size`` aggregations
-    calibrate the deadline at the ``1 - drop_percentage`` quantile;
+    no drops during the first ``warmup_iteration`` iterations; PER-
+    CONTRIBUTION arrival durations (the reference computed its threshold
+    over per-task compute times, one sample per model per iteration) from
+    the last ``compute_threshold_batch_size`` samples calibrate the
+    deadline at the ``1 - drop_percentage`` quantile — so a minority
+    straggler (mass < p) is persistently dropped while the quantile stays
+    in the fast cluster, and a RECOVERED straggler re-enters as soon as
+    its arrivals (observed late via :meth:`BlockStoreParameter.
+    _probe_late_arrivals`) pull the quantile back over its times.
     ``max_drop_percentage`` caps how many contributions one aggregation may
     discard regardless of the deadline."""
 
@@ -295,6 +357,11 @@ class BlockStoreParameter:
             os.environ.get("BIGDL_BLOCKSTORE_TIMEOUT_S", "300"))
         self.dropped_total = 0          # contributions discarded so far
         self._my_slice_cache: Optional[np.ndarray] = None
+        # (iteration, src) -> aggregation start time, for contributions
+        # dropped at the deadline whose blocks have not arrived yet — the
+        # next aggregations probe them so a late arrival's true (upper-
+        # bound) duration can enter the calibration window
+        self._late_probes: Dict[Tuple[int, int], float] = {}
 
     # -- keys (deterministic BlockId analog) -------------------------------
 
@@ -345,6 +412,11 @@ class BlockStoreParameter:
         checkpoint can sweep its stale blocks (see ``sweep_stale``)."""
         flat = self._pad(flat_grad)
         self._my_slice_cache = self._slice(flat, self.pid).copy()
+        # the position marker is the one NON-iteration-unique key (same
+        # key every step) — delete-then-put explicitly, instead of riding
+        # put()'s exists-message heuristic on the overwrite-refusing
+        # coordination KV every single iteration
+        self.store.delete(f"{self.ns}/pos/{self.pid}")
         self.store.put(f"{self.ns}/pos/{self.pid}",
                        encode_array(np.int64(t)))
         for part in range(self.n):
@@ -383,6 +455,7 @@ class BlockStoreParameter:
         over arrived contributions, n_arrived, dropped source pids)."""
         if self._my_slice_cache is None:
             raise RuntimeError("put_gradients must run first each iteration")
+        self._probe_late_arrivals(t)
         # GC any contribution a straggler published AFTER iteration t-2's
         # post-aggregation delete (the weight-fetch barrier keeps processes
         # within one iteration of each other, so t-2 blocks are dead)
@@ -405,6 +478,15 @@ class BlockStoreParameter:
                     acc += self._decode(blob)
                     arrived += 1
                     pending.remove(src)
+                    if self.drop is not None:
+                        # PER-CONTRIBUTION arrival duration (the
+                        # reference's per-task time distribution): the
+                        # (1-p) quantile then sits in the fast cluster as
+                        # long as straggling mass stays below p — a
+                        # deadline-truncated aggregation wait is never
+                        # recorded, so the window cannot fill with
+                        # deadline-valued samples and freeze the quantile
+                        self.drop.record(time.monotonic() - t0)
             if not pending:
                 break
             now = time.monotonic()
@@ -417,20 +499,38 @@ class BlockStoreParameter:
                     f"contributions after {self.timeout_s}s at iteration {t} "
                     "— a peer process likely died")
             time.sleep(0.002)
-        if self.drop is not None:
-            self.drop.record(time.monotonic() - t0)
         if pending:
             self.dropped_total += len(pending)
+            for src in pending:
+                self._late_probes[(t, src)] = t0
             logger.warning(
                 "iteration %d partition %d: dropped %d straggler gradient "
                 "contribution(s) from %s (%d/%d arrived)",
                 t, self.pid, len(pending), pending, arrived, self.n)
-        # cleanup this iteration's blocks for my partition (incl. any
-        # dropped ones that land later — delete is idempotent)
+        # cleanup this iteration's arrived blocks for my partition; a
+        # DROPPED source's block is left for _probe_late_arrivals (its
+        # eventual arrival is the calibration signal) and is GC'd at t+2
         for src in range(self.n):
-            if src != self.pid:
+            if src != self.pid and (t, src) not in self._late_probes:
                 self.store.delete(self._gkey(t, self.pid, src))
         return (acc / arrived).astype(np.float32), arrived, pending
+
+    def _probe_late_arrivals(self, t: int) -> None:
+        """Check whether contributions dropped by earlier aggregations have
+        landed since; record the observed (upper-bound) arrival duration so
+        the calibrated deadline can adapt UPWARD when a straggler recovers.
+        Probes whose blocks never appear by GC time (t-2) are discarded
+        without a sample — a dead peer must not inflate the window."""
+        if self.drop is None or not self._late_probes:
+            return
+        now = time.monotonic()
+        for (tp, src), t0 in list(self._late_probes.items()):
+            if self.store.try_get(self._gkey(tp, self.pid, src)) is not None:
+                self.drop.record(now - t0)
+                del self._late_probes[(tp, src)]
+                self.store.delete(self._gkey(tp, self.pid, src))
+            elif tp <= t - 2:
+                del self._late_probes[(tp, src)]
 
     def publish_weights(self, t: int, wshard: np.ndarray) -> None:
         """Reference ``sendWeightPartition``; also GCs this owner's weight

@@ -411,8 +411,27 @@ def load_keras(json_path: Optional[str] = None,
     params = copy.deepcopy(model.params)
     state = copy.deepcopy(model.state)
     consumed = set()
+    # tf-dim_ordering bookkeeping: the builder converts input shapes and
+    # conv kernels to CHW, so the model FLATTENS in CHW order — but a
+    # keras1 tf-ordered save's first post-Flatten Dense kernel has its
+    # rows in HWC-flat order (the classic th/tf conversion pitfall).
+    # Track the Flatten of tf-ordered spatial features and permute that
+    # Dense kernel's input rows HWC-flat -> CHW-flat.
+    pending_perm = None
+    cur_tf = False
     for i, entry in enumerate(blob["config"]):
         cname, cfg = entry["class_name"], entry["config"]
+        if "dim_ordering" in cfg:
+            cur_tf = cfg["dim_ordering"] == "tf"
+        if cname == "Flatten":
+            shp = model.layers[i].input_shape
+            if cur_tf and shp is not None and len(shp) == 3:
+                c, h, w = shp
+                # perm[chw_flat_position] = hwc_flat_row of the keras kernel
+                pending_perm = np.arange(h * w * c).reshape(
+                    (h, w, c)).transpose(2, 0, 1).ravel()
+            else:
+                pending_perm = None
         lname = cfg.get("name", "")
         arrays = by_layer.get(lname)
         if not arrays:
@@ -427,6 +446,24 @@ def load_keras(json_path: Optional[str] = None,
             continue
         consumed.add(lname)
         p_upd, s_upd = _convert_weights(cname, cfg, arrays)
+        if cname in _WEIGHTED_CLASSES and pending_perm is not None:
+            if cname == "Dense":
+                if "weight" in p_upd:
+                    p_upd["weight"] = p_upd["weight"][:, pending_perm]
+                pending_perm = None  # downstream features are 1-D again
+            elif cname == "BatchNormalization":
+                # per-feature vectors reorder the same way; the features
+                # STAY HWC-flat afterwards, so the perm remains pending
+                # for the eventual Dense
+                p_upd = {k: v[pending_perm] for k, v in p_upd.items()}
+                s_upd = {k: v[pending_perm] for k, v in s_upd.items()}
+            else:
+                raise NotImplementedError(
+                    f"load_keras: tf-dim_ordering Flatten followed by "
+                    f"{cname} — permuting this layer's weights from "
+                    "HWC-flat to CHW-flat feature order is not "
+                    "implemented; loading unpermuted weights would "
+                    "silently predict garbage")
         if p_upd:
             params = _apply_updates(params, i, p_upd,
                                     anchor=next(iter(p_upd)))

@@ -185,6 +185,49 @@ def test_threaded_exchange_drops_straggler(tmp_path):
     np.testing.assert_allclose(results[0], exp, rtol=1e-6, atol=1e-6)
 
 
+def test_drop_deadline_recovers_after_straggler(tmp_path):
+    """The calibration window must NOT ratchet down: a deadline-truncated
+    wait is never recorded (it equals the deadline by construction), and a
+    dropped contribution that lands late is probed on the next aggregation
+    so its true (upper-bound) duration enters the window and the quantile
+    can rise again once the straggler recovers."""
+    store = FsBlockStore(str(tmp_path / "bs"))
+    policy = GradientDropPolicy(0.5, warmup_iteration=0,
+                                compute_threshold_batch_size=8,
+                                min_deadline_s=0.05)
+    owner = BlockStoreParameter(store, 2, 0, 8, drop_policy=policy,
+                                timeout_s=5.0)
+    peer = BlockStoreParameter(store, 2, 1, 8, timeout_s=5.0)
+    g = np.ones(8, np.float32)
+
+    # t=0: healthy iteration — one fast per-contribution sample
+    peer.put_gradients(0, g)
+    owner.put_gradients(0, g)
+    owner.aggregate_my_partition(0)
+    assert len(policy._samples) == 1
+
+    # t=1: peer absent — the owner drops it at the 0.05s floor; the
+    # truncated wait must NOT enter the window
+    owner.put_gradients(1, g)
+    _, arrived, dropped = owner.aggregate_my_partition(1)
+    assert arrived == 1 and dropped == [1]
+    assert owner.dropped_total == 1
+    assert len(policy._samples) == 1          # no deadline-valued sample
+    # and the (not-yet-arrived) late block was NOT pre-deleted
+    peer.put_gradients(1, g * 2.0)            # lands AFTER the drop
+    time.sleep(0.06)
+
+    # t=2: the probe sees iteration 1's late arrival, records its true
+    # (upper-bound) duration — which exceeds the floor — and reaps it
+    peer.put_gradients(2, g)
+    owner.put_gradients(2, g)
+    owner.aggregate_my_partition(2)
+    late = [s for s in policy._samples if s > 0.05]
+    assert late, list(policy._samples)        # window can adapt upward
+    assert store.try_get(owner._gkey(1, 0, 1)) is None
+    assert not owner._late_probes
+
+
 def test_late_blocks_garbage_collected(tmp_path):
     """A contribution landing after the owner's post-aggregation delete is
     reaped by the t+2 sweep — no leaked blocks."""

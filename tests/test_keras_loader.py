@@ -113,6 +113,48 @@ def test_conv_tf_ordering_transposed_to_chw(tmp_path):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_tf_ordering_dense_after_flatten_permuted(tmp_path):
+    """The th/tf conversion pitfall: a tf-ordered save's first
+    post-Flatten Dense kernel has rows in HWC-flat order, but the built
+    model (converted to CHW) flattens CHW — the importer must permute the
+    kernel rows so the th and tf twins predict identically."""
+    rs = np.random.RandomState(11)
+    C, H, W, out = 2, 3, 3, 4              # conv output: (2, 3, 3)
+    k_oihw = rs.randn(C, 1, 3, 3).astype(np.float32)
+    k_tf = np.transpose(k_oihw, (2, 3, 1, 0))
+    b = rs.randn(C).astype(np.float32)
+    w_th = rs.randn(C * H * W, out).astype(np.float32)   # rows CHW-flat
+    # the tf twin's kernel rows are the SAME weights in HWC-flat order
+    w_tf = np.transpose(w_th.reshape(C, H, W, out),
+                        (1, 2, 0, 3)).reshape(C * H * W, out)
+    bd = rs.randn(out).astype(np.float32)
+
+    def build(ordering, kernel, shape, dense_w):
+        js = _seq_json([
+            {"class_name": "Convolution2D",
+             "config": {"name": "conv_1", "nb_filter": C, "nb_row": 3,
+                        "nb_col": 3, "dim_ordering": ordering,
+                        "border_mode": "valid", "activation": "relu",
+                        "batch_input_shape": shape}},
+            {"class_name": "Flatten", "config": {"name": "flat_1"}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "output_dim": out,
+                        "activation": "linear"}},
+        ])
+        p = tmp_path / f"{ordering}d.json"
+        p.write_text(js)
+        _write_h5(tmp_path / f"{ordering}d.h5",
+                  [("conv_1", [kernel, b]), ("dense_1", [dense_w, bd])])
+        return load_keras(str(p), str(tmp_path / f"{ordering}d.h5"))
+
+    th = build("th", k_oihw, [None, 1, 5, 5], w_th)
+    tf_ = build("tf", k_tf, [None, 5, 5, 1], w_tf)
+    x = rs.randn(2, 1, 5, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(th.forward(x)),
+                               np.asarray(tf_.forward(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_batchnorm_weights_and_running_stats(tmp_path):
     rs = np.random.RandomState(3)
     gamma = rs.rand(4).astype(np.float32) + 0.5

@@ -190,3 +190,66 @@ def test_end_when_every_epoch_stops(tmp_path):
     opt.set_checkpoint(Trigger.every_epoch(), checkpoint_path=str(tmp_path))
     assert opt.checkpoint_path == str(tmp_path)
     assert opt.checkpoint_trigger is not None
+
+
+def test_pod_resume_consistency_helpers(tmp_path, monkeypatch):
+    """On a multi-process pod every rank checkpoints under proc_<rank> of
+    one shared path; resume must reconcile to the pod-wide COMMON
+    iteration (min over LATEST sidecars) instead of silently restoring
+    skewed per-rank snapshots."""
+    import jax
+
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    opt = Optimizer.__new__(Optimizer)
+    opt.checkpoint_path = str(tmp_path)
+
+    # single process: the configured path is used verbatim
+    assert opt._ckpt_dir() == str(tmp_path)
+    assert opt._pod_common_neval(42) == 42
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert opt._ckpt_dir() == str(tmp_path / "proc_1")
+
+    # sidecars present and skewed: the common iteration is the minimum
+    for rank, neval in ((0, 100), (1, 105)):
+        d = tmp_path / f"proc_{rank}"
+        d.mkdir()
+        opt._write_latest_marker(str(d), neval)
+    assert (tmp_path / "proc_1" / "LATEST").read_text() == "105"
+    assert opt._pod_common_neval(105) == 100
+
+    # equal sidecars: own neval stands
+    opt._write_latest_marker(str(tmp_path / "proc_0"), 105)
+    assert opt._pod_common_neval(105) == 105
+
+    # unreadable sibling sidecar is skipped, not fatal
+    (tmp_path / "proc_0" / "LATEST").write_text("garbage")
+    assert opt._pod_common_neval(105) == 105
+
+
+def test_pod_fresh_start_with_peer_snapshots_raises(tmp_path, monkeypatch):
+    """A pod rank with NOTHING restorable must refuse to silently start
+    fresh while peers hold snapshots (the other door into iteration
+    skew)."""
+    import jax
+    import pytest
+
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    opt = Optimizer.__new__(Optimizer)
+    opt.checkpoint_path = str(tmp_path)
+    opt.checkpoint_backend = "pickle"
+    opt._async_ckptr = None
+    opt._async_pending_marker = None
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    # no checkpoints anywhere: clean fresh start is fine
+    assert opt._latest_checkpoint() is None
+    # peer holds a snapshot: fresh start must refuse
+    (tmp_path / "proc_0").mkdir()
+    opt._write_latest_marker(str(tmp_path / "proc_0"), 7)
+    with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+        opt._latest_checkpoint()

@@ -81,7 +81,9 @@ def test_pod_checkpoint_kill_resume(tmp_path):
     pod.mkdir()
     _run_pod(pod, "crash", expect_rc=3)
     for pid in (0, 1):
-        assert (pod / f"ckpt_{pid}" / "model").exists(), (
+        # on a pod the Optimizer suffixes the configured path per-rank
+        # (proc_<rank>) so ranks sharing one durable path cannot race
+        assert (pod / f"ckpt_{pid}" / f"proc_{pid}" / "model").exists(), (
             "no checkpoint written before the kill")
     _run_pod(pod, "resume")
     for pid in (0, 1):
