@@ -347,7 +347,10 @@ class GRU(_KerasRecurrent):
     def _cell(self, input_shape):
         from bigdl_tpu.nn.recurrent import GRU as CoreGRU
 
-        return CoreGRU(input_shape[-1], self.output_dim)
+        # keras1 GRU math (reset BEFORE the candidate matmul) — this is
+        # the keras-compat layer, and load_keras routes GRU weights here
+        return CoreGRU(input_shape[-1], self.output_dim,
+                       reset_after=False)
 
 
 class ZeroPadding2D(KerasLayer):

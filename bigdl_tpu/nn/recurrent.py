@@ -356,13 +356,21 @@ class ConvLSTMPeephole(Cell):
 class GRU(_FusedInputCell):
     """GRU cell (reference ``nn/GRU.scala``). Gate order r, z, n; separate
     input/hidden biases so the candidate gate matches torch:
-    n = tanh(W_in x + b_in + r * (W_hn h + b_hn))."""
+    n = tanh(W_in x + b_in + r * (W_hn h + b_hn)).
+
+    ``reset_after=False`` selects the keras-1 convention instead — the
+    reset gate is applied to the hidden state BEFORE the candidate's
+    recurrent matmul, n = tanh(W_in x + b_in + W_hn (r * h) + b_hn) —
+    which is what ``Model.load_keras`` GRU weights were trained under
+    (the two formulations are not weight-convertible into each other)."""
 
     def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
-                 w_regularizer=None, u_regularizer=None, b_regularizer=None) -> None:
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None,
+                 reset_after: bool = True) -> None:
         super().__init__(hidden_size)
         self.input_size = input_size
         self.p = p
+        self.reset_after = reset_after
         self.w_regularizer = w_regularizer
         self.u_regularizer = u_regularizer
         self.b_regularizer = b_regularizer
@@ -385,12 +393,25 @@ class GRU(_FusedInputCell):
         import jax.numpy as jnp
 
         (h,) = carry
-        hp = jnp.matmul(h, params["w_hh"].T) + params["b_hh"]
         xr, xz, xn = jnp.split(pre_t, 3, axis=-1)
-        hr, hz, hn = jnp.split(hp, 3, axis=-1)
-        r = jax.nn.sigmoid(xr + hr)
-        z = jax.nn.sigmoid(xz + hz)
-        n = jnp.tanh(xn + r * hn)
+        if getattr(self, "reset_after", True):
+            hp = jnp.matmul(h, params["w_hh"].T) + params["b_hh"]
+            hr, hz, hn = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+        else:
+            # keras1 convention: reset gate gates the STATE, then the
+            # candidate matmul runs on the gated state — W_hn cannot be
+            # hoisted out of r, so the r/z half and the n half split
+            H = self.hidden_size
+            hp = jnp.matmul(h, params["w_hh"][:2 * H].T) \
+                + params["b_hh"][:2 * H]
+            hr, hz = jnp.split(hp, 2, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + jnp.matmul(r * h, params["w_hh"][2 * H:].T)
+                         + params["b_hh"][2 * H:])
         new_h = (1.0 - z) * n + z * h
         return new_h, (new_h,)
 

@@ -395,24 +395,10 @@ class Optimizer:
         end trigger at different steps."""
         if self._pod_rank()[0] <= 1:
             return own_neval
-        base = self.checkpoint_path
-        try:
-            siblings = sorted(
-                d for d in os.listdir(base)
-                if d.startswith("proc_")
-                and os.path.isdir(os.path.join(base, d)))
-        except OSError:
+        markers = self._peer_latest_markers()
+        if not markers:              # path not shared — nothing visible
             return own_neval
-        if len(siblings) <= 1:       # path not shared — nothing visible
-            return own_neval
-        common = own_neval
-        for d in siblings:
-            try:
-                with open(os.path.join(base, d, "LATEST")) as f:
-                    common = min(common, int(f.read().strip()))
-            except (OSError, ValueError):
-                continue             # pre-sidecar snapshot: can't check
-        return common
+        return min([own_neval] + list(markers.values()))
 
     def _checkpoint(self, state, params, model_state, opt_state) -> None:
         from bigdl_tpu.utils.file_io import File

@@ -176,10 +176,18 @@ class CoordServiceBlockStore(BlockStore):
         startup: the busy-poll and overwrite-retry paths classify the
         client's human-readable status text, so a jaxlib that rewords
         its missing-key/key-exists errors must fail HERE, loudly, not on
-        the first training iteration's poll."""
-        import os as _os
+        the first training iteration's poll. The probe key is unique per
+        rank AND attempt — containerized ranks often share a PID, and
+        concurrent startups must not race on one key."""
+        import uuid
 
-        probe = f"selfcheck/{_os.getpid()}"
+        try:
+            import jax
+
+            rank = jax.process_index()
+        except Exception:
+            rank = os.getpid()
+        probe = f"selfcheck/{rank}/{uuid.uuid4().hex}"
         try:
             if self.try_get(probe) is not None:     # leftover from a crash
                 self.delete(probe)

@@ -21,11 +21,10 @@ Scope (documented, enforced with clear errors):
   BatchNormalization (keras1 stored [gamma, beta, running_mean,
   running_std] where ``running_std`` is in fact the running VARIANCE —
   keras 1.2's ``batch_normalization`` passes it as var) / Embedding /
-  LSTM / SimpleRNN (gate identity parsed from the keras1 weight NAMES,
-  robust to list ordering). GRU raises: keras1 applies the reset gate
-  before the recurrent matmul, this framework (torch semantics) after —
-  exact import is mathematically impossible. Functional-model weights
-  raise NotImplementedError.
+  LSTM / SimpleRNN / GRU (gate identity parsed from the keras1 weight
+  NAMES, robust to list ordering; the keras-compat GRU layer runs the
+  keras1 reset-before-candidate cell, so GRU import is exact).
+  Functional-model weights raise NotImplementedError.
 * ``dim_ordering``: ``"th"`` maps 1:1 (this framework is CHW/NCHW, the
   reference's own convention); ``"tf"`` configs get their input shapes
   and conv kernels transposed to CHW — the loaded model expects CHW
@@ -291,12 +290,25 @@ def _convert_weights(class_name: str, cfg: Dict[str, Any],
         return {"w_ih": Ws[0].T, "w_hh": Us[0].T, "b_ih": bs[0],
                 "b_hh": np.zeros(bs[0].size, np.float32)}, {}
     if class_name == "GRU":
-        raise NotImplementedError(
-            "load_keras: keras1 GRU applies the reset gate BEFORE the "
-            "recurrent matmul (U_h @ (r*h)); this framework's GRU (torch "
-            "semantics) applies it after (r * (U_h @ h)) — the math "
-            "differs, so an exact weight import is impossible. Rebuild "
-            "with LSTM or retrain.")
+        # keras1 gate names z (update), r (reset), h (candidate); the
+        # keras-compat GRU layer runs the keras1 reset-before-candidate
+        # cell (recurrent.GRU reset_after=False), so the import is exact.
+        # Our fused layout orders gates r, z, n
+        W = _named_gates(named, "W", "zrh")
+        U = _named_gates(named, "U", "zrh")
+        b = _named_gates(named, "b", "zrh")
+        if not (W and U and b):
+            raise NotImplementedError(
+                "load_keras: GRU weight names do not follow the keras1 "
+                "_W_z/_U_r/_b_h pattern — cannot identify gates")
+        order = "rzh"
+        p = {
+            "w_ih": np.concatenate([W[g].T for g in order]),
+            "w_hh": np.concatenate([U[g].T for g in order]),
+            "b_ih": np.concatenate([b[g] for g in order]),
+            "b_hh": np.zeros(sum(b[g].size for g in order), np.float32),
+        }
+        return p, {}
     if class_name == "Dense":
         p = {"weight": arrays[0].T}
         if len(arrays) > 1:

@@ -349,10 +349,56 @@ def test_simple_rnn_weight_import(tmp_path):
     np.testing.assert_allclose(got, h, rtol=1e-4, atol=1e-5)
 
 
-def test_gru_weight_import_is_rejected(tmp_path):
-    """keras1 GRU applies the reset gate before the recurrent matmul;
-    ours (torch semantics) after — the import must refuse, not
-    approximate."""
+def test_gru_weight_import_vs_manual_keras1_math(tmp_path):
+    """keras1 GRU applies the reset gate BEFORE the candidate's recurrent
+    matmul (h~ = tanh(W_h x + U_h (r*h) + b_h)); the keras-compat GRU
+    layer runs that exact cell (recurrent.GRU reset_after=False), so the
+    named-gate weight import must reproduce the recurrence exactly."""
+    rs = np.random.RandomState(9)
+    I, H, T = 3, 4, 5
+    names = {}
+    for g in "zrh":
+        names[f"W_{g}"] = rs.randn(I, H).astype(np.float32) * 0.3
+        names[f"U_{g}"] = rs.randn(H, H).astype(np.float32) * 0.3
+        names[f"b_{g}"] = rs.randn(H).astype(np.float32) * 0.1
+    js = _seq_json([
+        {"class_name": "GRU",
+         "config": {"name": "gru_1", "output_dim": H,
+                    "return_sequences": False,
+                    "batch_input_shape": [None, T, I]}}])
+    (tmp_path / "m.json").write_text(js)
+    with h5py.File(tmp_path / "m.h5", "w") as f:
+        f.attrs["layer_names"] = [b"gru_1"]
+        g = f.create_group("gru_1")
+        wn = []
+        for gate in "zrh":                    # keras1's own list order
+            for kind in ("W", "U", "b"):
+                n = f"gru_1_{kind}_{gate}"
+                wn.append(n.encode())
+                g[n] = names[f"{kind}_{gate}"]
+        g.attrs["weight_names"] = wn
+    model = load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
+
+    x = rs.randn(2, T, I).astype(np.float32)
+    got = np.asarray(model.forward(x))
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((2, H), np.float32)
+    for t in range(T):
+        xt = x[:, t]
+        z_ = sig(xt @ names["W_z"] + h @ names["U_z"] + names["b_z"])
+        r_ = sig(xt @ names["W_r"] + h @ names["U_r"] + names["b_r"])
+        hh = np.tanh(xt @ names["W_h"] + (r_ * h) @ names["U_h"]
+                     + names["b_h"])
+        h = (1 - z_) * hh + z_ * h
+    np.testing.assert_allclose(got, h, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unrecognized_gate_names_rejected(tmp_path):
+    """GRU arrays whose names don't carry the keras1 gate suffixes must
+    refuse (gate identity cannot be guessed from list position)."""
     js = _seq_json([
         {"class_name": "GRU",
          "config": {"name": "gru_1", "output_dim": 3,
@@ -362,9 +408,9 @@ def test_gru_weight_import_is_rejected(tmp_path):
     with h5py.File(tmp_path / "m.h5", "w") as f:
         f.attrs["layer_names"] = [b"gru_1"]
         g = f.create_group("gru_1")
-        g.attrs["weight_names"] = [b"gru_1_W_z"]
-        g["gru_1_W_z"] = np.zeros((2, 3), np.float32)
-    with pytest.raises(NotImplementedError, match="reset gate"):
+        g.attrs["weight_names"] = [b"gru_1_param_0"]
+        g["gru_1_param_0"] = np.zeros((2, 3), np.float32)
+    with pytest.raises(NotImplementedError, match="gates"):
         load_keras(str(tmp_path / "m.json"), str(tmp_path / "m.h5"))
 
 

@@ -343,3 +343,44 @@ def test_stacked_conv_lstm_multirnncell(rng):
     frame = rng.randn(2, 2, 5, 6).astype(np.float32)
     res = cell.forward([frame])
     assert np.asarray(res[0]).shape == (2, 3, 5, 6)
+
+
+def test_gru_reset_after_false_keras1_convention(rng):
+    """reset_after=False applies the reset gate to the state BEFORE the
+    candidate matmul (keras1 semantics) — numpy oracle, and a sanity
+    check that the two conventions genuinely differ on the same
+    weights."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn import GRU, Recurrent
+
+    B, T, I, H = 2, 5, 3, 4
+    rec = Recurrent().add(GRU(I, H, reset_after=False))
+    rec._ensure_params()
+    x = rng.randn(B, T, I).astype(np.float32)
+    out = np.asarray(rec.forward(x))
+
+    cp = rec.params[rec._key()]
+    w_ih = np.asarray(cp["w_ih"]); w_hh = np.asarray(cp["w_hh"])
+    b_ih = np.asarray(cp["b_ih"]); b_hh = np.asarray(cp["b_hh"])
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        pre = x[:, t] @ w_ih.T + b_ih
+        xr, xz, xn = np.split(pre, 3, axis=-1)
+        hp = h @ w_hh[:2 * H].T + b_hh[:2 * H]
+        hr, hz = np.split(hp, 2, axis=-1)
+        r, z = sig(xr + hr), sig(xz + hz)
+        n = np.tanh(xn + (r * h) @ w_hh[2 * H:].T + b_hh[2 * H:])
+        h = (1 - z) * n + z * h
+    assert_close(out[:, -1], h, atol=1e-5)
+
+    # same weights under torch semantics give a DIFFERENT trajectory
+    rec2 = Recurrent().add(GRU(I, H))
+    rec2._ensure_params()
+    rec2.params = {rec2._key(): rec.params[rec._key()]}
+    out2 = np.asarray(rec2.forward(x))
+    assert float(np.abs(out - out2).max()) > 1e-4
