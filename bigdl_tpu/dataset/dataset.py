@@ -126,11 +126,28 @@ class _DataSetFactory:
         return _DataSetFactory.array(image_folder_samples(path, **kwargs))
 
     @staticmethod
-    def seq_file_folder(path: str, decoder=None, seed: int = 0):
+    def seq_file_folder(path: str, decoder=None, seed: int = 0,
+                        format: str = "recs"):
         """Sharded record-file ingestion (reference ``DataSet.SeqFileFolder``
-        — ImageNet-as-SequenceFiles). Shards are split across processes."""
+        — ImageNet-as-SequenceFiles). Shards are split across processes.
+        ``format="hadoop"`` streams actual Hadoop SequenceFiles (a
+        reference user's existing corpus) via
+        ``dataset/hadoop_seqfile.py``; the default reads this framework's
+        RECS shards (convert once with ``hadoop_seqfile.convert_to_recs``
+        for the native-indexer fast path). ``decoder(label, payload)``
+        has the SAME signature for both formats (hadoop derives the label
+        from the Text/Int/Long key and unwraps BytesWritable first), so
+        one decoder survives a convert_to_recs migration."""
         import jax
 
+        if format == "hadoop":
+            from bigdl_tpu.dataset.hadoop_seqfile import HadoopSeqFileDataSet
+
+            return HadoopSeqFileDataSet(
+                path, decoder=decoder, seed=seed,
+                shard_index=jax.process_index(),
+                num_shards=jax.process_count(),
+            )
         from bigdl_tpu.dataset.seqfile import SeqFileDataSet
 
         return SeqFileDataSet(

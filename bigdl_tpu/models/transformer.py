@@ -123,19 +123,35 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
                   mlp_ratio: int = 4, causal: bool = True,
                   remat: bool = False,
                   sequence_parallel: Optional[str] = None,
-                  sp_axis: str = "seq") -> Sequential:
+                  sp_axis: str = "seq",
+                  output: str = "logprobs",
+                  embed_grad_matmul: bool = False) -> Sequential:
     """GPT-style decoder LM over 1-based token ids ``(B, T)`` →
     per-position log-probs ``(B, T, vocab)``.
 
     ``remat=True`` checkpoints each block (long-context memory);
     ``sequence_parallel="ring"|"ulysses"`` shards the sequence axis across
     the ``sp_axis`` mesh dimension inside a ``shard_map``.
+
+    ``output="logits"`` drops the final LogSoftMax — pair it with
+    :class:`bigdl_tpu.nn.criterion_more.MaskedSoftmaxCECriterion`, which
+    fuses the softmax into the loss instead of materializing the
+    ``(B, T, vocab)`` log-prob tensor (identical math, gigabytes less HBM
+    traffic at LM scale — see benchmarks/llm_mfu_bench.py).
+
+    ``embed_grad_matmul`` routes the token-embedding gradient through a
+    one-hot MXU matmul instead of the scatter-add lowering — measured
+    slightly SLOWER at GPT-2-small scale on v5e (llm_mfu_bench), so off
+    by default; kept as a knob for scatter-bound profiles.
     """
+    if output not in ("logprobs", "logits"):
+        raise ValueError(f"unknown output {output!r}")
     from bigdl_tpu.nn.activations import LogSoftMax
     from bigdl_tpu.nn.misc import LookupTable
 
     model = Sequential()
-    model.add(LookupTable(vocab_size, hidden_size))
+    model.add(LookupTable(vocab_size, hidden_size,
+                          grad_via_matmul=embed_grad_matmul))
     model.add(PositionEmbedding(
         max_len, hidden_size,
         sp_axis=sp_axis if sequence_parallel else None))
@@ -145,7 +161,8 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
         model.add(Remat(block) if remat else block)
     model.add(LayerNorm(hidden_size))
     model.add(Linear(hidden_size, vocab_size))
-    model.add(LogSoftMax())
+    if output == "logprobs":
+        model.add(LogSoftMax())
     return model
 
 
@@ -246,8 +263,13 @@ def make_decode_step(model: Sequential):
             inner, bp = m.modules[0], bp[m._child_key(0)]
         if isinstance(inner, TransformerBlock):
             blocks.append((inner, bp))
-    lnf, lnf_p = mods[-3], P[model._child_key(len(mods) - 3)]
-    lin_p = P[model._child_key(len(mods) - 2)]
+    from bigdl_tpu.nn.activations import LogSoftMax
+
+    # output="logits" models have no trailing LogSoftMax (the decode step
+    # applies log_softmax itself either way)
+    off = 1 if isinstance(mods[-1], LogSoftMax) else 0
+    lnf, lnf_p = mods[-2 - off], P[model._child_key(len(mods) - 2 - off)]
+    lin_p = P[model._child_key(len(mods) - 1 - off)]
 
     attn0 = blocks[0][0].attn
     heads, hd = attn0.n_heads, attn0.head_dim

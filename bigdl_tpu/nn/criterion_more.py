@@ -124,3 +124,37 @@ class TimeDistributedMaskCriterion(AbstractCriterion):
         mask = mask_nd if mask_nd.ndim == 1 else mask_nd.reshape(b * t, -1).any(axis=-1)
         mask = mask.astype(per.dtype)
         return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class MaskedSoftmaxCECriterion(AbstractCriterion):
+    """Sequence cross-entropy straight from LOGITS ``(B, T, V)`` against
+    1-based targets ``(B, T)``, masking ``padding_value`` steps — the
+    fused form of ``TimeDistributedMaskCriterion(CrossEntropyCriterion)``
+    over a ``TransformerLM(output="logits")``.
+
+    Why it exists (TPU): the unfused pipeline materializes the full
+    ``(B, T, V)`` log-prob tensor (LogSoftMax writes it, NLL re-reads it)
+    — at LM scale that is gigabytes of pure HBM traffic per step. Here
+    the loss is ``logsumexp(logits) - logits[target]`` (one reduction +
+    one gather, no log-prob tensor), and the backward's
+    ``softmax - onehot`` is generated inside one fusion. Identical math.
+    """
+
+    def __init__(self, padding_value: int = 0) -> None:
+        super().__init__()
+        self.padding_value = int(padding_value)
+
+    def apply(self, input, target):
+        import jax
+        import jax.numpy as jnp
+
+        b, t, v = input.shape
+        logits = input.reshape(b * t, v)
+        tg = target.reshape(b * t).astype(jnp.int32)
+        idx = jnp.clip(tg - 1, 0, v - 1)          # 1-based reference ids
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            logits, idx[:, None], axis=-1)[:, 0].astype(jnp.float32)
+        per = lse - picked
+        mask = (tg != self.padding_value).astype(per.dtype)
+        return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)

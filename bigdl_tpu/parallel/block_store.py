@@ -176,21 +176,20 @@ class CoordServiceBlockStore(BlockStore):
         startup: the busy-poll and overwrite-retry paths classify the
         client's human-readable status text, so a jaxlib that rewords
         its missing-key/key-exists errors must fail HERE, loudly, not on
-        the first training iteration's poll. The probe key is unique per
-        rank AND attempt — containerized ranks often share a PID, and
-        concurrent startups must not race on one key."""
-        import uuid
-
+        the first training iteration's poll. The probe key is
+        DETERMINISTIC per rank — no cross-rank race (containerized ranks
+        share PIDs but not process_index), and a crash between put and
+        delete is reclaimed by the next attempt's delete-first — while
+        staying unique across live ranks."""
         try:
             import jax
 
             rank = jax.process_index()
         except Exception:
             rank = os.getpid()
-        probe = f"selfcheck/{rank}/{uuid.uuid4().hex}"
+        probe = f"selfcheck/{rank}"
         try:
-            if self.try_get(probe) is not None:     # leftover from a crash
-                self.delete(probe)
+            self.delete(probe)                      # reclaim crashed probe
             assert self.try_get(probe) is None      # 'missing' classified
             self.put(probe, b"x")
             self.put(probe, b"y")                   # 'exists' -> del+retry

@@ -204,3 +204,53 @@ def test_softmargin_stable_large_logits():
     loss = SoftMarginCriterion().forward(
         np.array([[100.0]], np.float32), np.array([[-1.0]], np.float32))
     assert np.isfinite(loss) and abs(loss - 100.0) < 1e-3
+
+
+def test_masked_softmax_ce_matches_unfused(rng):
+    """MaskedSoftmaxCECriterion (fused, from logits) must equal
+    TimeDistributedMaskCriterion(CrossEntropyCriterion) on the same
+    logits, including padding masking — identical math, fused lowering."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.nn.criterion_more import (
+        MaskedSoftmaxCECriterion, TimeDistributedMaskCriterion,
+    )
+
+    B, T, V = 3, 5, 11
+    logits = rng.randn(B, T, V).astype(np.float32) * 2.0
+    tg = rng.randint(1, V + 1, size=(B, T)).astype(np.float32)
+    tg[0, 2] = 0.0  # padded step
+    tg[2, 4] = 0.0
+
+    fused = MaskedSoftmaxCECriterion(padding_value=0)
+    unfused = TimeDistributedMaskCriterion(CrossEntropyCriterion(),
+                                           padding_value=0)
+    a = float(fused.apply(jnp.asarray(logits), jnp.asarray(tg)))
+    b = float(unfused.apply(jnp.asarray(logits), jnp.asarray(tg)))
+    assert abs(a - b) < 1e-5, (a, b)
+
+
+def test_masked_softmax_ce_gradient_matches(rng):
+    """Backward parity: d loss / d logits of the fused CE equals the
+    unfused pipeline's (softmax - onehot scaled by mask/count)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+    from bigdl_tpu.nn.criterion_more import (
+        MaskedSoftmaxCECriterion, TimeDistributedMaskCriterion,
+    )
+
+    B, T, V = 2, 4, 7
+    logits = jnp.asarray(rng.randn(B, T, V).astype(np.float32))
+    tg = jnp.asarray(rng.randint(1, V + 1, size=(B, T)).astype(np.float32)
+                     * (rng.rand(B, T) > 0.2))
+
+    fused = MaskedSoftmaxCECriterion(padding_value=0)
+    unfused = TimeDistributedMaskCriterion(CrossEntropyCriterion(),
+                                           padding_value=0)
+    ga = jax.grad(lambda x: fused.apply(x, tg))(logits)
+    gb = jax.grad(lambda x: unfused.apply(x, tg))(logits)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                               rtol=1e-4, atol=1e-6)

@@ -126,3 +126,201 @@ def test_recs_index_label_beyond_int32():
     assert labels.dtype == np.int64
     assert list(labels) == [big, 7]
     assert list(lengths) == [3, 1]
+
+
+# -- Hadoop SequenceFile read path (reference-format corpora) --------------
+
+def test_hadoop_vint_codec_roundtrip():
+    """Hadoop WritableUtils.writeVLong encoding, bit-exact: single-byte
+    range boundaries, multi-byte positives/negatives, and the documented
+    wire bytes for a known value."""
+    import io
+
+    from bigdl_tpu.dataset.hadoop_seqfile import read_vlong, write_vlong
+
+    values = [0, 1, -1, 127, 128, -112, -113, 255, 256, 65535, 2 ** 31 - 1,
+              -(2 ** 31), 2 ** 62, -(2 ** 62)]
+    for v in values:
+        buf = io.BytesIO()
+        write_vlong(buf, v)
+        buf.seek(0)
+        assert read_vlong(buf) == v, v
+        assert not buf.read(1), f"trailing bytes for {v}"
+    # known encoding: 128 -> first byte -113 (len 1, positive), then 0x80
+    buf = io.BytesIO()
+    write_vlong(buf, 128)
+    assert buf.getvalue() == bytes([256 - 113, 0x80])
+
+
+def test_hadoop_seqfile_roundtrip_with_sync(tmp_path):
+    """Write an ImageNet-convention file (Text label key, BytesWritable
+    payload) with a tiny sync interval so the reader exercises the -1
+    sync-escape path; read back every record in order."""
+    from bigdl_tpu.dataset.hadoop_seqfile import (
+        SequenceFileReader, SequenceFileWriter, decode_bytes_writable,
+        decode_text,
+    )
+
+    rng = np.random.RandomState(0)
+    records = [(f"img_{i} {i % 7}", rng.bytes(50 + i)) for i in range(40)]
+    path = tmp_path / "part-00000"
+    with SequenceFileWriter(str(path), sync_interval=128) as w:
+        for key, payload in records:
+            w.append(key, payload)
+
+    with SequenceFileReader(str(path)) as r:
+        assert r.key_class.endswith(".Text")
+        assert r.value_class.endswith(".BytesWritable")
+        got = [(decode_text(k), decode_bytes_writable(v)) for k, v in r]
+    assert got == records
+
+
+def test_hadoop_seqfile_compressed_refused(tmp_path):
+    """A compressed SequenceFile must refuse with the codec named, not
+    stream garbage."""
+    import struct
+
+    from bigdl_tpu.dataset.hadoop_seqfile import (
+        SequenceFileReader, _write_hadoop_string,
+    )
+
+    path = tmp_path / "gz.seq"
+    with open(path, "wb") as f:
+        f.write(b"SEQ\x06")
+        _write_hadoop_string(f, "org.apache.hadoop.io.Text")
+        _write_hadoop_string(f, "org.apache.hadoop.io.BytesWritable")
+        f.write(b"\x01\x00")
+        _write_hadoop_string(f, "org.apache.hadoop.io.compress.GzipCodec")
+        f.write(struct.pack(">i", 0))
+        f.write(b"\x00" * 16)
+    with pytest.raises(NotImplementedError, match="GzipCodec"):
+        SequenceFileReader(str(path))
+
+
+def test_hadoop_convert_to_recs_and_native_read(tmp_path):
+    """convert_to_recs repacks a SequenceFile folder into RECS shards the
+    existing SeqFileDataSet (native indexer path) consumes, preserving
+    every (label, payload) pair."""
+    from bigdl_tpu.dataset.hadoop_seqfile import (
+        SequenceFileWriter, convert_to_recs,
+    )
+    from bigdl_tpu.dataset.seqfile import read_shard
+
+    rng = np.random.RandomState(1)
+    src = tmp_path / "seq"
+    src.mkdir()
+    want = {}
+    for s in range(2):
+        with SequenceFileWriter(str(src / f"part-{s:05d}")) as w:
+            for i in range(10):
+                label = s * 10 + i + 1
+                payload = rng.bytes(30)
+                want[label] = payload
+                w.append(f"n{label:08d} {label}", payload)
+
+    out = tmp_path / "recs"
+    paths = convert_to_recs(str(src), str(out), n_shards=3)
+    got = {}
+    for p in paths:
+        for label, payload in read_shard(p):
+            got[label] = payload
+    assert got == want
+
+
+def test_hadoop_dataset_streaming(tmp_path):
+    """HadoopSeqFileDataSet streams Samples straight off the Java framing
+    (uint8 payload + int32 label by default)."""
+    from bigdl_tpu.dataset.hadoop_seqfile import (
+        HadoopSeqFileDataSet, SequenceFileWriter,
+    )
+
+    rng = np.random.RandomState(2)
+    src = tmp_path / "seq"
+    src.mkdir()
+    payloads = {}
+    with SequenceFileWriter(str(src / "part-00000")) as w:
+        for i in range(12):
+            payload = rng.bytes(20)
+            payloads[i + 1] = payload
+            w.append(f"x {i + 1}", payload)
+
+    ds = HadoopSeqFileDataSet(str(src))
+    assert ds.size() == 12
+    seen = {}
+    for s in ds.data(train=False):
+        seen[int(np.asarray(s.labels[0]))] = bytes(
+            np.asarray(s.feature(), np.uint8).tobytes())
+    assert seen == payloads
+
+    # train iterator reshuffles per epoch but yields the same multiset
+    it = ds.data(train=True)
+    first_epoch = [int(np.asarray(next(it).labels[0])) for _ in range(12)]
+    assert sorted(first_epoch) == sorted(payloads)
+
+
+def test_hadoop_dataset_is_optimizer_consumable(tmp_path):
+    """The hadoop dataset follows the LocalDataSet contract: transformer
+    chains (ds >> t) and Optimizer training both work, and the decoder
+    signature matches the RECS dataset's (label, payload) so one decoder
+    survives a convert_to_recs migration."""
+    from bigdl_tpu.dataset.hadoop_seqfile import (
+        HadoopSeqFileDataSet, SequenceFileWriter,
+    )
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.dataset.seqfile import encode_array
+    from bigdl_tpu.nn import Linear, MSECriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.random_gen import RNG
+
+    rs = np.random.RandomState(4)
+    src = tmp_path / "seq"
+    src.mkdir()
+    with SequenceFileWriter(str(src / "part-00000")) as w:
+        for i in range(16):
+            w.append(f"r{i} {i % 3 + 1}",
+                     encode_array(rs.rand(4).astype(np.float32)))
+
+    def decoder(label, payload):  # same signature as the RECS decoder
+        nd = payload[0]
+        import struct as _s
+
+        dims = _s.unpack_from(f"<{nd}I", payload, 1)
+        arr = np.frombuffer(payload, np.float32,
+                            offset=1 + 4 * nd).reshape(dims)
+        return Sample(arr.copy(), np.float32(label))
+
+    ds = HadoopSeqFileDataSet(str(src), decoder=decoder)
+    # transformer chain contract
+    seen = []
+
+    def spy(it):
+        for s in it:
+            seen.append(1)
+            yield s
+
+    ds2 = ds >> spy
+    RNG.set_seed(1)
+    opt = Optimizer(model=Linear(4, 1), dataset=ds2,
+                    criterion=MSECriterion(), batch_size=8,
+                    end_trigger=Trigger.max_iteration(2))
+    opt.set_optim_method(SGD(learning_rate=0.01))
+    opt.optimize()
+    assert len(seen) >= 16
+
+
+def test_hadoop_long_writable_label_beyond_int32(tmp_path):
+    """LongWritable keys past 2**31 must stream with the full label, not
+    overflow int32."""
+    from bigdl_tpu.dataset.hadoop_seqfile import (
+        HadoopSeqFileDataSet, LONG_WRITABLE, SequenceFileWriter,
+    )
+
+    src = tmp_path / "seq"
+    src.mkdir()
+    big = 2 ** 33 + 5
+    with SequenceFileWriter(str(src / "part-00000"),
+                            key_class=LONG_WRITABLE) as w:
+        w.append(big, b"\x01\x02")
+    ds = HadoopSeqFileDataSet(str(src))
+    s = next(ds.data(train=False))
+    assert int(np.asarray(s.labels[0])) == big

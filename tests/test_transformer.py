@@ -251,3 +251,73 @@ def test_generate_rejects_overlong_decode(rng):
     # exactly at the limit is fine
     out = generate(model, [1, 2, 3], length=6, temperature=0.0)
     assert out.shape == (6,)
+
+
+def test_lookup_table_matmul_grad_matches_scatter(rng):
+    """grad_via_matmul computes the embedding gradient as a one-hot MXU
+    matmul — must match the scatter-add backward exactly (fp32)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.misc import LookupTable
+
+    V, D = 13, 6
+    ids = rng.randint(1, V + 1, size=(4, 5)).astype(np.float32)
+    ids[0, 0] = 0.0   # padding id embeds to zero, must get zero grad
+    w = rng.randn(V, D).astype(np.float32)
+
+    def loss_for(flag):
+        lt = LookupTable(V, D, grad_via_matmul=flag)
+
+        def f(wv):
+            out, _ = lt.apply({"weight": wv}, jnp.asarray(ids))
+            return jnp.sum(out * out)
+
+        return jax.grad(f)(jnp.asarray(w))
+
+    g_scatter = np.asarray(loss_for(False))
+    g_matmul = np.asarray(loss_for(True))
+    np.testing.assert_allclose(g_matmul, g_scatter, rtol=1e-5, atol=1e-6)
+    assert abs(g_matmul).sum() > 0
+
+
+def test_transformer_lm_logits_output_trains_and_decodes(rng):
+    """output="logits" + MaskedSoftmaxCECriterion is the fused LM-scale
+    path: one train step moves the loss, and make_decode_step still
+    resolves the head (no trailing LogSoftMax)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer import make_decode_step
+    from bigdl_tpu.nn.criterion_more import MaskedSoftmaxCECriterion
+    from bigdl_tpu.optim.optim_method import Adam
+    from bigdl_tpu.optim.train_step import make_train_step
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(5)
+    V, T, B = 31, 8, 4
+    lm = TransformerLM(V, hidden_size=16, n_heads=2, n_layers=2, max_len=T,
+                       output="logits")
+    crit = MaskedSoftmaxCECriterion(padding_value=0)
+    optim = Adam(learning_rate=1e-2)
+    lm._ensure_params()
+    step = jax.jit(make_train_step(lm, crit, optim))
+    x = jnp.asarray(rng.randint(1, V + 1, size=(B, T)).astype(np.int32))
+    y = jnp.asarray(rng.randint(1, V + 1, size=(B, T)).astype(np.float32))
+    params, ms = lm.params, lm.state
+    opt_state = optim.init_state(params)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(8):
+        params, opt_state, ms, loss = step(params, opt_state, ms, key, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    lm.params = params
+    dstep, init_carry = make_decode_step(lm)
+    logp, carry = dstep(None, jnp.zeros((2,), jnp.int32), init_carry(2))
+    assert logp.shape == (2, V)
+    # decode head emits normalized log-probs even without the LM softmax
+    np.testing.assert_allclose(np.exp(np.asarray(logp)).sum(-1), 1.0,
+                               rtol=1e-4)
