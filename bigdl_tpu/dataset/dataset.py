@@ -140,6 +140,10 @@ class _DataSetFactory:
         one decoder survives a convert_to_recs migration."""
         import jax
 
+        if format not in ("recs", "hadoop"):
+            raise ValueError(
+                f"unknown seq_file_folder format {format!r} — expected "
+                "'recs' (native shards) or 'hadoop' (SequenceFiles)")
         if format == "hadoop":
             from bigdl_tpu.dataset.hadoop_seqfile import HadoopSeqFileDataSet
 

@@ -109,6 +109,17 @@ class DistriOptimizer(Optimizer):
             warmup_iteration=warmup_iteration)
         return self
 
+    def _teardown(self) -> None:
+        # drain the async gradient-put thread: a daemon thread still inside
+        # a coordination-KV RPC at interpreter shutdown SIGABRTs (observed
+        # as "FATAL: exception not rethrown" in blockstore_bench workers)
+        bsp = self._bsp
+        if bsp is not None:
+            try:
+                bsp._join_puts()
+            except Exception as e:
+                logger.warning("draining async gradient puts failed: %s", e)
+
     # -- mesh --------------------------------------------------------------
 
     def mesh(self):
@@ -385,9 +396,24 @@ class DistriOptimizer(Optimizer):
         store = self._block_store
         if store is None:
             store = default_block_store()
+        if self._bsp is not None:
+            # a FAILED attempt's async put thread may still be in flight;
+            # drain it BEFORE sweeping, or its stale gradient block can
+            # land after the sweep and alias the retried run's
+            # same-numbered iteration
+            try:
+                self._bsp._join_puts()
+            except Exception as e:
+                logger.warning(
+                    "draining previous attempt's gradient puts: %s", e)
         bsp = BlockStoreParameter(
             store, n_proc, pid, total, compress=self.compress,
-            drop_policy=self._drop_policy)
+            drop_policy=self._drop_policy,
+            # with gradient-drop on, remote transfers must not sit in
+            # front of this process's own weight publish, or a slow
+            # transfer stalls every peer at the weight barrier anyway
+            # and the drop saves nothing (blockstore_bench.py)
+            async_puts=self._drop_policy is not None)
         # a retry-from-checkpoint restarts the iteration counter: reap any
         # blocks a previous attempt left behind so they can't alias the
         # retried run's same-numbered iterations

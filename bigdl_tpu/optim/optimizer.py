@@ -699,6 +699,12 @@ class Optimizer:
                 self._flush_async_marker()
                 self._async_ckptr.close()
                 self._async_ckptr = None
+            self._teardown()
+
+    def _teardown(self) -> None:
+        """Subclass hook run when optimize() finishes or fails — drain any
+        background machinery (a daemon thread mid-RPC at interpreter
+        shutdown aborts the process)."""
 
     # -- subclass hooks ----------------------------------------------------
 

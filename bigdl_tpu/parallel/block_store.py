@@ -34,11 +34,22 @@ pod, rides DCN) and a **shared filesystem** directory (atomic renames).
 The reference's FP16 compression maps to bf16/fp16 casts on the encoded
 slices.
 
-Honest scope note (also in docs/architecture.md): partition ownership is
-static, so a straggling *owner* still bounds the publish of its own weight
-partition — true of the reference as well, whose partition owner was the
-same executor that computed on that data shard. The mechanism's win, here
-as there, is that nobody waits for a slow peer's gradient *contributions*.
+Honest scope notes (measured in ``benchmarks/blockstore_bench.py``; also
+docs/parallelism.md):
+
+* partition ownership is static, so a straggling *owner's compute* still
+  bounds the publish of its own weight partition — a COMPUTE straggler
+  stalls both this plane and sync SPMD equally;
+* a *transfer* straggler (slow gradient puts — the reference's slow
+  BlockManager fetch) is the drop's win domain, and reaping it requires
+  ``async_puts``: with synchronous puts the slow transfers sit in front
+  of the straggler's own weight publish and the get_weights barrier eats
+  the whole delay anyway (drop fires, zero wall-clock saved — measured);
+  ``DistriOptimizer`` enables async_puts whenever a drop policy is set;
+* the per-contribution calibration quantile needs the FAST cluster to
+  hold at least ``1 - drop_percentage`` of the sample mass, i.e. pods of
+  n >= 3 for one straggler — at n=2 every remote sample IS the straggler
+  and the deadline chases its delay (measured; harmless, just no win).
 """
 
 from __future__ import annotations
@@ -346,7 +357,8 @@ class BlockStoreParameter:
                  total_size: int, compress: Optional[str] = None,
                  drop_policy: Optional[GradientDropPolicy] = None,
                  namespace: str = "arp",
-                 timeout_s: Optional[float] = None) -> None:
+                 timeout_s: Optional[float] = None,
+                 async_puts: bool = False) -> None:
         self.store = store
         self.n = int(n_procs)
         self.pid = int(pid)
@@ -369,6 +381,16 @@ class BlockStoreParameter:
         # next aggregations probe them so a late arrival's true (upper-
         # bound) duration can enter the calibration window
         self._late_probes: Dict[Tuple[int, int], float] = {}
+        # async_puts decouples this process's REMOTE gradient transfers
+        # from its own aggregate→publish_weights pipeline (the reference
+        # decoupled them structurally: gradient tasks vs BlockManager
+        # hosts). Without it a slow-transfer straggler delays its own
+        # weight publish and the get_weights barrier eats the whole
+        # delay, making gradient-drop wall-clock-neutral — measured in
+        # benchmarks/blockstore_bench.py
+        self.async_puts = bool(async_puts)
+        self._put_thread: Optional[threading.Thread] = None
+        self._put_error: Optional[BaseException] = None
 
     # -- keys (deterministic BlockId analog) -------------------------------
 
@@ -426,11 +448,37 @@ class BlockStoreParameter:
         self.store.delete(f"{self.ns}/pos/{self.pid}")
         self.store.put(f"{self.ns}/pos/{self.pid}",
                        encode_array(np.int64(t)))
-        for part in range(self.n):
-            if part == self.pid:
-                continue
-            self.store.put(self._gkey(t, part, self.pid),
-                           self._encode(self._slice(flat, part)))
+        blobs = [(self._gkey(t, part, self.pid),
+                  self._encode(self._slice(flat, part)))
+                 for part in range(self.n) if part != self.pid]
+
+        def _send():
+            try:
+                for key, blob in blobs:
+                    self.store.put(key, blob)
+            except BaseException as e:  # surfaced on the next join
+                self._put_error = e
+
+        if self.async_puts:
+            self._join_puts()           # at most ONE outstanding transfer
+            self._put_thread = threading.Thread(target=_send, daemon=True)
+            self._put_thread.start()
+        else:
+            _send()
+            if self._put_error is not None:
+                e, self._put_error = self._put_error, None
+                raise e
+
+    def _join_puts(self) -> None:
+        """Wait for the previous iteration's async transfer and surface
+        any error it hit (a broken store must fail the training loop, not
+        vanish into a daemon thread)."""
+        if self._put_thread is not None:
+            self._put_thread.join()
+            self._put_thread = None
+        if self._put_error is not None:
+            e, self._put_error = self._put_error, None
+            raise e
 
     def sweep_stale(self, aux_names: Sequence[str] = ()) -> None:
         """Delete every block THIS process may have left in the store by a
@@ -441,6 +489,7 @@ class BlockStoreParameter:
         own timeout→retry→sweep cycle (pod-wide failures — the common case,
         and the one the pod retry test exercises — sweep everywhere at
         once)."""
+        self._join_puts()       # a retried attempt's transfer may be live
         blob = self.store.try_get(f"{self.ns}/pos/{self.pid}")
         if blob is None:
             return

@@ -45,16 +45,16 @@ def cifar_dir(tmp_path_factory):
     return str(d)
 
 
-def _batches(cifar_dir, n_batches):
+def _batches(cifar_dir, n_batches, n_train=N_TRAIN, batch=BATCH):
     from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
     from bigdl_tpu.dataset.cifar import TRAIN_MEAN, TRAIN_STD, load_samples
     from bigdl_tpu.dataset.image import BGRImgNormalizer
 
     samples = load_samples(cifar_dir, "train", synthetic_fallback=False)
-    assert len(samples) == N_TRAIN
+    assert len(samples) == n_train
     ds = (DataSet.array(samples, seed=13)
           .transform(BGRImgNormalizer(TRAIN_MEAN, TRAIN_STD))
-          .transform(SampleToMiniBatch(BATCH)))
+          .transform(SampleToMiniBatch(batch)))
     it = ds.data(train=True)
     return [next(it) for _ in range(n_batches)]
 
@@ -89,9 +89,10 @@ def _weighted_in_topo_order(graph):
     return out
 
 
-def _torch_resnet8():
-    """torch mirror of ``_resnet_cifar(10, depth=8, shortcut A,
-    zero_gamma)`` — layer order matches graph topo order."""
+def _torch_resnet_cifar(n_blocks: int = 1):
+    """torch mirror of ``_resnet_cifar(10, depth=6n+2, shortcut A,
+    zero_gamma)`` — layer order matches graph topo order. ``n_blocks`` is
+    the per-stage block count n (depth 8 -> 1, depth 20 -> 3)."""
     import torch
     import torch.nn as tnn
     import torch.nn.functional as F
@@ -125,18 +126,35 @@ def _torch_resnet8():
             super().__init__()
             self.conv0 = tnn.Conv2d(3, 16, 3, 1, 1, bias=False)
             self.bn0 = tnn.BatchNorm2d(16)
-            self.b1 = Block(16, 16, 1)
-            self.b2 = Block(16, 32, 2)
-            self.b3 = Block(32, 64, 2)
+            blocks = []
+            n_in = 16
+            for stage, planes in enumerate((16, 32, 64)):
+                for i in range(n_blocks):
+                    stride = 2 if (stage > 0 and i == 0) else 1
+                    blocks.append(Block(n_in, planes, stride))
+                    n_in = planes
+            self.blocks = tnn.ModuleList(blocks)
             self.fc = tnn.Linear(64, 10)
 
         def forward(self, x):
             x = torch.relu(self.bn0(self.conv0(x)))
-            x = self.b3(self.b2(self.b1(x)))
+            for b in self.blocks:
+                x = b(x)
             x = x.mean(dim=(2, 3))
             return torch.log_softmax(self.fc(x), dim=1)
 
+        def weighted_modules(self):
+            mods = [self.conv0, self.bn0]
+            for b in self.blocks:
+                mods += [b.conv1, b.bn1, b.conv2, b.bn2]
+            mods.append(self.fc)
+            return mods
+
     return Net()
+
+
+def _torch_resnet8():
+    return _torch_resnet_cifar(1)
 
 
 def test_resnet_convergence_and_torch_parity(cifar_dir):
@@ -189,10 +207,7 @@ def test_resnet_convergence_and_torch_parity(cifar_dir):
 
     # --- torch: identical arch/init/batches/schedule ---------------------
     tmodel = _torch_resnet8()
-    tmods = ([tmodel.conv0, tmodel.bn0]
-             + [m for b in (tmodel.b1, tmodel.b2, tmodel.b3)
-                for m in (b.conv1, b.bn1, b.conv2, b.bn2)]
-             + [tmodel.fc])
+    tmods = tmodel.weighted_modules()
     with torch.no_grad():
         for tm, ours in zip(tmods, init_np):
             tm.weight.copy_(torch.from_numpy(ours["weight"]))
@@ -200,7 +215,7 @@ def test_resnet_convergence_and_torch_parity(cifar_dir):
                     tm, tnn.BatchNorm2d):
                 tm.bias.copy_(torch.from_numpy(ours["bias"]))
     # zero-gamma check transferred: each block's bn2 starts at γ=0
-    assert float(tmodel.b1.bn2.weight.detach().abs().max()) == 0.0
+    assert float(tmodel.blocks[0].bn2.weight.detach().abs().max()) == 0.0
 
     topt = torch.optim.SGD(tmodel.parameters(), lr=LR, momentum=MOMENTUM,
                            weight_decay=WEIGHT_DECAY)
@@ -237,8 +252,8 @@ def _iter_state_leaves(state):
                 yield from _iter_state_leaves(v)
 
 
-def _as_minibatches(xs, ys):
+def _as_minibatches(xs, ys, batch=BATCH):
     from bigdl_tpu.dataset.sample import MiniBatch
 
-    for i in range(0, len(xs), BATCH):
-        yield MiniBatch(xs[i:i + BATCH], ys[i:i + BATCH].astype(np.float32))
+    for i in range(0, len(xs), batch):
+        yield MiniBatch(xs[i:i + batch], ys[i:i + batch].astype(np.float32))

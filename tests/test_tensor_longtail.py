@@ -454,3 +454,169 @@ def test_round3b_view_rebinders():
     t3 = Tensor(ref.copy())
     t3.unsqueeze_(1)
     assert tuple(t3.data.shape) == (1, 2, 3)
+
+
+# -- round-4 tranche 4 ------------------------------------------------------
+
+def test_t4_amax_amin_aminmax_diff():
+    t, tt = _pair((3, 4), 41)
+    assert np.isclose(t.amax(), float(tt.amax()))
+    assert np.isclose(t.amin(), float(tt.amin()))
+    assert_close(t.amax(2).data, tt.amax(dim=1).numpy())
+    assert_close(t.amin(1).data, tt.amin(dim=0).numpy())
+    lo, hi = t.aminmax()
+    assert np.isclose(lo, float(tt.amin())) and np.isclose(hi,
+                                                           float(tt.amax()))
+    assert_close(t.diff().data, torch.diff(tt).numpy())
+    assert_close(t.diff(n=2, dim=1).data, torch.diff(tt, n=2, dim=0).numpy())
+
+
+def test_t4_flips_movedim_broadcast():
+    t, tt = _pair((3, 4), 42)
+    assert_close(t.fliplr().data, torch.fliplr(tt).numpy())
+    assert_close(t.flipud().data, torch.flipud(tt).numpy())
+    t3, tt3 = _pair((2, 3, 4), 43)
+    assert_close(t3.movedim(1, 3).data, torch.movedim(tt3, 0, 2).numpy())
+    b = Tensor(np.ones((1, 4), np.float32)).broadcast_to(3, 4)
+    assert b.shape == (3, 4)
+
+
+def test_t4_take_along_repeat_interleave():
+    t, tt = _pair((3, 4), 44)
+    idx = np.array([[1, 2], [3, 1], [4, 4]], np.int64)   # 1-based
+    got = t.take_along_dim(idx, 2)
+    want = torch.take_along_dim(tt, torch.from_numpy(idx - 1), dim=1)
+    assert_close(got.data, want.numpy())
+    assert_close(t.repeat_interleave(3, dim=2).data,
+                 torch.repeat_interleave(tt, 3, dim=1).numpy())
+    assert_close(t.repeat_interleave(2).data,
+                 torch.repeat_interleave(tt, 2).numpy())
+
+
+def test_t4_binary_math_family():
+    t, tt = _pair((3, 4), 45)
+    o, ot = _pair((3, 4), 46)
+    assert_close(t.logaddexp(o).data, torch.logaddexp(tt, ot).numpy())
+    assert_close(t.logaddexp2(o).data, torch.logaddexp2(tt, ot).numpy())
+    assert_close(t.copysign(o).data, torch.copysign(tt, ot).numpy())
+    assert_close(t.nextafter(o).data, torch.nextafter(tt, ot).numpy())
+    assert_close(t.heaviside(o.abs()).data,
+                 torch.heaviside(tt, ot.abs()).numpy())
+    p = Tensor(np.abs(np.asarray(t.data)) + 0.5)
+    pt = torch.from_numpy(np.asarray(p.data).copy())
+    assert_close(p.xlogy(o.abs()).data,
+                 torch.special.xlogy(pt, ot.abs()).numpy(), atol=1e-5)
+    assert_close(t.floor_divide(2.0).data,
+                 torch.floor_divide(tt, 2.0).numpy())
+    assert_close(t.true_divide(2.0).data,
+                 torch.true_divide(tt, 2.0).numpy())
+    assert_close(t.float_power(2.0).data,
+                 torch.float_power(tt, 2.0).numpy(), atol=1e-5)
+
+
+def test_t4_unary_family():
+    t, tt = _pair((3, 4), 47)
+    assert_close(t.deg2rad().data, torch.deg2rad(tt).numpy())
+    assert_close(t.rad2deg().data, torch.rad2deg(tt).numpy())
+    assert_close(t.sinc().data, torch.sinc(tt).numpy(), atol=1e-6)
+    u = Tensor(np.clip(np.abs(np.asarray(t.data)) % 1.0, 0.01, 0.99))
+    ut = torch.from_numpy(np.asarray(u.data).copy())
+    assert_close(u.logit().data, torch.logit(ut).numpy(), atol=1e-5)
+    w = Tensor(np.array([1.0, np.nan, np.inf, -np.inf], np.float32))
+    assert_close(w.nan_to_num(nan=7.0, posinf=8.0, neginf=-8.0).data,
+                 np.array([1.0, 7.0, 8.0, -8.0], np.float32))
+    z = Tensor(np.array([1.0, np.inf, -np.inf], np.float32))
+    assert list(np.asarray(z.isposinf().data)) == [False, True, False]
+    assert list(np.asarray(z.isneginf().data)) == [False, False, True]
+
+
+def test_t4_isclose_bincount_searchsorted():
+    t, tt = _pair((3, 4), 48)
+    o = Tensor(np.asarray(t.data) + 1e-7)
+    assert bool(np.asarray(t.isclose(o).data).all())
+    c = Tensor(np.array([0, 1, 1, 3, 2, 1], np.float32))
+    assert_close(c.bincount().data,
+                 torch.bincount(torch.tensor([0, 1, 1, 3, 2, 1])).numpy())
+    w = np.array([0.5, 1.0, 1.0, 2.0, 0.25, 0.25], np.float32)
+    assert_close(c.bincount(weights=w, minlength=6).data,
+                 torch.bincount(torch.tensor([0, 1, 1, 3, 2, 1]),
+                                torch.from_numpy(w), minlength=6).numpy())
+    s = Tensor(np.array([1.0, 3.0, 5.0, 7.0], np.float32))
+    got = s.searchsorted(np.array([0.0, 3.0, 8.0], np.float32))
+    want = torch.searchsorted(torch.tensor([1.0, 3.0, 5.0, 7.0]),
+                              torch.tensor([0.0, 3.0, 8.0])) + 1  # 1-based
+    assert_close(got.data, want.numpy())
+    got_r = s.searchsorted(np.array([3.0], np.float32), right=True)
+    want_r = torch.searchsorted(torch.tensor([1.0, 3.0, 5.0, 7.0]),
+                                torch.tensor([3.0]), right=True) + 1
+    assert_close(got_r.data, want_r.numpy())
+
+
+def test_t4_stacks_split_cast_cov():
+    a, at = _pair((2, 3), 49)
+    b, bt = _pair((2, 3), 50)
+    assert_close(Tensor.hstack([a, b]).data,
+                 torch.hstack([at, bt]).numpy())
+    assert_close(Tensor.vstack([a, b]).data,
+                 torch.vstack([at, bt]).numpy())
+    assert_close(Tensor.dstack([a, b]).data,
+                 torch.dstack([at, bt]).numpy())
+    assert_close(Tensor.column_stack([a, b]).data,
+                 torch.column_stack([at, bt]).numpy())
+
+    t, tt = _pair((3, 8), 51)
+    parts = t.tensor_split(3, dim=2)
+    wparts = torch.tensor_split(tt, 3, dim=1)
+    assert len(parts) == len(wparts)
+    for p, w in zip(parts, wparts):
+        assert_close(p.data, w.numpy())
+
+    assert t.cast(np.int32).data.dtype == np.int32
+    assert t.cast(Tensor(np.zeros(1, np.float16))).data.dtype == np.float16
+
+    c, ct = _pair((3, 10), 52)
+    assert_close(c.cov().data, torch.cov(ct).numpy(), atol=1e-5)
+    assert_close(c.corrcoef().data, torch.corrcoef(ct).numpy(), atol=1e-5)
+
+
+def test_t4_inplace_spellings_distinct():
+    """The _ spellings rebind self where the pure forms return new
+    tensors — both directions checked."""
+    t, tt = _pair((3, 4), 53)
+    pure = t.cumsum(2)
+    assert pure is not t and not np.allclose(np.asarray(pure.data),
+                                            np.asarray(t.data))
+    r = t.cumsum_(2)
+    assert r is t
+    assert_close(t.data, torch.cumsum(tt, dim=1).numpy())
+
+    t2, tt2 = _pair((4, 4), 54)
+    t2.tril_()
+    assert_close(t2.data, torch.tril(tt2).numpy())
+    t2.triu_(-1)
+    assert_close(t2.data, torch.triu(torch.tril(tt2), -1).numpy())
+
+    t3, tt3 = _pair((3, 4), 55)
+    t3.cumprod_(1)
+    assert_close(t3.data, torch.cumprod(tt3, dim=0).numpy())
+
+    t4, _ = _pair((3, 4), 56)
+    snap = np.asarray(t4.data).copy()
+    t4.ge_(0.0)
+    assert_close(t4.data, (snap >= 0.0).astype(np.float32))
+    for name, op in (("eq_", np.equal), ("ne_", np.not_equal),
+                     ("lt_", np.less), ("gt_", np.greater),
+                     ("le_", np.less_equal)):
+        u, _ = _pair((3, 4), 57)
+        snap = np.asarray(u.data).copy()
+        getattr(u, name)(0.1)
+        assert_close(u.data, op(snap, 0.1).astype(np.float32))
+
+    s = Tensor(np.zeros((3, 4), np.float32))
+    idx = np.ones((1, 4), np.int64)           # 1-based row 1
+    src = np.arange(4, dtype=np.float32).reshape(1, 4) + 1
+    r = s.scatter_(1, idx, src)
+    assert r is s
+    want = np.zeros((3, 4), np.float32)
+    want[0] = src[0]
+    assert_close(s.data, want)
