@@ -164,7 +164,12 @@ def run_scenario(tag: str, n: int, mode: str, put_delay: float = 0.0,
          str(compute_delay), str(drop), out_dir],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for pid in range(n)]
-    outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:          # a hung rank must not orphan its peers
+            if p.poll() is None:
+                p.kill()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         if p.returncode != 0:
             raise RuntimeError(

@@ -19,7 +19,8 @@ import json
 import time
 
 
-def bench_infer(model_builder, batch, iters, dtype=None, quantize=False):
+def bench_infer(model_builder, batch, iters, dtype=None, quantize=False,
+                scheme="dynamic"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -31,7 +32,7 @@ def bench_infer(model_builder, batch, iters, dtype=None, quantize=False):
     model = model_builder()
     model._ensure_params()
     if quantize:
-        model = Quantizer.quantize(model)
+        model = Quantizer.quantize(model, scheme=scheme)
         model._ensure_params()
     params, state = model.params, model.state
     if dtype is not None:
@@ -121,7 +122,11 @@ def main():
     bf16 = bench_infer(build, args.batch, args.iters, dtype=jnp.bfloat16)
     print(f"bf16 inference : {bf16:8.1f} img/s", flush=True)
     i8 = bench_infer(build, args.batch, args.iters, quantize=True)
-    print(f"int8 inference : {i8:8.1f} img/s  ({i8 / bf16:.2f}x bf16)",
+    print(f"int8 dynamic   : {i8:8.1f} img/s  ({i8 / bf16:.2f}x bf16)",
+          flush=True)
+    i8w = bench_infer(build, args.batch, args.iters, quantize=True,
+                      scheme="weight_only")
+    print(f"int8 weight-only: {i8w:8.1f} img/s  ({i8w / bf16:.2f}x bf16)",
           flush=True)
 
     f32_acc, q_acc = accuracy_delta()
@@ -133,6 +138,8 @@ def main():
         "value": round(i8, 1),
         "unit": "images/sec/chip",
         "vs_bf16": round(i8 / bf16, 3),
+        "weight_only_images_per_sec": round(i8w, 1),
+        "weight_only_vs_bf16": round(i8w / bf16, 3),
         "accuracy": {"float": round(f32_acc, 4), "int8": round(q_acc, 4)},
     }))
 

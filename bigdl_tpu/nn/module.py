@@ -493,12 +493,14 @@ class AbstractModule:
 
         return jax.make_jaxpr(fn)(self.params, x)
 
-    def quantize(self) -> "AbstractModule":
+    def quantize(self, scheme: str = "dynamic") -> "AbstractModule":
         """int8-quantize this trained model for inference (reference
-        ``module.quantize()`` → ``nn/quantized`` path)."""
+        ``module.quantize()`` → ``nn/quantized`` path).
+        ``scheme="weight_only"`` selects the bf16-activation serving mode
+        (see ``QuantizedLinear``)."""
         from bigdl_tpu.nn.quantized import Quantizer
 
-        return Quantizer.quantize(self)
+        return Quantizer.quantize(self, scheme=scheme)
 
     def predict_class(self, inputs, batch_size: int = 32):
         """1-based predicted classes (reference ``predictClass``)."""

@@ -117,7 +117,8 @@ class DistriOptimizer(Optimizer):
         if bsp is not None:
             try:
                 bsp._join_puts()
-            except Exception as e:
+            except BaseException as e:  # the put thread stores BaseException;
+                # raising from optimize()'s finally would mask the original
                 logger.warning("draining async gradient puts failed: %s", e)
 
     # -- mesh --------------------------------------------------------------
@@ -403,7 +404,7 @@ class DistriOptimizer(Optimizer):
             # same-numbered iteration
             try:
                 self._bsp._join_puts()
-            except Exception as e:
+            except BaseException as e:
                 logger.warning(
                     "draining previous attempt's gradient puts: %s", e)
         bsp = BlockStoreParameter(

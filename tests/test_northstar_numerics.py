@@ -209,8 +209,6 @@ def test_resnet50_step_trajectory_parity_vs_torch():
 def _weighted_in_topo_order_params(graph, params):
     """The trained params sub-dicts in the same order as
     ``_weighted_in_topo_order`` produced them at init."""
-    from bigdl_tpu.nn.tpu_fusion import _expand, _tree_get
-
     old = graph.params
     graph.params = params
     try:

@@ -116,3 +116,38 @@ def test_quantize_vgg_smoke(rng):
     q = m.quantize()
     got = np.asarray(q.forward(x)).argmax(-1)
     assert (got == want).mean() >= 0.75
+
+
+def test_weight_only_scheme_closer_than_dynamic(rng):
+    """scheme="weight_only" keeps activations un-rounded, so its output
+    must be at least as close to the float reference as dynamic's;
+    Quantizer.quantize routes the scheme and rejects unknown ones."""
+    import pickle
+
+    from bigdl_tpu.nn import ReLU, Sequential, SpatialConvolution
+    from bigdl_tpu.nn.quantized import Quantizer
+
+    m = (Sequential()
+         .add(SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+         .add(ReLU()))
+    m._ensure_params()
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    want = np.asarray(m.forward(x))
+    twin = pickle.loads(pickle.dumps(m))     # same float weights
+
+    q_dyn = Quantizer.quantize(m, scheme="dynamic")
+    got_dyn = np.asarray(q_dyn.forward(x))
+    q_w = Quantizer.quantize(twin, scheme="weight_only")
+    got_w = np.asarray(q_w.forward(x))
+
+    err_w = np.abs(got_w - want).max()
+    err_d = np.abs(got_dyn - want).max()
+    assert err_w <= err_d + 1e-6, (err_w, err_d)
+    assert err_w < 0.1 * max(1.0, np.abs(want).max())
+
+    fresh = (Sequential()
+             .add(SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+             .add(ReLU()))
+    fresh._ensure_params()
+    with pytest.raises(ValueError, match="scheme"):
+        Quantizer.quantize(fresh, scheme="int4")

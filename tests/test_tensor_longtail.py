@@ -570,6 +570,12 @@ def test_t4_stacks_split_cast_cov():
     assert len(parts) == len(wparts)
     for p, w in zip(parts, wparts):
         assert_close(p.data, w.numpy())
+    # indices form: 1-based split-before positions == torch 0-based + 1
+    parts_i = t.tensor_split([3, 6], dim=2)
+    wparts_i = torch.tensor_split(tt, [2, 5], dim=1)
+    assert len(parts_i) == len(wparts_i)
+    for p, w in zip(parts_i, wparts_i):
+        assert_close(p.data, w.numpy())
 
     assert t.cast(np.int32).data.dtype == np.int32
     assert t.cast(Tensor(np.zeros(1, np.float16))).data.dtype == np.float16
