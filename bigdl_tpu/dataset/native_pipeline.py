@@ -38,7 +38,9 @@ class NativeImagePipeline(AbstractDataSet):
                  batch_size: int, crop: Optional[tuple] = None,
                  mean, std, pad: int = 0, hflip: bool = True,
                  queue_depth: int = 4, n_workers: int = 4,
-                 seed: int = 0) -> None:
+                 seed: int = 0, output: str = "f32_nchw") -> None:
+        if output not in ("f32_nchw", "u8_nhwc"):
+            raise ValueError(f"unknown output {output!r}")
         images = np.ascontiguousarray(images, np.uint8)
         assert images.ndim == 4, "expect (N, H, W, C) uint8"
         if pad:
@@ -58,6 +60,7 @@ class NativeImagePipeline(AbstractDataSet):
         self.queue_depth = queue_depth
         self.n_workers = n_workers
         self.seed = seed
+        self.output = output
 
     def size(self) -> int:
         return self.n
@@ -87,26 +90,57 @@ class NativeImagePipeline(AbstractDataSet):
     # -- iteration --
 
     def data(self, train: bool) -> Iterator[MiniBatch]:
+        if self.output == "u8_nhwc":
+            # host does crop/flip COPIES only (uint8, no float conversion,
+            # no transpose): quarter the transfer bytes, and the heavy
+            # normalize runs on device (DeviceImageNormalizer inside the
+            # jitted step). The C++ loader is pointless here — the hot
+            # work moved off the host
+            return self._u8_iter(train)
         if native.is_available():
             return self._native_iter(train)
         return self._numpy_iter(train)
 
+    def device_normalizer(self):
+        """The matching on-device preprocess for ``output="u8_nhwc"``
+        batches (pass to ``Optimizer.set_device_preprocess`` /
+        ``make_train_step(device_preprocess=...)``)."""
+        return DeviceImageNormalizer(self.mean, self.std)
+
+    def _u8_iter(self, train: bool) -> Iterator[MiniBatch]:
+        return self._host_iter(train, u8=True)
+
     def _numpy_iter(self, train: bool) -> Iterator[MiniBatch]:
+        return self._host_iter(train, u8=False)
+
+    def _host_iter(self, train: bool, u8: bool) -> Iterator[MiniBatch]:
+        """ONE epoch/shuffle/crop/flip loop for both host feeds — only the
+        per-image finishing differs (u8 passthrough vs normalize+CHW), so
+        the two cannot drift apart."""
         rng = np.random.RandomState(self.seed)
         while True:
             idx = self._epoch_indices(rng, train)
             for i in range(0, self.n - self.batch + 1, self.batch):
                 sel = idx[i:i + self.batch]
                 oy, ox, fl = self._params(rng, train, len(sel))
-                out = np.empty((len(sel), self.c, self.crop_h, self.crop_w),
-                               np.float32)
+                if u8:
+                    out = np.empty(
+                        (len(sel), self.crop_h, self.crop_w, self.c),
+                        np.uint8)
+                else:
+                    out = np.empty(
+                        (len(sel), self.c, self.crop_h, self.crop_w),
+                        np.float32)
                 for j, s in enumerate(sel):
                     im = self.images[s, oy[j]:oy[j] + self.crop_h,
                                      ox[j]:ox[j] + self.crop_w, :]
                     if fl[j]:
                         im = im[:, ::-1, :]
-                    out[j] = ((im.astype(np.float32) - self.mean) /
-                              self.std).transpose(2, 0, 1)
+                    if u8:
+                        out[j] = im
+                    else:
+                        out[j] = ((im.astype(np.float32) - self.mean) /
+                                  self.std).transpose(2, 0, 1)
                 yield MiniBatch(out, self.labels[sel].astype(np.float32))
             if not train:
                 return
@@ -152,3 +186,18 @@ class NativeImagePipeline(AbstractDataSet):
             loader.stop()       # unblock a producer stuck in push()
             t.join(timeout=5)
             loader.close()      # frees only after no thread can touch it
+
+
+class DeviceImageNormalizer:
+    """uint8 NHWC batch → normalized float32 NCHW, traced inside the jitted
+    train step (the device-side half of the ``output="u8_nhwc"`` feed)."""
+
+    def __init__(self, mean, std) -> None:
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+
+        xf = (x.astype(jnp.float32) - self.mean) / self.std
+        return jnp.transpose(xf, (0, 3, 1, 2))

@@ -202,6 +202,9 @@ class Quantizer:
         into bf16 matmuls (serving mode — see QuantizedLinear). Both keep
         the 4× weight-footprint win; throughput measured in
         benchmarks/int8_bench.py."""
+        if scheme not in ("dynamic", "weight_only"):
+            # fail fast even when no quantizable layer exists to catch it
+            raise ValueError(f"unknown quantization scheme {scheme!r}")
         from bigdl_tpu.nn.conv import SpatialConvolution
         from bigdl_tpu.nn.linear import Linear
 

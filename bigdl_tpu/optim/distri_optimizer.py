@@ -117,8 +117,7 @@ class DistriOptimizer(Optimizer):
         if bsp is not None:
             try:
                 bsp._join_puts()
-            except BaseException as e:  # the put thread stores BaseException;
-                # raising from optimize()'s finally would mask the original
+            except Exception as e:   # _join_puts wraps stored BaseExceptions
                 logger.warning("draining async gradient puts failed: %s", e)
 
     # -- mesh --------------------------------------------------------------
@@ -211,6 +210,8 @@ class DistriOptimizer(Optimizer):
             def loss_fn(shard):
                 p_full = arp.get_weights(shard)   # fp32 master weights
                 p, x = p_full, inputs
+                if self._device_preprocess is not None:
+                    x = self._device_preprocess(x)
                 if compute_dtype is not None:
                     p = cast_floats(p_full, compute_dtype)
                     x = cast_floats(x, compute_dtype)
@@ -295,6 +296,8 @@ class DistriOptimizer(Optimizer):
 
             def loss_fn(p):
                 x = inputs
+                if self._device_preprocess is not None:
+                    x = self._device_preprocess(x)
                 if compute_dtype is not None:
                     p = cast_floats(p, compute_dtype)
                     x = cast_floats(x, compute_dtype)
@@ -404,7 +407,7 @@ class DistriOptimizer(Optimizer):
             # same-numbered iteration
             try:
                 self._bsp._join_puts()
-            except BaseException as e:
+            except Exception as e:
                 logger.warning(
                     "draining previous attempt's gradient puts: %s", e)
         bsp = BlockStoreParameter(
@@ -436,6 +439,8 @@ class DistriOptimizer(Optimizer):
         def local_grad(params, model_state, rng, inputs, targets):
             def loss_fn(p):
                 p_master, x = p, inputs
+                if self._device_preprocess is not None:
+                    x = self._device_preprocess(x)
                 if compute_dtype is not None:
                     p = cast_floats(p, compute_dtype)
                     x = cast_floats(x, compute_dtype)

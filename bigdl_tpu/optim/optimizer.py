@@ -163,6 +163,7 @@ class Optimizer:
         self.criterion = criterion
         self.optim_method: OptimMethod = SGD()
         self.end_when: Trigger = end_trigger or Trigger.max_epoch(1)
+        self._device_preprocess = None
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_trigger: Optional[Trigger] = None
         self.checkpoint_backend = "pickle"
@@ -229,6 +230,15 @@ class Optimizer:
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         self.checkpoint_backend = backend
+        return self
+
+    def set_device_preprocess(self, fn) -> "Optimizer":
+        """Jit-traced preprocessing applied to each input batch ON DEVICE
+        before the forward pass — pair with a uint8-NHWC host pipeline
+        (``NativeImagePipeline(output="u8_nhwc")`` +
+        ``DeviceImageNormalizer``) so host→device transfers ship 4× fewer
+        bytes and the normalize fuses into the first conv."""
+        self._device_preprocess = fn
         return self
 
     def handle_preemption(self, enabled: bool = True) -> "Optimizer":
@@ -621,7 +631,8 @@ class Optimizer:
         import jax
 
         if not hasattr(self, "_eval_step"):
-            self._eval_step = jax.jit(make_eval_step(self.model))
+            self._eval_step = jax.jit(make_eval_step(
+                self.model, self._device_preprocess))
         return self._eval_step(params, model_state, inp)
 
     def _run_validation(self, params, model_state, state) -> Optional[float]:
@@ -980,7 +991,8 @@ class LocalOptimizer(Optimizer):
         step = jax.jit(
             make_train_step(self.model, self.criterion, self.optim_method,
                             self.grad_clip, loss_scale=self.loss_scale,
-                            compute_dtype=resolve_dtype(self.compute_dtype)),
+                            compute_dtype=resolve_dtype(self.compute_dtype),
+                            device_preprocess=self._device_preprocess),
             donate_argnums=(0, 1),
         )
 

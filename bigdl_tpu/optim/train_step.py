@@ -217,6 +217,7 @@ def make_train_step(
     grad_transform: Optional[Callable] = None,
     loss_scale: float = 1.0,
     compute_dtype: Optional[Any] = None,
+    device_preprocess: Optional[Callable] = None,
 ):
     """Returns pure ``step(params, opt_state, model_state, rng, inp, tgt)``
     → ``(params, opt_state, model_state, loss)``. Caller jits (possibly with
@@ -232,6 +233,12 @@ def make_train_step(
     the gradients after — needed with fp16 compute, whose ~6e-8 cotangent
     floor otherwise flushes small gradients to zero (bf16 shares fp32's
     exponent range and usually needs none).
+
+    ``device_preprocess`` runs INSIDE the jit on the raw input batch
+    before anything else — the uint8-NHWC transfer path
+    (``DeviceImageNormalizer``): the host ships quarter-size uint8
+    batches and the normalize/transpose fuses into the first conv's
+    prologue on device.
     """
 
     def step(params, opt_state, model_state, rng, inputs, targets):
@@ -240,6 +247,8 @@ def make_train_step(
 
         def loss_fn(p):
             x = inputs
+            if device_preprocess is not None:
+                x = device_preprocess(x)
             if compute_dtype is not None:
                 p = cast_floats(p, compute_dtype)
                 x = cast_floats(x, compute_dtype)
@@ -274,8 +283,10 @@ def make_train_step(
     return step
 
 
-def make_eval_step(model):
+def make_eval_step(model, device_preprocess: Optional[Callable] = None):
     def step(params, model_state, inputs):
+        if device_preprocess is not None:
+            inputs = device_preprocess(inputs)
         out, _ = model.apply(params, inputs, model_state, training=False, rng=None)
         return out
 

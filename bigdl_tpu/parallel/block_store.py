@@ -478,7 +478,14 @@ class BlockStoreParameter:
             self._put_thread = None
         if self._put_error is not None:
             e, self._put_error = self._put_error, None
-            raise e
+            if isinstance(e, Exception):
+                raise e
+            # a stored KeyboardInterrupt/SystemExit from the SENDER thread
+            # is a dead transfer, not a live interrupt of THIS thread —
+            # surface it as a regular error so callers' except Exception
+            # guards treat it uniformly
+            raise RuntimeError(
+                f"async gradient put thread died with {e!r}") from e
 
     def sweep_stale(self, aux_names: Sequence[str] = ()) -> None:
         """Delete every block THIS process may have left in the store by a

@@ -166,3 +166,68 @@ def test_recs_index_rejects_malformed(tmp_path):
     bad = b"RECS" + bytes([5]) + bytes([100]) + b"\x01\x02"
     with pytest.raises(ValueError):
         native.recs_index(np.frombuffer(bad, np.uint8))
+
+
+def test_u8_nhwc_output_and_device_normalizer(rng):
+    """output="u8_nhwc" ships raw uint8 crops; DeviceImageNormalizer on
+    the batch must equal the pipeline's own f32_nchw output for the same
+    crop (flip/augment off for determinism)."""
+    import jax
+
+    from bigdl_tpu.dataset.native_pipeline import (
+        DeviceImageNormalizer, NativeImagePipeline,
+    )
+
+    imgs = rng.randint(0, 256, size=(8, 10, 10, 3)).astype(np.uint8)
+    labels = (np.arange(8) % 3 + 1).astype(np.int32)
+    kw = dict(batch_size=4, crop=(8, 8), mean=(10.0, 20.0, 30.0),
+              std=(50.0, 60.0, 70.0), hflip=False, seed=3)
+
+    u8 = NativeImagePipeline(imgs, labels, output="u8_nhwc", **kw)
+    f32 = NativeImagePipeline(imgs, labels, **kw)
+    b_u8 = next(u8.data(train=False))
+    b_f32 = next(f32.data(train=False))
+
+    x = np.asarray(b_u8.get_input())
+    assert x.dtype == np.uint8 and x.shape == (4, 8, 8, 3)
+    norm = DeviceImageNormalizer((10.0, 20.0, 30.0), (50.0, 60.0, 70.0))
+    got = np.asarray(jax.jit(norm)(x))
+    want = np.asarray(b_f32.get_input())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert u8.device_normalizer().mean.tolist() == [10.0, 20.0, 30.0]
+
+    with pytest.raises(ValueError, match="output"):
+        NativeImagePipeline(imgs, labels, output="f16_nhwc", **kw)
+
+
+def test_u8_feed_through_distri_optimizer(rng):
+    """set_device_preprocess must reach the DistriOptimizer spmd step
+    builders too (a silently-dropped preprocess feeds raw uint8 NHWC into
+    an NCHW conv)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.dataset.native_pipeline import NativeImagePipeline
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+    from bigdl_tpu.utils.random_gen import RNG
+
+    imgs = rng.randint(0, 256, size=(64, 28, 28, 1)).astype(np.uint8)
+    labels = (np.arange(64) % 10 + 1).astype(np.int32)
+    pipe = NativeImagePipeline(imgs, labels, batch_size=16, crop=(28, 28),
+                               mean=(33.3,), std=(78.6,), hflip=False,
+                               output="u8_nhwc")
+    for mode in ("allreduce", "partitioned"):
+        RNG.set_seed(5)
+        opt = Optimizer(model=LeNet5(10), dataset=pipe,
+                        criterion=ClassNLLCriterion(), distributed=True,
+                        parameter_mode=mode,
+                        mesh=Mesh(np.asarray(jax.devices()).reshape(-1),
+                                  ("data",)),
+                        end_trigger=Trigger.max_iteration(2))
+        opt.set_device_preprocess(pipe.device_normalizer())
+        opt.set_optim_method(SGD(learning_rate=0.05))
+        trained = opt.optimize()
+        ws, _ = trained.parameters()
+        assert sum(np.asarray(w).size for w in ws) > 1000
