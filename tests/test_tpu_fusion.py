@@ -41,7 +41,8 @@ def _data(batch=2, hw=8, seed=0):
                        jnp.float32)
 
 
-@pytest.mark.parametrize("planes", [8, 128])
+@pytest.mark.parametrize(
+    "planes", [8, pytest.param(128, marks=pytest.mark.integration)])
 def test_forward_parity_training(planes, monkeypatch):
     """planes=8 exercises the XLA-dot edge lowering; planes=128 forces the
     Pallas kernel path (interpret mode on CPU) via the env threshold."""
@@ -79,7 +80,8 @@ def test_forward_parity_eval():
                                rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("planes", [8, 128])
+@pytest.mark.parametrize(
+    "planes", [8, pytest.param(128, marks=pytest.mark.integration)])
 def test_grad_parity(planes, monkeypatch):
     monkeypatch.setenv("BIGDL_PALLAS_MIN_C", "128")
     RNG.set_seed(5)
