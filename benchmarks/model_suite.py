@@ -83,17 +83,16 @@ def main() -> None:
         rng.integers(1, 1001, size=(b,)).astype(np.int32),
         compute_dtype=jnp.bfloat16)
 
-    # extension: TransformerLM with flash attention, tokens/sec
-    # (TimeDistributedMaskCriterion vmaps over B·T — the per-step Python
-    # loop of TimeDistributedCriterion would unroll 2048× at trace time)
-    from bigdl_tpu.nn.criterion_more import TimeDistributedMaskCriterion
+    # extension: TransformerLM tokens/sec on the round-4 fused path
+    # (logits output + MaskedSoftmaxCECriterion — the LM-scale default;
+    # the 137M-param MFU story lives in llm_mfu_bench.py)
+    from bigdl_tpu.nn.criterion_more import MaskedSoftmaxCECriterion
 
     b, t = 8, 2048
     lm = TransformerLM(8192, hidden_size=512, n_heads=8, n_layers=6,
-                       max_len=t)
+                       max_len=t, output="logits")
     tok_rate = _measure(
-        lm, TimeDistributedMaskCriterion(ClassNLLCriterion(),
-                                         padding_value=0),
+        lm, MaskedSoftmaxCECriterion(padding_value=0),
         SGD(learning_rate=0.1),
         rng.integers(1, 8193, size=(b, t)).astype(np.int32),
         rng.integers(1, 8193, size=(b, t)).astype(np.float32),

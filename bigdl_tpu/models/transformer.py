@@ -92,12 +92,13 @@ class TransformerBlock(Container):
 
     def __init__(self, hidden_size: int, n_heads: int, mlp_ratio: int = 4,
                  causal: bool = True, sequence_parallel: Optional[str] = None,
-                 sp_axis: str = "seq") -> None:
+                 sp_axis: str = "seq", use_flash: str = "auto") -> None:
         super().__init__()
         self.ln1 = LayerNorm(hidden_size)
         self.attn = MultiHeadAttention(
             hidden_size, n_heads, causal=causal,
-            sequence_parallel=sequence_parallel, sp_axis=sp_axis)
+            sequence_parallel=sequence_parallel, sp_axis=sp_axis,
+            use_flash=use_flash)
         self.ln2 = LayerNorm(hidden_size)
         self.fc1 = Linear(hidden_size, mlp_ratio * hidden_size)
         self.fc2 = Linear(mlp_ratio * hidden_size, hidden_size)
@@ -125,7 +126,8 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
                   sequence_parallel: Optional[str] = None,
                   sp_axis: str = "seq",
                   output: str = "logprobs",
-                  embed_grad_matmul: bool = False) -> Sequential:
+                  embed_grad_matmul: bool = False,
+                  use_flash: str = "auto") -> Sequential:
     """GPT-style decoder LM over 1-based token ids ``(B, T)`` →
     per-position log-probs ``(B, T, vocab)``.
 
@@ -143,9 +145,21 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
     one-hot MXU matmul instead of the scatter-add lowering — measured
     slightly SLOWER at GPT-2-small scale on v5e (llm_mfu_bench), so off
     by default; kept as a knob for scatter-bound profiles.
+
+    ``use_flash`` routes through every block to the attention layers'
+    constructors (so their own validation applies — e.g. striped_ring
+    refuses ``"never"``). ``"auto"`` (default) = flash on TPU at every
+    length: IN-MODEL, flash wins even at T=2048 (152.4 vs 261.7 ms/step
+    on the 137M config — the dense path's T×T score/softmax
+    materialization is pure HBM traffic the rest of the step is already
+    starved by), although the STANDALONE kernel microbench
+    (flash_bench.py) only breaks even near 8k. Measured in
+    llm_mfu_bench.py; ``"never"`` forces the dense path.
     """
     if output not in ("logprobs", "logits"):
         raise ValueError(f"unknown output {output!r}")
+    if use_flash not in ("auto", "always", "never"):
+        raise ValueError(f"unknown use_flash {use_flash!r}")
     from bigdl_tpu.nn.activations import LogSoftMax
     from bigdl_tpu.nn.misc import LookupTable
 
@@ -157,7 +171,8 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
         sp_axis=sp_axis if sequence_parallel else None))
     for _ in range(n_layers):
         block = TransformerBlock(hidden_size, n_heads, mlp_ratio, causal,
-                                 sequence_parallel, sp_axis)
+                                 sequence_parallel, sp_axis,
+                                 use_flash=use_flash)
         model.add(Remat(block) if remat else block)
     model.add(LayerNorm(hidden_size))
     model.add(Linear(hidden_size, vocab_size))
