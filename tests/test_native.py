@@ -231,3 +231,36 @@ def test_u8_feed_through_distri_optimizer(rng):
         trained = opt.optimize()
         ws, _ = trained.parameters()
         assert sum(np.asarray(w).size for w in ws) > 1000
+
+
+def test_u8_feed_validation_path(rng):
+    """In-training validation must also run the device preprocess — a
+    u8_nhwc validation set fed to a conv model crashes (or scores
+    garbage) if _eval_forward skips the normalizer."""
+    from bigdl_tpu.dataset.native_pipeline import NativeImagePipeline
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.utils.random_gen import RNG
+
+    imgs = rng.randint(0, 256, size=(64, 28, 28, 1)).astype(np.uint8)
+    labels = (np.arange(64) % 10 + 1).astype(np.int32)
+
+    def pipe(n):
+        return NativeImagePipeline(imgs[:n], labels[:n], batch_size=16,
+                                   crop=(28, 28), mean=(33.3,),
+                                   std=(78.6,), hflip=False,
+                                   output="u8_nhwc")
+
+    train = pipe(64)
+    RNG.set_seed(9)
+    opt = Optimizer(model=LeNet5(10), dataset=train,
+                    criterion=ClassNLLCriterion(),
+                    end_trigger=Trigger.max_iteration(3))
+    opt.set_device_preprocess(train.device_normalizer())
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    opt.set_validation(Trigger.several_iteration(2), pipe(32),
+                       [Top1Accuracy()], batch_size=16)
+    trained = opt.optimize()   # would raise on conv dim mismatch if the
+    ws, _ = trained.parameters()   # eval path skipped the preprocess
+    assert sum(np.asarray(w).size for w in ws) > 1000
