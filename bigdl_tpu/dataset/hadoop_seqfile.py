@@ -202,7 +202,10 @@ class SequenceFileReader:
         self.key_class = _read_hadoop_string(f)
         self.value_class = _read_hadoop_string(f)
         compressed = f.read(1)[0] != 0
-        block_compressed = f.read(1)[0] != 0 if self.version >= 5 else False
+        # Hadoop's BLOCK_COMPRESS_VERSION is 4, so every supported version
+        # (4-6, enforced above) carries the blockCompressed flag byte; only
+        # the codec class string (CUSTOM_COMPRESS_VERSION) waits for v5.
+        block_compressed = f.read(1)[0] != 0
         codec = None
         if compressed or block_compressed:
             if self.version >= 5:

@@ -732,8 +732,11 @@ class DistriOptimizer(Optimizer):
             mesh = self.mesh()
             if self.parameter_mode == "partitioned":
                 arp, model = self._arp, self.model
+                dev_pre = self._device_preprocess
 
                 def spmd(shards, model_state, x):
+                    if dev_pre is not None:
+                        x = dev_pre(x)
                     p_full = arp.get_weights(shards[0])
                     out, _ = model.apply(p_full, x, model_state,
                                          training=False, rng=None)
@@ -746,7 +749,8 @@ class DistriOptimizer(Optimizer):
                 ))
             else:
                 self._dist_eval_step = make_sharded_eval_step(
-                    self.model, mesh)
+                    self.model, mesh,
+                    device_preprocess=self._device_preprocess)
         return pad_shard_call(self._dist_eval_step, self._n_devices,
                               params, model_state, inp)
 

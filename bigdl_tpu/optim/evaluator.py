@@ -49,16 +49,21 @@ def _batches(data, batch_size: int):
             yield MiniBatch(arr[i:i + batch_size])
 
 
-def make_sharded_eval_step(model, mesh):
+def make_sharded_eval_step(model, mesh, device_preprocess=None):
     """Jitted forward with the batch sharded over the mesh's ``data`` axis
     and params/state replicated — the one construction shared by
-    :class:`Evaluator` and ``DistriOptimizer``'s in-training validation."""
+    :class:`Evaluator` and ``DistriOptimizer``'s in-training validation.
+
+    ``device_preprocess`` (e.g. the u8-NHWC ``DeviceImageNormalizer``) runs
+    inside the jit on the raw sharded batch, mirroring the training step —
+    a pipeline that trains through ``set_device_preprocess`` must validate
+    through the same transform or the model sees unnormalized input."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     batch_sh = NamedSharding(mesh, P("data"))
     rep = NamedSharding(mesh, P())
-    return jax.jit(make_eval_step(model),
+    return jax.jit(make_eval_step(model, device_preprocess),
                    in_shardings=(rep, rep, batch_sh), out_shardings=batch_sh)
 
 
@@ -88,9 +93,14 @@ class Evaluator:
     """Distributed/batched evaluation of a model against ValidationMethods
     (reference ``Evaluator(model).test(dataset, methods, batchSize)``)."""
 
-    def __init__(self, model, mesh=None) -> None:
+    def __init__(self, model, mesh=None, device_preprocess=None) -> None:
+        """``device_preprocess`` mirrors ``Optimizer.set_device_preprocess``:
+        a model trained on normalized input through that hook must be
+        scored through the same transform, or raw (e.g. uint8-NHWC) batches
+        reach the model unnormalized."""
         self.model = model
         self.mesh = mesh
+        self.device_preprocess = device_preprocess
         self._step = None
 
     def _forward(self, params, model_state, inp):
@@ -98,9 +108,11 @@ class Evaluator:
 
         if self._step is None:
             if self.mesh is not None:
-                self._step = make_sharded_eval_step(self.model, self.mesh)
+                self._step = make_sharded_eval_step(
+                    self.model, self.mesh, self.device_preprocess)
             else:
-                self._step = jax.jit(make_eval_step(self.model))
+                self._step = jax.jit(
+                    make_eval_step(self.model, self.device_preprocess))
         if self.mesh is not None:
             # a ragged final batch can't shard N ways — pad to the mesh size
             n_dev = int(np.prod(list(self.mesh.shape.values())))
@@ -125,8 +137,9 @@ class Evaluator:
 class Predictor:
     """Batched prediction (reference ``Predictor.predict/predictClass``)."""
 
-    def __init__(self, model, mesh=None) -> None:
-        self._ev = Evaluator(model, mesh=mesh)
+    def __init__(self, model, mesh=None, device_preprocess=None) -> None:
+        self._ev = Evaluator(model, mesh=mesh,
+                             device_preprocess=device_preprocess)
         self.model = model
 
     @staticmethod

@@ -199,13 +199,29 @@ class CoordServiceBlockStore(BlockStore):
         except Exception:
             rank = os.getpid()
         probe = f"selfcheck/{rank}"
+
+        class _ProbeFailed(RuntimeError):
+            """Probe semantics broken (delete/put did not take effect) —
+            distinct from the wording-classification failure below, which
+            points the operator at _classify_status's token lists."""
+
         try:
             self.delete(probe)                      # reclaim crashed probe
-            assert self.try_get(probe) is None      # 'missing' classified
+            if self.try_get(probe) is not None:     # 'missing' classified
+                raise _ProbeFailed(
+                    "CoordServiceBlockStore self-check failed — probe key "
+                    "still visible after delete: this client's deletes do "
+                    "not take effect (NOT a _classify_status wording issue)")
             self.put(probe, b"x")
             self.put(probe, b"y")                   # 'exists' -> del+retry
-            assert self.try_get(probe) == b"y"
+            if self.try_get(probe) != b"y":
+                raise _ProbeFailed(
+                    "CoordServiceBlockStore self-check failed — overwrite-"
+                    "retry did not land: delete+put on an existing key left "
+                    "a stale value (NOT a _classify_status wording issue)")
             self.delete(probe)
+        except _ProbeFailed:
+            raise
         except Exception as e:
             raise RuntimeError(
                 "CoordServiceBlockStore self-check failed — this jaxlib's "
@@ -376,10 +392,11 @@ class BlockStoreParameter:
             os.environ.get("BIGDL_BLOCKSTORE_TIMEOUT_S", "300"))
         self.dropped_total = 0          # contributions discarded so far
         self._my_slice_cache: Optional[np.ndarray] = None
-        # (iteration, src) -> aggregation start time, for contributions
-        # dropped at the deadline whose blocks have not arrived yet — the
-        # next aggregations probe them so a late arrival's true (upper-
-        # bound) duration can enter the calibration window
+        # (iteration, src) -> that iteration's aggregation start time, for
+        # contributions dropped at the deadline whose blocks have not
+        # arrived yet — the next aggregations probe them so a late
+        # arrival's true (upper-bound) duration can enter the calibration
+        # window and the deadline can adapt upward on recovery
         self._late_probes: Dict[Tuple[int, int], float] = {}
         # async_puts decouples this process's REMOTE gradient transfers
         # from its own aggregate→publish_weights pipeline (the reference
@@ -432,6 +449,24 @@ class BlockStoreParameter:
     def _decode(blob: bytes) -> np.ndarray:
         return decode_array(blob).astype(np.float32)
 
+    # Gradient blobs carry an 8-byte wall-clock send marker so the OWNER
+    # can fold the contribution's publish→arrival duration into its
+    # calibration sample (max with wait-since-aggregation-start — see
+    # aggregate_my_partition): without it, an owner that is itself the
+    # slowest process records ~0 s for contributions that landed before it
+    # began aggregating, collapsing the window to min_deadline_s and
+    # dropping honest peers on the first jitter. Wall clock (not
+    # monotonic) because the marker crosses processes; same-host pods
+    # share it exactly, and multi-host NTP skew is ms-scale against the
+    # ≥min_deadline_s (50 ms) floor. Negative skew clamps to 0.
+    def _encode_g(self, arr: np.ndarray) -> bytes:
+        return struct.pack(">d", time.time()) + self._encode(arr)
+
+    @staticmethod
+    def _decode_g(blob: bytes) -> Tuple[float, np.ndarray]:
+        (send_ts,) = struct.unpack(">d", blob[:8])
+        return send_ts, BlockStoreParameter._decode(blob[8:])
+
     # -- the four reference verbs -----------------------------------------
 
     def put_gradients(self, t: int, flat_grad: np.ndarray) -> None:
@@ -449,7 +484,7 @@ class BlockStoreParameter:
         self.store.put(f"{self.ns}/pos/{self.pid}",
                        encode_array(np.int64(t)))
         blobs = [(self._gkey(t, part, self.pid),
-                  self._encode(self._slice(flat, part)))
+                  self._encode_g(self._slice(flat, part)))
                  for part in range(self.n) if part != self.pid]
 
         def _send():
@@ -538,18 +573,28 @@ class BlockStoreParameter:
             for src in list(pending):
                 blob = self.store.try_get(self._gkey(t, self.pid, src))
                 if blob is not None:
-                    acc += self._decode(blob)
+                    send_ts, contrib = self._decode_g(blob)
+                    acc += contrib
                     arrived += 1
                     pending.remove(src)
                     if self.drop is not None:
-                        # PER-CONTRIBUTION arrival duration (the
-                        # reference's per-task time distribution): the
-                        # (1-p) quantile then sits in the fast cluster as
-                        # long as straggling mass stays below p — a
-                        # deadline-truncated aggregation wait is never
-                        # recorded, so the window cannot fill with
-                        # deadline-valued samples and freeze the quantile
-                        self.drop.record(time.monotonic() - t0)
+                        # PER-CONTRIBUTION sample = max(wait since MY
+                        # aggregation start, publish→arrival from the
+                        # sender's embedded marker). The wait term is the
+                        # actual decision variable (the deadline cuts off
+                        # wait-since-start), so compute-slow peers keep
+                        # registering their full lateness and the quantile
+                        # can adapt upward; the transfer term keeps an
+                        # owner that is ITSELF the slowest from recording
+                        # ~0 s for contributions that landed before it
+                        # began aggregating and collapsing the window to
+                        # min_deadline_s. A deadline-truncated wait is
+                        # still never recorded (in-loop arrivals have
+                        # wait < deadline by construction), so the window
+                        # cannot fill with deadline-valued samples.
+                        self.drop.record(max(
+                            0.0, time.monotonic() - t0,
+                            time.time() - send_ts))
             if not pending:
                 break
             now = time.monotonic()
@@ -586,10 +631,17 @@ class BlockStoreParameter:
         without a sample — a dead peer must not inflate the window."""
         if self.drop is None or not self._late_probes:
             return
-        now = time.monotonic()
         for (tp, src), t0 in list(self._late_probes.items()):
-            if self.store.try_get(self._gkey(tp, self.pid, src)) is not None:
-                self.drop.record(now - t0)
+            blob = self.store.try_get(self._gkey(tp, self.pid, src))
+            if blob is not None:
+                # same max(wait, transfer) convention as the in-loop
+                # sample: the wait term (observed from the DROPPED
+                # iteration's aggregation start) is what lets a recovered
+                # compute-slow straggler pull the quantile back up. Only
+                # the 8-byte marker is needed — skip the array decode.
+                (send_ts,) = struct.unpack(">d", blob[:8])
+                self.drop.record(max(0.0, time.monotonic() - t0,
+                                     time.time() - send_ts))
                 del self._late_probes[(tp, src)]
                 self.store.delete(self._gkey(tp, self.pid, src))
             elif tp <= t - 2:

@@ -77,6 +77,97 @@ def test_drop_policy_floor_and_validation():
         GradientDropPolicy(0.5, max_drop_percentage=0.2)
 
 
+def test_calibration_epoch_is_publish_time_not_aggregation_start(tmp_path):
+    """A contribution that lands BEFORE the owner starts aggregating must
+    still record its true publish→arrival duration (the blob's embedded
+    send marker), not ~0 s — otherwise an owner that is itself the slowest
+    process collapses the calibration window to min_deadline_s and drops
+    honest peers on the first jitter (round-4 ADVICE low)."""
+    store = FsBlockStore(str(tmp_path / "bs"))
+    policy = GradientDropPolicy(0.5, warmup_iteration=0,
+                                min_deadline_s=0.05)
+    owner = BlockStoreParameter(store, 2, 0, 8, drop_policy=policy,
+                                timeout_s=5.0)
+    peer = BlockStoreParameter(store, 2, 1, 8, timeout_s=5.0)
+    g = np.ones(8, np.float32)
+
+    peer.put_gradients(0, g)       # peer publishes early...
+    time.sleep(0.2)                # ...owner is slow to reach aggregation
+    owner.put_gradients(0, g)
+    owner.aggregate_my_partition(0)
+    assert len(policy._samples) == 1
+    # the sample reflects the 0.2 s the blob sat in the store, not the
+    # ~0 s the owner waited after starting aggregation
+    assert policy._samples[0] >= 0.15, list(policy._samples)
+
+
+def test_calibration_also_captures_compute_slow_peers(tmp_path):
+    """The other side of the epoch fix: a peer whose COMPUTE lags (publish
+    late, transfer instant) must still register its full lateness — the
+    sample is max(wait-since-aggregation-start, transfer), so the deadline
+    can adapt upward and a recovered straggler re-enters (the round-5
+    review's heterogeneous-pod scenario)."""
+    store = FsBlockStore(str(tmp_path / "bs"))
+    policy = GradientDropPolicy(0.5, warmup_iteration=0,
+                                min_deadline_s=0.05)
+    owner = BlockStoreParameter(store, 2, 0, 8, drop_policy=policy,
+                                timeout_s=5.0)
+    peer = BlockStoreParameter(store, 2, 1, 8, timeout_s=5.0)
+    g = np.ones(8, np.float32)
+
+    owner.put_gradients(0, g)
+
+    def late_publish():
+        time.sleep(0.2)        # compute skew; the transfer itself is fast
+        peer.put_gradients(0, g)
+
+    th = threading.Thread(target=late_publish)
+    th.start()
+    owner.aggregate_my_partition(0)   # no deadline yet (first sample)
+    th.join()
+    assert len(policy._samples) == 1
+    # the owner waited ~0.2 s; the sample must reflect that wait, not the
+    # ~0 s publish→arrival transfer time
+    assert policy._samples[0] >= 0.15, list(policy._samples)
+
+
+def test_coord_store_self_check_raises_runtime_error():
+    """The startup self-check must verify its probes with explicit raises
+    (not bare ``assert``, which ``python -O`` strips — round-4 ADVICE
+    low): a client whose deletes don't take must fail construction with
+    the classification RuntimeError."""
+    from bigdl_tpu.parallel.block_store import CoordServiceBlockStore
+
+    class StickyClient:
+        """key_value_delete silently no-ops, so the 'missing' probe sees a
+        stale value — exactly the condition the bare assert guarded."""
+
+        def __init__(self):
+            self.kv = {"bigdl_bs/selfcheck/0": b"stale"}
+
+        def key_value_set_bytes(self, k, v):
+            if k in self.kv:
+                raise RuntimeError(f"ALREADY_EXISTS: {k}")
+            self.kv[k] = v
+
+        def key_value_try_get_bytes(self, k):
+            if k not in self.kv:
+                raise RuntimeError(f"NOT_FOUND: {k}")
+            return self.kv[k]
+
+        def key_value_delete(self, k):
+            pass  # broken: delete never lands
+
+    store = CoordServiceBlockStore.__new__(CoordServiceBlockStore)
+    store._client = StickyClient()
+    store._prefix = "bigdl_bs"
+    import unittest.mock as mock
+
+    with mock.patch("jax.process_index", return_value=0):
+        with pytest.raises(RuntimeError, match="self-check failed"):
+            store._self_check()
+
+
 # -- threaded exchange ----------------------------------------------------
 
 def _run_exchange(store, n, total, grads_by_pid, w0, n_iters=1,

@@ -201,6 +201,46 @@ def test_validation_runs_sharded_on_mesh(mode):
     assert len(out.sharding.device_set) == 8
 
 
+@pytest.mark.parametrize("mode", ["partitioned", "allreduce"])
+def test_sharded_validation_applies_device_preprocess(mode):
+    """The sharded eval paths (spmd closure + make_sharded_eval_step) must
+    run ``set_device_preprocess`` on the raw batch exactly like the train
+    step does — a u8-NHWC pipeline that trains normalized must not
+    validate on raw uint8 (round-4 ADVICE medium,
+    ``distri_optimizer._eval_forward``)."""
+    import jax
+
+    rs = np.random.RandomState(3)
+    raw_u8 = rs.randint(0, 256, size=(16, 1, 28, 28)).astype(np.uint8)
+
+    def preprocess(x):
+        return (x.astype(np.float32) / 255.0 - TRAIN_MEAN) / TRAIN_STD
+
+    from bigdl_tpu.dataset.sample import Sample
+
+    samples = [Sample(raw_u8[i], np.float32((i % 10) + 1))
+               for i in range(16)]
+    model = LeNet5(10)
+    ds = DistributedDataSet(samples).transform(SampleToMiniBatch(16))
+    opt = DistriOptimizer(
+        model=model, dataset=ds, criterion=ClassNLLCriterion(),
+        parameter_mode=mode,
+    )
+    opt.set_device_preprocess(preprocess)
+    vds = DistributedDataSet(samples).transform(SampleToMiniBatch(16))
+    opt.set_optim_method(SGD(learning_rate=1e-3)).set_end_when(
+        Trigger.max_iteration(1))
+    opt.set_validation(Trigger.several_iteration(1), vds, [Top1Accuracy()])
+    opt.optimize()  # in-training validation itself exercises the path
+
+    params = opt._host_params_to_device(model.params) \
+        if mode == "partitioned" else model.params
+    out = np.asarray(opt._eval_forward(params, model.state, raw_u8))
+    ref, _ = model.apply(model.params, preprocess(raw_u8), model.state,
+                         training=False, rng=None)
+    assert_close(out, np.asarray(ref), atol=1e-5)
+
+
 def test_pod_set_validation_pyspark_order():
     """Pod-mode set_validation must survive the pyspark positional order
     (batch_size, val_rdd, trigger, val_method) — round-2 review finding:

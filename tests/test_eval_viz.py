@@ -88,6 +88,37 @@ def test_evaluator_on_mesh(rng):
     assert total == 20
 
 
+def test_evaluator_device_preprocess_local_and_mesh(rng):
+    """Standalone Evaluator/Predictor must honor a device_preprocess the
+    way the optimizer's validation path does (round-4 review): a model
+    trained on normalized input scores raw batches through the same
+    transform, on both the local and the sharded path."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.optim import Evaluator, Predictor, Top1Accuracy
+
+    m = _toy_classifier(rng)
+    raw = [s for s in _toy_samples(rng, n=16)]
+
+    def pre(x):
+        return x * 0.1 - 0.5
+
+    for mesh in (None, Mesh(np.asarray(jax.devices()).reshape(8), ("data",))):
+        (res,) = Evaluator(m, mesh=mesh, device_preprocess=pre).test(
+            raw, [Top1Accuracy()], batch_size=8)
+        acc, total = res.result()
+        assert total == 16
+        xs = np.stack([s.feature() for s in raw])
+        ys = np.array([int(s.label()) for s in raw])
+        want = (np.asarray(m.forward(pre(xs))).argmax(-1) + 1 == ys).mean()
+        assert acc == pytest.approx(want)
+        probs = Predictor(m, mesh=mesh, device_preprocess=pre).predict(
+            xs, batch_size=8)
+        np.testing.assert_allclose(
+            probs, np.asarray(m.forward(pre(xs))), atol=1e-5)
+
+
 def test_evaluator_accepts_dataset_and_respects_batch_size(rng):
     from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.optim import Evaluator, Top1Accuracy
