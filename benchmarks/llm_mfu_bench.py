@@ -73,7 +73,9 @@ def total_params(vocab: int, hidden: int, layers: int, t: int,
 def measure(batch: int, t: int, vocab: int, hidden: int, layers: int,
             heads: int, remat: bool, use_flash: str, iters: int = 10,
             lr: float = 1e-4, fused_ce: bool = True,
-            embed_matmul: bool = False) -> dict:
+            embed_matmul: bool = False, flash_block=None,
+            layer_scan: bool = False, opt_state_dtype=None,
+            bf16_masters: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -81,7 +83,7 @@ def measure(batch: int, t: int, vocab: int, hidden: int, layers: int,
     from bigdl_tpu.nn.criterion import ClassNLLCriterion
     from bigdl_tpu.nn.criterion_more import TimeDistributedMaskCriterion
     from bigdl_tpu.optim.optim_method import Adam
-    from bigdl_tpu.optim.train_step import make_train_step
+    from bigdl_tpu.optim.train_step import cast_floats, make_train_step
     from bigdl_tpu.utils.random_gen import RNG
 
     RNG.set_seed(7)
@@ -89,7 +91,8 @@ def measure(batch: int, t: int, vocab: int, hidden: int, layers: int,
                        n_layers=layers, max_len=t, remat=remat,
                        output="logits" if fused_ce else "logprobs",
                        embed_grad_matmul=embed_matmul,
-                       use_flash=use_flash)
+                       use_flash=use_flash, flash_block=flash_block,
+                       layer_scan=layer_scan)
     if fused_ce:
         from bigdl_tpu.nn.criterion_more import MaskedSoftmaxCECriterion
 
@@ -97,7 +100,8 @@ def measure(batch: int, t: int, vocab: int, hidden: int, layers: int,
     else:
         crit = TimeDistributedMaskCriterion(ClassNLLCriterion(),
                                             padding_value=0)
-    optim = Adam(learning_rate=lr)
+    optim = Adam(learning_rate=lr, state_dtype=opt_state_dtype,
+                 stochastic_rounding=bf16_masters)
 
     lm._ensure_params()
     step = jax.jit(make_train_step(lm, crit, optim,
@@ -108,11 +112,19 @@ def measure(batch: int, t: int, vocab: int, hidden: int, layers: int,
                                     size=(batch, t)).astype(np.int32))
     y = jax.device_put(rng.integers(1, vocab + 1,
                                     size=(batch, t)).astype(np.float32))
-    params, ms = jax.device_put(lm.params), lm.state
+    host_params = lm.params
+    if bf16_masters:
+        # the weights ARE the bf16 tensors (no fp32 master copy);
+        # stochastic rounding keeps the sub-ulp Adam updates unbiased
+        host_params = cast_floats(host_params, jnp.bfloat16)
+    params, ms = jax.device_put(host_params), lm.state
     opt_state = jax.device_put(optim.init_state(params))
     key = jax.random.PRNGKey(0)
 
+    c0 = time.perf_counter()
     params, opt_state, ms, loss = step(params, opt_state, ms, key, x, y)
+    float(loss)
+    compile_s = time.perf_counter() - c0
     for _ in range(2):
         params, opt_state, ms, loss = step(params, opt_state, ms, key, x, y)
     float(loss)
@@ -128,6 +140,9 @@ def measure(batch: int, t: int, vocab: int, hidden: int, layers: int,
     return {
         "batch": batch, "t": t, "remat": remat, "use_flash": use_flash,
         "fused_ce": fused_ce, "embed_matmul": embed_matmul,
+        "flash_block": flash_block, "layer_scan": layer_scan,
+        "opt_state_dtype": opt_state_dtype, "bf16_masters": bf16_masters,
+        "compile_s": round(compile_s, 1),
         "step_ms": round(1000 * dt / iters, 1),
         "tokens_per_sec": round(tokens_per_sec, 0),
         "mfu": round(tokens_per_sec * fpt / peak, 4),
@@ -146,6 +161,16 @@ def main(argv=None) -> None:
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--sweep", action="store_true",
                    help="grid over batch x flash x remat")
+    p.add_argument("--sweep_block", action="store_true",
+                   help="in-model flash block-size sweep at the best config")
+    p.add_argument("--sweep_opt", action="store_true",
+                   help="optimizer-state dtype rows: fp32 / bf16 slots / "
+                        "bf16 masters + stochastic rounding")
+    p.add_argument("--sweep_remat_batch", action="store_true",
+                   help="remat x batch frontier beyond B=8")
+    p.add_argument("--layer_scan", action="store_true",
+                   help="one row with the lax.scan layer stack (vs the "
+                        "default unrolled row for compile + step time)")
     args = p.parse_args(argv)
 
     n = total_params(args.vocab, args.hidden, args.layers, args.seqLen)
@@ -155,26 +180,48 @@ def main(argv=None) -> None:
                       "flops_per_token": fpt,
                       "peak_bf16": detect_peak()}))
 
+    # every row: (extra-kwargs dict) merged onto the canonical best config
+    # (flash, no remat, fused CE)
+    base = dict(batch=args.batch, t=args.seqLen, vocab=args.vocab,
+                hidden=args.hidden, layers=args.layers, heads=args.heads,
+                remat=False, use_flash="auto", iters=args.iters)
+    rows: list = []
     if args.sweep:
         # "always"/"never" (not "auto") so each sweep row's label states
         # its path unconditionally — "auto" also means flash on TPU, so
         # auto-vs-always rows would differ only by run noise
-        grid = [(b, fl, rm)
-                for b in (4, 8, 16)
-                for fl in ("never", "always")
-                for rm in (True, False)]
-    else:
+        rows += [dict(batch=b, use_flash=fl, remat=rm)
+                 for b in (4, 8, 16)
+                 for fl in ("never", "always")
+                 for rm in (True, False)]
+    if args.sweep_block:
+        rows += [dict(flash_block=blk)
+                 for blk in (None, 128, 256, 512, 1024)]
+    if args.sweep_opt:
+        rows += [dict(),                                    # fp32 baseline
+                 dict(opt_state_dtype="bf16"),              # bf16 slots
+                 dict(opt_state_dtype="bf16",
+                      bf16_masters=True)]                   # + bf16 masters
+    if args.sweep_remat_batch:
+        rows += [dict(batch=b, remat=rm)
+                 for rm in (False, True)
+                 for b in (8, 12, 16, 24, 32)]
+    if args.layer_scan:
+        rows += [dict(layer_scan=False), dict(layer_scan=True)]
+    if not rows:
         # the measured best single-chip operating point (PERF_ANALYSIS_r4,
         # incl. the correction note): FLASH attention, no remat, fused CE
         # + logits output (measure() defaults)
-        grid = [(args.batch, "auto", False)]
-    for b, fl, rm in grid:
+        rows = [dict()]
+    for extra in rows:
+        cfg = {**base, **extra}
         try:
-            res = measure(b, args.seqLen, args.vocab, args.hidden,
-                          args.layers, args.heads, remat=rm, use_flash=fl,
-                          iters=args.iters)
+            res = measure(**cfg)
         except Exception as e:  # OOM configs report instead of aborting
-            res = {"batch": b, "use_flash": fl, "remat": rm,
+            res = {**{k: v for k, v in cfg.items()
+                      if k in ("batch", "use_flash", "remat", "flash_block",
+                               "layer_scan", "opt_state_dtype",
+                               "bf16_masters")},
                    "error": repr(e)[:160]}
         print(json.dumps(res))
 

@@ -92,13 +92,14 @@ class TransformerBlock(Container):
 
     def __init__(self, hidden_size: int, n_heads: int, mlp_ratio: int = 4,
                  causal: bool = True, sequence_parallel: Optional[str] = None,
-                 sp_axis: str = "seq", use_flash: str = "auto") -> None:
+                 sp_axis: str = "seq", use_flash: str = "auto",
+                 flash_block: Optional[int] = None) -> None:
         super().__init__()
         self.ln1 = LayerNorm(hidden_size)
         self.attn = MultiHeadAttention(
             hidden_size, n_heads, causal=causal,
             sequence_parallel=sequence_parallel, sp_axis=sp_axis,
-            use_flash=use_flash)
+            use_flash=use_flash, flash_block=flash_block)
         self.ln2 = LayerNorm(hidden_size)
         self.fc1 = Linear(hidden_size, mlp_ratio * hidden_size)
         self.fc2 = Linear(mlp_ratio * hidden_size, hidden_size)
@@ -119,6 +120,64 @@ class TransformerBlock(Container):
         return x + run(4, h), state
 
 
+class ScanBlocks(Container):
+    """``n_layers`` copies of one :class:`TransformerBlock` applied via
+    ``lax.scan`` over a stacked-params pytree (every leaf gains a leading
+    ``(n_layers,)`` axis).
+
+    The alternative lowering to ``n_layers`` unrolled blocks: ONE compiled
+    block program is iterated instead of ``n_layers`` inlined copies, so
+    compile time is O(1) in depth and the weight working set cycles
+    through the same HBM region each iteration. Step-time impact at LM
+    scale is measured in benchmarks/llm_mfu_bench.py (``--layer_scan``) —
+    scan forbids cross-layer fusion, so this trades peak step time for
+    compile time; see PERF_ANALYSIS_r5.md for the numbers.
+
+    Holds exactly one child (the template block); ``init_params`` stacks
+    per-layer inits so each layer starts at a DIFFERENT draw, exactly like
+    the unrolled construction."""
+
+    def __init__(self, block: TransformerBlock, n_layers: int) -> None:
+        super().__init__()
+        if n_layers <= 0:
+            raise ValueError(f"n_layers must be positive, got {n_layers}")
+        self.n_layers = int(n_layers)
+        self.add(block)
+
+    def init_params(self, rng):
+        import jax
+
+        block = self.modules[0]
+        keys = jax.random.split(rng, self.n_layers)
+        per_layer = [block.init_params(k) for k in keys]
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jax.numpy.stack(leaves), *per_layer)
+        return {self._child_key(0): stacked}
+
+    def unstacked_params(self, params):
+        """Per-layer list view of the stacked params (decode-step /
+        export interop — the inverse of init_params' stacking)."""
+        import jax
+
+        stacked = params[self._child_key(0)]
+        return [jax.tree_util.tree_map(lambda a: a[i], stacked)
+                for i in range(self.n_layers)]
+
+    def apply(self, params, input, state=None, training=False, rng=None):
+        from jax import lax
+
+        block = self.modules[0]
+        stacked = params[self._child_key(0)]
+
+        def body(x, layer_params):
+            out, _ = block.apply(layer_params, x, {}, training=training,
+                                 rng=None)
+            return out, None
+
+        out, _ = lax.scan(body, input, stacked)
+        return out, state
+
+
 def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
                   n_layers: int = 4, max_len: int = 1024,
                   mlp_ratio: int = 4, causal: bool = True,
@@ -127,7 +186,9 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
                   sp_axis: str = "seq",
                   output: str = "logprobs",
                   embed_grad_matmul: bool = False,
-                  use_flash: str = "auto") -> Sequential:
+                  use_flash: str = "auto",
+                  flash_block: Optional[int] = None,
+                  layer_scan: bool = False) -> Sequential:
     """GPT-style decoder LM over 1-based token ids ``(B, T)`` →
     per-position log-probs ``(B, T, vocab)``.
 
@@ -155,6 +216,15 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
     starved by), although the STANDALONE kernel microbench
     (flash_bench.py) only breaks even near 8k. Measured in
     llm_mfu_bench.py; ``"never"`` forces the dense path.
+
+    ``flash_block`` overrides the flash kernel's VMEM tile length
+    (multiple of 128; None = auto, measured optimal — the in-model sweep
+    lives in llm_mfu_bench.py ``--sweep_block``).
+
+    ``layer_scan=True`` lowers the block stack as ONE ``lax.scan`` over
+    stacked per-layer params (:class:`ScanBlocks`) instead of
+    ``n_layers`` unrolled copies — O(1) compile time in depth; step-time
+    tradeoff measured in PERF_ANALYSIS_r5.md.
     """
     if output not in ("logprobs", "logits"):
         raise ValueError(f"unknown output {output!r}")
@@ -169,11 +239,19 @@ def TransformerLM(vocab_size: int, hidden_size: int = 256, n_heads: int = 8,
     model.add(PositionEmbedding(
         max_len, hidden_size,
         sp_axis=sp_axis if sequence_parallel else None))
-    for _ in range(n_layers):
-        block = TransformerBlock(hidden_size, n_heads, mlp_ratio, causal,
-                                 sequence_parallel, sp_axis,
-                                 use_flash=use_flash)
-        model.add(Remat(block) if remat else block)
+    def make_block():
+        return TransformerBlock(hidden_size, n_heads, mlp_ratio, causal,
+                                sequence_parallel, sp_axis,
+                                use_flash=use_flash,
+                                flash_block=flash_block)
+
+    if layer_scan:
+        block = make_block()
+        model.add(ScanBlocks(Remat(block) if remat else block, n_layers))
+    else:
+        for _ in range(n_layers):
+            block = make_block()
+            model.add(Remat(block) if remat else block)
     model.add(LayerNorm(hidden_size))
     model.add(Linear(hidden_size, vocab_size))
     if output == "logprobs":
@@ -276,6 +354,16 @@ def make_decode_step(model: Sequential):
         inner, bp = m, P[model._child_key(i)]
         if isinstance(m, Remat):
             inner, bp = m.modules[0], bp[m._child_key(0)]
+        if isinstance(inner, ScanBlocks):
+            # layer_scan models store one stacked params tree — unstack
+            # into per-layer views so decode runs the same unrolled loop
+            tmpl = inner.modules[0]
+            for lp in inner.unstacked_params(bp):
+                t2, p2 = tmpl, lp
+                if isinstance(t2, Remat):
+                    t2, p2 = t2.modules[0], p2[t2._child_key(0)]
+                blocks.append((t2, p2))
+            continue
         if isinstance(inner, TransformerBlock):
             blocks.append((inner, bp))
     from bigdl_tpu.nn.activations import LogSoftMax

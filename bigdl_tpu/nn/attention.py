@@ -23,13 +23,15 @@ from bigdl_tpu.nn.module import TensorModule
 
 
 class MultiHeadAttention(TensorModule):
-    # class-level default so instances deserialized from pre-use_flash
+    # class-level defaults so instances deserialized from pre-use_flash
     # checkpoints (decoder bypasses __init__) still forward correctly
     use_flash = "auto"
+    flash_block = None
 
     def __init__(self, hidden_size: int, n_heads: int, causal: bool = False,
                  sequence_parallel: Optional[str] = None,
-                 sp_axis: str = "seq", use_flash: str = "auto") -> None:
+                 sp_axis: str = "seq", use_flash: str = "auto",
+                 flash_block: Optional[int] = None) -> None:
         super().__init__()
         if hidden_size % n_heads:
             raise ValueError(f"hidden {hidden_size} % heads {n_heads} != 0")
@@ -53,6 +55,15 @@ class MultiHeadAttention(TensorModule):
         # local path kernel choice: the Pallas flash kernel
         # (bigdl_tpu.ops.flash_attention) on TPU, dense jnp otherwise
         self.use_flash = use_flash
+        # VMEM tile length for the local flash path (None = _auto_block's
+        # min(1024, T) — measured optimal in-model at T=2048, see
+        # benchmarks/PERF_ANALYSIS_r5.md block sweep); exposed so the
+        # sweep is runnable in-model rather than only standalone
+        if flash_block is not None and (flash_block % 128 or flash_block <= 0):
+            raise ValueError(
+                f"flash_block must be a positive multiple of 128, "
+                f"got {flash_block}")
+        self.flash_block = flash_block
 
     def init_params(self, rng):
         import jax
@@ -111,7 +122,8 @@ class MultiHeadAttention(TensorModule):
         elif flash_ok:
             from bigdl_tpu.ops import flash_attention
 
-            out = flash_attention(q, k, v, causal=self.causal)
+            out = flash_attention(q, k, v, causal=self.causal,
+                                  block=self.flash_block)
         else:
             out = attention(q, k, v, causal=self.causal)
         out = out.reshape(B, T, self.hidden_size)

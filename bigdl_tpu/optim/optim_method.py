@@ -299,40 +299,120 @@ class SGD(OptimMethod):
         return new_params, new_state
 
 
+def stochastic_round(x, dtype, key):
+    """Unbiased fp32 → bf16 cast: add uniform 16-bit noise below the kept
+    mantissa, truncate (E[result] = x, unlike round-to-nearest whose bias
+    accumulates over thousands of tiny Adam updates when the weights
+    themselves are stored bf16). Non-finite values pass through the
+    deterministic cast — adding noise to inf/nan bit patterns corrupts
+    them."""
+    import jax
+    import jax.numpy as jnp
+
+    if jnp.dtype(dtype) != jnp.bfloat16:
+        raise ValueError("stochastic_round targets bfloat16 storage")
+    xf = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    noise = jax.random.bits(key, xf.shape, jnp.uint16).astype(jnp.uint32)
+    rounded = jax.lax.bitcast_convert_type(
+        (bits + noise) & jnp.uint32(0xFFFF0000), jnp.float32)
+    return jnp.where(jnp.isfinite(xf), rounded,
+                     xf).astype(jnp.bfloat16)
+
+
 class Adam(OptimMethod):
+    """Torch-convention Adam.
+
+    ``state_dtype`` stores the m/v slot buffers in a reduced dtype
+    (``"bf16"``) — the update math still runs fp32 (cast-in/cast-out), so
+    this is purely an HBM-traffic/footprint lever: 2× less slot traffic
+    per step, at bf16's ~3-decimal-digit slot precision (measured on the
+    137M-param LM in benchmarks/llm_mfu_bench.py ``--sweep_opt``).
+
+    ``stochastic_rounding=True`` makes the parameter write-back unbiased
+    when the PARAMS themselves are stored bf16 ("bf16 masters"): the
+    fp32 update result is stochastically rounded into the bf16 leaf
+    (plain round-to-nearest silently drops updates smaller than half the
+    param's ulp — the classic bf16-master failure). Ignored for fp32
+    params. The noise key derives from the step counter, so the update
+    stays a pure function of (grads, state, params)."""
+
     def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
-                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8) -> None:
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
+                 state_dtype: Optional[str] = None,
+                 stochastic_rounding: bool = False) -> None:
         super().__init__()
         self.learning_rate = learning_rate
         self.learning_rate_decay = learning_rate_decay
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        if state_dtype not in (None, "bf16", "bfloat16"):
+            raise ValueError(
+                f"state_dtype must be None or 'bf16', got {state_dtype!r}")
+        self.state_dtype = state_dtype
+        self.stochastic_rounding = bool(stochastic_rounding)
+
+    def _slot_dtype(self, leaf_dtype):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.state_dtype else leaf_dtype
 
     def init_state(self, params):
         import jax.numpy as jnp
 
+        def zeros(p):
+            return jnp.zeros(jnp.shape(p), self._slot_dtype(p.dtype))
+
         return {
             "neval": jnp.zeros((), jnp.int32),
-            "m": _tree_map(jnp.zeros_like, params),
-            "v": _tree_map(jnp.zeros_like, params),
+            "m": _tree_map(zeros, params),
+            "v": _tree_map(zeros, params),
         }
 
     def update(self, grads, state, params):
+        import jax
         import jax.numpy as jnp
 
         t = state["neval"] + 1
         clr = self.learning_rate / (1.0 + state["neval"] * self.learning_rate_decay)
-        m = _tree_map(lambda m_, g: self.beta1 * m_ + (1 - self.beta1) * g,
-                      state["m"], grads)
-        v = _tree_map(lambda v_, g: self.beta2 * v_ + (1 - self.beta2) * g * g,
-                      state["v"], grads)
+        # slot math in fp32 regardless of storage dtype (bf16 squares
+        # underflow at ~1e-20 gradient magnitude; fp32 accumulate is free
+        # on the VPU)
+        m32 = _tree_map(
+            lambda m_, g: self.beta1 * m_.astype(jnp.float32)
+            + (1 - self.beta1) * g.astype(jnp.float32),
+            state["m"], grads)
+        v32 = _tree_map(
+            lambda v_, g: self.beta2 * v_.astype(jnp.float32)
+            + (1 - self.beta2) * jnp.square(g.astype(jnp.float32)),
+            state["v"], grads)
         bc1 = 1.0 - self.beta1 ** t.astype(jnp.float32)
         bc2 = 1.0 - self.beta2 ** t.astype(jnp.float32)
-        new_params = _tree_map(
-            lambda p, m_, v_: p - clr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
-            params, m, v,
-        )
+
+        def step_leaf(p, m_, v_):
+            return p.astype(jnp.float32) - clr * (m_ / bc1) / (
+                jnp.sqrt(v_ / bc2) + self.epsilon)
+
+        new32 = _tree_map(step_leaf, params, m32, v32)
+        if self.stochastic_rounding:
+            leaves, treedef = jax.tree_util.tree_flatten(new32)
+            p_leaves = jax.tree_util.tree_leaves(params)
+            key = jax.random.PRNGKey(0)
+            key = jax.random.fold_in(key, t)
+            out = []
+            for i, (n, p) in enumerate(zip(leaves, p_leaves)):
+                if jnp.dtype(p.dtype) == jnp.bfloat16:
+                    out.append(stochastic_round(
+                        n, jnp.bfloat16, jax.random.fold_in(key, i)))
+                else:
+                    out.append(n.astype(p.dtype))
+            new_params = jax.tree_util.tree_unflatten(treedef, out)
+        else:
+            new_params = _tree_map(
+                lambda n, p: n.astype(p.dtype), new32, params)
+        m = _tree_map(lambda n, s: n.astype(s.dtype), m32, state["m"])
+        v = _tree_map(lambda n, s: n.astype(s.dtype), v32, state["v"])
         return new_params, {"neval": t, "m": m, "v": v}
 
 

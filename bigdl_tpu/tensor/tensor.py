@@ -34,6 +34,12 @@ def _unwrap(x: Any):
     return x.data if isinstance(x, Tensor) else x
 
 
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
 def _resolve_dim(dim: int, ndim: int) -> int:
     """1-based positive dims; negative dims count from the end (numpy
     style); 0 is invalid in the 1-based convention."""
@@ -1974,6 +1980,303 @@ class Tensor:
         self.data = self.scatter(dim, index, src).data
         return self
 
+    # -- tranche 5 (final): the remaining torch/reference spellings -------
+    # (reference ``tensor/Tensor.scala`` long tail — the JVM-only residue
+    # is documented as an exclusion list in COVERAGE.md)
+
+    def value(self) -> float:
+        """Scalar read of a 1-element tensor (reference ``value()``)."""
+        if self.data.size != 1:
+            raise ValueError(
+                f"value() needs a 1-element tensor, got shape "
+                f"{tuple(self.data.shape)}")
+        return float(self.data.reshape(()))
+
+    def acosh(self):
+        return self._np_el("arccosh")
+
+    def asinh(self):
+        return self._np_el("arcsinh")
+
+    def atanh(self):
+        return self._np_el("arctanh")
+
+    def positive(self) -> "Tensor":
+        return Tensor(self.data)
+
+    def swapaxes(self, axis0: int, axis1: int) -> "Tensor":
+        """0-based numpy/torch.swapaxes spelling (the 1-based heritage
+        form is ``transpose``)."""
+        import jax.numpy as jnp
+
+        return Tensor(jnp.swapaxes(self.data, axis0, axis1))
+
+    def swapdims(self, dim0: int, dim1: int) -> "Tensor":
+        return self.swapaxes(dim0, dim1)
+
+    def unbind(self, dim: int = 1):
+        """Tuple of views with 1-based ``dim`` removed (torch.unbind)."""
+        ax = _resolve_dim(dim, self.data.ndim)
+        n = self.data.shape[ax]
+        import jax.numpy as jnp
+
+        return tuple(Tensor(jnp.take(self.data, i, axis=ax))
+                     for i in range(n))
+
+    def unflatten(self, dim: int, sizes) -> "Tensor":
+        ax = _resolve_dim(dim, self.data.ndim)
+        shape = list(self.data.shape)
+        new_shape = shape[:ax] + list(sizes) + shape[ax + 1:]
+        return Tensor(self.data.reshape(new_shape))
+
+    def diagonal(self, offset: int = 0, dim1: int = 1,
+                 dim2: int = 2) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.diagonal(
+            self.data, offset=offset,
+            axis1=_resolve_dim(dim1, self.data.ndim),
+            axis2=_resolve_dim(dim2, self.data.ndim)))
+
+    def diagflat(self, offset: int = 0) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.diagflat(self.data, k=offset))
+
+    def diag_embed(self, offset: int = 0) -> "Tensor":
+        """Batched (n, n) diagonal matrices from the last axis
+        (torch.diag_embed, dim1/dim2 fixed at the trailing pair,
+        n = last_dim + |offset|)."""
+        import jax.numpy as jnp
+
+        x = self.data
+        n = x.shape[-1] + abs(offset)
+        eye = jnp.eye(n, k=offset, dtype=x.dtype)
+        # row r of the output carries x[r - max(0, -offset)] on its one
+        # nonzero column; pad x so that index aligns with the row index
+        pad = [(0, 0)] * (x.ndim - 1) + [(max(0, -offset), max(0, offset))]
+        xpad = jnp.pad(x, pad)
+        return Tensor(eye * xpad[..., :, None])
+
+    def cummax(self, dim: int = 1):
+        """(values, 1-based indices of the latest max) along ``dim``
+        (host-eager — accumulate has no jnp ufunc form)."""
+        ax = _resolve_dim(dim, self.data.ndim)
+        a = np.asarray(self.data)
+        vals = np.maximum.accumulate(a, axis=ax)
+        pos = np.arange(a.shape[ax]).reshape(
+            [-1 if i == ax else 1 for i in range(a.ndim)])
+        idx = np.maximum.accumulate(np.where(a == vals, pos, 0), axis=ax)
+        return Tensor(vals), Tensor((idx + 1).astype(np.int32))
+
+    def cummin(self, dim: int = 1):
+        ax = _resolve_dim(dim, self.data.ndim)
+        a = np.asarray(self.data)
+        vals = np.minimum.accumulate(a, axis=ax)
+        pos = np.arange(a.shape[ax]).reshape(
+            [-1 if i == ax else 1 for i in range(a.ndim)])
+        idx = np.maximum.accumulate(np.where(a == vals, pos, 0), axis=ax)
+        return Tensor(vals), Tensor((idx + 1).astype(np.int32))
+
+    def logcumsumexp(self, dim: int = 1) -> "Tensor":
+        ax = _resolve_dim(dim, self.data.ndim)
+        return Tensor(np.logaddexp.accumulate(
+            np.asarray(self.data, np.float64), axis=ax).astype(
+                np.asarray(self.data).dtype))
+
+    def logsumexp(self, dim: Optional[int] = None):
+        import jax.scipy.special as jsp
+
+        if dim is None:
+            return float(jsp.logsumexp(self.data))
+        return Tensor(jsp.logsumexp(
+            self.data, axis=_resolve_dim(dim, self.data.ndim)))
+
+    def nansum(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.nansum(self.data))
+        return Tensor(jnp.nansum(self.data,
+                                 axis=_resolve_dim(dim, self.data.ndim)))
+
+    def nanmean(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.nanmean(self.data))
+        return Tensor(jnp.nanmean(self.data,
+                                  axis=_resolve_dim(dim, self.data.ndim)))
+
+    def nanmedian(self, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            return float(jnp.nanmedian(self.data))
+        return Tensor(jnp.nanmedian(self.data,
+                                    axis=_resolve_dim(dim, self.data.ndim)))
+
+    def quantile(self, q, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            out = jnp.quantile(self.data, q)
+            return float(out) if jnp.ndim(out) == 0 else Tensor(out)
+        return Tensor(jnp.quantile(self.data, q,
+                                   axis=_resolve_dim(dim, self.data.ndim)))
+
+    def nanquantile(self, q, dim: Optional[int] = None):
+        import jax.numpy as jnp
+
+        if dim is None:
+            out = jnp.nanquantile(self.data, q)
+            return float(out) if jnp.ndim(out) == 0 else Tensor(out)
+        return Tensor(jnp.nanquantile(
+            self.data, q, axis=_resolve_dim(dim, self.data.ndim)))
+
+    def std_mean(self, dim: Optional[int] = None, unbiased: bool = True):
+        return self.std(dim, unbiased), self.mean(dim)
+
+    def var_mean(self, dim: Optional[int] = None, unbiased: bool = True):
+        return self.var(dim, unbiased), self.mean(dim)
+
+    def gcd(self, other) -> "Tensor":
+        # host numpy in int64: under JAX's default x64-off config a jnp
+        # int64 cast silently truncates to int32 (gcd itself never
+        # exceeds its inputs, so no overflow guard needed)
+        return Tensor(np.gcd(np.asarray(self.data, np.int64),
+                             np.asarray(_unwrap(other), np.int64)))
+
+    def lcm(self, other) -> "Tensor":
+        out = np.lcm(np.asarray(self.data, np.int64),
+                     np.asarray(_unwrap(other), np.int64))
+        if np.any(np.abs(out) > np.iinfo(np.int32).max) and \
+                not _x64_enabled():
+            raise OverflowError(
+                "lcm result exceeds int32 and JAX x64 is disabled — the "
+                "facade's device storage would silently truncate it; "
+                "enable jax.config.update('jax_enable_x64', True) or "
+                "compute on to_numpy()")
+        return Tensor(out)
+
+    def ldexp(self, other) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.ldexp(self.data,
+                                jnp.asarray(_unwrap(other), jnp.int32)))
+
+    def frexp(self):
+        m, e = np.frexp(np.asarray(self.data))
+        return Tensor(m), Tensor(e.astype(np.int32))
+
+    def i0(self) -> "Tensor":
+        import jax.scipy.special as jsp
+
+        return Tensor(jsp.i0(self.data))
+
+    def mvlgamma(self, p: int) -> "Tensor":
+        """Multivariate log-gamma (torch.mvlgamma):
+        ``p(p-1)/4·ln π + Σ_{j=1..p} lgamma(x + (1-j)/2)``."""
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+
+        x = self.data
+        js = jnp.arange(1, p + 1, dtype=jnp.float32)
+        terms = jsp.gammaln(x[..., None] + (1.0 - js) / 2.0)
+        return Tensor(terms.sum(-1) + p * (p - 1) / 4.0 * jnp.log(jnp.pi))
+
+    def polygamma(self, n: int) -> "Tensor":
+        from scipy.special import polygamma as _pg
+
+        return Tensor(np.asarray(_pg(n, np.asarray(self.data)),
+                                 np.asarray(self.data).dtype))
+
+    def trapz(self, dx: float = 1.0, dim: int = -1) -> "Tensor":
+        ax = _resolve_dim(dim, self.data.ndim)
+        trap = getattr(np, "trapezoid", None) or np.trapz
+        out = trap(np.asarray(self.data), dx=dx, axis=ax)
+        return float(out) if np.ndim(out) == 0 else Tensor(out)
+
+    def vdot(self, other) -> float:
+        return float(np.vdot(np.asarray(self.data),
+                             np.asarray(_unwrap(other))))
+
+    def histogram(self, bins: int = 100, min_v: Optional[float] = None,
+                  max_v: Optional[float] = None):
+        """(hist, bin_edges) — torch.histogram (histc returns counts
+        only)."""
+        a = np.asarray(self.data).reshape(-1)
+        rng = None
+        if min_v is not None or max_v is not None:
+            rng = (min_v if min_v is not None else float(a.min()),
+                   max_v if max_v is not None else float(a.max()))
+        h, edges = np.histogram(a, bins=bins, range=rng)
+        # edges stay floating even for integer inputs — casting back to
+        # the input dtype truncates bin boundaries into duplicates
+        return Tensor(h.astype(np.float32)), Tensor(edges.astype(np.float32))
+
+    def signbit(self) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.signbit(self.data))
+
+    def rsub(self, other, alpha: float = 1.0) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.asarray(_unwrap(other)) - alpha * self.data)
+
+    def matrix_power(self, n: int) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.linalg.matrix_power(self.data, n))
+
+    def pinverse(self, rcond: float = 1e-15) -> "Tensor":
+        import jax.numpy as jnp
+
+        return Tensor(jnp.linalg.pinv(self.data, rtol=rcond))
+
+    def slogdet(self):
+        import jax.numpy as jnp
+
+        sign, logabs = jnp.linalg.slogdet(self.data)
+        return float(sign), float(logabs)
+
+    def cholesky(self, upper: bool = False) -> "Tensor":
+        """torch.cholesky spelling (lower by default; ``potrf`` is the
+        reference spelling, upper by default)."""
+        return self.potrf(upper=upper)
+
+    def lstsq(self, b) -> "Tensor":
+        return self.gels(b)
+
+    def masked_scatter(self, mask, source) -> "Tensor":
+        """Fill ``mask``-true positions with consecutive ``source``
+        elements (host-eager: data-dependent layout)."""
+        a = np.asarray(self.data).copy()
+        # broadcast first (torch semantics), so a broadcastable mask
+        # counts — and consumes source for — every EXPANDED position
+        m = np.broadcast_to(np.asarray(_unwrap(mask), bool), a.shape)
+        src = np.asarray(_unwrap(source)).reshape(-1)
+        n = int(m.sum())
+        if src.size < n:
+            raise ValueError(
+                f"masked_scatter: source has {src.size} elements for "
+                f"{n} masked positions")
+        a[m] = src[:n]
+        return Tensor(a)
+
+    def index_put(self, indices, values) -> "Tensor":
+        """Write ``values`` at 1-based coordinate arrays (one per dim —
+        the facade's 1-based heritage convention, like ``index_fill``)."""
+        import jax.numpy as jnp
+
+        idx = tuple(jnp.asarray(_unwrap(i), jnp.int32) - 1
+                    for i in indices)
+        return Tensor(self.data.at[idx].set(
+            jnp.asarray(_unwrap(values), self.data.dtype)))
+
+    def narrow_copy(self, dim: int, start: int, length: int) -> "Tensor":
+        return self.narrow(dim, start, length).clone()
 
     def __repr__(self) -> str:
         return f"Tensor(shape={tuple(self.data.shape)}, dtype={self.data.dtype})"
@@ -2019,6 +2322,30 @@ def _make_rebinder(name):
 for _viewer in ("t", "transpose", "unsqueeze"):
     setattr(Tensor, _viewer + "_", _make_rebinder(_viewer))
 del _viewer
+
+# tranche 5: torch's "spelled-out" aliases (same objects — both names are
+# torch-legit and ported user code uses either; in-place semantics follow
+# the aliased method)
+for _alias, _target in (("arccos", "acos"), ("arcsin", "asin"),
+                        ("arctan", "atan"), ("arctan2", "atan2"),
+                        ("arccosh", "acosh"), ("arcsinh", "asinh"),
+                        ("arctanh", "atanh"), ("absolute", "abs"),
+                        ("divide", "div"), ("multiply", "mul"),
+                        ("subtract", "sub"), ("fix", "trunc"),
+                        ("greater", "gt"), ("greater_equal", "ge"),
+                        ("less", "lt"), ("less_equal", "le"),
+                        ("not_equal", "ne"), ("moveaxis", "movedim"),
+                        ("concat", "cat"), ("concatenate", "cat")):
+    # __dict__ (not getattr) so staticmethod descriptors (cat) survive
+    setattr(Tensor, _alias, Tensor.__dict__[_target])
+del _alias, _target
+
+# tranche 5 underscore variants for the in-place-under-plain-name family
+for _plain in ("acos", "asin", "atan", "sinh", "cosh", "square",
+               "exp2", "lgamma", "digamma", "erfinv", "acosh", "asinh",
+               "atanh", "cinv"):
+    setattr(Tensor, _plain + "_", getattr(Tensor, _plain))
+del _plain
 
 
 def _tensor_flatten(t: Tensor):

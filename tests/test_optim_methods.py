@@ -240,3 +240,96 @@ def test_rmsprop_adagrad_adadelta_trajectories_vs_torch(rng):
         np.testing.assert_allclose(
             np.asarray(params["x"]), pt.detach().numpy(), atol=2e-3,
             err_msg=type(ours).__name__)
+
+
+def test_adam_bf16_state_tracks_fp32():
+    """state_dtype='bf16' halves the slot-buffer footprint; the update
+    math stays fp32 cast-in/cast-out, so trajectories track the fp32
+    optimizer to bf16 slot precision on a quadratic."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import Adam
+
+    w32 = {"w": jnp.asarray([1.0, -2.0, 3.0], jnp.float32)}
+    wbf = {"w": jnp.asarray([1.0, -2.0, 3.0], jnp.float32)}
+    o32, obf = Adam(learning_rate=0.05), Adam(learning_rate=0.05,
+                                              state_dtype="bf16")
+    s32, sbf = o32.init_state(w32), obf.init_state(wbf)
+    assert sbf["m"]["w"].dtype == jnp.bfloat16
+    assert sbf["v"]["w"].dtype == jnp.bfloat16
+    for _ in range(50):
+        g32 = {"w": 2.0 * w32["w"]}
+        gbf = {"w": 2.0 * wbf["w"]}
+        w32, s32 = o32.update(g32, s32, w32)
+        wbf, sbf = obf.update(gbf, sbf, wbf)
+    assert wbf["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(wbf["w"]), np.asarray(w32["w"]),
+                               atol=0.02)
+    # both converged toward 0
+    assert np.abs(np.asarray(wbf["w"])).max() < 1.0
+
+
+def test_stochastic_round_unbiased_and_exact():
+    """stochastic_round is exact on bf16-representable values and unbiased
+    in expectation between them."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim.optim_method import stochastic_round
+
+    # exact: a bf16-representable value never moves
+    x = jnp.asarray([1.0, -0.5, 0.0, 2.0], jnp.float32)
+    for seed in range(5):
+        out = stochastic_round(x, jnp.bfloat16, jax.random.PRNGKey(seed))
+        np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                      np.asarray(x))
+
+    # unbiased: 1 + 0.3*ulp rounds up ~30% of the time
+    import ml_dtypes
+
+    one = np.float32(1.0)
+    ulp = float(np.spacing(ml_dtypes.bfloat16(1.0)))
+    val = jnp.full((4096,), one + 0.3 * ulp, jnp.float32)
+    out = stochastic_round(val, jnp.bfloat16,
+                           jax.random.PRNGKey(123)).astype(jnp.float32)
+    frac_up = float((np.asarray(out) > 1.0).mean())
+    assert 0.25 < frac_up < 0.35, frac_up
+    # mean preserved to ~ulp/sqrt(N)
+    np.testing.assert_allclose(float(np.asarray(out).mean()),
+                               float(one + 0.3 * ulp), rtol=3e-4)
+
+    # non-finite passthrough
+    bad = jnp.asarray([np.inf, -np.inf, np.nan], jnp.float32)
+    outb = np.asarray(stochastic_round(bad, jnp.bfloat16,
+                                       jax.random.PRNGKey(0)),
+                      np.float32)
+    assert np.isinf(outb[0]) and np.isinf(outb[1]) and np.isnan(outb[2])
+
+
+def test_adam_bf16_masters_with_sr_converges():
+    """bf16 master weights + stochastic rounding keep making progress on
+    updates far below the bf16 ulp — the regime where round-to-nearest
+    stalls completely."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim import Adam
+
+    # lr tuned so per-step updates are ~1e-4 relative to w=8.0 (bf16 ulp
+    # at 8.0 is 0.0625 — each update is ~1/600 ulp)
+    w_sr = {"w": jnp.full((512,), 8.0, jnp.bfloat16)}
+    w_rn = {"w": jnp.full((512,), 8.0, jnp.bfloat16)}
+    sr = Adam(learning_rate=1e-4, stochastic_rounding=True)
+    rn = Adam(learning_rate=1e-4)
+    s_sr, s_rn = sr.init_state(w_sr), rn.init_state(w_rn)
+    for _ in range(200):
+        g_sr = {"w": w_sr["w"].astype(jnp.float32)}
+        g_rn = {"w": w_rn["w"].astype(jnp.float32)}
+        w_sr, s_sr = sr.update(g_sr, s_sr, w_sr)
+        w_rn, s_rn = rn.update(g_rn, s_rn, w_rn)
+    assert w_sr["w"].dtype == jnp.bfloat16
+    moved_sr = 8.0 - float(np.asarray(w_sr["w"], np.float32).mean())
+    moved_rn = 8.0 - float(np.asarray(w_rn["w"], np.float32).mean())
+    # Adam's unit-scale step is ~lr: 200 steps * 1e-4 = 0.02 expected
+    assert 0.01 < moved_sr < 0.04, moved_sr
+    # round-to-nearest cannot cross the 0.0625 ulp and stays pinned
+    assert abs(moved_rn) < 1e-6, moved_rn

@@ -626,3 +626,230 @@ def test_t4_inplace_spellings_distinct():
     want = np.zeros((3, 4), np.float32)
     want[0] = src[0]
     assert_close(s.data, want)
+
+
+# -- tranche 5 (final) ------------------------------------------------------
+
+def test_t5_value_and_hyperbolic_inverses():
+    assert Tensor(np.asarray([3.5], np.float32)).value() == 3.5
+    with pytest.raises(ValueError):
+        Tensor(np.zeros((2,), np.float32)).value()
+    a = np.asarray([1.5, 2.0, 3.0], np.float32)
+    assert_close(Tensor(a.copy()).acosh().to_numpy(),
+                 torch.from_numpy(a).acosh().numpy(), atol=1e-6)
+    assert_close(Tensor(a.copy()).asinh().to_numpy(),
+                 torch.from_numpy(a).asinh().numpy(), atol=1e-6)
+    b = np.asarray([-0.5, 0.0, 0.5], np.float32)
+    assert_close(Tensor(b.copy()).atanh().to_numpy(),
+                 torch.from_numpy(b).atanh().numpy(), atol=1e-6)
+    # spelled-out aliases resolve and share in-place semantics
+    t = Tensor(b.copy())
+    t.arctanh()
+    assert_close(t.to_numpy(), torch.from_numpy(b).atanh().numpy(),
+                 atol=1e-6)
+
+
+def test_t5_axis_movement_and_views():
+    t, tt = _pair((2, 3, 4))
+    assert_close(t.swapaxes(0, 2).to_numpy(),
+                 tt.swapaxes(0, 2).numpy())
+    assert_close(t.swapdims(1, 2).to_numpy(), tt.swapdims(1, 2).numpy())
+    parts = t.unbind(2)
+    tparts = tt.unbind(1)                    # 1-based vs 0-based dim
+    assert len(parts) == len(tparts) == 3
+    for p, tp in zip(parts, tparts):
+        assert_close(p.to_numpy(), tp.numpy())
+    assert_close(t.unflatten(3, (2, 2)).to_numpy(),
+                 tt.unflatten(2, (2, 2)).numpy())
+    assert_close(t.positive().to_numpy(), tt.positive().numpy())
+
+
+def test_t5_diagonal_family():
+    t, tt = _pair((3, 4))
+    assert_close(t.diagonal(1).to_numpy(), tt.diagonal(1).numpy())
+    v = Tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+    tv = torch.tensor([1.0, 2.0, 3.0])
+    assert_close(v.diagflat(1).to_numpy(), torch.diag_embed(
+        tv, offset=1).numpy())
+    assert_close(v.diag_embed().to_numpy(),
+                 torch.diag_embed(tv).numpy())
+    b = Tensor(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+    tb = torch.arange(6.0).reshape(2, 3)
+    assert_close(b.diag_embed(1).to_numpy(),
+                 torch.diag_embed(tb, offset=1).numpy())
+
+
+def test_t5_cumulative_family():
+    a = np.asarray([[3.0, 1.0, 4.0, 1.0], [-1.0, -5.0, 2.0, 0.0]],
+                   np.float32)
+    t, tt = Tensor(a.copy()), torch.from_numpy(a.copy())
+    vals, idx = t.cummax(2)
+    tv, ti = tt.cummax(1)
+    assert_close(vals.to_numpy(), tv.numpy())
+    np.testing.assert_array_equal(idx.to_numpy() - 1, ti.numpy())
+    vals, idx = t.cummin(2)
+    tv, ti = tt.cummin(1)
+    assert_close(vals.to_numpy(), tv.numpy())
+    np.testing.assert_array_equal(idx.to_numpy() - 1, ti.numpy())
+    assert_close(t.logcumsumexp(2).to_numpy(),
+                 tt.logcumsumexp(1).numpy(), atol=1e-5)
+    assert_close(np.asarray(t.logsumexp()),
+                 tt.logsumexp(dim=(0, 1)).numpy(), atol=1e-5)
+    assert_close(t.logsumexp(1).to_numpy(), tt.logsumexp(0).numpy(),
+                 atol=1e-5)
+
+
+def test_t5_nan_reductions():
+    a = np.asarray([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]], np.float32)
+    t, tt = Tensor(a.copy()), torch.from_numpy(a.copy())
+    assert_close(np.float32(t.nansum()), tt.nansum().numpy())
+    assert_close(t.nansum(1).to_numpy(), tt.nansum(0).numpy())
+    assert_close(np.float32(t.nanmean()), tt.nanmean().numpy())
+    assert_close(t.nanmean(2).to_numpy(), tt.nanmean(1).numpy())
+    clean = np.asarray([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    tc = Tensor(clean.copy())
+    ttc = torch.from_numpy(clean.copy())
+    assert_close(np.float32(tc.quantile(0.5)),
+                 ttc.quantile(0.5).numpy())
+    assert_close(tc.quantile(0.25, 2).to_numpy(),
+                 ttc.quantile(0.25, dim=1).numpy(), atol=1e-6)
+    assert tc.nanquantile(0.5) == 2.5
+    # numpy convention: even-count median AVERAGES the middle two
+    # ([1,3,5,6] -> 4.0); torch.nanmedian returns the lower (3.0)
+    assert np.isclose(t.nanmedian(), 4.0)
+
+
+def test_t5_std_var_mean_pairs():
+    t, tt = _pair((3, 4), seed=3)
+    s, m = t.std_mean(1)
+    # facade heritage conventions: std drops the reduced dim, mean keeps it
+    assert_close(s.to_numpy(), torch.std(tt, dim=0).numpy(), atol=1e-5)
+    assert_close(m.to_numpy(),
+                 torch.mean(tt, dim=0, keepdim=True).numpy(), atol=1e-6)
+    v, m = t.var_mean()
+    tv, tm = torch.var_mean(tt)
+    assert_close(np.float32(v), tv.numpy(), atol=1e-5)
+    assert_close(np.float32(m), tm.numpy(), atol=1e-6)
+
+
+def test_t5_integer_and_float_decomp():
+    a = np.asarray([12, 18, 7], np.int64)
+    b = np.asarray([8, 12, 21], np.int64)
+    np.testing.assert_array_equal(
+        Tensor(a).gcd(b).to_numpy(),
+        torch.from_numpy(a).gcd(torch.from_numpy(b)).numpy())
+    np.testing.assert_array_equal(
+        Tensor(a).lcm(b).to_numpy(),
+        torch.from_numpy(a).lcm(torch.from_numpy(b)).numpy())
+    # beyond-int32 results refuse loudly instead of truncating (the
+    # facade's jnp storage is int32 under JAX's default x64-off config)
+    with pytest.raises(OverflowError, match="int32"):
+        Tensor(np.asarray([100000])).lcm(np.asarray([99999]))
+    x = np.asarray([0.75, -3.5, 10.0], np.float32)
+    assert_close(Tensor(x).ldexp(np.asarray([2, 1, 3])).to_numpy(),
+                 torch.from_numpy(x).ldexp(torch.tensor([2, 1, 3])).numpy())
+    m, e = Tensor(x).frexp()
+    tm, te = torch.from_numpy(x).frexp()
+    assert_close(m.to_numpy(), tm.numpy())
+    np.testing.assert_array_equal(e.to_numpy(), te.numpy())
+
+
+def test_t5_special_functions():
+    x = np.asarray([0.5, 1.0, 2.5], np.float32)
+    assert_close(Tensor(x).i0().to_numpy(),
+                 torch.from_numpy(x).i0().numpy(), atol=1e-5)
+    assert_close(Tensor(x).mvlgamma(3).to_numpy(),
+                 torch.from_numpy(x.astype(np.float64)).mvlgamma(3)
+                 .numpy().astype(np.float32), atol=1e-4)
+    assert_close(Tensor(x).polygamma(1).to_numpy(),
+                 torch.polygamma(1, torch.from_numpy(x)).numpy(),
+                 atol=1e-5)
+    y = np.asarray([[1.0, 2.0, 4.0, 7.0]], np.float32)
+    assert_close(np.float32(Tensor(y[0]).trapz(dx=2.0)),
+                 torch.trapz(torch.from_numpy(y[0]), dx=2.0).numpy())
+    a, ta = _pair((6,), seed=5)
+    b, tb = _pair((6,), seed=6)
+    assert np.isclose(a.vdot(b.to_numpy()),
+                      torch.dot(ta, tb).item(), atol=1e-5)
+    h, edges = Tensor(x).histogram(bins=4)
+    th, tedges = torch.histogram(torch.from_numpy(x), bins=4)
+    assert_close(h.to_numpy(), th.numpy())
+    assert_close(edges.to_numpy(), tedges.numpy(), atol=1e-6)
+    sb = np.asarray([-1.0, 0.0, 2.0], np.float32)
+    np.testing.assert_array_equal(
+        Tensor(sb).signbit().to_numpy(),
+        torch.from_numpy(sb).signbit().numpy())
+    assert_close(Tensor(sb).rsub(10.0, alpha=2.0).to_numpy(),
+                 torch.rsub(torch.from_numpy(sb), 10.0, alpha=2.0).numpy())
+
+
+def test_t5_linalg_family():
+    rs = np.random.RandomState(9)
+    m = rs.randn(3, 3).astype(np.float32)
+    spd = (m @ m.T + 3 * np.eye(3)).astype(np.float32)
+    t, tt = Tensor(spd.copy()), torch.from_numpy(spd.copy())
+    assert_close(t.matrix_power(3).to_numpy(),
+                 torch.linalg.matrix_power(tt, 3).numpy(), atol=1e-2)
+    assert_close(t.pinverse().to_numpy(),
+                 torch.linalg.pinv(tt).numpy(), atol=1e-4)
+    sign, logabs = t.slogdet()
+    tsign, tlog = torch.linalg.slogdet(tt)
+    assert sign == tsign.item()
+    assert np.isclose(logabs, tlog.item(), atol=1e-4)
+    assert_close(t.cholesky().to_numpy(),
+                 torch.linalg.cholesky(tt).numpy(), atol=1e-4)
+    b = rs.randn(3, 2).astype(np.float32)
+    assert_close(t.lstsq(b).to_numpy(),
+                 np.linalg.lstsq(spd, b, rcond=None)[0], atol=1e-4)
+
+
+def test_t5_masked_and_indexed_writes():
+    a = np.zeros((2, 3), np.float32)
+    mask = np.asarray([[True, False, True], [False, True, False]])
+    out = Tensor(a.copy()).masked_scatter(mask, np.asarray([1.0, 2.0, 3.0]))
+    tout = torch.zeros(2, 3).masked_scatter_(
+        torch.from_numpy(mask), torch.tensor([1.0, 2.0, 3.0]))
+    assert_close(out.to_numpy(), tout.numpy())
+    with pytest.raises(ValueError, match="masked_scatter"):
+        Tensor(a.copy()).masked_scatter(mask, np.asarray([1.0]))
+    # broadcastable mask: every expanded position consumes one source
+    # element (torch semantics), and the guard counts them
+    bmask = np.asarray([True, False])
+    bout = Tensor(np.zeros((2, 2), np.float32)).masked_scatter(
+        bmask, np.asarray([9.0, 8.0]))
+    tbout = torch.zeros(2, 2).masked_scatter_(
+        torch.from_numpy(bmask), torch.tensor([9.0, 8.0]))
+    assert_close(bout.to_numpy(), tbout.numpy())
+    # integer inputs keep FLOAT bin edges (no truncated duplicates)
+    ih, iedges = Tensor(np.asarray([0, 1, 2, 3], np.int32)).histogram(bins=4)
+    assert iedges.to_numpy().dtype == np.float32
+    assert len(np.unique(iedges.to_numpy())) == 5
+
+    t = Tensor(np.zeros((3, 3), np.float32))
+    out = t.index_put((np.asarray([1, 3]), np.asarray([2, 1])),
+                      np.asarray([5.0, 7.0]))
+    exp = np.zeros((3, 3), np.float32)
+    exp[0, 1], exp[2, 0] = 5.0, 7.0
+    assert_close(out.to_numpy(), exp)
+
+    n = Tensor(np.arange(12.0, dtype=np.float32).reshape(3, 4))
+    nc = n.narrow_copy(2, 2, 2)
+    assert_close(nc.to_numpy(),
+                 np.arange(12.0, dtype=np.float32).reshape(3, 4)[:, 1:3])
+
+
+def test_t5_aliases_resolve():
+    t, tt = _pair((4,), seed=7)
+    assert_close(t.clone().arccos is not None and
+                 Tensor(np.asarray([0.3], np.float32)).arccos().to_numpy(),
+                 torch.tensor([0.3]).arccos().numpy(), atol=1e-6)
+    a = Tensor(np.asarray([1.0, -2.0], np.float32))
+    assert_close(a.absolute().to_numpy(), np.asarray([1.0, 2.0]))
+    b, tb = _pair((4,), seed=8)
+    np.testing.assert_array_equal(
+        t.greater(b.to_numpy()).to_numpy(),
+        tt.greater(tb).numpy())
+    assert_close(Tensor.concat([Tensor(np.ones((1, 2), np.float32)),
+                                Tensor(np.zeros((1, 2), np.float32))],
+                               1).to_numpy(),
+                 np.concatenate([np.ones((1, 2)), np.zeros((1, 2))], 0))

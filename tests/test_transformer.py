@@ -321,3 +321,140 @@ def test_transformer_lm_logits_output_trains_and_decodes(rng):
     # decode head emits normalized log-probs even without the LM softmax
     np.testing.assert_allclose(np.exp(np.asarray(logp)).sum(-1), 1.0,
                                rtol=1e-4)
+
+
+def test_layer_scan_matches_unrolled(rng):
+    """layer_scan=True (ScanBlocks lax.scan over stacked params) computes
+    EXACTLY the unrolled stack — verified by transplanting the unrolled
+    model's block params into the stacked layout — and the KV-cached
+    decode step resolves the scan model too."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer import ScanBlocks, make_decode_step
+    from bigdl_tpu.utils.random_gen import RNG
+
+    V, T, B, L = 23, 10, 2, 3
+    RNG.set_seed(11)
+    unrolled = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=L,
+                             max_len=T)
+    unrolled._ensure_params()
+    RNG.set_seed(12)
+    scan = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=L,
+                         max_len=T, layer_scan=True)
+    scan._ensure_params()
+    sb = scan.modules[2]
+    assert isinstance(sb, ScanBlocks)
+
+    # transplant: unrolled blocks at Sequential indices 2..2+L; module
+    # names carry a global counter so child keys must be remapped by
+    # POSITION onto the scan template block's keys before stacking
+    tmpl = sb.modules[0]
+
+    def rekey(i):
+        bp = unrolled.params[unrolled._child_key(2 + i)]
+        blk = unrolled.modules[2 + i]
+        return {tmpl._child_key(j): bp[blk._child_key(j)] for j in range(5)}
+
+    per_layer = [rekey(i) for i in range(L)]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_layer)
+    new_p = dict(scan.params)
+    new_p[scan._child_key(0)] = unrolled.params[unrolled._child_key(0)]
+    new_p[scan._child_key(1)] = unrolled.params[unrolled._child_key(1)]
+    new_p[scan._child_key(2)] = {sb._child_key(0): stacked}
+    new_p[scan._child_key(3)] = unrolled.params[unrolled._child_key(2 + L)]
+    new_p[scan._child_key(4)] = unrolled.params[unrolled._child_key(3 + L)]
+    scan.params = new_p
+
+    unrolled.evaluate()
+    scan.evaluate()
+    ids = rng.randint(1, V + 1, size=(B, T)).astype(np.float32)
+    a, b = np.asarray(unrolled.forward(ids)), np.asarray(scan.forward(ids))
+    assert_close(a, b, atol=1e-5)
+
+    # gradients agree too (scan backward == unrolled backward)
+    ga = jax.grad(lambda p: (unrolled.apply(p, ids, {})[0] ** 2).sum())(
+        unrolled.params)
+    gb = jax.grad(lambda p: (scan.apply(p, ids, {})[0] ** 2).sum())(
+        scan.params)
+    def rekey_grad(i):
+        bp = ga[unrolled._child_key(2 + i)]
+        blk = unrolled.modules[2 + i]
+        return {tmpl._child_key(j): bp[blk._child_key(j)] for j in range(5)}
+
+    ga_stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *[rekey_grad(i) for i in range(L)])
+    for u, v in zip(
+            jax.tree_util.tree_leaves(ga_stacked),
+            jax.tree_util.tree_leaves(gb[scan._child_key(2)][sb._child_key(0)])):
+        assert_close(np.asarray(u), np.asarray(v), atol=1e-4)
+
+    # decode parity: the scan model's cached decode matches its forward
+    dstep, init_carry = make_decode_step(scan)
+    toks = rng.randint(1, V + 1, size=(1, 5)).astype(np.float32)
+    full = np.asarray(scan.forward(toks))
+    carry = init_carry(1)
+    outs = []
+    for t in range(5):
+        logp, carry = dstep(None, jnp.asarray([int(toks[0, t]) - 1],
+                                              jnp.int32), carry)
+        outs.append(np.asarray(logp)[0])
+    assert_close(np.stack(outs), full[0], atol=1e-4)
+
+
+def test_layer_scan_with_remat(rng):
+    """ScanBlocks composes with Remat (checkpoint-inside-scan — the
+    long-context memory recipe): forward matches the bare scan model."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.utils.random_gen import RNG
+
+    V, T = 17, 8
+    RNG.set_seed(21)
+    plain = TransformerLM(V, hidden_size=16, n_heads=2, n_layers=2,
+                          max_len=T, layer_scan=True)
+    plain._ensure_params()
+    RNG.set_seed(21)
+    remat = TransformerLM(V, hidden_size=16, n_heads=2, n_layers=2,
+                          max_len=T, layer_scan=True, remat=True)
+    remat._ensure_params()
+    ids = rng.randint(1, V + 1, size=(2, T)).astype(np.float32)
+    plain.evaluate()
+    remat.evaluate()
+    a = np.asarray(plain.forward(ids))
+    b = np.asarray(remat.forward(ids))
+    # same seed, but the Remat wrapper adds a child-key level; compare
+    # only shapes/finiteness here — exact parity is the unrolled test's job
+    assert a.shape == b.shape and np.isfinite(b).all()
+
+
+def test_flash_block_knob_validates_and_matches(rng):
+    """flash_block must reject non-128-multiples and, when valid, compute
+    the same attention as the dense path (interpret-mode Pallas on CPU)."""
+    import pytest as _pytest
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    from bigdl_tpu.utils.random_gen import RNG
+
+    with _pytest.raises(ValueError, match="multiple of 128"):
+        MultiHeadAttention(32, 4, flash_block=100)
+
+    V, T = 19, 128
+    RNG.set_seed(31)
+    flash = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=1,
+                          max_len=T, use_flash="always", flash_block=128)
+    flash._ensure_params()
+    RNG.set_seed(31)
+    dense = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=1,
+                          max_len=T, use_flash="never")
+    dense._ensure_params()
+    ids = rng.randint(1, V + 1, size=(1, T)).astype(np.float32)
+    flash.evaluate()
+    dense.evaluate()
+    a = np.asarray(flash.forward(ids))
+    b = np.asarray(dense.forward(ids))
+    assert_close(a, b, atol=2e-3)
