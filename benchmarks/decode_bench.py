@@ -1,0 +1,154 @@
+"""Serving/decode throughput bench (round-5 verdict item #3).
+
+Ties the serving pieces together end-to-end: KV-cached
+``make_decode_step`` (models/transformer.py), the ``compute_dtype``
+serving knob, and weight-only int8 (``Quantizer.quantize(lm,
+scheme="weight_only")``) — answering whether the 1.29× int8 win measured
+at the isolated weight-bound matmul (int8_bench.py, r4) survives an
+end-to-end generation loop.
+
+Protocol per (model, batch, variant): prime the cache with a 128-token
+prompt, then generate 256 tokens greedily with the WHOLE loop inside one
+jitted ``lax.scan`` (one device program — per-token host dispatch through
+the axon tunnel would otherwise dominate at ~ms/call), and report
+tokens/sec = batch * 256 / wall.
+
+    PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/decode_bench.py
+    ... --models 137m --batches 1 8 --variants bf16 int8   # subset
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+MODELS = {
+    "137m": dict(vocab=32768, hidden=768, layers=12, heads=12),
+    "371m": dict(vocab=32768, hidden=1024, layers=24, heads=16),
+}
+PROMPT, GEN = 128, 256
+
+
+def build(name: str, variant: str):
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer import make_decode_step, serving_params
+    from bigdl_tpu.nn.quantized import Quantizer
+    from bigdl_tpu.utils.random_gen import RNG
+
+    cfg = MODELS[name]
+    RNG.set_seed(17)
+    lm = TransformerLM(cfg["vocab"], hidden_size=cfg["hidden"],
+                       n_heads=cfg["heads"], n_layers=cfg["layers"],
+                       max_len=PROMPT + GEN, output="logits")
+    lm._ensure_params()
+    lm.evaluate()
+    if variant == "int8":
+        lm = Quantizer.quantize(lm, scheme="weight_only")
+    dtype = {"fp32": None, "bf16": jnp.bfloat16,
+             "int8": jnp.bfloat16}[variant]
+    step, init_carry = make_decode_step(lm, compute_dtype=dtype)
+    # weights as RESIDENT device buffers in the serving dtype (passing
+    # None would bake them into the program as constants — hundreds of MB
+    # shipped per compile, rejected by the axon tunnel at 137M params)
+    P = jax.device_put(serving_params(lm, dtype))
+    return step, init_carry, P
+
+
+def measure(name: str, variant: str, batch: int, reps: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    step, init_carry, P = build(name, variant)
+    rng = np.random.default_rng(0)
+    vocab = MODELS[name]["vocab"]
+    prompt = jnp.asarray(rng.integers(0, vocab, size=(PROMPT, batch)),
+                         jnp.int32)
+
+    def prime(params, carry, toks):
+        def body(c, tok):
+            _, c = step(params, tok, c)
+            return c, None
+
+        return lax.scan(body, carry, toks)[0]
+
+    def generate(params, carry, tok0, n):
+        def body(c, _):
+            tok, cc = c
+            logp, cc = step(params, tok, cc)
+            return (jnp.argmax(logp, -1).astype(jnp.int32), cc), None
+
+        (tok, carry), _ = lax.scan(body, (tok0, carry), None, length=n)
+        return tok, carry
+
+    prime_j = jax.jit(prime)
+    gen_j = jax.jit(generate, static_argnums=3)
+
+    carry0 = init_carry(batch)
+    t0 = time.perf_counter()
+    carry = prime_j(P, carry0, prompt[:-1])
+    jax.block_until_ready(carry)
+    prime_compile_plus_run = time.perf_counter() - t0
+
+    tok0 = prompt[-1]
+    tok, carry1 = gen_j(P, carry, tok0, GEN)     # compile + first run
+    jax.block_until_ready(tok)
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        tok, _ = gen_j(P, carry, tok0, GEN)
+        jax.block_until_ready(tok)
+        best = min(best, time.perf_counter() - t0)
+
+    return {
+        "model": name, "variant": variant, "batch": batch,
+        "prompt": PROMPT, "gen": GEN,
+        "gen_s": round(best, 3),
+        "ms_per_token": round(1000 * best / GEN, 3),
+        "tokens_per_sec": round(batch * GEN / best, 1),
+        "prime_s_cold": round(prime_compile_plus_run, 1),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--models", nargs="+", default=["137m", "371m"],
+                   choices=sorted(MODELS))
+    p.add_argument("--batches", nargs="+", type=int, default=[1, 8])
+    p.add_argument("--variants", nargs="+", default=["bf16", "int8"],
+                   choices=["fp32", "bf16", "int8"])
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args(argv)
+
+    rows = []
+    for name in args.models:
+        for b in args.batches:
+            for v in args.variants:
+                try:
+                    r = measure(name, v, b, args.reps)
+                except Exception as e:
+                    r = {"model": name, "variant": v, "batch": b,
+                         "error": repr(e)[:160]}
+                rows.append(r)
+                print(json.dumps(r), flush=True)
+    # headline ratio: int8 vs bf16 at each (model, batch)
+    by = {(r["model"], r["batch"], r["variant"]): r for r in rows
+          if "tokens_per_sec" in r}
+    for (m, b) in sorted({(r["model"], r["batch"]) for r in rows}):
+        i8, bf = by.get((m, b, "int8")), by.get((m, b, "bf16"))
+        if i8 and bf:
+            print(json.dumps({
+                "model": m, "batch": b,
+                "int8_vs_bf16": round(
+                    i8["tokens_per_sec"] / bf["tokens_per_sec"], 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -317,22 +317,66 @@ if __name__ == "__main__":
     train_main()
 
 
-def make_decode_step(model: Sequential):
+def _cast_keep_scales(tree, compute_dtype):
+    """Cast float leaves to the serving dtype; quantized ``w_scale``
+    leaves stay fp32 so the dequant multiply keeps full scale precision
+    (int8 ``weight_q`` is not floating and passes through untouched).
+    THE one copy of the serving-cast rule — used by both
+    :func:`serving_params` and :func:`make_decode_step`."""
+    if compute_dtype is None:
+        return tree
+    from bigdl_tpu.optim.train_step import cast_floats
+
+    if isinstance(tree, dict):
+        return {k: (v if k == "w_scale"
+                    else _cast_keep_scales(v, compute_dtype))
+                for k, v in tree.items()}
+    return cast_floats(tree, compute_dtype)
+
+
+def serving_params(model: Sequential, compute_dtype=None):
+    """The model's params pre-cast for serving (floats to
+    ``compute_dtype``, quantized ``w_scale`` leaves kept fp32) — put this
+    on device once and pass it to the decode step as the runtime params
+    argument, so weights are resident buffers in the serving dtype rather
+    than program constants."""
+    model._ensure_params()
+    return _cast_keep_scales(model.params, compute_dtype)
+
+
+def make_decode_step(model: Sequential, compute_dtype=None):
     """KV-cached incremental decoding for a trained :func:`TransformerLM`.
 
     Returns ``(step_fn, init_carry)``:
 
     * ``init_carry(batch) -> carry`` — per-layer K/V caches
       ``(batch, max_len, heads, head_dim)`` plus a position counter;
-    * ``step_fn(params_ignored, tokens, carry) -> (logprobs, carry)`` —
+    * ``step_fn(params, tokens, carry) -> (logprobs, carry)`` —
       one token per call, attention reads the cache (O(1) new compute per
-      step instead of re-running the full prefix). The signature matches
-      ``SequenceBeamSearch``/:func:`bigdl_tpu.nn.beam_search.beam_search`;
-      beam parent-gathering permutes whole cache rows, and the position
+      step instead of re-running the full prefix). ``params`` may be
+      ``None`` (use the weights captured at build time — convenient, but
+      jit bakes them into the program as CONSTANTS, so the compiled
+      executable carries the full weight payload; measured as an HTTP 413
+      on the axon remote-compile tunnel at 137M params) or the model's
+      params pytree passed as a RUNTIME argument — the serving mode:
+      weights live in device buffers, update without recompiling, and the
+      program stays small (benchmarks/decode_bench.py uses this). The
+      signature matches ``SequenceBeamSearch``/
+      :func:`bigdl_tpu.nn.beam_search.beam_search`; beam
+      parent-gathering permutes whole cache rows, and the position
       counter is uniform across rows, so lockstep decoding stays exact.
 
     Tokens are 0-based class indices (logit column c ↔ 1-based word id
     c+1), matching the LM's LogSoftMax output columns.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) is the serving-precision
+    knob: captured weights and K/V caches store/compute in that dtype
+    (decode is weight-read-bound, so halving weight bytes is the
+    first-order lever — measured in benchmarks/decode_bench.py); score
+    accumulation and the final log-softmax stay fp32. Quantized models
+    (``Quantizer.quantize(lm, scheme="weight_only")``) decode through the
+    same step — projections whose params carry ``weight_q`` run the int8
+    dequant-into-matmul path, compounding with ``compute_dtype``.
     """
     import jax
     import jax.numpy as jnp
@@ -341,56 +385,90 @@ def make_decode_step(model: Sequential):
     from bigdl_tpu.nn.misc import LookupTable
 
     model._ensure_params()
-    P = model.params
     mods = model.modules
     assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
-    lookup_w = P[model._child_key(0)]["weight"]
     posemb = mods[1]
-    pos_w = P[model._child_key(1)]["pos"]
     max_len = posemb.max_len
-
-    blocks = []
-    for i, m in enumerate(mods):
-        inner, bp = m, P[model._child_key(i)]
-        if isinstance(m, Remat):
-            inner, bp = m.modules[0], bp[m._child_key(0)]
-        if isinstance(inner, ScanBlocks):
-            # layer_scan models store one stacked params tree — unstack
-            # into per-layer views so decode runs the same unrolled loop
-            tmpl = inner.modules[0]
-            for lp in inner.unstacked_params(bp):
-                t2, p2 = tmpl, lp
-                if isinstance(t2, Remat):
-                    t2, p2 = t2.modules[0], p2[t2._child_key(0)]
-                blocks.append((t2, p2))
-            continue
-        if isinstance(inner, TransformerBlock):
-            blocks.append((inner, bp))
     from bigdl_tpu.nn.activations import LogSoftMax
 
     # output="logits" models have no trailing LogSoftMax (the decode step
     # applies log_softmax itself either way)
     off = 1 if isinstance(mods[-1], LogSoftMax) else 0
-    lnf, lnf_p = mods[-2 - off], P[model._child_key(len(mods) - 2 - off)]
-    lin_p = P[model._child_key(len(mods) - 1 - off)]
+    lnf = mods[-2 - off]
 
-    attn0 = blocks[0][0].attn
+    def resolve(Pt):
+        """Navigate a params tree into the views the step reads — run at
+        build time on the captured weights AND in-trace on a runtime
+        params argument (same key navigation either way)."""
+        blocks = []
+        for i, m in enumerate(mods):
+            inner, bp = m, Pt[model._child_key(i)]
+            if isinstance(m, Remat):
+                inner, bp = m.modules[0], bp[m._child_key(0)]
+            if isinstance(inner, ScanBlocks):
+                # layer_scan models store one stacked params tree —
+                # unstack into per-layer views (tree_map slices, valid
+                # in-trace too) so decode runs the same unrolled loop
+                tmpl = inner.modules[0]
+                for lp in inner.unstacked_params(bp):
+                    t2, p2 = tmpl, lp
+                    if isinstance(t2, Remat):
+                        t2, p2 = t2.modules[0], p2[t2._child_key(0)]
+                    blocks.append((t2, p2))
+                continue
+            if isinstance(inner, TransformerBlock):
+                blocks.append((inner, bp))
+        return (Pt[model._child_key(0)]["weight"],
+                Pt[model._child_key(1)]["pos"],
+                blocks,
+                Pt[model._child_key(len(mods) - 2 - off)],
+                Pt[model._child_key(len(mods) - 1 - off)])
+
+    # structural metadata from the UNCAST params (no weight copy); the
+    # converted P0 copy is materialized lazily, only if a caller uses the
+    # params=None (baked-constants) mode
+    _, _, blocks0, _, _ = resolve(model.params)
+    attn0 = blocks0[0][0].attn
+    _p0_cache: list = []
+
+    def get_p0():
+        if not _p0_cache:
+            _p0_cache.append(_cast_keep_scales(model.params, compute_dtype))
+        return _p0_cache[0]
     heads, hd = attn0.n_heads, attn0.head_dim
     scale = hd ** -0.5
 
+    cache_dtype = compute_dtype or jnp.float32
+
     def init_carry(batch: int):
         carry = {"pos": jnp.zeros((batch,), jnp.int32)}
-        for i in range(len(blocks)):
+        for i in range(len(blocks0)):
             carry[f"k{i}"] = jnp.zeros((batch, max_len, heads, hd),
-                                       jnp.float32)
+                                       cache_dtype)
             carry[f"v{i}"] = jnp.zeros((batch, max_len, heads, hd),
-                                       jnp.float32)
+                                       cache_dtype)
         return carry
 
     def _proj(p, x):
+        if "weight_q" in p:
+            # weight-only int8 (QuantizedLinear layout): int8 weights
+            # convert inside the dot's fusion, fp32 accumulate, per-
+            # channel scale on the output
+            acc = lax.dot_general(
+                x.astype(jnp.bfloat16),
+                p["weight_q"].astype(jnp.bfloat16),
+                (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out = (acc * p["w_scale"][:, 0]).astype(x.dtype)
+            return out + p["bias"].astype(x.dtype) if "bias" in p else out
         return jnp.matmul(x, p["weight"].T) + p["bias"]
 
     def step(params, tokens, carry):
+        if params is None:
+            Pt = get_p0()    # captured weights, baked in as jit constants
+        else:
+            Pt = _cast_keep_scales(params, compute_dtype)
+        lookup_w, pos_w, blocks, lnf_p, lin_p = resolve(Pt)
         n = tokens.shape[0]
         t = carry["pos"][0]                      # uniform across rows
         x = jnp.take(lookup_w, jnp.clip(tokens, 0, lookup_w.shape[0] - 1),
@@ -405,15 +483,20 @@ def make_decode_step(model: Sequential):
             k_new = _proj(ap["wk"], h).reshape(n, heads, hd)
             v_new = _proj(ap["wv"], h).reshape(n, heads, hd)
             kc = lax.dynamic_update_slice_in_dim(
-                new_carry[f"k{i}"], k_new[:, None].astype(jnp.float32), t, 1)
+                new_carry[f"k{i}"], k_new[:, None].astype(cache_dtype), t, 1)
             vc = lax.dynamic_update_slice_in_dim(
-                new_carry[f"v{i}"], v_new[:, None].astype(jnp.float32), t, 1)
+                new_carry[f"v{i}"], v_new[:, None].astype(cache_dtype), t, 1)
             new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
-            s = jnp.einsum("nhd,nlhd->nhl", q * scale, kc)
+            # scores accumulate fp32 regardless of the serving dtype
+            s = jnp.einsum("nhd,nlhd->nhl",
+                           (q * scale).astype(cache_dtype), kc,
+                           preferred_element_type=jnp.float32)
             valid = jnp.arange(max_len)[None, None, :] <= t
             s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("nhl,nlhd->nhd", p, vc).reshape(n, heads * hd)
+            ctx = jnp.einsum("nhl,nlhd->nhd", p.astype(cache_dtype), vc,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype).reshape(n, heads * hd)
             x = x + _proj(ap["wo"], ctx)
             h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x[:, None])
             h2 = h2[:, 0]
@@ -423,7 +506,8 @@ def make_decode_step(model: Sequential):
         xf, _ = lnf.apply(lnf_p, x[:, None])
         logits = _proj(lin_p, xf[:, 0])
         new_carry["pos"] = carry["pos"] + 1
-        return jax.nn.log_softmax(logits, axis=-1), new_carry
+        return jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1), new_carry
 
     # shapes are static across steps: compile once, reuse every token
     # (composes with beam_search's lax.scan — jit-of-jit inlines)

@@ -391,6 +391,11 @@ class BlockStoreParameter:
         self.timeout_s = timeout_s if timeout_s is not None else float(
             os.environ.get("BIGDL_BLOCKSTORE_TIMEOUT_S", "300"))
         self.dropped_total = 0          # contributions discarded so far
+        # per-source drop counts + (iteration, dropped pids) log — the
+        # drop-targeting diagnostics the width tests assert on (only the
+        # actual straggler should ever appear here)
+        self.dropped_by_src: Dict[int, int] = {}
+        self.drop_log: List[Tuple[int, Tuple[int, ...]]] = []
         self._my_slice_cache: Optional[np.ndarray] = None
         # (iteration, src) -> that iteration's aggregation start time, for
         # contributions dropped at the deadline whose blocks have not
@@ -609,7 +614,9 @@ class BlockStoreParameter:
             time.sleep(0.002)
         if pending:
             self.dropped_total += len(pending)
+            self.drop_log.append((t, tuple(pending)))
             for src in pending:
+                self.dropped_by_src[src] = self.dropped_by_src.get(src, 0) + 1
                 self._late_probes[(t, src)] = t0
             logger.warning(
                 "iteration %d partition %d: dropped %d straggler gradient "

@@ -19,7 +19,11 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # the wide-pod mode runs 6+ processes on this box: 1 device each keeps
+    # oversubscription bounded (the point is drop-POLICY behavior)
+    n_dev = 1 if mode == "blockstore_drop_wide" else 4
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={n_dev}"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -34,8 +38,8 @@ def main():
         num_processes=n_procs, process_id=pid,
     )
     assert jax.process_count() == n_procs
-    assert len(jax.devices()) == 4 * n_procs, jax.devices()
-    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == n_dev * n_procs, jax.devices()
+    assert len(jax.local_devices()) == n_dev
 
     from jax.sharding import Mesh
 
@@ -59,9 +63,19 @@ def main():
 
     model = LeNet5(10)
     n_iter = 3 if mode == "orig" else 6
+    if mode == "blockstore_drop_wide":
+        # round-5 verdict item #5: the drop policy at realistic width —
+        # n=6+ procs, drop_percentage=0.15 (min_arrivals = ceil(0.85*n)),
+        # ONE persistent straggler that heals mid-run; a small MLP keeps
+        # the 1-core box on policy behavior rather than compute
+        from bigdl_tpu.nn import Linear, LogSoftMax, Reshape, Sequential
+
+        model = Sequential().add(Reshape([784], batch_mode=True)) \
+            .add(Linear(784, 64)).add(Linear(64, 10)).add(LogSoftMax())
+        n_iter = 9
     if mode.startswith("blockstore"):
         # the BlockManager-analog DCN plane: host block store over the
-        # coordination service, straggler gradient-drop in the _drop mode
+        # coordination service, straggler gradient-drop in the _drop modes
         from bigdl_tpu.parallel.block_store import CoordServiceBlockStore
 
         from tests.straggler import DelayedGradientPuts
@@ -69,6 +83,10 @@ def main():
         store = CoordServiceBlockStore()
         if mode == "blockstore_drop" and pid == n_procs - 1:
             store = DelayedGradientPuts(store, delay_s=0.7, first_iter=2)
+        if mode == "blockstore_drop_wide" and pid == n_procs - 1:
+            # straggle iterations 2..5, healed from 6 on (probe recovery)
+            store = DelayedGradientPuts(store, delay_s=1.0, first_iter=2,
+                                        last_iter=5)
         opt = Optimizer(
             model=model, dataset=ds, criterion=ClassNLLCriterion(),
             batch_size=16 * n_procs,
@@ -78,6 +96,9 @@ def main():
         if mode == "blockstore_drop":
             opt.set_drop_module_property(
                 0.34, batch_size=20, warmup_iteration=2)
+        elif mode == "blockstore_drop_wide":
+            opt.set_drop_module_property(
+                0.15, batch_size=30, warmup_iteration=2)
     else:
         mesh = Mesh(np.asarray(jax.devices()).reshape(4 * n_procs),
                     ("data",))
@@ -108,9 +129,13 @@ def main():
         trained = opt.optimize()
     elif mode == "straight":
         trained = opt.optimize()
-    elif mode in ("blockstore", "blockstore_drop"):
+    elif mode in ("blockstore", "blockstore_drop", "blockstore_drop_wide"):
         trained = opt.optimize()
         print(f"worker {pid}: drops={opt._bsp.dropped_total}")
+        if mode == "blockstore_drop_wide":
+            print(f"worker {pid}: drops_by_src="
+                  f"{sorted(opt._bsp.dropped_by_src.items())}")
+            print(f"worker {pid}: drop_log={opt._bsp.drop_log}")
     elif mode == "crash":
         # checkpoint every iteration, then die HARD (os._exit — no python
         # cleanup, the closest in-env analog of a killed pod worker) at the

@@ -10,14 +10,20 @@ import time
 
 
 class DelayedGradientPuts:
-    def __init__(self, inner, delay_s, first_iter=1):
+    """``last_iter`` (inclusive) bounds the straggling window so tests can
+    model a straggler that HEALS mid-run — the late-arrival-probe recovery
+    path (BlockStoreParameter._probe_late_arrivals)."""
+
+    def __init__(self, inner, delay_s, first_iter=1, last_iter=None):
         self._inner, self._delay, self._first = inner, delay_s, first_iter
+        self._last = last_iter
 
     def put(self, key, value):
         parts = key.split("/")
-        if len(parts) >= 3 and parts[1] == "g" and \
-                int(parts[2]) >= self._first:
-            time.sleep(self._delay)
+        if len(parts) >= 3 and parts[1] == "g":
+            t = int(parts[2])
+            if t >= self._first and (self._last is None or t <= self._last):
+                time.sleep(self._delay)
         self._inner.put(key, value)
 
     def __getattr__(self, name):

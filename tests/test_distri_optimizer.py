@@ -273,3 +273,59 @@ def test_pod_set_validation_pyspark_order():
         with pytest.raises(ValueError, match="_result_cls"):
             opt.set_validation(256, DistributedDataSet(samples),
                                Trigger.every_epoch(), [NoCls()])
+
+
+def test_allreduce_construction_single_collective_on_wire():
+    """The allreduce-mode spmd construction (mark params VARYING with
+    pvary/pcast, then one explicit pmean — distri_optimizer.py:286-295)
+    must compile to exactly ONE all-reduce carrying the gradient bytes.
+    Without the varying mark, jax auto-psums the cotangent of the
+    replicated input AND the user pmean reduces again — 2x wire traffic
+    with sum-not-mean semantics. This pins the jax behavior the hot path
+    depends on (verified by HLO extraction; also the cross-check inside
+    benchmarks/pod_projection.py)."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+    pcast = getattr(lax, "pcast", None)
+    mark = ((lambda t: pcast(t, "data", to="varying")) if pcast is not None
+            else (lambda t: lax.pvary(t, "data")))
+
+    def make(marked):
+        def f(x, w):
+            wv = mark(w) if marked else w
+            loss, g = jax.value_and_grad(
+                lambda w_: jnp.mean(jnp.dot(x, w_) ** 2))(wv)
+            return lax.pmean(g, "data"), lax.pmean(loss, "data")
+
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("data"), P()), out_specs=(P(), P())))
+
+    x = np.ones((8, 16), np.float32) * 0.25
+    w = np.linspace(-1, 1, 64).astype(np.float32).reshape(16, 4)
+
+    def allreduce_f32_bytes(fn):
+        hlo = fn.lower(x, w).compile().as_text()
+        total = 0
+        for line in hlo.splitlines():
+            if "all-reduce(" not in line or "=" not in line:
+                continue
+            sig = line.split("=", 1)[1].split("all-reduce(", 1)[0]
+            for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", sig):
+                if dt == "f32":
+                    k = 1
+                    for d in dims.split(","):
+                        if d:
+                            k *= int(d)
+                    total += 4 * k
+        return total
+
+    # marked (the framework construction): grads (64 f32) + loss, ONCE
+    assert allreduce_f32_bytes(make(True)) == 64 * 4 + 4
+    # unmarked: auto-psum'd cotangent + explicit pmean = the grad twice
+    assert allreduce_f32_bytes(make(False)) == 2 * 64 * 4 + 4

@@ -458,3 +458,73 @@ def test_flash_block_knob_validates_and_matches(rng):
     a = np.asarray(flash.forward(ids))
     b = np.asarray(dense.forward(ids))
     assert_close(a, b, atol=2e-3)
+
+
+def test_decode_step_bf16_and_weight_only_int8(rng):
+    """Serving paths of make_decode_step: compute_dtype=bf16 tracks the
+    fp32 decode closely, and a weight_only-quantized LM decodes through
+    the same step (int8 dequant projections) matching ITS full forward."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer import make_decode_step
+    from bigdl_tpu.nn.quantized import Quantizer
+    from bigdl_tpu.utils.random_gen import RNG
+
+    V, T = 27, 12
+    RNG.set_seed(41)
+    lm = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=2, max_len=T)
+    lm._ensure_params()
+    lm.evaluate()
+    toks = rng.randint(1, V + 1, size=(1, 6)).astype(np.float32)
+
+    # bf16 serving dtype ~ fp32 decode
+    d32, ic32 = make_decode_step(lm)
+    dbf, icbf = make_decode_step(lm, compute_dtype=jnp.bfloat16)
+    c32, cbf = ic32(1), icbf(1)
+    assert cbf["k0"].dtype == jnp.bfloat16
+    for t in range(6):
+        tok = jnp.asarray([int(toks[0, t]) - 1], jnp.int32)
+        l32, c32 = d32(None, tok, c32)
+        lbf, cbf = dbf(None, tok, cbf)
+    assert_close(np.asarray(l32), np.asarray(lbf), atol=0.15)
+    # ranking preserved at bf16 for the top token
+    assert np.asarray(l32).argmax() == np.asarray(lbf).argmax()
+
+    # weight-only int8: decode matches the quantized model's own forward
+    qlm = Quantizer.quantize(lm, scheme="weight_only")
+    full = np.asarray(qlm.forward(toks))
+    dq, icq = make_decode_step(qlm)
+    cq = icq(1)
+    outs = []
+    for t in range(6):
+        logp, cq = dq(None, jnp.asarray([int(toks[0, t]) - 1], jnp.int32),
+                      cq)
+        outs.append(np.asarray(logp)[0])
+    # the quantized forward emits logprobs through LogSoftMax
+    assert_close(np.stack(outs), full[0], atol=2e-3)
+
+
+def test_decode_step_runtime_params_match_captured(rng):
+    """step(params, ...) with the serving-params tree must equal
+    step(None, ...) (captured constants) — the runtime-argument mode is
+    how serving avoids baking weights into the compiled program."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer import make_decode_step, serving_params
+    from bigdl_tpu.utils.random_gen import RNG
+
+    V, T = 21, 10
+    RNG.set_seed(51)
+    lm = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=2, max_len=T)
+    lm._ensure_params()
+    step, init_carry = make_decode_step(lm, compute_dtype=jnp.bfloat16)
+    P = serving_params(lm, jnp.bfloat16)
+    c_none, c_p = init_carry(1), init_carry(1)
+    toks = rng.randint(1, V + 1, size=(5,))
+    for t in toks:
+        tok = jnp.asarray([int(t) - 1], jnp.int32)
+        l_none, c_none = step(None, tok, c_none)
+        l_p, c_p = step(P, tok, c_p)
+    np.testing.assert_array_equal(np.asarray(l_none), np.asarray(l_p))
