@@ -516,20 +516,25 @@ def make_decode_step(model: Sequential, compute_dtype=None):
 
 def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
                   decode_length: int = 32, eos_id: int = -1,
-                  alpha: float = 0.6):
+                  alpha: float = 0.6, compute_dtype=None):
     """Beam-search continuation of a prompt with the KV-cached decoder.
 
     ``prompt_ids``: (P,) 1-based word ids for ONE prompt (decode several
     prompts with separate calls — beam_search's sos is scalar). Returns
     ``(sequences (beam, decode_length) of 1-based ids, scores (beam,))``.
-    ``eos_id`` is a 1-based id, or -1 for none.
+    ``eos_id`` is a 1-based id, or -1 for none. ``compute_dtype``
+    (e.g. bf16) selects the serving precision; weights ride as runtime
+    arguments either way (large models cannot bake them into the
+    program — see :func:`make_decode_step`).
     """
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
     from bigdl_tpu.nn.beam_search import beam_search
 
-    step, init_carry = make_decode_step(model)
+    step, init_carry = make_decode_step(model, compute_dtype=compute_dtype)
+    P = jax.device_put(serving_params(model, compute_dtype))
     prompt = [int(t) for t in prompt_ids]
     assert prompt, "need a non-empty prompt"
     max_len = model.modules[1].max_len
@@ -543,10 +548,10 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
     # prime the cache with the prompt (every beam identical)
     for tok in prompt[:-1]:
         toks = jnp.full((K,), tok - 1, jnp.int32)
-        _, carry = step(None, toks, carry)
+        _, carry = step(P, toks, carry)
     vocab = model.modules[0].n_index
     seqs, scores = beam_search(
-        step, None, carry, 1, K, vocab, decode_length,
+        step, P, carry, 1, K, vocab, decode_length,
         sos_id=prompt[-1] - 1,
         eos_id=(eos_id - 1) if eos_id > 0 else vocab + 7,
         alpha=alpha, padding_value=-1)
@@ -555,17 +560,21 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
 
 
 def generate(model: Sequential, prompt_ids, length: int = 32,
-             temperature: float = 1.0, top_k: int = 0, seed: int = 0):
+             temperature: float = 1.0, top_k: int = 0, seed: int = 0,
+             compute_dtype=None):
     """Sampled (or greedy) continuation with the KV-cached decoder.
 
     ``temperature=0`` is greedy argmax; ``top_k > 0`` restricts sampling to
     the k most likely tokens. Returns (length,) 1-based word ids.
+    ``compute_dtype`` selects the serving precision; weights ride as
+    runtime arguments (see :func:`make_decode_step`).
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    step, init_carry = make_decode_step(model)
+    step, init_carry = make_decode_step(model, compute_dtype=compute_dtype)
+    P = jax.device_put(serving_params(model, compute_dtype))
     prompt = [int(t) for t in prompt_ids]
     assert prompt, "need a non-empty prompt"
     max_len = model.modules[1].max_len
@@ -576,13 +585,13 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
             "silently clamp (same guard as PositionEmbedding)")
     carry = init_carry(1)
     for tok in prompt[:-1]:
-        _, carry = step(None, jnp.asarray([tok - 1], jnp.int32), carry)
+        _, carry = step(P, jnp.asarray([tok - 1], jnp.int32), carry)
 
     key = jax.random.PRNGKey(seed)
     tok = jnp.asarray([prompt[-1] - 1], jnp.int32)
     out = []
     for i in range(length):
-        logp, carry = step(None, tok, carry)
+        logp, carry = step(P, tok, carry)
         logits = logp[0]
         if temperature <= 0.0:
             nxt = jnp.argmax(logits)
