@@ -110,13 +110,18 @@ def test_transformer_ring_sequence_parallel(rng):
     assert_close(out, want, atol=1e-3)
 
 
-def test_transformer_serialization_roundtrip(rng, tmp_path):
+@pytest.mark.parametrize("layer_scan", [False, True])
+def test_transformer_serialization_roundtrip(rng, tmp_path, layer_scan):
+    """Unrolled AND ScanBlocks (stacked per-layer params) stacks survive
+    the structured serializer — the Container protocol carries the
+    stacked tree like any other child dict."""
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.nn.module import AbstractModule
     from bigdl_tpu.utils.random_gen import RNG
 
     RNG.set_seed(4)
-    m = TransformerLM(12, hidden_size=16, n_heads=2, n_layers=1, max_len=8)
+    m = TransformerLM(12, hidden_size=16, n_heads=2, n_layers=3, max_len=8,
+                      layer_scan=layer_scan)
     m._ensure_params()
     m.evaluate()
     ids = (rng.randint(1, 13, size=(2, 8))).astype(np.float32)
