@@ -344,6 +344,160 @@ def serving_params(model: Sequential, compute_dtype=None):
     return _cast_keep_scales(model.params, compute_dtype)
 
 
+def _decode_head_offset(model: Sequential) -> int:
+    """1 when the model carries a trailing LogSoftMax (the decode/prefill
+    steps apply log_softmax themselves either way), else 0."""
+    from bigdl_tpu.nn.activations import LogSoftMax
+
+    return 1 if isinstance(model.modules[-1], LogSoftMax) else 0
+
+
+def _resolve_decode_views(model: Sequential, off: int, Pt):
+    """Navigate a params tree into the views the decode/prefill steps
+    read — runs at build time on the captured weights AND in-trace on a
+    runtime params argument (same key navigation either way). Returns
+    ``(lookup_w, pos_w, [(block_module, block_params)], lnf_p, lin_p)``;
+    layer_scan (ScanBlocks) stacks unstack into per-layer views
+    (tree_map slices, valid in-trace too)."""
+    mods = model.modules
+    blocks = []
+    for i, m in enumerate(mods):
+        inner, bp = m, Pt[model._child_key(i)]
+        if isinstance(m, Remat):
+            inner, bp = m.modules[0], bp[m._child_key(0)]
+        if isinstance(inner, ScanBlocks):
+            tmpl = inner.modules[0]
+            for lp in inner.unstacked_params(bp):
+                t2, p2 = tmpl, lp
+                if isinstance(t2, Remat):
+                    t2, p2 = t2.modules[0], p2[t2._child_key(0)]
+                blocks.append((t2, p2))
+            continue
+        if isinstance(inner, TransformerBlock):
+            blocks.append((inner, bp))
+    return (Pt[model._child_key(0)]["weight"],
+            Pt[model._child_key(1)]["pos"],
+            blocks,
+            Pt[model._child_key(len(mods) - 2 - off)],
+            Pt[model._child_key(len(mods) - 1 - off)])
+
+
+def _serving_proj(p, x):
+    """Linear projection for the serving steps: plain {weight,bias}
+    params or a QuantizedLinear weight-only layout (int8 weights convert
+    inside the dot's fusion, fp32 accumulate, per-channel scale)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if "weight_q" in p:
+        acc = lax.dot_general(
+            x.astype(jnp.bfloat16),
+            p["weight_q"].astype(jnp.bfloat16),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = (acc * p["w_scale"][:, 0]).astype(x.dtype)
+        return out + p["bias"].astype(x.dtype) if "bias" in p else out
+    return jnp.matmul(x, p["weight"].T) + p["bias"]
+
+
+def make_prefill_step(model: Sequential, compute_dtype=None):
+    """ONE-pass prompt ingestion for the KV-cached decoder (the serving
+    "prefill" phase). Returns ``prefill(params, tokens, carry) ->
+    (logprobs_last, carry)``:
+
+    * ``tokens``: (B, P) 0-based prompt ids, P ≤ max_len (static shape —
+      re-jit per length bucket). EQUAL-LENGTH prompts only: there is no
+      per-row length mask, so right-padding a shorter prompt would write
+      pad tokens into its cache and score the pad position (batch rows
+      must share one true length; ragged batches need per-row prefill
+      calls or a future lengths argument);
+    * the whole prompt runs as ONE causal forward (parallel over P, full
+      MXU tiles) and the per-layer K/V land in the carry at positions
+      0..P-1 with ``pos`` set to P — decoding continues with the
+      :func:`make_decode_step` step.
+
+    Replaces priming the cache with P sequential single-token decode
+    steps, each of which re-reads every weight: at 137M/P=128 that is
+    ~74 ms of weight traffic vs one ~6 ms forward (measured in
+    benchmarks/decode_bench.py). ``params`` follows the same runtime-
+    argument convention as the decode step (``serving_params``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigdl_tpu.nn.misc import LookupTable
+
+    model._ensure_params()
+    mods = model.modules
+    assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
+    max_len = mods[1].max_len
+    off = _decode_head_offset(model)
+    lnf = mods[-2 - off]
+    _, _, blocks0, _, _ = _resolve_decode_views(model, off, model.params)
+    attn0 = blocks0[0][0].attn
+    heads, hd = attn0.n_heads, attn0.head_dim
+    scale = hd ** -0.5
+    cache_dtype = compute_dtype or jnp.float32
+    _p0_cache: list = []
+
+    def get_p0():
+        if not _p0_cache:
+            _p0_cache.append(_cast_keep_scales(model.params, compute_dtype))
+        return _p0_cache[0]
+
+    # NOTE: the per-block body below intentionally parallels (not shares)
+    # make_decode_step's loop — a length-generic unification would make
+    # prefill attend over the full max_len cache instead of the P-sized
+    # prompt (3x the attention work at P=127/max_len=384). The drift risk
+    # is pinned by test_prefill_matches_sequential_decode, which asserts
+    # cache/logit equality against the decode step for plain, bf16 and
+    # int8 models.
+    def prefill(params, tokens, carry):
+        Pt = get_p0() if params is None else \
+            _cast_keep_scales(params, compute_dtype)
+        lookup_w, pos_w, blocks, lnf_p, lin_p = \
+            _resolve_decode_views(model, off, Pt)
+        B, P = tokens.shape
+        if P > max_len:
+            raise ValueError(f"prompt length {P} exceeds max_len {max_len}")
+        x = jnp.take(lookup_w, jnp.clip(tokens, 0, lookup_w.shape[0] - 1),
+                     axis=0)                          # (B, P, Hid)
+        x = x + pos_w[:P]
+        causal = jnp.tril(jnp.ones((P, P), bool))
+        new_carry = dict(carry)
+        for i, (blk, bp) in enumerate(blocks):
+            h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
+            ap = bp[blk._child_key(1)]
+            q = _serving_proj(ap["wq"], h).reshape(B, P, heads, hd)
+            k = _serving_proj(ap["wk"], h).reshape(B, P, heads, hd)
+            v = _serving_proj(ap["wv"], h).reshape(B, P, heads, hd)
+            new_carry[f"k{i}"] = lax.dynamic_update_slice_in_dim(
+                new_carry[f"k{i}"], k.astype(cache_dtype), 0, 1)
+            new_carry[f"v{i}"] = lax.dynamic_update_slice_in_dim(
+                new_carry[f"v{i}"], v.astype(cache_dtype), 0, 1)
+            # dense causal attention over the prompt (P is prompt-sized;
+            # scores accumulate fp32 like the decode step)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k,
+                           preferred_element_type=jnp.float32)
+            s = jnp.where(causal[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype).reshape(B, P, heads * hd)
+            x = x + _serving_proj(ap["wo"], ctx)
+            h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x)
+            mlp = _serving_proj(bp[blk._child_key(4)], jax.nn.gelu(
+                _serving_proj(bp[blk._child_key(3)], h2)))
+            x = x + mlp
+        xf, _ = lnf.apply(lnf_p, x[:, -1:])           # last position only
+        logits = _serving_proj(lin_p, xf[:, 0])
+        new_carry["pos"] = jnp.full_like(carry["pos"], P)
+        return jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1), new_carry
+
+    return jax.jit(prefill)
+
+
 def make_decode_step(model: Sequential, compute_dtype=None):
     """KV-cached incremental decoding for a trained :func:`TransformerLM`.
 
@@ -389,40 +543,11 @@ def make_decode_step(model: Sequential, compute_dtype=None):
     assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
     posemb = mods[1]
     max_len = posemb.max_len
-    from bigdl_tpu.nn.activations import LogSoftMax
-
-    # output="logits" models have no trailing LogSoftMax (the decode step
-    # applies log_softmax itself either way)
-    off = 1 if isinstance(mods[-1], LogSoftMax) else 0
+    off = _decode_head_offset(model)
     lnf = mods[-2 - off]
 
     def resolve(Pt):
-        """Navigate a params tree into the views the step reads — run at
-        build time on the captured weights AND in-trace on a runtime
-        params argument (same key navigation either way)."""
-        blocks = []
-        for i, m in enumerate(mods):
-            inner, bp = m, Pt[model._child_key(i)]
-            if isinstance(m, Remat):
-                inner, bp = m.modules[0], bp[m._child_key(0)]
-            if isinstance(inner, ScanBlocks):
-                # layer_scan models store one stacked params tree —
-                # unstack into per-layer views (tree_map slices, valid
-                # in-trace too) so decode runs the same unrolled loop
-                tmpl = inner.modules[0]
-                for lp in inner.unstacked_params(bp):
-                    t2, p2 = tmpl, lp
-                    if isinstance(t2, Remat):
-                        t2, p2 = t2.modules[0], p2[t2._child_key(0)]
-                    blocks.append((t2, p2))
-                continue
-            if isinstance(inner, TransformerBlock):
-                blocks.append((inner, bp))
-        return (Pt[model._child_key(0)]["weight"],
-                Pt[model._child_key(1)]["pos"],
-                blocks,
-                Pt[model._child_key(len(mods) - 2 - off)],
-                Pt[model._child_key(len(mods) - 1 - off)])
+        return _resolve_decode_views(model, off, Pt)
 
     # structural metadata from the UNCAST params (no weight copy); the
     # converted P0 copy is materialized lazily, only if a caller uses the
@@ -449,19 +574,7 @@ def make_decode_step(model: Sequential, compute_dtype=None):
                                        cache_dtype)
         return carry
 
-    def _proj(p, x):
-        if "weight_q" in p:
-            # weight-only int8 (QuantizedLinear layout): int8 weights
-            # convert inside the dot's fusion, fp32 accumulate, per-
-            # channel scale on the output
-            acc = lax.dot_general(
-                x.astype(jnp.bfloat16),
-                p["weight_q"].astype(jnp.bfloat16),
-                (((x.ndim - 1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            out = (acc * p["w_scale"][:, 0]).astype(x.dtype)
-            return out + p["bias"].astype(x.dtype) if "bias" in p else out
-        return jnp.matmul(x, p["weight"].T) + p["bias"]
+    _proj = _serving_proj
 
     def step(params, tokens, carry):
         if params is None:
@@ -545,10 +658,14 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
             "would silently clamp (same guard as PositionEmbedding)")
     K = beam_size
     carry = init_carry(K)
-    # prime the cache with the prompt (every beam identical)
-    for tok in prompt[:-1]:
-        toks = jnp.full((K,), tok - 1, jnp.int32)
-        _, carry = step(P, toks, carry)
+    # prime the cache with the prompt in ONE prefill pass (every beam
+    # identical; sequential single-token priming re-reads all weights
+    # per prompt token)
+    if len(prompt) > 1:
+        prefill = make_prefill_step(model, compute_dtype=compute_dtype)
+        ptoks = jnp.tile(jnp.asarray([t - 1 for t in prompt[:-1]],
+                                     jnp.int32)[None], (K, 1))
+        _, carry = prefill(P, ptoks, carry)
     vocab = model.modules[0].n_index
     seqs, scores = beam_search(
         step, P, carry, 1, K, vocab, decode_length,
@@ -584,8 +701,10 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
             f"model's max_len {max_len} — the cache position would "
             "silently clamp (same guard as PositionEmbedding)")
     carry = init_carry(1)
-    for tok in prompt[:-1]:
-        _, carry = step(P, jnp.asarray([tok - 1], jnp.int32), carry)
+    if len(prompt) > 1:
+        prefill = make_prefill_step(model, compute_dtype=compute_dtype)
+        ptoks = jnp.asarray([[t - 1 for t in prompt[:-1]]], jnp.int32)
+        _, carry = prefill(P, ptoks, carry)
 
     key = jax.random.PRNGKey(seed)
     tok = jnp.asarray([prompt[-1] - 1], jnp.int32)

@@ -533,3 +533,50 @@ def test_decode_step_runtime_params_match_captured(rng):
         l_none, c_none = step(None, tok, c_none)
         l_p, c_p = step(P, tok, c_p)
     np.testing.assert_array_equal(np.asarray(l_none), np.asarray(l_p))
+
+
+def test_prefill_matches_sequential_decode(rng):
+    """make_prefill_step must leave the carry EXACTLY where P sequential
+    decode steps leave it (same K/V, same pos, same last-token logprobs)
+    — for plain, bf16-serving, and weight-only-int8 models."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.models.transformer import (
+        make_decode_step, make_prefill_step, serving_params,
+    )
+    from bigdl_tpu.nn.quantized import Quantizer
+    from bigdl_tpu.utils.random_gen import RNG
+
+    V, T, P, B = 19, 16, 7, 2
+    RNG.set_seed(71)
+    lm = TransformerLM(V, hidden_size=32, n_heads=4, n_layers=2, max_len=T)
+    lm._ensure_params()
+    lm.evaluate()
+    cases = [(lm, None, 1e-5), (lm, jnp.bfloat16, 0.1),
+             (Quantizer.quantize(lm, scheme="weight_only"), None, 1e-5)]
+    toks = rng.randint(0, V, size=(B, P)).astype(np.int32)
+    for model, dtype, atol in cases:
+        step, init_carry = make_decode_step(model, compute_dtype=dtype)
+        prefill = make_prefill_step(model, compute_dtype=dtype)
+        Pp = serving_params(model, dtype)
+
+        c_seq = init_carry(B)
+        for t in range(P):
+            l_seq, c_seq = step(Pp, jnp.asarray(toks[:, t]), c_seq)
+        l_pre, c_pre = prefill(Pp, jnp.asarray(toks), init_carry(B))
+
+        np.testing.assert_array_equal(np.asarray(c_pre["pos"]),
+                                      np.asarray(c_seq["pos"]))
+        for key in c_seq:
+            if key == "pos":
+                continue
+            assert_close(np.asarray(c_pre[key], np.float32),
+                         np.asarray(c_seq[key], np.float32), atol=atol,
+                         msg=f"{key} dtype={dtype}")
+        assert_close(np.asarray(l_pre), np.asarray(l_seq), atol=max(atol, 1e-4))
+        # and decoding CONTINUES identically from the prefilled carry
+        nxt = jnp.asarray(toks[:, 0])
+        l1, _ = step(Pp, nxt, c_pre)
+        l2, _ = step(Pp, nxt, c_seq)
+        assert_close(np.asarray(l1), np.asarray(l2), atol=max(atol, 1e-4))
