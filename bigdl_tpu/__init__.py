@@ -14,6 +14,7 @@ Layer map (mirrors SURVEY.md §1):
     bigdl_tpu.optim         — Optimizer/OptimMethod/Trigger/...   (ref L3)
     bigdl_tpu.dataset       — DataSet/Transformer/Sample/...      (ref L4)
     bigdl_tpu.models        — model zoo                           (ref L6)
+    bigdl_tpu.serving       — continuous-batching inference       (no ref)
     bigdl_tpu.parallel      — distributed parameter plane         (ref L7)
     bigdl_tpu.utils         — Engine/Table/File/RNG               (ref L8)
     bigdl_tpu.visualization — TrainSummary/ValidationSummary      (ref L10)

@@ -13,7 +13,9 @@ from bigdl_tpu.models.textclassifier import TextClassifier
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
 from bigdl_tpu.models.transformer import (
     LayerNorm, PositionEmbedding, TransformerBlock, TransformerLM,
-    beam_generate, generate, make_decode_step,
+    beam_generate, generate, get_batch_decode_step, get_decode_step,
+    get_prefill_step, make_batch_decode_step, make_decode_step,
+    make_prefill_step, serving_params,
 )
 from bigdl_tpu.models.treelstm import BinaryTreeLSTM, TreeLSTMSentiment
 
@@ -24,6 +26,8 @@ __all__ = [
     "AlexNet", "AlexNet_OWT", "Autoencoder",
     "TextClassifier", "PTBModel", "SimpleRNN",
     "TransformerLM", "TransformerBlock", "LayerNorm", "PositionEmbedding",
-    "beam_generate", "generate", "make_decode_step",
+    "beam_generate", "generate", "make_decode_step", "make_prefill_step",
+    "make_batch_decode_step", "get_decode_step", "get_prefill_step",
+    "get_batch_decode_step", "serving_params",
     "BinaryTreeLSTM", "TreeLSTMSentiment",
 ]

@@ -411,6 +411,12 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
       pad tokens into its cache and score the pad position (batch rows
       must share one true length; ragged batches need per-row prefill
       calls or a future lengths argument);
+    * ``carry`` must be FRESH (``carry['pos'] == 0`` everywhere, straight
+      from ``init_carry``): prefill writes K/V at positions 0..P-1 and
+      forces ``pos = P`` unconditionally, so a partially-filled carry
+      would be silently corrupted. The returned wrapper raises on a
+      non-zero concrete ``pos`` before entering jit (skipped under an
+      outer trace, where the value is abstract);
     * the whole prompt runs as ONE causal forward (parallel over P, full
       MXU tiles) and the per-layer K/V land in the carry at positions
       0..P-1 with ``pos`` set to P — decoding continues with the
@@ -495,7 +501,24 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
         return jax.nn.log_softmax(logits.astype(jnp.float32),
                                   axis=-1), new_carry
 
-    return jax.jit(prefill)
+    jitted = jax.jit(prefill)
+
+    def prefill_checked(params, tokens, carry):
+        import numpy as np
+
+        pos = carry["pos"]
+        # fresh-carry contract (see docstring): cheap concrete-value check
+        # outside jit; under an outer trace pos is abstract and the check
+        # is skipped (the (B,) int32 host readback costs microseconds)
+        if not isinstance(pos, jax.core.Tracer) and np.asarray(pos).any():
+            raise ValueError(
+                "make_prefill_step requires a fresh carry (carry['pos'] "
+                "must be all zeros): prefill writes K/V at positions "
+                "0..P-1 and resets pos, which would corrupt a partially-"
+                f"filled cache (got pos={np.asarray(pos).tolist()})")
+        return jitted(params, tokens, carry)
+
+    return prefill_checked
 
 
 def make_decode_step(model: Sequential, compute_dtype=None):
@@ -627,6 +650,188 @@ def make_decode_step(model: Sequential, compute_dtype=None):
     return jax.jit(step), init_carry
 
 
+def make_batch_decode_step(model: Sequential, compute_dtype=None):
+    """Per-ROW-position decode step for continuous batching
+    (``bigdl_tpu.serving``): every cache row advances independently, so
+    one pooled carry can hold many requests at different depths and rows
+    can be recycled mid-flight.
+
+    Returns ``(step_fn, init_carry)``:
+
+    * ``init_carry(n_slots) -> carry`` — identical layout to
+      :func:`make_decode_step` (per-layer ``(N, max_len, heads, hd)``
+      K/V + ``pos``), but ``pos`` is PER-ROW state, not uniform;
+    * ``step_fn(params, tokens, active, carry) -> (logprobs, carry)`` —
+      ``tokens`` (N,) 0-based ids, ``active`` (N,) bool. Active rows
+      write K/V at their own ``pos[r]``, attend over ``0..pos[r]`` of
+      their own cache row, and advance ``pos[r]`` by one; inactive rows
+      are pure ballast — their cache and ``pos`` are bitwise untouched
+      (the write scatters the OLD value back) and their logprob rows are
+      garbage the caller must ignore. Rows never interact (attention is
+      per-row over the row's own cache), so each active row computes the
+      same math as the single-request :func:`make_decode_step` (equal to
+      float round-off — batch shape changes XLA reduction order).
+
+    NOTE: the per-layer body below intentionally parallels (not shares)
+    make_decode_step's loop — unifying them would put per-row gathers and
+    masked scatters on the lockstep path that beam_search scans over.
+    The drift risk is pinned by test_batch_decode_step_matches_single_row
+    and the engine-vs-generate parity tests (plain + bf16): any fix to
+    the decode math (mask constant, cache-dtype casts, _serving_proj)
+    must land in BOTH loops or those tests fail.
+
+    ``params``/``compute_dtype`` follow the :func:`make_decode_step`
+    conventions (runtime params tree via :func:`serving_params`, fp32
+    score accumulation, int8 weight-only projections supported).
+    The caller owns slot assignment and must keep ``pos[r] < max_len``
+    for active rows (writes clamp to the last cache index rather than
+    silently wrapping).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.misc import LookupTable
+
+    model._ensure_params()
+    mods = model.modules
+    assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
+    max_len = mods[1].max_len
+    off = _decode_head_offset(model)
+    lnf = mods[-2 - off]
+    _, _, blocks0, _, _ = _resolve_decode_views(model, off, model.params)
+    attn0 = blocks0[0][0].attn
+    heads, hd = attn0.n_heads, attn0.head_dim
+    scale = hd ** -0.5
+    cache_dtype = compute_dtype or jnp.float32
+
+    def init_carry(n_slots: int):
+        carry = {"pos": jnp.zeros((n_slots,), jnp.int32)}
+        for i in range(len(blocks0)):
+            carry[f"k{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
+                                       cache_dtype)
+            carry[f"v{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
+                                       cache_dtype)
+        return carry
+
+    _proj = _serving_proj
+
+    def step(params, tokens, active, carry):
+        Pt = _cast_keep_scales(params, compute_dtype)
+        lookup_w, pos_w, blocks, lnf_p, lin_p = \
+            _resolve_decode_views(model, off, Pt)
+        n = tokens.shape[0]
+        pos = carry["pos"]                        # (N,) per-row
+        rows = jnp.arange(n)
+        wpos = jnp.clip(pos, 0, max_len - 1)      # write index per row
+        x = jnp.take(lookup_w, jnp.clip(tokens, 0, lookup_w.shape[0] - 1),
+                     axis=0)                      # (N, Hid)
+        x = x + jnp.take(pos_w, wpos, axis=0)
+        new_carry = dict(carry)
+        for i, (blk, bp) in enumerate(blocks):
+            h, _ = blk.ln1.apply(bp[blk._child_key(0)], x[:, None])
+            h = h[:, 0]
+            ap = bp[blk._child_key(1)]
+            q = _proj(ap["wq"], h).reshape(n, heads, hd)
+            k_new = _proj(ap["wk"], h).reshape(n, heads, hd)
+            v_new = _proj(ap["wv"], h).reshape(n, heads, hd)
+            # masked per-row scatter: inactive rows write their OLD value
+            # back, so their cache stays bitwise identical
+            kc_prev, vc_prev = new_carry[f"k{i}"], new_carry[f"v{i}"]
+            k_old, v_old = kc_prev[rows, wpos], vc_prev[rows, wpos]
+            k_wr = jnp.where(active[:, None, None],
+                             k_new.astype(cache_dtype), k_old)
+            v_wr = jnp.where(active[:, None, None],
+                             v_new.astype(cache_dtype), v_old)
+            kc = kc_prev.at[rows, wpos].set(k_wr)
+            vc = vc_prev.at[rows, wpos].set(v_wr)
+            new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
+            # per-row causal mask over the row's own cache prefix; scores
+            # accumulate fp32 regardless of the serving dtype
+            s = jnp.einsum("nhd,nlhd->nhl",
+                           (q * scale).astype(cache_dtype), kc,
+                           preferred_element_type=jnp.float32)
+            valid = jnp.arange(max_len)[None, None, :] <= wpos[:, None, None]
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("nhl,nlhd->nhd", p.astype(cache_dtype), vc,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype).reshape(n, heads * hd)
+            x = x + _proj(ap["wo"], ctx)
+            h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x[:, None])
+            h2 = h2[:, 0]
+            mlp = _proj(bp[blk._child_key(4)],
+                        jax.nn.gelu(_proj(bp[blk._child_key(3)], h2)))
+            x = x + mlp
+        xf, _ = lnf.apply(lnf_p, x[:, None])
+        logits = _proj(lin_p, xf[:, 0])
+        new_carry["pos"] = pos + active.astype(jnp.int32)
+        return jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1), new_carry
+
+    # the carry is DONATED: the engine replaces its pooled carry with the
+    # step's output every token, and without donation XLA materializes a
+    # complete second copy of the whole KV pool per generated token
+    # (~300 MB/step at 137M/8 slots). Callers must not touch the input
+    # carry after a step — read it (np.asarray) before stepping.
+    return jax.jit(step, donate_argnums=(3,)), init_carry
+
+
+# -- jitted-step cache (ADVICE r5: generate()/beam_generate() paid two
+# full XLA compiles per call; the serving engine shares the same cache) --
+
+import weakref as _weakref
+
+_SERVING_STEPS: dict = {}          # id(model) -> {(kind, dtype): step}
+
+
+def _step_cache(model: Sequential, kind: str, compute_dtype, builder):
+    """Per-(model, kind, compute_dtype) cache of built serving steps.
+
+    Keyed by ``id(model)`` with a ``weakref.finalize`` that drops the
+    entry when the model is collected (a dropped model frees its
+    compiled steps; a WeakKeyDictionary could NOT — the cached step
+    closures strongly reference the model, so weak keys would never
+    die). Dtype is keyed by name. Prompt-length buckets need no
+    explicit key: the cached prefill wrapper is ONE ``jax.jit`` whose
+    internal trace cache is keyed on argument shapes, so each (B, P)
+    bucket compiles once and is reused across calls. The cache assumes
+    the model's ARCHITECTURE is frozen after first use (the steps bake
+    structure, not weights — weights ride as runtime arguments)."""
+    import numpy as np
+
+    mid = id(model)
+    per_model = _SERVING_STEPS.get(mid)
+    if per_model is None:
+        per_model = _SERVING_STEPS[mid] = {}
+        # pops the entry at gc, so a recycled id() starts fresh
+        _weakref.finalize(model, _SERVING_STEPS.pop, mid, None)
+    key = (kind,
+           None if compute_dtype is None else np.dtype(compute_dtype).name)
+    if key not in per_model:
+        per_model[key] = builder()
+    return per_model[key]
+
+
+def get_decode_step(model: Sequential, compute_dtype=None):
+    """Cached :func:`make_decode_step` — same ``(step, init_carry)``
+    tuple for repeated calls with the same (model, compute_dtype)."""
+    return _step_cache(model, "decode", compute_dtype,
+                       lambda: make_decode_step(model, compute_dtype))
+
+
+def get_prefill_step(model: Sequential, compute_dtype=None):
+    """Cached :func:`make_prefill_step` (one wrapper; jit re-traces per
+    prompt-length bucket internally and caches each compilation)."""
+    return _step_cache(model, "prefill", compute_dtype,
+                       lambda: make_prefill_step(model, compute_dtype))
+
+
+def get_batch_decode_step(model: Sequential, compute_dtype=None):
+    """Cached :func:`make_batch_decode_step` (the serving engine's step)."""
+    return _step_cache(model, "batch_decode", compute_dtype,
+                       lambda: make_batch_decode_step(model, compute_dtype))
+
+
 def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
                   decode_length: int = 32, eos_id: int = -1,
                   alpha: float = 0.6, compute_dtype=None):
@@ -646,7 +851,8 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
 
     from bigdl_tpu.nn.beam_search import beam_search
 
-    step, init_carry = make_decode_step(model, compute_dtype=compute_dtype)
+    # cached per (model, dtype) — repeated calls stop paying XLA compiles
+    step, init_carry = get_decode_step(model, compute_dtype=compute_dtype)
     P = jax.device_put(serving_params(model, compute_dtype))
     prompt = [int(t) for t in prompt_ids]
     assert prompt, "need a non-empty prompt"
@@ -662,7 +868,7 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
     # identical; sequential single-token priming re-reads all weights
     # per prompt token)
     if len(prompt) > 1:
-        prefill = make_prefill_step(model, compute_dtype=compute_dtype)
+        prefill = get_prefill_step(model, compute_dtype=compute_dtype)
         ptoks = jnp.tile(jnp.asarray([t - 1 for t in prompt[:-1]],
                                      jnp.int32)[None], (K, 1))
         _, carry = prefill(P, ptoks, carry)
@@ -690,7 +896,8 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
     import jax.numpy as jnp
     import numpy as np
 
-    step, init_carry = make_decode_step(model, compute_dtype=compute_dtype)
+    # cached per (model, dtype) — repeated calls stop paying XLA compiles
+    step, init_carry = get_decode_step(model, compute_dtype=compute_dtype)
     P = jax.device_put(serving_params(model, compute_dtype))
     prompt = [int(t) for t in prompt_ids]
     assert prompt, "need a non-empty prompt"
@@ -702,7 +909,7 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
             "silently clamp (same guard as PositionEmbedding)")
     carry = init_carry(1)
     if len(prompt) > 1:
-        prefill = make_prefill_step(model, compute_dtype=compute_dtype)
+        prefill = get_prefill_step(model, compute_dtype=compute_dtype)
         ptoks = jnp.asarray([[t - 1 for t in prompt[:-1]]], jnp.int32)
         _, carry = prefill(P, ptoks, carry)
 

@@ -34,6 +34,12 @@ class Metrics:
             vals = self._values.get(name, [])
             return sum(vals), len(vals)
 
+    def values(self, name: str) -> List[float]:
+        """Copy of the raw recorded samples (percentile consumers — e.g.
+        serving TTFT — need more than get()'s (sum, count))."""
+        with self._lock:
+            return list(self._values.get(name, []))
+
     def mean(self, name: str) -> float:
         total, n = self.get(name)
         return total / n if n else 0.0

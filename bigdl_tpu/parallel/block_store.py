@@ -403,6 +403,13 @@ class BlockStoreParameter:
         # arrival's true (upper-bound) duration can enter the calibration
         # window and the deadline can adapt upward on recovery
         self._late_probes: Dict[Tuple[int, int], float] = {}
+        # per-peer window of recent RAW publish→arrival wall-clock deltas
+        # (time.time() - send_ts). The minimum over the window estimates
+        # that peer's constant clock-offset + minimum-transfer baseline;
+        # calibration records only the EXCESS over it, so NTP skew of
+        # either sign cannot inflate (or deflate) the drop deadline. A
+        # bounded window lets the baseline track slow clock drift.
+        self._peer_transfer_raw: Dict[int, deque] = {}
         # async_puts decouples this process's REMOTE gradient transfers
         # from its own aggregate→publish_weights pipeline (the reference
         # decoupled them structurally: gradient tasks vs BlockManager
@@ -461,9 +468,11 @@ class BlockStoreParameter:
     # slowest process records ~0 s for contributions that landed before it
     # began aggregating, collapsing the window to min_deadline_s and
     # dropping honest peers on the first jitter. Wall clock (not
-    # monotonic) because the marker crosses processes; same-host pods
-    # share it exactly, and multi-host NTP skew is ms-scale against the
-    # ≥min_deadline_s (50 ms) floor. Negative skew clamps to 0.
+    # monotonic) because the marker crosses processes; the owner is
+    # skew-immune either way — it subtracts a per-peer baseline (min of
+    # recent raw deltas, see _transfer_sample) before recording, so a
+    # CONSTANT clock offset of either sign cancels and only excess
+    # transfer/queue delay enters the calibration window.
     def _encode_g(self, arr: np.ndarray) -> bytes:
         return struct.pack(">d", time.time()) + self._encode(arr)
 
@@ -471,6 +480,37 @@ class BlockStoreParameter:
     def _decode_g(blob: bytes) -> Tuple[float, np.ndarray]:
         (send_ts,) = struct.unpack(">d", blob[:8])
         return send_ts, BlockStoreParameter._decode(blob[8:])
+
+    def _transfer_sample(self, src: int, send_ts: float) -> float:
+        """Skew-bounded publish→arrival calibration term for a gradient
+        block from ``src``: the raw wall-clock delta minus that peer's
+        baseline — the min over its PREVIOUS raw deltas, which estimates
+        clock offset plus best-case transfer time. From the second marker
+        on, a constant NTP offset of either sign cancels and only excess
+        transfer/queue delay is recorded; without the baseline, positive
+        skew (owner clock ahead of sender) inflated EVERY sample and
+        permanently disabled straggler drops (ADVICE r5). The peer's
+        FIRST marker has no baseline and records its raw delta — one
+        possibly-skewed sample cannot outlive the bounded calibration
+        window (and typically lands during warmup), while a genuinely
+        early-published blob's sitting time stays visible to the
+        calibration (the round-4 slow-owner fix).
+
+        Tradeoff (inherent — one-directional timestamps cannot separate
+        a constant clock offset from a constant sitting time): an owner
+        that is persistently ~S s slower than its peers now calibrates
+        toward the VARIATION in sitting time rather than S itself, so
+        once the window fills, a hiccup larger than the deadline costs
+        one dropped contribution before :meth:`_probe_late_arrivals`
+        records the late arrival's full wait and pulls the quantile back
+        up. That one-drop-then-adapt cost buys skew immunity; the
+        pre-baseline behavior was strictly worse under skew (drops
+        permanently disabled)."""
+        raw = time.time() - send_ts
+        window = self._peer_transfer_raw.setdefault(src, deque(maxlen=32))
+        baseline = min(window) if window else 0.0
+        window.append(raw)
+        return max(0.0, raw - baseline)
 
     # -- the four reference verbs -----------------------------------------
 
@@ -584,22 +624,25 @@ class BlockStoreParameter:
                     pending.remove(src)
                     if self.drop is not None:
                         # PER-CONTRIBUTION sample = max(wait since MY
-                        # aggregation start, publish→arrival from the
-                        # sender's embedded marker). The wait term is the
-                        # actual decision variable (the deadline cuts off
-                        # wait-since-start), so compute-slow peers keep
+                        # aggregation start, baseline-corrected
+                        # publish→arrival from the sender's embedded
+                        # marker — see _transfer_sample). The wait term is
+                        # the actual decision variable (the deadline cuts
+                        # off wait-since-start), so compute-slow peers keep
                         # registering their full lateness and the quantile
                         # can adapt upward; the transfer term keeps an
                         # owner that is ITSELF the slowest from recording
                         # ~0 s for contributions that landed before it
-                        # began aggregating and collapsing the window to
-                        # min_deadline_s. A deadline-truncated wait is
+                        # began aggregating — per-peer VARIATION in
+                        # sitting time (a constant component cancels into
+                        # the skew baseline; see _transfer_sample's
+                        # tradeoff note). A deadline-truncated wait is
                         # still never recorded (in-loop arrivals have
                         # wait < deadline by construction), so the window
                         # cannot fill with deadline-valued samples.
                         self.drop.record(max(
                             0.0, time.monotonic() - t0,
-                            time.time() - send_ts))
+                            self._transfer_sample(src, send_ts)))
             if not pending:
                 break
             now = time.monotonic()
@@ -641,14 +684,15 @@ class BlockStoreParameter:
         for (tp, src), t0 in list(self._late_probes.items()):
             blob = self.store.try_get(self._gkey(tp, self.pid, src))
             if blob is not None:
-                # same max(wait, transfer) convention as the in-loop
-                # sample: the wait term (observed from the DROPPED
-                # iteration's aggregation start) is what lets a recovered
-                # compute-slow straggler pull the quantile back up. Only
-                # the 8-byte marker is needed — skip the array decode.
+                # same max(wait, baseline-corrected transfer) convention
+                # as the in-loop sample: the wait term (observed from the
+                # DROPPED iteration's aggregation start) is what lets a
+                # recovered compute-slow straggler pull the quantile back
+                # up. Only the 8-byte marker is needed — skip the array
+                # decode.
                 (send_ts,) = struct.unpack(">d", blob[:8])
                 self.drop.record(max(0.0, time.monotonic() - t0,
-                                     time.time() - send_ts))
+                                     self._transfer_sample(src, send_ts)))
                 del self._late_probes[(tp, src)]
                 self.store.delete(self._gkey(tp, self.pid, src))
             elif tp <= t - 2:

@@ -1,0 +1,22 @@
+"""bigdl_tpu.serving — continuous-batching inference engine.
+
+The serving layer between the model zoo and the parallel stack: many
+independent generation requests share ONE pooled, slot-indexed KV cache
+and ONE compiled per-row decode program, with FIFO admission into rows
+freed mid-flight (continuous batching). See ``docs/serving.md``.
+
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=8, compute_dtype=jnp.bfloat16)
+    rid = eng.submit([3, 7, 2], max_new_tokens=32, eos_id=5)
+    outputs = eng.drain()            # {rid: 1-based token ids}
+    print(eng.metrics.summary())     # TTFT percentiles, tokens/sec, ...
+"""
+
+from bigdl_tpu.serving.engine import ServingEngine
+from bigdl_tpu.serving.kv_pool import KVPool
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
+           "Scheduler"]

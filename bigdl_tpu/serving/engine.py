@@ -1,0 +1,219 @@
+"""Continuous-batching serving engine for :func:`TransformerLM`.
+
+``generate()`` runs one request per call with a private KV carry and
+pays the full weight-read bandwidth per token for a single row.
+:class:`ServingEngine` instead serves MANY independent requests from one
+pooled cache (:class:`bigdl_tpu.serving.kv_pool.KVPool`) stepped by ONE
+compiled per-row-position decode program
+(:func:`bigdl_tpu.models.transformer.make_batch_decode_step`):
+
+* requests are ``submit()``-ed at any time and queue FIFO;
+* before every decode step the scheduler admits waiting requests into
+  free slots — the prompt is ingested in one
+  :func:`make_prefill_step` pass and row-scattered into the pooled
+  cache (continuous batching: admission happens MID-FLIGHT, between
+  decode steps of the requests already running);
+* every ``step()`` decodes one token for ALL active rows at once —
+  decode is weight-read-bound, so a batched step costs roughly what a
+  single-row step costs and aggregate tokens/sec scales with occupancy
+  (measured in benchmarks/serving_bench.py);
+* rows are evicted at EOS or ``max_new_tokens`` and their slot returns
+  to the free list for the next admission.
+
+Decoding is GREEDY (argmax), and the pooled step computes the same math
+as the single-request step, so engine outputs match per-request
+``generate(..., temperature=0)`` token for token — pinned by
+tests/test_serving.py for plain and bf16-serving params. (The two steps
+are numerically equal only to float round-off — different batch shapes
+can reorder XLA reductions — so a checkpoint whose top-2 logprobs tie
+within ~1e-5 could in principle break a tie differently; the parity
+tests pin the realistic case, not a bitwise guarantee.)
+
+The jitted step/prefill functions come from the per-(model, dtype) step
+cache (``get_batch_decode_step`` / ``get_prefill_step``), so several
+engines over one model — or an engine plus ad-hoc ``generate()`` calls —
+share compilations; prompt-length buckets re-trace once each inside the
+cached prefill's own jit cache.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.serving.kv_pool import KVPool
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.scheduler import Request, Scheduler
+
+
+class ServingEngine:
+    """Continuous-batching greedy decoder over a pooled KV cache.
+
+    ``n_slots`` is the fixed decode capacity (concurrent requests);
+    ``compute_dtype`` is the serving precision knob (weights + KV cache,
+    e.g. ``jnp.bfloat16`` — scores and log-softmax stay fp32);
+    ``policy`` is the admission policy (``"prefill_priority"`` = admit
+    into freed rows before every step, ``"fifo"`` = refill only after
+    the running batch drains — see ``serving.scheduler``).
+    """
+
+    def __init__(self, model, n_slots: int = 8, compute_dtype=None,
+                 policy: str = "prefill_priority",
+                 metrics: Optional[ServingMetrics] = None) -> None:
+        import jax
+
+        from bigdl_tpu.models.transformer import (
+            get_batch_decode_step, get_prefill_step, serving_params,
+        )
+
+        model._ensure_params()
+        self.model = model
+        self.max_len = model.modules[1].max_len
+        self.compute_dtype = compute_dtype
+        # weights as resident device buffers in the serving dtype
+        # (runtime arguments — never baked into the compiled programs)
+        self.params = jax.device_put(serving_params(model, compute_dtype))
+        self._step_fn, pool_init = get_batch_decode_step(model, compute_dtype)
+        self._prefill_fn = get_prefill_step(model, compute_dtype)
+        # ONE fresh B=1 carry for prefill, built once and reused for every
+        # admission (prefill returns a new carry; jax arrays are
+        # immutable, so sharing the zero input is free — at 137M scale a
+        # per-admission rebuild would be ~12 MB of pure allocation churn).
+        # pool_init's carry layout is make_decode_step's, so n_slots=1 IS
+        # the single-request carry.
+        self._zero_carry1 = pool_init(1)
+        self.pool = KVPool(pool_init, n_slots)
+        self.scheduler = Scheduler(policy)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._next_id = 0
+        self._finished: Dict[int, Request] = {}
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
+               eos_id: int = -1) -> int:
+        """Queue one generation request (1-based prompt ids, like
+        ``generate()``); returns its request id. Raises if the request
+        could ever overflow the cache (same ``max_len`` guard as
+        ``generate()``)."""
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("need a non-empty prompt")
+        if len(prompt) - 1 + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the model's max_len "
+                f"{self.max_len} — the cache position would silently "
+                "clamp (same guard as generate())")
+        rid = self._next_id
+        self._next_id += 1
+        self.scheduler.submit(Request(
+            req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            eos_id=int(eos_id), submit_time=time.perf_counter()))
+        self.metrics.on_submit()
+        return rid
+
+    def result(self, req_id: int) -> Optional[np.ndarray]:
+        """Generated 1-based ids for a FINISHED request, else None."""
+        req = self._finished.get(req_id)
+        return None if req is None else np.asarray(req.output, np.int32)
+
+    def request(self, req_id: int) -> Optional[Request]:
+        return self._finished.get(req_id)
+
+    # -- the serving loop --------------------------------------------------
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+
+        n = self.scheduler.admissible(self.pool.free_slots)
+        for _ in range(n):
+            slot = self.pool.alloc()
+            assert slot is not None          # admissible() checked
+            req = self.scheduler.admit(slot)
+            prompt0 = [t - 1 for t in req.prompt]     # 0-based
+            if len(prompt0) > 1:
+                t0 = time.perf_counter()
+                ptoks = jnp.asarray([prompt0[:-1]], jnp.int32)
+                _, pc = self._prefill_fn(self.params, ptoks,
+                                         self._zero_carry1)
+                self.pool.write_prefill(slot, pc, len(prompt0) - 1)
+                self.metrics.add_phase("prefill",
+                                       time.perf_counter() - t0)
+            else:
+                self.pool.set_pos(slot, 0)
+            # the last prompt token is the first decode input — exactly
+            # generate()'s convention, so outputs match token-for-token
+            req.next_token = prompt0[-1]
+
+    def step(self) -> Dict[int, int]:
+        """Admit waiting requests, then decode ONE token for every active
+        row. Returns ``{req_id: 1-based token}`` emitted this step (empty
+        when the engine is idle)."""
+        import jax.numpy as jnp
+
+        self._admit()
+        running = self.scheduler.running
+        if not running:
+            return {}
+        N = self.pool.n_slots
+        tokens = np.zeros((N,), np.int32)
+        active = np.zeros((N,), bool)
+        for slot, req in running.items():
+            tokens[slot] = req.next_token
+            active[slot] = True
+        t0 = time.perf_counter()
+        logp, carry = self._step_fn(self.params, jnp.asarray(tokens),
+                                    jnp.asarray(active), self.pool.carry)
+        self.pool.carry = carry
+        # ONE host readback per step: the argmax reduces (N, V) → (N,)
+        # on device before crossing
+        nxt = np.asarray(jnp.argmax(logp, axis=-1))
+        self.metrics.add_phase("decode_step", time.perf_counter() - t0)
+        self.metrics.on_step(self.scheduler.queue_depth,
+                             self.pool.occupancy(), int(active.sum()))
+
+        emitted: Dict[int, int] = {}
+        now = time.perf_counter()
+        for slot, req in list(running.items()):
+            tok0 = int(nxt[slot])
+            tok1 = tok0 + 1                      # back to 1-based ids
+            req.output.append(tok1)
+            emitted[req.req_id] = tok1
+            if req.first_token_time is None:
+                req.first_token_time = now
+                self.metrics.on_first_token(now - req.submit_time)
+            done = ((req.eos_id > 0 and tok1 == req.eos_id)
+                    or len(req.output) >= req.max_new_tokens)
+            if done:
+                freed = self.scheduler.finish(req, now)
+                self.pool.free(freed)
+                self._finished[req.req_id] = req
+                self.metrics.on_finish(now - req.submit_time,
+                                       len(req.output))
+            else:
+                req.next_token = tok0
+        return emitted
+
+    def drain(self) -> Dict[int, np.ndarray]:
+        """Step until every submitted request has finished; returns
+        ``{req_id: generated 1-based ids}`` for ALL finished requests."""
+        while not self.scheduler.idle():
+            self.step()
+        return {rid: np.asarray(r.output, np.int32)
+                for rid, r in self._finished.items()}
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return self.scheduler.queue_depth
+
+    @property
+    def active(self) -> int:
+        return self.scheduler.active
+
+    def idle(self) -> bool:
+        return self.scheduler.idle()

@@ -1,0 +1,133 @@
+"""Pooled, slot-indexed KV cache with a free-list allocator.
+
+The serving analog of the reference's fixed executor pool (SoCC'19: work
+is scheduled onto a FIXED set of executors instead of spawning per-job
+state): decode capacity is ``n_slots`` rows of ONE pooled per-layer K/V
+cache, allocated/freed per request through a free list, instead of the
+per-call private carries ``generate()`` builds. One pool + one compiled
+step means admission and eviction never change tensor shapes — the XLA
+program is compiled once and reused for the engine's whole lifetime.
+
+The pool's tensors ARE a :func:`make_batch_decode_step` carry (same
+``pos``/``k{i}``/``v{i}`` layout), so the engine hands ``pool.carry``
+straight to the step function and stores the returned carry back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class KVPool:
+    """Fixed-capacity pooled KV cache: ``n_slots`` independent rows.
+
+    * :meth:`alloc` pops a slot id off the free list (None when full);
+    * :meth:`free` zeroes the row's position and returns the slot;
+    * :meth:`write_prefill` row-scatters a prefilled single-request
+      carry (from ``make_prefill_step`` on a fresh B=1 carry) into a
+      slot — the cheap admission path for mid-flight continuous
+      batching.
+
+    Invariants (pinned by tests/test_serving.py): a slot is never handed
+    out twice without an intervening free (no aliasing), ``free`` of an
+    unallocated slot raises, and after every request drains the free
+    list holds all ``n_slots`` again (no leaks).
+    """
+
+    def __init__(self, init_carry, n_slots: int) -> None:
+        import jax
+
+        if n_slots <= 0:
+            raise ValueError(f"n_slots must be positive, got {n_slots}")
+        self.n_slots = int(n_slots)
+        self.carry = init_carry(self.n_slots)
+        self.n_layers = sum(1 for k in self.carry if k.startswith("k"))
+        self.max_len = int(self.carry["k0"].shape[1])
+        # LIFO free list: the most recently freed row is the most likely
+        # to still be resident in cache/HBM
+        self._free: List[int] = list(range(self.n_slots - 1, -1, -1))
+        self._in_use: set = set()
+        # ONE jitted, donated scatter for admissions: copies every
+        # layer's full B=1 prefill row into the slot in place. Op-by-op
+        # eager updates would allocate 2*n_layers full-pool output
+        # buffers per admission (hundreds of MB of HBM traffic at LM
+        # scale); donation updates the pool buffers in place, and
+        # copying the FULL max_len row (tail zeros included — masked by
+        # pos anyway) keeps the program length-independent, so it
+        # compiles exactly once per pool.
+        self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0,))
+
+    def _scatter_impl(self, carry, prefill_carry, slot, pos):
+        from jax import lax
+
+        out = dict(carry)
+        for i in range(self.n_layers):
+            for kind in ("k", "v"):
+                key = f"{kind}{i}"
+                src = prefill_carry[key].astype(carry[key].dtype)
+                out[key] = lax.dynamic_update_slice(
+                    carry[key], src, (slot, 0, 0, 0))
+        out["pos"] = carry["pos"].at[slot].set(pos)
+        return out
+
+    # -- allocator ---------------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """A free slot id, or None when the pool is saturated."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._in_use.remove(slot)
+        self._free.append(slot)
+        # reset the row's position so a recycled slot starts fresh; the
+        # stale K/V rows are harmless (masked by pos) and zeroing them
+        # would be pure HBM traffic
+        import jax.numpy as jnp
+
+        self.carry["pos"] = self.carry["pos"].at[slot].set(
+            jnp.int32(0))
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return len(self._in_use)
+
+    def occupancy(self) -> float:
+        return self.used_slots / self.n_slots
+
+    # -- prefill admission -------------------------------------------------
+
+    def write_prefill(self, slot: int, prefill_carry: Dict,
+                      prompt_len: int) -> None:
+        """Row-scatter a B=1 prefilled carry into ``slot``: per-layer K/V
+        positions ``0..prompt_len-1`` land in the pooled row and the
+        slot's ``pos`` becomes ``prompt_len`` — after this the slot
+        decodes exactly as if it had been stepped ``prompt_len`` times.
+        (The full ``max_len`` row is copied — the tail is the prefill
+        carry's zeros, invisible behind ``pos`` — via the single jitted
+        donated scatter built in ``__init__``.)"""
+        import jax.numpy as jnp
+
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        if not 0 < prompt_len <= self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} outside 1..{self.max_len}")
+        self.carry = self._scatter(self.carry, prefill_carry,
+                                   jnp.int32(slot), jnp.int32(prompt_len))
+
+    def set_pos(self, slot: int, pos: int) -> None:
+        """Set one slot's position counter (the no-prefill admission path:
+        a 1-token prompt starts decoding at pos 0)."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self.carry["pos"] = self.carry["pos"].at[slot].set(int(pos))

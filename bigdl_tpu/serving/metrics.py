@@ -1,0 +1,94 @@
+"""Serving observability counters.
+
+Layered on :class:`bigdl_tpu.optim.metrics.Metrics` (the reference's
+``Metrics.scala`` analog, already exercised by the observability suite)
+so serving counters ride the same set/add/mean surface the training plane
+uses — a ``TrainSummary``-style consumer can read either.
+
+Counters (all under the ``serving/`` prefix in the backing Metrics):
+
+* ``queue_depth``       — sampled every engine step
+* ``slot_occupancy``    — used/total slots, sampled every engine step
+* ``batch_active``      — active rows per decode step
+* ``ttft_s``            — per-request time-to-first-token (submit →
+  first GENERATED token on host; includes queueing + prefill)
+* ``latency_s``         — per-request submit → finish
+* ``tokens_out``        — generated tokens per request (recorded at
+  finish; sum = total tokens served)
+* ``prefill_s`` / ``decode_step_s`` — phase timings
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from bigdl_tpu.optim.metrics import Metrics
+
+
+class ServingMetrics:
+    """Queue/latency/throughput counters for :class:`ServingEngine`."""
+
+    def __init__(self, backing: Optional[Metrics] = None) -> None:
+        self.metrics = backing if backing is not None else Metrics()
+        self._t_start: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- engine hooks ------------------------------------------------------
+
+    def on_submit(self) -> None:
+        self.metrics.add("serving/submitted", 1.0)
+
+    def on_step(self, queue_depth: int, occupancy: float,
+                batch_active: int) -> None:
+        now = time.perf_counter()
+        if self._t_start is None:
+            self._t_start = now
+        self._t_last = now
+        self.metrics.add("serving/queue_depth", float(queue_depth))
+        self.metrics.add("serving/slot_occupancy", float(occupancy))
+        self.metrics.add("serving/batch_active", float(batch_active))
+
+    def on_first_token(self, ttft_s: float) -> None:
+        self.metrics.add("serving/ttft_s", float(ttft_s))
+
+    def on_finish(self, latency_s: float, n_tokens: int) -> None:
+        self.metrics.add("serving/finished", 1.0)
+        self.metrics.add("serving/latency_s", float(latency_s))
+        self.metrics.add("serving/tokens_out", float(n_tokens))
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        self.metrics.add(f"serving/{name}_s", float(seconds))
+
+    # -- derived views -----------------------------------------------------
+
+    def _values(self, name: str) -> List[float]:
+        return self.metrics.values(f"serving/{name}")
+
+    def tokens_per_sec(self) -> float:
+        """Aggregate generated-token throughput over the engine's active
+        window (first step → last step)."""
+        total, _ = self.metrics.get("serving/tokens_out")
+        if self._t_start is None or self._t_last is None \
+                or self._t_last <= self._t_start:
+            return 0.0
+        return total / (self._t_last - self._t_start)
+
+    def ttft_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        import numpy as np
+
+        vals = self._values("ttft_s")
+        if not vals:
+            return {f"p{q}": 0.0 for q in qs}
+        arr = np.asarray(vals)
+        return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
+
+    def summary(self) -> Dict[str, float]:
+        """Means of every serving counter plus derived throughput/TTFT
+        percentiles — one flat dict for logging/asserting."""
+        out = {k: v for k, v in self.metrics.summary().items()
+               if k.startswith("serving/")}
+        out["serving/tokens_per_sec"] = self.tokens_per_sec()
+        for k, v in self.ttft_percentiles().items():
+            out[f"serving/ttft_{k}_s"] = v
+        return out

@@ -1,0 +1,113 @@
+"""Request lifecycle + admission scheduling for the serving engine.
+
+The reference scheduled jobs onto a fixed executor pool FIFO (SoCC'19);
+here the "executors" are decode slots in the pooled KV cache and the
+"jobs" are generation requests. The scheduler owns the waiting queue and
+the WAITING → RUNNING → FINISHED lifecycle; the engine owns the tensors.
+
+Admission policies:
+
+* ``"prefill_priority"`` (default) — before EVERY decode step, waiting
+  requests are admitted into any free slots (continuous batching: new
+  arrivals slot into rows freed mid-flight, minimizing time-to-first-
+  token and keeping the batch full);
+* ``"fifo"`` — slots are only refilled once the whole running batch has
+  drained (run-to-completion batching, the classic static-batch
+  baseline; still FIFO across requests). Useful as the contrast
+  baseline in benchmarks/serving_bench.py.
+
+Both are FIFO in ARRIVAL ORDER — the policies differ only in WHEN free
+slots are refilled, never in which request goes first.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+_POLICIES = ("prefill_priority", "fifo")
+
+
+@dataclass
+class Request:
+    """One generation request's full lifecycle record."""
+
+    req_id: int
+    prompt: List[int]                  # 1-based word ids, non-empty
+    max_new_tokens: int
+    eos_id: int = -1                   # 1-based, -1 = none
+    state: str = WAITING
+    slot: Optional[int] = None
+    next_token: Optional[int] = None   # 0-based token to feed next step
+    output: List[int] = field(default_factory=list)   # 1-based ids
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def done_reason(self) -> Optional[str]:
+        if self.state != FINISHED:
+            return None
+        if self.output and self.eos_id > 0 and self.output[-1] == self.eos_id:
+            return "eos"
+        return "length"
+
+
+class Scheduler:
+    """FIFO admission over a fixed slot pool (see module docstring)."""
+
+    def __init__(self, policy: str = "prefill_priority") -> None:
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"unknown admission policy {policy!r} (one of {_POLICIES})")
+        self.policy = policy
+        self.waiting: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}     # slot -> request
+
+    def submit(self, req: Request) -> None:
+        if not req.prompt:
+            raise ValueError("need a non-empty prompt")
+        if req.max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        req.state = WAITING
+        self.waiting.append(req)
+
+    def admissible(self, free_slots: int) -> int:
+        """How many waiting requests may be admitted right now."""
+        if not free_slots or not self.waiting:
+            return 0
+        if self.policy == "fifo" and self.running:
+            return 0          # run-to-completion: wait for a full drain
+        return min(free_slots, len(self.waiting))
+
+    def admit(self, slot: int) -> Request:
+        """Pop the next waiting request (FIFO) and bind it to ``slot``."""
+        req = self.waiting.popleft()
+        req.state = RUNNING
+        req.slot = slot
+        self.running[slot] = req
+        return req
+
+    def finish(self, req: Request, now: float) -> int:
+        """Mark finished; returns the freed slot id."""
+        slot = req.slot
+        assert slot is not None and self.running.get(slot) is req
+        del self.running[slot]
+        req.state = FINISHED
+        req.slot = None
+        req.finish_time = now
+        return slot
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active(self) -> int:
+        return len(self.running)
+
+    def idle(self) -> bool:
+        return not self.waiting and not self.running

@@ -131,6 +131,41 @@ def test_calibration_also_captures_compute_slow_peers(tmp_path):
     assert policy._samples[0] >= 0.15, list(policy._samples)
 
 
+def test_calibration_transfer_term_immune_to_clock_skew(tmp_path):
+    """Positive wall-clock skew (owner clock AHEAD of the sender) must not
+    inflate steady-state calibration samples: the per-peer min-offset
+    baseline cancels a constant offset from the second marker on, so skew
+    cannot permanently inflate the drop deadline and silently disable
+    straggler drops (round-5 ADVICE). The first marker (no baseline yet)
+    may carry the offset once — it ages out of the bounded window."""
+    from unittest import mock
+
+    import bigdl_tpu.parallel.block_store as bs
+
+    store = FsBlockStore(str(tmp_path / "bs"))
+    policy = GradientDropPolicy(0.5, warmup_iteration=0,
+                                min_deadline_s=0.05)
+    owner = BlockStoreParameter(store, 2, 0, 8, drop_policy=policy,
+                                timeout_s=5.0)
+    peer = BlockStoreParameter(store, 2, 1, 8, timeout_s=5.0)
+    g = np.ones(8, np.float32)
+    real_time = time.time
+    for t in range(3):
+        # the peer's send markers are stamped by a clock 5 s BEHIND the
+        # owner's → every raw publish→arrival delta is ~+5 s
+        with mock.patch.object(bs.time, "time",
+                               lambda: real_time() - 5.0):
+            peer.put_gradients(t, g)
+        owner.put_gradients(t, g)
+        owner.aggregate_my_partition(t)
+    samples = list(policy._samples)
+    assert len(samples) == 3
+    assert samples[0] >= 4.0, samples       # first marker: raw (no baseline)
+    # thereafter the constant offset cancels — samples are the genuine
+    # excess transfer/queue delay (~0 here), not the 5 s skew
+    assert max(samples[1:]) < 1.0, samples
+
+
 def test_coord_store_self_check_raises_runtime_error():
     """The startup self-check must verify its probes with explicit raises
     (not bare ``assert``, which ``python -O`` strips — round-4 ADVICE

@@ -293,8 +293,19 @@ def test_allreduce_construction_single_collective_on_wire():
 
     mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
     pcast = getattr(lax, "pcast", None)
+    pvary = getattr(lax, "pvary", None)
+    if pcast is None and pvary is None:
+        # NOTE: on such a jax the varying-mark construction (and the
+        # distri_optimizer hot path that uses it) cannot be BUILT at all,
+        # so there is no behavior to pin here — the skip loses coverage
+        # only on toolchains where the feature itself is absent
+        pytest.skip("this jax predates lax.pcast/lax.pvary — the "
+                    "varying-mark construction under test cannot be built")
     mark = ((lambda t: pcast(t, "data", to="varying")) if pcast is not None
-            else (lambda t: lax.pvary(t, "data")))
+            else (lambda t: pvary(t, "data")))
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:                    # pre-0.6 spelling
+        from jax.experimental.shard_map import shard_map
 
     def make(marked):
         def f(x, w):
@@ -303,7 +314,7 @@ def test_allreduce_construction_single_collective_on_wire():
                 lambda w_: jnp.mean(jnp.dot(x, w_) ** 2))(wv)
             return lax.pmean(g, "data"), lax.pmean(loss, "data")
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             f, mesh=mesh, in_specs=(P("data"), P()), out_specs=(P(), P())))
 
     x = np.ones((8, 16), np.float32) * 0.25
@@ -325,7 +336,17 @@ def test_allreduce_construction_single_collective_on_wire():
                     total += 4 * k
         return total
 
-    # marked (the framework construction): grads (64 f32) + loss, ONCE
-    assert allreduce_f32_bytes(make(True)) == 64 * 4 + 4
-    # unmarked: auto-psum'd cotangent + explicit pmean = the grad twice
-    assert allreduce_f32_bytes(make(False)) == 2 * 64 * 4 + 4
+    # RELATIONAL assertions, not exact byte pins: XLA formatting/combining
+    # changes (tupled all-reduces, loss folded into the grad reduce) can
+    # shift the textual accounting by a few bytes without any behavioral
+    # regression. What the hot path depends on is only that the marked
+    # construction reduces the gradient ONCE and the unmarked one pays
+    # for it twice (auto-psum'd cotangent + explicit pmean).
+    grad_bytes = 64 * 4
+    marked = allreduce_f32_bytes(make(True))
+    unmarked = allreduce_f32_bytes(make(False))
+    assert marked < unmarked, (marked, unmarked)
+    # marked: at least the gradient, and strictly less than two of them
+    assert grad_bytes <= marked < 2 * grad_bytes, (marked, grad_bytes)
+    # unmarked: the gradient goes over the wire (at least) twice
+    assert unmarked >= 2 * grad_bytes, (unmarked, grad_bytes)
