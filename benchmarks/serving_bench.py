@@ -1,9 +1,17 @@
-"""Continuous-batching serving throughput under mixed arrivals.
+"""Continuous-batching serving throughput under mixed arrivals, plus the
+batched-admission scenario (``--scenario admission``): ragged prompt
+lengths + a shared system prefix, comparing PR 1's per-request admission
+(B=1 prefill per request, one XLA trace per NOVEL prompt length,
+mid-admission) against the batched admission subsystem (bucketed masked
+multi-row prefill + prefix cache — bounded compiled-program set). The
+admission scenario deliberately runs COLD: the compile stall on novel
+lengths IS the phenomenon under study.
 
-The question decode_bench.py leaves open: decode_bench measures a FIXED
-batch decoded in lockstep, but production traffic is independent
-requests arriving at staggered times with different prompt/output
-lengths. This bench replays one such trace two ways:
+The mixed-arrival question decode_bench.py leaves open: decode_bench
+measures a FIXED batch decoded in lockstep, but production traffic is
+independent requests arriving at staggered times with different
+prompt/output lengths. The default scenario replays one such trace two
+ways:
 
 * **sequential** — requests served one at a time in arrival order with
   the per-call KV-cached path (``get_decode_step``/``get_prefill_step``,
@@ -154,6 +162,121 @@ def run_engine(lm, dtype, trace, n_slots: int, policy: str):
                 eng.metrics.metrics.mean("serving/slot_occupancy"), 3)}
 
 
+def make_ragged_trace(cfg, n_requests: int, gen_tokens: int,
+                      shared_frac: float = 0.5, prefix_len: int = 12,
+                      seed: int = 7):
+    """The admission-stress trace: EVERY prompt has a distinct length
+    (the per-request path's worst case — one compile per length) and a
+    ``shared_frac`` fraction open with one shared ``prefix_len``-token
+    system prefix (the prefix cache's best case)."""
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(1, cfg["vocab"] + 1, size=(prefix_len,)).tolist()
+    # distinct lengths while they fit; wrap once a prompt plus its
+    # generation budget would overflow the model's max_len
+    max_plen = max(cfg["max_len"] - gen_tokens + 1, 3)
+    plens = [2 + i % (max_plen - 1) for i in range(n_requests)]
+    eligible = [i for i in range(n_requests) if plens[i] > prefix_len + 1]
+    shared = set(rng.choice(eligible,
+                            size=int(len(eligible) * shared_frac),
+                            replace=False).tolist()) if eligible else set()
+    with_prefix, without = [], []
+    for i in range(n_requests):
+        plen = plens[i]
+        if i in shared:
+            prompt = prefix + rng.randint(
+                1, cfg["vocab"] + 1, size=(plen - prefix_len,)).tolist()
+            with_prefix.append((0.0, prompt, gen_tokens))
+        else:
+            prompt = rng.randint(1, cfg["vocab"] + 1, size=(plen,)).tolist()
+            without.append((0.0, prompt, gen_tokens))
+    # interleave SUBMIT order so shared-prefix prompts spread across
+    # admission waves: within one wave every lookup precedes that
+    # wave's inserts, so same-wave repeats can't hit — spreading them
+    # is what exercises the cache-hit path
+    trace, step = [], max(1, n_requests // (len(with_prefix) + 1))
+    for j in range(n_requests):
+        src = with_prefix if (j % step == step - 1 and with_prefix) \
+            else (without or with_prefix)
+        trace.append(src.pop(0))
+    return trace
+
+
+def run_admission_mode(lm, dtype, trace, n_slots: int, admission: str,
+                       prefix_cache: bool):
+    """One cold engine pass; reports admission-phase time and the
+    compiled prefill-program count next to the usual aggregates."""
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=n_slots, compute_dtype=dtype,
+                        admission=admission, prefix_cache=prefix_cache)
+    for _, prompt, n_new in trace:
+        eng.submit(prompt, max_new_tokens=n_new)
+    t0 = time.perf_counter()
+    outs = eng.drain()
+    wall = time.perf_counter() - t0
+    prefill_s, n_calls = eng.metrics.metrics.get("serving/prefill_s")
+    if admission == "batched":
+        programs = eng._batch_prefill_fn._jitted._cache_size()
+    else:
+        programs = eng._prefill_fn._jitted._cache_size()
+    out = {"wall_s": round(wall, 3),
+           "admission_s": round(prefill_s, 3),
+           "prefill_calls": n_calls,
+           "prefill_programs": programs,
+           "ttft": _percentiles([eng.request(rid).first_token_time
+                                 - eng.request(rid).submit_time
+                                 for rid in outs])}
+    if prefix_cache:
+        out["prefix_hit_rate"] = round(eng.prefix_cache.hit_rate(), 3)
+        out["prefix_hit_tokens"] = eng.prefix_cache.hit_tokens
+    return out, outs
+
+
+def run_admission(model: str = "tiny", variant: str = "fp32",
+                  n_requests: int = 20, gen_tokens: int = 4,
+                  n_slots: int = 8, shared_frac: float = 0.5,
+                  prefix_len: int = 12) -> dict:
+    """Batched vs per-request ADMISSION on the ragged + shared-prefix
+    trace. Decode is pre-warmed (both paths share the pooled step); the
+    prefill paths start cold on purpose — bounding that compile set is
+    the subsystem's reason to exist. ``n_slots < n_requests`` so
+    admission happens in waves and later waves hit the prefix cache."""
+    from bigdl_tpu.serving import ServingEngine, bucket_len
+
+    lm, dtype, cfg = build(model, variant)
+    trace = make_ragged_trace(cfg, n_requests, gen_tokens,
+                              shared_frac, prefix_len)
+    # warm ONLY the shared pooled decode step (1-token prompts touch no
+    # prefill path), so the comparison isolates admission cost
+    warm = ServingEngine(lm, n_slots=n_slots, compute_dtype=dtype)
+    warm.submit([1], max_new_tokens=2)
+    warm.drain()
+
+    per_req, outs_p = run_admission_mode(lm, dtype, trace, n_slots,
+                                         "per_request", False)
+    batched, outs_b = run_admission_mode(lm, dtype, trace, n_slots,
+                                         "batched", True)
+    match = (sorted(outs_p) == sorted(outs_b)
+             and all(np.array_equal(outs_p[k], outs_b[k])
+                     for k in outs_p))
+    distinct = {len(p) - 1 for _, p, _ in trace if len(p) > 1}
+    buckets = {bucket_len(n, cfg["max_len"]) for n in distinct}
+    return {
+        "metric": "serving_admission_ragged_shared_prefix",
+        "model": model, "variant": variant, "requests": n_requests,
+        "gen_tokens": gen_tokens, "slots": n_slots,
+        "shared_frac": shared_frac, "prefix_len": prefix_len,
+        "distinct_prompt_lengths": len(distinct),
+        "length_buckets": len(buckets),
+        "outputs_match": match,
+        "per_request": per_req, "batched": batched,
+        "admission_speedup": round(
+            per_req["admission_s"] / max(batched["admission_s"], 1e-9), 2),
+        "wall_speedup": round(
+            per_req["wall_s"] / max(batched["wall_s"], 1e-9), 2),
+    }
+
+
 def run(model: str = "tiny", variant: str = "fp32", n_requests: int = 12,
         gen_tokens: int = 48, stagger_ms: float = 10.0, n_slots: int = 12,
         policy: str = "prefill_priority") -> dict:
@@ -181,18 +304,34 @@ def run(model: str = "tiny", variant: str = "fp32", n_requests: int = 12,
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenario", default="mixed",
+                    choices=["mixed", "admission"])
     ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
     ap.add_argument("--variant", default="fp32", choices=["fp32", "bf16"])
-    ap.add_argument("--requests", type=int, default=12)
-    ap.add_argument("--gen_tokens", type=int, default=48)
+    # requests/gen_tokens/slots default per scenario: mixed 12/48/12,
+    # admission 20/4/8 (admission wants waves — n_slots < n_requests
+    # exercises the cache — and short decodes that keep admission
+    # dominant)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--gen_tokens", type=int, default=None)
     ap.add_argument("--stagger_ms", type=float, default=10.0)
-    ap.add_argument("--slots", type=int, default=12)
+    ap.add_argument("--slots", type=int, default=None)
     ap.add_argument("--policy", default="prefill_priority",
                     choices=["prefill_priority", "fifo"])
+    ap.add_argument("--shared_frac", type=float, default=0.5)
+    ap.add_argument("--prefix_len", type=int, default=12)
     args = ap.parse_args()
-    print(json.dumps(run(args.model, args.variant, args.requests,
-                         args.gen_tokens, args.stagger_ms, args.slots,
-                         args.policy)))
+    if args.scenario == "admission":
+        print(json.dumps(run_admission(
+            args.model, args.variant,
+            n_requests=args.requests or 20,
+            gen_tokens=args.gen_tokens or 4,
+            n_slots=args.slots or 8, shared_frac=args.shared_frac,
+            prefix_len=args.prefix_len)))
+        return
+    print(json.dumps(run(args.model, args.variant, args.requests or 12,
+                         args.gen_tokens or 48, args.stagger_ms,
+                         args.slots or 12, args.policy)))
 
 
 if __name__ == "__main__":

@@ -13,9 +13,10 @@ from bigdl_tpu.models.textclassifier import TextClassifier
 from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
 from bigdl_tpu.models.transformer import (
     LayerNorm, PositionEmbedding, TransformerBlock, TransformerLM,
-    beam_generate, generate, get_batch_decode_step, get_decode_step,
-    get_prefill_step, make_batch_decode_step, make_decode_step,
-    make_prefill_step, serving_params,
+    beam_generate, generate, get_batch_decode_step, get_batch_prefill_step,
+    get_decode_step, get_prefill_step, make_batch_decode_step,
+    make_batch_prefill_step, make_decode_step, make_prefill_step,
+    serving_params,
 )
 from bigdl_tpu.models.treelstm import BinaryTreeLSTM, TreeLSTMSentiment
 
@@ -27,7 +28,8 @@ __all__ = [
     "TextClassifier", "PTBModel", "SimpleRNN",
     "TransformerLM", "TransformerBlock", "LayerNorm", "PositionEmbedding",
     "beam_generate", "generate", "make_decode_step", "make_prefill_step",
-    "make_batch_decode_step", "get_decode_step", "get_prefill_step",
-    "get_batch_decode_step", "serving_params",
+    "make_batch_decode_step", "make_batch_prefill_step",
+    "get_decode_step", "get_prefill_step",
+    "get_batch_decode_step", "get_batch_prefill_step", "serving_params",
     "BinaryTreeLSTM", "TreeLSTMSentiment",
 ]

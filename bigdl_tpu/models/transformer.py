@@ -409,8 +409,8 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
       re-jit per length bucket). EQUAL-LENGTH prompts only: there is no
       per-row length mask, so right-padding a shorter prompt would write
       pad tokens into its cache and score the pad position (batch rows
-      must share one true length; ragged batches need per-row prefill
-      calls or a future lengths argument);
+      must share one true length; ragged batches go through
+      :func:`make_batch_prefill_step`, which masks per row);
     * ``carry`` must be FRESH (``carry['pos'] == 0`` everywhere, straight
       from ``init_carry``): prefill writes K/V at positions 0..P-1 and
       forces ``pos = P`` unconditionally, so a partially-filled carry
@@ -518,6 +518,162 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
                 f"filled cache (got pos={np.asarray(pos).tolist()})")
         return jitted(params, tokens, carry)
 
+    # exposed so benchmarks/tests can count compiled (B, P) buckets
+    prefill_checked._jitted = jitted
+    return prefill_checked
+
+
+def make_batch_prefill_step(model: Sequential, compute_dtype=None):
+    """MASKED multi-row prompt ingestion: one compiled program prefills a
+    whole RAGGED batch of prompts (the admission path of
+    ``bigdl_tpu.serving`` — see ``serving/admission.py``). Returns
+    ``prefill(params, tokens, lengths, carry) -> (logprobs_last, carry)``:
+
+    * ``tokens``: (B, L) 0-based ids, each row RIGHT-PADDED to the
+      length bucket L (pad values are ignored — clip to vocab range is
+      applied, any filler works);
+    * ``lengths``: (B,) int32 — row r's true token count (0 ≤ lengths[r]
+      ≤ L). Rows with ``lengths[r] == 0`` are pure ballast: their cache
+      and ``pos`` are bitwise untouched and their logprob row is garbage
+      the caller must ignore (exactly the batch-decode ``active``
+      convention, so one (B, L) program serves every occupancy);
+    * ``carry``: a B-row :func:`make_batch_decode_step` carry.
+      ``carry['pos'][r]`` is row r's START offset: 0 for a fresh prompt,
+      ``p0 > 0`` to CONTINUE over ``p0`` already-cached positions (the
+      shared-prefix path: a prefix-cache hit clones the cached carry and
+      prefills only the suffix). Row r writes K/V at absolute positions
+      ``pos[r]..pos[r]+lengths[r]-1`` and its ``pos`` advances by
+      ``lengths[r]``;
+    * returns per-row log-probs of each row's LAST VALID position (the
+      next-token distribution after the prompt) and the updated carry.
+
+    Masking: pad columns never reach the cache (their scatter indices
+    are routed out of bounds and DROPPED), queries use absolute
+    positions ``pos[r] + i`` for both the position embedding and the
+    causal mask, and attention runs over the row's full cache window so
+    cached-prefix keys participate — one program shape per (B, L)
+    regardless of per-row lengths or start offsets. That bounds the
+    compiled-program set by the bucket count where per-row
+    :func:`make_prefill_step` calls compile per DISTINCT LENGTH (the
+    PR-1 admission stall — see docs/serving.md). The tradeoff: scores
+    span ``(L, max_len)`` instead of ``(P, P)``, so for one lone short
+    prompt the per-row step does less work; the win is batching ragged
+    admissions into one call (and it is what keeps a sharded prefill
+    program reusable — shape-stable admission).
+
+    The wrapper raises (on concrete values) if a row would write past
+    ``max_len`` (``pos[r] + lengths[r] > max_len``) or ``lengths``
+    exceeds L. Numerics follow the serving conventions (fp32 score
+    accumulation, ``compute_dtype`` cache, int8 weight-only
+    projections); per-row results equal :func:`make_prefill_step` to
+    float round-off — the wider masked reduction can reorder XLA sums —
+    pinned by tests/test_serving_admission.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.misc import LookupTable
+
+    model._ensure_params()
+    mods = model.modules
+    assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
+    max_len = mods[1].max_len
+    off = _decode_head_offset(model)
+    lnf = mods[-2 - off]
+    _, _, blocks0, _, _ = _resolve_decode_views(model, off, model.params)
+    attn0 = blocks0[0][0].attn
+    heads, hd = attn0.n_heads, attn0.head_dim
+    scale = hd ** -0.5
+    cache_dtype = compute_dtype or jnp.float32
+    _proj = _serving_proj
+
+    def prefill(params, tokens, lengths, carry):
+        Pt = _cast_keep_scales(params, compute_dtype)
+        lookup_w, pos_w, blocks, lnf_p, lin_p = \
+            _resolve_decode_views(model, off, Pt)
+        B, L = tokens.shape
+        start = carry["pos"]                           # (B,) per-row offset
+        rows = jnp.arange(B)
+        qpos = start[:, None] + jnp.arange(L)[None]    # (B, L) absolute
+        inb = jnp.arange(L)[None] < lengths[:, None]   # (B, L) valid mask
+        # pad/overflow columns scatter to index max_len → dropped; valid
+        # columns are in range (checked wrapper) and strictly increasing
+        # per row, so writes never collide
+        widx = jnp.where(inb, qpos, max_len)
+        x = jnp.take(lookup_w, jnp.clip(tokens, 0, lookup_w.shape[0] - 1),
+                     axis=0)                           # (B, L, Hid)
+        x = x + jnp.take(pos_w, jnp.clip(qpos, 0, max_len - 1), axis=0)
+        new_carry = dict(carry)
+        for i, (blk, bp) in enumerate(blocks):
+            h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
+            ap = bp[blk._child_key(1)]
+            q = _proj(ap["wq"], h).reshape(B, L, heads, hd)
+            k = _proj(ap["wk"], h).reshape(B, L, heads, hd)
+            v = _proj(ap["wv"], h).reshape(B, L, heads, hd)
+            kc = new_carry[f"k{i}"].at[rows[:, None], widx].set(
+                k.astype(cache_dtype), mode="drop")
+            vc = new_carry[f"v{i}"].at[rows[:, None], widx].set(
+                v.astype(cache_dtype), mode="drop")
+            new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
+            # queries attend over the row's FULL cache window (cached
+            # prefix + this chunk) under an absolute causal mask; scores
+            # accumulate fp32 regardless of the serving dtype
+            s = jnp.einsum("blhd,bmhd->bhlm",
+                           (q * scale).astype(cache_dtype), kc,
+                           preferred_element_type=jnp.float32)
+            valid = (jnp.arange(max_len)[None, None, None, :]
+                     <= qpos[:, None, :, None])
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhlm,bmhd->blhd", p.astype(cache_dtype), vc,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype).reshape(B, L, heads * hd)
+            x = x + _proj(ap["wo"], ctx)
+            h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x)
+            mlp = _proj(bp[blk._child_key(4)], jax.nn.gelu(
+                _proj(bp[blk._child_key(3)], h2)))
+            x = x + mlp
+        # each row's next-token logits come from its LAST VALID position
+        last = jnp.clip(lengths - 1, 0, L - 1)
+        xf, _ = lnf.apply(lnf_p, x[rows, last][:, None])
+        logits = _proj(lin_p, xf[:, 0])
+        new_carry["pos"] = start + lengths.astype(start.dtype)
+        return jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1), new_carry
+
+    jitted = jax.jit(prefill)
+
+    def prefill_checked(params, tokens, lengths, carry):
+        import numpy as np
+
+        lengths = jnp.asarray(lengths, jnp.int32)
+        if tokens.ndim != 2 or lengths.shape != tokens.shape[:1]:
+            raise ValueError(
+                f"tokens must be (B, L) with lengths (B,): got "
+                f"{tokens.shape} / {lengths.shape}")
+        if carry["pos"].shape[0] != tokens.shape[0]:
+            raise ValueError(
+                f"carry has {carry['pos'].shape[0]} rows but tokens has "
+                f"{tokens.shape[0]} — the carry must come from "
+                "make_batch_decode_step's init_carry(B)")
+        pos = carry["pos"]
+        # cheap concrete-value guards outside jit (abstract under an
+        # outer trace, where they are skipped): a row writing past the
+        # cache would be silently DROPPED by the masked scatter
+        if not isinstance(lengths, jax.core.Tracer) \
+                and not isinstance(pos, jax.core.Tracer):
+            ln, ps = np.asarray(lengths), np.asarray(pos)
+            if (ln < 0).any() or (ln > tokens.shape[1]).any():
+                raise ValueError(
+                    f"lengths must lie in 0..L={tokens.shape[1]} "
+                    f"(got {ln.tolist()})")
+            if (ps + ln > max_len).any():
+                raise ValueError(
+                    f"rows would write past max_len {max_len}: "
+                    f"pos={ps.tolist()} + lengths={ln.tolist()}")
+        return jitted(params, tokens, lengths, carry)
+
+    # exposed so benchmarks/tests can count compiled (B, L) buckets
+    prefill_checked._jitted = jitted
     return prefill_checked
 
 
@@ -830,6 +986,13 @@ def get_batch_decode_step(model: Sequential, compute_dtype=None):
     """Cached :func:`make_batch_decode_step` (the serving engine's step)."""
     return _step_cache(model, "batch_decode", compute_dtype,
                        lambda: make_batch_decode_step(model, compute_dtype))
+
+
+def get_batch_prefill_step(model: Sequential, compute_dtype=None):
+    """Cached :func:`make_batch_prefill_step` (the batched-admission
+    prefill; one wrapper whose jit re-traces per (B, L) bucket)."""
+    return _step_cache(model, "batch_prefill", compute_dtype,
+                       lambda: make_batch_prefill_step(model, compute_dtype))
 
 
 def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,

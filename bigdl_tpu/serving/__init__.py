@@ -3,20 +3,28 @@
 The serving layer between the model zoo and the parallel stack: many
 independent generation requests share ONE pooled, slot-indexed KV cache
 and ONE compiled per-row decode program, with FIFO admission into rows
-freed mid-flight (continuous batching). See ``docs/serving.md``.
+freed mid-flight (continuous batching). Admission itself is batched and
+shape-stable: ragged prompts prefill together through a bounded set of
+power-of-two length buckets (``admission.py``), optionally reusing
+shared-prefix K/V from a ref-counted radix cache (``prefix_cache.py``).
+See ``docs/serving.md``.
 
     from bigdl_tpu.serving import ServingEngine
 
-    eng = ServingEngine(lm, n_slots=8, compute_dtype=jnp.bfloat16)
+    eng = ServingEngine(lm, n_slots=8, compute_dtype=jnp.bfloat16,
+                        prefix_cache=True)
     rid = eng.submit([3, 7, 2], max_new_tokens=32, eos_id=5)
     outputs = eng.drain()            # {rid: 1-based token ids}
     print(eng.metrics.summary())     # TTFT percentiles, tokens/sec, ...
 """
 
+from bigdl_tpu.serving.admission import AdmissionController, bucket_len
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
-           "Scheduler"]
+           "Scheduler", "AdmissionController", "PrefixCache",
+           "bucket_len"]

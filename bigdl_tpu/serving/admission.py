@@ -1,0 +1,210 @@
+"""Batched, length-bucketed admission for the serving engine.
+
+PR 1 admitted requests ONE AT A TIME: each admission ran a private B=1
+``make_prefill_step`` call, and every novel prompt length triggered a
+fresh XLA trace MID-ADMISSION, stalling all in-flight rows for the
+compile (the docs/serving.md operational caveat). The reference's core
+scheduling lesson (SoCC'19: schedule work onto fixed, pre-compiled
+executors instead of spawning per-job state) applies to prompt ingestion
+just as much as to decode — and MLPerf-scale TPU practice shows bounding
+the compiled-program set is what keeps admission latency flat under
+ragged traffic.
+
+:class:`AdmissionController` turns admission into a pooled,
+shape-stable pipeline:
+
+* waiting requests are grouped into POWER-OF-TWO length buckets
+  (clamped at ``max_len``) — a bounded bucket set, so the set of
+  compiled prefill programs is bounded by ``O(log max_len)`` buckets
+  regardless of how many distinct prompt lengths traffic brings;
+* each bucket prefills in ONE :func:`make_batch_prefill_step` call over
+  a ``(B, L_bucket)`` right-padded token block with a per-row
+  ``lengths`` vector. The row count B is FIXED (``prefill_rows``,
+  default ``n_slots`` — an admission round never has more rows to
+  fill; unfilled rows are zero-length ballast), so the
+  compiled-program set is exactly ONE
+  program per length bucket no matter how arrival timing groups the
+  requests — admission never compiles mid-flight after the buckets are
+  warm. (Ballast rows cost padding FLOPs; on the MXU a small fixed B
+  is the cheap side of that trade, and shape stability is the point —
+  it is also what keeps a future SHARDED prefill program reusable.);
+* every produced row is scattered into its :class:`KVPool` slot through
+  the existing donated scatter (``write_prefill(..., row=j)``);
+* with a :class:`bigdl_tpu.serving.prefix_cache.PrefixCache` attached,
+  each prompt first takes the longest-cached-prefix path: a FULL hit
+  clones the cached carry straight into the pool (zero prefill work), a
+  PARTIAL hit clones it and prefills only the suffix (the batch
+  prefill's nonzero per-row start offsets), and finished prefills are
+  inserted back so later requests hit.
+
+The zero input carries (one per row bucket) are built once and reused
+for every admission — jax arrays are immutable, so sharing them is free
+(the same trick as the engine's old ``_zero_carry1``, per shape).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.serving.prefix_cache import PrefixCache
+
+
+def bucket_len(n: int, cap: int) -> int:
+    """The power-of-two length bucket for ``n`` tokens, clamped to
+    ``cap`` (= max_len): 1, 2, 4, ... cap. Bucketing bounds the set of
+    compiled prefill programs; the clamp keeps the block no wider than
+    the cache (pad columns beyond a row's length are masked anyway)."""
+    if n <= 0:
+        raise ValueError(f"need a positive length, got {n}")
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+class AdmissionController:
+    """Groups admissions into bucketed batch-prefill calls (see module
+    docstring). Owned by :class:`ServingEngine`; reads the engine's
+    pool/scheduler/metrics and its cached batch-prefill step."""
+
+    def __init__(self, engine, prefix_cache: Optional[PrefixCache] = None,
+                 prefill_rows: int = 0) -> None:
+        # engine is the owning ServingEngine (pool, scheduler, metrics,
+        # params, jitted steps); the controller is its admission policy,
+        # split out so the pieces stay independently testable
+        self.engine = engine
+        self.prefix_cache = prefix_cache
+        # FIXED batch-prefill row count (module docstring): one compiled
+        # shape per length bucket, independent of arrival grouping (an
+        # admission round never has more than n_slots rows to fill)
+        self.prefill_rows = int(prefill_rows) or engine.pool.n_slots
+        # ONE shared fresh zero carry, built lazily and reused for every
+        # admission (prefill never donates its carry and jax arrays are
+        # immutable, so sharing the zero input is free)
+        self._zero_carry_cache: Optional[dict] = None
+        # (B, L) shapes routed through THIS controller — the bounded
+        # compiled-program set this subsystem exists to enforce. The
+        # serving/prefill_bucket_compiles counter instead counts shapes
+        # new to the SHARED jitted step (cached per model/dtype), so a
+        # second engine over a warm model reports zero compiles.
+        self.traced_shapes: set = set()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _zero_carry(self) -> dict:
+        if self._zero_carry_cache is None:
+            self._zero_carry_cache = self.engine._pool_init(self.prefill_rows)
+        return self._zero_carry_cache
+
+    def _note_shape(self, B: int, L: int) -> None:
+        self.traced_shapes.add((B, L))
+        fn = self.engine._batch_prefill_fn
+        seen = getattr(fn, "_traced_shapes", None)
+        if seen is None:
+            seen = fn._traced_shapes = set()
+        if (B, L) not in seen:
+            seen.add((B, L))
+            self.engine.metrics.on_bucket_compile()
+
+    @staticmethod
+    def _carry_row(carry: dict, row: int) -> dict:
+        """Row ``row`` of a multi-row carry as a B=1 carry (a device
+        slice per leaf — what PrefixCache stores)."""
+        return {k: v[row:row + 1] for k, v in carry.items()}
+
+    # -- the admission pipeline --------------------------------------------
+
+    def admit(self, n: int) -> None:
+        """Admit ``n`` scheduler-approved requests: allocate slots,
+        route each prompt through the prefix cache, then prefill the
+        misses bucket-by-bucket."""
+        eng = self.engine
+        groups: Dict[int, List[Tuple]] = {}    # L_bucket -> (req, slot, pf)
+        for _ in range(n):
+            slot = eng.pool.alloc()
+            assert slot is not None            # admissible() checked
+            req = eng.scheduler.admit(slot)
+            prompt0 = [t - 1 for t in req.prompt]      # 0-based
+            # the last prompt token is the first decode input — exactly
+            # generate()'s convention, so outputs match token-for-token
+            req.next_token = prompt0[-1]
+            pf = prompt0[:-1]                  # tokens to prefill
+            if not pf:
+                eng.pool.set_pos(slot, 0)
+                continue
+            if self.prefix_cache is not None and self._try_prefix(slot, pf):
+                continue
+            groups.setdefault(bucket_len(len(pf), eng.max_len),
+                              []).append((req, slot, pf))
+        for L in sorted(groups):
+            rows = groups[L]
+            # a bucket larger than the row block prefills in chunks
+            for lo in range(0, len(rows), self.prefill_rows):
+                self._prefill_bucket(L, rows[lo:lo + self.prefill_rows])
+
+    def _try_prefix(self, slot: int, pf: List[int]) -> bool:
+        """The prefix-cache path: full hit → clone into the pool;
+        partial hit → clone + prefill only the suffix. Returns False on
+        a miss (the caller buckets the prompt normally)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        eng = self.engine
+        carry, matched, lease = self.prefix_cache.acquire(pf)
+        eng.metrics.on_prefix_lookup(matched, len(pf))
+        if matched == 0:
+            return False
+        # the prefill phase timer brackets prefill AND pool scatter,
+        # matching the per-request path's accounting exactly (the bench
+        # compares serving/prefill_s across admission modes)
+        t0 = time.perf_counter()
+        try:
+            if matched == len(pf):             # full hit: zero prefill work
+                eng.pool.write_prefill(slot, carry, len(pf))
+                return True
+            S = len(pf) - matched
+            L = bucket_len(S, eng.max_len)
+            toks = np.zeros((1, L), np.int32)
+            toks[0, :S] = pf[matched:]
+            self._note_shape(1, L)
+            # the cached carry's pos IS the start offset: the batch
+            # prefill continues over the cached prefix, writing only
+            # positions matched..len(pf)-1
+            _, out = eng._batch_prefill_fn(
+                eng.params, jnp.asarray(toks),
+                np.asarray([S], np.int32), carry)
+            eng.metrics.on_prefill_batch(1, 1)
+            eng.pool.write_prefill(slot, out, len(pf))
+            self.prefix_cache.insert(pf, out)
+            return True
+        finally:
+            self.prefix_cache.release(lease)
+            eng.metrics.add_phase("prefill", time.perf_counter() - t0)
+
+    def _prefill_bucket(self, L: int, rows: List[Tuple]) -> None:
+        """ONE masked multi-row prefill for every miss in an L-bucket,
+        then per-row scatter into the pool."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        eng = self.engine
+        k = len(rows)
+        B = self.prefill_rows
+        toks = np.zeros((B, L), np.int32)
+        lengths = np.zeros((B,), np.int32)     # pad rows stay ballast (0)
+        for j, (_, _, pf) in enumerate(rows):
+            toks[j, :len(pf)] = pf
+            lengths[j] = len(pf)
+        t0 = time.perf_counter()
+        self._note_shape(B, L)
+        _, out = eng._batch_prefill_fn(eng.params, jnp.asarray(toks),
+                                       lengths, self._zero_carry())
+        eng.metrics.on_prefill_batch(k, B)
+        for j, (_, slot, pf) in enumerate(rows):
+            eng.pool.write_prefill(slot, out, len(pf), row=j)
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(pf, self._carry_row(out, j))
+        # timer brackets prefill + per-row pool scatter, matching the
+        # per-request path's serving/prefill_s accounting
+        eng.metrics.add_phase("prefill", time.perf_counter() - t0)

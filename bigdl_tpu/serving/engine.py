@@ -9,10 +9,20 @@ compiled per-row-position decode program
 
 * requests are ``submit()``-ed at any time and queue FIFO;
 * before every decode step the scheduler admits waiting requests into
-  free slots — the prompt is ingested in one
-  :func:`make_prefill_step` pass and row-scattered into the pooled
-  cache (continuous batching: admission happens MID-FLIGHT, between
-  decode steps of the requests already running);
+  free slots (continuous batching: admission happens MID-FLIGHT,
+  between decode steps of the requests already running). The DEFAULT
+  admission path (``admission="batched"``) groups the admitted prompts
+  into power-of-two length buckets and ingests each bucket in ONE
+  masked multi-row :func:`make_batch_prefill_step` call, row-scattering
+  every result into the pooled cache — ragged prompt lengths share a
+  BOUNDED set of compiled prefill programs instead of compiling per
+  novel length mid-admission (see ``serving/admission.py``).
+  ``admission="per_request"`` keeps PR 1's one-at-a-time B=1
+  :func:`make_prefill_step` path (the parity baseline);
+* an optional :class:`bigdl_tpu.serving.prefix_cache.PrefixCache`
+  (``prefix_cache=True`` or an instance) reuses prefilled K/V across
+  requests sharing a token prefix — a full hit clones cached state
+  straight into the pool, a partial hit prefills only the suffix;
 * every ``step()`` decodes one token for ALL active rows at once —
   decode is weight-read-bound, so a batched step costs roughly what a
   single-row step costs and aggregate tokens/sec scales with occupancy
@@ -45,7 +55,7 @@ import numpy as np
 
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
-from bigdl_tpu.serving.scheduler import Request, Scheduler
+from bigdl_tpu.serving.scheduler import FINISHED, Request, Scheduler
 
 
 class ServingEngine:
@@ -56,18 +66,43 @@ class ServingEngine:
     e.g. ``jnp.bfloat16`` — scores and log-softmax stay fp32);
     ``policy`` is the admission policy (``"prefill_priority"`` = admit
     into freed rows before every step, ``"fifo"`` = refill only after
-    the running batch drains — see ``serving.scheduler``).
+    the running batch drains — see ``serving.scheduler``);
+    ``admission`` picks the prompt-ingestion pipeline: ``"batched"``
+    (default — bucketed multi-row masked prefill, bounded compile set)
+    or ``"per_request"`` (PR 1's B=1-per-admission baseline);
+    ``prefix_cache`` enables shared-prefix K/V reuse under batched
+    admission: ``True`` for a default-capacity
+    :class:`~bigdl_tpu.serving.prefix_cache.PrefixCache`, or pass a
+    configured instance (``None`` = off);
+    ``keep_finished`` bounds the finished-request ledger: only the N
+    most recently finished requests stay retrievable via ``result()``
+    (older ones are evicted oldest-first), so a long-lived engine under
+    heavy traffic doesn't grow without bound. ``None`` keeps everything
+    (then ``pop_result()`` is the caller's eviction lever).
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
                  policy: str = "prefill_priority",
-                 metrics: Optional[ServingMetrics] = None) -> None:
+                 metrics: Optional[ServingMetrics] = None,
+                 admission: str = "batched",
+                 prefix_cache=None,
+                 keep_finished: Optional[int] = None) -> None:
         import jax
 
         from bigdl_tpu.models.transformer import (
-            get_batch_decode_step, get_prefill_step, serving_params,
+            get_batch_decode_step, get_batch_prefill_step, get_prefill_step,
+            serving_params,
         )
+        from bigdl_tpu.serving.admission import AdmissionController
+        from bigdl_tpu.serving.prefix_cache import PrefixCache
 
+        if admission not in ("batched", "per_request"):
+            raise ValueError(
+                f"unknown admission mode {admission!r} "
+                "(one of 'batched', 'per_request')")
+        if keep_finished is not None and keep_finished < 0:
+            raise ValueError(
+                f"keep_finished must be >= 0 or None, got {keep_finished}")
         model._ensure_params()
         self.model = model
         self.max_len = model.modules[1].max_len
@@ -76,17 +111,37 @@ class ServingEngine:
         # (runtime arguments — never baked into the compiled programs)
         self.params = jax.device_put(serving_params(model, compute_dtype))
         self._step_fn, pool_init = get_batch_decode_step(model, compute_dtype)
-        self._prefill_fn = get_prefill_step(model, compute_dtype)
-        # ONE fresh B=1 carry for prefill, built once and reused for every
-        # admission (prefill returns a new carry; jax arrays are
-        # immutable, so sharing the zero input is free — at 137M scale a
-        # per-admission rebuild would be ~12 MB of pure allocation churn).
-        # pool_init's carry layout is make_decode_step's, so n_slots=1 IS
-        # the single-request carry.
-        self._zero_carry1 = pool_init(1)
+        self._pool_init = pool_init
         self.pool = KVPool(pool_init, n_slots)
         self.scheduler = Scheduler(policy)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.admission = admission
+        self.keep_finished = keep_finished
+        if admission == "batched":
+            self._batch_prefill_fn = get_batch_prefill_step(model,
+                                                            compute_dtype)
+            # True -> default cache, False/None -> off, else an instance
+            self.prefix_cache = (PrefixCache() if prefix_cache is True
+                                 else (prefix_cache or None))
+            self.admitter = AdmissionController(
+                self, prefix_cache=self.prefix_cache)
+        else:
+            if prefix_cache:
+                raise ValueError(
+                    "prefix_cache requires admission='batched' (the "
+                    "per-request prefill cannot continue from a cached "
+                    "carry)")
+            self.prefix_cache = None
+            self.admitter = None
+            self._prefill_fn = get_prefill_step(model, compute_dtype)
+            # ONE fresh B=1 carry for prefill, built once and reused for
+            # every admission (prefill returns a new carry; jax arrays
+            # are immutable, so sharing the zero input is free — at 137M
+            # scale a per-admission rebuild would be ~12 MB of pure
+            # allocation churn). pool_init's carry layout is
+            # make_decode_step's, so n_slots=1 IS the single-request
+            # carry.
+            self._zero_carry1 = pool_init(1)
         self._next_id = 0
         self._finished: Dict[int, Request] = {}
 
@@ -116,19 +171,55 @@ class ServingEngine:
         return rid
 
     def result(self, req_id: int) -> Optional[np.ndarray]:
-        """Generated 1-based ids for a FINISHED request, else None."""
+        """Generated 1-based ids for a FINISHED request, else None
+        (also None once evicted by ``keep_finished``/``pop_result``)."""
         req = self._finished.get(req_id)
         return None if req is None else np.asarray(req.output, np.int32)
+
+    def pop_result(self, req_id: int) -> Optional[np.ndarray]:
+        """Like :meth:`result` but RELEASES the request's ledger entry —
+        the memory-bounding consumption pattern for long-lived engines
+        (take each output exactly once; see ``keep_finished`` for the
+        automatic alternative)."""
+        req = self._finished.pop(req_id, None)
+        return None if req is None else np.asarray(req.output, np.int32)
+
+    def cancel(self, req_id: int) -> bool:
+        """Cancel a WAITING request: it is dequeued, never occupies a
+        slot, and lands in the finished ledger with state 'cancelled'
+        and empty output. Returns False (no-op) for requests already
+        running, finished, or unknown."""
+        req = self.scheduler.cancel(req_id)
+        if req is None:
+            return False
+        self.metrics.on_cancel()
+        self._finished[req_id] = req
+        self._evict_finished()
+        return True
 
     def request(self, req_id: int) -> Optional[Request]:
         return self._finished.get(req_id)
 
     # -- the serving loop --------------------------------------------------
 
+    def _evict_finished(self) -> None:
+        # dict preserves insertion order = finish order → oldest-first
+        if self.keep_finished is None:
+            return
+        while len(self._finished) > self.keep_finished:
+            self._finished.pop(next(iter(self._finished)))
+
     def _admit(self) -> None:
         import jax.numpy as jnp
 
         n = self.scheduler.admissible(self.pool.free_slots)
+        if not n:
+            return
+        if self.admitter is not None:
+            # batched admission: bucketed multi-row masked prefill with
+            # optional shared-prefix reuse (serving/admission.py)
+            self.admitter.admit(n)
+            return
         for _ in range(n):
             slot = self.pool.alloc()
             assert slot is not None          # admissible() checked
@@ -191,6 +282,7 @@ class ServingEngine:
                 freed = self.scheduler.finish(req, now)
                 self.pool.free(freed)
                 self._finished[req.req_id] = req
+                self._evict_finished()
                 self.metrics.on_finish(now - req.submit_time,
                                        len(req.output))
             else:
@@ -199,11 +291,14 @@ class ServingEngine:
 
     def drain(self) -> Dict[int, np.ndarray]:
         """Step until every submitted request has finished; returns
-        ``{req_id: generated 1-based ids}`` for ALL finished requests."""
+        ``{req_id: generated 1-based ids}`` for all RETAINED finished
+        requests (all of them unless ``keep_finished``/``pop_result``
+        evicted some)."""
         while not self.scheduler.idle():
             self.step()
         return {rid: np.asarray(r.output, np.int32)
-                for rid, r in self._finished.items()}
+                for rid, r in self._finished.items()
+                if r.state == FINISHED}
 
     # -- introspection -----------------------------------------------------
 

@@ -23,8 +23,9 @@ class KVPool:
 
     * :meth:`alloc` pops a slot id off the free list (None when full);
     * :meth:`free` zeroes the row's position and returns the slot;
-    * :meth:`write_prefill` row-scatters a prefilled single-request
-      carry (from ``make_prefill_step`` on a fresh B=1 carry) into a
+    * :meth:`write_prefill` row-scatters one row of a prefilled carry
+      (a ``make_prefill_step`` B=1 carry, or any row of a
+      ``make_batch_prefill_step`` batched-admission carry) into a
       slot — the cheap admission path for mid-flight continuous
       batching.
 
@@ -57,14 +58,16 @@ class KVPool:
         # compiles exactly once per pool.
         self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0,))
 
-    def _scatter_impl(self, carry, prefill_carry, slot, pos):
+    def _scatter_impl(self, carry, prefill_carry, slot, pos, row):
         from jax import lax
 
         out = dict(carry)
         for i in range(self.n_layers):
             for kind in ("k", "v"):
                 key = f"{kind}{i}"
-                src = prefill_carry[key].astype(carry[key].dtype)
+                src = lax.dynamic_slice_in_dim(
+                    prefill_carry[key], row, 1, axis=0
+                ).astype(carry[key].dtype)
                 out[key] = lax.dynamic_update_slice(
                     carry[key], src, (slot, 0, 0, 0))
         out["pos"] = carry["pos"].at[slot].set(pos)
@@ -107,14 +110,18 @@ class KVPool:
     # -- prefill admission -------------------------------------------------
 
     def write_prefill(self, slot: int, prefill_carry: Dict,
-                      prompt_len: int) -> None:
-        """Row-scatter a B=1 prefilled carry into ``slot``: per-layer K/V
-        positions ``0..prompt_len-1`` land in the pooled row and the
-        slot's ``pos`` becomes ``prompt_len`` — after this the slot
-        decodes exactly as if it had been stepped ``prompt_len`` times.
-        (The full ``max_len`` row is copied — the tail is the prefill
-        carry's zeros, invisible behind ``pos`` — via the single jitted
-        donated scatter built in ``__init__``.)"""
+                      prompt_len: int, row: int = 0) -> None:
+        """Row-scatter row ``row`` of a prefilled carry into ``slot``:
+        per-layer K/V positions ``0..prompt_len-1`` land in the pooled
+        row and the slot's ``pos`` becomes ``prompt_len`` — after this
+        the slot decodes exactly as if it had been stepped
+        ``prompt_len`` times. ``prefill_carry`` may be the old B=1
+        per-request carry (``row=0``) or a multi-row batched-admission
+        carry (``make_batch_prefill_step`` output — ``row`` picks the
+        request's row). The full ``max_len`` row is copied — the tail
+        beyond ``prompt_len`` is invisible behind ``pos`` — via the
+        jitted donated scatter built in ``__init__`` (one trace per
+        prefill-carry row count; ``row`` rides as a traced argument)."""
         import jax.numpy as jnp
 
         if slot not in self._in_use:
@@ -122,8 +129,13 @@ class KVPool:
         if not 0 < prompt_len <= self.max_len:
             raise ValueError(
                 f"prompt_len {prompt_len} outside 1..{self.max_len}")
+        if not 0 <= row < prefill_carry["pos"].shape[0]:
+            raise ValueError(
+                f"row {row} outside the prefill carry's "
+                f"{prefill_carry['pos'].shape[0]} rows")
         self.carry = self._scatter(self.carry, prefill_carry,
-                                   jnp.int32(slot), jnp.int32(prompt_len))
+                                   jnp.int32(slot), jnp.int32(prompt_len),
+                                   jnp.int32(row))
 
     def set_pos(self, slot: int, pos: int) -> None:
         """Set one slot's position counter (the no-prefill admission path:

@@ -16,6 +16,17 @@ Counters (all under the ``serving/`` prefix in the backing Metrics):
 * ``tokens_out``        — generated tokens per request (recorded at
   finish; sum = total tokens served)
 * ``prefill_s`` / ``decode_step_s`` — phase timings
+* ``cancelled``         — requests cancelled while WAITING
+
+Batched-admission counters (``serving/admission.py``):
+
+* ``prefill_batch``     — true rows per batched prefill call (mean =
+  admission batching factor; count = number of prefill calls)
+* ``prefill_batch_padded`` — padded rows per call (bucketing overhead)
+* ``prefill_bucket_compiles`` — novel (B, L) prefill shapes traced
+  (sum = the bounded compiled-program count the bucket scheme enforces)
+* ``prefix_lookups`` / ``prefix_hits`` / ``prefix_hit_tokens`` —
+  prefix-cache traffic; ``summary()`` derives ``prefix_hit_rate``
 """
 
 from __future__ import annotations
@@ -57,6 +68,23 @@ class ServingMetrics:
         self.metrics.add("serving/latency_s", float(latency_s))
         self.metrics.add("serving/tokens_out", float(n_tokens))
 
+    def on_cancel(self) -> None:
+        self.metrics.add("serving/cancelled", 1.0)
+
+    def on_prefill_batch(self, n_rows: int, n_padded: int) -> None:
+        self.metrics.add("serving/prefill_batch", float(n_rows))
+        self.metrics.add("serving/prefill_batch_padded", float(n_padded))
+
+    def on_bucket_compile(self) -> None:
+        self.metrics.add("serving/prefill_bucket_compiles", 1.0)
+
+    def on_prefix_lookup(self, matched_tokens: int, total_tokens: int) -> None:
+        self.metrics.add("serving/prefix_lookups", 1.0)
+        if matched_tokens > 0:
+            self.metrics.add("serving/prefix_hits", 1.0)
+            self.metrics.add("serving/prefix_hit_tokens",
+                             float(matched_tokens))
+
     def add_phase(self, name: str, seconds: float) -> None:
         self.metrics.add(f"serving/{name}_s", float(seconds))
 
@@ -89,6 +117,10 @@ class ServingMetrics:
         out = {k: v for k, v in self.metrics.summary().items()
                if k.startswith("serving/")}
         out["serving/tokens_per_sec"] = self.tokens_per_sec()
+        n_look, _ = self.metrics.get("serving/prefix_lookups")
+        if n_look:
+            n_hit, _ = self.metrics.get("serving/prefix_hits")
+            out["serving/prefix_hit_rate"] = n_hit / n_look
         for k, v in self.ttft_percentiles().items():
             out[f"serving/ttft_{k}_s"] = v
         return out

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+CANCELLED = "cancelled"
 
 _POLICIES = ("prefill_priority", "fifo")
 
@@ -90,6 +91,19 @@ class Scheduler:
         req.slot = slot
         self.running[slot] = req
         return req
+
+    def cancel(self, req_id: int) -> Optional[Request]:
+        """Dequeue a WAITING request: it will never be admitted and
+        never occupies a slot. Returns the (now CANCELLED) request, or
+        None if ``req_id`` is not waiting — RUNNING requests are not
+        cancellable here (their slot state is mid-flight; they run to
+        EOS/length like any other row)."""
+        for i, req in enumerate(self.waiting):
+            if req.req_id == req_id:
+                del self.waiting[i]
+                req.state = CANCELLED
+                return req
+        return None
 
     def finish(self, req: Request, now: float) -> int:
         """Mark finished; returns the freed slot id."""

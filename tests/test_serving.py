@@ -226,6 +226,63 @@ def test_engine_rejects_bad_requests():
     with pytest.raises(ValueError, match="non-empty"):
         eng.submit([], max_new_tokens=4)
     eng.submit([1, 2, 3], max_new_tokens=4)             # at the edge: fine
+    with pytest.raises(ValueError, match="admission"):
+        ServingEngine(lm, admission="psychic")
+    with pytest.raises(ValueError, match="batched"):
+        ServingEngine(lm, admission="per_request", prefix_cache=True)
+
+
+# -- lifecycle: cancel + bounded finished ledger ---------------------------
+
+def test_cancel_waiting_request_never_occupies_a_slot():
+    """A cancelled WAITING request is dequeued for good: it never takes
+    a slot, emits nothing, and is reported state='cancelled'; RUNNING
+    requests are not cancellable."""
+    from bigdl_tpu.models.transformer import generate
+    from bigdl_tpu.serving import ServingEngine
+
+    lm = _make_lm()
+    eng = ServingEngine(lm, n_slots=1)       # single slot forces queueing
+    a = eng.submit([3, 7], max_new_tokens=4)
+    b = eng.submit([5, 2], max_new_tokens=4)
+    c = eng.submit([9], max_new_tokens=3)
+    eng.step()                               # a runs; b, c wait
+    assert not eng.cancel(a)                 # running: not cancellable
+    assert eng.cancel(b)
+    assert not eng.cancel(b)                 # already cancelled: no-op
+    assert eng.queue_depth == 1              # only c still waits
+    outs = eng.drain()
+    assert b not in outs                     # never ran, emitted nothing
+    assert eng.request(b).state == "cancelled"
+    assert eng.request(b).done_reason is None
+    assert eng.result(b) is not None and len(eng.result(b)) == 0
+    np.testing.assert_array_equal(
+        outs[c], generate(lm, [9], length=3, temperature=0.0))
+    assert eng.pool.free_slots == 1          # the slot b never touched
+    total, n = eng.metrics.metrics.get("serving/cancelled")
+    assert (total, n) == (1.0, 1)
+
+
+def test_pop_result_and_keep_finished_bound_the_ledger():
+    """pop_result() consumes an output; keep_finished=N evicts the
+    oldest finished entries so a long-lived engine stays bounded."""
+    from bigdl_tpu.serving import ServingEngine
+
+    lm = _make_lm()
+    eng = ServingEngine(lm, n_slots=2, keep_finished=2)
+    rids = [eng.submit([3, i + 2], max_new_tokens=3) for i in range(5)]
+    eng.drain()
+    # only the 2 most recently finished survive
+    assert len(eng._finished) == 2
+    assert eng.result(rids[0]) is None       # evicted oldest-first
+    kept = [r for r in rids if eng.result(r) is not None]
+    assert len(kept) == 2
+    out = eng.pop_result(kept[0])
+    assert out is not None and len(out) == 3
+    assert eng.result(kept[0]) is None       # consumed
+    assert eng.pop_result(kept[0]) is None   # second pop: gone
+    with pytest.raises(ValueError, match="keep_finished"):
+        ServingEngine(lm, n_slots=2, keep_finished=-1)
 
 
 # -- metrics ---------------------------------------------------------------
