@@ -7,6 +7,14 @@ multi-row prefill + prefix cache — bounded compiled-program set). The
 admission scenario deliberately runs COLD: the compile stall on novel
 lengths IS the phenomenon under study.
 
+``--scenario sampling`` exercises the per-row sampling subsystem
+(``serving/sampling.py``): mixed greedy/sampled traffic (distinct
+temperature/top-k/top-p/penalty mixes, fixed seeds) against an
+all-greedy baseline on the same prompts — asserting ZERO extra
+decode-program compiles (every knob mix is runtime data of ONE compiled
+sampled step), greedy rows unperturbed by sampled neighbors, and
+reporting the fused epilogue's tokens/sec overhead.
+
 The mixed-arrival question decode_bench.py leaves open: decode_bench
 measures a FIXED batch decoded in lockstep, but production traffic is
 independent requests arriving at staggered times with different
@@ -277,6 +285,98 @@ def run_admission(model: str = "tiny", variant: str = "fp32",
     }
 
 
+def make_sampling_trace(cfg, n_requests: int, gen_tokens: int,
+                        seed: int = 13):
+    """Mixed greedy/sampled traffic: even requests are greedy (default
+    params), odd requests cycle through distinct knob mixes
+    (temperature/top-k/top-p/penalties, fixed per-request seeds) — the
+    one-compiled-program-for-every-mix claim under test."""
+    from bigdl_tpu.serving import SamplingParams
+
+    rng = np.random.RandomState(seed)
+    buckets = [5, 9, 17]
+    mixes = [
+        dict(temperature=0.7, top_k=20, seed=101),
+        dict(temperature=1.0, top_p=0.95, repetition_penalty=1.2,
+             seed=102),
+        dict(temperature=1.3, top_k=50, top_p=0.8, presence_penalty=0.4,
+             seed=103),
+        dict(temperature=0.9, frequency_penalty=0.3, min_tokens=4,
+             seed=104),
+    ]
+    trace = []
+    for i in range(n_requests):
+        plen = buckets[i % len(buckets)]
+        prompt = rng.randint(1, cfg["vocab"] + 1, size=(plen,)).tolist()
+        sp = SamplingParams(**mixes[(i // 2) % len(mixes)]) \
+            if i % 2 else None
+        trace.append((prompt, gen_tokens, sp))
+    return trace
+
+
+def _run_sampling_engine(lm, dtype, trace, n_slots: int, greedy: bool):
+    """One drain()-to-empty pass; greedy=True strips every request's
+    SamplingParams (the baseline same-prompts workload)."""
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=n_slots, compute_dtype=dtype)
+    rids = [eng.submit(p, max_new_tokens=n,
+                       sampling=None if greedy else sp)
+            for p, n, sp in trace]
+    t0 = time.perf_counter()
+    outs = eng.drain()
+    wall = time.perf_counter() - t0
+    n_tokens = int(sum(len(v) for v in outs.values()))
+    return eng, rids, outs, {
+        "tokens_per_sec": round(n_tokens / wall, 1),
+        "wall_s": round(wall, 3), "tokens": n_tokens,
+        "decode_programs": eng._step_fn._cache_size(),
+    }
+
+
+def run_sampling(model: str = "tiny", variant: str = "fp32",
+                 n_requests: int = 16, gen_tokens: int = 32,
+                 n_slots: int = 8) -> dict:
+    """Mixed greedy/sampled serving vs an all-greedy baseline on the
+    SAME prompts. The contract under test: (a) the mixed run adds ZERO
+    decode-program compiles beyond the greedy baseline (knobs are
+    runtime per-row arrays of one compiled sampled step), and (b) the
+    greedy requests inside the mixed batch produce tokens identical to
+    the greedy-only run (sampled neighbors don't perturb greedy rows).
+    Reports the tokens/sec delta — the fused sampling epilogue's cost."""
+    lm, dtype, cfg = build(model, variant)
+    trace = make_sampling_trace(cfg, n_requests, gen_tokens)
+    # warm the (model, dtype, n_slots) step + prefill buckets so both
+    # timed passes are compile-free and the delta is pure epilogue math
+    _run_sampling_engine(lm, dtype, [(p, 2, sp) for p, _, sp in trace],
+                         n_slots, greedy=False)
+    eng_g, rids_g, outs_g, greedy_stats = _run_sampling_engine(
+        lm, dtype, trace, n_slots, greedy=True)
+    eng_m, rids_m, outs_m, mixed_stats = _run_sampling_engine(
+        lm, dtype, trace, n_slots, greedy=False)
+    greedy_rows_match = all(
+        np.array_equal(outs_g[rg], outs_m[rm])
+        for (p, n, sp), rg, rm in zip(trace, rids_g, rids_m)
+        if sp is None)
+    s = eng_m.metrics.summary()
+    return {
+        "metric": "serving_mixed_sampling_tokens_per_sec",
+        "model": model, "variant": variant, "requests": n_requests,
+        "gen_tokens": gen_tokens, "slots": n_slots,
+        "greedy": greedy_stats, "mixed": mixed_stats,
+        "extra_decode_compiles": (mixed_stats["decode_programs"]
+                                  - greedy_stats["decode_programs"]),
+        "greedy_rows_match": bool(greedy_rows_match),
+        "sampled_row_frac": round(s.get("serving/sampled_row_frac", 0.0),
+                                  3),
+        "mean_logprob": round(s.get("serving/mean_logprob", 0.0), 3),
+        "sampling_overhead_pct": round(
+            100.0 * (greedy_stats["tokens_per_sec"]
+                     / max(mixed_stats["tokens_per_sec"], 1e-9) - 1.0),
+            1),
+    }
+
+
 def run(model: str = "tiny", variant: str = "fp32", n_requests: int = 12,
         gen_tokens: int = 48, stagger_ms: float = 10.0, n_slots: int = 12,
         policy: str = "prefill_priority") -> dict:
@@ -305,7 +405,7 @@ def run(model: str = "tiny", variant: str = "fp32", n_requests: int = 12,
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario", default="mixed",
-                    choices=["mixed", "admission"])
+                    choices=["mixed", "admission", "sampling"])
     ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
     ap.add_argument("--variant", default="fp32", choices=["fp32", "bf16"])
     # requests/gen_tokens/slots default per scenario: mixed 12/48/12,
@@ -321,6 +421,13 @@ def main() -> None:
     ap.add_argument("--shared_frac", type=float, default=0.5)
     ap.add_argument("--prefix_len", type=int, default=12)
     args = ap.parse_args()
+    if args.scenario == "sampling":
+        print(json.dumps(run_sampling(
+            args.model, args.variant,
+            n_requests=args.requests or 16,
+            gen_tokens=args.gen_tokens or 32,
+            n_slots=args.slots or 8)))
+        return
     if args.scenario == "admission":
         print(json.dumps(run_admission(
             args.model, args.variant,

@@ -806,7 +806,8 @@ def make_decode_step(model: Sequential, compute_dtype=None):
     return jax.jit(step), init_carry
 
 
-def make_batch_decode_step(model: Sequential, compute_dtype=None):
+def make_batch_decode_step(model: Sequential, compute_dtype=None,
+                           sampling: bool = False):
     """Per-ROW-position decode step for continuous batching
     (``bigdl_tpu.serving``): every cache row advances independently, so
     one pooled carry can hold many requests at different depths and rows
@@ -827,6 +828,25 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None):
       per-row over the row's own cache), so each active row computes the
       same math as the single-request :func:`make_decode_step` (equal to
       float round-off — batch shape changes XLA reduction order).
+
+    ``sampling=True`` fuses a per-row SAMPLE-FROM-LOGITS epilogue
+    (:func:`bigdl_tpu.serving.sampling.sample_rows`) into the step:
+
+    * the carry grows per-row sampling state — ``rng`` (N, 2) uint32
+      RNG lanes, ``tok_counts`` (N, vocab) int32 generated-token
+      counts, ``prompt_mask`` (N, vocab) bool prompt membership (the
+      engine seeds these per admission via ``KVPool.write_sampling``);
+    * the signature becomes ``step_fn(params, tokens, active, carry,
+      knobs) -> (token, chosen_logp, carry)`` — ``knobs`` is the
+      per-row array dict of :func:`~bigdl_tpu.serving.sampling.
+      make_knob_rows` (temperature/top-k/top-p/penalties/ban rows, all
+      runtime VALUES: one compiled program covers every knob mix, and
+      ``temperature == 0`` rows reduce to exact argmax);
+    * the ``(N, vocab)`` distribution never crosses to host — only the
+      chosen token ids and their raw model log-probs do, preserving the
+      one-small-readback-per-step property the greedy step had;
+    * inactive rows stay bitwise untouched (rng/counts included); their
+      token/log-prob outputs are garbage the caller must ignore.
 
     NOTE: the per-layer body below intentionally parallels (not shares)
     make_decode_step's loop — unifying them would put per-row gathers and
@@ -852,6 +872,7 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None):
     mods = model.modules
     assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
     max_len = mods[1].max_len
+    vocab = mods[0].n_index
     off = _decode_head_offset(model)
     lnf = mods[-2 - off]
     _, _, blocks0, _, _ = _resolve_decode_views(model, off, model.params)
@@ -867,11 +888,17 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None):
                                        cache_dtype)
             carry[f"v{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
                                        cache_dtype)
+        if sampling:
+            # per-row sampling state: RNG lanes + penalty counters (the
+            # engine seeds rows at admission — KVPool.write_sampling)
+            carry["rng"] = jnp.zeros((n_slots, 2), jnp.uint32)
+            carry["tok_counts"] = jnp.zeros((n_slots, vocab), jnp.int32)
+            carry["prompt_mask"] = jnp.zeros((n_slots, vocab), bool)
         return carry
 
     _proj = _serving_proj
 
-    def step(params, tokens, active, carry):
+    def forward(params, tokens, active, carry):
         Pt = _cast_keep_scales(params, compute_dtype)
         lookup_w, pos_w, blocks, lnf_p, lin_p = \
             _resolve_decode_views(model, off, Pt)
@@ -924,12 +951,35 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None):
         return jax.nn.log_softmax(logits.astype(jnp.float32),
                                   axis=-1), new_carry
 
+    def step(params, tokens, active, carry):
+        return forward(params, tokens, active, carry)
+
+    def sample_step(params, tokens, active, carry, knobs):
+        # fused sampling epilogue: (N, vocab) log-probs reduce to a
+        # per-row token + raw-model log-prob on device (sampling.py is
+        # imported lazily — serving imports models, not vice versa)
+        from bigdl_tpu.serving.sampling import sample_rows
+
+        logp, new_carry = forward(params, tokens, active, carry)
+        tok, chosen, new_keys, new_counts = sample_rows(
+            logp, carry["rng"], knobs, carry["tok_counts"],
+            carry["prompt_mask"])
+        # inactive rows: rng/counts bitwise untouched, same contract as
+        # the K/V scatter above
+        new_carry["rng"] = jnp.where(active[:, None], new_keys,
+                                     carry["rng"])
+        new_carry["tok_counts"] = jnp.where(active[:, None], new_counts,
+                                            carry["tok_counts"])
+        return tok, chosen, new_carry
+
     # the carry is DONATED: the engine replaces its pooled carry with the
     # step's output every token, and without donation XLA materializes a
     # complete second copy of the whole KV pool per generated token
     # (~300 MB/step at 137M/8 slots). Callers must not touch the input
     # carry after a step — read it (np.asarray) before stepping.
-    return jax.jit(step, donate_argnums=(3,)), init_carry
+    jitted = jax.jit(sample_step if sampling else step,
+                     donate_argnums=(3,))
+    return jitted, init_carry
 
 
 # -- jitted-step cache (ADVICE r5: generate()/beam_generate() paid two
@@ -982,10 +1032,15 @@ def get_prefill_step(model: Sequential, compute_dtype=None):
                        lambda: make_prefill_step(model, compute_dtype))
 
 
-def get_batch_decode_step(model: Sequential, compute_dtype=None):
-    """Cached :func:`make_batch_decode_step` (the serving engine's step)."""
-    return _step_cache(model, "batch_decode", compute_dtype,
-                       lambda: make_batch_decode_step(model, compute_dtype))
+def get_batch_decode_step(model: Sequential, compute_dtype=None,
+                          sampling: bool = False):
+    """Cached :func:`make_batch_decode_step` (the serving engine's step).
+    ``sampling=True`` selects the sampled-epilogue variant (its own
+    cache entry — the two steps have different signatures/carries)."""
+    kind = "batch_decode_sample" if sampling else "batch_decode"
+    return _step_cache(model, kind, compute_dtype,
+                       lambda: make_batch_decode_step(model, compute_dtype,
+                                                      sampling=sampling))
 
 
 def get_batch_prefill_step(model: Sequential, compute_dtype=None):
@@ -1047,18 +1102,40 @@ def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
 
 def generate(model: Sequential, prompt_ids, length: int = 32,
              temperature: float = 1.0, top_k: int = 0, seed: int = 0,
-             compute_dtype=None):
+             compute_dtype=None, sampling=None, return_logprobs=False):
     """Sampled (or greedy) continuation with the KV-cached decoder.
 
-    ``temperature=0`` is greedy argmax; ``top_k > 0`` restricts sampling to
-    the k most likely tokens. Returns (length,) 1-based word ids.
-    ``compute_dtype`` selects the serving precision; weights ride as
-    runtime arguments (see :func:`make_decode_step`).
+    ``temperature=0`` is greedy argmax; ``top_k > 0`` restricts sampling
+    to the k most likely tokens. Returns (n,) 1-based word ids (n ==
+    ``length`` unless a stop set ends the run early);
+    ``return_logprobs=True`` returns ``(ids, logprobs)`` with the chosen
+    tokens' raw model log-probs. ``compute_dtype`` selects the serving
+    precision; weights ride as runtime arguments
+    (see :func:`make_decode_step`).
+
+    ``sampling`` takes a full
+    :class:`bigdl_tpu.serving.sampling.SamplingParams` (top-p,
+    penalties, min/max tokens, stop sets — it overrides the
+    ``temperature``/``top_k``/``seed`` scalars). The draw runs through
+    the SAME per-row sampler as the serving engine
+    (:func:`~bigdl_tpu.serving.sampling.sample_rows` with one row), with
+    the lane seeded by the same seed → key rule — so a fixed seed yields
+    the engine's token stream for the same request (to the usual float
+    round-off caveat on near-tied logits).
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from bigdl_tpu.serving.sampling import (
+        SamplingParams, get_sampler, knob_row_values, lane_key,
+        match_stop_sequences,
+    )
+
+    sp = sampling if sampling is not None else SamplingParams(
+        temperature=temperature, top_k=top_k, seed=seed)
+    if sp.max_tokens is not None:
+        length = sp.max_tokens
     # cached per (model, dtype) — repeated calls stop paying XLA compiles
     step, init_carry = get_decode_step(model, compute_dtype=compute_dtype)
     P = jax.device_put(serving_params(model, compute_dtype))
@@ -1076,21 +1153,35 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
         ptoks = jnp.asarray([[t - 1 for t in prompt[:-1]]], jnp.int32)
         _, carry = prefill(P, ptoks, carry)
 
-    key = jax.random.PRNGKey(seed)
+    # one-row sampler state: the engine's per-slot layout with N=1
+    vocab = model.modules[0].n_index
+    scal, ban_row = knob_row_values(sp, -1)
+    ban_base = bool(scal["ban"])
+    knobs = {k: jnp.asarray([v]) for k, v in scal.items()}
+    knobs["ban_ids"] = jnp.asarray(ban_row[None])
+    counts = jnp.zeros((1, vocab), jnp.int32)
+    pmask = np.zeros((vocab,), bool)
+    pmask[np.clip(np.asarray(prompt) - 1, 0, vocab - 1)] = True
+    pmask = jnp.asarray(pmask[None])
+    keys = lane_key(sp.seed if sp.seed is not None else seed)[None]
+    sampler = get_sampler()
+
     tok = jnp.asarray([prompt[-1] - 1], jnp.int32)
-    out = []
+    out, lps = [], []
     for i in range(length):
         logp, carry = step(P, tok, carry)
-        logits = logp[0]
-        if temperature <= 0.0:
-            nxt = jnp.argmax(logits)
-        else:
-            logits = logits / temperature
-            if top_k > 0:
-                kth = jax.lax.top_k(logits, top_k)[0][-1]
-                logits = jnp.where(logits >= kth, logits, -1e30)
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits)
-        tok = nxt[None].astype(jnp.int32)
-        out.append(int(nxt) + 1)             # back to 1-based ids
-    return np.asarray(out, np.int32)
+        # min-tokens ban rides as a runtime VALUE (no retrace)
+        knobs["ban"] = jnp.asarray([ban_base and i < sp.min_tokens])
+        tok, chosen, keys, counts = sampler(logp, keys, knobs, counts,
+                                            pmask)
+        t1 = int(tok[0]) + 1                 # back to 1-based ids
+        out.append(t1)
+        lps.append(float(chosen[0]))
+        if len(out) >= sp.min_tokens and (
+                t1 in sp.stop_token_ids
+                or match_stop_sequences(out, sp.stop_sequences)):
+            break
+    ids = np.asarray(out, np.int32)
+    if return_logprobs:
+        return ids, np.asarray(lps, np.float32)
+    return ids

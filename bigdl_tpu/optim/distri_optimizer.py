@@ -173,7 +173,8 @@ class DistriOptimizer(Optimizer):
         import jax.numpy as jnp
         from jax import lax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        shard_map = jax.shard_map
+
+        from bigdl_tpu.utils.compat import shard_map
 
         n = mesh.devices.size
         arp = AllReduceParameter(params, n, "data", compress=self.compress)
@@ -272,8 +273,9 @@ class DistriOptimizer(Optimizer):
         import jax
         import jax.numpy as jnp
         from jax import lax
-        shard_map = jax.shard_map
         from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.utils.compat import device_varying_marker, shard_map
 
         model, criterion, optim = self.model, self.criterion, self.optim_method
         compute_dtype = resolve_dtype(self.compute_dtype)
@@ -286,12 +288,7 @@ class DistriOptimizer(Optimizer):
             # mark replicated params device-varying so grads come back LOCAL
             # (jax 0.9 shard_map auto-psums cotangents of unvaried inputs);
             # the pmean below is then the one explicit all-reduce.
-            pcast = getattr(lax, "pcast", None)
-            mark_varying = (
-                (lambda x: pcast(x, "data", to="varying"))
-                if pcast is not None
-                else (lambda x: lax.pvary(x, "data"))
-            )
+            mark_varying = device_varying_marker("data")
             params_v = jax.tree_util.tree_map(mark_varying, params)
 
             def loss_fn(p):
@@ -465,13 +462,12 @@ class DistriOptimizer(Optimizer):
             return grads, new_ms, loss
 
         if nl > 1:
-            local_mesh = Mesh(np.asarray(local_devs), ("ldata",))
+            from bigdl_tpu.utils.compat import (
+                device_varying_marker, shard_map,
+            )
 
-            pcast = getattr(lax, "pcast", None)
-            mark_varying = (
-                (lambda x: pcast(x, "ldata", to="varying"))
-                if pcast is not None
-                else (lambda x: lax.pvary(x, "ldata")))
+            local_mesh = Mesh(np.asarray(local_devs), ("ldata",))
+            mark_varying = device_varying_marker("ldata")
 
             def spmd(params, model_state, rng, inputs, targets):
                 rng = jax.random.fold_in(
@@ -485,7 +481,7 @@ class DistriOptimizer(Optimizer):
                 return grads, new_ms, loss
 
             rep, sh = P(), P("ldata")
-            grad_step = jax.jit(jax.shard_map(
+            grad_step = jax.jit(shard_map(
                 spmd, mesh=local_mesh,
                 in_specs=(rep, rep, rep, sh, sh),
                 out_specs=(rep, rep, rep)))
@@ -742,7 +738,9 @@ class DistriOptimizer(Optimizer):
                                          training=False, rng=None)
                     return out
 
-                self._dist_eval_step = jax.jit(jax.shard_map(
+                from bigdl_tpu.utils.compat import shard_map
+
+                self._dist_eval_step = jax.jit(shard_map(
                     spmd, mesh=mesh,
                     in_specs=(P("data"), P(), P("data")),
                     out_specs=P("data"),

@@ -82,9 +82,9 @@ def gpipe(fn: Callable, stage_params, microbatches, axis_name: str = "pipe"):
     # axes the microbatches vary over (e.g. 'data' on a composed
     # DP×TP×PP mesh) — fresh zeros would type as replicated there and the
     # fori_loop carry would mismatch its body.
-    pcast = getattr(lax, "pcast", None)
-    vary = ((lambda t: pcast(t, axis_name, to="varying")) if pcast is not None
-            else (lambda t: lax.pvary(t, axis_name)))
+    from bigdl_tpu.utils.compat import device_varying_marker
+
+    vary = device_varying_marker(axis_name)
     recv0 = vary((microbatches[0] * 0).astype(out_dtype))
     out0 = vary((microbatches * 0).astype(out_dtype))
     _, outputs = lax.fori_loop(0, M + n_stages - 1, tick, (recv0, out0))

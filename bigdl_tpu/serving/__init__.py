@@ -3,18 +3,26 @@
 The serving layer between the model zoo and the parallel stack: many
 independent generation requests share ONE pooled, slot-indexed KV cache
 and ONE compiled per-row decode program, with FIFO admission into rows
-freed mid-flight (continuous batching). Admission itself is batched and
+freed mid-flight (continuous batching). Decoding is sampled PER ROW
+(``sampling.py``): each request's ``SamplingParams`` (temperature,
+top-k/top-p, penalties, seed, stop sets) ride as per-row runtime arrays
+of the one compiled step — greedy and sampled requests mix freely with
+zero recompiles, and per-row RNG lanes make a fixed seed bit-stable
+across batching and slot readmission. Admission itself is batched and
 shape-stable: ragged prompts prefill together through a bounded set of
 power-of-two length buckets (``admission.py``), optionally reusing
 shared-prefix K/V from a ref-counted radix cache (``prefix_cache.py``).
 See ``docs/serving.md``.
 
-    from bigdl_tpu.serving import ServingEngine
+    from bigdl_tpu.serving import SamplingParams, ServingEngine
 
     eng = ServingEngine(lm, n_slots=8, compute_dtype=jnp.bfloat16,
                         prefix_cache=True)
-    rid = eng.submit([3, 7, 2], max_new_tokens=32, eos_id=5)
+    rid = eng.submit([3, 7, 2], max_new_tokens=32, eos_id=5,
+                     sampling=SamplingParams(temperature=0.8,
+                                             top_k=50, seed=42))
     outputs = eng.drain()            # {rid: 1-based token ids}
+    print(eng.logprobs(rid))         # chosen-token model log-probs
     print(eng.metrics.summary())     # TTFT percentiles, tokens/sec, ...
 """
 
@@ -23,8 +31,9 @@ from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.prefix_cache import PrefixCache
+from bigdl_tpu.serving.sampling import SamplingParams
 from bigdl_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "Scheduler", "AdmissionController", "PrefixCache",
-           "bucket_len"]
+           "SamplingParams", "bucket_len"]

@@ -30,14 +30,27 @@ compiled per-row-position decode program
 * rows are evicted at EOS or ``max_new_tokens`` and their slot returns
   to the free list for the next admission.
 
-Decoding is GREEDY (argmax), and the pooled step computes the same math
-as the single-request step, so engine outputs match per-request
-``generate(..., temperature=0)`` token for token — pinned by
-tests/test_serving.py for plain and bf16-serving params. (The two steps
+Decoding is SAMPLED per row (``bigdl_tpu.serving.sampling``): every
+request carries its own :class:`~bigdl_tpu.serving.sampling.
+SamplingParams` (temperature, top-k/top-p, penalties, seed, stop sets)
+and its own ``jax.random`` lane in the pooled carry, and ONE compiled
+step samples all rows at once — the knobs are per-row runtime arrays,
+so greedy and sampled rows mix freely in a batch and changing knobs
+never recompiles. The default params are greedy (``temperature=0``
+degrades exactly to argmax inside the same program), and the pooled
+step computes the same math as the single-request step, so default
+engine outputs match per-request ``generate(..., temperature=0)`` token
+for token — pinned by tests/test_serving.py for plain and bf16-serving
+params; a fixed-seed sampled request reproduces its stream across
+batching, slot placement, and eviction/readmission (pinned by
+tests/test_serving_sampling.py). (The pooled and single-request steps
 are numerically equal only to float round-off — different batch shapes
 can reorder XLA reductions — so a checkpoint whose top-2 logprobs tie
 within ~1e-5 could in principle break a tie differently; the parity
-tests pin the realistic case, not a bitwise guarantee.)
+tests pin the realistic case, not a bitwise guarantee.) Stop-SEQUENCE
+matching runs on host against each row's token tail; stop TOKEN ids
+(incl. the per-request ``eos_id``) evict the row the step they appear,
+with ``min_tokens`` banning them on device until the floor is met.
 
 The jitted step/prefill functions come from the per-(model, dtype) step
 cache (``get_batch_decode_step`` / ``get_prefill_step``), so several
@@ -55,11 +68,14 @@ import numpy as np
 
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.sampling import (
+    SamplingParams, knob_row_values, make_knob_rows, match_stop_sequences,
+)
 from bigdl_tpu.serving.scheduler import FINISHED, Request, Scheduler
 
 
 class ServingEngine:
-    """Continuous-batching greedy decoder over a pooled KV cache.
+    """Continuous-batching per-row-sampled decoder over a pooled KV cache.
 
     ``n_slots`` is the fixed decode capacity (concurrent requests);
     ``compute_dtype`` is the serving precision knob (weights + KV cache,
@@ -78,7 +94,11 @@ class ServingEngine:
     most recently finished requests stay retrievable via ``result()``
     (older ones are evicted oldest-first), so a long-lived engine under
     heavy traffic doesn't grow without bound. ``None`` keeps everything
-    (then ``pop_result()`` is the caller's eviction lever).
+    (then ``pop_result()`` is the caller's eviction lever);
+    ``seed`` is the engine's base RNG seed: requests whose
+    ``SamplingParams.seed`` is None draw from a lane folded from this
+    base and their request id (fresh per request); an explicit
+    per-request seed pins the lane regardless of the engine seed.
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
@@ -86,7 +106,8 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  admission: str = "batched",
                  prefix_cache=None,
-                 keep_finished: Optional[int] = None) -> None:
+                 keep_finished: Optional[int] = None,
+                 seed: int = 0) -> None:
         import jax
 
         from bigdl_tpu.models.transformer import (
@@ -110,13 +131,28 @@ class ServingEngine:
         # weights as resident device buffers in the serving dtype
         # (runtime arguments — never baked into the compiled programs)
         self.params = jax.device_put(serving_params(model, compute_dtype))
-        self._step_fn, pool_init = get_batch_decode_step(model, compute_dtype)
+        # the SAMPLED pooled step is the only decode program: greedy
+        # requests are temperature=0 rows of the same compiled step, so
+        # greedy-only and mixed traffic share one program (pinned by the
+        # compile-count guard in tests/test_serving_sampling.py)
+        self._step_fn, pool_init = get_batch_decode_step(
+            model, compute_dtype, sampling=True)
         self._pool_init = pool_init
         self.pool = KVPool(pool_init, n_slots)
         self.scheduler = Scheduler(policy)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.admission = admission
         self.keep_finished = keep_finished
+        self.seed = int(seed)
+        # host-side per-slot knob rows (greedy no-op state) + which
+        # slots have been configured for their current occupant
+        self._knobs = make_knob_rows(n_slots)
+        self._ban_base = np.zeros((n_slots,), bool)
+        self._configured: set = set()
+        # device-side knob cache: knobs only change at admission or a
+        # min-tokens ban flip, so the steady-state decode loop reuses
+        # the same device arrays instead of re-uploading every step
+        self._knobs_device = None
         if admission == "batched":
             self._batch_prefill_fn = get_batch_prefill_step(model,
                                                             compute_dtype)
@@ -148,14 +184,28 @@ class ServingEngine:
     # -- request surface ---------------------------------------------------
 
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
-               eos_id: int = -1) -> int:
+               eos_id: int = -1, sampling: Optional[SamplingParams] = None
+               ) -> int:
         """Queue one generation request (1-based prompt ids, like
         ``generate()``); returns its request id. Raises if the request
         could ever overflow the cache (same ``max_len`` guard as
-        ``generate()``)."""
+        ``generate()``).
+
+        ``eos_id`` is the request's PRIVATE eos (1-based; -1 = none) —
+        different requests in the same batch may stop on different
+        tokens; it joins ``sampling.stop_token_ids`` in the min-tokens
+        device ban. ``sampling`` carries the request's
+        :class:`~bigdl_tpu.serving.sampling.SamplingParams` (None =
+        greedy defaults, the pre-sampling engine behavior);
+        ``sampling.max_tokens`` (when set) overrides
+        ``max_new_tokens``."""
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("need a non-empty prompt")
+        # SamplingParams validates on construction (frozen dataclass)
+        sp = sampling if sampling is not None else SamplingParams()
+        if sp.max_tokens is not None:
+            max_new_tokens = sp.max_tokens
         if len(prompt) - 1 + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -166,7 +216,8 @@ class ServingEngine:
         self._next_id += 1
         self.scheduler.submit(Request(
             req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
-            eos_id=int(eos_id), submit_time=time.perf_counter()))
+            eos_id=int(eos_id), sampling=sp,
+            submit_time=time.perf_counter()))
         self.metrics.on_submit()
         return rid
 
@@ -183,6 +234,13 @@ class ServingEngine:
         automatic alternative)."""
         req = self._finished.pop(req_id, None)
         return None if req is None else np.asarray(req.output, np.int32)
+
+    def logprobs(self, req_id: int) -> Optional[np.ndarray]:
+        """Chosen-token raw model log-probs for a FINISHED request (one
+        per output token), else None — the logprobs twin of
+        :meth:`result`."""
+        req = self._finished.get(req_id)
+        return None if req is None else np.asarray(req.logprobs, np.float32)
 
     def cancel(self, req_id: int) -> bool:
         """Cancel a WAITING request: it is dequeued, never occupies a
@@ -239,6 +297,35 @@ class ServingEngine:
             # generate()'s convention, so outputs match token-for-token
             req.next_token = prompt0[-1]
 
+    def _lane_key(self, req: Request):
+        """The request's RNG-lane key: an explicit ``SamplingParams.seed``
+        pins the lane (``sampling.lane_key`` — the rule ``generate()``
+        shares), else a fresh lane folded from the engine seed and the
+        request id. Either way the lane is a function of the REQUEST,
+        never the slot, so readmission into any slot replays the same
+        stream."""
+        import jax
+
+        from bigdl_tpu.serving.sampling import lane_key
+
+        sp = req.sampling
+        if sp.seed is not None:
+            return lane_key(sp.seed)
+        return jax.random.fold_in(lane_key(self.seed), req.req_id)
+
+    def _configure_slot(self, slot: int, req: Request) -> None:
+        """Thread one admitted request's SamplingParams into its slot:
+        knob rows on host, RNG lane + penalty state on device."""
+        sp = req.sampling
+        scal, ban_row = knob_row_values(sp, req.eos_id)
+        for k, v in scal.items():
+            self._knobs[k][slot] = v
+        self._knobs["ban_ids"][slot] = ban_row
+        self._ban_base[slot] = self._knobs["ban"][slot]
+        self._knobs_device = None                # re-upload next step
+        self.pool.write_sampling(slot, self._lane_key(req), req.prompt)
+        self._configured.add(slot)
+
     def step(self) -> Dict[int, int]:
         """Admit waiting requests, then decode ONE token for every active
         row. Returns ``{req_id: 1-based token}`` emitted this step (empty
@@ -252,19 +339,30 @@ class ServingEngine:
         N = self.pool.n_slots
         tokens = np.zeros((N,), np.int32)
         active = np.zeros((N,), bool)
+        n_sampled = 0
         for slot, req in running.items():
+            if slot not in self._configured:
+                self._configure_slot(slot, req)
             tokens[slot] = req.next_token
             active[slot] = True
+            n_sampled += not req.sampling.is_greedy
         t0 = time.perf_counter()
-        logp, carry = self._step_fn(self.params, jnp.asarray(tokens),
-                                    jnp.asarray(active), self.pool.carry)
+        if self._knobs_device is None:
+            self._knobs_device = {k: jnp.asarray(v)
+                                  for k, v in self._knobs.items()}
+        knobs = self._knobs_device
+        tok, chosen, carry = self._step_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(active),
+            self.pool.carry, knobs)
         self.pool.carry = carry
-        # ONE host readback per step: the argmax reduces (N, V) → (N,)
-        # on device before crossing
-        nxt = np.asarray(jnp.argmax(logp, axis=-1))
+        # the (N, V) distribution never crosses to host — sampling is
+        # fused into the step; only token ids + chosen log-probs do
+        nxt = np.asarray(tok)
+        lps = np.asarray(chosen)
         self.metrics.add_phase("decode_step", time.perf_counter() - t0)
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.occupancy(), int(active.sum()))
+        self.metrics.on_sample_rows(n_sampled, len(running) - n_sampled)
 
         emitted: Dict[int, int] = {}
         now = time.perf_counter()
@@ -272,21 +370,42 @@ class ServingEngine:
             tok0 = int(nxt[slot])
             tok1 = tok0 + 1                      # back to 1-based ids
             req.output.append(tok1)
+            req.logprobs.append(float(lps[slot]))
             emitted[req.req_id] = tok1
             if req.first_token_time is None:
                 req.first_token_time = now
                 self.metrics.on_first_token(now - req.submit_time)
-            done = ((req.eos_id > 0 and tok1 == req.eos_id)
-                    or len(req.output) >= req.max_new_tokens)
-            if done:
+            sp = req.sampling
+            n_out = len(req.output)
+            reason = None
+            if n_out >= sp.min_tokens:
+                if req.eos_id > 0 and tok1 == req.eos_id:
+                    reason = "eos"
+                elif (tok1 in sp.stop_token_ids
+                      or match_stop_sequences(req.output,
+                                              sp.stop_sequences)):
+                    reason = "stop"
+            if reason is None and n_out >= req.max_new_tokens:
+                reason = "length"
+            if reason is not None:
+                req.finish_reason = reason
                 freed = self.scheduler.finish(req, now)
                 self.pool.free(freed)
+                self._configured.discard(freed)
                 self._finished[req.req_id] = req
                 self._evict_finished()
-                self.metrics.on_finish(now - req.submit_time,
-                                       len(req.output))
+                self.metrics.on_finish(
+                    now - req.submit_time, len(req.output),
+                    mean_logprob=float(np.mean(req.logprobs)))
             else:
                 req.next_token = tok0
+                if self._ban_base[slot]:
+                    # min-tokens ban lifts the step the floor is met —
+                    # a runtime VALUE change, never a recompile
+                    ban = n_out < sp.min_tokens
+                    if ban != self._knobs["ban"][slot]:
+                        self._knobs["ban"][slot] = ban
+                        self._knobs_device = None
         return emitted
 
     def drain(self) -> Dict[int, np.ndarray]:

@@ -143,3 +143,37 @@ class KVPool:
         if slot not in self._in_use:
             raise ValueError(f"slot {slot} is not allocated")
         self.carry["pos"] = self.carry["pos"].at[slot].set(int(pos))
+
+    # -- sampling lanes ----------------------------------------------------
+
+    def write_sampling(self, slot: int, key, prompt_ids) -> None:
+        """Seed one slot's SAMPLING state at admission (requires a
+        sampling-enabled carry — ``make_batch_decode_step(...,
+        sampling=True)``): the row's RNG lane becomes ``key`` (derived
+        from the REQUEST's seed, never from the slot — so a request
+        readmitted into a different slot after an eviction continues
+        the exact same lane), its generated-token counts reset to zero,
+        and its prompt-membership mask is rebuilt from ``prompt_ids``
+        (1-based; feeds the repetition penalty). Stale state from the
+        slot's previous occupant is fully overwritten — recycled slots
+        leak nothing into the new request's distribution."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        if "rng" not in self.carry:
+            raise ValueError(
+                "this pool's carry has no sampling state — build it "
+                "from make_batch_decode_step(..., sampling=True)")
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        V = self.carry["tok_counts"].shape[1]
+        mask = np.zeros((V,), bool)
+        if len(prompt_ids):
+            mask[np.clip(np.asarray(prompt_ids, np.int64) - 1,
+                         0, V - 1)] = True
+        self.carry["rng"] = self.carry["rng"].at[slot].set(
+            jnp.asarray(key, jnp.uint32))
+        self.carry["tok_counts"] = self.carry["tok_counts"].at[slot].set(
+            jnp.int32(0))
+        self.carry["prompt_mask"] = self.carry["prompt_mask"].at[slot].set(
+            jnp.asarray(mask))

@@ -27,6 +27,14 @@ Batched-admission counters (``serving/admission.py``):
   (sum = the bounded compiled-program count the bucket scheme enforces)
 * ``prefix_lookups`` / ``prefix_hits`` / ``prefix_hit_tokens`` —
   prefix-cache traffic; ``summary()`` derives ``prefix_hit_rate``
+
+Sampling counters (``serving/sampling.py``):
+
+* ``rows_sampled`` / ``rows_greedy`` — active rows per decode step that
+  drew from a sampled distribution (temperature > 0) vs took argmax;
+  ``summary()`` derives ``sampled_row_frac``
+* ``mean_logprob``        — per-request mean chosen-token raw model
+  log-prob (recorded at finish; a cheap generation-quality signal)
 """
 
 from __future__ import annotations
@@ -63,10 +71,19 @@ class ServingMetrics:
     def on_first_token(self, ttft_s: float) -> None:
         self.metrics.add("serving/ttft_s", float(ttft_s))
 
-    def on_finish(self, latency_s: float, n_tokens: int) -> None:
+    def on_finish(self, latency_s: float, n_tokens: int,
+                  mean_logprob: Optional[float] = None) -> None:
         self.metrics.add("serving/finished", 1.0)
         self.metrics.add("serving/latency_s", float(latency_s))
         self.metrics.add("serving/tokens_out", float(n_tokens))
+        if mean_logprob is not None:
+            self.metrics.add("serving/mean_logprob", float(mean_logprob))
+
+    def on_sample_rows(self, n_sampled: int, n_greedy: int) -> None:
+        """Per decode step: how many active rows drew from a sampled
+        distribution (temperature > 0) vs took the argmax."""
+        self.metrics.add("serving/rows_sampled", float(n_sampled))
+        self.metrics.add("serving/rows_greedy", float(n_greedy))
 
     def on_cancel(self) -> None:
         self.metrics.add("serving/cancelled", 1.0)
@@ -121,6 +138,10 @@ class ServingMetrics:
         if n_look:
             n_hit, _ = self.metrics.get("serving/prefix_hits")
             out["serving/prefix_hit_rate"] = n_hit / n_look
+        n_s, _ = self.metrics.get("serving/rows_sampled")
+        n_g, _ = self.metrics.get("serving/rows_greedy")
+        if n_s + n_g > 0:
+            out["serving/sampled_row_frac"] = n_s / (n_s + n_g)
         for k, v in self.ttft_percentiles().items():
             out[f"serving/ttft_{k}_s"] = v
         return out

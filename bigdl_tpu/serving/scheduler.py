@@ -26,6 +26,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
 
+from bigdl_tpu.serving.sampling import SamplingParams
+
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 CANCELLED = "cancelled"
 
@@ -34,7 +36,14 @@ _POLICIES = ("prefill_priority", "fifo")
 
 @dataclass
 class Request:
-    """One generation request's full lifecycle record."""
+    """One generation request's full lifecycle record.
+
+    ``sampling`` carries the request's
+    :class:`~bigdl_tpu.serving.sampling.SamplingParams` (None = greedy
+    defaults — the engine normalizes at submit); ``logprobs`` collects
+    the chosen tokens' raw model log-probs, one per output token;
+    ``finish_reason`` is set by the engine at eviction (``"eos"``,
+    ``"stop"`` for stop-token/stop-sequence hits, ``"length"``)."""
 
     req_id: int
     prompt: List[int]                  # 1-based word ids, non-empty
@@ -44,6 +53,9 @@ class Request:
     slot: Optional[int] = None
     next_token: Optional[int] = None   # 0-based token to feed next step
     output: List[int] = field(default_factory=list)   # 1-based ids
+    sampling: Optional[SamplingParams] = None
+    logprobs: List[float] = field(default_factory=list)
+    finish_reason: Optional[str] = None
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -52,6 +64,8 @@ class Request:
     def done_reason(self) -> Optional[str]:
         if self.state != FINISHED:
             return None
+        if self.finish_reason is not None:
+            return self.finish_reason
         if self.output and self.eos_id > 0 and self.output[-1] == self.eos_id:
             return "eos"
         return "length"

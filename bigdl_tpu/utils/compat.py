@@ -1,0 +1,69 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+The SPMD plane targets three generations of jax at once:
+
+* ``shard_map`` lived in ``jax.experimental.shard_map`` through the
+  0.4.x line, then graduated to ``jax.shard_map``;
+* varying-type marking went ``lax.pvary`` (0.5/0.6 era) and then
+  ``lax.pcast(..., to="varying")`` (0.9+, which auto-psums cotangents
+  of unvaried inputs — the marker is what keeps gradients LOCAL so the
+  step's one explicit ``pmean`` stays the only all-reduce). Pre-pvary
+  shard_map has no varying-type tracking at all, so cotangents come
+  back local already and the correct marker is the identity.
+
+Product code must not pin any one spelling — these helpers resolve the
+best available implementation at call time (cheap getattr probes, no
+import-time jax dependency), so the same file runs on the 0.4.37
+container, the 0.9 dev box, and whatever ships next.
+"""
+
+from __future__ import annotations
+
+
+def resolve_shard_map():
+    """The best available ``shard_map`` callable: ``jax.shard_map``
+    when it exists, else ``jax.experimental.shard_map.shard_map``.
+    Raises ``NotImplementedError`` only if neither exists (pre-0.4.3
+    jax, below this repo's floor)."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    try:
+        from jax.experimental.shard_map import shard_map as sm
+    except ImportError as e:                      # pragma: no cover
+        raise NotImplementedError(
+            f"this jax ({jax.__version__}) has neither jax.shard_map "
+            "nor jax.experimental.shard_map — too old for the SPMD "
+            "plane") from e
+    return sm
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map``-or-``jax.experimental.shard_map`` (resolved per
+    call — cheap, and keeps this module import-safe without jax).
+    Callers pass ``mesh``/``in_specs``/``out_specs`` as keywords, the
+    signature both generations share."""
+    return resolve_shard_map()(f, **kwargs)
+
+
+def device_varying_marker(axis_name: str):
+    """A function marking an array device-varying over ``axis_name``
+    inside a ``shard_map`` body — the knob that keeps cotangents of
+    replicated inputs LOCAL (per-shard) instead of auto-psummed:
+
+    * jax >= 0.9: ``lax.pcast(x, axis, to="varying")``;
+    * pvary-era jax: ``lax.pvary(x, axis)``;
+    * pre-pvary jax (e.g. 0.4.37): identity — old shard_map has no
+      varying-type system, cotangents are already local.
+    """
+    from jax import lax
+
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return lambda x: pcast(x, axis_name, to="varying")
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None:
+        return lambda x: pvary(x, axis_name)
+    return lambda x: x
