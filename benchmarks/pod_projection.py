@@ -69,9 +69,11 @@ LM371_PARAMS = 371_000_000
 _CHILD = r"""
 import json, re, sys
 import jax, jax.numpy as jnp, numpy as np
+
 from jax.sharding import Mesh, PartitionSpec as P
 
 sys.path.insert(0, {repo!r})
+from bigdl_tpu.utils.compat import shard_map
 from bigdl_tpu.optim.train_step import cast_floats
 from bigdl_tpu.optim.optim_method import SGD
 from bigdl_tpu.utils.random_gen import RNG
@@ -162,7 +164,7 @@ def build(model_kind, compress):
         return new_p, new_o, jax.lax.pmean(loss, "data")
 
     rep, sh = P(), P("data")
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         spmd, mesh=mesh,
         in_specs=(rep, rep, rep, rep, sh, sh),
         out_specs=(rep, rep, rep)))

@@ -382,6 +382,90 @@ def _resolve_decode_views(model: Sequential, off: int, Pt):
             Pt[model._child_key(len(mods) - 1 - off)])
 
 
+def _tree_has_key(tree, key: str) -> bool:
+    """True if any nested dict in ``tree`` carries ``key`` (used to
+    refuse quantized weight layouts on paths that cannot shard them)."""
+    if isinstance(tree, dict):
+        return key in tree or any(_tree_has_key(v, key)
+                                  for v in tree.values())
+    return False
+
+
+def tp_param_specs(model: Sequential, model_axis: str = "model"):
+    """``PartitionSpec`` tree mirroring ``model.params`` for the
+    Megatron layout the serving steps shard over ``model_axis``:
+    attention QKV + MLP fc1 column-parallel (output rows — head-major
+    for QKV, so ``n_heads % tp == 0`` splits whole heads), attention
+    output + MLP fc2 row-parallel (input columns, bias replicated and
+    added once post-psum), everything else (embeddings, LayerNorms, LM
+    head) replicated. Feed it to ``shard_map`` ``in_specs`` or
+    ``jax.device_put`` — shard_map hands each chip exactly the slice
+    :mod:`bigdl_tpu.parallel.tensor_parallel` expects."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    model._ensure_params()
+    if _tree_has_key(model.params, "weight_q"):
+        raise NotImplementedError(
+            "tensor-parallel serving does not shard quantized "
+            "(weight_q/w_scale) layouts yet — serve the float model or "
+            "drop the model-axis sharding")
+    specs = jax.tree_util.tree_map(lambda _: P(), model.params)
+    for i, m in enumerate(model.modules):
+        inner, bp = m, specs[model._child_key(i)]
+        if isinstance(inner, Remat):
+            inner, bp = inner.modules[0], bp[inner._child_key(0)]
+        if isinstance(inner, ScanBlocks):
+            raise NotImplementedError(
+                "tensor-parallel serving over layer_scan stacks is not "
+                "wired up (stacked leaves need a leading layer dim in "
+                "every spec) — build the model with layer_scan=False")
+        if not isinstance(inner, TransformerBlock):
+            continue
+        def put(p, weight_spec, bias_spec):
+            # spec trees must mirror the params STRUCTURE exactly — a
+            # bias spec for a bias-free Linear would desync shard_map's
+            # in_specs tree
+            p["weight"] = weight_spec
+            if "bias" in p:
+                p["bias"] = bias_spec
+        ap = bp[inner._child_key(1)]
+        for wname in ("wq", "wk", "wv"):
+            put(ap[wname], P(model_axis, None), P(model_axis))
+        put(ap["wo"], P(None, model_axis), P())
+        put(bp[inner._child_key(3)], P(model_axis, None), P(model_axis))
+        put(bp[inner._child_key(4)], P(None, model_axis), P())
+    return specs
+
+
+def serving_carry_specs(model: Sequential, sampling: bool = False,
+                        data_axis: str = "data",
+                        model_axis: Optional[str] = None):
+    """``PartitionSpec`` tree for a :func:`make_batch_decode_step` carry:
+    every leaf's slot axis over ``data_axis``, and (when ``model_axis``
+    is given) the per-layer K/V head axis over ``model_axis``. Specs
+    deliberately carry NO trailing ``None`` dims — ``P("data")`` and
+    ``P("data", None, ...)`` hash differently on some jax generations,
+    and mixing the two spellings between placement and step output would
+    double-compile the one serving program."""
+    from jax.sharding import PartitionSpec as P
+
+    model._ensure_params()
+    off = _decode_head_offset(model)
+    _, _, blocks, _, _ = _resolve_decode_views(model, off, model.params)
+    specs = {"pos": P(data_axis)}
+    kv = P(data_axis) if model_axis is None \
+        else P(data_axis, None, model_axis)
+    for i in range(len(blocks)):
+        specs[f"k{i}"] = kv
+        specs[f"v{i}"] = kv
+    if sampling:
+        specs["rng"] = P(data_axis)
+        specs["tok_counts"] = P(data_axis)
+        specs["prompt_mask"] = P(data_axis)
+    return specs
+
+
 def _serving_proj(p, x):
     """Linear projection for the serving steps: plain {weight,bias}
     params or a QuantizedLinear weight-only layout (int8 weights convert
@@ -523,7 +607,10 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
     return prefill_checked
 
 
-def make_batch_prefill_step(model: Sequential, compute_dtype=None):
+def make_batch_prefill_step(model: Sequential, compute_dtype=None,
+                            mesh=None, data_axis: str = "data",
+                            model_axis: str = "model",
+                            carry_sampling: bool = False):
     """MASKED multi-row prompt ingestion: one compiled program prefills a
     whole RAGGED batch of prompts (the admission path of
     ``bigdl_tpu.serving`` — see ``serving/admission.py``). Returns
@@ -567,7 +654,15 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None):
     accumulation, ``compute_dtype`` cache, int8 weight-only
     projections); per-row results equal :func:`make_prefill_step` to
     float round-off — the wider masked reduction can reorder XLA sums —
-    pinned by tests/test_serving_admission.py."""
+    pinned by tests/test_serving_admission.py.
+
+    ``mesh`` lowers the program through ``utils.compat.shard_map`` with
+    the same Megatron layout as :func:`make_batch_decode_step`: heads +
+    MLP hidden shard over ``model_axis`` (two psums per block), while
+    tokens/lengths/carry rows stay REPLICATED over ``data_axis`` —
+    prefill rows are few and short-lived, so sharding them would buy
+    little and break the B=1 prefix-cache path. The returned carry's
+    K/V are head-sharded, matching the sharded pool's decode layout."""
     import jax
     import jax.numpy as jnp
 
@@ -585,6 +680,10 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None):
     scale = hd ** -0.5
     cache_dtype = compute_dtype or jnp.float32
     _proj = _serving_proj
+    tp = 1 if mesh is None else int(mesh.shape[model_axis])
+    if mesh is not None:
+        _check_tp_divisibility(model, heads, tp)
+    heads_l = heads // tp
 
     def prefill(params, tokens, lengths, carry):
         Pt = _cast_keep_scales(params, compute_dtype)
@@ -606,9 +705,9 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None):
         for i, (blk, bp) in enumerate(blocks):
             h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
             ap = bp[blk._child_key(1)]
-            q = _proj(ap["wq"], h).reshape(B, L, heads, hd)
-            k = _proj(ap["wk"], h).reshape(B, L, heads, hd)
-            v = _proj(ap["wv"], h).reshape(B, L, heads, hd)
+            q = _proj(ap["wq"], h).reshape(B, L, heads_l, hd)
+            k = _proj(ap["wk"], h).reshape(B, L, heads_l, hd)
+            v = _proj(ap["wv"], h).reshape(B, L, heads_l, hd)
             kc = new_carry[f"k{i}"].at[rows[:, None], widx].set(
                 k.astype(cache_dtype), mode="drop")
             vc = new_carry[f"v{i}"].at[rows[:, None], widx].set(
@@ -626,11 +725,17 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None):
             p = jax.nn.softmax(s, axis=-1)
             ctx = jnp.einsum("bhlm,bmhd->blhd", p.astype(cache_dtype), vc,
                              preferred_element_type=jnp.float32
-                             ).astype(x.dtype).reshape(B, L, heads * hd)
-            x = x + _proj(ap["wo"], ctx)
+                             ).astype(x.dtype).reshape(B, L, heads_l * hd)
+            if mesh is None:
+                x = x + _proj(ap["wo"], ctx)
+            else:
+                x = x + _tp_row_proj(ap["wo"], ctx, model_axis)
             h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x)
-            mlp = _proj(bp[blk._child_key(4)], jax.nn.gelu(
-                _proj(bp[blk._child_key(3)], h2)))
+            hmid = jax.nn.gelu(_proj(bp[blk._child_key(3)], h2))
+            if mesh is None:
+                mlp = _proj(bp[blk._child_key(4)], hmid)
+            else:
+                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis)
             x = x + mlp
         # each row's next-token logits come from its LAST VALID position
         last = jnp.clip(lengths - 1, 0, L - 1)
@@ -640,7 +745,29 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None):
         return jax.nn.log_softmax(logits.astype(jnp.float32),
                                   axis=-1), new_carry
 
-    jitted = jax.jit(prefill)
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.utils.compat import shard_map as _shard_map
+
+        kv = P(None, None, model_axis)
+        cspecs = {"pos": P()}
+        for i in range(len(blocks0)):
+            cspecs[f"k{i}"] = kv
+            cspecs[f"v{i}"] = kv
+        if carry_sampling:
+            # a sampling-enabled pool's zero carry rides through prefill
+            # untouched — but shard_map's spec tree must still name
+            # every leaf (replicated: prefill never reads them)
+            cspecs["rng"] = P()
+            cspecs["tok_counts"] = P()
+            cspecs["prompt_mask"] = P()
+        jitted = jax.jit(_shard_map(
+            prefill, mesh=mesh,
+            in_specs=(tp_param_specs(model, model_axis), P(), P(), cspecs),
+            out_specs=(P(), cspecs), check_vma=False))
+    else:
+        jitted = jax.jit(prefill)
 
     def prefill_checked(params, tokens, lengths, carry):
         import numpy as np
@@ -806,8 +933,52 @@ def make_decode_step(model: Sequential, compute_dtype=None):
     return jax.jit(step), init_carry
 
 
+def _tp_row_proj(p, x, axis_name: str):
+    """Row-parallel serving projection: this chip's partial product is
+    completed by the block's one closing psum; the bias (replicated)
+    is added once, post-psum (``parallel.tensor_parallel``'s layout).
+    Partials and the psum accumulate fp32 and round to the serving
+    dtype ONCE — matching the unsharded matmul's single rounding, so
+    bf16 TP serving stays token-aligned with the single-device engine
+    instead of drifting an ulp per psum addend."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.parallel.tensor_parallel import row_parallel_linear
+
+    return row_parallel_linear(x, p["weight"], p.get("bias"), axis_name,
+                               accum_dtype=jnp.float32)
+
+
+def _check_tp_divisibility(model: Sequential, heads: int, tp: int) -> None:
+    """Fail fast (with the fix in the message) when a model cannot split
+    over a ``tp``-way model axis: whole heads and whole MLP hidden rows
+    must land on each chip."""
+    if tp <= 0:
+        raise ValueError(f"model-axis size must be positive, got {tp}")
+    hidden = model.modules[1].hidden_size
+    mlp_hidden = None
+    for m in model.modules:
+        inner = m.modules[0] if isinstance(m, Remat) else m
+        if isinstance(inner, TransformerBlock):
+            mlp_hidden = inner.fc1.output_size
+            break
+    if heads % tp:
+        raise ValueError(
+            f"n_heads {heads} not divisible by the model-axis size {tp} "
+            "— tensor-parallel serving shards whole heads")
+    if mlp_hidden is not None and mlp_hidden % tp:
+        raise ValueError(
+            f"MLP hidden {mlp_hidden} not divisible by the model-axis "
+            f"size {tp}")
+    if hidden % tp:
+        raise ValueError(
+            f"hidden {hidden} not divisible by the model-axis size {tp}")
+
+
 def make_batch_decode_step(model: Sequential, compute_dtype=None,
-                           sampling: bool = False):
+                           sampling: bool = False, mesh=None,
+                           data_axis: str = "data",
+                           model_axis: str = "model"):
     """Per-ROW-position decode step for continuous batching
     (``bigdl_tpu.serving``): every cache row advances independently, so
     one pooled carry can hold many requests at different depths and rows
@@ -862,6 +1033,24 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     The caller owns slot assignment and must keep ``pos[r] < max_len``
     for active rows (writes clamp to the last cache index rather than
     silently wrapping).
+
+    ``mesh`` (a ``jax.sharding.Mesh`` with ``data_axis`` and
+    ``model_axis``) lowers the step through ``utils.compat.shard_map``
+    instead of a bare jit — the tensor-parallel serving plane
+    (``bigdl_tpu.serving.sharded``): slot rows shard over ``data_axis``,
+    attention heads + MLP hidden shard over ``model_axis`` with the
+    Megatron two-collectives-per-block layout (one psum closing the
+    attention output projection, one closing the MLP — the column-
+    parallel QKV/fc1 halves communicate nothing; see
+    ``parallel/tensor_parallel.py``). Callers place params with
+    :func:`tp_param_specs` and the carry with
+    :func:`serving_carry_specs`; requires ``n_heads`` and
+    ``mlp_ratio*hidden`` divisible by the model-axis size, float (non-
+    quantized) weights, and no layer_scan. Per-row math is unchanged —
+    only the two closing psums reorder float sums, so outputs match the
+    unsharded step to round-off (slot-data-parallel-only meshes skip
+    shard_map entirely and stay bitwise identical; pinned by
+    tests/test_serving_sharded.py).
     """
     import jax
     import jax.numpy as jnp
@@ -880,6 +1069,12 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     heads, hd = attn0.n_heads, attn0.head_dim
     scale = hd ** -0.5
     cache_dtype = compute_dtype or jnp.float32
+    tp = 1 if mesh is None else int(mesh.shape[model_axis])
+    if mesh is not None:
+        _check_tp_divisibility(model, heads, tp)
+    # per-device head count: under shard_map each chip sees its own
+    # head slice of the (already column-parallel) QKV projections
+    heads_l = heads // tp
 
     def init_carry(n_slots: int):
         carry = {"pos": jnp.zeros((n_slots,), jnp.int32)}
@@ -914,9 +1109,12 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
             h, _ = blk.ln1.apply(bp[blk._child_key(0)], x[:, None])
             h = h[:, 0]
             ap = bp[blk._child_key(1)]
-            q = _proj(ap["wq"], h).reshape(n, heads, hd)
-            k_new = _proj(ap["wk"], h).reshape(n, heads, hd)
-            v_new = _proj(ap["wv"], h).reshape(n, heads, hd)
+            # under a mesh these params are per-chip column-parallel
+            # slices (head-major rows), so the same _proj IS the
+            # column-parallel half — zero communication
+            q = _proj(ap["wq"], h).reshape(n, heads_l, hd)
+            k_new = _proj(ap["wk"], h).reshape(n, heads_l, hd)
+            v_new = _proj(ap["wv"], h).reshape(n, heads_l, hd)
             # masked per-row scatter: inactive rows write their OLD value
             # back, so their cache stays bitwise identical
             kc_prev, vc_prev = new_carry[f"k{i}"], new_carry[f"v{i}"]
@@ -938,12 +1136,20 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
             p = jax.nn.softmax(s, axis=-1)
             ctx = jnp.einsum("nhl,nlhd->nhd", p.astype(cache_dtype), vc,
                              preferred_element_type=jnp.float32
-                             ).astype(x.dtype).reshape(n, heads * hd)
-            x = x + _proj(ap["wo"], ctx)
+                             ).astype(x.dtype).reshape(n, heads_l * hd)
+            if mesh is None:
+                x = x + _proj(ap["wo"], ctx)
+            else:
+                # row-parallel output projection — collective 1 of 2
+                x = x + _tp_row_proj(ap["wo"], ctx, model_axis)
             h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x[:, None])
             h2 = h2[:, 0]
-            mlp = _proj(bp[blk._child_key(4)],
-                        jax.nn.gelu(_proj(bp[blk._child_key(3)], h2)))
+            hmid = jax.nn.gelu(_proj(bp[blk._child_key(3)], h2))
+            if mesh is None:
+                mlp = _proj(bp[blk._child_key(4)], hmid)
+            else:
+                # row-parallel MLP projection — collective 2 of 2
+                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis)
             x = x + mlp
         xf, _ = lnf.apply(lnf_p, x[:, None])
         logits = _proj(lin_p, xf[:, 0])
@@ -977,8 +1183,33 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     # complete second copy of the whole KV pool per generated token
     # (~300 MB/step at 137M/8 slots). Callers must not touch the input
     # carry after a step — read it (np.asarray) before stepping.
-    jitted = jax.jit(sample_step if sampling else step,
-                     donate_argnums=(3,))
+    fn = sample_step if sampling else step
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.serving.sampling import knob_partition_specs
+        from bigdl_tpu.utils.compat import shard_map as _shard_map
+
+        pspecs = tp_param_specs(model, model_axis)
+        cspecs = serving_carry_specs(model, sampling=sampling,
+                                     data_axis=data_axis,
+                                     model_axis=model_axis)
+        row = P(data_axis)
+        if sampling:
+            in_specs = (pspecs, row, row, cspecs,
+                        knob_partition_specs(data_axis))
+            out_specs = (row, row, cspecs)
+        else:
+            in_specs = (pspecs, row, row, cspecs)
+            out_specs = (row, cspecs)
+        # check_vma/check_rep off: sampled tokens and non-head state are
+        # REPLICATED over the model axis (every model chip computes the
+        # identical post-psum value deterministically), which the static
+        # replication checker cannot prove through the sampler's vmapped
+        # random.split
+        fn = _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=False)
+    jitted = jax.jit(fn, donate_argnums=(3,))
     return jitted, init_carry
 
 
@@ -990,8 +1221,13 @@ import weakref as _weakref
 _SERVING_STEPS: dict = {}          # id(model) -> {(kind, dtype): step}
 
 
-def _step_cache(model: Sequential, kind: str, compute_dtype, builder):
-    """Per-(model, kind, compute_dtype) cache of built serving steps.
+def _step_cache(model: Sequential, kind: str, compute_dtype, builder,
+                extra=None):
+    """Per-(model, kind, compute_dtype[, extra]) cache of built serving
+    steps. ``extra`` extends the key for mesh-lowered variants (a
+    ``jax.sharding.Mesh`` hashes by device assignment + axis names, so
+    two engines over the same mesh share one compiled program while
+    different mesh shapes stay distinct).
 
     Keyed by ``id(model)`` with a ``weakref.finalize`` that drops the
     entry when the model is collected (a dropped model frees its
@@ -1012,7 +1248,8 @@ def _step_cache(model: Sequential, kind: str, compute_dtype, builder):
         # pops the entry at gc, so a recycled id() starts fresh
         _weakref.finalize(model, _SERVING_STEPS.pop, mid, None)
     key = (kind,
-           None if compute_dtype is None else np.dtype(compute_dtype).name)
+           None if compute_dtype is None else np.dtype(compute_dtype).name,
+           extra)
     if key not in per_model:
         per_model[key] = builder()
     return per_model[key]
@@ -1033,21 +1270,40 @@ def get_prefill_step(model: Sequential, compute_dtype=None):
 
 
 def get_batch_decode_step(model: Sequential, compute_dtype=None,
-                          sampling: bool = False):
+                          sampling: bool = False, mesh=None,
+                          data_axis: str = "data",
+                          model_axis: str = "model"):
     """Cached :func:`make_batch_decode_step` (the serving engine's step).
     ``sampling=True`` selects the sampled-epilogue variant (its own
-    cache entry — the two steps have different signatures/carries)."""
+    cache entry — the two steps have different signatures/carries);
+    ``mesh`` selects the shard_map-lowered tensor-parallel variant
+    (cached per mesh — see :func:`make_batch_decode_step`)."""
     kind = "batch_decode_sample" if sampling else "batch_decode"
+    extra = None if mesh is None else (mesh, data_axis, model_axis)
     return _step_cache(model, kind, compute_dtype,
-                       lambda: make_batch_decode_step(model, compute_dtype,
-                                                      sampling=sampling))
+                       lambda: make_batch_decode_step(
+                           model, compute_dtype, sampling=sampling,
+                           mesh=mesh, data_axis=data_axis,
+                           model_axis=model_axis),
+                       extra=extra)
 
 
-def get_batch_prefill_step(model: Sequential, compute_dtype=None):
+def get_batch_prefill_step(model: Sequential, compute_dtype=None,
+                           mesh=None, data_axis: str = "data",
+                           model_axis: str = "model",
+                           carry_sampling: bool = False):
     """Cached :func:`make_batch_prefill_step` (the batched-admission
-    prefill; one wrapper whose jit re-traces per (B, L) bucket)."""
+    prefill; one wrapper whose jit re-traces per (B, L) bucket).
+    ``mesh``/``carry_sampling`` select the shard_map-lowered tensor-
+    parallel variant (cached per mesh + carry layout)."""
+    extra = None if mesh is None else (mesh, data_axis, model_axis,
+                                       carry_sampling)
     return _step_cache(model, "batch_prefill", compute_dtype,
-                       lambda: make_batch_prefill_step(model, compute_dtype))
+                       lambda: make_batch_prefill_step(
+                           model, compute_dtype, mesh=mesh,
+                           data_axis=data_axis, model_axis=model_axis,
+                           carry_sampling=carry_sampling),
+                       extra=extra)
 
 
 def beam_generate(model: Sequential, prompt_ids, beam_size: int = 4,
@@ -1168,10 +1424,13 @@ def generate(model: Sequential, prompt_ids, length: int = 32,
 
     tok = jnp.asarray([prompt[-1] - 1], jnp.int32)
     out, lps = [], []
+    # min-tokens ban rides as a runtime VALUE (no retrace); with no ban
+    # configured it is the constant False — upload it once, not per token
+    knobs["ban"] = jnp.asarray([False])
     for i in range(length):
         logp, carry = step(P, tok, carry)
-        # min-tokens ban rides as a runtime VALUE (no retrace)
-        knobs["ban"] = jnp.asarray([ban_base and i < sp.min_tokens])
+        if ban_base:
+            knobs["ban"] = jnp.asarray([i < sp.min_tokens])
         tok, chosen, keys, counts = sampler(logp, keys, knobs, counts,
                                             pmask)
         t1 = int(tok[0]) + 1                 # back to 1-based ids

@@ -283,9 +283,11 @@ def _out_struct(shape, dtype, *refs):
     """ShapeDtypeStruct carrying the UNION of the operands' varying-manual-
     axes sets, so pallas_call type-checks inside shard_map (check_vma) even
     when operands vary over different axes."""
+    from bigdl_tpu.utils.compat import varying_axes
+
     vma = frozenset()
     for ref in refs:
-        vma = vma | (getattr(jax.typeof(ref), "vma", None) or frozenset())
+        vma = vma | varying_axes(ref)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -106,7 +106,9 @@ def _ring_flash_impl(q, k, v, axis_name: str, scale: float, spec):
 
     from bigdl_tpu.ops.flash_attention import flash_attention_with_lse
 
-    n = lax.axis_size(axis_name)
+    from bigdl_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -157,7 +159,9 @@ def _ring_flash_bwd_impl(q, k, v, o, lse, do, axis_name: str, scale: float,
 
     from bigdl_tpu.ops.flash_attention import flash_attention_block_grads
 
-    n = lax.axis_size(axis_name)
+    from bigdl_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -279,7 +283,9 @@ def _ring_einsum(q, k, v, axis_name: str, causal: bool = False,
     from jax import lax
 
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    n = lax.axis_size(axis_name)
+    from bigdl_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     q_off = my * T
@@ -334,7 +340,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     flash kernel (O(T) memory over the FULL gathered sequence)."""
     from jax import lax
 
-    n = lax.axis_size(axis_name)
+    from bigdl_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(f"heads {q.shape[2]} not divisible by axis size {n}")
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])

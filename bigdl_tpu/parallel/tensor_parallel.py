@@ -25,6 +25,17 @@ TPU interconnect:
 All functions are pure and run inside a ``shard_map`` over the TP mesh axis;
 ``split_*`` helpers produce the host-side sharded views for ``in_specs``.
 Tested on the 8-virtual-device CPU mesh (SURVEY.md §4 pattern).
+
+The SERVING plane consumes these primitives too: the KV-cached
+decode/prefill steps (``models/transformer.py``, ``mesh=`` on
+``make_batch_decode_step``/``make_batch_prefill_step``) thread
+:func:`row_parallel_linear` through the attention-output and fc2
+projections under ``utils.compat.shard_map`` — column-parallel QKV/fc1
+arrive pre-sliced via ``tp_param_specs``'s in_specs, so each block costs
+exactly the two closing psums, with the per-layer K/V cache sharded on
+its head axis (``bigdl_tpu.serving.sharded``). Use ``compat.shard_map``
+(not ``jax.shard_map``) around these functions when the code must run on
+jax 0.4.x as well.
 """
 
 from __future__ import annotations
@@ -55,17 +66,33 @@ def column_parallel_linear(x, w_shard, b_shard=None, axis_name: str = "model",
     return y
 
 
-def row_parallel_linear(x_shard, w_shard, b=None, axis_name: str = "model"):
+def row_parallel_linear(x_shard, w_shard, b=None, axis_name: str = "model",
+                        accum_dtype=None):
     """y = psum_over_axis(x_shard @ w_shard.T) (+ b).
 
     ``x_shard``: feature-sharded activations ``(..., in/n)``; ``w_shard``:
     this chip's input-column slice ``(out, in/n)``. The single ``psum`` is
     the block's only collective; the bias is added once (post-psum).
+
+    ``accum_dtype`` (e.g. ``jnp.float32``) carries each chip's partial
+    product AND the psum in that dtype, rounding to ``x_shard.dtype``
+    once after the reduction — without it, low-precision activations
+    (bf16 serving) round per chip and again per psum addend, so the
+    sharded result drifts a full low-precision ulp from the unsharded
+    matmul (enough to flip a greedy argmax on near-tied logits; the
+    serving plane's TP steps pass fp32 here for exactly that reason).
     """
     import jax.lax as lax
     import jax.numpy as jnp
 
-    y = lax.psum(jnp.matmul(x_shard, w_shard.T), axis_name)
+    if accum_dtype is not None:
+        acc = lax.dot_general(
+            x_shard, w_shard,
+            (((x_shard.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=accum_dtype)
+        y = lax.psum(acc, axis_name).astype(x_shard.dtype)
+    else:
+        y = lax.psum(jnp.matmul(x_shard, w_shard.T), axis_name)
     if b is not None:
         y = y + b
     return y

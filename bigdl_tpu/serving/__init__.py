@@ -33,7 +33,11 @@ from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.sampling import SamplingParams
 from bigdl_tpu.serving.scheduler import Request, Scheduler
+from bigdl_tpu.serving.sharded import (
+    ShardedEngine, ShardedKVPool, emulate_cpu_devices, make_mesh,
+)
 
 __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "Scheduler", "AdmissionController", "PrefixCache",
-           "SamplingParams", "bucket_len"]
+           "SamplingParams", "bucket_len", "ShardedEngine",
+           "ShardedKVPool", "make_mesh", "emulate_cpu_devices"]

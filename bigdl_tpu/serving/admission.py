@@ -40,6 +40,14 @@ shape-stable pipeline:
 The zero input carries (one per row bucket) are built once and reused
 for every admission — jax arrays are immutable, so sharing them is free
 (the same trick as the engine's old ``_zero_carry1``, per shape).
+
+On a SHARDED engine (``serving/sharded.py``) this controller runs
+unchanged: ``pool.alloc()`` is the balanced cross-shard allocator, and
+every ``write_prefill(..., row=j)`` routes the prefilled row to the
+slot's OWNING shard through the pool's mesh-pinned scatter
+(slot → (shard, row) is ``pool.slot_shard``) — admission never needs to
+know the mesh exists, which is what keeps the bucketed prefill programs
+reusable across mesh shapes.
 """
 
 from __future__ import annotations

@@ -98,7 +98,15 @@ class ServingEngine:
     ``seed`` is the engine's base RNG seed: requests whose
     ``SamplingParams.seed`` is None draw from a lane folded from this
     base and their request id (fresh per request); an explicit
-    per-request seed pins the lane regardless of the engine seed.
+    per-request seed pins the lane regardless of the engine seed;
+    ``mesh``/``parallelism`` swap in the SHARDED serving plane
+    (``serving/sharded.py``): pass a ``jax.sharding.Mesh`` with
+    ``data``/``model`` axes, or a ``{"data": N, "model": M}`` dict to
+    build one from the host's devices. Slot rows shard over ``data``
+    (token-identical to the unsharded engine — same per-row math, SPMD-
+    partitioned), attention heads + MLP hidden over ``model``
+    (Megatron two-psums-per-block under ``compat.shard_map``; equal to
+    round-off). Still ONE compiled decode program per engine.
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
@@ -107,7 +115,8 @@ class ServingEngine:
                  admission: str = "batched",
                  prefix_cache=None,
                  keep_finished: Optional[int] = None,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 mesh=None, parallelism=None) -> None:
         import jax
 
         from bigdl_tpu.models.transformer import (
@@ -128,19 +137,43 @@ class ServingEngine:
         self.model = model
         self.max_len = model.modules[1].max_len
         self.compute_dtype = compute_dtype
+        # the sharded serving plane (serving/sharded.py): a mesh or a
+        # {"data": N, "model": M} parallelism dict swaps the pooled
+        # tensors onto a device mesh — slot rows shard over "data"
+        # (token-identical: pure SPMD partitioning of the same per-row
+        # math), weights/KV-heads over "model" (Megatron layout under
+        # compat.shard_map). None/None is the stock single-device plane.
+        if mesh is not None or parallelism is not None:
+            from bigdl_tpu.serving.sharded import ShardPlane
+
+            self._plane = ShardPlane(mesh=mesh, parallelism=parallelism)
+            self.mesh = self._plane.mesh
+        else:
+            self._plane = None
+            self.mesh = None
         # weights as resident device buffers in the serving dtype
-        # (runtime arguments — never baked into the compiled programs)
-        self.params = jax.device_put(serving_params(model, compute_dtype))
+        # (runtime arguments — never baked into the compiled programs);
+        # tensor-parallel planes pre-shard them over the model axis
+        sp = serving_params(model, compute_dtype)
+        self.params = (jax.device_put(sp) if self._plane is None
+                       else self._plane.place_params(model, sp))
         # the SAMPLED pooled step is the only decode program: greedy
         # requests are temperature=0 rows of the same compiled step, so
         # greedy-only and mixed traffic share one program (pinned by the
-        # compile-count guard in tests/test_serving_sampling.py)
+        # compile-count guards in tests/test_serving_sampling.py and
+        # tests/test_serving_sharded.py)
+        tp = self._plane is not None and self._plane.tensor_parallel
         self._step_fn, pool_init = get_batch_decode_step(
-            model, compute_dtype, sampling=True)
+            model, compute_dtype, sampling=True,
+            mesh=self.mesh if tp else None)
         self._pool_init = pool_init
-        self.pool = KVPool(pool_init, n_slots)
+        self.pool = (KVPool(pool_init, n_slots) if self._plane is None
+                     else self._plane.make_pool(model, pool_init, n_slots))
         self.scheduler = Scheduler(policy)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        if self._plane is not None:
+            self.metrics.set_mesh_shape(self._plane.data_shards,
+                                        self._plane.model_shards)
         self.admission = admission
         self.keep_finished = keep_finished
         self.seed = int(seed)
@@ -154,8 +187,13 @@ class ServingEngine:
         # the same device arrays instead of re-uploading every step
         self._knobs_device = None
         if admission == "batched":
-            self._batch_prefill_fn = get_batch_prefill_step(model,
-                                                            compute_dtype)
+            # the tensor-parallel prefill shares the mesh (and must name
+            # the sampling carry leaves in its shard_map specs); data-
+            # only planes keep the stock prefill — its output rows
+            # reshard into the sharded pool through the scatter
+            self._batch_prefill_fn = get_batch_prefill_step(
+                model, compute_dtype, mesh=self.mesh if tp else None,
+                carry_sampling=tp)
             # True -> default cache, False/None -> off, else an instance
             self.prefix_cache = (PrefixCache() if prefix_cache is True
                                  else (prefix_cache or None))
@@ -267,6 +305,13 @@ class ServingEngine:
         while len(self._finished) > self.keep_finished:
             self._finished.pop(next(iter(self._finished)))
 
+    def _place_rows(self, x):
+        """Commit a per-slot array to the plane's mesh (identity on the
+        single-device plane). Every slot-axis array the step consumes
+        goes through here so its sharding matches the pooled carry —
+        mismatched placements would recompile or silently gather."""
+        return x if self._plane is None else self._plane.place_rows(x)
+
     def _admit(self) -> None:
         import jax.numpy as jnp
 
@@ -277,6 +322,7 @@ class ServingEngine:
             # batched admission: bucketed multi-row masked prefill with
             # optional shared-prefix reuse (serving/admission.py)
             self.admitter.admit(n)
+            self._note_shard_balance()
             return
         for _ in range(n):
             slot = self.pool.alloc()
@@ -296,6 +342,15 @@ class ServingEngine:
             # the last prompt token is the first decode input — exactly
             # generate()'s convention, so outputs match token-for-token
             req.next_token = prompt0[-1]
+        self._note_shard_balance()
+
+    def _note_shard_balance(self) -> None:
+        """Post-admission shard-balance sample (sharded pools only):
+        per-shard occupancy extremes + the max−min admission imbalance
+        the balanced allocator is supposed to keep ≤ 1."""
+        if self.pool.n_shards > 1:
+            self.metrics.on_shard_slots(self.pool.used_per_shard(),
+                                        self.pool.rows_per_shard)
 
     def _lane_key(self, req: Request):
         """The request's RNG-lane key: an explicit ``SamplingParams.seed``
@@ -348,11 +403,12 @@ class ServingEngine:
             n_sampled += not req.sampling.is_greedy
         t0 = time.perf_counter()
         if self._knobs_device is None:
-            self._knobs_device = {k: jnp.asarray(v)
+            self._knobs_device = {k: self._place_rows(jnp.asarray(v))
                                   for k, v in self._knobs.items()}
         knobs = self._knobs_device
         tok, chosen, carry = self._step_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(active),
+            self.params, self._place_rows(jnp.asarray(tokens)),
+            self._place_rows(jnp.asarray(active)),
             self.pool.carry, knobs)
         self.pool.carry = carry
         # the (N, V) distribution never crosses to host — sampling is

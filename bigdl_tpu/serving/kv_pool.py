@@ -41,6 +41,11 @@ class KVPool:
         if n_slots <= 0:
             raise ValueError(f"n_slots must be positive, got {n_slots}")
         self.n_slots = int(n_slots)
+        # one logical shard; the mesh-aware subclass (serving.sharded.
+        # ShardedKVPool) overrides these with the slot-axis shard count
+        # and per-shard row block
+        self.n_shards = 1
+        self.rows_per_shard = self.n_slots
         self.carry = init_carry(self.n_slots)
         self.n_layers = sum(1 for k in self.carry if k.startswith("k"))
         self.max_len = int(self.carry["k0"].shape[1])
@@ -55,8 +60,15 @@ class KVPool:
         # scale); donation updates the pool buffers in place, and
         # copying the FULL max_len row (tail zeros included — masked by
         # pos anyway) keeps the program length-independent, so it
-        # compiles exactly once per pool.
-        self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0,))
+        # compiles exactly once per pool. (_make_scatter is the subclass
+        # hook: the sharded pool pins the output shardings so scattered
+        # carries keep their mesh placement.)
+        self._scatter = self._make_scatter()
+
+    def _make_scatter(self):
+        import jax
+
+        return jax.jit(self._scatter_impl, donate_argnums=(0,))
 
     def _scatter_impl(self, carry, prefill_carry, slot, pos, row):
         from jax import lax
@@ -105,7 +117,21 @@ class KVPool:
         return len(self._in_use)
 
     def occupancy(self) -> float:
-        return self.used_slots / self.n_slots
+        # guard n_slots == 0 rather than divide: the constructor forbids
+        # it today, but subclasses/metrics must never turn an empty pool
+        # into a ZeroDivisionError mid-serving
+        return self.used_slots / self.n_slots if self.n_slots else 0.0
+
+    def used_per_shard(self) -> List[int]:
+        """Allocated-slot count per shard (one logical shard here; the
+        mesh-aware subclass reports per-device counts — the imbalance
+        signal ServingMetrics surfaces)."""
+        return [self.used_slots]
+
+    def __repr__(self) -> str:
+        shards = "" if self.n_shards == 1 else f", n_shards={self.n_shards}"
+        return (f"{type(self).__name__}(n_slots={self.n_slots}, "
+                f"used={self.used_slots}, free={self.free_slots}{shards})")
 
     # -- prefill admission -------------------------------------------------
 

@@ -35,6 +35,16 @@ Sampling counters (``serving/sampling.py``):
   ``summary()`` derives ``sampled_row_frac``
 * ``mean_logprob``        — per-request mean chosen-token raw model
   log-prob (recorded at finish; a cheap generation-quality signal)
+
+Sharded-plane counters (``serving/sharded.py``):
+
+* ``mesh_data_shards`` / ``mesh_model_shards`` — the engine's mesh
+  shape (set once at construction; 1/1 for an unsharded engine)
+* ``shard_occupancy_min`` / ``shard_occupancy_max`` — per-shard slot
+  occupancy extremes, sampled every engine step
+* ``shard_imbalance`` — cross-shard admission imbalance in ROWS
+  (max − min allocated slots across shards; 0 = perfectly balanced —
+  the balanced allocator keeps it ≤ 1 under drain-style traffic)
 """
 
 from __future__ import annotations
@@ -87,6 +97,22 @@ class ServingMetrics:
 
     def on_cancel(self) -> None:
         self.metrics.add("serving/cancelled", 1.0)
+
+    def set_mesh_shape(self, data_shards: int, model_shards: int) -> None:
+        """Record the engine's mesh shape (once, at construction)."""
+        self.metrics.set("serving/mesh_data_shards", float(data_shards))
+        self.metrics.set("serving/mesh_model_shards", float(model_shards))
+
+    def on_shard_slots(self, used_per_shard, rows_per_shard: int) -> None:
+        """Per-shard occupancy + cross-shard admission imbalance
+        (max−min allocated rows), sampled per engine step on sharded
+        pools."""
+        if not used_per_shard or not rows_per_shard:
+            return
+        lo, hi = min(used_per_shard), max(used_per_shard)
+        self.metrics.add("serving/shard_occupancy_min", lo / rows_per_shard)
+        self.metrics.add("serving/shard_occupancy_max", hi / rows_per_shard)
+        self.metrics.add("serving/shard_imbalance", float(hi - lo))
 
     def on_prefill_batch(self, n_rows: int, n_padded: int) -> None:
         self.metrics.add("serving/prefill_batch", float(n_rows))

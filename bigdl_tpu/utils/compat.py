@@ -44,8 +44,56 @@ def shard_map(f, **kwargs):
     """``jax.shard_map``-or-``jax.experimental.shard_map`` (resolved per
     call — cheap, and keeps this module import-safe without jax).
     Callers pass ``mesh``/``in_specs``/``out_specs`` as keywords, the
-    signature both generations share."""
-    return resolve_shard_map()(f, **kwargs)
+    signature both generations share.
+
+    The replication-check toggle RENAMED between generations —
+    ``check_rep`` (0.4.x experimental) became ``check_vma`` (jax with
+    the varying-type system). Callers may pass either spelling; it is
+    forwarded under whichever name this jax accepts (and dropped if the
+    resolved shard_map has neither — the check simply stays at its
+    default there)."""
+    import inspect
+
+    sm = resolve_shard_map()
+    if "check_vma" in kwargs or "check_rep" in kwargs:
+        val = kwargs.pop("check_vma", None)
+        if "check_rep" in kwargs:
+            val = kwargs.pop("check_rep")
+        try:
+            accepted = inspect.signature(sm).parameters
+        except (TypeError, ValueError):     # pragma: no cover
+            accepted = {}
+        if "check_vma" in accepted:
+            kwargs["check_vma"] = val
+        elif "check_rep" in accepted:
+            kwargs["check_rep"] = val
+    return sm(f, **kwargs)
+
+
+def axis_size(axis_name: str):
+    """Static size of a mapped axis inside a ``shard_map``/``pmap`` body:
+    ``lax.axis_size`` where it exists, else ``lax.psum(1, axis)`` — the
+    pre-axis_size spelling (a static constant either way: the axis size
+    is known at trace time)."""
+    from jax import lax
+
+    sz = getattr(lax, "axis_size", None)
+    if sz is not None:
+        return sz(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def varying_axes(x):
+    """The varying-manual-axes (vma) set of ``x``'s type on jax
+    generations with the varying-type system (``jax.typeof`` + ``.vma``),
+    else an empty frozenset — pre-vma jax (e.g. 0.4.37) tracks no
+    replication types, so nothing varies as far as type checking goes."""
+    import jax
+
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", None) or frozenset()
 
 
 def device_varying_marker(axis_name: str):

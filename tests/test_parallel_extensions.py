@@ -1,10 +1,16 @@
 """Tensor/pipeline/expert parallelism vs single-device oracles, on the
 8-virtual-device CPU mesh (the distributed-in-one-process pattern of
-SURVEY.md §4)."""
+SURVEY.md §4).
+
+Uses ``utils.compat.shard_map`` (not ``jax.shard_map``) so the suite
+runs on every jax generation this repo supports — 0.4.x spells it
+``jax.experimental.shard_map`` and calls the replication check
+``check_rep``; the shim resolves both."""
 
 import numpy as np
 import pytest
 
+from bigdl_tpu.utils.compat import shard_map
 from tests.oracle import assert_close
 
 
@@ -32,7 +38,7 @@ def test_column_parallel_linear(rng):
     mesh = _mesh()
 
     # unsplit weights; in_specs P("model", None) shards the output rows
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x, ws, bs: column_parallel_linear(x, ws, bs, "model"),
         mesh=mesh, in_specs=(P(), P("model", None), P("model")),
         out_specs=P(None, "model"),
@@ -53,7 +59,7 @@ def test_row_parallel_linear(rng):
     b = rng.randn(OUT).astype(np.float32)
     mesh = _mesh()
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda xs, ws, b: row_parallel_linear(xs, ws, b, "model"),
         mesh=mesh,
         # x sharded on features; w sharded on input columns (dim 1)
@@ -78,7 +84,7 @@ def test_tp_mlp_matches_dense(rng):
     b2 = rng.randn(D).astype(np.float32)
     mesh = _mesh()
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x, w1, b1, w2, b2: tp_mlp(x, w1, b1, w2, b2, "model"),
         mesh=mesh,
         # w1 column-parallel (rows), w2 row-parallel (input columns)
@@ -106,7 +112,7 @@ def test_tp_attention_matches_dense(rng, causal):
     bo = rng.randn(D).astype(np.float32)
     mesh = _mesh()
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x, wq, wk, wv, wo, bo: tp_attention(
             x, wq, wk, wv, wo, "model", n_heads_local=1, causal=causal, bo=bo),
         mesh=mesh,
@@ -149,7 +155,7 @@ def test_gpipe_matches_sequential(rng):
     mesh = _mesh(name="pipe")
 
     stacked = stack_stage_params(stages)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda p, xm: gpipe(_stage_fn, p, xm, "pipe"),
         mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
     ))
@@ -177,7 +183,7 @@ def test_gpipe_gradients_match(rng):
     stacked = stack_stage_params(stages)
 
     def piped_loss(p, xm):
-        inner = jax.shard_map(
+        inner = shard_map(
             lambda p, xm: gpipe(_stage_fn, p, xm, "pipe"),
             mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
         )
@@ -220,7 +226,7 @@ def test_moe_matches_dense_oracle(rng, top_k):
     }
     mesh = _mesh(name="expert")
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda x, r, ep: moe_layer(x, r, ep, mlp_expert, "expert",
                                    top_k=top_k, capacity=T_loc),
         mesh=mesh,
@@ -275,7 +281,7 @@ def test_hybrid_dcn_ici_mesh_step():
         # gradient-style reduction over the data axes (dcn is one of them)
         return lax.pmean(lax.pmean(loss, "data"), "dcn")
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         spmd, mesh=mesh,
         in_specs=(P("model", None), P(("dcn", "data"), "model")),
         out_specs=P()))

@@ -3,6 +3,7 @@
 import numpy as np
 import pytest
 
+from bigdl_tpu.utils.compat import shard_map
 from tests.oracle import assert_close
 
 
@@ -102,7 +103,7 @@ def test_transformer_ring_sequence_parallel(rng):
     mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("seq",))
     # sequence-sharded ids; PositionEmbedding(sp_axis="seq") offsets by
     # axis_index so positions stay global, matching ring causal offsets
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda p, x: sp.apply(p, x, sp.state, training=False)[0],
         mesh=mesh, in_specs=(P(), P(None, "seq")), out_specs=P(None, "seq"),
     ))
