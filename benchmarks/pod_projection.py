@@ -141,10 +141,8 @@ def build(model_kind, compress):
     # jax auto-psums the replicated input's cotangent and the pmean
     # reduces AGAIN — 2x bytes; regression-tested in
     # test_distri_optimizer.test_allreduce_construction_single_collective)
-    from jax import lax
-    pcast = getattr(lax, "pcast", None)
-    mark = ((lambda t: pcast(t, "data", to="varying"))
-            if pcast is not None else (lambda t: lax.pvary(t, "data")))
+    from bigdl_tpu.utils.compat import device_varying_marker
+    mark = device_varying_marker("data")
 
     def spmd(params, opt_state, ms, rng, xs, ys):
         params_v = jax.tree_util.tree_map(mark, params)

@@ -1,0 +1,44 @@
+"""SPMD hygiene analyzer — AST lint for recompilation, sharding-spec,
+and jax-compat drift.
+
+The serving/optim/parallel planes all rest on invariants XLA never
+checks: one compiled program per engine, one spelling per PartitionSpec
+axis, every version-moved jax API routed through ``utils/compat.py``.
+This package makes those invariants machine-checked — as a CLI
+(``python -m bigdl_tpu.analysis``) and as a tier-1 test
+(``tests/test_static_analysis.py``).  Pure stdlib ``ast``; never
+imports jax.  Rule catalog and war stories: ``docs/analysis.md``.
+"""
+
+from bigdl_tpu.analysis.core import (
+    DEFAULT_EXCLUDE_DIRS,
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    format_baseline_entry,
+    load_baseline,
+    rule_codes,
+    split_baselined,
+)
+# importing the rules module populates the registry
+from bigdl_tpu.analysis import rules as _rules  # noqa: F401
+from bigdl_tpu.analysis.cli import DEFAULT_PATHS, main
+
+__all__ = [
+    "DEFAULT_EXCLUDE_DIRS",
+    "DEFAULT_PATHS",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "format_baseline_entry",
+    "load_baseline",
+    "main",
+    "rule_codes",
+    "split_baselined",
+]

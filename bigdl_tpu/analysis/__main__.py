@@ -1,0 +1,7 @@
+"""``python -m bigdl_tpu.analysis`` entry point."""
+
+import sys
+
+from bigdl_tpu.analysis import main
+
+sys.exit(main())

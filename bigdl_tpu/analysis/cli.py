@@ -1,0 +1,131 @@
+"""Command-line front end: ``python -m bigdl_tpu.analysis``.
+
+Exit status is the contract CI rides on: 0 when every finding is
+baselined (or there are none), 1 when NEW findings exist, 2 on usage
+errors.  ``--json`` emits a machine-readable report so future tooling
+can diff findings across PRs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from bigdl_tpu.analysis.core import (
+    DEFAULT_EXCLUDE_DIRS, all_rules, analyze_paths,
+    format_baseline_entry, load_baseline, rule_codes, split_baselined,
+)
+
+#: what the pass covers when no paths are given — the three analyzed
+#: planes plus their tests/benchmarks, mirroring tests/test_static_analysis
+DEFAULT_PATHS = ["bigdl_tpu", "benchmarks", "tests"]
+DEFAULT_BASELINE = "analysis_baseline.txt"
+
+
+def _parse_codes(s: Optional[str]) -> Optional[List[str]]:
+    if not s:
+        return None
+    return [c.strip() for c in s.split(",") if c.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis",
+        description="SPMD hygiene analyzer: AST lint for recompilation, "
+                    "sharding-spec, and jax-compat drift.")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"files/directories to analyze "
+                        f"(default: {' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--select", metavar="CODES",
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--ignore", metavar="CODES",
+                   help="comma-separated rule codes to skip")
+    p.add_argument("--baseline", metavar="FILE", default=DEFAULT_BASELINE,
+                   help=f"baseline file of grandfathered findings "
+                        f"(default: {DEFAULT_BASELINE}; missing file = "
+                        f"empty baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="print ready-to-commit baseline entries for the "
+                        "current findings and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report (findings + summary) on stdout")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list rule codes and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-finding hints")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.code}  {r.name}: {r.summary}")
+        return 0
+
+    select = _parse_codes(args.select)
+    ignore = _parse_codes(args.ignore)
+    known = set(rule_codes())
+    for c in (select or []) + (ignore or []):
+        if c not in known:
+            print(f"error: unknown rule code {c!r} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
+    paths = args.paths or DEFAULT_PATHS
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        # a typo'd or wrong-cwd path silently scanning ZERO files would
+        # be a false green on the exact exit code CI rides on
+        print(f"error: path(s) do not exist: {', '.join(missing)} "
+              f"(cwd: {Path.cwd()})", file=sys.stderr)
+        return 2
+    findings = analyze_paths(paths, select=select, ignore=ignore,
+                             exclude_dirs=DEFAULT_EXCLUDE_DIRS)
+
+    if args.write_baseline:
+        print(f"# SPMD hygiene baseline — {len(findings)} grandfathered "
+              "finding(s).")
+        print("# Every entry MUST carry a justification comment; prefer "
+              "fixing over baselining.")
+        for f in findings:
+            print(format_baseline_entry(f))
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    new, grandfathered = split_baselined(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "paths": list(paths),
+            "rules": sorted(select or known),
+            "findings": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in grandfathered],
+            "summary": {
+                "new": len(new),
+                "baselined": len(grandfathered),
+                "total": len(findings),
+            },
+        }, indent=2))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.format(show_hint=not args.quiet))
+    if new:
+        counts: dict = {}
+        for f in new:
+            counts[f.code] = counts.get(f.code, 0) + 1
+        per_code = ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+        print(f"\n{len(new)} new finding(s) [{per_code}]"
+              + (f", {len(grandfathered)} baselined" if grandfathered
+                 else ""))
+        return 1
+    tail = f" ({len(grandfathered)} baselined)" if grandfathered else ""
+    print(f"clean: 0 new findings{tail}")
+    return 0

@@ -1,0 +1,343 @@
+"""Rule engine for the SPMD hygiene analyzer.
+
+Pure stdlib ``ast`` — importing this module (or running the CLI) never
+imports jax, so the pass costs milliseconds per file and runs anywhere,
+including boxes where the SPMD plane itself cannot even trace.
+
+The moving parts:
+
+* :class:`Finding` — one violation: ``path:line:col``, a stable rule
+  ``code``, a message, a fix ``hint``, and the stripped offending source
+  line (the line content, not the line *number*, feeds the baseline
+  fingerprint so baselines survive unrelated edits above the finding).
+* :class:`Rule` + :func:`register` — the rule registry.  Each rule walks
+  one parsed file (:class:`FileContext`) and yields findings.
+* :func:`analyze_paths` — walk files/dirs, parse once, run every
+  selected rule.
+* :func:`load_baseline` / :func:`format_baseline_entry` — grandfathered
+  findings.  An entry matches ``path : code : fingerprint`` so moving a
+  violating line does not un-baseline it, while *editing* it does.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+from pathlib import Path
+from typing import Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: directory basenames never walked into — fixture trees hold deliberate
+#: violations and must only be scanned when named explicitly as files
+DEFAULT_EXCLUDE_DIRS = frozenset(
+    {"__pycache__", ".git", "_build", ".cache", "analysis_fixtures"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str            # posix-style path as reported (relative when possible)
+    line: int            # 1-based
+    col: int             # 1-based (ast cols are 0-based; shifted for humans)
+    code: str            # e.g. "SPMD101"
+    message: str
+    hint: str = ""
+    source: str = ""     # stripped source line, for fingerprints + context
+    occurrence: int = 0  # nth finding with this (code, source) in the file
+
+    def fingerprint(self) -> str:
+        """Content hash of (code, offending line, occurrence index) —
+        line-number free so baselines survive edits elsewhere in the
+        file, occurrence-indexed so a baselined line PASTED a second
+        time is a NEW finding, not a silently grandfathered one."""
+        h = hashlib.sha1(
+            f"{self.code}:{self.source}:{self.occurrence}".encode(
+                "utf-8", "replace"))
+        return h.hexdigest()[:12]
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.path, self.code, self.fingerprint())
+
+    def format(self, show_hint: bool = True) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+        if show_hint and self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "col": self.col,
+            "code": self.code, "message": self.message, "hint": self.hint,
+            "source": self.source, "occurrence": self.occurrence,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+class FileContext:
+    """One parsed file handed to every rule: the tree, the raw lines,
+    and helpers for building findings and resolving imported names."""
+
+    def __init__(self, path: str, relpath: str, text: str,
+                 tree: ast.Module) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        self._parents: Optional[dict] = None
+        self._imports: Optional[dict] = None
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def is_compat(self) -> bool:
+        """True for ``bigdl_tpu/utils/compat.py`` itself — the one module
+        allowed to spell version-moved jax APIs directly."""
+        p = self.relpath.replace(os.sep, "/")
+        return p.endswith("bigdl_tpu/utils/compat.py") or \
+            p.endswith("utils/compat.py")
+
+    # -- finding construction ---------------------------------------------
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, node: ast.AST, code: str, message: str,
+                hint: str = "") -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        return Finding(path=self.relpath, line=line, col=col, code=code,
+                       message=message, hint=hint,
+                       source=self.source_line(line))
+
+    # -- structure helpers -------------------------------------------------
+
+    @property
+    def parents(self) -> dict:
+        """child-node -> parent-node map (built lazily, once per file)."""
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda, or None
+        at module level."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    # -- import resolution -------------------------------------------------
+
+    @property
+    def imports(self) -> dict:
+        """local alias -> fully qualified dotted name, from every
+        Import/ImportFrom in the file (any nesting level — the repo
+        imports jax inside functions deliberately)."""
+        if self._imports is not None:
+            return self._imports
+        amap: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        amap[a.asname] = a.name
+                    else:
+                        # `import jax.lax` binds `jax`; the chain resolves
+                        # attribute-by-attribute from the root
+                        amap[a.name.split(".")[0]] = a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    amap[a.asname or a.name] = f"{node.module}.{a.name}"
+        self._imports = amap
+        return amap
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain to a fully qualified dotted
+        name using the file's imports (``lax.pvary`` -> ``jax.lax.pvary``
+        under ``from jax import lax``).  None when the root is not an
+        imported name."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.imports.get(cur.id)
+        if root is None:
+            return None
+        return ".".join([root] + list(reversed(parts)))
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Unresolved dotted spelling of a Name/Attribute chain
+        (``self._scatter``), for matching local callables and reuse of
+        donated buffers.  None for anything else."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        return ".".join([cur.id] + list(reversed(parts)))
+
+
+class Rule:
+    """Base class: subclasses set ``code``/``name``/``hint`` and
+    implement :meth:`check`."""
+
+    code: str = ""
+    name: str = ""
+    #: one-line description for --list-rules / docs
+    summary: str = ""
+    hint: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: List[Rule] = []
+
+
+def register(cls):
+    """Class decorator adding a rule (instantiated once) to the registry."""
+    _REGISTRY.append(cls())
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return list(_REGISTRY)
+
+
+def rule_codes() -> List[str]:
+    return [r.code for r in _REGISTRY]
+
+
+# -- engine ----------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str],
+                   exclude_dirs: Iterable[str]) -> Iterator[Path]:
+    excl = set(exclude_dirs)
+    for p in paths:
+        path = Path(p)
+        if path.is_file():
+            # explicit file paths bypass directory exclusion — that is
+            # how the fixture tests point the engine at deliberate
+            # violations
+            if path.suffix == ".py":
+                yield path
+        elif path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                if not any(part in excl for part in f.parts):
+                    yield f
+
+
+def _relpath(p: Path) -> str:
+    try:
+        rel = p.resolve().relative_to(Path.cwd().resolve())
+        return rel.as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def analyze_source(text: str, path: str = "<string>",
+                   select: Optional[Iterable[str]] = None,
+                   ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the selected rules over one source string (test/fixture entry
+    point; :func:`analyze_paths` is the file-walking wrapper)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 1),
+                        code="SPMD000",
+                        message=f"file does not parse: {e.msg}",
+                        source=(e.text or "").strip())]
+    ctx = FileContext(path=path, relpath=path, text=text, tree=tree)
+    sel = set(select) if select else None
+    ign = set(ignore) if ignore else set()
+    out: List[Finding] = []
+    for rule in _REGISTRY:
+        if sel is not None and rule.code not in sel:
+            continue
+        if rule.code in ign:
+            continue
+        out.extend(rule.check(ctx))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    # occurrence-index repeated (code, source) pairs in source order so
+    # each duplicate line needs its own baseline entry
+    seen: dict = {}
+    for i, f in enumerate(out):
+        k = (f.code, f.source)
+        idx = seen.get(k, 0)
+        seen[k] = idx + 1
+        if idx:
+            out[i] = dataclasses.replace(f, occurrence=idx)
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Iterable[str]] = None,
+                  ignore: Optional[Iterable[str]] = None,
+                  exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+                  ) -> List[Finding]:
+    """Walk ``paths`` (files and/or directories) and run the rules."""
+    findings: List[Finding] = []
+    for f in _iter_py_files(paths, exclude_dirs):
+        text = f.read_text(encoding="utf-8", errors="replace")
+        findings.extend(analyze_source(text, path=_relpath(f),
+                                       select=select, ignore=ignore))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
+    return findings
+
+
+# -- baseline --------------------------------------------------------------
+
+def load_baseline(path: str) -> Set[Tuple[str, str, str]]:
+    """Parse a baseline file into ``{(path, code, fingerprint)}``.
+
+    Format: one entry per line, ``path:CODE:fingerprint``; blank lines
+    and ``#`` comments (the required justifications) are skipped."""
+    entries: Set[Tuple[str, str, str]] = set()
+    p = Path(path)
+    if not p.exists():
+        return entries
+    for raw in p.read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        # path may itself contain ':' on exotic systems — split from the
+        # right, the code and fingerprint never do
+        parts = line.rsplit(":", 2)
+        if len(parts) == 3:
+            entries.add((parts[0], parts[1], parts[2]))
+    return entries
+
+
+def split_baselined(findings: Sequence[Finding],
+                    baseline: Set[Tuple[str, str, str]],
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new, grandfathered)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        (old if f.baseline_key() in baseline else new).append(f)
+    return new, old
+
+
+def format_baseline_entry(f: Finding) -> str:
+    """One ready-to-commit baseline line (offending source as a trailing
+    comment so reviewers see what is being grandfathered)."""
+    path, code, fp = f.baseline_key()
+    return f"# line {f.line}: {f.source}\n{path}:{code}:{fp}"

@@ -1,0 +1,781 @@
+"""The six SPMD hygiene rules.
+
+Every rule here encodes a bug class this repo has actually shipped (see
+docs/analysis.md for the war stories):
+
+==========  ==============================================================
+SPMD101     compat drift — version-moved jax APIs spelled directly
+SPMD102     PartitionSpec spelling drift (the PR-4 double-compile)
+SPMD103     recompile hazards in/around jitted programs
+SPMD104     donated buffer reused after the donating call
+SPMD105     Python control flow on traced values
+SPMD106     shard_map specs naming axes the mesh does not have
+==========  ==============================================================
+
+All rules are import-resolution based, not textual: ``lax.pvary`` is
+flagged under ``from jax import lax`` and not when ``lax`` is someone's
+local variable, and docstrings/comments never trigger (the historical
+reason the repo could not just grep for these).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from bigdl_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# --------------------------------------------------------------------------
+# shared machinery
+# --------------------------------------------------------------------------
+
+#: wrappers whose function argument becomes a traced body
+_JIT_QUALNAMES = {"jax.jit", "jax.pmap"}
+_SHARD_MAP_QUALNAMES = {
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "bigdl_tpu.utils.compat.shard_map",
+    "bigdl_tpu.utils.compat.resolve_shard_map",
+}
+#: control-flow combinators: (qualname -> positions of traced callees)
+_COMBINATOR_FN_ARGS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": None,       # every arg from 1 on is a branch
+    "jax.lax.associative_scan": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+}
+
+#: attributes of a traced array that are static at trace time — branching
+#: or formatting on these is fine
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type", "itemsize", "nbytes"}
+#: calls whose result on a tracer is static / python-level
+_STATIC_CALLS = {"len", "isinstance", "callable", "hasattr", "getattr",
+                 "type", "id", "repr"}
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """(1, 2) / 1 / [0] as a tuple of ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _const_str_set(node: ast.AST) -> Optional[Set[str]]:
+    """Set of string constants in a str / tuple/list-of-str literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(getattr(a, "posonlyargs", [])) + list(a.args)
+             + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _TracedFn:
+    """A function object the analyzer believes gets traced, plus which of
+    its parameters are dynamic (non-static) there."""
+
+    def __init__(self, fn: ast.AST, via: str,
+                 static_argnums: Tuple[int, ...] = (),
+                 static_argnames: Sequence[str] = ()) -> None:
+        self.fn = fn                      # FunctionDef / Lambda
+        self.via = via                    # "jax.jit", "compat.shard_map", ...
+        names = _param_names(fn)
+        drop = set(static_argnames)
+        for i in static_argnums:
+            if 0 <= i < len(names):
+                drop.add(names[i])
+        self.dynamic_params = {n for n in names if n not in drop
+                               and n != "self"}
+
+
+def _local_defs(ctx: FileContext) -> Dict[str, List[ast.AST]]:
+    """name -> FunctionDefs in the file (all scopes), in source order."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _resolve_fn_arg(ctx: FileContext, node: ast.AST,
+                    defs: Dict[str, List[ast.AST]],
+                    before_line: int) -> Optional[ast.AST]:
+    """The function object an argument refers to: a Lambda/def literal,
+    or the nearest preceding local def with that name."""
+    if isinstance(node, ast.Lambda):
+        return node
+    if isinstance(node, ast.Name) and node.id in defs:
+        cands = [d for d in defs[node.id] if d.lineno <= before_line]
+        return cands[-1] if cands else defs[node.id][0]
+    return None
+
+
+def _is_partial(ctx: FileContext, call: ast.Call) -> bool:
+    q = ctx.qualname(call.func)
+    return q in {"functools.partial", "partial"} or \
+        (isinstance(call.func, ast.Name) and call.func.id == "partial")
+
+
+def _jit_info(ctx: FileContext, value: ast.AST,
+              ) -> Optional[Tuple[ast.Call, Tuple[int, ...], List[str]]]:
+    """If ``value`` is a (possibly partial-wrapped) ``jax.jit(...)`` call,
+    -> (the jit Call, static_argnums, static_argnames)."""
+    if not isinstance(value, ast.Call):
+        return None
+    call = value
+    q = ctx.qualname(call.func)
+    if q in {"functools.partial", "partial"} and call.args:
+        inner_q = ctx.qualname(call.args[0])
+        if inner_q in _JIT_QUALNAMES:
+            q = inner_q
+        else:
+            return None
+    if q not in _JIT_QUALNAMES:
+        return None
+    nums = _kwarg(call, "static_argnums")
+    names = _kwarg(call, "static_argnames")
+    return (call,
+            _const_int_tuple(nums) or () if nums is not None else (),
+            sorted(_const_str_set(names) or set()) if names is not None
+            else [])
+
+
+def _traced_functions(ctx: FileContext) -> List[_TracedFn]:
+    """Every local def/lambda the file hands to jit / shard_map / a lax
+    control-flow combinator, plus defs decorated with them."""
+    defs = _local_defs(ctx)
+    traced: List[_TracedFn] = []
+    seen: Set[int] = set()
+
+    def add(fn: Optional[ast.AST], via: str,
+            static_argnums: Tuple[int, ...] = (),
+            static_argnames: Sequence[str] = ()) -> None:
+        if fn is None or id(fn) in seen:
+            return
+        seen.add(id(fn))
+        traced.append(_TracedFn(fn, via, static_argnums, static_argnames))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            q = ctx.qualname(node.func)
+            if q in _JIT_QUALNAMES or q in _SHARD_MAP_QUALNAMES:
+                info = _jit_info(ctx, node)
+                nums, names = (info[1], info[2]) if info else ((), [])
+                if node.args:
+                    add(_resolve_fn_arg(ctx, node.args[0], defs,
+                                        node.lineno), q or "jit",
+                        nums, names)
+            elif q in _COMBINATOR_FN_ARGS:
+                poss = _COMBINATOR_FN_ARGS[q]
+                if poss is None:                       # lax.switch
+                    poss = tuple(range(1, len(node.args)))
+                for i in poss:
+                    if i < len(node.args):
+                        add(_resolve_fn_arg(ctx, node.args[i], defs,
+                                            node.lineno), q)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    q = ctx.qualname(dec.func)
+                    if q in _JIT_QUALNAMES:
+                        nums = _kwarg(dec, "static_argnums")
+                        names = _kwarg(dec, "static_argnames")
+                        add(node, q, _const_int_tuple(nums) or ()
+                            if nums is not None else (),
+                            sorted(_const_str_set(names) or set())
+                            if names is not None else [])
+                    elif _is_partial(ctx, dec) and dec.args and \
+                            ctx.qualname(dec.args[0]) in _JIT_QUALNAMES:
+                        nums = _kwarg(dec, "static_argnums")
+                        names = _kwarg(dec, "static_argnames")
+                        add(node, "jax.jit", _const_int_tuple(nums) or ()
+                            if nums is not None else (),
+                            sorted(_const_str_set(names) or set())
+                            if names is not None else [])
+                else:
+                    q = ctx.qualname(dec)
+                    if q in _JIT_QUALNAMES:
+                        add(node, q)
+    return traced
+
+
+def _dynamic_uses(expr: ast.AST, tainted: Set[str]) -> List[ast.Name]:
+    """Name nodes in ``expr`` bound to tainted (traced) values that are
+    used *dynamically* — i.e. NOT behind a trace-time-static accessor
+    (``x.shape``/``x.ndim``/``x.dtype``..., ``len(x)``, ``isinstance``,
+    ``x is None``).  These are the uses that concretize a tracer."""
+    offending: List[ast.Name] = []
+
+    def visit(node: ast.AST, static: bool) -> None:
+        if isinstance(node, ast.Name):
+            if node.id in tainted and not static:
+                offending.append(node)
+            return
+        if isinstance(node, ast.Attribute):
+            visit(node.value, static or node.attr in _STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            inner_static = static or fname in _STATIC_CALLS
+            for child in list(node.args) + [kw.value for kw in node.keywords]:
+                visit(child, inner_static)
+            if not isinstance(node.func, ast.Name):
+                visit(node.func, static)
+            return
+        if isinstance(node, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            for child in [node.left] + list(node.comparators):
+                visit(child, True)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, static)
+
+    visit(expr, False)
+    return offending
+
+
+# --------------------------------------------------------------------------
+# SPMD101 — compat drift
+# --------------------------------------------------------------------------
+
+#: qualified names that moved between jax releases and therefore must be
+#: spelled only inside utils/compat.py; value = the shim to use instead
+_COMPAT_ONLY = {
+    "jax.shard_map": "utils.compat.shard_map",
+    "jax.experimental.shard_map": "utils.compat.shard_map",
+    "jax.typeof": "utils.compat.varying_axes",
+    "jax.lax.pvary": "utils.compat.device_varying_marker",
+    "jax.lax.pcast": "utils.compat.device_varying_marker",
+}
+#: getattr-probe spellings of the same drift ({module qualname: attrs})
+_COMPAT_ONLY_PROBES = {
+    "jax": {"shard_map": "utils.compat.shard_map",
+            "typeof": "utils.compat.varying_axes"},
+    "jax.lax": {"pvary": "utils.compat.device_varying_marker",
+                "pcast": "utils.compat.device_varying_marker"},
+}
+
+
+def _compat_match(qual: str) -> Optional[Tuple[str, str]]:
+    """-> (matched banned prefix, replacement shim) or None."""
+    for banned, shim in _COMPAT_ONLY.items():
+        if qual == banned or qual.startswith(banned + "."):
+            return banned, shim
+    return None
+
+
+@register
+class CompatDriftRule(Rule):
+    code = "SPMD101"
+    name = "compat-drift"
+    summary = ("version-moved jax API (shard_map / typeof / pvary / pcast) "
+               "spelled directly instead of through utils.compat")
+    hint = ("route through bigdl_tpu.utils.compat — shard_map for "
+            "jax.shard_map/jax.experimental.shard_map, varying_axes for "
+            "jax.typeof(...).vma, device_varying_marker for lax.pvary/"
+            "lax.pcast; the shim resolves the right spelling per jax "
+            "generation")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.is_compat:
+            return
+        flagged: Set[Tuple[int, int]] = set()
+
+        def emit(node: ast.AST, qual: str, shim: str) -> Optional[Finding]:
+            key = (node.lineno, node.col_offset)
+            if key in flagged:
+                return None
+            flagged.add(key)
+            return ctx.finding(
+                node, self.code,
+                f"direct use of `{qual}` outside utils/compat.py "
+                f"— this API moved between jax releases",
+                hint=f"use `{shim}` — {self.hint}")
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    m = _compat_match(a.name)
+                    if m:
+                        f = emit(node, a.name, m[1])
+                        if f:
+                            yield f
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    m = _compat_match(f"{node.module}.{a.name}")
+                    if m:
+                        f = emit(node, f"{node.module}.{a.name}", m[1])
+                        if f:
+                            yield f
+            elif isinstance(node, ast.Attribute):
+                qual = ctx.qualname(node)
+                if qual:
+                    m = _compat_match(qual)
+                    if m and not isinstance(ctx.parents.get(node),
+                                            ast.Attribute):
+                        f = emit(node, qual, m[1])
+                        if f:
+                            yield f
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "getattr" and len(node.args) >= 2:
+                mod = ctx.qualname(node.args[0])
+                attr = node.args[1]
+                if mod in _COMPAT_ONLY_PROBES and \
+                        isinstance(attr, ast.Constant) and \
+                        attr.value in _COMPAT_ONLY_PROBES[mod]:
+                    shim = _COMPAT_ONLY_PROBES[mod][attr.value]
+                    f = emit(node, f'getattr({mod}, "{attr.value}")', shim)
+                    if f:
+                        yield f
+
+
+# --------------------------------------------------------------------------
+# SPMD102 — PartitionSpec spelling drift
+# --------------------------------------------------------------------------
+
+_PSPEC_QUALNAMES = {"jax.sharding.PartitionSpec",
+                    "jax.experimental.pjit.PartitionSpec"}
+
+
+@register
+class SpecSpellingRule(Rule):
+    code = "SPMD102"
+    name = "spec-spelling"
+    summary = ("PartitionSpec single-axis tuple spelling `P((\"a\",))` — "
+               "hashes differently from `P(\"a\")` and double-compiles")
+    hint = ("spell single-axis entries as the bare string: "
+            "`P(\"data\")`, never `P((\"data\",))` — jit cache keys and "
+            "NamedSharding equality treat them as DIFFERENT specs even "
+            "though they place identically, so one drifted spelling "
+            "silently compiles every program twice (the PR-4 bug); for "
+            "placement specs, build through "
+            "bigdl_tpu.serving.sharded.named_sharding which also drops "
+            "size-1 axes")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.qualname(node.func) not in _PSPEC_QUALNAMES:
+                continue
+            for arg in node.args:
+                if isinstance(arg, (ast.Tuple, ast.List)) and \
+                        len(arg.elts) == 1:
+                    spelled = ast.unparse(arg)
+                    yield ctx.finding(
+                        arg, self.code,
+                        f"single-axis tuple spelling `{spelled}` in "
+                        f"PartitionSpec — equivalent placement to the bare "
+                        f"string but a DIFFERENT hash/compile key",
+                        hint=self.hint)
+
+
+# --------------------------------------------------------------------------
+# SPMD103 — recompile hazards
+# --------------------------------------------------------------------------
+
+@register
+class RecompileHazardRule(Rule):
+    code = "SPMD103"
+    name = "recompile-hazard"
+    summary = ("f-string/.format on traced values inside jitted bodies; "
+               "structure-varying containers passed to jitted callables")
+    hint = ("traced values cannot be formatted (concretization error, or "
+            "a retrace per shape via `.shape` interpolation) — format "
+            "outside the traced function, e.g. in the caller or via "
+            "jax.debug.print; containers built by comprehension change "
+            "their pytree STRUCTURE with the data, and structure is part "
+            "of the jit cache key — pad to a fixed layout or bucket it "
+            "(see serving/admission.py)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # (a) formatting on traced values inside traced bodies
+        for tf in _traced_functions(ctx):
+            tainted = set(tf.dynamic_params)
+            for node in ast.walk(tf.fn):
+                if isinstance(node, ast.JoinedStr):
+                    offs: List[ast.Name] = []
+                    for part in node.values:
+                        if isinstance(part, ast.FormattedValue):
+                            offs.extend(_dynamic_uses(part.value, tainted))
+                    if offs:
+                        yield ctx.finding(
+                            node, self.code,
+                            f"f-string interpolates traced value "
+                            f"`{offs[0].id}` inside a body traced via "
+                            f"{tf.via}",
+                            hint=self.hint)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "format":
+                    offs = []
+                    for a in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        offs.extend(_dynamic_uses(a, tainted))
+                    if offs:
+                        yield ctx.finding(
+                            node, self.code,
+                            f".format() on traced value `{offs[0].id}` "
+                            f"inside a body traced via {tf.via}",
+                            hint=self.hint)
+
+        # (b) structure-varying container literally built at the call
+        # site of a known-jitted callable
+        jitted_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _jit_info(ctx, node.value):
+                for t in node.targets:
+                    d = ctx.dotted(t)
+                    if d:
+                        jitted_names.add(d)
+            elif isinstance(node, ast.Return) and node.value is not None \
+                    and _jit_info(ctx, node.value):
+                fn = ctx.enclosing_function(node)
+                if isinstance(fn, ast.FunctionDef):
+                    # e.g. a cached_property returning jax.jit(...) —
+                    # call sites spell it self.<name>
+                    jitted_names.add(f"self.{fn.name}")
+        if not jitted_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.dotted(node.func) not in jitted_names:
+                continue
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, (ast.DictComp, ast.ListComp, ast.SetComp,
+                                  ast.GeneratorExp)):
+                    yield ctx.finding(
+                        a, self.code,
+                        "container built by comprehension flows into "
+                        f"jitted callable `{ctx.dotted(node.func)}` — its "
+                        "pytree structure varies with the data, so every "
+                        "new structure is a new compile",
+                        hint=self.hint)
+
+
+# --------------------------------------------------------------------------
+# SPMD104 — donation misuse
+# --------------------------------------------------------------------------
+
+@register
+class DonationReuseRule(Rule):
+    code = "SPMD104"
+    name = "donation-reuse"
+    summary = ("argument donated via donate_argnums read again after the "
+               "donating call")
+    hint = ("a donated buffer is INVALID after the call (XLA reuses its "
+            "memory for the outputs) — rebind the name to the call's "
+            "result (`carry = step(carry, x)`) or drop donation for "
+            "buffers you must keep")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # donated callable name -> donated positional indices
+        donated: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            info = None
+            if isinstance(node, ast.Assign):
+                info = _jit_info(ctx, node.value)
+                targets = [ctx.dotted(t) for t in node.targets]
+            elif isinstance(node, ast.Return) and node.value is not None:
+                info = _jit_info(ctx, node.value)
+                fn = ctx.enclosing_function(node)
+                targets = [f"self.{fn.name}"] \
+                    if isinstance(fn, ast.FunctionDef) else []
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    j = _jit_info(ctx, dec) if isinstance(dec, ast.Call) \
+                        else None
+                    if j:
+                        info, targets = j, [node.name]
+                        break
+                else:
+                    continue
+            else:
+                continue
+            if not info:
+                continue
+            nums = _kwarg(info[0], "donate_argnums")
+            pos = _const_int_tuple(nums) if nums is not None else None
+            if pos:
+                for t in targets:
+                    if t:
+                        donated[t] = pos
+
+        if not donated:
+            return
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = ctx.dotted(node.func)
+            if callee not in donated:
+                continue
+            scope = ctx.enclosing_function(node) or ctx.tree
+            for i in donated[callee]:
+                if i >= len(node.args):
+                    continue
+                buf = ctx.dotted(node.args[i])
+                if buf is None or buf == "self":
+                    continue
+                reuse = self._first_reuse(ctx, scope, buf, node)
+                if reuse is not None:
+                    yield ctx.finding(
+                        reuse, self.code,
+                        f"`{buf}` was donated to `{callee}` on line "
+                        f"{node.lineno} (donate_argnums includes position "
+                        f"{i}) and is read again here",
+                        hint=self.hint)
+
+    @staticmethod
+    def _first_reuse(ctx: FileContext, scope: ast.AST, buf: str,
+                     call: ast.Call) -> Optional[ast.AST]:
+        """First Load of ``buf`` after the donating ``call`` in ``scope``
+        (same function only — closures and other functions are out of
+        this linear approximation) with no intervening rebinding."""
+        call_line = getattr(call, "end_lineno", call.lineno)
+        scope_fn = scope if isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                    ast.Lambda)) else None
+        loads: List[ast.AST] = []
+        stores: List[int] = []
+        for n in ast.walk(scope):
+            if isinstance(n, ast.AugAssign):
+                # `cache += 1` reads the old buffer before rebinding —
+                # the target carries Store ctx only, so surface the
+                # implicit read here
+                if ctx.dotted(n.target) == buf and \
+                        ctx.enclosing_function(n) is scope_fn and \
+                        n.lineno > call_line:
+                    loads.append(n.target)
+                continue
+            d = ctx.dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) \
+                else None
+            if d != buf:
+                continue
+            if ctx.enclosing_function(n) is not scope_fn:
+                continue
+            ic = getattr(n, "ctx", None)
+            if isinstance(ic, ast.Load):
+                # strictly after the donating call's last line — the
+                # call's own argument loads never count
+                if n.lineno > call_line:
+                    loads.append(n)
+            elif isinstance(ic, (ast.Store, ast.Del)):
+                stores.append(n.lineno)
+        for n in sorted(loads, key=lambda x: (x.lineno, x.col_offset)):
+            # a store masks only loads on LATER lines: in
+            # `cache = cache + 1` the RHS reads the (dead) buffer before
+            # the same-statement rebind takes effect
+            if not any(call.lineno <= s < n.lineno for s in stores):
+                return n
+        return None
+
+
+# --------------------------------------------------------------------------
+# SPMD105 — tracer leaks
+# --------------------------------------------------------------------------
+
+@register
+class TracerLeakRule(Rule):
+    code = "SPMD105"
+    name = "tracer-leak"
+    summary = ("Python `if`/`while` on a traced value inside a "
+               "jitted/shard_mapped/scanned body")
+    hint = ("Python control flow runs at TRACE time and needs a concrete "
+            "bool — on a tracer this raises (or silently bakes in one "
+            "branch). Use lax.cond / lax.select / jnp.where for value-"
+            "dependent branches; branching on static facts "
+            "(`x is None`, `x.ndim`, `x.shape[0]`, `len(xs)`) is fine "
+            "and not flagged")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        reported: Set[Tuple[int, int]] = set()
+        for tf in _traced_functions(ctx):
+            params = set(tf.dynamic_params)
+            if not params:
+                continue
+            for node in ast.walk(tf.fn):
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp,
+                                         ast.Assert)):
+                    continue
+                test = node.test
+                offs = _dynamic_uses(test, params)
+                if not offs:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in reported:
+                    continue
+                reported.add(key)
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression",
+                        ast.Assert: "assert"}[type(node)]
+                yield ctx.finding(
+                    node, self.code,
+                    f"`{kind}` on traced value `{offs[0].id}` inside a "
+                    f"body traced via {tf.via}",
+                    hint=self.hint)
+
+
+# --------------------------------------------------------------------------
+# SPMD106 — mesh-axis consistency
+# --------------------------------------------------------------------------
+
+_MESH_QUALNAMES = {"jax.sharding.Mesh", "jax.experimental.maps.Mesh"}
+#: mesh factories with FIXED axis names (bigdl_tpu.serving.sharded.make_mesh
+#: always builds ("data", "model"))
+_MESH_FACTORIES = {
+    "bigdl_tpu.serving.sharded.make_mesh": {"data", "model"},
+    "bigdl_tpu.serving.make_mesh": {"data", "model"},
+}
+
+
+def _mesh_axes_from_call(ctx: FileContext,
+                         call: ast.Call) -> Optional[Set[str]]:
+    q = ctx.qualname(call.func)
+    if q in _MESH_FACTORIES:
+        return set(_MESH_FACTORIES[q])
+    if q in _MESH_QUALNAMES:
+        ax = _kwarg(call, "axis_names")
+        if ax is None and len(call.args) >= 2:
+            ax = call.args[1]
+        if ax is None:
+            return None
+        return _const_str_set(ax)
+    return None
+
+
+@register
+class MeshAxisRule(Rule):
+    code = "SPMD106"
+    name = "mesh-axis"
+    summary = ("in_specs/out_specs naming an axis the shard_map's mesh "
+               "does not define")
+    hint = ("every axis name in in_specs/out_specs must be one of the "
+            "Mesh's axis_names — a misspelled axis fails at trace time "
+            "at best, silently replicates at worst; fix the spec or the "
+            "Mesh construction")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # mesh variable name -> [(enclosing scope, lineno, axes-or-None)];
+        # axes is None for assignments whose provenance the analyzer
+        # cannot see (helper calls, parameters...) — those SHADOW
+        # literal constructions rather than being skipped over
+        mesh_vars: Dict[str, List[Tuple[Optional[ast.AST], int,
+                                        Optional[Set[str]]]]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                axes = _mesh_axes_from_call(ctx, node.value) \
+                    if isinstance(node.value, ast.Call) else None
+                scope = ctx.enclosing_function(node)
+                for t in node.targets:
+                    d = ctx.dotted(t)
+                    if d:
+                        mesh_vars.setdefault(d, []).append(
+                            (scope, node.lineno, axes))
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = ctx.qualname(node.func)
+            if q not in _SHARD_MAP_QUALNAMES:
+                continue
+            mesh_arg = _kwarg(node, "mesh")
+            if mesh_arg is None:
+                continue
+            axes: Optional[Set[str]] = None
+            mesh_label = ast.unparse(mesh_arg)
+            if isinstance(mesh_arg, ast.Call):
+                axes = _mesh_axes_from_call(ctx, mesh_arg)
+            else:
+                d = ctx.dotted(mesh_arg)
+                if d in mesh_vars:
+                    axes = self._resolve_var(ctx, mesh_vars[d], node)
+            if axes is None:
+                continue           # provenance unknown — stay silent
+            for kw_name in ("in_specs", "out_specs"):
+                specs = _kwarg(node, kw_name)
+                if specs is None:
+                    continue
+                for f in self._check_specs(ctx, specs, axes, kw_name,
+                                           mesh_label):
+                    yield f
+
+    @staticmethod
+    def _resolve_var(ctx: FileContext,
+                     cands: List[Tuple[Optional[ast.AST], int,
+                                       Optional[Set[str]]]],
+                     call: ast.Call) -> Optional[Set[str]]:
+        """Axes of the nearest preceding assignment to the mesh variable,
+        searching the call's lexical scope chain innermost-out.  Returns
+        None (silence) when the binding that actually wins is one the
+        analyzer cannot see into."""
+        scope: Optional[ast.AST] = ctx.enclosing_function(call)
+        while True:
+            in_scope = [(ln, axes) for (s, ln, axes) in cands
+                        if s is scope and ln <= call.lineno]
+            if in_scope:
+                # nearest preceding; its axes may be None -> silence
+                return max(in_scope, key=lambda t: t[0])[1]
+            if scope is None:
+                return None
+            scope = ctx.enclosing_function(scope)
+
+    def _check_specs(self, ctx: FileContext, specs: ast.AST,
+                     axes: Set[str], kw_name: str,
+                     mesh_label: str) -> Iterator[Finding]:
+        for node in ast.walk(specs):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.qualname(node.func) not in _PSPEC_QUALNAMES:
+                continue
+            for s in ast.walk(node):
+                if isinstance(s, ast.Constant) and \
+                        isinstance(s.value, str) and s.value not in axes:
+                    yield ctx.finding(
+                        s, self.code,
+                        f"{kw_name} names axis `{s.value}` but mesh "
+                        f"`{mesh_label}` defines axes "
+                        f"{sorted(axes)}",
+                        hint=self.hint)

@@ -96,6 +96,23 @@ def varying_axes(x):
     return getattr(typeof(x), "vma", None) or frozenset()
 
 
+def varying_marker_kind() -> str:
+    """Which marker :func:`device_varying_marker` resolves to on this
+    jax: ``"pcast"`` (0.9+), ``"pvary"`` (0.5/0.6 era), or
+    ``"identity"`` (pre-pvary, e.g. 0.4.37 — no varying-type system, so
+    there is nothing to mark).  Lets callers that *test* the marking
+    construction skip where it cannot be built, without probing
+    ``lax.pcast``/``lax.pvary`` themselves (that probe is exactly the
+    compat drift SPMD101 flags)."""
+    from jax import lax
+
+    if getattr(lax, "pcast", None) is not None:
+        return "pcast"
+    if getattr(lax, "pvary", None) is not None:
+        return "pvary"
+    return "identity"
+
+
 def device_varying_marker(axis_name: str):
     """A function marking an array device-varying over ``axis_name``
     inside a ``shard_map`` body — the knob that keeps cotangents of
@@ -108,10 +125,9 @@ def device_varying_marker(axis_name: str):
     """
     from jax import lax
 
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        return lambda x: pcast(x, axis_name, to="varying")
-    pvary = getattr(lax, "pvary", None)
-    if pvary is not None:
-        return lambda x: pvary(x, axis_name)
+    kind = varying_marker_kind()
+    if kind == "pcast":
+        return lambda x: lax.pcast(x, axis_name, to="varying")
+    if kind == "pvary":
+        return lambda x: lax.pvary(x, axis_name)
     return lambda x: x

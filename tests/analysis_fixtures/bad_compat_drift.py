@@ -1,0 +1,33 @@
+"""SPMD101 fixtures: version-moved jax APIs spelled directly.
+
+Docstring mentions of jax.shard_map, jax.typeof, lax.pvary and
+lax.pcast must NOT trigger — the rule is import-resolution based, which
+is why the repo could not simply grep for these spellings.
+"""
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map  # EXPECT: SPMD101
+
+
+def direct_attribute_uses(x):
+    sm = jax.shard_map  # EXPECT: SPMD101
+    t = jax.typeof(x)  # EXPECT: SPMD101
+    marked = lax.pvary(x, "data")  # EXPECT: SPMD101
+    cast = jax.lax.pcast  # EXPECT: SPMD101
+    return sm, t, marked, cast, shard_map
+
+
+def getattr_probes():
+    # the probe spelling is the same drift — compat.py owns these probes
+    a = getattr(jax, "shard_map", None)  # EXPECT: SPMD101
+    b = getattr(lax, "pvary", None)  # EXPECT: SPMD101
+    # probing something unrelated is fine
+    c = getattr(jax, "devices", None)
+    return a, b, c
+
+
+def unrelated_attributes_are_fine(engine):
+    # `engine` is not an imported jax module — must not trigger even
+    # though the attribute is literally named shard_map
+    return engine.shard_map, engine.typeof

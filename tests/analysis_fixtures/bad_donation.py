@@ -1,0 +1,49 @@
+"""SPMD104 fixtures: donated buffers read after the donating call.
+
+``donate_argnums`` hands the argument's memory to XLA for the outputs —
+the old array is INVALID afterwards (jax raises on some backends,
+silently corrupts on others).  The carry idiom (rebind the name to the
+result) is the fix, and is what serving's KV pool does.
+"""
+
+import jax
+
+
+def scatter(buf, upd):
+    return buf.at[0].set(upd)
+
+
+donating = jax.jit(scatter, donate_argnums=(0,))
+
+
+def good_carry_rebind(cache, upd):
+    cache = donating(cache, upd)      # name rebound to the result — fine
+    return cache + 1
+
+
+def good_last_use(cache, upd):
+    return donating(cache, upd)       # never touched again — fine
+
+
+def bad_reuse(cache, upd):
+    out = donating(cache, upd)
+    return out + cache  # EXPECT: SPMD104
+
+
+def bad_reuse_later(cache, upd):
+    out = donating(cache, upd)
+    other = out * 2
+    norm = cache.sum()  # EXPECT: SPMD104
+    return other, norm
+
+
+def bad_same_line_rebind(cache, upd):
+    out = donating(cache, upd)
+    cache = cache + 1  # EXPECT: SPMD104
+    return out, cache
+
+
+def bad_augmented_rebind(cache, upd):
+    out = donating(cache, upd)
+    cache += 1  # EXPECT: SPMD104
+    return out, cache

@@ -1,0 +1,45 @@
+"""SPMD106 fixtures: in_specs/out_specs axes vs the Mesh's axis names.
+
+A spec naming an axis the mesh does not define fails at trace time at
+best, silently replicates at worst.  The rule only fires when it can
+SEE the mesh construction (literal ``Mesh(...)`` axis names or the
+fixed-axis ``make_mesh`` factory) — unknown provenance stays silent.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.utils.compat import shard_map
+
+
+def known_mesh(f):
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("data", "model"))
+    good = shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P("data", "model"))
+    bad = shard_map(
+        f, mesh=mesh,
+        in_specs=(P("batch"),),  # EXPECT: SPMD106
+        out_specs=P("model"))
+    return good, bad
+
+
+def factory_mesh(f):
+    from bigdl_tpu.serving.sharded import make_mesh
+
+    mesh = make_mesh(data=4, model=2)
+    return shard_map(
+        f, mesh=mesh,
+        in_specs=(P("data"),),
+        out_specs=P("slots"))  # EXPECT: SPMD106
+
+
+def unknown_mesh(f, mesh):
+    # mesh arrives as a parameter — provenance unknown, stay silent
+    return shard_map(f, mesh=mesh, in_specs=(P("whatever"),), out_specs=P())
+
+
+def shadowed_mesh(f, build):
+    mesh = Mesh(np.asarray(jax.devices()).reshape(8), ("data",))
+    mesh = build()              # rebound to an unknown — stay silent
+    return shard_map(f, mesh=mesh, in_specs=(P("rows"),), out_specs=P())

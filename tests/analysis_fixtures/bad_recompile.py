@@ -1,0 +1,43 @@
+"""SPMD103 fixtures: recompile hazards.
+
+(a) string formatting on traced values inside jitted bodies — either a
+    concretization error outright or, via ``.shape`` of data-dependent
+    intermediates, a retrace per shape;
+(b) containers built by comprehension flowing into a jitted callable —
+    their pytree STRUCTURE varies with the data and structure is part of
+    the compile key (the bug class serving's length-bucketed admission
+    exists to prevent).
+"""
+
+import jax
+
+
+def traced(x, y):
+    bad_fstring = f"x is {x}"  # EXPECT: SPMD103
+    ok_static = f"shape is {x.shape}, rank {x.ndim}"
+    bad_format = "y = {}".format(y)  # EXPECT: SPMD103
+    ok_const = "nothing traced {}".format(42)
+    return x + y, bad_fstring, bad_format, ok_static, ok_const
+
+
+step = jax.jit(traced)
+
+
+def cfg_step(x, mode):
+    # `mode` is static (static_argnames below) — formatting it is fine
+    label = f"mode={mode}"
+    return x, label
+
+
+cfg = jax.jit(cfg_step, static_argnames=("mode",))
+
+
+def admit(requests):
+    # structure of the dict depends on the request batch -> one compile
+    # per novel structure
+    return step(1, {k: v for k, v in requests})  # EXPECT: SPMD103
+
+
+def fine_calls(x):
+    # plain dict literals / arrays are stable structures — fine
+    return step(x, 2), cfg(x, mode="fast")

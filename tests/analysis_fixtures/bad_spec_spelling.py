@@ -1,0 +1,21 @@
+"""SPMD102 fixtures: the PR-4 PartitionSpec spelling drift.
+
+``P(("data",))`` places identically to ``P("data")`` but hashes
+differently — one drifted spelling made every sharded-engine step
+compile twice before ``serving/sharded.py``'s ``named_sharding``
+normalizer pinned it down.
+"""
+
+import jax.sharding
+from jax.sharding import PartitionSpec as P
+
+GOOD_BARE = P("data")
+GOOD_MULTI_DIM = P("data", "model")
+# a MULTI-axis tuple entry shards one dim over two mesh axes — legit
+GOOD_MULTI_AXIS_ENTRY = P(("dcn", "data"), "model")
+GOOD_EMPTY = P()
+GOOD_NONE = P(None, "model")
+
+BAD_ONE_TUPLE = P(("data",))  # EXPECT: SPMD102
+BAD_MIXED = P("data", ("model",))  # EXPECT: SPMD102
+BAD_FULL_NAME = jax.sharding.PartitionSpec(("model",))  # EXPECT: SPMD102

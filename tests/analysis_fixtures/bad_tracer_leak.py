@@ -1,0 +1,45 @@
+"""SPMD105 fixtures: Python control flow on traced values.
+
+``if``/``while`` run at TRACE time and need a concrete bool — on a
+tracer that raises TracerBoolConversionError (or silently bakes one
+branch into the program).  Branching on static facts (identity, shapes,
+dtypes, ``len``) is fine and must not be flagged.
+"""
+
+import jax
+
+xs = None
+
+
+def body(x, lim):
+    if x is None:                       # static identity check — fine
+        return lim
+    if x.ndim > 1:                      # shapes are static — fine
+        x = x.sum(axis=0)
+    if len(x.shape) == 1 and x.shape[0] > 4:    # still static — fine
+        x = x[:4]
+    if x > 0:  # EXPECT: SPMD105
+        x = -x
+    while lim > 0:  # EXPECT: SPMD105
+        lim = lim - 1
+    return x, lim
+
+
+step = jax.jit(body)
+
+
+def scan_body(carry, t):
+    if carry:  # EXPECT: SPMD105
+        carry = carry + t
+    return carry, t
+
+
+def run(init):
+    return jax.lax.scan(scan_body, init, xs)
+
+
+def untraced(x):
+    # this function is never jitted — host-side branching is fine
+    if x > 0:
+        return x
+    return -x

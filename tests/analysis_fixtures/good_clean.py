@@ -1,0 +1,77 @@
+"""The idiomatic spelling of every pattern the analyzer polices —
+must produce ZERO findings (the false-positive guard for the whole
+rule set)."""
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.utils.compat import (
+    device_varying_marker, shard_map, varying_axes, varying_marker_kind)
+
+# -- compat spellings (SPMD101) --------------------------------------------
+
+mark = device_varying_marker("data")
+kind = varying_marker_kind()
+
+
+def vma_of(x):
+    return varying_axes(x)
+
+
+# -- spec spellings (SPMD102) ----------------------------------------------
+
+REPLICATED = P()
+ROWS = P("data")
+TP = P("data", "model")
+DOUBLE_SHARDED = P(("dcn", "data"), "model")
+
+
+# -- jit bodies (SPMD103 / SPMD105) ----------------------------------------
+
+def step(x, scale):
+    # static facts about tracers are fine to branch and format on
+    if x is None:
+        return x
+    if x.ndim > 1:
+        x = x.sum(axis=0)
+    shape_note = f"rank-{x.ndim} {x.shape}"
+    y = jax.numpy.where(x > 0, x * scale, x)      # value branch via where
+    return y, shape_note
+
+
+jit_step = jax.jit(step)
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def bucketed_prefill(tokens, bucket):
+    # bucket is static — a bounded compile set by construction
+    del bucket
+    return tokens
+
+
+# -- donation (SPMD104) ----------------------------------------------------
+
+def scatter(buf, upd):
+    return buf.at[0].set(upd)
+
+
+donating = jax.jit(scatter, donate_argnums=(0,))
+
+
+def carry_loop(cache, upds):
+    for u in upds:
+        cache = donating(cache, u)    # rebound every step — the idiom
+    return cache
+
+
+# -- mesh axes (SPMD106) ---------------------------------------------------
+
+def sharded_apply(f):
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4, 2), ("data", "model"))
+    fn = shard_map(f, mesh=mesh, in_specs=(P("data"), P("model")),
+                   out_specs=P("data"))
+    placement = NamedSharding(mesh, ROWS)
+    return fn, placement

@@ -1,0 +1,40 @@
+"""One-compiled-program guards (plain helper module, no side effects).
+
+The serving plane's core perf invariant is that knob changes, mesh
+shapes, and traffic mixes are runtime DATA, never a recompile.  The
+runtime half of that guarantee is pinned here; the static half is
+bigdl_tpu/analysis (SPMD102/SPMD103 — see docs/analysis.md).  Both
+halves reference this one utility so the invariant has a single home.
+
+Import from here (``from tests.compile_guards import ...``), not from
+``tests.conftest`` — conftest re-exports these for discoverability, but
+importing it as a module would load a SECOND copy next to pytest's
+``conftest`` instance and re-run its jax/XLA session setup.
+"""
+
+
+def compile_count(step_fn):
+    """Number of programs a cached jitted step has compiled.  Accepts
+    either a caching wrapper exposing ``_cache_size()`` directly (the
+    decode steps' ``eng._step_fn``) or one holding it on ``._jitted``
+    (the prefill steps' ``eng._batch_prefill_fn``)."""
+    if hasattr(step_fn, "_cache_size"):
+        return step_fn._cache_size()
+    jitted = getattr(step_fn, "_jitted", None)
+    if jitted is not None and hasattr(jitted, "_cache_size"):
+        return jitted._cache_size()
+    raise TypeError(
+        f"{step_fn!r} exposes neither _cache_size() nor _jitted — not a "
+        "cached jitted step")
+
+
+def assert_compile_count(step_fn, expected, what=""):
+    """Assert a cached jitted step has compiled exactly ``expected``
+    programs — the shared compile-count regression guard used by the
+    serving suites (sharded / admission / sampling)."""
+    got = compile_count(step_fn)
+    label = f" [{what}]" if what else ""
+    assert got == expected, (
+        f"compile-count guard{label}: expected {expected} compiled "
+        f"program(s), found {got} — something recompiled that should "
+        f"have been runtime data")

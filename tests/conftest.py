@@ -38,3 +38,13 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+# -- one-compiled-program guards -------------------------------------------
+# Canonical home: tests/compile_guards.py (a plain, side-effect-free
+# module — import THAT in test files; importing tests.conftest would
+# load a second copy of this module next to pytest's own instance and
+# re-run the jax/XLA session setup above).  Re-exported here so the
+# guard is discoverable where fixtures live.
+from tests.compile_guards import (  # noqa: E402,F401
+    assert_compile_count, compile_count)

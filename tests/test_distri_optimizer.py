@@ -291,21 +291,18 @@ def test_allreduce_construction_single_collective_on_wire():
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from bigdl_tpu.utils.compat import (
+        device_varying_marker, shard_map, varying_marker_kind)
+
     mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
-    pcast = getattr(lax, "pcast", None)
-    pvary = getattr(lax, "pvary", None)
-    if pcast is None and pvary is None:
+    if varying_marker_kind() == "identity":
         # NOTE: on such a jax the varying-mark construction (and the
         # distri_optimizer hot path that uses it) cannot be BUILT at all,
         # so there is no behavior to pin here — the skip loses coverage
         # only on toolchains where the feature itself is absent
         pytest.skip("this jax predates lax.pcast/lax.pvary — the "
                     "varying-mark construction under test cannot be built")
-    mark = ((lambda t: pcast(t, "data", to="varying")) if pcast is not None
-            else (lambda t: pvary(t, "data")))
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:                    # pre-0.6 spelling
-        from jax.experimental.shard_map import shard_map
+    mark = device_varying_marker("data")
 
     def make(marked):
         def f(x, w):

@@ -213,6 +213,7 @@ def test_prefill_compile_count_bounded_by_buckets(rng):
     the number of distinct lengths (PR 1's per-request path compiled one
     program per novel length, mid-admission)."""
     from bigdl_tpu.serving import ServingEngine, bucket_len
+    from tests.compile_guards import assert_compile_count
 
     lm = _make_lm()
     eng = ServingEngine(lm, n_slots=16, admission="batched")
@@ -226,7 +227,8 @@ def test_prefill_compile_count_bounded_by_buckets(rng):
     traced = eng.admitter.traced_shapes
     assert len(traced) <= len(buckets) < len(distinct)
     # the jit cache agrees with our shape ledger
-    assert eng._batch_prefill_fn._jitted._cache_size() == len(traced)
+    assert_compile_count(eng._batch_prefill_fn, len(traced),
+                         what="first admission wave")
     total_compiles, _ = eng.metrics.metrics.get(
         "serving/prefill_bucket_compiles")
     assert total_compiles == len(traced)
@@ -235,7 +237,8 @@ def test_prefill_compile_count_bounded_by_buckets(rng):
     for n in plens:
         eng.submit(rng.randint(1, 30, size=(n,)).tolist(), max_new_tokens=3)
     eng.drain()
-    assert eng._batch_prefill_fn._jitted._cache_size() == len(traced)
+    assert_compile_count(eng._batch_prefill_fn, len(traced),
+                         what="repeat lengths, same engine")
     assert len(eng.admitter.traced_shapes) == len(traced)
     # a SECOND engine over the same warm model shares the jitted step:
     # same shapes routed, zero new compiles reported
@@ -248,7 +251,8 @@ def test_prefill_compile_count_bounded_by_buckets(rng):
     compiles2, _ = eng2.metrics.metrics.get(
         "serving/prefill_bucket_compiles")
     assert compiles2 == 0
-    assert eng2._batch_prefill_fn._jitted._cache_size() == len(traced)
+    assert_compile_count(eng2._batch_prefill_fn, len(traced),
+                         what="second engine, warm model")
 
 
 # -- PrefixCache unit invariants -------------------------------------------

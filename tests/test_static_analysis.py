@@ -1,0 +1,227 @@
+"""SPMD hygiene analyzer (bigdl_tpu/analysis): the tier-1 repo-wide
+zero-findings gate, exact (line, code) parity against the EXPECT-marked
+fixtures, the utils/compat.py no-false-positive guarantee, and the CLI
+contract (exit codes, --select/--ignore, --json, baseline handling).
+
+Pure AST — none of this traces or compiles anything, so the whole
+module runs in milliseconds plus one subprocess for the `python -m`
+entry point.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from bigdl_tpu.analysis import (
+    DEFAULT_PATHS, analyze_paths, analyze_source, load_baseline, main,
+    rule_codes, split_baselined,
+)
+
+pytestmark = pytest.mark.analysis
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "analysis_fixtures"
+BASELINE = REPO / "analysis_baseline.txt"
+
+BAD_FIXTURES = sorted(FIXTURES.glob("bad_*.py"))
+ALL_CODES = ("SPMD101", "SPMD102", "SPMD103", "SPMD104", "SPMD105",
+             "SPMD106")
+
+
+def _expected(path: Path):
+    """(line, code) pairs from the fixture's `# EXPECT: CODE` comments."""
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        m = re.search(r"#\s*EXPECT:\s*(SPMD\d+)", line)
+        if m:
+            out.add((i, m.group(1)))
+    return out
+
+
+# -- the tier-1 acceptance gate --------------------------------------------
+
+def test_repo_has_zero_non_baselined_findings(monkeypatch):
+    """`python -m bigdl_tpu.analysis bigdl_tpu benchmarks tests` must be
+    clean: every finding either fixed or explicitly grandfathered in the
+    committed baseline.  Re-introducing the PR-4 spec drift or a direct
+    jax.shard_map import anywhere in those trees fails THIS test with
+    the rule code and file:line."""
+    monkeypatch.chdir(REPO)
+    # analyze_paths silently skips nonexistent paths — guard against a
+    # renamed tree turning this gate into a zero-file false green (the
+    # CLI exits 2 on this; the API caller must check itself)
+    for p in DEFAULT_PATHS:
+        assert (REPO / p).is_dir(), f"analyzed tree missing: {p}"
+    findings = analyze_paths(DEFAULT_PATHS)
+    new, _ = split_baselined(findings, load_baseline(str(BASELINE)))
+    assert not new, (
+        "SPMD hygiene violations (fix them, or baseline each with a "
+        "justification comment in analysis_baseline.txt — see "
+        "docs/analysis.md):\n"
+        + "\n".join(f.format() for f in new))
+
+
+def test_rule_registry_is_complete():
+    assert tuple(sorted(rule_codes())) == ALL_CODES
+
+
+# -- fixture parity ---------------------------------------------------------
+
+@pytest.mark.parametrize("fixture", BAD_FIXTURES,
+                         ids=[p.stem for p in BAD_FIXTURES])
+def test_bad_fixture_exact_findings(fixture):
+    """Exact (line, code) parity with the EXPECT comments — unmarked
+    lines in the bad files double as false-positive checks (static
+    shape branches, getattr of unrelated attrs, legit multi-axis tuple
+    specs, carry rebinding...)."""
+    expected = _expected(fixture)
+    assert expected, f"{fixture} has no EXPECT annotations"
+    got = {(f.line, f.code) for f in analyze_paths([str(fixture)])}
+    assert got == expected, (
+        f"missing: {sorted(expected - got)}; "
+        f"spurious: {sorted(got - expected)}")
+
+
+def test_good_fixture_is_clean():
+    assert analyze_paths([str(FIXTURES / "good_clean.py")]) == []
+
+
+def test_compat_module_itself_is_clean():
+    """utils/compat.py is the one module allowed to spell the moved APIs
+    directly — the analyzer must not flag its own shim."""
+    compat = REPO / "bigdl_tpu" / "utils" / "compat.py"
+    assert analyze_paths([str(compat)]) == []
+
+
+def test_compat_rule_fires_on_compat_body_elsewhere(tmp_path):
+    """The compat exemption is PATH-based, not content-based: the same
+    probes outside utils/compat.py are flagged."""
+    clone = tmp_path / "not_compat.py"
+    clone.write_text((REPO / "bigdl_tpu" / "utils"
+                      / "compat.py").read_text())
+    assert any(f.code == "SPMD101" for f in analyze_paths([str(clone)]))
+
+
+def test_fixture_dir_excluded_from_tree_scans():
+    """Repo-wide scans must skip analysis_fixtures/ (deliberate
+    violations) while explicit file paths still reach inside."""
+    findings = analyze_paths([str(FIXTURES.parent)],
+                             select=["SPMD102"])
+    assert not any("analysis_fixtures" in f.path for f in findings)
+
+
+# -- acceptance: re-introducing the historical bugs ------------------------
+
+def test_reintroduced_pr4_spec_drift_is_caught(tmp_path):
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "ROWS = P(('data',))\n"
+    )
+    fs = analyze_source(src, "drifted.py")
+    assert [(f.code, f.line) for f in fs] == [("SPMD102", 2)]
+
+
+def test_duplicate_lines_get_distinct_fingerprints():
+    """Baselining one occurrence of a drifted line must not silence a
+    second paste of the identical line — fingerprints are occurrence-
+    indexed."""
+    src = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "SPECS = [\n"
+        "    P(('data',)),\n"
+        "    P(('data',)),\n"
+        "]\n"
+    )
+    fs = analyze_source(src, "dup.py")
+    assert [f.code for f in fs] == ["SPMD102", "SPMD102"]
+    assert fs[0].source == fs[1].source
+    assert fs[0].fingerprint() != fs[1].fingerprint()
+    new, old = split_baselined(fs, {fs[0].baseline_key()})
+    assert [f.line for f in old] == [3] and [f.line for f in new] == [4]
+
+
+def test_reintroduced_direct_shard_map_import_is_caught():
+    fs = analyze_source(
+        "from jax.experimental.shard_map import shard_map\n", "bad.py")
+    assert [(f.code, f.line) for f in fs] == [("SPMD101", 1)]
+
+
+# -- CLI contract -----------------------------------------------------------
+
+def test_cli_exit_codes_and_select(capsys, monkeypatch):
+    monkeypatch.chdir(REPO)
+    bad = str(FIXTURES / "bad_spec_spelling.py")
+
+    assert main([bad, "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "SPMD102" in out and "bad_spec_spelling.py:19" in out
+
+    # selecting a rule the file does not violate -> clean, exit 0
+    assert main([bad, "--no-baseline", "--select", "SPMD104"]) == 0
+    # ignoring the violated rule -> clean
+    assert main([bad, "--no-baseline", "--ignore", "SPMD102"]) == 0
+    capsys.readouterr()
+    # unknown code -> usage error
+    assert main([bad, "--select", "SPMD999"]) == 2
+    # a typo'd / wrong-cwd path must be a usage error, never a false
+    # green from scanning zero files
+    assert main(["no_such_tree"]) == 2
+
+
+def test_cli_json_report(capsys, monkeypatch):
+    monkeypatch.chdir(REPO)
+    rc = main([str(FIXTURES / "bad_donation.py"), "--no-baseline",
+               "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["summary"]["new"] == 4
+    assert {f["code"] for f in report["findings"]} == {"SPMD104"}
+    assert all(f["fingerprint"] for f in report["findings"])
+
+    rc = main([str(FIXTURES / "good_clean.py"), "--no-baseline", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["summary"] == {
+        "new": 0, "baselined": 0, "total": 0}
+
+
+def test_cli_baseline_roundtrip(tmp_path, capsys, monkeypatch):
+    """--write-baseline output, committed as the baseline, silences
+    exactly the current findings (and ONLY those: the fingerprint is
+    content-addressed, so editing the offending line re-flags it)."""
+    monkeypatch.chdir(REPO)
+    bad = str(FIXTURES / "bad_tracer_leak.py")
+    assert main([bad, "--write-baseline"]) == 0
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(capsys.readouterr().out)
+
+    assert main([bad, "--baseline", str(baseline)]) == 0
+    assert "baselined" in capsys.readouterr().out
+
+    # a NEW violation in the same file is not covered by the baseline
+    drifted = tmp_path / "drifted_copy.py"
+    drifted.write_text(Path(bad).read_text()
+                       + "\n\nimport jax\nsm = jax.shard_map\n")
+    assert main([str(drifted), "--baseline", str(baseline)]) == 1
+
+
+def test_module_entrypoint_subprocess():
+    """The `python -m bigdl_tpu.analysis` contract CI rides on: nonzero
+    on findings, zero on clean, works from the repo root."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.analysis",
+         str(FIXTURES / "bad_compat_drift.py"), "--no-baseline",
+         "--quiet"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    assert "SPMD101" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.analysis", "--list-rules"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for code in ALL_CODES:
+        assert code in proc.stdout
