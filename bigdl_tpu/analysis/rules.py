@@ -415,19 +415,76 @@ class SpecSpellingRule(Rule):
 # SPMD103 — recompile hazards
 # --------------------------------------------------------------------------
 
+_BLOCKSPEC_QUALNAMES = {"jax.experimental.pallas.BlockSpec"}
+
+
+def _own_scope_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested
+    def/lambda subtrees — their assignment targets are locals of a
+    DIFFERENT scope and must not count as this function's bindings."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _scope_local_names(ctx: FileContext, node: ast.AST) -> Set[str]:
+    """Names bound in the enclosing function/lambda scope chain of
+    ``node`` (params + assignment/loop/with targets) — the values a
+    closure at ``node`` could capture per call, as opposed to
+    module-level constants."""
+    names: Set[str] = set()
+    cur = ctx.enclosing_function(node)
+    while cur is not None:
+        a = cur.args
+        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            names.add(p.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        if not isinstance(cur, ast.Lambda):
+            for sub in _own_scope_nodes(cur):
+                targets: List[ast.AST] = []
+                if isinstance(sub, ast.Assign):
+                    targets = list(sub.targets)
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign,
+                                      ast.For)):
+                    targets = [sub.target]
+                elif isinstance(sub, ast.withitem) and \
+                        sub.optional_vars is not None:
+                    targets = [sub.optional_vars]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            names.add(n.id)
+        cur = ctx.enclosing_function(cur)
+    return names
+
+
 @register
 class RecompileHazardRule(Rule):
     code = "SPMD103"
     name = "recompile-hazard"
     summary = ("f-string/.format on traced values inside jitted bodies; "
-               "structure-varying containers passed to jitted callables")
+               "structure-varying containers passed to jitted callables; "
+               "Pallas BlockSpec index-map closures over per-call values")
     hint = ("traced values cannot be formatted (concretization error, or "
             "a retrace per shape via `.shape` interpolation) — format "
             "outside the traced function, e.g. in the caller or via "
             "jax.debug.print; containers built by comprehension change "
             "their pytree STRUCTURE with the data, and structure is part "
             "of the jit cache key — pad to a fixed layout or bucket it "
-            "(see serving/admission.py)")
+            "(see serving/admission.py); a BlockSpec index map that "
+            "closes over an enclosing function's local bakes that value "
+            "into the kernel trace — every distinct value is a NEW "
+            "compiled kernel; pass per-call offsets as operands "
+            "(scalar prefetch) or fold them into the grid "
+            "(see ops/decode_attention.py for the closure-free pattern)")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         # (a) formatting on traced values inside traced bodies
@@ -459,6 +516,44 @@ class RecompileHazardRule(Rule):
                             f".format() on traced value `{offs[0].id}` "
                             f"inside a body traced via {tf.via}",
                             hint=self.hint)
+
+        # (c) Pallas BlockSpec index maps that close over per-call
+        # values: the index map is traced into the kernel's program, so
+        # a captured enclosing-scope local (a per-request offset, a
+        # data-derived start) keys a NEW pallas compile per distinct
+        # value. Index maps should be pure functions of the grid
+        # indices; per-call data belongs in operands. (Module-level
+        # constants and the lambda's own params are fine — only names
+        # bound in an enclosing function scope fire.)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    ctx.qualname(node.func) not in _BLOCKSPEC_QUALNAMES:
+                continue
+            im = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "index_map":
+                    im = kw.value
+            if not isinstance(im, ast.Lambda):
+                continue
+            a = im.args
+            own = {p.arg for p in
+                   list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)}
+            if a.vararg:
+                own.add(a.vararg.arg)
+            if a.kwarg:
+                own.add(a.kwarg.arg)
+            outer = _scope_local_names(ctx, im)
+            for n in ast.walk(im.body):
+                if isinstance(n, ast.Name) and n.id not in own and \
+                        n.id in outer:
+                    yield ctx.finding(
+                        im, self.code,
+                        f"BlockSpec index map closes over enclosing-"
+                        f"scope value `{n.id}` — the closure is baked "
+                        f"into the kernel trace, so every distinct "
+                        f"value compiles a new pallas program",
+                        hint=self.hint)
+                    break
 
         # (b) structure-varying container literally built at the call
         # site of a known-jitted callable

@@ -440,14 +440,17 @@ def tp_param_specs(model: Sequential, model_axis: str = "model"):
 
 def serving_carry_specs(model: Sequential, sampling: bool = False,
                         data_axis: str = "data",
-                        model_axis: Optional[str] = None):
+                        model_axis: Optional[str] = None,
+                        kv_quant: bool = False):
     """``PartitionSpec`` tree for a :func:`make_batch_decode_step` carry:
     every leaf's slot axis over ``data_axis``, and (when ``model_axis``
     is given) the per-layer K/V head axis over ``model_axis``. Specs
     deliberately carry NO trailing ``None`` dims — ``P("data")`` and
     ``P("data", None, ...)`` hash differently on some jax generations,
     and mixing the two spellings between placement and step output would
-    double-compile the one serving program."""
+    double-compile the one serving program. ``kv_quant`` adds the int8
+    path's ``(N, heads)`` dequant-scale leaves — their head axis shards
+    over ``model_axis`` alongside the heads they scale."""
     from jax.sharding import PartitionSpec as P
 
     model._ensure_params()
@@ -456,14 +459,103 @@ def serving_carry_specs(model: Sequential, sampling: bool = False,
     specs = {"pos": P(data_axis)}
     kv = P(data_axis) if model_axis is None \
         else P(data_axis, None, model_axis)
+    ks = P(data_axis) if model_axis is None \
+        else P(data_axis, model_axis)
     for i in range(len(blocks)):
         specs[f"k{i}"] = kv
         specs[f"v{i}"] = kv
+        if kv_quant:
+            specs[f"k{i}_scale"] = ks
+            specs[f"v{i}_scale"] = ks
     if sampling:
         specs["rng"] = P(data_axis)
         specs["tok_counts"] = P(data_axis)
         specs["prompt_mask"] = P(data_axis)
     return specs
+
+
+# Over-provision a growing scale by this factor. A requantization
+# (round(q * s_old / s_new) over the whole stored row) costs up to half
+# a quantum of FRESH rounding error each time it runs, and without
+# headroom a stationary K/V stream grows its running max ~log(n) times
+# over a rollout — stored values accumulate several quanta of drift.
+# With headroom, one growth jumps PAST the running max, so follow-up
+# maxima land inside the provisioned range and requants become rare
+# (~1 per 1.25x growth of the true max); the price is that values use
+# 127/1.25 ~ 101 int8 levels instead of 127 (error 0.39% -> 0.49% of
+# amax). Net on the serving parity scan: flipped-argmax rollouts drop,
+# and decode steps skip most requant work.
+_KV_SCALE_HEADROOM = 1.25
+
+
+def _kv_quant_merge(qc, s_old, amax_new):
+    """Grow-only per-(row, head) scale merge for the int8 KV cache —
+    THE one copy of the quantized-write rule (decode step, batched
+    prefill, and per-request prefill all route through here).
+
+    ``qc``: stored int8 cache ``(R, L, H, D)``; ``s_old``: current
+    ``(R, H)`` fp32 scales; ``amax_new``: ``(R, H)`` max |new values|
+    about to be written (0 for rows that write nothing — their scale
+    and stored values pass through BITWISE: their scale does not grow,
+    so the ratio is exactly 1.0 and ``round(q * 1.0)`` is the identity
+    on int8 values).
+
+    Returns ``(requantized qc, s_new, s_safe)``: when ``amax_new / 127``
+    exceeds the stored scale, the scale jumps to ``_KV_SCALE_HEADROOM``
+    times that (see the constant's comment — headroom makes growth
+    rare), and already-stored values are requantized to it
+    (``round(q * s_old / s_new)`` — one extra rounding, bounded by half
+    a quantum of the NEW scale; scales only ever grow, so the ratio is
+    ≤ 1 and the result stays in int8 range). ``s_safe`` substitutes 1.0
+    for still-zero scales so dividing by it is always defined."""
+    import jax.numpy as jnp
+
+    s_cand = amax_new / 127.0
+    s_new = jnp.where(s_cand > s_old, s_cand * _KV_SCALE_HEADROOM, s_old)
+    s_safe = jnp.where(s_new > 0, s_new, 1.0)
+    ratio = jnp.where(s_new > 0, s_old / s_safe, 1.0)
+    qc2 = jnp.round(qc.astype(jnp.float32) * ratio[:, None, :, None]
+                    ).astype(jnp.int8)
+    return qc2, s_new, s_safe
+
+
+def _kv_quant_merge_step(kc, vc, ks_old, vs_old, k_amax, v_amax):
+    """Decode-step spelling of the grow-only merge: the full-cache
+    requantization is a read-modify-write over every stored K/V byte,
+    which would triple the decode step's HBM traffic if it ran
+    unconditionally — the exact traffic the int8 cache exists to halve.
+    So it runs under ONE ``lax.cond`` per layer: on the common
+    no-growth step (headroom makes growth rare — see
+    ``_KV_SCALE_HEADROOM``) the cond's identity branch passes the
+    caches through and the step touches no cache bytes beyond the
+    attention read and the one written column. Numerics are identical
+    to the unconditional merge: non-growing (row, head) entries have
+    ratio exactly 1.0 and requantize bitwise, so skipping them is
+    exact."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    grew = (jnp.any(k_amax / 127.0 > ks_old) |
+            jnp.any(v_amax / 127.0 > vs_old))
+
+    def _grow(args):
+        kc, vc, ks_old, vs_old = args
+        kc2, ks, _ = _kv_quant_merge(kc, ks_old, k_amax)
+        vc2, vs, _ = _kv_quant_merge(vc, vs_old, v_amax)
+        return kc2, vc2, ks, vs
+
+    kc, vc, ks, vs = lax.cond(grew, _grow, lambda args: args,
+                              (kc, vc, ks_old, vs_old))
+    ks_safe = jnp.where(ks > 0, ks, 1.0)
+    vs_safe = jnp.where(vs > 0, vs, 1.0)
+    return kc, vc, ks, vs, ks_safe, vs_safe
+
+
+def _kv_quantize(x32, s_safe):
+    """fp32 values → int8 at the given (broadcastable) safe scale."""
+    import jax.numpy as jnp
+
+    return jnp.clip(jnp.round(x32 / s_safe), -127, 127).astype(jnp.int8)
 
 
 def _serving_proj(p, x):
@@ -484,7 +576,8 @@ def _serving_proj(p, x):
     return jnp.matmul(x, p["weight"].T) + p["bias"]
 
 
-def make_prefill_step(model: Sequential, compute_dtype=None):
+def make_prefill_step(model: Sequential, compute_dtype=None,
+                      kv_quant: bool = False):
     """ONE-pass prompt ingestion for the KV-cached decoder (the serving
     "prefill" phase). Returns ``prefill(params, tokens, carry) ->
     (logprobs_last, carry)``:
@@ -510,7 +603,13 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
     steps, each of which re-reads every weight: at 137M/P=128 that is
     ~74 ms of weight traffic vs one ~6 ms forward (measured in
     benchmarks/decode_bench.py). ``params`` follows the same runtime-
-    argument convention as the decode step (``serving_params``)."""
+    argument convention as the decode step (``serving_params``).
+
+    ``kv_quant=True`` writes the cache int8 with (row, head) scales —
+    the fresh-carry contract makes this the degenerate one-shot case of
+    the grow-only merge (old scale is 0, so the written chunk's amax IS
+    the scale) — and runs the prompt's own attention over the
+    dequantized values, mirroring :func:`make_batch_prefill_step`."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -561,10 +660,42 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
             q = _serving_proj(ap["wq"], h).reshape(B, P, heads, hd)
             k = _serving_proj(ap["wk"], h).reshape(B, P, heads, hd)
             v = _serving_proj(ap["wv"], h).reshape(B, P, heads, hd)
-            new_carry[f"k{i}"] = lax.dynamic_update_slice_in_dim(
-                new_carry[f"k{i}"], k.astype(cache_dtype), 0, 1)
-            new_carry[f"v{i}"] = lax.dynamic_update_slice_in_dim(
-                new_carry[f"v{i}"], v.astype(cache_dtype), 0, 1)
+            if kv_quant:
+                # fresh carry (pos 0, scale 0): the degenerate one-shot
+                # case of the grow-only merge — s_old is 0, so the
+                # chunk's amax sets the scale (headroom included) and
+                # the "requantized" zero cache passes through as zeros.
+                # Routing through _kv_quant_merge keeps THE one copy of
+                # the write rule honest.
+                k32, v32 = k.astype(jnp.float32), v.astype(jnp.float32)
+                kc_rq, ks, ks_safe = _kv_quant_merge(
+                    new_carry[f"k{i}"], new_carry[f"k{i}_scale"],
+                    jnp.max(jnp.abs(k32), axis=(1, 3)))
+                vc_rq, vs, vs_safe = _kv_quant_merge(
+                    new_carry[f"v{i}"], new_carry[f"v{i}_scale"],
+                    jnp.max(jnp.abs(v32), axis=(1, 3)))
+                kq = _kv_quantize(k32, ks_safe[:, None, :, None])
+                vq = _kv_quantize(v32, vs_safe[:, None, :, None])
+                # write into the REQUANTIZED cache (zeros requantize to
+                # zeros on the fresh-carry contract, so this is free
+                # here — but discarding kc_rq would silently corrupt any
+                # future warm-carry caller the pos guard can't see,
+                # e.g. under an outer trace)
+                new_carry[f"k{i}"] = lax.dynamic_update_slice_in_dim(
+                    kc_rq, kq, 0, 1)
+                new_carry[f"v{i}"] = lax.dynamic_update_slice_in_dim(
+                    vc_rq, vq, 0, 1)
+                new_carry[f"k{i}_scale"] = ks
+                new_carry[f"v{i}_scale"] = vs
+                # attend over the dequantized values decode will read
+                k = kq.astype(jnp.float32) * ks_safe[:, None, :, None]
+                v = vq.astype(jnp.float32) * vs_safe[:, None, :, None]
+                q = q.astype(jnp.float32)
+            else:
+                new_carry[f"k{i}"] = lax.dynamic_update_slice_in_dim(
+                    new_carry[f"k{i}"], k.astype(cache_dtype), 0, 1)
+                new_carry[f"v{i}"] = lax.dynamic_update_slice_in_dim(
+                    new_carry[f"v{i}"], v.astype(cache_dtype), 0, 1)
             # dense causal attention over the prompt (P is prompt-sized;
             # scores accumulate fp32 like the decode step)
             s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k,
@@ -610,7 +741,8 @@ def make_prefill_step(model: Sequential, compute_dtype=None):
 def make_batch_prefill_step(model: Sequential, compute_dtype=None,
                             mesh=None, data_axis: str = "data",
                             model_axis: str = "model",
-                            carry_sampling: bool = False):
+                            carry_sampling: bool = False,
+                            kv_quant: bool = False):
     """MASKED multi-row prompt ingestion: one compiled program prefills a
     whole RAGGED batch of prompts (the admission path of
     ``bigdl_tpu.serving`` — see ``serving/admission.py``). Returns
@@ -662,7 +794,16 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
     tokens/lengths/carry rows stay REPLICATED over ``data_axis`` —
     prefill rows are few and short-lived, so sharding them would buy
     little and break the B=1 prefix-cache path. The returned carry's
-    K/V are head-sharded, matching the sharded pool's decode layout."""
+    K/V are head-sharded, matching the sharded pool's decode layout.
+
+    ``kv_quant=True`` matches the int8 decode carry
+    (:func:`make_batch_decode_step` with the same knob): written K/V
+    quantize through the grow-only (row, head) scale merge — a suffix
+    continuation over a quantized cached prefix requantizes the prefix
+    when the suffix raises the scale — and the prompt's own attention
+    reads the DEQUANTIZED cache, so prefill scores see exactly the
+    values decode will (ballast rows still pass through bitwise:
+    zero-length rows have amax 0 and their scatter drops)."""
     import jax
     import jax.numpy as jnp
 
@@ -708,22 +849,54 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
             q = _proj(ap["wq"], h).reshape(B, L, heads_l, hd)
             k = _proj(ap["wk"], h).reshape(B, L, heads_l, hd)
             v = _proj(ap["wv"], h).reshape(B, L, heads_l, hd)
-            kc = new_carry[f"k{i}"].at[rows[:, None], widx].set(
-                k.astype(cache_dtype), mode="drop")
-            vc = new_carry[f"v{i}"].at[rows[:, None], widx].set(
-                v.astype(cache_dtype), mode="drop")
+            if kv_quant:
+                # int8 storage: per-(row, head) amax over the VALID
+                # columns only (pad columns must not inflate the scale),
+                # grow-only merge with the cached prefix's scale, then
+                # the same dropped-index masked scatter
+                k32 = k.astype(jnp.float32)
+                v32 = v.astype(jnp.float32)
+                inbf = inb[:, :, None, None]
+                k_amax = jnp.max(jnp.abs(k32) * inbf, axis=(1, 3))
+                v_amax = jnp.max(jnp.abs(v32) * inbf, axis=(1, 3))
+                kc_rq, ks_new, ks_safe = _kv_quant_merge(
+                    new_carry[f"k{i}"], new_carry[f"k{i}_scale"], k_amax)
+                vc_rq, vs_new, vs_safe = _kv_quant_merge(
+                    new_carry[f"v{i}"], new_carry[f"v{i}_scale"], v_amax)
+                kc = kc_rq.at[rows[:, None], widx].set(
+                    _kv_quantize(k32, ks_safe[:, None, :, None]),
+                    mode="drop")
+                vc = vc_rq.at[rows[:, None], widx].set(
+                    _kv_quantize(v32, vs_safe[:, None, :, None]),
+                    mode="drop")
+                new_carry[f"k{i}_scale"] = ks_new
+                new_carry[f"v{i}_scale"] = vs_new
+                # the prompt attends over the DEQUANTIZED cache — the
+                # values decode-time reads will see, so prefill and
+                # decode stay one consistent numerics story
+                katt = kc.astype(jnp.float32) * ks_new[:, None, :, None]
+                vatt = vc.astype(jnp.float32) * vs_new[:, None, :, None]
+                qatt = (q * scale).astype(jnp.float32)
+                p_dt = jnp.float32
+            else:
+                kc = new_carry[f"k{i}"].at[rows[:, None], widx].set(
+                    k.astype(cache_dtype), mode="drop")
+                vc = new_carry[f"v{i}"].at[rows[:, None], widx].set(
+                    v.astype(cache_dtype), mode="drop")
+                katt, vatt = kc, vc
+                qatt = (q * scale).astype(cache_dtype)
+                p_dt = cache_dtype
             new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
             # queries attend over the row's FULL cache window (cached
             # prefix + this chunk) under an absolute causal mask; scores
             # accumulate fp32 regardless of the serving dtype
-            s = jnp.einsum("blhd,bmhd->bhlm",
-                           (q * scale).astype(cache_dtype), kc,
+            s = jnp.einsum("blhd,bmhd->bhlm", qatt, katt,
                            preferred_element_type=jnp.float32)
             valid = (jnp.arange(max_len)[None, None, None, :]
                      <= qpos[:, None, :, None])
             s = jnp.where(valid, s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("bhlm,bmhd->blhd", p.astype(cache_dtype), vc,
+            ctx = jnp.einsum("bhlm,bmhd->blhd", p.astype(p_dt), vatt,
                              preferred_element_type=jnp.float32
                              ).astype(x.dtype).reshape(B, L, heads_l * hd)
             if mesh is None:
@@ -755,6 +928,10 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
         for i in range(len(blocks0)):
             cspecs[f"k{i}"] = kv
             cspecs[f"v{i}"] = kv
+            if kv_quant:
+                # (B, heads) dequant scales shard with their heads
+                cspecs[f"k{i}_scale"] = P(None, model_axis)
+                cspecs[f"v{i}_scale"] = P(None, model_axis)
         if carry_sampling:
             # a sampling-enabled pool's zero carry rides through prefill
             # untouched — but shard_map's spec tree must still name
@@ -978,7 +1155,8 @@ def _check_tp_divisibility(model: Sequential, heads: int, tp: int) -> None:
 def make_batch_decode_step(model: Sequential, compute_dtype=None,
                            sampling: bool = False, mesh=None,
                            data_axis: str = "data",
-                           model_axis: str = "model"):
+                           model_axis: str = "model",
+                           kv_quant: bool = False):
     """Per-ROW-position decode step for continuous batching
     (``bigdl_tpu.serving``): every cache row advances independently, so
     one pooled carry can hold many requests at different depths and rows
@@ -1051,6 +1229,23 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     unsharded step to round-off (slot-data-parallel-only meshes skip
     shard_map entirely and stay bitwise identical; pinned by
     tests/test_serving_sharded.py).
+
+    ``kv_quant=True`` stores the per-layer K/V caches as INT8 with one
+    fp32 scale per (slot, head) (carry keys ``k{i}_scale``/
+    ``v{i}_scale``, shape ``(N, heads)`` — ~overhead-free next to the
+    halved cache payload). Writes quantize through the grow-only scale
+    merge (:func:`_kv_quant_merge`: a slot's scale only ever grows;
+    stored values are requantized on growth, and rows that write
+    nothing — inactive rows — pass through bitwise, preserving the
+    ballast contract above). The attention read routes through
+    :func:`bigdl_tpu.ops.decode_attention.decode_attention` with the
+    dequantization FUSED into the K/V load (the Pallas pooled decode
+    kernel on TPU, its jnp reference elsewhere — scales factor out of
+    both contractions exactly, so int8 bytes are what cross HBM).
+    Quantization is an engine-level storage choice, not per-row state:
+    a ``kv_quant`` step is still ONE compiled program for every
+    traffic mix, same as the float step (pinned by
+    tests/test_serving_kv_quant.py).
     """
     import jax
     import jax.numpy as jnp
@@ -1078,11 +1273,19 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
 
     def init_carry(n_slots: int):
         carry = {"pos": jnp.zeros((n_slots,), jnp.int32)}
+        kv_dt = jnp.int8 if kv_quant else cache_dtype
         for i in range(len(blocks0)):
             carry[f"k{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
-                                       cache_dtype)
+                                       kv_dt)
             carry[f"v{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
-                                       cache_dtype)
+                                       kv_dt)
+            if kv_quant:
+                # per-(slot, head) dequant scales; 0 = "no scale yet"
+                # (fresh rows — the first write establishes it)
+                carry[f"k{i}_scale"] = jnp.zeros((n_slots, heads),
+                                                 jnp.float32)
+                carry[f"v{i}_scale"] = jnp.zeros((n_slots, heads),
+                                                 jnp.float32)
         if sampling:
             # per-row sampling state: RNG lanes + penalty counters (the
             # engine seeds rows at admission — KVPool.write_sampling)
@@ -1115,28 +1318,61 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
             q = _proj(ap["wq"], h).reshape(n, heads_l, hd)
             k_new = _proj(ap["wk"], h).reshape(n, heads_l, hd)
             v_new = _proj(ap["wv"], h).reshape(n, heads_l, hd)
+            kc_prev, vc_prev = new_carry[f"k{i}"], new_carry[f"v{i}"]
+            if kv_quant:
+                # int8 storage: grow-only (slot, head) scale merge, then
+                # the same masked scatter contract — inactive rows have
+                # amax 0, so their scale, stored values, and the
+                # written-back old value are all bitwise untouched
+                k32 = k_new.astype(jnp.float32)
+                v32 = v_new.astype(jnp.float32)
+                k_amax = jnp.where(active[:, None],
+                                   jnp.max(jnp.abs(k32), axis=-1), 0.0)
+                v_amax = jnp.where(active[:, None],
+                                   jnp.max(jnp.abs(v32), axis=-1), 0.0)
+                (kc_prev, vc_prev, ks_new, vs_new, ks_safe,
+                 vs_safe) = _kv_quant_merge_step(
+                    kc_prev, vc_prev, new_carry[f"k{i}_scale"],
+                    new_carry[f"v{i}_scale"], k_amax, v_amax)
+                k_wr0 = _kv_quantize(k32, ks_safe[..., None])
+                v_wr0 = _kv_quantize(v32, vs_safe[..., None])
+                new_carry[f"k{i}_scale"] = ks_new
+                new_carry[f"v{i}_scale"] = vs_new
+            else:
+                k_wr0 = k_new.astype(cache_dtype)
+                v_wr0 = v_new.astype(cache_dtype)
             # masked per-row scatter: inactive rows write their OLD value
             # back, so their cache stays bitwise identical
-            kc_prev, vc_prev = new_carry[f"k{i}"], new_carry[f"v{i}"]
             k_old, v_old = kc_prev[rows, wpos], vc_prev[rows, wpos]
-            k_wr = jnp.where(active[:, None, None],
-                             k_new.astype(cache_dtype), k_old)
-            v_wr = jnp.where(active[:, None, None],
-                             v_new.astype(cache_dtype), v_old)
+            k_wr = jnp.where(active[:, None, None], k_wr0, k_old)
+            v_wr = jnp.where(active[:, None, None], v_wr0, v_old)
             kc = kc_prev.at[rows, wpos].set(k_wr)
             vc = vc_prev.at[rows, wpos].set(v_wr)
             new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
-            # per-row causal mask over the row's own cache prefix; scores
-            # accumulate fp32 regardless of the serving dtype
-            s = jnp.einsum("nhd,nlhd->nhl",
-                           (q * scale).astype(cache_dtype), kc,
-                           preferred_element_type=jnp.float32)
-            valid = jnp.arange(max_len)[None, None, :] <= wpos[:, None, None]
-            s = jnp.where(valid, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            ctx = jnp.einsum("nhl,nlhd->nhd", p.astype(cache_dtype), vc,
-                             preferred_element_type=jnp.float32
-                             ).astype(x.dtype).reshape(n, heads_l * hd)
+            if kv_quant:
+                # attention via the pooled decode op: Pallas kernel on
+                # TPU (int8 K/V loads, dequant fused as two scalar
+                # factors), jnp reference elsewhere — per-row masked
+                # single-query attention over cols 0..wpos[r]
+                from bigdl_tpu.ops.decode_attention import decode_attention
+
+                ctx = decode_attention(
+                    q, kc, vc, wpos, k_scale=ks_new, v_scale=vs_new,
+                    scale=scale, out_dtype=x.dtype
+                ).reshape(n, heads_l * hd)
+            else:
+                # per-row causal mask over the row's own cache prefix;
+                # scores accumulate fp32 regardless of the serving dtype
+                s = jnp.einsum("nhd,nlhd->nhl",
+                               (q * scale).astype(cache_dtype), kc,
+                               preferred_element_type=jnp.float32)
+                valid = jnp.arange(max_len)[None, None, :] \
+                    <= wpos[:, None, None]
+                s = jnp.where(valid, s, -1e30)
+                p = jax.nn.softmax(s, axis=-1)
+                ctx = jnp.einsum("nhl,nlhd->nhd", p.astype(cache_dtype),
+                                 vc, preferred_element_type=jnp.float32
+                                 ).astype(x.dtype).reshape(n, heads_l * hd)
             if mesh is None:
                 x = x + _proj(ap["wo"], ctx)
             else:
@@ -1193,7 +1429,8 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
         pspecs = tp_param_specs(model, model_axis)
         cspecs = serving_carry_specs(model, sampling=sampling,
                                      data_axis=data_axis,
-                                     model_axis=model_axis)
+                                     model_axis=model_axis,
+                                     kv_quant=kv_quant)
         row = P(data_axis)
         if sampling:
             in_specs = (pspecs, row, row, cspecs,
@@ -1262,47 +1499,59 @@ def get_decode_step(model: Sequential, compute_dtype=None):
                        lambda: make_decode_step(model, compute_dtype))
 
 
-def get_prefill_step(model: Sequential, compute_dtype=None):
+def get_prefill_step(model: Sequential, compute_dtype=None,
+                     kv_quant: bool = False):
     """Cached :func:`make_prefill_step` (one wrapper; jit re-traces per
-    prompt-length bucket internally and caches each compilation)."""
+    prompt-length bucket internally and caches each compilation).
+    ``kv_quant`` selects the int8-KV-writing variant (own cache
+    entry — the carries have different structures)."""
     return _step_cache(model, "prefill", compute_dtype,
-                       lambda: make_prefill_step(model, compute_dtype))
+                       lambda: make_prefill_step(model, compute_dtype,
+                                                 kv_quant=kv_quant),
+                       extra="int8" if kv_quant else None)
 
 
 def get_batch_decode_step(model: Sequential, compute_dtype=None,
                           sampling: bool = False, mesh=None,
                           data_axis: str = "data",
-                          model_axis: str = "model"):
+                          model_axis: str = "model",
+                          kv_quant: bool = False):
     """Cached :func:`make_batch_decode_step` (the serving engine's step).
     ``sampling=True`` selects the sampled-epilogue variant (its own
     cache entry — the two steps have different signatures/carries);
     ``mesh`` selects the shard_map-lowered tensor-parallel variant
-    (cached per mesh — see :func:`make_batch_decode_step`)."""
+    (cached per mesh); ``kv_quant`` the int8-KV variant (own entry —
+    different carry structure). See :func:`make_batch_decode_step`."""
     kind = "batch_decode_sample" if sampling else "batch_decode"
-    extra = None if mesh is None else (mesh, data_axis, model_axis)
+    extra = ("int8" if kv_quant else None,
+             None if mesh is None else (mesh, data_axis, model_axis))
     return _step_cache(model, kind, compute_dtype,
                        lambda: make_batch_decode_step(
                            model, compute_dtype, sampling=sampling,
                            mesh=mesh, data_axis=data_axis,
-                           model_axis=model_axis),
+                           model_axis=model_axis, kv_quant=kv_quant),
                        extra=extra)
 
 
 def get_batch_prefill_step(model: Sequential, compute_dtype=None,
                            mesh=None, data_axis: str = "data",
                            model_axis: str = "model",
-                           carry_sampling: bool = False):
+                           carry_sampling: bool = False,
+                           kv_quant: bool = False):
     """Cached :func:`make_batch_prefill_step` (the batched-admission
     prefill; one wrapper whose jit re-traces per (B, L) bucket).
     ``mesh``/``carry_sampling`` select the shard_map-lowered tensor-
-    parallel variant (cached per mesh + carry layout)."""
-    extra = None if mesh is None else (mesh, data_axis, model_axis,
-                                       carry_sampling)
+    parallel variant (cached per mesh + carry layout); ``kv_quant``
+    the int8-KV-writing variant."""
+    extra = ("int8" if kv_quant else None,
+             None if mesh is None else (mesh, data_axis, model_axis,
+                                        carry_sampling))
     return _step_cache(model, "batch_prefill", compute_dtype,
                        lambda: make_batch_prefill_step(
                            model, compute_dtype, mesh=mesh,
                            data_axis=data_axis, model_axis=model_axis,
-                           carry_sampling=carry_sampling),
+                           carry_sampling=carry_sampling,
+                           kv_quant=kv_quant),
                        extra=extra)
 
 

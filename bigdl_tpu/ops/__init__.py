@@ -5,11 +5,17 @@ backends (MKL/MKL-DNN JNI) provide fast kernels under the generic layer
 API. On TPU, XLA covers that role for gemms/convs; this package holds the
 Pallas kernels for the ops XLA doesn't schedule optimally — flash
 attention (fused online-softmax attention, linear memory in sequence
-length) and the fused BN→ReLU→1×1-conv training edge (prologue fusion XLA
-cannot do across a batch-stats barrier).
+length), pooled decode attention (the serving engine's memory-bound
+single-query inner loop, with fused int8-KV dequantization), and the
+fused BN→ReLU→1×1-conv training edge (prologue fusion XLA cannot do
+across a batch-stats barrier).
 """
 
+from bigdl_tpu.ops.decode_attention import (
+    decode_attention, decode_attention_reference, pooled_decode_attention,
+)
 from bigdl_tpu.ops.flash_attention import flash_attention
 from bigdl_tpu.ops.fused_conv import bn_relu_conv1x1
 
-__all__ = ["flash_attention", "bn_relu_conv1x1"]
+__all__ = ["flash_attention", "bn_relu_conv1x1", "decode_attention",
+           "decode_attention_reference", "pooled_decode_attention"]

@@ -1,0 +1,312 @@
+"""Pooled decode attention as a Pallas TPU kernel (+ jnp reference).
+
+The serving engine's decode step is memory-bandwidth-bound: every token
+re-reads the whole pooled KV cache ``(n_slots, max_len, heads, head_dim)``
+to score ONE query per row. This module owns that inner loop:
+
+* :func:`decode_attention_reference` — the jnp spelling (the math the
+  engine's inline decode path computes): masked single-query attention
+  over each row's own cache prefix ``0..pos[r]``, fp32 score/softmax
+  accumulation;
+* :func:`pooled_decode_attention` — the Pallas kernel (grid
+  ``(n_rows, heads, kv_blocks)``, online softmax in VMEM scratch, one
+  ``(block_l, head_dim)`` K/V tile resident per step) with the same
+  ``interpret``-mode CPU fallback pattern as ``ops.flash_attention``
+  (the dispatch probe is shared: ``utils.compat.auto_interpret``).
+
+Quantized KV (the int8 serving path — see docs/serving.md "Quantized KV
+cache"): K/V arrive as int8 with ONE fp32 scale per (row, head)
+(``k_scale``/``v_scale``, shape ``(N, H)``). Because the scale is
+constant over the positions and lanes being contracted, dequantization
+FACTORS OUT of both matmuls exactly —
+
+    scores[n,h,l] = (q . k_int8) * (qk_scale * k_scale[n,h])
+    out[n,h,d]    = (p . v_int8) * v_scale[n,h]
+
+so the kernel's K/V loads stay int8 end-to-end (half the HBM traffic of
+bf16) and the dequant costs two scalar multiplies per (row, head), not
+an elementwise pass over the cache. The reference computes the
+identically-factored expression, so interpret-mode numerics match to
+float round-off (pinned by tests/test_decode_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from bigdl_tpu.ops.flash_attention import _out_struct
+
+_NEG_INF = -1e30  # finite sentinel, same convention as flash/decode steps
+
+
+def _auto_interpret() -> bool:
+    from bigdl_tpu.utils.compat import auto_interpret
+
+    return auto_interpret()
+
+
+def _check_qkv(q, k, v, k_scale, v_scale):
+    if q.ndim != 3 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(
+            f"expected q (N, H, D) and k/v (N, L, H, D), got "
+            f"{q.shape} / {k.shape} / {v.shape}")
+    n, h, d = q.shape
+    if k.shape != v.shape or k.shape[0] != n or k.shape[2:] != (h, d):
+        raise ValueError(
+            f"k/v {k.shape}/{v.shape} do not match q {q.shape}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "quantized KV needs BOTH k_scale and v_scale (or neither)")
+    if k_scale is not None:
+        if k_scale.shape != (n, h) or v_scale.shape != (n, h):
+            raise ValueError(
+                f"per-(row, head) scales must be ({n}, {h}), got "
+                f"{k_scale.shape} / {v_scale.shape}")
+        if k.dtype != jnp.int8 or v.dtype != jnp.int8:
+            raise ValueError(
+                f"scaled K/V must be int8, got {k.dtype}/{v.dtype}")
+
+
+# --------------------------------------------------------------- reference
+
+
+def decode_attention_reference(q, k, v, pos, k_scale=None, v_scale=None,
+                               scale: Optional[float] = None,
+                               out_dtype=None):
+    """Masked single-query pooled attention, plain jnp — the numerics
+    contract the kernel is tested against AND the CPU serving path.
+
+    ``q``: (N, H, D) one query per pooled row; ``k``/``v``:
+    (N, L, H, D) per-row caches (float, or int8 with (N, H) fp32
+    ``k_scale``/``v_scale``); ``pos``: (N,) int32 — row ``r`` attends
+    over its own cache columns ``0..pos[r]`` INCLUSIVE (the decode
+    step's ``wpos``, where the new K/V was just written). Scores and
+    softmax accumulate fp32 regardless of input dtype; the int8 path
+    runs the q.k and p.v contractions on the RAW int8 values (cast to
+    f32) and applies the per-(row, head) scales as factored-out scalar
+    multiplies — exactly the kernel's fused-dequant math. Returns
+    (N, H, D) in ``out_dtype`` (default: q's dtype)."""
+    _check_qkv(q, k, v, k_scale, v_scale)
+    n, h, d = q.shape
+    L = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if out_dtype is None:
+        out_dtype = q.dtype
+    valid = jnp.arange(L)[None, None, :] <= \
+        jnp.asarray(pos, jnp.int32)[:, None, None]
+    if k_scale is not None:
+        s = jnp.einsum("nhd,nlhd->nhl", q.astype(jnp.float32),
+                       k.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        s = s * (scale * k_scale.astype(jnp.float32))[:, :, None]
+        p = jax.nn.softmax(jnp.where(valid, s, _NEG_INF), axis=-1)
+        ctx = jnp.einsum("nhl,nlhd->nhd", p, v.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        ctx = ctx * v_scale.astype(jnp.float32)[:, :, None]
+    else:
+        # dots run on the cache dtype (bf16 stays on the fast MXU path)
+        # with f32 accumulation — the flash-kernel convention
+        s = jnp.einsum("nhd,nlhd->nhl", q.astype(k.dtype), k,
+                       preferred_element_type=jnp.float32) * scale
+        p = jax.nn.softmax(jnp.where(valid, s, _NEG_INF), axis=-1)
+        ctx = jnp.einsum("nhl,nlhd->nhd", p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+    return ctx.astype(out_dtype)
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def _decode_kernel(*refs, scale, quantized, skip):
+    """Grid (N, H, n_l) — the KV-position axis is the INNER grid
+    dimension, so one (block_l, D) K tile and one V tile are
+    VMEM-resident per step and the online-softmax state carries across
+    the position blocks in scratch (the flash-forward recipe, with a
+    single query row per (n, h) program).
+
+    Quantized layout: int8 K/V tiles are loaded RAW; the (row, head)
+    scales enter as scalar factors — k_scale folds into the score
+    scaling, v_scale multiplies the accumulated context once at the
+    end (exact: both are constant over the contracted axes)."""
+    if quantized:
+        (q_ref, k_ref, v_ref, pos_ref, ks_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, pos_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    j = pl.program_id(2)
+    n_l = pl.num_programs(2)
+    bl = k_ref.shape[1]
+    pos = jnp.reshape(pos_ref[...], ())
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    def _step():
+        q = q_ref[0]                                    # (1, D)
+        k = k_ref[0, :, 0, :]                           # (BL, D)
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            ks = jnp.reshape(ks_ref[...], ())
+            s = jax.lax.dot_general(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * (scale * ks)
+        else:
+            s = jax.lax.dot_general(
+                q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+        cols = j * bl + jax.lax.broadcasted_iota(jnp.int32, (1, bl), 1)
+        s = jnp.where(cols <= pos, s, _NEG_INF)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # (1, BL) f32
+        alpha = jnp.exp(m - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+        if quantized:
+            pv = jnp.dot(p, v.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        else:
+            pv = jnp.dot(p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    if skip:
+        # compiled path: key blocks entirely past the row's pos
+        # contribute nothing — skip their gemms (most of the grid when
+        # the pool is young). Interpret mode runs unconditionally: a
+        # traced pl.when predicate is rejected there under shard_map
+        # (same constraint the flash kernel documents).
+        pl.when(j * bl <= pos)(_step)
+    else:
+        _step()
+
+    @pl.when(j == n_l - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        out = acc_scr[...] / l_safe
+        if quantized:
+            out = out * jnp.reshape(vs_ref[...], ())
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _auto_block_l(L: int) -> int:
+    """KV-position tile length: the LARGEST of 512/384/256/128 that
+    divides the 128-padded cache window (VMEM holds 2 int8/bf16
+    (block, D) tiles + the (1, block) f32 score row — far under budget;
+    bigger tiles amortize grid-step overhead on the short-query decode
+    grid). Divisibility is the load-bearing part: a non-dividing block
+    forces :func:`pooled_decode_attention` to ``jnp.pad`` the K/V
+    operands, and on the per-step decode hot path that pad is a full
+    copy of the entire pooled cache — the exact HBM traffic this kernel
+    exists to avoid. Any 128-multiple window (every real serving
+    ``max_len``) gets pad 0 here; only sub-128 or ragged windows pay
+    the (small-cache) pad."""
+    padded = ((max(L, 1) + 127) // 128) * 128
+    for b in (512, 384, 256, 128):
+        if padded % b == 0:
+            return b
+    return 128
+
+
+def pooled_decode_attention(q, k, v, pos, k_scale=None, v_scale=None,
+                            scale: Optional[float] = None,
+                            block: Optional[int] = None,
+                            interpret: Optional[bool] = None,
+                            out_dtype=None):
+    """Pallas pooled decode attention over slot-indexed KV.
+
+    Same contract as :func:`decode_attention_reference` (q ``(N, H, D)``,
+    k/v ``(N, L, H, D)`` float or int8-with-``(N, H)``-scales, per-row
+    inclusive ``pos``), computed by the tiled online-softmax kernel.
+    ``block`` is the KV-position tile length (None = auto);
+    ``interpret=None`` auto-selects Pallas interpreter mode off-TPU via
+    the shared ``utils.compat.auto_interpret`` probe. The cache window
+    is right-padded to a block multiple when needed — padded columns
+    sit beyond every row's ``pos`` and are masked like any other
+    out-of-window position."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from bigdl_tpu.utils.compat import pallas_tpu_compiler_params
+
+    _check_qkv(q, k, v, k_scale, v_scale)
+    n, h, d = q.shape
+    L = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if out_dtype is None:
+        out_dtype = q.dtype
+    if interpret is None:
+        interpret = _auto_interpret()
+    if block is None:
+        block = _auto_block_l(L)
+    quantized = k_scale is not None
+    pad = (-L) % block
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    lp = L + pad
+    pos2 = jnp.asarray(pos, jnp.int32).reshape(n, 1)
+    grid = (n, h, lp // block)
+    qblk = pl.BlockSpec((1, 1, d), lambda n_, h_, j: (n_, h_, 0))
+    kblk = pl.BlockSpec((1, block, 1, d), lambda n_, h_, j: (n_, j, h_, 0))
+    posblk = pl.BlockSpec((1, 1), lambda n_, h_, j: (n_, 0))
+    sblk = pl.BlockSpec((1, 1), lambda n_, h_, j: (n_, h_))
+    operands = [q, k, v, pos2]
+    in_specs = [qblk, kblk, kblk, posblk]
+    if quantized:
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+        in_specs += [sblk, sblk]
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale),
+                          quantized=quantized, skip=not interpret),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=qblk,
+        out_shape=_out_struct((n, h, d), out_dtype, *operands),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+        compiler_params=None if interpret else pallas_tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
+    return out
+
+
+def decode_attention(q, k, v, pos, k_scale=None, v_scale=None,
+                     scale: Optional[float] = None,
+                     block: Optional[int] = None,
+                     interpret: Optional[bool] = None,
+                     impl: str = "auto", out_dtype=None):
+    """The serving steps' dispatch point: ``impl="auto"`` runs the
+    compiled Pallas kernel on TPU and the jnp reference elsewhere
+    (interpret-mode Pallas is an emulator — correct but far too slow
+    for the CPU CI serving loop); ``"kernel"``/``"reference"`` force a
+    path (tests pin kernel-vs-reference numerics with
+    ``impl="kernel", interpret=True``)."""
+    if impl not in ("auto", "kernel", "reference"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl == "auto":
+        impl = "reference" if _auto_interpret() else "kernel"
+    if impl == "reference":
+        return decode_attention_reference(
+            q, k, v, pos, k_scale=k_scale, v_scale=v_scale, scale=scale,
+            out_dtype=out_dtype)
+    return pooled_decode_attention(
+        q, k, v, pos, k_scale=k_scale, v_scale=v_scale, scale=scale,
+        block=block, interpret=interpret, out_dtype=out_dtype)

@@ -31,7 +31,12 @@ _NEG_INF = -1e30  # finite sentinel: keeps exp() well-defined for masked rows
 
 
 def _auto_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # shared platform probe (utils.compat.auto_interpret): one dispatch
+    # decision for every Pallas kernel in ops/, so flash and the pooled
+    # decode kernel can't drift on the CPU/TPU interpret choice
+    from bigdl_tpu.utils.compat import auto_interpret
+
+    return auto_interpret()
 
 
 # ---------------------------------------------------------------- forward
@@ -305,6 +310,8 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret,
                causal_offset=None):
     from jax.experimental.pallas import tpu as pltpu
 
+    from bigdl_tpu.utils.compat import pallas_tpu_compiler_params
+
     bh, t, d = q3.shape
     tp = t + (-t) % block
     qp, kp, vp = (_pad_seq(x, block) for x in (q3, k3, v3))
@@ -331,7 +338,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret,
             pltpu.VMEM((block, 1), jnp.float32),
             pltpu.VMEM((block, d), jnp.float32),
         ],
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, off)
@@ -341,6 +348,8 @@ def _flash_fwd(q3, k3, v3, scale, causal, block, interpret,
 def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret,
                causal_offset=None):
     from jax.experimental.pallas import tpu as pltpu
+
+    from bigdl_tpu.utils.compat import pallas_tpu_compiler_params
 
     bh, t, d = q3.shape
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
@@ -365,7 +374,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret,
         out_specs=qblk(d),
         out_shape=_out_struct((bh, tp, d), q3.dtype, q3, k3, v3, off),
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, off)
@@ -384,7 +393,7 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block, interpret,
                    _out_struct((bh, kp_len, d), v3.dtype, q3, k3, v3, off)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                         pltpu.VMEM((block, d), jnp.float32)],
-        compiler_params=None if interpret else pltpu.CompilerParams(
+        compiler_params=None if interpret else pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, off)

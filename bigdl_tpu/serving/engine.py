@@ -106,7 +106,15 @@ class ServingEngine:
     (token-identical to the unsharded engine — same per-row math, SPMD-
     partitioned), attention heads + MLP hidden over ``model``
     (Megatron two-psums-per-block under ``compat.shard_map``; equal to
-    round-off). Still ONE compiled decode program per engine.
+    round-off). Still ONE compiled decode program per engine;
+    ``kv_dtype="int8"`` stores the pooled K/V caches as per-(slot,
+    head)-scaled int8 — half the KV bytes per slot, so an HBM budget
+    holds ~2x the concurrent slots — with dequantization fused into the
+    attention read (the Pallas pooled decode kernel on TPU, its jnp
+    reference on CPU; ``ops/decode_attention.py``). Greedy outputs are
+    parity-pinned against the float-KV engine and quantization adds
+    ZERO decode compiles (tests/test_serving_kv_quant.py); default
+    (None) follows ``compute_dtype``.
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
@@ -116,8 +124,10 @@ class ServingEngine:
                  prefix_cache=None,
                  keep_finished: Optional[int] = None,
                  seed: int = 0,
-                 mesh=None, parallelism=None) -> None:
+                 mesh=None, parallelism=None,
+                 kv_dtype: Optional[str] = None) -> None:
         import jax
+        import jax.numpy as jnp
 
         from bigdl_tpu.models.transformer import (
             get_batch_decode_step, get_batch_prefill_step, get_prefill_step,
@@ -137,6 +147,37 @@ class ServingEngine:
         self.model = model
         self.max_len = model.modules[1].max_len
         self.compute_dtype = compute_dtype
+        # KV storage format: None follows compute_dtype (the status quo);
+        # "int8" switches the pooled cache to the quantized layout
+        # (per-(slot, head)-scaled int8 — half the KV bytes, double the
+        # slots at equal HBM; see docs/serving.md "Quantized KV cache").
+        # Spelling out "fp32"/"bf16" is allowed but must AGREE with
+        # compute_dtype — the float cache always stores the serving
+        # dtype, and a silent disagreement would misreport capacity.
+        # normalize the dtype spelling: compute_dtype may arrive as the
+        # jnp type, a np.dtype, or a string ("bfloat16") — all serve
+        # identically, so all must classify identically here. The name
+        # must match KVPool's stored-dtype mapping for EVERY float
+        # dtype (fp16 engines serve fine and their default must keep
+        # constructing), not just the two canonical serving formats —
+        # so uncanonical dtypes keep their numpy name ("float16").
+        stored = jnp.zeros((), compute_dtype or jnp.float32).dtype.name
+        float_kv = {"float32": "fp32", "bfloat16": "bf16"}.get(stored,
+                                                               stored)
+        if kv_dtype is None:
+            kv_dtype = float_kv
+        elif kv_dtype not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown kv_dtype {kv_dtype!r} "
+                "(one of 'fp32', 'bf16', 'int8')")
+        if kv_dtype != "int8" and kv_dtype != float_kv:
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} conflicts with "
+                f"compute_dtype={compute_dtype!r} (the float KV cache "
+                f"stores the serving dtype, {float_kv!r} here) — pick "
+                "kv_dtype='int8' or drop the knob")
+        self.kv_dtype = kv_dtype
+        kv_quant = kv_dtype == "int8"
         # the sharded serving plane (serving/sharded.py): a mesh or a
         # {"data": N, "model": M} parallelism dict swaps the pooled
         # tensors onto a device mesh — slot rows shard over "data"
@@ -165,15 +206,21 @@ class ServingEngine:
         tp = self._plane is not None and self._plane.tensor_parallel
         self._step_fn, pool_init = get_batch_decode_step(
             model, compute_dtype, sampling=True,
-            mesh=self.mesh if tp else None)
+            mesh=self.mesh if tp else None, kv_quant=kv_quant)
         self._pool_init = pool_init
-        self.pool = (KVPool(pool_init, n_slots) if self._plane is None
-                     else self._plane.make_pool(model, pool_init, n_slots))
+        self.pool = (KVPool(pool_init, n_slots, kv_dtype=kv_dtype)
+                     if self._plane is None
+                     else self._plane.make_pool(model, pool_init, n_slots,
+                                                kv_quant=kv_quant,
+                                                kv_dtype=kv_dtype))
         self.scheduler = Scheduler(policy)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if self._plane is not None:
             self.metrics.set_mesh_shape(self._plane.data_shards,
                                         self._plane.model_shards)
+        # KV-format observability: bytes one slot owns + the derived
+        # effective-capacity number (slots a GiB of HBM would hold)
+        self.metrics.set_kv_format(kv_dtype, self.pool.kv_bytes_per_slot)
         self.admission = admission
         self.keep_finished = keep_finished
         self.seed = int(seed)
@@ -193,7 +240,7 @@ class ServingEngine:
             # reshard into the sharded pool through the scatter
             self._batch_prefill_fn = get_batch_prefill_step(
                 model, compute_dtype, mesh=self.mesh if tp else None,
-                carry_sampling=tp)
+                carry_sampling=tp, kv_quant=kv_quant)
             # True -> default cache, False/None -> off, else an instance
             self.prefix_cache = (PrefixCache() if prefix_cache is True
                                  else (prefix_cache or None))
@@ -207,7 +254,8 @@ class ServingEngine:
                     "carry)")
             self.prefix_cache = None
             self.admitter = None
-            self._prefill_fn = get_prefill_step(model, compute_dtype)
+            self._prefill_fn = get_prefill_step(model, compute_dtype,
+                                                kv_quant=kv_quant)
             # ONE fresh B=1 carry for prefill, built once and reused for
             # every admission (prefill returns a new carry; jax arrays
             # are immutable, so sharing the zero input is free — at 137M

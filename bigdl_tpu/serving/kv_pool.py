@@ -17,6 +17,20 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+_FREE_RESET = None
+
+
+def _shared_free_reset():
+    """Lazily-built process-wide jitted free-reset (see
+    KVPool._make_free_reset for why it is shared)."""
+    global _FREE_RESET
+    if _FREE_RESET is None:
+        import jax
+
+        _FREE_RESET = jax.jit(KVPool._free_reset_impl,
+                              donate_argnums=(0,))
+    return _FREE_RESET
+
 
 class KVPool:
     """Fixed-capacity pooled KV cache: ``n_slots`` independent rows.
@@ -33,10 +47,24 @@ class KVPool:
     out twice without an intervening free (no aliasing), ``free`` of an
     unallocated slot raises, and after every request drains the free
     list holds all ``n_slots`` again (no leaks).
+
+    ``kv_dtype`` (``"fp32"``/``"bf16"``/``"int8"``, None = infer) is
+    the declarative storage-format knob: it must match what the carry
+    actually stores (``make_batch_decode_step``'s ``kv_quant``/
+    ``compute_dtype`` knobs decide that), and mismatches raise at
+    construction. An int8 carry brings per-(slot, head) fp32 dequant
+    scales (``k{i}_scale``/``v{i}_scale``) that ride the admission
+    scatter with their rows and reset to zero on ``free`` (scales are
+    grow-only mid-flight — a recycled slot must not inherit its
+    previous occupant's range). ``kv_bytes_per_slot`` is the per-slot
+    KV footprint in bytes (payload + scales) — the capacity
+    denominator behind the serving metrics and the kv_quant bench.
     """
 
-    def __init__(self, init_carry, n_slots: int) -> None:
+    def __init__(self, init_carry, n_slots: int,
+                 kv_dtype: Optional[str] = None) -> None:
         import jax
+        import numpy as np
 
         if n_slots <= 0:
             raise ValueError(f"n_slots must be positive, got {n_slots}")
@@ -47,8 +75,32 @@ class KVPool:
         self.n_shards = 1
         self.rows_per_shard = self.n_slots
         self.carry = init_carry(self.n_slots)
-        self.n_layers = sum(1 for k in self.carry if k.startswith("k"))
+        # k0, k1, ... — NOT k0_scale (the int8 layout's dequant scales)
+        self.n_layers = sum(1 for k in self.carry
+                            if k.startswith("k") and k[1:].isdigit())
         self.max_len = int(self.carry["k0"].shape[1])
+        self.quantized = "k0_scale" in self.carry
+        # the storage-format knob is declarative: the carry (built by
+        # make_batch_decode_step's init_carry) is the ground truth, and
+        # a mismatched claim here would mean the engine wired its knobs
+        # inconsistently — fail loudly at construction, not at serve
+        stored = np.dtype(self.carry["k0"].dtype).name
+        stored = {"float32": "fp32", "bfloat16": "bf16"}.get(stored, stored)
+        if kv_dtype is not None and kv_dtype != stored:
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r} but the carry stores K/V as "
+                f"{stored!r} — build the carry with the matching "
+                "make_batch_decode_step(kv_quant=...) knob")
+        self.kv_dtype = stored
+        # bytes of KV state ONE slot owns (int8 payload + its scales,
+        # or the float cache): the capacity denominator the kv_quant
+        # bench and serving/kv_bytes_per_slot metric report
+        import re
+
+        kv_key = re.compile(r"^[kv]\d+(_scale)?$")
+        self.kv_bytes_per_slot = int(sum(
+            v.dtype.itemsize * int(np.prod(v.shape[1:]))
+            for k, v in self.carry.items() if kv_key.match(k)))
         # LIFO free list: the most recently freed row is the most likely
         # to still be resident in cache/HBM
         self._free: List[int] = list(range(self.n_slots - 1, -1, -1))
@@ -64,11 +116,38 @@ class KVPool:
         # hook: the sharded pool pins the output shardings so scattered
         # carries keep their mesh placement.)
         self._scatter = self._make_scatter()
+        # ONE jitted, donated reset for free(): pos plus, on the int8
+        # layout, every (slot, head) dequant-scale row. Op-by-op eager
+        # .at[].set would be 1 + 2*n_layers separate device dispatches
+        # (each allocating a fresh buffer) on the request-completion hot
+        # path; the slot id is a traced scalar so the program compiles
+        # once per pool. (_make_free_reset is the subclass hook — the
+        # sharded pool pins output shardings, same as the scatter.)
+        self._reset_keys = ["pos"]
+        if self.quantized:
+            self._reset_keys += [f"{kind}{i}_scale"
+                                 for i in range(self.n_layers)
+                                 for kind in ("k", "v")]
+        self._free_reset = self._make_free_reset()
 
     def _make_scatter(self):
         import jax
 
         return jax.jit(self._scatter_impl, donate_argnums=(0,))
+
+    def _make_free_reset(self):
+        # ONE process-wide jitted wrapper (module cache): pools come and
+        # go with engines, and a per-instance jax.jit would re-trace the
+        # same-shaped reset for every new engine — inside a timed serve
+        # for benches that construct engines per pass. Shapes/dtypes key
+        # jit's own cache, so unrelated pool layouts still coexist. (The
+        # sharded subclass overrides with a per-instance wrapper — its
+        # output shardings are mesh-specific.)
+        return _shared_free_reset()
+
+    @staticmethod
+    def _free_reset_impl(leaves, slot):
+        return {k: v.at[slot].set(0) for k, v in leaves.items()}
 
     def _scatter_impl(self, carry, prefill_carry, slot, pos, row):
         from jax import lax
@@ -82,6 +161,14 @@ class KVPool:
                 ).astype(carry[key].dtype)
                 out[key] = lax.dynamic_update_slice(
                     carry[key], src, (slot, 0, 0, 0))
+                # int8 layout: the row's (1, heads) dequant scales land
+                # with it — a quantized row is meaningless without them
+                skey = f"{key}_scale"
+                if skey in carry:
+                    ssrc = lax.dynamic_slice_in_dim(
+                        prefill_carry[skey], row, 1, axis=0)
+                    out[skey] = lax.dynamic_update_slice(
+                        carry[skey], ssrc, (slot, 0))
         out["pos"] = carry["pos"].at[slot].set(pos)
         return out
 
@@ -102,11 +189,17 @@ class KVPool:
         self._free.append(slot)
         # reset the row's position so a recycled slot starts fresh; the
         # stale K/V rows are harmless (masked by pos) and zeroing them
-        # would be pure HBM traffic
+        # would be pure HBM traffic. On the int8 layout the dequant
+        # scales reset too: scales are grow-only in-step, so a recycled
+        # slot MUST drop its previous occupant's scale — a stale large
+        # scale would quantize the next request's (smaller) values
+        # coarsely for its whole lifetime. One donated jitted dispatch
+        # covers pos + all scale rows (see _make_free_reset).
         import jax.numpy as jnp
 
-        self.carry["pos"] = self.carry["pos"].at[slot].set(
-            jnp.int32(0))
+        self.carry.update(self._free_reset(
+            {k: self.carry[k] for k in self._reset_keys},
+            jnp.int32(slot)))
 
     @property
     def free_slots(self) -> int:
@@ -130,8 +223,10 @@ class KVPool:
 
     def __repr__(self) -> str:
         shards = "" if self.n_shards == 1 else f", n_shards={self.n_shards}"
+        kv = "" if not self.quantized else f", kv_dtype={self.kv_dtype}"
         return (f"{type(self).__name__}(n_slots={self.n_slots}, "
-                f"used={self.used_slots}, free={self.free_slots}{shards})")
+                f"used={self.used_slots}, free={self.free_slots}"
+                f"{shards}{kv})")
 
     # -- prefill admission -------------------------------------------------
 

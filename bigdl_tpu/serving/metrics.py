@@ -45,6 +45,14 @@ Sharded-plane counters (``serving/sharded.py``):
 * ``shard_imbalance`` — cross-shard admission imbalance in ROWS
   (max − min allocated slots across shards; 0 = perfectly balanced —
   the balanced allocator keeps it ≤ 1 under drain-style traffic)
+
+KV-format counters (``serving/kv_pool.py`` — set once at construction):
+
+* ``kv_bits``            — bits per stored K/V element (32/16/8)
+* ``kv_bytes_per_slot``  — one slot's KV footprint in bytes (int8
+  payload + per-(slot, head) scales on the quantized path)
+* ``kv_slots_per_gib``   — derived effective capacity: concurrent
+  slots per GiB of HBM at this format (the int8 path's ~2x headline)
 """
 
 from __future__ import annotations
@@ -102,6 +110,19 @@ class ServingMetrics:
         """Record the engine's mesh shape (once, at construction)."""
         self.metrics.set("serving/mesh_data_shards", float(data_shards))
         self.metrics.set("serving/mesh_model_shards", float(model_shards))
+
+    def set_kv_format(self, kv_dtype: str, bytes_per_slot: int) -> None:
+        """Record the pooled cache's storage format (once, at
+        construction): bits per stored K/V element, the per-slot KV
+        footprint in bytes (int8 payload + dequant scales, or the float
+        cache), and the derived effective capacity — concurrent slots
+        one GiB of HBM holds at this format. The capacity number is the
+        kv_quant headline: int8 runs ~2x the fp16-cache slots."""
+        bits = {"fp32": 32.0, "bf16": 16.0, "int8": 8.0}.get(kv_dtype, 0.0)
+        self.metrics.set("serving/kv_bits", bits)
+        self.metrics.set("serving/kv_bytes_per_slot", float(bytes_per_slot))
+        self.metrics.set("serving/kv_slots_per_gib",
+                         float((1 << 30) // max(int(bytes_per_slot), 1)))
 
     def on_shard_slots(self, used_per_shard, rows_per_shard: int) -> None:
         """Per-shard occupancy + cross-shard admission imbalance

@@ -181,7 +181,8 @@ class ShardedKVPool(KVPool):
     """
 
     def __init__(self, init_carry, n_slots: int, mesh, carry_specs: Dict,
-                 data_axis: str = DATA_AXIS) -> None:
+                 data_axis: str = DATA_AXIS,
+                 kv_dtype: Optional[str] = None) -> None:
         import jax
 
         n_shards = _axis_size(mesh, data_axis)
@@ -194,7 +195,7 @@ class ShardedKVPool(KVPool):
         self.data_axis = data_axis
         self._shardings = {k: named_sharding(mesh, spec)
                            for k, spec in carry_specs.items()}
-        super().__init__(init_carry, n_slots)
+        super().__init__(init_carry, n_slots, kv_dtype=kv_dtype)
         self.n_shards = n_shards
         self.rows_per_shard = self.n_slots // n_shards
         # shard the freshly-built carry (init_carry returns host-fresh
@@ -213,6 +214,16 @@ class ShardedKVPool(KVPool):
 
         return jax.jit(self._scatter_impl, donate_argnums=(0,),
                        out_shardings=self._shardings)
+
+    def _make_free_reset(self):
+        import jax
+
+        # pin the reset outputs to the carry's placements — a follower
+        # sharding with a drifted spelling would double-compile the one
+        # decode program (the PR-4 lesson)
+        return jax.jit(self._free_reset_impl, donate_argnums=(0,),
+                       out_shardings={k: self._shardings[k]
+                                      for k in self._reset_keys})
 
     # -- slot → shard routing ---------------------------------------------
 
@@ -326,18 +337,22 @@ class ShardPlane:
             params, _sharding_tree(self.mesh,
                                    tp_param_specs(model, self.model_axis)))
 
-    def carry_specs(self, model, sampling: bool = True) -> Dict:
+    def carry_specs(self, model, sampling: bool = True,
+                    kv_quant: bool = False) -> Dict:
         from bigdl_tpu.models.transformer import serving_carry_specs
 
         return serving_carry_specs(
             model, sampling=sampling, data_axis=self.data_axis,
-            model_axis=self.model_axis if self.tensor_parallel else None)
+            model_axis=self.model_axis if self.tensor_parallel else None,
+            kv_quant=kv_quant)
 
     def make_pool(self, model, pool_init, n_slots: int,
-                  sampling: bool = True) -> ShardedKVPool:
+                  sampling: bool = True, kv_quant: bool = False,
+                  kv_dtype: Optional[str] = None) -> ShardedKVPool:
         return ShardedKVPool(pool_init, n_slots, self.mesh,
-                             self.carry_specs(model, sampling=sampling),
-                             data_axis=self.data_axis)
+                             self.carry_specs(model, sampling=sampling,
+                                              kv_quant=kv_quant),
+                             data_axis=self.data_axis, kv_dtype=kv_dtype)
 
 
 class ShardedEngine:

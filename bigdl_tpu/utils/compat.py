@@ -83,6 +83,42 @@ def axis_size(axis_name: str):
     return lax.psum(1, axis_name)
 
 
+def auto_interpret() -> bool:
+    """Whether Pallas kernels should run in INTERPRET mode on this
+    backend: True anywhere but a real TPU. THE one copy of the
+    CPU-vs-TPU kernel dispatch decision — both ``ops.flash_attention``
+    and ``ops.decode_attention`` resolve their ``interpret=None``
+    default through here, so the two kernels can never drift on when
+    the compiled Mosaic path engages (tier-1 CI runs everything in
+    interpret mode on CPU; the compiled path is exercised by the
+    TPU/multichip dryrun flow)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """A Mosaic compiler-params object for ``pl.pallas_call`` — the
+    class RENAMED between jax generations (``pltpu.TPUCompilerParams``
+    on the 0.4.x line, ``pltpu.CompilerParams`` later). Callers pass
+    the fields both generations share (``dimension_semantics=...``);
+    this resolves whichever spelling the installed jax has, so the
+    compiled (non-interpret) kernel path traces on every supported
+    generation — interpret-mode CI never touches compiler params, which
+    is exactly how a pinned spelling would rot undetected."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:                               # pragma: no cover
+        import jax
+
+        raise NotImplementedError(
+            f"this jax ({jax.__version__}) has neither "
+            "pltpu.CompilerParams nor pltpu.TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def varying_axes(x):
     """The varying-manual-axes (vma) set of ``x``'s type on jax
     generations with the varying-type system (``jax.typeof`` + ``.vma``),

@@ -1,0 +1,58 @@
+"""SPMD103 fixtures: recompile hazards in Pallas BlockSpec index maps.
+
+An index map is traced into the kernel's compiled program. Closing it
+over an enclosing function's local — a per-request offset, a
+data-derived start row — bakes that VALUE into the trace, so every
+distinct value silently compiles a new pallas program (the kernel-side
+cousin of the f-string-in-jit hazard). Index maps must be pure
+functions of the grid indices; per-call data belongs in operands
+(scalar prefetch) or the grid. Module-level constants are fine: they
+cannot vary call to call.
+"""
+
+from jax.experimental import pallas as pl
+
+N_HEADS = 4  # module-level constant: capturing this cannot recompile
+
+
+def _kernel(q_ref, o_ref):
+    o_ref[...] = q_ref[...] * 2.0
+
+
+def sliced_attention(q, start, block):
+    # `start` arrives per request — every distinct value is a new
+    # compiled kernel keyed by the closure, not an argument
+    qspec = pl.BlockSpec(
+        (1, block), lambda i, j: (i, j + start))  # EXPECT: SPMD103
+    # same hazard through the index_map keyword
+    ospec = pl.BlockSpec((1, block),
+                         index_map=lambda i, j: (start, j))  # EXPECT: SPMD103
+    return pl.pallas_call(
+        _kernel, grid=(4, 4), in_specs=[qspec], out_specs=ospec,
+        out_shape=q)
+
+
+def clean_shadow_in_nested_def(q, block):
+    # a SIBLING nested function's local named like the module constant
+    # must not make the index map's `N_HEADS` look like a per-call
+    # capture — the lambda resolves the module-level name
+    def helper():
+        N_HEADS = 99  # noqa: F841 — different scope entirely
+        return N_HEADS
+
+    spec = pl.BlockSpec((1, block), lambda i, j: (i // N_HEADS, j))
+    return pl.pallas_call(_kernel, grid=(4, 4), in_specs=[spec],
+                          out_specs=spec, out_shape=q), helper
+
+
+def clean_attention(q, block):
+    # pure functions of the grid indices: nothing captured
+    qspec = pl.BlockSpec((1, block), lambda i, j: (i, j))
+    # a module-level constant is not per-call state
+    ospec = pl.BlockSpec((1, block), lambda i, j: (i // N_HEADS, j))
+    # data-derived locals in the BLOCK SHAPE are fine — shapes key the
+    # compile legitimately (a new shape IS a new program)
+    hspec = pl.BlockSpec((1, q.shape[-1]), lambda i, j: (i, 0))
+    return pl.pallas_call(
+        _kernel, grid=(4, 4), in_specs=[qspec, ospec], out_specs=hspec,
+        out_shape=q)
