@@ -13,8 +13,16 @@ jitted ``lax.scan`` (one device program — per-token host dispatch through
 the axon tunnel would otherwise dominate at ~ms/call), and report
 tokens/sec = batch * 256 / wall.
 
+``--attention`` switches to the pooled decode-attention OP bench
+(``measure_attention``): Pallas kernel vs jnp reference step wall time
+at each model's serving geometry, float and int8-quantized layouts —
+the per-step bandwidth half of the int8-KV story (PR 6 measured
+capacity; this row measures time). CPU runs execute the kernel in
+interpret mode and say so in the row; run on TPU for real numbers.
+
     PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/decode_bench.py
     ... --models 137m --batches 1 8 --variants bf16 int8   # subset
+    ... --attention --models 137m 371m --variants bf16 int8
 """
 
 from __future__ import annotations
@@ -169,6 +177,70 @@ def measure(name: str, variant: str, batch: int, reps: int = 3) -> dict:
     }
 
 
+def measure_attention(name: str, batch: int, variant: str,
+                      reps: int = 3) -> dict:
+    """Pooled decode-attention STEP wall time, Pallas kernel vs the jnp
+    reference (``ops/decode_attention.py``) at this model's serving
+    geometry — the unmeasured half of the int8-KV story: the fused
+    int8 dequant halves the bytes the kernel streams per step, and this
+    row is where that shows up as time. ``variant``: ``int8`` benches
+    the quantized layout (int8 K/V + per-(row, head) fp32 scales),
+    ``fp32``/``bf16`` the float cache. On a CPU host the "kernel" path
+    runs in Pallas INTERPRET mode (``compat.auto_interpret``) — a
+    functional dryrun whose time is emulation overhead, not kernel
+    speed; the row carries ``interpret`` so readers can tell (run on
+    TPU for the bandwidth numbers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.ops.decode_attention import decode_attention
+    from bigdl_tpu.utils.compat import auto_interpret
+
+    cfg = MODELS[name]
+    heads, hd = cfg["heads"], cfg["hidden"] // cfg["heads"]
+    L = PROMPT + GEN
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((batch, heads, hd)), jnp.float32)
+    pos = jnp.asarray(rng.integers(L // 2, L, size=(batch,)), jnp.int32)
+    if variant == "int8":
+        k = jnp.asarray(rng.integers(-127, 128,
+                                     size=(batch, L, heads, hd)), jnp.int8)
+        v = jnp.asarray(rng.integers(-127, 128,
+                                     size=(batch, L, heads, hd)), jnp.int8)
+        ks = jnp.asarray(0.02 + 0.01 * rng.random((batch, heads)),
+                         jnp.float32)
+        vs = jnp.asarray(0.02 + 0.01 * rng.random((batch, heads)),
+                         jnp.float32)
+    else:
+        dt = {"fp32": jnp.float32, "bf16": jnp.bfloat16}[variant]
+        k = jnp.asarray(rng.standard_normal((batch, L, heads, hd)), dt)
+        v = jnp.asarray(rng.standard_normal((batch, L, heads, hd)), dt)
+        ks = vs = None
+
+    def timed(impl: str) -> float:
+        fn = jax.jit(lambda *a: decode_attention(
+            *a, k_scale=ks, v_scale=vs, impl=impl))
+        jax.block_until_ready(fn(q, k, v, pos))     # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(q, k, v, pos))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    ref_s = timed("reference")
+    kern_s = timed("kernel")
+    return {
+        "metric": "decode_attention_step_ms", "model": name,
+        "variant": variant, "rows": batch, "heads": heads,
+        "head_dim": hd, "window": L,
+        "interpret": bool(auto_interpret()),
+        "reference_ms": round(1e3 * ref_s, 3),
+        "kernel_ms": round(1e3 * kern_s, 3),
+        "kernel_vs_reference": round(ref_s / max(kern_s, 1e-9), 3),
+    }
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--models", nargs="+", default=["137m", "371m"],
@@ -177,7 +249,23 @@ def main(argv=None) -> None:
     p.add_argument("--variants", nargs="+", default=["bf16", "int8"],
                    choices=["fp32", "bf16", "int8"])
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--attention", action="store_true",
+                   help="bench the pooled decode-attention op (Pallas "
+                        "kernel vs jnp reference) instead of the full "
+                        "decode loop")
     args = p.parse_args(argv)
+
+    if args.attention:
+        for name in args.models:
+            for b in args.batches:
+                for v in args.variants:
+                    try:
+                        r = measure_attention(name, b, v, args.reps)
+                    except Exception as e:
+                        r = {"model": name, "variant": v, "rows": b,
+                             "error": repr(e)[:160]}
+                    print(json.dumps(r), flush=True)
+        return
 
     rows = []
     for name in args.models:

@@ -981,6 +981,42 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
     return prefill_checked
 
 
+def _serving_init_carry(n_layers: int, max_len: int, heads: int, hd: int,
+                        cache_dtype, kv_quant: bool, sampling: bool,
+                        vocab: int):
+    """THE one pooled-carry layout: per-layer K/V rows + per-row ``pos``,
+    int8 dequant scales on the quantized layout, and the per-row
+    sampling state (RNG lanes + penalty counters — the engine seeds rows
+    at admission via ``KVPool.write_sampling``). Shared by
+    :func:`make_batch_decode_step` and :func:`make_batch_verify_step` so
+    a pool built by either hands its carry to the other unchanged (the
+    speculative engine's verify step IS its decode step)."""
+    import jax.numpy as jnp
+
+    def init_carry(n_slots: int):
+        carry = {"pos": jnp.zeros((n_slots,), jnp.int32)}
+        kv_dt = jnp.int8 if kv_quant else cache_dtype
+        for i in range(n_layers):
+            carry[f"k{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
+                                       kv_dt)
+            carry[f"v{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
+                                       kv_dt)
+            if kv_quant:
+                # per-(slot, head) dequant scales; 0 = "no scale yet"
+                # (fresh rows — the first write establishes it)
+                carry[f"k{i}_scale"] = jnp.zeros((n_slots, heads),
+                                                 jnp.float32)
+                carry[f"v{i}_scale"] = jnp.zeros((n_slots, heads),
+                                                 jnp.float32)
+        if sampling:
+            carry["rng"] = jnp.zeros((n_slots, 2), jnp.uint32)
+            carry["tok_counts"] = jnp.zeros((n_slots, vocab), jnp.int32)
+            carry["prompt_mask"] = jnp.zeros((n_slots, vocab), bool)
+        return carry
+
+    return init_carry
+
+
 def make_decode_step(model: Sequential, compute_dtype=None):
     """KV-cached incremental decoding for a trained :func:`TransformerLM`.
 
@@ -1271,28 +1307,9 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     # head slice of the (already column-parallel) QKV projections
     heads_l = heads // tp
 
-    def init_carry(n_slots: int):
-        carry = {"pos": jnp.zeros((n_slots,), jnp.int32)}
-        kv_dt = jnp.int8 if kv_quant else cache_dtype
-        for i in range(len(blocks0)):
-            carry[f"k{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
-                                       kv_dt)
-            carry[f"v{i}"] = jnp.zeros((n_slots, max_len, heads, hd),
-                                       kv_dt)
-            if kv_quant:
-                # per-(slot, head) dequant scales; 0 = "no scale yet"
-                # (fresh rows — the first write establishes it)
-                carry[f"k{i}_scale"] = jnp.zeros((n_slots, heads),
-                                                 jnp.float32)
-                carry[f"v{i}_scale"] = jnp.zeros((n_slots, heads),
-                                                 jnp.float32)
-        if sampling:
-            # per-row sampling state: RNG lanes + penalty counters (the
-            # engine seeds rows at admission — KVPool.write_sampling)
-            carry["rng"] = jnp.zeros((n_slots, 2), jnp.uint32)
-            carry["tok_counts"] = jnp.zeros((n_slots, vocab), jnp.int32)
-            carry["prompt_mask"] = jnp.zeros((n_slots, vocab), bool)
-        return carry
+    init_carry = _serving_init_carry(len(blocks0), max_len, heads, hd,
+                                     cache_dtype, kv_quant, sampling,
+                                     vocab)
 
     _proj = _serving_proj
 
@@ -1450,6 +1467,307 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     return jitted, init_carry
 
 
+def make_batch_verify_step(model: Sequential, compute_dtype=None,
+                           width: int = 4, mesh=None,
+                           data_axis: str = "data",
+                           model_axis: str = "model",
+                           kv_quant: bool = False):
+    """Speculative DRAFT-AND-VERIFY step for the serving engine
+    (``bigdl_tpu.serving.speculative``): one compiled program scores a
+    per-row CHUNK of candidate tokens against the target model and
+    advances each row by however many the target confirms — the
+    multi-token generalization of :func:`make_batch_decode_step`.
+    Structurally this is the masked multi-row prefill
+    (:func:`make_batch_prefill_step`'s per-row start offsets already
+    express "continue this row's suffix"); what is new is that EVERY
+    chunk position's next-token distribution is kept and fed through
+    the per-row sampler, not just the last one.
+
+    Returns ``(verify_fn, init_carry)``; ``init_carry`` builds exactly
+    the :func:`make_batch_decode_step` ``sampling=True`` carry (shared
+    layout — a pool built by either hands its carry to the other).
+
+    ``verify_fn(params, tokens, lengths, carry, knobs) ->
+    (tokens_out, logps_out, n_emit, carry)``:
+
+    * ``tokens``: (N, ``width``) 0-based ids — row r's column 0 is its
+      current decode input (the engine's ``next_token``), columns
+      ``1..lengths[r]-1`` are DRAFT proposals for the following
+      positions; columns at and beyond ``lengths[r]`` are pad the
+      program never uses;
+    * ``lengths``: (N,) int32, ``0 <= lengths[r] <= width`` — how many
+      chunk positions row r runs this step (``k_r`` drafts + 1).
+      ``lengths[r] == 1`` is EXACTLY the plain sampled decode step
+      (one input, one draw, one emission — a normal row in a mixed
+      speculative/normal batch costs nothing extra), and
+      ``lengths[r] == 0`` rows are pure ballast: carry bitwise
+      untouched, outputs garbage (the ``active`` convention). Per-row
+      lengths are runtime VALUES of one compiled (N, width) program —
+      traffic mix never recompiles;
+    * ``knobs``: the per-row sampling knob dict
+      (:func:`~bigdl_tpu.serving.sampling.make_knob_rows`);
+    * ``tokens_out``/``logps_out``: (N, width) — position j's token is
+      drawn by THE one per-row sampler
+      (:func:`~bigdl_tpu.serving.sampling.sample_rows`) from the
+      target's next-token distribution after chunk inputs ``0..j``,
+      with the row's RNG lane split once per position IN ORDER and
+      penalty counts updated per draw — each position computes the
+      same math the plain decode step would had the accepted prefix
+      been fed token by token. (Numerics caveat, the kv_quant
+      accuracy contract's sibling: the chunked path rounds reduced-
+      precision activations in a different order than the single-
+      token step, so at bf16 an argmax sitting on a sub-rounding
+      near-tie — untrained near-uniform logits — can flip vs the
+      baseline; fp32 parity is exact on the dev box, and the parity
+      tests pin configs with real gaps);
+    * ``n_emit``: (N,) int32 — ``1 + (leading positions whose drawn
+      token equals the NEXT chunk input)``. Acceptance is
+      sampled-token agreement: position j's draw is a valid emission
+      iff drafts ``1..j`` all matched the draws before them (so its
+      conditioning context is the true emitted stream); the first
+      mismatch position still emits — its draw came from the correct
+      conditional — and everything after it is discarded. For
+      temperature-0 rows this is standard greedy speculative
+      verification (argmax agreement), token-identical to the baseline
+      engine; for sampled rows the EMITTED stream equals the baseline
+      engine's stream draw for draw (same lane splits, same
+      conditionals — the draft only controls how many of those draws
+      land per step, never their values), which is what makes fixed
+      seeds replay across speculative/normal engines and
+      eviction/readmission. (This deliberately trades Leviathan-style
+      distribution-matching rejection sampling — which consumes
+      randomness in a draft-dependent pattern and so cannot replay the
+      baseline stream — for exact stream equality; acceptance rate is
+      then ``P(draft == the sampler's draw)``.)
+
+    The carry rollback contract: K/V for ALL ``lengths[r]`` inputs are
+    written at ``pos[r]..pos[r]+lengths[r]-1`` (the masked dropped-index
+    scatter of the batch prefill), but ``pos`` advances by only
+    ``n_emit[r]`` — positions past the accepted prefix are stale bytes
+    BEHIND ``pos``, invisible to every later step (the same masking
+    that makes recycled slots safe) and overwritten as decoding
+    proceeds. Rollback is pointer arithmetic, not a cache rewrite.
+    The RNG lane and penalty counts advance by exactly ``n_emit[r]``
+    draws for the same reason.
+
+    ``mesh``/``kv_quant`` follow :func:`make_batch_decode_step`: the
+    tensor-parallel lowering shards heads/MLP hidden over
+    ``model_axis`` with slot rows over ``data_axis`` (chunk outputs
+    replicate over the model axis like the sampled step's), and the
+    int8 cache quantizes chunk writes through the grow-only
+    (slot, head) scale merge with the chunk's own attention reading
+    the dequantized values (the batch-prefill spelling). int8 caveat:
+    the merge's amax covers the WHOLE chunk — the in-step attention
+    needs every position dequantizable before acceptance is known —
+    so a REJECTED draft can grow a row's scale one step early (bounded
+    by the merge's <= half-quantum requant error); exact
+    draft-independence is the float cache's property. Persisting an
+    accepted-only merge would need the chunk attention to read float
+    chunk K/V with the scatter deferred past acceptance — a
+    restructure noted in ROADMAP, not worth a second full-row requant
+    per step here.
+
+    Caller contract (the engine enforces it): ``pos[r] + lengths[r] <=
+    max_len`` — out-of-range columns would be silently dropped by the
+    masked scatter, exactly like :func:`make_batch_prefill_step`.
+
+    NOTE: the per-block body parallels (not shares)
+    make_batch_prefill_step's loop for the same reason the decode/
+    prefill pair documents — drift is pinned by the speculative parity
+    tests (tests/test_serving_speculative.py: greedy outputs equal the
+    baseline engine and generate()).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.misc import LookupTable
+
+    model._ensure_params()
+    mods = model.modules
+    assert isinstance(mods[0], LookupTable), "TransformerLM-shaped model"
+    if width < 1:
+        raise ValueError(f"width must be >= 1, got {width}")
+    max_len = mods[1].max_len
+    vocab = mods[0].n_index
+    off = _decode_head_offset(model)
+    lnf = mods[-2 - off]
+    _, _, blocks0, _, _ = _resolve_decode_views(model, off, model.params)
+    attn0 = blocks0[0][0].attn
+    heads, hd = attn0.n_heads, attn0.head_dim
+    scale = hd ** -0.5
+    cache_dtype = compute_dtype or jnp.float32
+    tp = 1 if mesh is None else int(mesh.shape[model_axis])
+    if mesh is not None:
+        _check_tp_divisibility(model, heads, tp)
+    heads_l = heads // tp
+    S = int(width)
+
+    init_carry = _serving_init_carry(len(blocks0), max_len, heads, hd,
+                                     cache_dtype, kv_quant, True, vocab)
+    _proj = _serving_proj
+
+    def verify(params, tokens, lengths, carry, knobs):
+        from bigdl_tpu.serving.sampling import sample_rows
+
+        Pt = _cast_keep_scales(params, compute_dtype)
+        lookup_w, pos_w, blocks, lnf_p, lin_p = \
+            _resolve_decode_views(model, off, Pt)
+        N = tokens.shape[0]
+        start = carry["pos"]                          # (N,) per-row
+        rows = jnp.arange(N)
+        qpos = start[:, None] + jnp.arange(S)[None]   # (N, S) absolute
+        inb = jnp.arange(S)[None] < lengths[:, None]  # (N, S) valid cols
+        # pad/overflow columns scatter to index max_len -> dropped
+        widx = jnp.where(inb, qpos, max_len)
+        x = jnp.take(lookup_w, jnp.clip(tokens, 0, lookup_w.shape[0] - 1),
+                     axis=0)                          # (N, S, Hid)
+        x = x + jnp.take(pos_w, jnp.clip(qpos, 0, max_len - 1), axis=0)
+        new_carry = dict(carry)
+        for i, (blk, bp) in enumerate(blocks):
+            h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
+            ap = bp[blk._child_key(1)]
+            q = _proj(ap["wq"], h).reshape(N, S, heads_l, hd)
+            k = _proj(ap["wk"], h).reshape(N, S, heads_l, hd)
+            v = _proj(ap["wv"], h).reshape(N, S, heads_l, hd)
+            if kv_quant:
+                # int8 storage: the batch-prefill spelling — valid-column
+                # amax, grow-only merge, dropped-index quantized scatter,
+                # chunk attention over the dequantized cache
+                k32 = k.astype(jnp.float32)
+                v32 = v.astype(jnp.float32)
+                inbf = inb[:, :, None, None]
+                k_amax = jnp.max(jnp.abs(k32) * inbf, axis=(1, 3))
+                v_amax = jnp.max(jnp.abs(v32) * inbf, axis=(1, 3))
+                kc_rq, ks_new, ks_safe = _kv_quant_merge(
+                    new_carry[f"k{i}"], new_carry[f"k{i}_scale"], k_amax)
+                vc_rq, vs_new, vs_safe = _kv_quant_merge(
+                    new_carry[f"v{i}"], new_carry[f"v{i}_scale"], v_amax)
+                kc = kc_rq.at[rows[:, None], widx].set(
+                    _kv_quantize(k32, ks_safe[:, None, :, None]),
+                    mode="drop")
+                vc = vc_rq.at[rows[:, None], widx].set(
+                    _kv_quantize(v32, vs_safe[:, None, :, None]),
+                    mode="drop")
+                new_carry[f"k{i}_scale"] = ks_new
+                new_carry[f"v{i}_scale"] = vs_new
+                katt = kc.astype(jnp.float32) * ks_new[:, None, :, None]
+                vatt = vc.astype(jnp.float32) * vs_new[:, None, :, None]
+                qatt = (q * scale).astype(jnp.float32)
+                p_dt = jnp.float32
+            else:
+                kc = new_carry[f"k{i}"].at[rows[:, None], widx].set(
+                    k.astype(cache_dtype), mode="drop")
+                vc = new_carry[f"v{i}"].at[rows[:, None], widx].set(
+                    v.astype(cache_dtype), mode="drop")
+                katt, vatt = kc, vc
+                qatt = (q * scale).astype(cache_dtype)
+                p_dt = cache_dtype
+            new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
+            # each chunk position attends over the row's full cache
+            # window under the absolute causal mask; fp32 accumulation
+            s = jnp.einsum("blhd,bmhd->bhlm", qatt, katt,
+                           preferred_element_type=jnp.float32)
+            valid = (jnp.arange(max_len)[None, None, None, :]
+                     <= qpos[:, None, :, None])
+            s = jnp.where(valid, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.einsum("bhlm,bmhd->blhd", p.astype(p_dt), vatt,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype).reshape(N, S, heads_l * hd)
+            if mesh is None:
+                x = x + _proj(ap["wo"], ctx)
+            else:
+                x = x + _tp_row_proj(ap["wo"], ctx, model_axis)
+            h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x)
+            hmid = jax.nn.gelu(_proj(bp[blk._child_key(3)], h2))
+            if mesh is None:
+                mlp = _proj(bp[blk._child_key(4)], hmid)
+            else:
+                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis)
+            x = x + mlp
+        # EVERY position's next-token distribution (the whole point —
+        # prefill keeps only the last valid one)
+        xf, _ = lnf.apply(lnf_p, x)
+        logits = _proj(lin_p, xf)                     # (N, S, V)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # sequential per-position sampling through THE one sampler: the
+        # lane splits once per position in order, penalty counts grow
+        # per draw — position j computes exactly the baseline step's
+        # draw for emission j. S is small and static, so the unrolled
+        # chain stays one compiled program.
+        keys, counts = carry["rng"], carry["tok_counts"]
+        pmask = carry["prompt_mask"]
+        toks_out, lps_out, key_hist = [], [], []
+        for j in range(S):
+            t_j, lp_j, keys, counts = sample_rows(
+                logp[:, j], keys, knobs, counts, pmask)
+            toks_out.append(t_j)
+            lps_out.append(lp_j)
+            key_hist.append(keys)
+        s_tok = jnp.stack(toks_out, axis=1)           # (N, S)
+        s_lp = jnp.stack(lps_out, axis=1)
+        # acceptance chain: position j's draw is emitted iff every draft
+        # before it matched its preceding draw (cumulative product of
+        # leading matches); the first mismatch still emits — its draw
+        # conditioned on the true accepted context
+        if S > 1:
+            match = s_tok[:, :-1] == tokens[:, 1:]
+            has_draft = jnp.arange(1, S)[None] < lengths[:, None]
+            acc = jnp.cumprod((match & has_draft).astype(jnp.int32),
+                              axis=1)
+            n_acc = jnp.sum(acc, axis=1)
+        else:
+            n_acc = jnp.zeros((N,), jnp.int32)
+        active = lengths > 0
+        n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+        # lane/counts advance by EXACTLY n_emit draws. The lane: select
+        # the key after the last emitted draw from the (S, N, 2) split
+        # history (inactive rows stay bitwise untouched). The counts:
+        # sample_rows adds exactly one_hot(draw) per call, so the state
+        # after n_emit draws is counts0 + the emitted draws' one-hots —
+        # S small scatters instead of materializing an (S, N, vocab)
+        # history stack on the decode hot path (unemitted/inactive rows
+        # add 0, staying bitwise untouched)
+        kh = jnp.stack(key_hist)                      # (S, N, 2)
+        idx = jnp.clip(n_emit - 1, 0, S - 1)
+        new_carry["rng"] = jnp.where(active[:, None], kh[idx, rows],
+                                     carry["rng"])
+        new_counts = carry["tok_counts"]
+        for j in range(S):
+            new_counts = new_counts.at[rows, s_tok[:, j]].add(
+                (j < n_emit).astype(jnp.int32))
+        new_carry["tok_counts"] = new_counts
+        # accepted-prefix rollback: pos advances by the emitted count
+        # only — chunk writes past it are stale bytes behind the mask
+        new_carry["pos"] = start + n_emit
+        return s_tok, s_lp, n_emit, new_carry
+
+    fn = verify
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.serving.sampling import knob_partition_specs
+        from bigdl_tpu.utils.compat import shard_map as _shard_map
+
+        cspecs = serving_carry_specs(model, sampling=True,
+                                     data_axis=data_axis,
+                                     model_axis=model_axis,
+                                     kv_quant=kv_quant)
+        row = P(data_axis)
+        # check_vma off for the decode step's reason: chunk draws and
+        # non-head state replicate over the model axis deterministically,
+        # which the static checker cannot prove through the sampler
+        fn = _shard_map(fn, mesh=mesh,
+                        in_specs=(tp_param_specs(model, model_axis),
+                                  row, row, cspecs,
+                                  knob_partition_specs(data_axis)),
+                        out_specs=(row, row, row, cspecs),
+                        check_vma=False)
+    # carry donated like the decode step's: the engine swaps its pooled
+    # carry for the output every super-step
+    return jax.jit(fn, donate_argnums=(3,)), init_carry
+
+
 # -- jitted-step cache (ADVICE r5: generate()/beam_generate() paid two
 # full XLA compiles per call; the serving engine shares the same cache) --
 
@@ -1530,6 +1848,26 @@ def get_batch_decode_step(model: Sequential, compute_dtype=None,
                            model, compute_dtype, sampling=sampling,
                            mesh=mesh, data_axis=data_axis,
                            model_axis=model_axis, kv_quant=kv_quant),
+                       extra=extra)
+
+
+def get_batch_verify_step(model: Sequential, compute_dtype=None,
+                          width: int = 4, mesh=None,
+                          data_axis: str = "data",
+                          model_axis: str = "model",
+                          kv_quant: bool = False):
+    """Cached :func:`make_batch_verify_step` (the speculative engine's
+    one target-side program). ``width`` (the chunk width = max drafts
+    + 1) keys the cache alongside the mesh/kv_quant variants — engines
+    sharing a (model, dtype, width) share one compiled verify program,
+    exactly like the decode step cache."""
+    extra = (int(width), "int8" if kv_quant else None,
+             None if mesh is None else (mesh, data_axis, model_axis))
+    return _step_cache(model, "batch_verify", compute_dtype,
+                       lambda: make_batch_verify_step(
+                           model, compute_dtype, width=width, mesh=mesh,
+                           data_axis=data_axis, model_axis=model_axis,
+                           kv_quant=kv_quant),
                        extra=extra)
 
 

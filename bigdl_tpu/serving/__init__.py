@@ -36,8 +36,10 @@ from bigdl_tpu.serving.scheduler import Request, Scheduler
 from bigdl_tpu.serving.sharded import (
     ShardedEngine, ShardedKVPool, emulate_cpu_devices, make_mesh,
 )
+from bigdl_tpu.serving.speculative import SpeculativeConfig
 
 __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "Scheduler", "AdmissionController", "PrefixCache",
-           "SamplingParams", "bucket_len", "ShardedEngine",
-           "ShardedKVPool", "make_mesh", "emulate_cpu_devices"]
+           "SamplingParams", "SpeculativeConfig", "bucket_len",
+           "ShardedEngine", "ShardedKVPool", "make_mesh",
+           "emulate_cpu_devices"]

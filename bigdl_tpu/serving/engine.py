@@ -114,7 +114,20 @@ class ServingEngine:
     reference on CPU; ``ops/decode_attention.py``). Greedy outputs are
     parity-pinned against the float-KV engine and quantization adds
     ZERO decode compiles (tests/test_serving_kv_quant.py); default
-    (None) follows ``compute_dtype``.
+    (None) follows ``compute_dtype``;
+    ``speculative`` turns on DRAFT-AND-VERIFY decoding
+    (``serving/speculative.py``): pass a
+    :class:`~bigdl_tpu.serving.speculative.SpeculativeConfig` (or a
+    bare draft model) and every step becomes a super-step — a small
+    draft proposes up to ``k`` tokens per row, ONE fixed-width batched
+    verify program (structurally the masked multi-row prefill) scores
+    them all, and each row advances by the confirmed count (1..k+1
+    tokens per step). Greedy output stays token-identical to the plain
+    engine, fixed-seed sampled streams replay exactly (verification
+    draws ride the per-slot RNG lanes), per-row draft budgets are
+    runtime data of the one program (``submit(..., draft_tokens=0)``
+    rows run as plain decode), and the draft's KV carry rides the same
+    pool slots (tests/test_serving_speculative.py).
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
@@ -125,7 +138,8 @@ class ServingEngine:
                  keep_finished: Optional[int] = None,
                  seed: int = 0,
                  mesh=None, parallelism=None,
-                 kv_dtype: Optional[str] = None) -> None:
+                 kv_dtype: Optional[str] = None,
+                 speculative=None) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -202,17 +216,34 @@ class ServingEngine:
         # requests are temperature=0 rows of the same compiled step, so
         # greedy-only and mixed traffic share one program (pinned by the
         # compile-count guards in tests/test_serving_sampling.py and
-        # tests/test_serving_sharded.py)
+        # tests/test_serving_sharded.py). A SPECULATIVE engine swaps in
+        # the fixed-width batched VERIFY step instead (serving/
+        # speculative.py) — still exactly one target-side program, with
+        # per-row draft lengths as runtime data (length-1 rows ARE plain
+        # decode), and a layout-identical pooled carry.
         tp = self._plane is not None and self._plane.tensor_parallel
-        self._step_fn, pool_init = get_batch_decode_step(
-            model, compute_dtype, sampling=True,
-            mesh=self.mesh if tp else None, kv_quant=kv_quant)
+        if speculative is None:
+            self._spec = None
+            self._step_fn, pool_init = get_batch_decode_step(
+                model, compute_dtype, sampling=True,
+                mesh=self.mesh if tp else None, kv_quant=kv_quant)
+        else:
+            from bigdl_tpu.serving.speculative import Speculator
+
+            self._spec = Speculator(self, speculative,
+                                    mesh=self.mesh if tp else None,
+                                    kv_quant=kv_quant)
+            self._step_fn = None
+            pool_init = self._spec.pool_init
         self._pool_init = pool_init
         self.pool = (KVPool(pool_init, n_slots, kv_dtype=kv_dtype)
                      if self._plane is None
                      else self._plane.make_pool(model, pool_init, n_slots,
                                                 kv_quant=kv_quant,
                                                 kv_dtype=kv_dtype))
+        if self._spec is not None:
+            # the draft model's pooled carry rides the same slots
+            self._spec.attach_pool(self.pool)
         self.scheduler = Scheduler(policy)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         if self._plane is not None:
@@ -270,8 +301,8 @@ class ServingEngine:
     # -- request surface ---------------------------------------------------
 
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
-               eos_id: int = -1, sampling: Optional[SamplingParams] = None
-               ) -> int:
+               eos_id: int = -1, sampling: Optional[SamplingParams] = None,
+               draft_tokens: Optional[int] = None) -> int:
         """Queue one generation request (1-based prompt ids, like
         ``generate()``); returns its request id. Raises if the request
         could ever overflow the cache (same ``max_len`` guard as
@@ -284,10 +315,18 @@ class ServingEngine:
         :class:`~bigdl_tpu.serving.sampling.SamplingParams` (None =
         greedy defaults, the pre-sampling engine behavior);
         ``sampling.max_tokens`` (when set) overrides
-        ``max_new_tokens``."""
+        ``max_new_tokens``; ``draft_tokens`` is the request's
+        speculative-decoding budget HINT (None = the engine's configured
+        draft count, 0 = plain decode for this request, n = at most n
+        drafts per super-step, clamped to the engine's ``k``; ignored
+        by non-speculative engines, so traces stay portable across
+        engine configs)."""
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("need a non-empty prompt")
+        if draft_tokens is not None and int(draft_tokens) < 0:
+            raise ValueError(
+                f"draft_tokens must be >= 0 or None, got {draft_tokens}")
         # SamplingParams validates on construction (frozen dataclass)
         sp = sampling if sampling is not None else SamplingParams()
         if sp.max_tokens is not None:
@@ -303,6 +342,7 @@ class ServingEngine:
         self.scheduler.submit(Request(
             req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_id=int(eos_id), sampling=sp,
+            draft_tokens=None if draft_tokens is None else int(draft_tokens),
             submit_time=time.perf_counter()))
         self.metrics.on_submit()
         return rid
@@ -427,18 +467,68 @@ class ServingEngine:
         self._ban_base[slot] = self._knobs["ban"][slot]
         self._knobs_device = None                # re-upload next step
         self.pool.write_sampling(slot, self._lane_key(req), req.prompt)
+        if self._spec is not None:
+            # the draft cache ingests the prompt alongside the target's
+            # (every admission path configures through here)
+            self._spec.prefill_draft(slot, req)
         self._configured.add(slot)
 
+    def _finish_check(self, req: Request) -> Optional[str]:
+        """Stop/length decision for the token JUST appended to
+        ``req.output`` — THE one copy of the per-token finish rule
+        (the decode loop and the speculative chunk emission both apply
+        it, token by token, so multi-token super-steps stop exactly
+        where the baseline would)."""
+        sp = req.sampling
+        n_out = len(req.output)
+        tok1 = req.output[-1]
+        if n_out >= sp.min_tokens:
+            if req.eos_id > 0 and tok1 == req.eos_id:
+                return "eos"
+            if (tok1 in sp.stop_token_ids
+                    or match_stop_sequences(req.output, sp.stop_sequences)):
+                return "stop"
+        if n_out >= req.max_new_tokens:
+            return "length"
+        return None
+
+    def _finish_row(self, req: Request, reason: str, now: float) -> None:
+        """Evict a finished request: free its slot, ledger it, account
+        the latency/throughput metrics."""
+        req.finish_reason = reason
+        freed = self.scheduler.finish(req, now)
+        self.pool.free(freed)
+        self._configured.discard(freed)
+        self._finished[req.req_id] = req
+        self._evict_finished()
+        self.metrics.on_finish(
+            now - req.submit_time, len(req.output),
+            mean_logprob=float(np.mean(req.logprobs)))
+
+    def _maybe_flip_ban(self, slot: int, req: Request) -> None:
+        """min-tokens ban lifts the step the floor is met — a runtime
+        VALUE change, never a recompile."""
+        if self._ban_base[slot]:
+            ban = len(req.output) < req.sampling.min_tokens
+            if ban != self._knobs["ban"][slot]:
+                self._knobs["ban"][slot] = ban
+                self._knobs_device = None
+
     def step(self) -> Dict[int, int]:
-        """Admit waiting requests, then decode ONE token for every active
-        row. Returns ``{req_id: 1-based token}`` emitted this step (empty
-        when the engine is idle)."""
+        """Admit waiting requests, then decode for every active row:
+        ONE token per row on the plain engine, up to ``k + 1`` on a
+        speculative engine (draft-and-verify super-step —
+        ``serving/speculative.py``). Returns ``{req_id: 1-based token}``
+        emitted this step (the LAST emitted token per request when a
+        super-step lands several; empty when the engine is idle)."""
         import jax.numpy as jnp
 
         self._admit()
         running = self.scheduler.running
         if not running:
             return {}
+        if self._spec is not None:
+            return self._spec.step(running)
         N = self.pool.n_slots
         tokens = np.zeros((N,), np.int32)
         active = np.zeros((N,), bool)
@@ -479,37 +569,12 @@ class ServingEngine:
             if req.first_token_time is None:
                 req.first_token_time = now
                 self.metrics.on_first_token(now - req.submit_time)
-            sp = req.sampling
-            n_out = len(req.output)
-            reason = None
-            if n_out >= sp.min_tokens:
-                if req.eos_id > 0 and tok1 == req.eos_id:
-                    reason = "eos"
-                elif (tok1 in sp.stop_token_ids
-                      or match_stop_sequences(req.output,
-                                              sp.stop_sequences)):
-                    reason = "stop"
-            if reason is None and n_out >= req.max_new_tokens:
-                reason = "length"
+            reason = self._finish_check(req)
             if reason is not None:
-                req.finish_reason = reason
-                freed = self.scheduler.finish(req, now)
-                self.pool.free(freed)
-                self._configured.discard(freed)
-                self._finished[req.req_id] = req
-                self._evict_finished()
-                self.metrics.on_finish(
-                    now - req.submit_time, len(req.output),
-                    mean_logprob=float(np.mean(req.logprobs)))
+                self._finish_row(req, reason, now)
             else:
                 req.next_token = tok0
-                if self._ban_base[slot]:
-                    # min-tokens ban lifts the step the floor is met —
-                    # a runtime VALUE change, never a recompile
-                    ban = n_out < sp.min_tokens
-                    if ban != self._knobs["ban"][slot]:
-                        self._knobs["ban"][slot] = ban
-                        self._knobs_device = None
+                self._maybe_flip_ban(slot, req)
         return emitted
 
     def drain(self) -> Dict[int, np.ndarray]:

@@ -129,6 +129,10 @@ class KVPool:
                                  for i in range(self.n_layers)
                                  for kind in ("k", "v")]
         self._free_reset = self._make_free_reset()
+        # optional DRAFT carry (speculative decoding): a second,
+        # slot-aligned pooled carry for the draft model — see
+        # attach_draft()
+        self.draft_carry = None
 
     def _make_scatter(self):
         import jax
@@ -149,26 +153,32 @@ class KVPool:
     def _free_reset_impl(leaves, slot):
         return {k: v.at[slot].set(0) for k, v in leaves.items()}
 
-    def _scatter_impl(self, carry, prefill_carry, slot, pos, row):
+    @staticmethod
+    def _scatter_impl(carry, prefill_carry, slot, pos, row):
+        # layer keys derive from the CARRY (static under trace), so one
+        # impl serves both the target pool and an attached draft carry
+        # (different layer counts/shapes key jit's own cache)
+        import re
+
         from jax import lax
 
         out = dict(carry)
-        for i in range(self.n_layers):
-            for kind in ("k", "v"):
-                key = f"{kind}{i}"
-                src = lax.dynamic_slice_in_dim(
-                    prefill_carry[key], row, 1, axis=0
-                ).astype(carry[key].dtype)
-                out[key] = lax.dynamic_update_slice(
-                    carry[key], src, (slot, 0, 0, 0))
-                # int8 layout: the row's (1, heads) dequant scales land
-                # with it — a quantized row is meaningless without them
-                skey = f"{key}_scale"
-                if skey in carry:
-                    ssrc = lax.dynamic_slice_in_dim(
-                        prefill_carry[skey], row, 1, axis=0)
-                    out[skey] = lax.dynamic_update_slice(
-                        carry[skey], ssrc, (slot, 0))
+        for key in carry:
+            if not re.fullmatch(r"[kv]\d+", key):
+                continue
+            src = lax.dynamic_slice_in_dim(
+                prefill_carry[key], row, 1, axis=0
+            ).astype(carry[key].dtype)
+            out[key] = lax.dynamic_update_slice(
+                carry[key], src, (slot, 0, 0, 0))
+            # int8 layout: the row's (1, heads) dequant scales land
+            # with it — a quantized row is meaningless without them
+            skey = f"{key}_scale"
+            if skey in carry:
+                ssrc = lax.dynamic_slice_in_dim(
+                    prefill_carry[skey], row, 1, axis=0)
+                out[skey] = lax.dynamic_update_slice(
+                    carry[skey], ssrc, (slot, 0))
         out["pos"] = carry["pos"].at[slot].set(pos)
         return out
 
@@ -200,6 +210,11 @@ class KVPool:
         self.carry.update(self._free_reset(
             {k: self.carry[k] for k in self._reset_keys},
             jnp.int32(slot)))
+        if self.draft_carry is not None:
+            # the draft carry frees WITH its slot: same pos-reset rule
+            # (stale draft K/V behind pos are masked, like the target's)
+            self.draft_carry.update(self._draft_reset(
+                {"pos": self.draft_carry["pos"]}, jnp.int32(slot)))
 
     @property
     def free_slots(self) -> int:
@@ -298,3 +313,66 @@ class KVPool:
             jnp.int32(0))
         self.carry["prompt_mask"] = self.carry["prompt_mask"].at[slot].set(
             jnp.asarray(mask))
+
+    # -- draft carry (speculative decoding) --------------------------------
+
+    def attach_draft(self, init_carry, specs=None) -> None:
+        """Attach a DRAFT model's pooled carry alongside the target K/V
+        (``bigdl_tpu.serving.speculative``): slot ``s`` of the draft
+        carry always belongs to the same request as slot ``s`` here —
+        one allocator, two caches. The draft carry is a plain
+        :func:`make_batch_decode_step` carry (no sampling state: the
+        draft proposes greedily; the REQUEST's lane lives in the target
+        carry) and frees/resets with its slot. ``specs`` is ignored on
+        the single-device pool (the sharded subclass uses it to pin the
+        draft leaves' mesh placement)."""
+        if self.draft_carry is not None:
+            raise ValueError("a draft carry is already attached")
+        self.draft_carry = self._place_draft(init_carry(self.n_slots),
+                                             specs)
+        self.draft_max_len = int(self.draft_carry["k0"].shape[1])
+        self._draft_reset = self._make_draft_reset(specs)
+        # last: SPMD104 reads a donating factory's call-site args as the
+        # jitted fn's — keep this the final `specs` read in the method
+        self._draft_scatter = self._make_draft_scatter(specs)
+
+    def _place_draft(self, carry, specs):
+        return carry
+
+    def _make_draft_scatter(self, specs):
+        import jax
+
+        # same impl as the admission scatter — layer keys derive from
+        # the carry, so the draft's (different) depth/geometry just
+        # retraces
+        return jax.jit(self._scatter_impl, donate_argnums=(0,))
+
+    def _make_draft_reset(self, specs):
+        return _shared_free_reset()
+
+    def write_draft_prefill(self, slot: int, prefill_carry: Dict,
+                            prompt_len: int, row: int = 0) -> None:
+        """Row-scatter one row of a DRAFT prefill carry into ``slot`` —
+        :meth:`write_prefill`'s twin for the attached draft cache."""
+        import jax.numpy as jnp
+
+        if self.draft_carry is None:
+            raise ValueError("no draft carry attached (attach_draft)")
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        if not 0 < prompt_len <= self.draft_max_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} outside 1..{self.draft_max_len}")
+        self.draft_carry = self._draft_scatter(
+            self.draft_carry, prefill_carry, jnp.int32(slot),
+            jnp.int32(prompt_len), jnp.int32(row))
+
+    def set_draft_pos(self, slot: int, pos: int) -> None:
+        """Set one slot's DRAFT position counter (the no-prefill
+        admission path, mirroring :meth:`set_pos`)."""
+        if self.draft_carry is None:
+            raise ValueError("no draft carry attached (attach_draft)")
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self.draft_carry["pos"] = \
+            self.draft_carry["pos"].at[slot].set(int(pos))

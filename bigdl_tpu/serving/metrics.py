@@ -36,6 +36,18 @@ Sampling counters (``serving/sampling.py``):
 * ``mean_logprob``        — per-request mean chosen-token raw model
   log-prob (recorded at finish; a cheap generation-quality signal)
 
+Speculative-decoding counters (``serving/speculative.py``):
+
+* ``draft_tokens`` / ``accepted_tokens`` — drafts proposed per
+  super-step vs landed in request outputs (verify-confirmed and not
+  discarded by a mid-chunk stop); ``summary()`` derives
+  ``accept_rate`` (accepted/drafted)
+* ``spec_rows``          — active rows per super-step (row-steps);
+  ``summary()`` derives ``tokens_per_step`` ((accepted + rows)/rows —
+  emitted tokens per row per target invocation, 1.0 = plain decode)
+* ``draft_s`` / ``draft_prefill_s`` — draft-side phase timings (the
+  verify dispatch lands in ``decode_step_s``)
+
 Sharded-plane counters (``serving/sharded.py``):
 
 * ``mesh_data_shards`` / ``mesh_model_shards`` — the engine's mesh
@@ -102,6 +114,21 @@ class ServingMetrics:
         distribution (temperature > 0) vs took the argmax."""
         self.metrics.add("serving/rows_sampled", float(n_sampled))
         self.metrics.add("serving/rows_greedy", float(n_greedy))
+
+    def on_spec_step(self, n_drafted: int, n_accepted: int,
+                     n_rows: int) -> None:
+        """Per speculative super-step (``serving/speculative.py``):
+        draft tokens proposed across active rows, how many LANDED in
+        request outputs (confirmed by the verify step AND not discarded
+        by a mid-chunk stop truncation), and the active row count
+        (row-steps). Every row also emits one non-draft draw per step,
+        so emitted tokens = accepted + rows; ``summary()`` derives
+        ``accept_rate`` = accepted/drafted and ``tokens_per_step`` =
+        emitted/rows (the per-row speedup denominator — 1.0 is the
+        plain decode floor)."""
+        self.metrics.add("serving/draft_tokens", float(n_drafted))
+        self.metrics.add("serving/accepted_tokens", float(n_accepted))
+        self.metrics.add("serving/spec_rows", float(n_rows))
 
     def on_cancel(self) -> None:
         self.metrics.add("serving/cancelled", 1.0)
@@ -189,6 +216,13 @@ class ServingMetrics:
         n_g, _ = self.metrics.get("serving/rows_greedy")
         if n_s + n_g > 0:
             out["serving/sampled_row_frac"] = n_s / (n_s + n_g)
+        n_draft, _ = self.metrics.get("serving/draft_tokens")
+        n_acc, _ = self.metrics.get("serving/accepted_tokens")
+        n_rows, _ = self.metrics.get("serving/spec_rows")
+        if n_draft:
+            out["serving/accept_rate"] = n_acc / n_draft
+        if n_rows:
+            out["serving/tokens_per_step"] = (n_acc + n_rows) / n_rows
         for k, v in self.ttft_percentiles().items():
             out[f"serving/ttft_{k}_s"] = v
         return out

@@ -54,6 +54,11 @@ class Request:
     next_token: Optional[int] = None   # 0-based token to feed next step
     output: List[int] = field(default_factory=list)   # 1-based ids
     sampling: Optional[SamplingParams] = None
+    # speculative-decoding hint: None = the engine's configured draft
+    # count, 0 = plain decode for this request, n = at most n drafts
+    # per super-step (clamped to the engine's k; ignored by
+    # non-speculative engines — it is a budget, not a semantic)
+    draft_tokens: Optional[int] = None
     logprobs: List[float] = field(default_factory=list)
     finish_reason: Optional[str] = None
     submit_time: float = 0.0

@@ -225,6 +225,36 @@ class ShardedKVPool(KVPool):
                        out_shardings={k: self._shardings[k]
                                       for k in self._reset_keys})
 
+    # -- draft carry (speculative decoding) --------------------------------
+
+    def _draft_shardings(self, specs):
+        if specs is None:
+            raise ValueError(
+                "a sharded pool needs the draft carry's PartitionSpecs "
+                "(ShardPlane.draft_carry_specs) — an unpinned draft "
+                "placement would drift from the step outputs and "
+                "double-compile")
+        return {k: named_sharding(self.mesh, s) for k, s in specs.items()}
+
+    def _place_draft(self, carry, specs):
+        import jax
+
+        sh = self._draft_shardings(specs)
+        return {k: jax.device_put(v, sh[k]) for k, v in carry.items()}
+
+    def _make_draft_scatter(self, specs):
+        import jax
+
+        return jax.jit(self._scatter_impl, donate_argnums=(0,),
+                       out_shardings=self._draft_shardings(specs))
+
+    def _make_draft_reset(self, specs):
+        import jax
+
+        sh = self._draft_shardings(specs)
+        return jax.jit(self._free_reset_impl, donate_argnums=(0,),
+                       out_shardings={"pos": sh["pos"]})
+
     # -- slot → shard routing ---------------------------------------------
 
     def slot_shard(self, slot: int) -> Tuple[int, int]:
@@ -345,6 +375,18 @@ class ShardPlane:
             model, sampling=sampling, data_axis=self.data_axis,
             model_axis=self.model_axis if self.tensor_parallel else None,
             kv_quant=kv_quant)
+
+    def draft_carry_specs(self, draft_model) -> Dict:
+        """PartitionSpec tree for a speculative DRAFT carry: slot rows
+        shard over the data axis like the target's, but K/V heads stay
+        UNSHARDED even on tensor-parallel meshes — the draft's weights
+        are replicated (a model small enough to draft with is small
+        enough to replicate), so its cache heads are whole per chip."""
+        from bigdl_tpu.models.transformer import serving_carry_specs
+
+        return serving_carry_specs(draft_model, sampling=False,
+                                   data_axis=self.data_axis,
+                                   model_axis=None)
 
     def make_pool(self, model, pool_init, n_slots: int,
                   sampling: bool = True, kv_quant: bool = False,

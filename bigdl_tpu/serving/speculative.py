@@ -1,0 +1,346 @@
+"""Speculative decoding: draft-and-verify under the one-program
+discipline.
+
+Decode emits one token per model invocation, so per-request latency is
+bound by SEQUENTIAL target-model steps no matter how well the engine
+batches across requests. Speculative decoding breaks that bound the way
+this repo breaks every serving bound — by restructuring the driver loop
+around what the hardware does well (the BigDL thesis, arXiv:1804.05839)
+and hiding per-step host/launch latency behind larger device steps (the
+MLPerf-TPU-pod playbook, arXiv:1909.09756):
+
+* a small DRAFT model proposes ``k`` tokens per row each super-step
+  (``k + 1`` chained invocations of the existing per-row batched decode
+  step — cheap, the draft is small);
+* the TARGET model scores all proposed positions in ONE batched verify
+  step (:func:`bigdl_tpu.models.transformer.make_batch_verify_step` —
+  structurally the masked multi-row prefill: per-row start offsets
+  already express "continue this row's suffix", so the verify program
+  is shape-stable);
+* each row advances by however many draws the target confirms —
+  between 1 (all drafts rejected; exactly the plain decode step) and
+  ``k + 1`` (all accepted plus the bonus draw) tokens per super-step.
+
+The serving invariants carry over wholesale:
+
+* **one compiled program** — per-row draft length is runtime data of
+  the fixed-width ``(n_slots, k + 1)`` verify program. Mixed
+  speculative/normal traffic (per-request ``draft_tokens=0`` rows,
+  budget-capped rows, min-tokens-banned rows) adds ZERO target-side
+  compiles: the speculative engine runs one verify program where the
+  baseline runs one decode program (pinned by
+  tests/test_serving_speculative.py via tests/compile_guards.py);
+* **greedy parity** — temperature-0 rows verify by argmax agreement,
+  so greedy speculative output is token-identical to the baseline
+  engine and ``generate()`` (test-pinned, like sampling's
+  temperature=0 contract);
+* **seed replay** — verification draws ride the per-slot RNG lanes
+  from ``serving/sampling.py``: the verify step splits each row's lane
+  once per chunk position IN ORDER and advances it by exactly the
+  emitted count, so a fixed-seed sampled request produces the SAME
+  stream as the non-speculative engine, across eviction/readmission,
+  batching, and admission modes. The draft only decides how many of
+  those draws land per step — never their values — which also means a
+  WRONG or weak draft degrades throughput, not correctness. (Scope
+  note: that draft-independence is exact on the FLOAT KV cache. Under
+  ``kv_dtype="int8"`` the verify step's grow-only scale merge amaxes
+  the whole chunk — the in-step attention must dequantize every
+  position before acceptance is known — so a rejected draft can grow
+  a row's (slot, head) scale one step early, bounded by the merge's
+  <= half-quantum requant error: the same caveat class as the int8
+  baseline's own parity contract, pinned by the int8 test in
+  tests/test_serving_speculative.py.)
+  (Acceptance is sampled-token agreement, deliberately traded against
+  Leviathan-style distribution-matching rejection sampling, whose
+  draft-dependent randomness consumption cannot replay the baseline
+  stream; see ``make_batch_verify_step``'s docstring.)
+
+KV bookkeeping: the draft's pooled KV carry rides alongside the
+target's in the one :class:`~bigdl_tpu.serving.kv_pool.KVPool`
+(``attach_draft`` — same slot ids, same allocator, freed together).
+Rejected drafts need no cache rewrite on EITHER side: both caches
+wrote the whole chunk, and the accepted-prefix rollback is pointer
+arithmetic — ``pos`` advances by the emitted count only, leaving
+rejected positions as stale bytes behind the per-row causal mask (the
+same masking that makes recycled slots safe). The draft loop runs
+``k + 1`` iterations (not ``k``) so the k-th draft's K/V lands too and
+a fully-accepted chunk leaves no hole in the draft cache.
+
+    from bigdl_tpu.serving import ServingEngine, SpeculativeConfig
+
+    eng = ServingEngine(lm, n_slots=8,
+                        speculative=SpeculativeConfig(draft_lm, k=4))
+    rid = eng.submit([3, 7, 2], max_new_tokens=64)
+    eng.submit([9, 9], max_new_tokens=8, draft_tokens=0)  # normal row
+    outs = eng.drain()
+    eng.metrics.summary()["serving/accept_rate"]    # drafts confirmed
+    eng.metrics.summary()["serving/tokens_per_step"]  # > 1 when drafts land
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.admission import bucket_len
+
+
+@dataclass(frozen=True)
+class SpeculativeConfig:
+    """Speculative-decoding knobs for :class:`ServingEngine`.
+
+    ``draft`` is the proposer: a TransformerLM-shaped model over the
+    SAME vocabulary as the target (its ids are fed to the target
+    verbatim) with ``max_len`` at least the target's (its cache tracks
+    the same positions). ``k`` is the drafts proposed per super-step —
+    the verify chunk width is ``k + 1`` and tokens-per-step ranges over
+    ``1..k+1``. Per-request ``submit(..., draft_tokens=)`` can lower
+    (never raise) the budget per row at runtime."""
+
+    draft: Any
+    k: int = 4
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(
+                f"k must be >= 1 (draft tokens per super-step), got "
+                f"{self.k} — a k=0 engine is the plain ServingEngine")
+
+
+class Speculator:
+    """The engine's speculative plane: owns the draft model's serving
+    state (params, decode/prefill steps, pooled carry attachment) and
+    the draft→verify→emit super-step. Built by
+    :class:`~bigdl_tpu.serving.engine.ServingEngine` when its
+    ``speculative=`` knob is set; reads the engine's pool/scheduler/
+    metrics/knobs the way :class:`AdmissionController` does."""
+
+    def __init__(self, engine, config, mesh=None,
+                 kv_quant: bool = False) -> None:
+        import jax
+
+        from bigdl_tpu.models.transformer import (
+            get_batch_decode_step, get_batch_prefill_step,
+            get_batch_verify_step, serving_params,
+        )
+
+        if not isinstance(config, SpeculativeConfig):
+            # accept a bare draft model for the common case
+            config = SpeculativeConfig(draft=config)
+        self.engine = engine
+        self.config = config
+        self.k = int(config.k)
+        self.width = self.k + 1
+        draft = config.draft
+        draft._ensure_params()
+        tgt_vocab = engine.model.modules[0].n_index
+        if draft.modules[0].n_index != tgt_vocab:
+            raise ValueError(
+                f"draft vocab {draft.modules[0].n_index} != target vocab "
+                f"{tgt_vocab} — draft proposals are target token ids")
+        self.draft_max_len = draft.modules[1].max_len
+        if self.draft_max_len < engine.max_len:
+            raise ValueError(
+                f"draft max_len {self.draft_max_len} < target max_len "
+                f"{engine.max_len} — the draft cache tracks the same "
+                "positions as the target's")
+        self.draft = draft
+        dtype = engine.compute_dtype
+        # ONE target-side program: the fixed-width verify step is the
+        # speculative engine's decode step (a length-1 row IS plain
+        # decode); its init_carry is the decode carry, so the pool is
+        # layout-identical to a non-speculative engine's
+        self.verify_fn, self.pool_init = get_batch_verify_step(
+            engine.model, dtype, width=self.width, mesh=mesh,
+            kv_quant=kv_quant)
+        # draft plane: weights REPLICATED (a model small enough to
+        # draft with is small enough to replicate — on data-sharded
+        # meshes XLA partitions the per-row step over the carry's slot
+        # sharding), plain float cache, greedy proposals
+        self._draft_step_fn, self._draft_init = get_batch_decode_step(
+            draft, dtype)
+        self._draft_prefill_fn = get_batch_prefill_step(draft, dtype)
+        self._draft_params = jax.device_put(serving_params(draft, dtype))
+        # shared fresh B=1 carry for draft prefills (immutable, reused)
+        self._zero_draft1 = self._draft_init(1)
+
+    # -- pool wiring --------------------------------------------------------
+
+    def attach_pool(self, pool) -> None:
+        plane = self.engine._plane
+        pool.attach_draft(
+            self._draft_init,
+            specs=None if plane is None
+            else plane.draft_carry_specs(self.draft))
+
+    # -- admission ----------------------------------------------------------
+
+    def prefill_draft(self, slot: int, req) -> None:
+        """Ingest an admitted request's prompt into the DRAFT cache —
+        called from the engine's slot configuration, so every admission
+        path (batched, per_request, prefix-cache hits) feeds the draft
+        the same way. Bucketed masked B=1 prefill: the compiled
+        draft-prefill set stays bounded by the power-of-two buckets, no
+        matter how many distinct prompt lengths traffic brings. (No
+        draft-side prefix cache: draft prefill is cheap and a stale
+        draft cache could only cost acceptance, never correctness —
+        but the bookkeeping would be real.)"""
+        import jax.numpy as jnp
+
+        eng = self.engine
+        prompt0 = [t - 1 for t in req.prompt]
+        pf = prompt0[:-1]
+        if not pf:
+            eng.pool.set_draft_pos(slot, 0)
+            return
+        t0 = time.perf_counter()
+        L = bucket_len(len(pf), self.draft_max_len)
+        toks = np.zeros((1, L), np.int32)
+        toks[0, :len(pf)] = pf
+        _, dc = self._draft_prefill_fn(
+            self._draft_params, jnp.asarray(toks),
+            np.asarray([len(pf)], np.int32), self._zero_draft1)
+        eng.pool.write_draft_prefill(slot, dc, len(pf))
+        eng.metrics.add_phase("draft_prefill", time.perf_counter() - t0)
+
+    # -- the super-step ------------------------------------------------------
+
+    def _draft_budget(self, slot: int, req) -> int:
+        """Row r's draft count this super-step — runtime data, never a
+        recompile. Capped by the engine ``k``, the per-request
+        ``draft_tokens`` hint, the remaining token budget (a chunk must
+        not overshoot ``max_new_tokens`` — that would desync the RNG
+        lane from the baseline stream), and forced to 0 while the row's
+        min-tokens ban is up (the ban is per-STEP host state; a chunk
+        must not cross its flip)."""
+        k = self.k if req.draft_tokens is None \
+            else min(int(req.draft_tokens), self.k)
+        if self.engine._knobs["ban"][slot]:
+            k = 0
+        rem = req.max_new_tokens - len(req.output)
+        return max(0, min(k, rem - 1))
+
+    def step(self, running) -> Dict[int, int]:
+        """One draft-and-verify super-step over every active row:
+        propose (``k + 1`` draft-decode dispatches), verify (ONE target
+        dispatch), roll the draft cache back to the accepted prefix,
+        then account the emitted tokens host-side exactly like the
+        baseline per-token loop (same finish rules, truncating a chunk
+        at its first stop condition). Returns ``{req_id: last emitted
+        1-based token}`` — multi-token emissions land in
+        ``Request.output``; the dict mirrors the baseline ``step()``
+        shape for callers that only poll liveness."""
+        import jax.numpy as jnp
+
+        eng = self.engine
+        N = eng.pool.n_slots
+        tokens = np.zeros((N,), np.int32)
+        active = np.zeros((N,), bool)
+        k_r = np.zeros((N,), np.int32)
+        n_sampled = 0
+        for slot, req in running.items():
+            if slot not in eng._configured:
+                eng._configure_slot(slot, req)
+            tokens[slot] = req.next_token
+            active[slot] = True
+            k_r[slot] = self._draft_budget(slot, req)
+            n_sampled += not req.sampling.is_greedy
+        if eng._knobs_device is None:
+            eng._knobs_device = {k: eng._place_rows(jnp.asarray(v))
+                                 for k, v in eng._knobs.items()}
+        knobs = eng._knobs_device
+
+        # propose: kmax+1 chained draft steps, kmax = the step's LARGEST
+        # per-row budget (host data — every dispatch reuses the one
+        # compiled draft program; an all-normal/banned step pays one
+        # dispatch, not k+1). Iteration j is active for row r while
+        # j <= k_r[r], so short-budget rows mask out and row r's last
+        # iteration writes its k_r-th draft's K/V — a fully-accepted
+        # chunk leaves no hole. Chunk columns past kmax are zero pad
+        # the fixed-width verify program never reads (lengths <= kmax+1)
+        t0 = time.perf_counter()
+        u = eng._place_rows(jnp.asarray(tokens))
+        dcarry = eng.pool.draft_carry
+        kmax = int(k_r[active].max()) if active.any() else 0
+        drafts = []
+        for j in range(kmax + 1):
+            act_j = eng._place_rows(jnp.asarray(active & (k_r >= j)))
+            logp_d, dcarry = self._draft_step_fn(
+                self._draft_params, u, act_j, dcarry)
+            u = jnp.argmax(logp_d, axis=-1).astype(jnp.int32)
+            if j < self.k:
+                drafts.append(u)
+        while len(drafts) < self.k:
+            drafts.append(eng._place_rows(jnp.zeros((N,), jnp.int32)))
+        eng.metrics.add_phase("draft", time.perf_counter() - t0)
+
+        # verify: ONE fixed-width target dispatch for the whole fleet
+        lengths = np.where(active, k_r + 1, 0).astype(np.int32)
+        vtoks = eng._place_rows(jnp.concatenate(
+            [jnp.asarray(tokens)[:, None]] + [d[:, None] for d in drafts],
+            axis=1))
+        t0 = time.perf_counter()
+        vt, vlp, n_emit, carry = self.verify_fn(
+            eng.params, vtoks, eng._place_rows(jnp.asarray(lengths)),
+            eng.pool.carry, knobs)
+        eng.pool.carry = carry
+        nxt = np.asarray(vt)
+        lps = np.asarray(vlp)
+        nem = np.asarray(n_emit)
+        eng.metrics.add_phase("decode_step", time.perf_counter() - t0)
+
+        # draft rollback: the loop advanced active rows by k_r+1; keep
+        # the accepted prefix + the emission that will be re-fed (pure
+        # pointer arithmetic — stale chunk bytes sit behind the mask)
+        act_dev = eng._place_rows(jnp.asarray(active))
+        dcarry = dict(dcarry)
+        dcarry["pos"] = jnp.where(
+            act_dev,
+            dcarry["pos"] - (eng._place_rows(jnp.asarray(k_r)) + 1)
+            + n_emit,
+            dcarry["pos"])
+        eng.pool.draft_carry = dcarry
+
+        eng.metrics.on_step(eng.scheduler.queue_depth,
+                            eng.pool.occupancy(), int(active.sum()))
+        eng.metrics.on_sample_rows(n_sampled, len(running) - n_sampled)
+
+        # emission: the baseline per-token accounting, applied to each
+        # chunk token IN ORDER and truncated at the first stop — a stop
+        # mid-chunk discards the tail exactly as the baseline engine
+        # would never have sampled it (the row is evicted; its
+        # over-advanced lane/counts die with the slot)
+        emitted: Dict[int, int] = {}
+        n_landed = 0          # chunk tokens that actually reached outputs
+        now = time.perf_counter()
+        for slot, req in list(running.items()):
+            m = int(nem[slot])
+            reason = None
+            for j in range(m):
+                tok1 = int(nxt[slot, j]) + 1        # back to 1-based
+                req.output.append(tok1)
+                req.logprobs.append(float(lps[slot, j]))
+                emitted[req.req_id] = tok1
+                n_landed += 1
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                    eng.metrics.on_first_token(now - req.submit_time)
+                reason = eng._finish_check(req)
+                if reason is not None:
+                    break
+            if reason is not None:
+                eng._finish_row(req, reason, now)
+            else:
+                req.next_token = int(nxt[slot, m - 1])
+                eng._maybe_flip_ban(slot, req)
+        # accounted AFTER truncation: accepted = landed minus the one
+        # non-draft draw per row, so accept_rate/tokens_per_step report
+        # what the engine actually emitted, not what the verify step
+        # confirmed before a mid-chunk stop discarded the tail
+        n_rows = int(active.sum())
+        eng.metrics.on_spec_step(int(k_r[active].sum()),
+                                 n_landed - n_rows, n_rows)
+        return emitted

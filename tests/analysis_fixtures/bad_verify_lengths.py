@@ -1,0 +1,35 @@
+"""SPMD105 fixtures: the speculative VERIFY-step pattern.
+
+``make_batch_verify_step`` takes per-row draft ``lengths`` as a runtime
+array of ONE compiled (N, width) program — that is the whole
+zero-compile contract of mixed speculative/normal traffic.  The
+tempting spelling is to branch on (or iterate up to) each row's length
+in Python: on a tracer that raises TracerBoolConversionError, and the
+"fix" of hoisting lengths to the host bakes one traffic mix into the
+program — a recompile per distinct draft-budget mix.  Mask arithmetic
+(``jnp.arange(width) < lengths[:, None]``) is the legal spelling and
+must not be flagged.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def verify_chunk(params, tokens, lengths, carry):
+    # legal spelling: per-row chunk validity is MASK arithmetic, so the
+    # traced lengths stay runtime values of the one program
+    width = tokens.shape[1]                      # static shape — fine
+    inb = jnp.arange(width)[None] < lengths[:, None]
+    x = jnp.where(inb, tokens, 0)
+    if tokens.ndim != 2:                         # static fact — fine
+        x = x[None]
+    if lengths.max() > 0:  # EXPECT: SPMD105
+        x = x + 1
+    while lengths.sum() > 0:  # EXPECT: SPMD105
+        lengths = lengths - 1
+    bonus = 1 if lengths[0] else 0  # EXPECT: SPMD105
+    n_emit = jnp.where(lengths > 0, 1, 0) + bonus
+    return x, n_emit, carry
+
+
+verify_step = jax.jit(verify_chunk)
