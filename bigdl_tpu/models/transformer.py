@@ -1553,19 +1553,22 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
     ``mesh``/``kv_quant`` follow :func:`make_batch_decode_step`: the
     tensor-parallel lowering shards heads/MLP hidden over
     ``model_axis`` with slot rows over ``data_axis`` (chunk outputs
-    replicate over the model axis like the sampled step's), and the
-    int8 cache quantizes chunk writes through the grow-only
-    (slot, head) scale merge with the chunk's own attention reading
-    the dequantized values (the batch-prefill spelling). int8 caveat:
-    the merge's amax covers the WHOLE chunk — the in-step attention
-    needs every position dequantizable before acceptance is known —
-    so a REJECTED draft can grow a row's scale one step early (bounded
-    by the merge's <= half-quantum requant error); exact
-    draft-independence is the float cache's property. Persisting an
-    accepted-only merge would need the chunk attention to read float
-    chunk K/V with the scatter deferred past acceptance — a
-    restructure noted in ROADMAP, not worth a second full-row requant
-    per step here.
+    replicate over the model axis like the sampled step's). The int8
+    cache path merges ACCEPTED COLUMNS ONLY: the chunk's own attention
+    reads the stored cache dequantized at its CURRENT (pre-merge)
+    scales with the chunk's float K/V overlaid in place, and the
+    grow-only (slot, head) scale merge + quantized scatter are
+    DEFERRED until ``n_emit`` is known — the amax covers emitted
+    positions alone and only they are written, so a REJECTED draft can
+    never touch a row's scales or stored bytes: two steps from the same
+    state whose accepted outcome agrees return BITWISE-identical
+    carries no matter what their rejected columns held (unit-pinned in
+    tests/test_serving_kv_quant.py::test_int8_draft_independence_exact,
+    with end-to-end stream equality across good/garbage drafts pinned
+    beside it). The trade, tiny and documented: in-step attention sees
+    the chunk's own K/V unrounded (the plain decode step reads the
+    current token int8-roundtripped), so int8 spec-vs-baseline parity
+    stays the pinned-config contract it always was.
 
     Caller contract (the engine enforces it): ``pos[r] + lengths[r] <=
     max_len`` — out-of-range columns would be silently dropped by the
@@ -1623,6 +1626,7 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
                      axis=0)                          # (N, S, Hid)
         x = x + jnp.take(pos_w, jnp.clip(qpos, 0, max_len - 1), axis=0)
         new_carry = dict(carry)
+        chunk_kv = []            # per-layer float chunk K/V (int8 path)
         for i, (blk, bp) in enumerate(blocks):
             h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
             ap = bp[blk._child_key(1)]
@@ -1630,30 +1634,26 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
             k = _proj(ap["wk"], h).reshape(N, S, heads_l, hd)
             v = _proj(ap["wv"], h).reshape(N, S, heads_l, hd)
             if kv_quant:
-                # int8 storage: the batch-prefill spelling — valid-column
-                # amax, grow-only merge, dropped-index quantized scatter,
-                # chunk attention over the dequantized cache
+                # int8 storage, ACCEPTED-ONLY merge: the chunk attention
+                # reads the stored cache dequantized at the CURRENT
+                # scales with the chunk's own FLOAT K/V overlaid (cast
+                # to fp32, the quantized path's attention dtype); the
+                # scale merge + quantized scatter are deferred past
+                # acceptance (below), so nothing a rejected draft
+                # produced can reach the carry
                 k32 = k.astype(jnp.float32)
                 v32 = v.astype(jnp.float32)
-                inbf = inb[:, :, None, None]
-                k_amax = jnp.max(jnp.abs(k32) * inbf, axis=(1, 3))
-                v_amax = jnp.max(jnp.abs(v32) * inbf, axis=(1, 3))
-                kc_rq, ks_new, ks_safe = _kv_quant_merge(
-                    new_carry[f"k{i}"], new_carry[f"k{i}_scale"], k_amax)
-                vc_rq, vs_new, vs_safe = _kv_quant_merge(
-                    new_carry[f"v{i}"], new_carry[f"v{i}_scale"], v_amax)
-                kc = kc_rq.at[rows[:, None], widx].set(
-                    _kv_quantize(k32, ks_safe[:, None, :, None]),
-                    mode="drop")
-                vc = vc_rq.at[rows[:, None], widx].set(
-                    _kv_quantize(v32, vs_safe[:, None, :, None]),
-                    mode="drop")
-                new_carry[f"k{i}_scale"] = ks_new
-                new_carry[f"v{i}_scale"] = vs_new
-                katt = kc.astype(jnp.float32) * ks_new[:, None, :, None]
-                vatt = vc.astype(jnp.float32) * vs_new[:, None, :, None]
+                ks_old = new_carry[f"k{i}_scale"]
+                vs_old = new_carry[f"v{i}_scale"]
+                katt = (new_carry[f"k{i}"].astype(jnp.float32)
+                        * ks_old[:, None, :, None]).at[
+                            rows[:, None], widx].set(k32, mode="drop")
+                vatt = (new_carry[f"v{i}"].astype(jnp.float32)
+                        * vs_old[:, None, :, None]).at[
+                            rows[:, None], widx].set(v32, mode="drop")
                 qatt = (q * scale).astype(jnp.float32)
                 p_dt = jnp.float32
+                chunk_kv.append((k32, v32))
             else:
                 kc = new_carry[f"k{i}"].at[rows[:, None], widx].set(
                     k.astype(cache_dtype), mode="drop")
@@ -1662,7 +1662,7 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
                 katt, vatt = kc, vc
                 qatt = (q * scale).astype(cache_dtype)
                 p_dt = cache_dtype
-            new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
+                new_carry[f"k{i}"], new_carry[f"v{i}"] = kc, vc
             # each chunk position attends over the row's full cache
             # window under the absolute causal mask; fp32 accumulation
             s = jnp.einsum("blhd,bmhd->bhlm", qatt, katt,
@@ -1720,6 +1720,33 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
             n_acc = jnp.zeros((N,), jnp.int32)
         active = lengths > 0
         n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+        if kv_quant:
+            # the DEFERRED accepted-only int8 commit: amax over emitted
+            # columns alone, grow-only merge, quantized scatter of
+            # exactly those columns (rejected drafts leave scales AND
+            # stored bytes bitwise untouched — inactive rows write
+            # nothing, amax 0, so their scales pass through bitwise
+            # like every other write path's inactive rows). One
+            # unconditional full-row requant per layer — the same cost
+            # the in-loop merge paid before the restructure.
+            emit = jnp.arange(S)[None] < n_emit[:, None]      # (N, S)
+            emitf = emit[:, :, None, None]
+            widx_e = jnp.where(emit, qpos, max_len)
+            for i, (k32, v32) in enumerate(chunk_kv):
+                k_amax = jnp.max(jnp.abs(k32) * emitf, axis=(1, 3))
+                v_amax = jnp.max(jnp.abs(v32) * emitf, axis=(1, 3))
+                kc_rq, ks_new, ks_safe = _kv_quant_merge(
+                    new_carry[f"k{i}"], new_carry[f"k{i}_scale"], k_amax)
+                vc_rq, vs_new, vs_safe = _kv_quant_merge(
+                    new_carry[f"v{i}"], new_carry[f"v{i}_scale"], v_amax)
+                new_carry[f"k{i}"] = kc_rq.at[rows[:, None], widx_e].set(
+                    _kv_quantize(k32, ks_safe[:, None, :, None]),
+                    mode="drop")
+                new_carry[f"v{i}"] = vc_rq.at[rows[:, None], widx_e].set(
+                    _kv_quantize(v32, vs_safe[:, None, :, None]),
+                    mode="drop")
+                new_carry[f"k{i}_scale"] = ks_new
+                new_carry[f"v{i}_scale"] = vs_new
         # lane/counts advance by EXACTLY n_emit draws. The lane: select
         # the key after the last emitted draw from the (S, N, 2) split
         # history (inactive rows stay bitwise untouched). The counts:

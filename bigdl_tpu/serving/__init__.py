@@ -12,7 +12,13 @@ across batching and slot readmission. Admission itself is batched and
 shape-stable: ragged prompts prefill together through a bounded set of
 power-of-two length buckets (``admission.py``), optionally reusing
 shared-prefix K/V from a ref-counted radix cache (``prefix_cache.py``).
-See ``docs/serving.md``.
+The plane is OPERABLE under faults and overload (``scheduler.py`` +
+``faults.py``): priority classes with per-request deadlines and
+loss-free preemption (evicted rows resume byte-identically), bounded-
+queue admission backpressure with shed/deadline-drop/degrade policies,
+and a step watchdog + deterministic fault injector whose
+retry-with-evict recovery replays failed, garbage, or stalled steps
+without ever wedging the engine. See ``docs/serving.md``.
 
     from bigdl_tpu.serving import SamplingParams, ServingEngine
 
@@ -26,8 +32,13 @@ See ``docs/serving.md``.
     print(eng.metrics.summary())     # TTFT percentiles, tokens/sec, ...
 """
 
-from bigdl_tpu.serving.admission import AdmissionController, bucket_len
+from bigdl_tpu.serving.admission import (
+    AdmissionController, Degrade, bucket_len,
+)
 from bigdl_tpu.serving.engine import ServingEngine
+from bigdl_tpu.serving.faults import (
+    FaultError, FaultInjector, VirtualClock, WatchdogConfig,
+)
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.prefix_cache import PrefixCache
@@ -42,4 +53,5 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "Scheduler", "AdmissionController", "PrefixCache",
            "SamplingParams", "SpeculativeConfig", "bucket_len",
            "ShardedEngine", "ShardedKVPool", "make_mesh",
-           "emulate_cpu_devices"]
+           "emulate_cpu_devices", "Degrade", "FaultError",
+           "FaultInjector", "VirtualClock", "WatchdogConfig"]

@@ -52,10 +52,37 @@ reusable across mesh shapes.
 
 from __future__ import annotations
 
-import time
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from bigdl_tpu.serving.prefix_cache import PrefixCache
+
+
+@dataclass(frozen=True)
+class Degrade:
+    """A request's graceful-degradation knobs, applied AT ADMISSION when
+    the engine is under pressure (queue depth ≥ the engine's
+    ``degrade_at`` — see ``ServingEngine``): ``max_new_tokens`` caps the
+    request's token budget (never raises it), ``draft_tokens`` replaces
+    its speculative budget (``0`` disables speculation for the request —
+    on a loaded engine the draft dispatches are pure added latency for
+    everyone else in the batch). Both are per-row RUNTIME data of the
+    already-compiled programs, so degrading traffic never recompiles —
+    the same shape-stability rule every serving knob follows. ``None``
+    fields leave the request untouched; a request with no ``degrade``
+    attached is never degraded."""
+
+    max_new_tokens: Optional[int] = None
+    draft_tokens: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_new_tokens is not None and self.max_new_tokens <= 0:
+            raise ValueError(
+                f"max_new_tokens must be positive, got "
+                f"{self.max_new_tokens}")
+        if self.draft_tokens is not None and self.draft_tokens < 0:
+            raise ValueError(
+                f"draft_tokens must be >= 0, got {self.draft_tokens}")
 
 
 def bucket_len(n: int, cap: int) -> int:
@@ -126,30 +153,59 @@ class AdmissionController:
     def admit(self, n: int) -> None:
         """Admit ``n`` scheduler-approved requests: allocate slots,
         route each prompt through the prefix cache, then prefill the
-        misses bucket-by-bucket."""
+        misses bucket-by-bucket.
+
+        Admission covers READMISSION too: a preempted or fault-evicted
+        request re-enters here with its emitted tokens in
+        ``req.output``, so its "prompt" for prefill purposes is
+        ``prompt + output`` (``eng._admitted_prefill_tokens``) — the
+        replay contract that makes eviction loss-free. A PREEMPTED row
+        carries its stashed KV slice (``req.resume_carry``) and
+        scatters it straight back (zero prefill work, byte-exact);
+        fault-evicted rows replay through the normal prefill pipeline
+        (their carry was never trusted). A prefill dispatch that FAULTS
+        (injected or real — serving/faults.py) requeues exactly its own
+        rows and frees their slots; other buckets in the round admit
+        normally."""
+        from bigdl_tpu.serving.faults import FaultError
+
         eng = self.engine
         groups: Dict[int, List[Tuple]] = {}    # L_bucket -> (req, slot, pf)
         for _ in range(n):
             slot = eng.pool.alloc()
             assert slot is not None            # admissible() checked
             req = eng.scheduler.admit(slot)
-            prompt0 = [t - 1 for t in req.prompt]      # 0-based
-            # the last prompt token is the first decode input — exactly
+            # the last fed token is the first decode input — exactly
             # generate()'s convention, so outputs match token-for-token
-            req.next_token = prompt0[-1]
-            pf = prompt0[:-1]                  # tokens to prefill
+            pf = eng._admitted_prefill_tokens(req)
             if not pf:
                 eng.pool.set_pos(slot, 0)
                 continue
-            if self.prefix_cache is not None and self._try_prefix(slot, pf):
+            if req.resume_carry is not None:
+                # byte-exact preemption resume: the evicted row's own
+                # bytes scatter straight back into the pool
+                eng.pool.write_prefill(slot, req.resume_carry, len(pf))
+                req.resume_carry = None
                 continue
+            if self.prefix_cache is not None:
+                try:
+                    if self._try_prefix(slot, pf):
+                        continue
+                except FaultError:
+                    eng._recover_admission([(slot, req)])
+                    continue
             groups.setdefault(bucket_len(len(pf), eng.max_len),
                               []).append((req, slot, pf))
         for L in sorted(groups):
             rows = groups[L]
             # a bucket larger than the row block prefills in chunks
             for lo in range(0, len(rows), self.prefill_rows):
-                self._prefill_bucket(L, rows[lo:lo + self.prefill_rows])
+                chunk = rows[lo:lo + self.prefill_rows]
+                try:
+                    self._prefill_bucket(L, chunk)
+                except FaultError:
+                    eng._recover_admission(
+                        [(slot, req) for req, slot, _ in chunk])
 
     def _try_prefix(self, slot: int, pf: List[int]) -> bool:
         """The prefix-cache path: full hit → clone into the pool;
@@ -165,8 +221,10 @@ class AdmissionController:
             return False
         # the prefill phase timer brackets prefill AND pool scatter,
         # matching the per-request path's accounting exactly (the bench
-        # compares serving/prefill_s across admission modes)
-        t0 = time.perf_counter()
+        # compares serving/prefill_s across admission modes) — on the
+        # ENGINE's clock, like every other serving timer, so injected-
+        # clock runs never mix time sources
+        t0 = eng._clock()
         try:
             if matched == len(pf):             # full hit: zero prefill work
                 eng.pool.write_prefill(slot, carry, len(pf))
@@ -179,16 +237,16 @@ class AdmissionController:
             # the cached carry's pos IS the start offset: the batch
             # prefill continues over the cached prefix, writing only
             # positions matched..len(pf)-1
-            _, out = eng._batch_prefill_fn(
-                eng.params, jnp.asarray(toks),
-                np.asarray([S], np.int32), carry)
+            _, out = eng._dispatch(
+                "prefill", eng._batch_prefill_fn, eng.params,
+                jnp.asarray(toks), np.asarray([S], np.int32), carry)
             eng.metrics.on_prefill_batch(1, 1)
             eng.pool.write_prefill(slot, out, len(pf))
             self.prefix_cache.insert(pf, out)
             return True
         finally:
             self.prefix_cache.release(lease)
-            eng.metrics.add_phase("prefill", time.perf_counter() - t0)
+            eng.metrics.add_phase("prefill", eng._clock() - t0)
 
     def _prefill_bucket(self, L: int, rows: List[Tuple]) -> None:
         """ONE masked multi-row prefill for every miss in an L-bucket,
@@ -204,10 +262,11 @@ class AdmissionController:
         for j, (_, _, pf) in enumerate(rows):
             toks[j, :len(pf)] = pf
             lengths[j] = len(pf)
-        t0 = time.perf_counter()
+        t0 = eng._clock()
         self._note_shape(B, L)
-        _, out = eng._batch_prefill_fn(eng.params, jnp.asarray(toks),
-                                       lengths, self._zero_carry())
+        _, out = eng._dispatch("prefill", eng._batch_prefill_fn,
+                               eng.params, jnp.asarray(toks), lengths,
+                               self._zero_carry())
         eng.metrics.on_prefill_batch(k, B)
         for j, (_, slot, pf) in enumerate(rows):
             eng.pool.write_prefill(slot, out, len(pf), row=j)
@@ -215,4 +274,4 @@ class AdmissionController:
                 self.prefix_cache.insert(pf, self._carry_row(out, j))
         # timer brackets prefill + per-row pool scatter, matching the
         # per-request path's serving/prefill_s accounting
-        eng.metrics.add_phase("prefill", time.perf_counter() - t0)
+        eng.metrics.add_phase("prefill", eng._clock() - t0)

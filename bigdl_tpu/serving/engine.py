@@ -61,17 +61,20 @@ cached prefill's own jit cache.
 
 from __future__ import annotations
 
-import time
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.serving.faults import (
+    FaultError, WatchdogConfig, default_clock,
+)
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.sampling import (
-    SamplingParams, knob_row_values, make_knob_rows, match_stop_sequences,
+    SamplingParams, advance_lane, knob_row_values, make_knob_rows,
+    match_stop_sequences,
 )
-from bigdl_tpu.serving.scheduler import FINISHED, Request, Scheduler
+from bigdl_tpu.serving.scheduler import FINISHED, SHED, Request, Scheduler
 
 
 class ServingEngine:
@@ -82,7 +85,9 @@ class ServingEngine:
     e.g. ``jnp.bfloat16`` — scores and log-softmax stay fp32);
     ``policy`` is the admission policy (``"prefill_priority"`` = admit
     into freed rows before every step, ``"fifo"`` = refill only after
-    the running batch drains — see ``serving.scheduler``);
+    the running batch drains, ``"priority"`` = continuous refill in
+    (priority, deadline, arrival) order with loss-free preemption —
+    see ``serving.scheduler`` and the resilience notes below);
     ``admission`` picks the prompt-ingestion pipeline: ``"batched"``
     (default — bucketed multi-row masked prefill, bounded compile set)
     or ``"per_request"`` (PR 1's B=1-per-admission baseline);
@@ -128,6 +133,51 @@ class ServingEngine:
     runtime data of the one program (``submit(..., draft_tokens=0)``
     rows run as plain decode), and the draft's KV carry rides the same
     pool slots (tests/test_serving_speculative.py).
+
+    RESILIENCE knobs (docs/serving.md "Operating under faults and
+    overload"; all host-side or per-row runtime data — none of them
+    adds a compiled program):
+
+    * ``policy="priority"`` orders the queue by (priority DESC,
+      deadline ASC, arrival) and enables loss-free PREEMPTION
+      (``preemption=False`` disables it): when waiting requests
+      outrank the lowest-priority running row and no slot is free,
+      that row is evicted — its KV slice stashed on the request (and
+      shared into the prefix cache when one is attached) — and
+      readmitted later byte-identically (RNG lanes are request-keyed
+      and recomputable, penalty counts rebuild from the emitted
+      tokens);
+    * ``max_queue`` bounds the waiting BACKLOG (queue depth beyond
+      what the pool's free slots will absorb at the next admission —
+      an idle engine with free capacity never sheds): a ``submit()``
+      arriving past the bound is SHED — it lands in the finished
+      ledger with ``finish_reason="shed"`` and empty output instead
+      of raising (backpressure the caller can observe per request).
+      WAITING requests whose ``deadline_s`` expires before admission
+      are deadline-dropped the same way
+      (``finish_reason="deadline"``);
+    * ``degrade_at`` is the pressure threshold (queue depth at
+      admission) beyond which a request's ``submit(...,
+      degrade=Degrade(...))`` knobs apply — capping
+      ``max_new_tokens`` and/or disabling speculation for that
+      request (graceful degradation instead of shedding);
+    * ``watchdog`` (a :class:`~bigdl_tpu.serving.faults.
+      WatchdogConfig`) bounds step time and per-request retries: a
+      decode/verify dispatch that raises, returns non-finite or
+      out-of-range outputs, or exceeds ``step_timeout_s`` on the
+      engine's clock is treated as FAILED — its outputs are
+      discarded, its rows evicted and replayed from the prompt +
+      emitted tokens (byte-identical streams, pinned by
+      tests/test_serving_faults.py) — and a request evicted more than
+      ``max_retries`` times finishes with ``finish_reason="error"``
+      so a persistent fault fails requests instead of wedging the
+      engine;
+    * ``faults`` (a :class:`~bigdl_tpu.serving.faults.FaultInjector`)
+      deterministically injects step failures / garbage outputs /
+      stalls / admission errors at the engine's dispatch sites — the
+      test harness for all of the above; ``clock`` swaps the engine's
+      time source (a :class:`~bigdl_tpu.serving.faults.VirtualClock`
+      lets deadline and stall tests run without sleeping).
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
@@ -139,7 +189,13 @@ class ServingEngine:
                  seed: int = 0,
                  mesh=None, parallelism=None,
                  kv_dtype: Optional[str] = None,
-                 speculative=None) -> None:
+                 speculative=None,
+                 clock=None,
+                 max_queue: Optional[int] = None,
+                 degrade_at: Optional[int] = None,
+                 preemption: Optional[bool] = None,
+                 watchdog: Optional[WatchdogConfig] = None,
+                 faults=None) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -157,9 +213,34 @@ class ServingEngine:
         if keep_finished is not None and keep_finished < 0:
             raise ValueError(
                 f"keep_finished must be >= 0 or None, got {keep_finished}")
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(
+                f"max_queue must be >= 0 or None, got {max_queue}")
+        if degrade_at is not None and degrade_at < 0:
+            raise ValueError(
+                f"degrade_at must be >= 0 or None, got {degrade_at}")
+        if preemption and policy != "priority":
+            raise ValueError(
+                "preemption=True requires policy='priority' — victim "
+                "selection is a priority-order decision")
+        # resilience wiring: the engine's ONE time source (a
+        # VirtualClock here lets deadline/stall tests move time without
+        # sleeping), the step watchdog, and the optional deterministic
+        # fault injector the dispatch sites consult
+        self._clock = clock if clock is not None else default_clock
+        self.watchdog = watchdog if watchdog is not None \
+            else WatchdogConfig()
+        self._faults = faults
+        self.max_queue = max_queue
+        self.degrade_at = degrade_at
+        # preemption defaults ON for the priority policy (it is the
+        # policy's point), and is meaningless elsewhere
+        self.preemption = (policy == "priority") if preemption is None \
+            else bool(preemption)
         model._ensure_params()
         self.model = model
         self.max_len = model.modules[1].max_len
+        self._vocab = model.modules[0].n_index   # step-health token range
         self.compute_dtype = compute_dtype
         # KV storage format: None follows compute_dtype (the status quo);
         # "int8" switches the pooled cache to the quantized layout
@@ -264,6 +345,9 @@ class ServingEngine:
         # min-tokens ban flip, so the steady-state decode loop reuses
         # the same device arrays instead of re-uploading every step
         self._knobs_device = None
+        # watchdog cold-start grace: the step timeout arms only after
+        # one healthy step has completed (see _timed_out)
+        self._warm = False
         if admission == "batched":
             # the tensor-parallel prefill shares the mesh (and must name
             # the sampling carry leaves in its shard_map specs); data-
@@ -302,7 +386,8 @@ class ServingEngine:
 
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
                eos_id: int = -1, sampling: Optional[SamplingParams] = None,
-               draft_tokens: Optional[int] = None) -> int:
+               draft_tokens: Optional[int] = None, priority: int = 0,
+               deadline_s: Optional[float] = None, degrade=None) -> int:
         """Queue one generation request (1-based prompt ids, like
         ``generate()``); returns its request id. Raises if the request
         could ever overflow the cache (same ``max_len`` guard as
@@ -320,31 +405,72 @@ class ServingEngine:
         draft count, 0 = plain decode for this request, n = at most n
         drafts per super-step, clamped to the engine's ``k``; ignored
         by non-speculative engines, so traces stay portable across
-        engine configs)."""
+        engine configs).
+
+        Resilience knobs (ignored semantically outside their engine
+        configs, so traces stay portable): ``priority`` orders the
+        queue and selects preemption victims under ``policy=
+        "priority"`` (higher admits first); ``deadline_s`` is the
+        request's completion SLO in seconds after submit (expired
+        WAITING requests are dropped with ``finish_reason="deadline"``,
+        late finishes count against ``serving/goodput``); ``degrade``
+        is a :class:`~bigdl_tpu.serving.admission.Degrade` applied at
+        admission when the engine is under pressure. When the engine's
+        ``max_queue`` is set and the waiting BACKLOG (queue depth minus
+        free slots) has reached it, the request is SHED instead of
+        queued: it lands in the finished ledger with
+        ``finish_reason="shed"`` and empty output — still returns the
+        request id, so callers observe backpressure per request rather
+        than as an exception."""
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("need a non-empty prompt")
         if draft_tokens is not None and int(draft_tokens) < 0:
             raise ValueError(
                 f"draft_tokens must be >= 0 or None, got {draft_tokens}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be positive or None, got {deadline_s}")
         # SamplingParams validates on construction (frozen dataclass)
         sp = sampling if sampling is not None else SamplingParams()
         if sp.max_tokens is not None:
             max_new_tokens = sp.max_tokens
+        if max_new_tokens <= 0:
+            raise ValueError(
+                f"max_new_tokens must be positive, got {max_new_tokens}")
         if len(prompt) - 1 + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the model's max_len "
                 f"{self.max_len} — the cache position would silently "
                 "clamp (same guard as generate())")
+        # every validation precedes the submitted counter and the shed
+        # decision: an invalid call must raise the same way loaded or
+        # idle, and must never skew serving/submitted (goodput's
+        # denominator)
         rid = self._next_id
         self._next_id += 1
-        self.scheduler.submit(Request(
+        req = Request(
             req_id=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_id=int(eos_id), sampling=sp,
             draft_tokens=None if draft_tokens is None else int(draft_tokens),
-            submit_time=time.perf_counter()))
+            priority=int(priority),
+            deadline_s=None if deadline_s is None else float(deadline_s),
+            degrade=degrade,
+            submit_time=self._clock())
         self.metrics.on_submit()
+        # admission backpressure: a bounded queue sheds at the door —
+        # the cheapest place to reject work is before any of it runs.
+        # The bound is on the BACKLOG (waiting beyond what the pool's
+        # free slots will absorb at the next admission), so an idle
+        # engine with free capacity never sheds — max_queue=0 means
+        # "serve up to capacity, queue nothing", not "serve nothing".
+        if self.max_queue is not None \
+                and (self.scheduler.queue_depth - self.pool.free_slots
+                     >= self.max_queue):
+            self._shed(req, "shed")
+            return rid
+        self.scheduler.submit(req)
         return rid
 
     def result(self, req_id: int) -> Optional[np.ndarray]:
@@ -369,13 +495,24 @@ class ServingEngine:
         return None if req is None else np.asarray(req.logprobs, np.float32)
 
     def cancel(self, req_id: int) -> bool:
-        """Cancel a WAITING request: it is dequeued, never occupies a
-        slot, and lands in the finished ledger with state 'cancelled'
-        and empty output. Returns False (no-op) for requests already
-        running, finished, or unknown."""
+        """Cancel a WAITING or RUNNING request. A waiting request is
+        dequeued and never occupies a slot; a RUNNING request's slot is
+        freed immediately — target AND draft caches alike (``pool.free``
+        resets both position counters), mid-speculative-chunk included —
+        and no token is ever emitted for it again (the next step simply
+        has no such row). Either way the request lands in the finished
+        ledger with state 'cancelled', keeping whatever output it had
+        already emitted. Returns False (no-op) for requests already
+        finished or unknown."""
         req = self.scheduler.cancel(req_id)
         if req is None:
-            return False
+            req = self.scheduler.cancel_running(req_id)
+            if req is None:
+                return False
+            slot, req.slot = req.slot, None
+            self.pool.free(slot)
+            self._configured.discard(slot)
+            req.resume_carry = None
         self.metrics.on_cancel()
         self._finished[req_id] = req
         self._evict_finished()
@@ -403,6 +540,26 @@ class ServingEngine:
     def _admit(self) -> None:
         import jax.numpy as jnp
 
+        now = self._clock()
+        # deadline-drop: an expired WAITING request can only miss its
+        # SLO — spending decode steps on it starves requests that can
+        # still make theirs
+        for req in self.scheduler.pop_expired(now):
+            self._shed(req, "deadline")
+        # loss-free preemption (priority policy): evict lowest-priority
+        # running rows while strictly-higher-priority requests wait
+        # without a free slot — each eviction stashes the row's KV for
+        # byte-exact resumption, so this trades latency across classes
+        # without ever trading correctness
+        if self.preemption:
+            while True:
+                victim = self.scheduler.lowest_running()
+                if victim is None:
+                    break
+                demand = self.scheduler.waiting_higher_than(victim.priority)
+                if demand <= self.pool.free_slots:
+                    break
+                self._preempt_row(victim)
         n = self.scheduler.admissible(self.pool.free_slots)
         if not n:
             return
@@ -416,21 +573,160 @@ class ServingEngine:
             slot = self.pool.alloc()
             assert slot is not None          # admissible() checked
             req = self.scheduler.admit(slot)
-            prompt0 = [t - 1 for t in req.prompt]     # 0-based
-            if len(prompt0) > 1:
-                t0 = time.perf_counter()
-                ptoks = jnp.asarray([prompt0[:-1]], jnp.int32)
-                _, pc = self._prefill_fn(self.params, ptoks,
-                                         self._zero_carry1)
-                self.pool.write_prefill(slot, pc, len(prompt0) - 1)
-                self.metrics.add_phase("prefill",
-                                       time.perf_counter() - t0)
-            else:
-                self.pool.set_pos(slot, 0)
-            # the last prompt token is the first decode input — exactly
+            # the last fed token is the first decode input — exactly
             # generate()'s convention, so outputs match token-for-token
-            req.next_token = prompt0[-1]
+            pf = self._admitted_prefill_tokens(req)
+            if not pf:
+                self.pool.set_pos(slot, 0)
+                continue
+            if req.resume_carry is not None:
+                # byte-exact preemption resume: scatter the stashed row
+                self.pool.write_prefill(slot, req.resume_carry, len(pf))
+                req.resume_carry = None
+                continue
+            t0 = self._clock()
+            ptoks = jnp.asarray([pf], jnp.int32)
+            try:
+                _, pc = self._dispatch("prefill", self._prefill_fn,
+                                       self.params, ptoks,
+                                       self._zero_carry1)
+            except FaultError:
+                self._recover_admission([(slot, req)])
+                continue
+            self.pool.write_prefill(slot, pc, len(pf))
+            self.metrics.add_phase("prefill", self._clock() - t0)
         self._note_shard_balance()
+
+    # -- resilience: shedding, degradation, preemption, recovery -----------
+
+    def _shed(self, req: Request, reason: str) -> None:
+        """Load-shed a request WITHOUT running it (queue-full submit or
+        waiting-deadline expiry): ledgered with ``finish_reason`` set
+        and empty output — observable backpressure, never an
+        exception."""
+        req.state = SHED
+        req.finish_reason = reason
+        req.finish_time = self._clock()
+        self._finished[req.req_id] = req
+        self._evict_finished()
+        self.metrics.on_shed(deadline=(reason == "deadline"))
+
+    def _maybe_degrade(self, req: Request) -> None:
+        """Apply the request's ``degrade`` knob at FIRST admission when
+        the waiting queue is at or past ``degrade_at`` — pure host-side
+        bookkeeping (the caps become per-row runtime data)."""
+        if (req.degrade is None or req.degraded or req.output
+                or self.degrade_at is None
+                or self.scheduler.queue_depth < self.degrade_at):
+            return
+        d = req.degrade
+        if d.max_new_tokens is not None:
+            req.max_new_tokens = min(req.max_new_tokens,
+                                     int(d.max_new_tokens))
+        if d.draft_tokens is not None:
+            req.draft_tokens = int(d.draft_tokens)
+        req.degraded = True
+        self.metrics.on_degrade()
+
+    def _admitted_prefill_tokens(self, req: Request) -> List[int]:
+        """0-based tokens whose K/V must be resident before ``req``
+        decodes: the original prompt plus everything already emitted —
+        empty output for fresh requests, the REPLAY source for
+        preempted/fault-evicted rows (the stream is its own lineage:
+        re-prefilling ``prompt + output`` reconstructs exactly the
+        cache state the evicted row had). Sets ``req.next_token`` to
+        the last fed token and applies the degrade knob under
+        pressure; returns everything before it (the prefill list)."""
+        self._maybe_degrade(req)
+        fed0 = [t - 1 for t in req.prompt] + [t - 1 for t in req.output]
+        req.next_token = fed0[-1]
+        return fed0[:-1]
+
+    def _dispatch(self, site: str, fn, *args):
+        """Every serving-path device dispatch routes through here so
+        the optional :class:`~bigdl_tpu.serving.faults.FaultInjector`
+        can fail, corrupt, or stall it deterministically — a no-op
+        passthrough without one."""
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def _preempt_row(self, victim: Request) -> None:
+        """Loss-free preemption of one RUNNING row: stash its pooled
+        carry slice on the request (scattered back bitwise at
+        readmission), share it into the prefix cache when one is
+        attached (any request on the same prefix benefits), then free
+        the slot and requeue the request at its ORIGINAL arrival key —
+        preemption reorders latency, never tokens."""
+        slot = victim.slot
+        row = self.pool.read_row(slot)
+        if len(victim.prompt) + len(victim.output) > 1:
+            victim.resume_carry = row
+            if self.prefix_cache is not None:
+                fed0 = [t - 1 for t in victim.prompt] + \
+                       [t - 1 for t in victim.output]
+                self.prefix_cache.insert(fed0[:-1], row)
+        victim.preemptions += 1
+        self.scheduler.requeue(victim)            # running -> waiting
+        self.pool.free(slot)
+        self._configured.discard(slot)
+        self.metrics.on_preempt()
+
+    def _recover_rows(self, rows, now: float) -> None:
+        """Fault-recovery disposition for evicted rows: requeue each
+        request for loss-free replay (its carry is never trusted — the
+        stream replays via prefill of ``prompt + output``), or fail it
+        out with ``finish_reason='error'`` once past the watchdog's
+        per-request retry budget. Either way the engine keeps making
+        progress — a persistent fault fails requests, not the engine."""
+        for slot, req in rows:
+            self._configured.discard(slot)
+            req.retries += 1
+            req.resume_carry = None
+            mr = self.watchdog.max_retries
+            if mr is not None and req.retries > mr:
+                self._finish_row(req, "error", now)   # frees the slot
+            else:
+                self.scheduler.requeue(req)           # running -> waiting
+                self.pool.free(slot)
+                self.metrics.on_retry()
+
+    def _recover_admission(self, rows) -> None:
+        """An admission-side prefill dispatch faulted: evict exactly
+        its rows (slots freed, requests requeued or failed out); other
+        buckets in the same admission round proceed normally."""
+        self._recover_rows(rows, self._clock())
+
+    def _recover_step(self, running, kind: str) -> None:
+        """A decode/verify step failed (raised dispatch, garbage
+        outputs, watchdog timeout): discard the step's outputs and
+        evict EVERY implicated row — a whole-batch dispatch fault
+        cannot be attributed to one row — for loss-free replay."""
+        self._recover_rows(list(running.items()), self._clock())
+
+    def _step_unhealthy(self, nxt, lps, active) -> Optional[str]:
+        """Garbage verdict on a decode step's host-read outputs:
+        non-finite chosen log-probs or out-of-range tokens on active
+        rows (the NaN-logits / corrupted-readback failure shape).
+        None = healthy."""
+        if active.any():
+            a_tok, a_lp = nxt[active], lps[active]
+            if (not np.isfinite(a_lp).all() or (a_tok < 0).any()
+                    or (a_tok >= self._vocab).any()):
+                return "garbage"
+        return None
+
+    def _timed_out(self, elapsed: float) -> bool:
+        """Watchdog timeout verdict. The timeout arms only after the
+        engine's FIRST healthy step: a cold engine's first dispatch
+        carries the one-time XLA compile (multi-second at LM scale on a
+        real clock), and evicting the whole batch for a healthy-but-
+        compiling device would burn every request's retry budget at
+        startup. A stall missed during that grace window is only a slow
+        CORRECT step — its outputs are valid, so accepting them costs
+        latency, never correctness."""
+        to = self.watchdog.step_timeout_s
+        return to is not None and self._warm and elapsed > to
 
     def _note_shard_balance(self) -> None:
         """Post-admission shard-balance sample (sharded pools only):
@@ -458,18 +754,35 @@ class ServingEngine:
 
     def _configure_slot(self, slot: int, req: Request) -> None:
         """Thread one admitted request's SamplingParams into its slot:
-        knob rows on host, RNG lane + penalty state on device."""
+        knob rows on host, RNG lane + penalty state on device. For a
+        READMITTED request (preempted or fault-evicted mid-stream —
+        ``req.output`` non-empty) the state resumes where it left off:
+        the lane fast-forwards by one split per emitted draw
+        (:func:`~bigdl_tpu.serving.sampling.advance_lane` — the lane
+        after n draws is a pure function of the request seed), penalty
+        counts rebuild from the emitted tokens, and the min-tokens ban
+        reflects the CURRENT output length, not the fresh-request
+        default. That host-side reconstruction is the whole loss-free
+        eviction contract's second half (the KV half is prefill
+        replay/the stashed row)."""
         sp = req.sampling
         scal, ban_row = knob_row_values(sp, req.eos_id)
         for k, v in scal.items():
             self._knobs[k][slot] = v
         self._knobs["ban_ids"][slot] = ban_row
         self._ban_base[slot] = self._knobs["ban"][slot]
+        if self._ban_base[slot] and req.output:
+            # resumed mid-stream: the ban may already have lifted
+            self._knobs["ban"][slot] = len(req.output) < sp.min_tokens
         self._knobs_device = None                # re-upload next step
-        self.pool.write_sampling(slot, self._lane_key(req), req.prompt)
+        key = self._lane_key(req)
+        if req.output:
+            key = advance_lane(key, len(req.output))
+        self.pool.write_sampling(slot, key, req.prompt,
+                                 output_ids=req.output)
         if self._spec is not None:
-            # the draft cache ingests the prompt alongside the target's
-            # (every admission path configures through here)
+            # the draft cache ingests the fed stream alongside the
+            # target's (every admission path configures through here)
             self._spec.prefill_draft(slot, req)
         self._configured.add(slot)
 
@@ -494,16 +807,28 @@ class ServingEngine:
 
     def _finish_row(self, req: Request, reason: str, now: float) -> None:
         """Evict a finished request: free its slot, ledger it, account
-        the latency/throughput metrics."""
+        the latency/throughput metrics (plus the SLO verdict for
+        goodput, and the recovery-success counter for requests that
+        survived a fault eviction)."""
         req.finish_reason = reason
+        req.resume_carry = None
         freed = self.scheduler.finish(req, now)
         self.pool.free(freed)
         self._configured.discard(freed)
         self._finished[req.req_id] = req
         self._evict_finished()
+        if reason == "error":
+            met = None          # neither goodput nor a deadline miss
+        else:
+            dl = req.deadline_time
+            met = dl is None or now <= dl
+            if req.retries > 0:
+                self.metrics.on_recovered()
         self.metrics.on_finish(
             now - req.submit_time, len(req.output),
-            mean_logprob=float(np.mean(req.logprobs)))
+            mean_logprob=(float(np.mean(req.logprobs))
+                          if req.logprobs else None),
+            met_deadline=met)
 
     def _maybe_flip_ban(self, slot: int, req: Request) -> None:
         """min-tokens ban lifts the step the floor is met — a runtime
@@ -539,27 +864,47 @@ class ServingEngine:
             tokens[slot] = req.next_token
             active[slot] = True
             n_sampled += not req.sampling.is_greedy
-        t0 = time.perf_counter()
+        t0 = self._clock()
         if self._knobs_device is None:
             self._knobs_device = {k: self._place_rows(jnp.asarray(v))
                                   for k, v in self._knobs.items()}
         knobs = self._knobs_device
-        tok, chosen, carry = self._step_fn(
-            self.params, self._place_rows(jnp.asarray(tokens)),
-            self._place_rows(jnp.asarray(active)),
-            self.pool.carry, knobs)
+        try:
+            tok, chosen, carry = self._dispatch(
+                "decode", self._step_fn,
+                self.params, self._place_rows(jnp.asarray(tokens)),
+                self._place_rows(jnp.asarray(active)),
+                self.pool.carry, knobs)
+        except FaultError:
+            # the dispatch failed BEFORE running: the pooled carry was
+            # never donated and stays valid — evict + replay the rows
+            self._recover_step(running, "fail")
+            return {}
         self.pool.carry = carry
         # the (N, V) distribution never crosses to host — sampling is
         # fused into the step; only token ids + chosen log-probs do
+        # (the readback also syncs the dispatch, so the watchdog's
+        # elapsed time covers the device work, not just the launch)
         nxt = np.asarray(tok)
         lps = np.asarray(chosen)
-        self.metrics.add_phase("decode_step", time.perf_counter() - t0)
+        elapsed = self._clock() - t0
+        self.metrics.add_phase("decode_step", elapsed)
+        bad = self._step_unhealthy(nxt, lps, active)
+        if bad is None and self._timed_out(elapsed):
+            bad = "timeout"
+        if bad is not None:
+            # outputs discarded; the returned carry is committed only
+            # so the pool keeps valid (post-donation) buffers — every
+            # implicated row is evicted, so its bytes die with the slot
+            self._recover_step(running, bad)
+            return {}
+        self._warm = True                  # arms the watchdog timeout
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.occupancy(), int(active.sum()))
         self.metrics.on_sample_rows(n_sampled, len(running) - n_sampled)
 
         emitted: Dict[int, int] = {}
-        now = time.perf_counter()
+        now = self._clock()
         for slot, req in list(running.items()):
             tok0 = int(nxt[slot])
             tok1 = tok0 + 1                      # back to 1-based ids

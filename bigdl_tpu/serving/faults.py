@@ -1,0 +1,217 @@
+"""Fault injection + watchdog config for the serving plane.
+
+A serving engine that assumes every device dispatch succeeds is one
+slow host, one NaN'd logit, or one wedged step away from dropping its
+whole batch. This module is the RESILIENCE half of the serving plane's
+operability story (docs/serving.md "Operating under faults and
+overload"): a deterministic fault injector the engine's dispatch sites
+consult, a virtual clock so stalls are SIMULATED (tier-1 runs no
+sleeps), and the watchdog knobs that bound how long a step may take and
+how many times one request may be retried before it is failed out.
+
+Recovery leans on the property the serving plane already owns: the
+request stream is LOSS-FREE under eviction + readmission (per-request
+RNG lanes + prefill replay of ``prompt + emitted``), so the engine's
+answer to ANY suspect step — a raised dispatch, garbage outputs, a
+watchdog timeout — is uniform: discard the step's outputs, evict the
+implicated rows, and let normal admission replay them byte-identically
+(pinned by tests/test_serving_faults.py). The BigDL reference survives
+executor loss the same way — recompute from lineage rather than
+checkpointing per-task state (arXiv:1804.05839); here "lineage" is the
+emitted token stream itself.
+
+Injection is DETERMINISTIC BY SEED: every dispatch draws one uniform
+from a private ``numpy`` Generator, so a (seed, trace) pair replays the
+same fault schedule run after run — which is what lets the fault suite
+pin byte-identity instead of eyeballing flakes.
+
+    from bigdl_tpu.serving import FaultInjector, ServingEngine
+    from bigdl_tpu.serving.faults import VirtualClock, WatchdogConfig
+
+    clk = VirtualClock()
+    eng = ServingEngine(
+        lm, n_slots=4, clock=clk,
+        watchdog=WatchdogConfig(step_timeout_s=5.0, max_retries=3),
+        faults=FaultInjector(seed=1, p_fail=0.2, p_stall=0.1,
+                             stall_s=30.0, clock=clk))
+    ...                       # streams identical to the fault-free run
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+class FaultError(RuntimeError):
+    """An injected (or real, if callers raise it) dispatch failure.
+    The engine's recovery path catches exactly this: the step's outputs
+    are discarded and its rows are evicted and replayed."""
+
+    def __init__(self, site: str, kind: str = "fail") -> None:
+        super().__init__(f"injected {kind} at {site!r} dispatch")
+        self.site = site
+        self.kind = kind
+
+
+class VirtualClock:
+    """A manually-advanced clock the engine (and injector) can share.
+
+    The stall fault and the deadline machinery both need TIME to move
+    without the test suite sleeping: pass one instance as the engine's
+    ``clock=`` and the injector's ``clock=`` and a "slow step" is just
+    ``advance(stall_s)`` between dispatch and readback — the watchdog
+    sees the elapsed time, the wall clock sees none of it."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.t = float(start)
+
+    def advance(self, seconds: float) -> None:
+        if seconds < 0:
+            raise ValueError(f"cannot rewind the clock ({seconds})")
+        self.t += float(seconds)
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@dataclass(frozen=True)
+class WatchdogConfig:
+    """Step-health knobs for :class:`ServingEngine`.
+
+    ``step_timeout_s`` — a decode/verify dispatch whose host-side
+    elapsed time (on the ENGINE's clock) exceeds this is treated as
+    failed even though it returned: its outputs are discarded and its
+    rows evicted + replayed (None = no timeout check). The timeout
+    arms only after the engine's first HEALTHY step — a cold engine's
+    first dispatch carries the one-time XLA compile, and a stall
+    accepted during that grace window is merely a slow correct step
+    (latency, never correctness). ``max_retries``
+    — per-REQUEST fault budget: a request evicted by recovery more than
+    this many times finishes with ``finish_reason='error'`` instead of
+    requeueing, so a persistent fault degrades to failed requests, not
+    a wedged engine (None = retry forever; byte-identity still holds,
+    liveness is the caller's problem)."""
+
+    step_timeout_s: Optional[float] = None
+    max_retries: Optional[int] = 3
+
+    def __post_init__(self):
+        if self.step_timeout_s is not None and self.step_timeout_s <= 0:
+            raise ValueError(
+                f"step_timeout_s must be positive, got {self.step_timeout_s}")
+        if self.max_retries is not None and self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}")
+
+
+#: Dispatch sites the engine routes through the injector. "decode" is
+#: the pooled decode step, "verify"/"draft" the speculative plane's two
+#: dispatches, "prefill" every admission-side prefill (B=1, bucketed,
+#: and prefix-suffix alike).
+SITES = ("decode", "verify", "draft", "prefill")
+
+
+class FaultInjector:
+    """Deterministic per-dispatch fault source (module docstring).
+
+    ``p_fail``/``p_garbage``/``p_stall`` apply to STEP sites (decode /
+    verify / draft): raise before dispatching, corrupt the returned
+    outputs (float leaves → NaN, int leaves → -1: the "device returned
+    garbage logits" shape the engine's health check must catch), or
+    advance the shared :class:`VirtualClock` by ``stall_s`` after the
+    dispatch (a slow step the watchdog times out). ``p_admit_fail``
+    applies to the "prefill" site (admission errors). At most one fault
+    fires per dispatch (the probabilities stack); ``max_faults`` caps
+    the total injected so a high-rate schedule still lets traffic
+    through eventually. ``counts`` tallies injections by kind — tests
+    assert faults actually fired instead of passing vacuously."""
+
+    def __init__(self, seed: int = 0, p_fail: float = 0.0,
+                 p_garbage: float = 0.0, p_stall: float = 0.0,
+                 p_admit_fail: float = 0.0, stall_s: float = 10.0,
+                 clock: Optional[VirtualClock] = None,
+                 max_faults: Optional[int] = None) -> None:
+        import numpy as np
+
+        for name, p in (("p_fail", p_fail), ("p_garbage", p_garbage),
+                        ("p_stall", p_stall),
+                        ("p_admit_fail", p_admit_fail)):
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must lie in [0, 1], got {p}")
+        if p_fail + p_garbage + p_stall > 1.0:
+            raise ValueError("p_fail + p_garbage + p_stall must be <= 1")
+        if p_stall > 0.0 and clock is None:
+            raise ValueError(
+                "p_stall needs a shared VirtualClock — stalls are "
+                "simulated by advancing it, never by sleeping")
+        self.p_fail = float(p_fail)
+        self.p_garbage = float(p_garbage)
+        self.p_stall = float(p_stall)
+        self.p_admit_fail = float(p_admit_fail)
+        self.stall_s = float(stall_s)
+        self.clock = clock
+        self.max_faults = max_faults
+        self.counts: Dict[str, int] = {
+            "fail": 0, "garbage": 0, "stall": 0, "admit_fail": 0}
+        self._rng = np.random.default_rng(int(seed))
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def _armed(self) -> bool:
+        return self.max_faults is None or self.total < self.max_faults
+
+    def call(self, site: str, fn, *args):
+        """Dispatch ``fn(*args)`` through the fault schedule. One
+        uniform draw per call decides the outcome, so the schedule is a
+        pure function of (seed, dispatch order)."""
+        u = float(self._rng.random())
+        if site == "prefill":
+            if self._armed() and u < self.p_admit_fail:
+                self.counts["admit_fail"] += 1
+                raise FaultError(site, "admit_fail")
+            return fn(*args)
+        if self._armed() and u < self.p_fail:
+            self.counts["fail"] += 1
+            raise FaultError(site, "fail")
+        out = fn(*args)
+        if self._armed() and u < self.p_fail + self.p_garbage:
+            self.counts["garbage"] += 1
+            return _corrupt(out)
+        if self._armed() and u < self.p_fail + self.p_garbage + self.p_stall:
+            self.counts["stall"] += 1
+            self.clock.advance(self.stall_s)
+        return out
+
+
+def _corrupt(out: Tuple):
+    """The "garbage device output" transform: every float array leaf of
+    a dispatch's output tuple becomes all-NaN and every integer array
+    all -1; dict leaves (the carry) pass through untouched — corrupting
+    the carry would be undetectable by construction, and the engine
+    evicts every implicated row anyway, so the carry's bytes die with
+    the slots regardless."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    def bad(x):
+        if isinstance(x, dict):
+            return x
+        dt = np.dtype(getattr(x, "dtype", np.float32))
+        if dt.kind == "f":
+            return jnp.full_like(x, jnp.nan)
+        if dt.kind in "iu":
+            return jnp.full_like(x, -1)
+        return x
+
+    if isinstance(out, tuple):
+        return tuple(bad(x) for x in out)
+    return bad(out)
+
+
+def default_clock():
+    """The engine's default time source (the real wall clock)."""
+    return time.perf_counter()

@@ -273,6 +273,21 @@ class KVPool:
                                    jnp.int32(slot), jnp.int32(prompt_len),
                                    jnp.int32(row))
 
+    def read_row(self, slot: int) -> Dict:
+        """One allocated slot's carry as a B=1 slice, every leaf (K/V
+        layers + scales, pos, sampling lanes) — the stash a PREEMPTED
+        row leaves behind. The slices are fresh device arrays (jax
+        arrays are immutable), so they survive the slot's ``free()``
+        and later scatter BACK via :meth:`write_prefill` bitwise — the
+        loss-free half of the eviction + readmission contract
+        (``ServingEngine._preempt_row``). The dict is also a valid
+        :class:`~bigdl_tpu.serving.prefix_cache.PrefixCache` entry (the
+        cache stores exactly such B=1 carries), so preempted state can
+        be shared with other requests on the same prefix."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        return {k: v[slot:slot + 1] for k, v in self.carry.items()}
+
     def set_pos(self, slot: int, pos: int) -> None:
         """Set one slot's position counter (the no-prefill admission path:
         a 1-token prompt starts decoding at pos 0)."""
@@ -282,17 +297,24 @@ class KVPool:
 
     # -- sampling lanes ----------------------------------------------------
 
-    def write_sampling(self, slot: int, key, prompt_ids) -> None:
+    def write_sampling(self, slot: int, key, prompt_ids,
+                       output_ids=()) -> None:
         """Seed one slot's SAMPLING state at admission (requires a
         sampling-enabled carry — ``make_batch_decode_step(...,
         sampling=True)``): the row's RNG lane becomes ``key`` (derived
         from the REQUEST's seed, never from the slot — so a request
         readmitted into a different slot after an eviction continues
-        the exact same lane), its generated-token counts reset to zero,
-        and its prompt-membership mask is rebuilt from ``prompt_ids``
-        (1-based; feeds the repetition penalty). Stale state from the
-        slot's previous occupant is fully overwritten — recycled slots
-        leak nothing into the new request's distribution."""
+        the exact same lane), its generated-token counts are rebuilt
+        from ``output_ids`` (empty for a fresh request — zero counts;
+        the tokens emitted so far for a preempted/fault-evicted request
+        being READMITTED mid-stream, reproducing exactly the counts the
+        in-flight row accumulated one draw at a time), and its
+        prompt-membership mask is rebuilt from ``prompt_ids`` (1-based;
+        feeds the repetition penalty — the ORIGINAL prompt only, never
+        the emitted continuation, matching the in-flight state). Stale
+        state from the slot's previous occupant is fully overwritten —
+        recycled slots leak nothing into the new request's
+        distribution."""
         import jax.numpy as jnp
         import numpy as np
 
@@ -307,10 +329,16 @@ class KVPool:
         if len(prompt_ids):
             mask[np.clip(np.asarray(prompt_ids, np.int64) - 1,
                          0, V - 1)] = True
+        counts = np.zeros((V,), np.int32)
+        if len(output_ids):
+            ids, reps = np.unique(
+                np.clip(np.asarray(output_ids, np.int64) - 1, 0, V - 1),
+                return_counts=True)
+            counts[ids] = reps
         self.carry["rng"] = self.carry["rng"].at[slot].set(
             jnp.asarray(key, jnp.uint32))
         self.carry["tok_counts"] = self.carry["tok_counts"].at[slot].set(
-            jnp.int32(0))
+            jnp.asarray(counts))
         self.carry["prompt_mask"] = self.carry["prompt_mask"].at[slot].set(
             jnp.asarray(mask))
 

@@ -58,6 +58,25 @@ Sharded-plane counters (``serving/sharded.py``):
   (max − min allocated slots across shards; 0 = perfectly balanced —
   the balanced allocator keeps it ≤ 1 under drain-style traffic)
 
+Resilience counters (``serving/scheduler.py`` + ``serving/faults.py``):
+
+* ``preempted``         — RUNNING rows evicted loss-free by priority
+  preemption (their streams resume byte-identically at readmission)
+* ``shed``              — requests load-shed without running: queue-full
+  rejections at submit plus deadline-drops of expired waiting requests
+* ``deadline_missed``   — deadline-dropped requests plus FINISHED
+  requests that completed after their deadline
+* ``retries``           — row evictions by fault recovery (a failed /
+  garbage / timed-out step evicts its rows and replays them)
+* ``recovered_rows``    — retried requests that went on to FINISH
+  successfully (the loss-free-recovery success count)
+* ``degraded``          — requests whose ``degrade`` knob was applied
+  at admission under pressure
+* ``finished_in_slo``   — finished requests that met their deadline
+  (no-deadline requests count as met); ``summary()`` derives
+  ``goodput`` = finished_in_slo / submitted — the overload bench's
+  headline (``serving_bench --scenario slo``)
+
 KV-format counters (``serving/kv_pool.py`` — set once at construction):
 
 * ``kv_bits``            — bits per stored K/V element (32/16/8)
@@ -102,12 +121,49 @@ class ServingMetrics:
         self.metrics.add("serving/ttft_s", float(ttft_s))
 
     def on_finish(self, latency_s: float, n_tokens: int,
-                  mean_logprob: Optional[float] = None) -> None:
+                  mean_logprob: Optional[float] = None,
+                  met_deadline: Optional[bool] = None) -> None:
         self.metrics.add("serving/finished", 1.0)
         self.metrics.add("serving/latency_s", float(latency_s))
         self.metrics.add("serving/tokens_out", float(n_tokens))
         if mean_logprob is not None:
             self.metrics.add("serving/mean_logprob", float(mean_logprob))
+        if met_deadline is not None:
+            if met_deadline:
+                self.metrics.add("serving/finished_in_slo", 1.0)
+            else:
+                self.metrics.add("serving/deadline_missed", 1.0)
+
+    # -- resilience hooks (scheduler preemption + fault recovery) ----------
+
+    def on_preempt(self) -> None:
+        """A RUNNING row evicted loss-free to make room for a
+        higher-priority request."""
+        self.metrics.add("serving/preempted", 1.0)
+
+    def on_shed(self, deadline: bool = False) -> None:
+        """A request load-shed without ever running: queue-full
+        rejection at submit, or (``deadline=True``) a deadline-drop of
+        an expired waiting request — the latter also counts as a
+        deadline miss."""
+        self.metrics.add("serving/shed", 1.0)
+        if deadline:
+            self.metrics.add("serving/deadline_missed", 1.0)
+
+    def on_retry(self) -> None:
+        """One row evicted by fault recovery (step failure, garbage
+        outputs, or a watchdog timeout) and requeued for replay."""
+        self.metrics.add("serving/retries", 1.0)
+
+    def on_recovered(self) -> None:
+        """A previously fault-evicted request FINISHED successfully —
+        the recovery path's success counter."""
+        self.metrics.add("serving/recovered_rows", 1.0)
+
+    def on_degrade(self) -> None:
+        """A request's ``degrade`` knob applied at admission under
+        queue pressure."""
+        self.metrics.add("serving/degraded", 1.0)
 
     def on_sample_rows(self, n_sampled: int, n_greedy: int) -> None:
         """Per decode step: how many active rows drew from a sampled
@@ -216,6 +272,22 @@ class ServingMetrics:
         n_g, _ = self.metrics.get("serving/rows_greedy")
         if n_s + n_g > 0:
             out["serving/sampled_row_frac"] = n_s / (n_s + n_g)
+        # count-like resilience counters surface as SUMS (the backing
+        # Metrics means each add-series; "preempted 0.97 mean" is
+        # useless where "preempted 13 rows" is the operational number)
+        for name in ("preempted", "shed", "deadline_missed", "retries",
+                     "recovered_rows", "degraded", "finished_in_slo"):
+            total, n = self.metrics.get(f"serving/{name}")
+            if n:
+                out[f"serving/{name}"] = total
+        n_sub, _ = self.metrics.get("serving/submitted")
+        if n_sub:
+            n_slo, _ = self.metrics.get("serving/finished_in_slo")
+            # goodput: requests that finished USEFULLY (met their
+            # deadline; no-deadline finishes count as met, error
+            # finishes never do) over everything submitted —
+            # shed/dropped/late/errored all count against it
+            out["serving/goodput"] = n_slo / n_sub
         n_draft, _ = self.metrics.get("serving/draft_tokens")
         n_acc, _ = self.metrics.get("serving/accepted_tokens")
         n_rows, _ = self.metrics.get("serving/spec_rows")

@@ -10,28 +10,42 @@ Admission policies:
 * ``"prefill_priority"`` (default) — before EVERY decode step, waiting
   requests are admitted into any free slots (continuous batching: new
   arrivals slot into rows freed mid-flight, minimizing time-to-first-
-  token and keeping the batch full);
+  token and keeping the batch full); FIFO in arrival order;
 * ``"fifo"`` — slots are only refilled once the whole running batch has
   drained (run-to-completion batching, the classic static-batch
   baseline; still FIFO across requests). Useful as the contrast
-  baseline in benchmarks/serving_bench.py.
+  baseline in benchmarks/serving_bench.py;
+* ``"priority"`` — continuous refill like ``prefill_priority``, but the
+  queue orders by (priority DESC, deadline ASC, arrival): higher
+  ``Request.priority`` admits first, earliest absolute deadline breaks
+  ties inside a class (EDF), arrival order breaks the rest. Under this
+  policy the ENGINE may also PREEMPT: when waiting requests outrank the
+  lowest-priority running row and no slot is free, that row is evicted
+  loss-free (its KV row is stashed for byte-exact readmission — see
+  ``ServingEngine._preempt_row``) and requeued WITH ITS ORIGINAL
+  arrival key, so it resumes ahead of later same-priority arrivals.
 
-Both are FIFO in ARRIVAL ORDER — the policies differ only in WHEN free
-slots are refilled, never in which request goes first.
+``requeue()`` is the loss-free re-entry point shared by preemption and
+fault recovery (serving/faults.py): the request keeps its original
+``seq``, its emitted ``output``, and its retry/preemption counters —
+only its place in a slot is given up.
 """
 
 from __future__ import annotations
 
-from collections import deque
+import heapq
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from bigdl_tpu.serving.sampling import SamplingParams
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 CANCELLED = "cancelled"
+SHED = "shed"
 
-_POLICIES = ("prefill_priority", "fifo")
+_POLICIES = ("prefill_priority", "fifo", "priority")
+
+_INF = float("inf")
 
 
 @dataclass
@@ -43,7 +57,21 @@ class Request:
     defaults — the engine normalizes at submit); ``logprobs`` collects
     the chosen tokens' raw model log-probs, one per output token;
     ``finish_reason`` is set by the engine at eviction (``"eos"``,
-    ``"stop"`` for stop-token/stop-sequence hits, ``"length"``)."""
+    ``"stop"`` for stop-token/stop-sequence hits, ``"length"``,
+    ``"deadline"``/``"shed"`` for load-shed requests, ``"error"`` when
+    the fault-recovery retry budget runs out).
+
+    Resilience fields: ``priority`` (higher admits first — only the
+    ``"priority"`` policy reads it), ``deadline_s`` (completion SLO in
+    seconds after submit; expired WAITING requests are deadline-dropped,
+    late finishes count against goodput), ``degrade`` (an optional
+    :class:`~bigdl_tpu.serving.admission.Degrade` applied at admission
+    when the engine is under pressure), ``preemptions``/``retries``
+    (how often this request was preempted / fault-evicted), and
+    ``resume_carry`` — a preempted row's stashed B=1 KV slice, scattered
+    back at readmission for byte-exact resumption (fault recovery
+    clears it and replays via prefill of ``prompt + output`` instead:
+    a suspect step's carry is never trusted)."""
 
     req_id: int
     prompt: List[int]                  # 1-based word ids, non-empty
@@ -64,6 +92,22 @@ class Request:
     submit_time: float = 0.0
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # -- resilience (serving/scheduler.py docstring) -----------------------
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    degrade: Optional[object] = None   # admission.Degrade
+    degraded: bool = False
+    seq: int = -1                      # arrival order, set by submit()
+    preemptions: int = 0
+    retries: int = 0
+    resume_carry: Optional[dict] = None
+
+    @property
+    def deadline_time(self) -> Optional[float]:
+        """Absolute completion deadline on the engine's clock."""
+        if self.deadline_s is None:
+            return None
+        return self.submit_time + self.deadline_s
 
     @property
     def done_reason(self) -> Optional[str]:
@@ -77,49 +121,129 @@ class Request:
 
 
 class Scheduler:
-    """FIFO admission over a fixed slot pool (see module docstring)."""
+    """Priority/FIFO admission over a fixed slot pool (module
+    docstring). The waiting queue is a heap of ``[key, req]`` entries;
+    keys are assigned once per request (requeue reuses them), so a
+    preempted request re-enters at its original position."""
 
     def __init__(self, policy: str = "prefill_priority") -> None:
         if policy not in _POLICIES:
             raise ValueError(
                 f"unknown admission policy {policy!r} (one of {_POLICIES})")
         self.policy = policy
-        self.waiting: Deque[Request] = deque()
+        self._waiting: List[list] = []            # heap of [key, req]
         self.running: Dict[int, Request] = {}     # slot -> request
+        self._seq = 0
+
+    def _key(self, req: Request):
+        if self.policy != "priority":
+            return (0, 0.0, req.seq)
+        dl = req.deadline_time
+        return (-req.priority, _INF if dl is None else dl, req.seq)
 
     def submit(self, req: Request) -> None:
         if not req.prompt:
             raise ValueError("need a non-empty prompt")
         if req.max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
+        if req.seq < 0:
+            req.seq = self._seq
+            self._seq += 1
         req.state = WAITING
-        self.waiting.append(req)
+        heapq.heappush(self._waiting, [self._key(req), req])
+
+    def requeue(self, req: Request) -> None:
+        """Return an evicted RUNNING request to the waiting queue
+        (preemption / fault recovery): its original arrival key — hence
+        its place among same-priority peers — is preserved, and its slot
+        binding is dropped. The engine frees the KV slot."""
+        if req.slot is not None:
+            assert self.running.get(req.slot) is req
+            del self.running[req.slot]
+            req.slot = None
+        req.state = WAITING
+        req.next_token = None
+        heapq.heappush(self._waiting, [self._key(req), req])
 
     def admissible(self, free_slots: int) -> int:
         """How many waiting requests may be admitted right now."""
-        if not free_slots or not self.waiting:
+        if not free_slots or not self._waiting:
             return 0
         if self.policy == "fifo" and self.running:
             return 0          # run-to-completion: wait for a full drain
-        return min(free_slots, len(self.waiting))
+        return min(free_slots, len(self._waiting))
 
     def admit(self, slot: int) -> Request:
-        """Pop the next waiting request (FIFO) and bind it to ``slot``."""
-        req = self.waiting.popleft()
+        """Pop the best waiting request and bind it to ``slot``."""
+        _, req = heapq.heappop(self._waiting)
         req.state = RUNNING
         req.slot = slot
         self.running[slot] = req
         return req
 
+    # -- priority/deadline surface (the engine's preemption loop) ----------
+
+    def top_waiting(self) -> Optional[Request]:
+        """The request the next ``admit()`` would pop, or None."""
+        return self._waiting[0][1] if self._waiting else None
+
+    def waiting_higher_than(self, priority: int) -> int:
+        """Waiting requests that OUTRANK ``priority`` (strictly) — the
+        preemption demand signal."""
+        return sum(1 for _, r in self._waiting if r.priority > priority)
+
+    def lowest_running(self) -> Optional[Request]:
+        """The preemption victim candidate: the lowest-priority running
+        row, most recent arrival first among equals (least time in a
+        slot — replay cost is smallest and its completion is furthest
+        away)."""
+        if not self.running:
+            return None
+        return min(self.running.values(),
+                   key=lambda r: (r.priority, -r.seq))
+
+    def pop_expired(self, now: float) -> List[Request]:
+        """Remove and return WAITING requests whose absolute deadline
+        has already passed — admitting them would spend decode steps on
+        a guaranteed SLO miss. The engine ledgers them with
+        ``finish_reason='deadline'``."""
+        keep, dropped = [], []
+        for entry in self._waiting:
+            req = entry[1]
+            dl = req.deadline_time
+            if dl is not None and now > dl:
+                dropped.append(req)
+            else:
+                keep.append(entry)
+        if dropped:
+            self._waiting = keep
+            heapq.heapify(self._waiting)
+        return dropped
+
+    # -- cancellation -------------------------------------------------------
+
     def cancel(self, req_id: int) -> Optional[Request]:
         """Dequeue a WAITING request: it will never be admitted and
         never occupies a slot. Returns the (now CANCELLED) request, or
-        None if ``req_id`` is not waiting — RUNNING requests are not
-        cancellable here (their slot state is mid-flight; they run to
-        EOS/length like any other row)."""
-        for i, req in enumerate(self.waiting):
+        None if ``req_id`` is not waiting — the ENGINE cancels RUNNING
+        requests (their KV slot must be freed; see
+        ``ServingEngine.cancel``)."""
+        for i, (_, req) in enumerate(self._waiting):
             if req.req_id == req_id:
-                del self.waiting[i]
+                del self._waiting[i]
+                heapq.heapify(self._waiting)
+                req.state = CANCELLED
+                return req
+        return None
+
+    def cancel_running(self, req_id: int) -> Optional[Request]:
+        """Unbind a RUNNING request (engine-driven cancellation): it
+        leaves the running set CANCELLED, with its slot id returned on
+        the request untouched for the engine to free. None if not
+        running."""
+        for slot, req in self.running.items():
+            if req.req_id == req_id:
+                del self.running[slot]
                 req.state = CANCELLED
                 return req
         return None
@@ -135,12 +259,18 @@ class Scheduler:
         return slot
 
     @property
+    def waiting(self) -> List[Request]:
+        """Waiting requests in admission order (a sorted VIEW — the
+        backing store is a heap; kept for introspection/tests)."""
+        return [r for _, r in sorted(self._waiting, key=lambda e: e[0])]
+
+    @property
     def queue_depth(self) -> int:
-        return len(self.waiting)
+        return len(self._waiting)
 
     @property
     def active(self) -> int:
         return len(self.running)
 
     def idle(self) -> bool:
-        return not self.waiting and not self.running
+        return not self._waiting and not self.running

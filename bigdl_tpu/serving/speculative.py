@@ -41,15 +41,14 @@ The serving invariants carry over wholesale:
   stream as the non-speculative engine, across eviction/readmission,
   batching, and admission modes. The draft only decides how many of
   those draws land per step — never their values — which also means a
-  WRONG or weak draft degrades throughput, not correctness. (Scope
-  note: that draft-independence is exact on the FLOAT KV cache. Under
-  ``kv_dtype="int8"`` the verify step's grow-only scale merge amaxes
-  the whole chunk — the in-step attention must dequantize every
-  position before acceptance is known — so a rejected draft can grow
-  a row's (slot, head) scale one step early, bounded by the merge's
-  <= half-quantum requant error: the same caveat class as the int8
-  baseline's own parity contract, pinned by the int8 test in
-  tests/test_serving_speculative.py.)
+  WRONG or weak draft degrades throughput, not correctness. That
+  draft-independence is exact on the int8 cache too: the verify
+  step's chunk attention reads FLOAT chunk K/V with the grow-only
+  scale merge + quantized scatter deferred until acceptance is known,
+  merging over ACCEPTED columns only — a rejected draft can touch
+  neither a row's (slot, head) scales nor its stored bytes (pinned by
+  the garbage-draft parity tests in tests/test_serving_speculative.py
+  and tests/test_serving_kv_quant.py).
   (Acceptance is sampled-token agreement, deliberately traded against
   Leviathan-style distribution-matching rejection sampling, whose
   draft-dependent randomness consumption cannot replay the baseline
@@ -79,7 +78,6 @@ a fully-accepted chunk leaves no hole in the draft cache.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -179,24 +177,27 @@ class Speculator:
     # -- admission ----------------------------------------------------------
 
     def prefill_draft(self, slot: int, req) -> None:
-        """Ingest an admitted request's prompt into the DRAFT cache —
-        called from the engine's slot configuration, so every admission
-        path (batched, per_request, prefix-cache hits) feeds the draft
-        the same way. Bucketed masked B=1 prefill: the compiled
-        draft-prefill set stays bounded by the power-of-two buckets, no
-        matter how many distinct prompt lengths traffic brings. (No
-        draft-side prefix cache: draft prefill is cheap and a stale
+        """Ingest an admitted request's fed stream (prompt + any tokens
+        emitted before a preemption/fault eviction) into the DRAFT
+        cache — called from the engine's slot configuration, so every
+        admission path (batched, per_request, prefix-cache hits,
+        loss-free readmission) feeds the draft the same way. Bucketed
+        masked B=1 prefill: the compiled draft-prefill set stays
+        bounded by the power-of-two buckets, no matter how many
+        distinct prompt lengths traffic brings. (No draft-side prefix
+        cache or preemption stash: draft prefill is cheap and a stale
         draft cache could only cost acceptance, never correctness —
         but the bookkeeping would be real.)"""
         import jax.numpy as jnp
 
         eng = self.engine
-        prompt0 = [t - 1 for t in req.prompt]
+        prompt0 = [t - 1 for t in req.prompt] + \
+                  [t - 1 for t in req.output]
         pf = prompt0[:-1]
         if not pf:
             eng.pool.set_draft_pos(slot, 0)
             return
-        t0 = time.perf_counter()
+        t0 = eng._clock()
         L = bucket_len(len(pf), self.draft_max_len)
         toks = np.zeros((1, L), np.int32)
         toks[0, :len(pf)] = pf
@@ -204,7 +205,7 @@ class Speculator:
             self._draft_params, jnp.asarray(toks),
             np.asarray([len(pf)], np.int32), self._zero_draft1)
         eng.pool.write_draft_prefill(slot, dc, len(pf))
-        eng.metrics.add_phase("draft_prefill", time.perf_counter() - t0)
+        eng.metrics.add_phase("draft_prefill", eng._clock() - t0)
 
     # -- the super-step ------------------------------------------------------
 
@@ -223,6 +224,24 @@ class Speculator:
         rem = req.max_new_tokens - len(req.output)
         return max(0, min(k, rem - 1))
 
+    def _chunk_unhealthy(self, nxt, lps, nem, lengths, active):
+        """Garbage verdict on a verify step's host-read outputs — the
+        chunked twin of ``ServingEngine._step_unhealthy``: active rows
+        must report an emit count in ``1..lengths[r]`` and finite
+        log-probs / in-range tokens over their emitted columns. None =
+        healthy."""
+        if not active.any():
+            return None
+        a_nem = nem[active]
+        if (a_nem < 1).any() or (a_nem > lengths[active]).any():
+            return "garbage"
+        emit = np.arange(nxt.shape[1])[None, :] < nem[:, None]
+        emit &= active[:, None]
+        if (not np.isfinite(lps[emit]).all() or (nxt[emit] < 0).any()
+                or (nxt[emit] >= self.engine._vocab).any()):
+            return "garbage"
+        return None
+
     def step(self, running) -> Dict[int, int]:
         """One draft-and-verify super-step over every active row:
         propose (``k + 1`` draft-decode dispatches), verify (ONE target
@@ -232,10 +251,23 @@ class Speculator:
         at its first stop condition). Returns ``{req_id: last emitted
         1-based token}`` — multi-token emissions land in
         ``Request.output``; the dict mirrors the baseline ``step()``
-        shape for callers that only poll liveness."""
+        shape for callers that only poll liveness.
+
+        Resilience: both dispatch sites route through the engine's
+        fault hook (``draft``/``verify`` — serving/faults.py). A raised
+        dispatch, garbage verify outputs (non-finite log-probs,
+        out-of-range tokens or emit counts), or a super-step exceeding
+        the watchdog budget discards the step and evicts every
+        implicated row for loss-free replay — both pooled carries are
+        first re-pointed at their latest VALID buffers (earlier
+        dispatches in the step donated the old ones), then the rows'
+        bytes die with their freed slots."""
         import jax.numpy as jnp
 
+        from bigdl_tpu.serving.faults import FaultError
+
         eng = self.engine
+        t_start = eng._clock()
         N = eng.pool.n_slots
         tokens = np.zeros((N,), np.int32)
         active = np.zeros((N,), bool)
@@ -261,36 +293,61 @@ class Speculator:
         # iteration writes its k_r-th draft's K/V — a fully-accepted
         # chunk leaves no hole. Chunk columns past kmax are zero pad
         # the fixed-width verify program never reads (lengths <= kmax+1)
-        t0 = time.perf_counter()
+        t0 = eng._clock()
         u = eng._place_rows(jnp.asarray(tokens))
         dcarry = eng.pool.draft_carry
         kmax = int(k_r[active].max()) if active.any() else 0
         drafts = []
-        for j in range(kmax + 1):
-            act_j = eng._place_rows(jnp.asarray(active & (k_r >= j)))
-            logp_d, dcarry = self._draft_step_fn(
-                self._draft_params, u, act_j, dcarry)
-            u = jnp.argmax(logp_d, axis=-1).astype(jnp.int32)
-            if j < self.k:
-                drafts.append(u)
+        try:
+            for j in range(kmax + 1):
+                act_j = eng._place_rows(jnp.asarray(active & (k_r >= j)))
+                logp_d, dcarry = eng._dispatch(
+                    "draft", self._draft_step_fn,
+                    self._draft_params, u, act_j, dcarry)
+                u = jnp.argmax(logp_d, axis=-1).astype(jnp.int32)
+                if j < self.k:
+                    drafts.append(u)
+        except FaultError:
+            # earlier iterations donated the pooled draft carry; keep
+            # the latest VALID buffers before evicting the rows
+            eng.pool.draft_carry = dcarry
+            eng._recover_step(running, "fail")
+            return {}
         while len(drafts) < self.k:
             drafts.append(eng._place_rows(jnp.zeros((N,), jnp.int32)))
-        eng.metrics.add_phase("draft", time.perf_counter() - t0)
+        eng.metrics.add_phase("draft", eng._clock() - t0)
 
         # verify: ONE fixed-width target dispatch for the whole fleet
         lengths = np.where(active, k_r + 1, 0).astype(np.int32)
         vtoks = eng._place_rows(jnp.concatenate(
             [jnp.asarray(tokens)[:, None]] + [d[:, None] for d in drafts],
             axis=1))
-        t0 = time.perf_counter()
-        vt, vlp, n_emit, carry = self.verify_fn(
-            eng.params, vtoks, eng._place_rows(jnp.asarray(lengths)),
-            eng.pool.carry, knobs)
+        t0 = eng._clock()
+        try:
+            vt, vlp, n_emit, carry = eng._dispatch(
+                "verify", self.verify_fn,
+                eng.params, vtoks, eng._place_rows(jnp.asarray(lengths)),
+                eng.pool.carry, knobs)
+        except FaultError:
+            eng.pool.draft_carry = dcarry     # target carry never donated
+            eng._recover_step(running, "fail")
+            return {}
         eng.pool.carry = carry
         nxt = np.asarray(vt)
         lps = np.asarray(vlp)
         nem = np.asarray(n_emit)
-        eng.metrics.add_phase("decode_step", time.perf_counter() - t0)
+        eng.metrics.add_phase("decode_step", eng._clock() - t0)
+        bad = self._chunk_unhealthy(nxt, lps, nem, lengths, active)
+        if bad is None and eng._timed_out(eng._clock() - t_start):
+            bad = "timeout"
+        if bad is not None:
+            # outputs discarded; both carries keep valid buffers and
+            # every implicated row is evicted, so the suspect bytes die
+            # with the freed slots
+            eng.pool.draft_carry = dcarry
+            eng._recover_step(running, bad)
+            return {}
+        eng._warm = True                   # arms the watchdog timeout
 
         # draft rollback: the loop advanced active rows by k_r+1; keep
         # the accepted prefix + the emission that will be re-fed (pure
@@ -315,7 +372,7 @@ class Speculator:
         # over-advanced lane/counts die with the slot)
         emitted: Dict[int, int] = {}
         n_landed = 0          # chunk tokens that actually reached outputs
-        now = time.perf_counter()
+        now = eng._clock()
         for slot, req in list(running.items()):
             m = int(nem[slot])
             reason = None

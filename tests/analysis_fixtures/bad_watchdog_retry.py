@@ -1,0 +1,66 @@
+"""SPMD104/SPMD105 fixtures: the serving watchdog's retry-with-evict
+loop (serving/faults.py + ServingEngine._recover_rows).
+
+The engine's fault recovery re-dispatches a DONATED-carry decode step
+after a failed attempt.  Two tempting spellings are wrong.  (a)
+Retrying with the SAME carry the failed attempt was already handed:
+donation means XLA reused that buffer's memory for the outputs, so the
+retry reads garbage — the real engine instead re-points the pool at the
+step's RETURNED carry (valid buffers) and replays the evicted rows from
+host state (``prompt + output``).  (b) Putting the health check INSIDE
+the compiled step as Python control flow on traced outputs: the check
+must run on host, on the read-back arrays — on a tracer the `if`
+raises, and "fixing" it by hoisting the value bakes one verdict into
+the program.  The legal spellings — the rebind-the-carry retry loop and
+the host-side verdict on concrete numpy arrays — are below and must not
+be flagged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _decode(params, tokens, carry):
+    logp = jnp.take(params, jnp.clip(tokens, 0, params.shape[0] - 1),
+                    axis=0)
+    return logp, {"pos": carry["pos"] + 1}
+
+
+step = jax.jit(_decode, donate_argnums=(2,))
+
+
+def retry_wrong(params, tokens, carry):
+    # first attempt donates `carry`; its buffers now back the OUTPUTS
+    logp, new_carry = step(params, tokens, carry)
+    if not np.isfinite(np.asarray(logp)).all():     # host verdict — fine
+        # WRONG retry: re-dispatching with the donated input reads a
+        # dead buffer (the engine re-points at new_carry instead)
+        logp, new_carry = step(params, tokens, carry)  # EXPECT: SPMD104
+    return logp, new_carry
+
+
+def retry_right(params, tokens, carry):
+    # the legal retry loop: the carry name REBINDS to each attempt's
+    # returned (valid) buffers, so no dead buffer is ever read
+    for _ in range(3):
+        logp, carry = step(params, tokens, carry)
+        if np.isfinite(np.asarray(logp)).all():     # host verdict — fine
+            break
+    return logp, carry
+
+
+def watchdog_step(params, tokens, carry):
+    # WRONG: the health check spelled inside the traced step — Python
+    # control flow needs a concrete bool, but every value here is a
+    # tracer; the verdict belongs on host, after readback
+    logp = jnp.take(params, jnp.clip(tokens, 0, params.shape[0] - 1),
+                    axis=0)
+    if params.max() > 1e30:  # EXPECT: SPMD105
+        logp = jnp.zeros_like(logp)
+    while tokens.sum() < 0:  # EXPECT: SPMD105
+        tokens = tokens + 1
+    return logp, {"pos": carry["pos"] + 1}
+
+
+checked_step = jax.jit(watchdog_step)

@@ -234,10 +234,11 @@ def test_engine_rejects_bad_requests():
 
 # -- lifecycle: cancel + bounded finished ledger ---------------------------
 
-def test_cancel_waiting_request_never_occupies_a_slot():
+def test_cancel_waiting_and_running_requests():
     """A cancelled WAITING request is dequeued for good: it never takes
-    a slot, emits nothing, and is reported state='cancelled'; RUNNING
-    requests are not cancellable."""
+    a slot, emits nothing, and is reported state='cancelled'. A
+    cancelled RUNNING request frees its slot immediately, keeps the
+    tokens it had already emitted, and never emits another."""
     from bigdl_tpu.models.transformer import generate
     from bigdl_tpu.serving import ServingEngine
 
@@ -247,20 +248,27 @@ def test_cancel_waiting_request_never_occupies_a_slot():
     b = eng.submit([5, 2], max_new_tokens=4)
     c = eng.submit([9], max_new_tokens=3)
     eng.step()                               # a runs; b, c wait
-    assert not eng.cancel(a)                 # running: not cancellable
     assert eng.cancel(b)
     assert not eng.cancel(b)                 # already cancelled: no-op
     assert eng.queue_depth == 1              # only c still waits
+    # RUNNING cancel: a has emitted one token; its slot frees NOW and
+    # its output freezes — c gets the slot on the next step
+    assert eng.cancel(a)
+    assert eng.request(a).state == "cancelled"
+    out_a = list(eng.request(a).output)
+    assert len(out_a) == 1
+    assert eng.pool.free_slots == 1
     outs = eng.drain()
-    assert b not in outs                     # never ran, emitted nothing
+    assert a not in outs and b not in outs   # neither reached FINISHED
+    assert list(eng.request(a).output) == out_a   # frozen at cancel
     assert eng.request(b).state == "cancelled"
     assert eng.request(b).done_reason is None
     assert eng.result(b) is not None and len(eng.result(b)) == 0
     np.testing.assert_array_equal(
         outs[c], generate(lm, [9], length=3, temperature=0.0))
-    assert eng.pool.free_slots == 1          # the slot b never touched
+    assert eng.pool.free_slots == 1
     total, n = eng.metrics.metrics.get("serving/cancelled")
-    assert (total, n) == (1.0, 1)
+    assert (total, n) == (2.0, 2)
 
 
 def test_pop_result_and_keep_finished_bound_the_ledger():

@@ -1,0 +1,419 @@
+"""Resilient serving under faults and overload (serving/faults.py +
+the priority scheduler): loss-free recovery invariants — under injected
+step failures, garbage (NaN) outputs, watchdog-timed-out stalls, and
+admission errors, the engine never wedges and every FINISHED stream is
+byte-identical to the fault-free run (greedy and fixed-seed sampled,
+per_request and batched admission, speculative and sharded planes) —
+plus priority preemption byte-identity, admission backpressure
+(shed / deadline-drop / degrade), the retry budget's error-out path,
+and the zero-extra-compiles guard for the whole resilience layer.
+
+Determinism discipline: fault schedules are seeded (FaultInjector draws
+one uniform per dispatch), stalls advance a shared VirtualClock (no
+test here ever sleeps), and byte-identity tests retry forever
+(``max_retries=None``) so truncated error-finishes can't masquerade as
+passing streams.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.faults
+
+
+def _make_lm(V=29, hidden=32, heads=4, layers=2, max_len=48, seed=9):
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(seed)
+    lm = TransformerLM(V, hidden_size=hidden, n_heads=heads,
+                       n_layers=layers, max_len=max_len)
+    lm._ensure_params()
+    lm.evaluate()
+    return lm
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _make_lm()
+
+
+def _trace():
+    """The shared mixed trace: greedy rows, fixed-seed sampled rows
+    (penalties included so tok_counts restoration is load-bearing), a
+    1-token prompt (the no-prefill admission path)."""
+    from bigdl_tpu.serving import SamplingParams
+
+    return [
+        ([3, 7, 2], 10, None),
+        ([5, 1], 8, SamplingParams(temperature=0.9, top_k=8, seed=123)),
+        ([9], 6, None),
+        ([4, 4, 4, 4], 9, SamplingParams(temperature=1.1, seed=7,
+                                         repetition_penalty=1.2,
+                                         frequency_penalty=0.2)),
+    ]
+
+
+def _run(lm, n_slots=2, **kw):
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=n_slots, **kw)
+    rids = [eng.submit(p, max_new_tokens=n, sampling=sp)
+            for p, n, sp in _trace()]
+    outs = eng.drain()
+    return eng, [list(outs[r]) for r in rids]
+
+
+@pytest.fixture(scope="module")
+def baseline(lm):
+    _, outs = _run(lm)
+    return outs
+
+
+# -- loss-free recovery: byte-identity under injected faults ---------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_step_failures_recover_byte_identical(seed, lm, baseline):
+    """Injected decode-dispatch failures at several fault seeds: rows
+    are evicted and replayed (prefill of prompt + emitted, lane
+    fast-forward, count rebuild) and every finished stream equals the
+    fault-free run byte for byte — greedy AND fixed-seed sampled."""
+    from bigdl_tpu.serving import FaultInjector, WatchdogConfig
+
+    eng, outs = _run(lm, watchdog=WatchdogConfig(max_retries=None),
+                     faults=FaultInjector(seed=seed, p_fail=0.35))
+    assert eng._faults.counts["fail"] > 0       # faults actually fired
+    assert outs == baseline
+    s = eng.metrics.summary()
+    assert s["serving/retries"] > 0
+    assert s["serving/recovered_rows"] > 0
+    assert eng.pool.free_slots == eng.pool.n_slots
+
+
+def test_garbage_outputs_recover_byte_identical(lm, baseline):
+    """NaN/garbage step outputs (the corrupted-logits failure shape):
+    the health check catches them post-dispatch, the step's outputs are
+    discarded, and replay restores the exact streams."""
+    from bigdl_tpu.serving import FaultInjector, WatchdogConfig
+
+    eng, outs = _run(lm, watchdog=WatchdogConfig(max_retries=None),
+                     faults=FaultInjector(seed=5, p_garbage=0.35))
+    assert eng._faults.counts["garbage"] > 0
+    assert outs == baseline
+    for _, r in eng._finished.items():
+        assert r.finish_reason in ("length", "eos", "stop")
+
+
+def test_stall_watchdog_recovers_byte_identical(lm, baseline):
+    """Slow-step stalls, SIMULATED via the shared VirtualClock (no
+    sleeps): the injector advances the clock past the watchdog budget
+    mid-dispatch, the watchdog discards the slow step, and replay
+    restores the exact streams."""
+    from bigdl_tpu.serving import (
+        FaultInjector, VirtualClock, WatchdogConfig,
+    )
+
+    clk = VirtualClock()
+    eng, outs = _run(
+        lm, clock=clk,
+        watchdog=WatchdogConfig(step_timeout_s=5.0, max_retries=None),
+        faults=FaultInjector(seed=6, p_stall=0.35, stall_s=30.0,
+                             clock=clk))
+    assert eng._faults.counts["stall"] > 0
+    assert outs == baseline
+
+
+@pytest.mark.parametrize("admission", ["batched", "per_request"])
+def test_admission_faults_retry_byte_identical(admission, lm, baseline):
+    """Prefill-dispatch faults during admission (both admission modes,
+    mixed with step failures): the affected rows requeue and admit on a
+    later round; streams stay byte-identical."""
+    from bigdl_tpu.serving import FaultInjector, WatchdogConfig
+
+    eng, outs = _run(
+        lm, admission=admission,
+        watchdog=WatchdogConfig(max_retries=None),
+        faults=FaultInjector(seed=7, p_fail=0.2, p_admit_fail=0.4))
+    assert eng._faults.counts["admit_fail"] > 0
+    assert outs == baseline
+
+
+def test_prefix_cache_faults_byte_identical(lm, baseline):
+    """Fault recovery composes with the prefix cache: replayed rows may
+    hit cached prefixes (including state preemption shared), and the
+    streams still pin."""
+    from bigdl_tpu.serving import FaultInjector, WatchdogConfig
+
+    eng, outs = _run(lm, prefix_cache=True,
+                     watchdog=WatchdogConfig(max_retries=None),
+                     faults=FaultInjector(seed=8, p_fail=0.25,
+                                          p_garbage=0.15))
+    assert eng._faults.total > 0
+    assert outs == baseline
+
+
+def test_speculative_faults_byte_identical(lm, baseline):
+    """Draft and verify dispatch faults through the speculative plane
+    (good AND garbage drafts): recovery re-points both pooled carries
+    at valid buffers, evicts the rows, and the replayed streams equal
+    the plain fault-free engine's."""
+    from bigdl_tpu.serving import (
+        FaultInjector, ServingEngine, SpeculativeConfig, WatchdogConfig,
+    )
+
+    for draft_seed, inj_seed in ((9, 11), (31, 12)):
+        draft = _make_lm(seed=draft_seed)
+        eng = ServingEngine(
+            lm, n_slots=2, speculative=SpeculativeConfig(draft, k=3),
+            watchdog=WatchdogConfig(max_retries=None),
+            faults=FaultInjector(seed=inj_seed, p_fail=0.2,
+                                 p_garbage=0.15))
+        rids = [eng.submit(p, max_new_tokens=n, sampling=sp)
+                for p, n, sp in _trace()]
+        outs = eng.drain()
+        assert eng._faults.total > 0
+        assert [list(outs[r]) for r in rids] == baseline
+        assert not np.asarray(eng.pool.draft_carry["pos"]).any()
+
+
+@pytest.mark.mesh
+def test_sharded_faults_and_preemption_byte_identical(lm, baseline):
+    """Fault recovery AND priority preemption on the slot-data-parallel
+    sharded plane: ``read_row`` slices sharded rows, the replay
+    scatter routes them back through the mesh-pinned scatter, and
+    streams stay identical to the unsharded fault-free engine."""
+    from bigdl_tpu.serving import (
+        FaultInjector, ServingEngine, WatchdogConfig,
+    )
+
+    eng, outs = _run(
+        lm, parallelism={"data": 2},
+        watchdog=WatchdogConfig(max_retries=None),
+        faults=FaultInjector(seed=13, p_fail=0.3))
+    assert eng._faults.counts["fail"] > 0
+    assert outs == baseline
+
+    trace = _trace()
+    eng = ServingEngine(lm, n_slots=2, policy="priority",
+                        parallelism={"data": 2})
+    low = [eng.submit(p, max_new_tokens=n, sampling=sp)
+           for p, n, sp in trace[:2]]
+    for _ in range(3):
+        eng.step()
+    hi = [eng.submit(p, max_new_tokens=n, sampling=sp, priority=5)
+          for p, n, sp in trace[2:]]
+    drained = eng.drain()
+    assert [list(drained[r]) for r in low + hi] == baseline
+    assert eng.metrics.summary()["serving/preempted"] >= 1
+
+
+# -- liveness: the engine never wedges --------------------------------------
+
+def test_persistent_fault_errors_out_never_wedges(lm):
+    """p_fail=1.0: every step faults forever. The retry budget turns
+    that into per-request ``finish_reason='error'`` — drain()
+    terminates, the pool drains clean, and no stream is silently
+    truncated WITHOUT the error marker."""
+    from bigdl_tpu.serving import (
+        FaultInjector, ServingEngine, WatchdogConfig,
+    )
+
+    eng = ServingEngine(lm, n_slots=2,
+                        watchdog=WatchdogConfig(max_retries=2),
+                        faults=FaultInjector(seed=14, p_fail=1.0))
+    rids = [eng.submit(p, max_new_tokens=n, sampling=sp)
+            for p, n, sp in _trace()]
+    eng.drain()                                  # must terminate
+    for r in rids:
+        assert eng.request(r).finish_reason == "error"
+    assert eng.pool.free_slots == eng.pool.n_slots
+    s = eng.metrics.summary()
+    assert s.get("serving/recovered_rows", 0.0) == 0.0
+    assert s.get("serving/goodput", 1.0) == 0.0  # nothing useful finished
+
+
+# -- priority preemption ----------------------------------------------------
+
+def test_preemption_byte_identity(lm, baseline):
+    """High-priority arrivals preempt running low-priority rows
+    mid-stream; the victims readmit from their stashed KV slice and
+    every stream — victims' and winners' — is byte-identical to the
+    unpreempted engine's."""
+    from bigdl_tpu.serving import ServingEngine
+
+    trace = _trace()
+    eng = ServingEngine(lm, n_slots=2, policy="priority")
+    low = [eng.submit(p, max_new_tokens=n, sampling=sp)
+           for p, n, sp in trace[:2]]
+    for _ in range(3):
+        eng.step()                 # low-priority rows emit a few tokens
+    hi = [eng.submit(p, max_new_tokens=n, sampling=sp, priority=5)
+          for p, n, sp in trace[2:]]
+    outs = eng.drain()
+    got = [list(outs[r]) for r in low + hi]
+    assert got == baseline
+    s = eng.metrics.summary()
+    assert s["serving/preempted"] >= 1
+    for r in low:
+        assert eng.request(r).preemptions >= 0   # victims recorded
+    assert sum(eng.request(r).preemptions for r in low) >= 1
+
+
+def test_preemption_shares_prefix_cache_and_replays(lm, baseline):
+    """With a prefix cache attached, a preempted row's state lands in
+    the cache (observable as entries) and readmission byte-identity
+    still holds — including when cache pressure forces the prefill
+    replay path instead (max_entries=1)."""
+    from bigdl_tpu.serving import PrefixCache, ServingEngine
+
+    trace = _trace()
+    for cache in (PrefixCache(), PrefixCache(max_entries=1)):
+        eng = ServingEngine(lm, n_slots=2, policy="priority",
+                            prefix_cache=cache)
+        low = [eng.submit(p, max_new_tokens=n, sampling=sp)
+               for p, n, sp in trace[:2]]
+        for _ in range(3):
+            eng.step()
+        hi = [eng.submit(p, max_new_tokens=n, sampling=sp, priority=5)
+              for p, n, sp in trace[2:]]
+        outs = eng.drain()
+        assert [list(outs[r]) for r in low + hi] == baseline
+        assert eng.metrics.summary()["serving/preempted"] >= 1
+
+
+def test_priority_order_and_edf_tiebreak(lm):
+    """The priority queue admits by (priority DESC, deadline ASC,
+    arrival): a later high-priority submit overtakes earlier
+    low-priority ones, and within a class the earlier deadline goes
+    first."""
+    from bigdl_tpu.serving import Request, Scheduler
+
+    s = Scheduler("priority")
+    def req(i, pri, dl=None):
+        return Request(req_id=i, prompt=[1], max_new_tokens=4,
+                       priority=pri, deadline_s=dl, submit_time=0.0)
+    s.submit(req(0, 0))
+    s.submit(req(1, 0))
+    s.submit(req(2, 5, dl=9.0))
+    s.submit(req(3, 5, dl=2.0))
+    order = [s.admit(i).req_id for i in range(4)]
+    assert order == [3, 2, 0, 1]
+
+
+# -- backpressure: shed, deadline-drop, degrade -----------------------------
+
+def test_bounded_queue_sheds_and_deadline_drops(lm):
+    """max_queue sheds at the door (finish_reason='shed', empty
+    output, no exception); a WAITING request whose deadline expires is
+    dropped with finish_reason='deadline'; both count into the shed /
+    deadline_missed / goodput metrics."""
+    from bigdl_tpu.serving import ServingEngine, VirtualClock
+
+    clk = VirtualClock()
+    eng = ServingEngine(lm, n_slots=1, max_queue=2, clock=clk)
+    a = eng.submit([3, 7, 2], max_new_tokens=8)
+    eng.step()                       # a admitted: the queue is empty
+    b = eng.submit([5, 1], max_new_tokens=6, deadline_s=0.5)  # queued
+    c = eng.submit([9], max_new_tokens=4)                     # queued
+    d = eng.submit([2, 2], max_new_tokens=4)                  # SHED
+    assert eng.request(d).state == "shed"
+    assert eng.request(d).finish_reason == "shed"
+    assert eng.result(d) is not None and len(eng.result(d)) == 0
+    clk.advance(1.0)                 # b expires while waiting
+    eng.step()
+    assert eng.request(b).finish_reason == "deadline"
+    outs = eng.drain()
+    assert sorted(outs) == sorted([a, c])      # shed rows never run
+    s = eng.metrics.summary()
+    assert s["serving/shed"] == 2.0            # d + b
+    assert s["serving/deadline_missed"] == 1.0
+    assert s["serving/goodput"] == pytest.approx(2 / 4)
+    # the deadline-dropped request is ledgered shed, not finished
+    assert eng.request(b).state == "shed"
+
+
+def test_max_queue_bounds_backlog_not_capacity(lm):
+    """max_queue bounds the BACKLOG (waiting beyond free slots), so an
+    idle engine with free capacity never sheds — max_queue=0 means
+    'serve up to capacity, queue nothing', not 'serve nothing'."""
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=2, max_queue=0)
+    a = eng.submit([3, 7, 2], max_new_tokens=4)   # free slots absorb it
+    b = eng.submit([5, 1], max_new_tokens=4)
+    c = eng.submit([9], max_new_tokens=4)         # beyond capacity: shed
+    assert eng.request(c).finish_reason == "shed"
+    outs = eng.drain()
+    assert sorted(outs) == sorted([a, b])
+
+
+def test_invalid_submit_raises_and_never_counts(lm):
+    """Validation precedes both the submitted counter and the shed
+    decision: an invalid submit raises identically loaded or idle and
+    never skews goodput's denominator."""
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=1, max_queue=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([3], max_new_tokens=0)
+    # a full queue must not turn the same invalid call into a shed
+    eng.submit([3, 7], max_new_tokens=4)
+    eng.submit([5], max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([3], max_new_tokens=-2)
+    assert eng.metrics.metrics.get("serving/submitted") == (2.0, 2)
+
+
+def test_degrade_under_pressure(lm):
+    """The per-request degrade knob applies only under pressure: it
+    caps max_new_tokens (and zeroes the speculative budget) when the
+    queue is at/above degrade_at at admission, and is a no-op on an
+    unloaded engine."""
+    from bigdl_tpu.serving import Degrade, ServingEngine
+
+    # pressure: one slot, deep queue, degrade_at=1
+    eng = ServingEngine(lm, n_slots=1, degrade_at=1)
+    a = eng.submit([3, 7, 2], max_new_tokens=8,
+                   degrade=Degrade(max_new_tokens=3, draft_tokens=0))
+    b = eng.submit([5, 1], max_new_tokens=8,
+                   degrade=Degrade(max_new_tokens=3))
+    c = eng.submit([9], max_new_tokens=8)      # no knob: untouched
+    outs = eng.drain()
+    assert len(outs[a]) == 3 and eng.request(a).degraded
+    assert len(outs[b]) == 3 and eng.request(b).draft_tokens is None
+    assert len(outs[c]) == 8 and not eng.request(c).degraded
+    assert eng.request(a).draft_tokens == 0
+    assert eng.metrics.summary()["serving/degraded"] == 2.0
+
+    # no pressure: same knobs, empty queue -> full budget
+    eng2 = ServingEngine(lm, n_slots=4, degrade_at=10)
+    r = eng2.submit([3, 7, 2], max_new_tokens=8,
+                    degrade=Degrade(max_new_tokens=3))
+    outs2 = eng2.drain()
+    assert len(outs2[r]) == 8 and not eng2.request(r).degraded
+
+
+# -- the one-program discipline survives the resilience layer ---------------
+
+def test_zero_extra_compiles_from_resilience(lm):
+    """Priorities, deadlines, degradation, preemption, faults, and
+    recovery are host-side (or per-row runtime) data: a priority
+    engine under fault + preemption churn runs EXACTLY as many decode
+    programs as the plain engine — one."""
+    from bigdl_tpu.serving import (
+        Degrade, FaultInjector, ServingEngine, WatchdogConfig,
+    )
+    from tests.compile_guards import assert_compile_count
+
+    lm = _make_lm()        # private model -> private jitted-step cache
+    eng = ServingEngine(lm, n_slots=2, policy="priority", degrade_at=1,
+                        watchdog=WatchdogConfig(max_retries=None),
+                        faults=FaultInjector(seed=15, p_fail=0.2))
+    low = [eng.submit(p, max_new_tokens=n, sampling=sp,
+                      degrade=Degrade(max_new_tokens=6))
+           for p, n, sp in _trace()[:2]]
+    for _ in range(3):
+        eng.step()
+    eng.submit([9], max_new_tokens=5, priority=5, deadline_s=60.0)
+    eng.drain()
+    assert_compile_count(eng._step_fn, 1, what="resilience layer")

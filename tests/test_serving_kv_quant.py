@@ -181,6 +181,105 @@ def test_kv_bytes_per_slot_halved(lm):
     assert "int8" in repr(e_q.pool)
 
 
+def test_int8_draft_independence_exact(lm):
+    """The accepted-only scale merge's contract, pinned at the BYTE
+    level: under ``kv_dtype="int8"`` the verify step's carry update is
+    a bitwise function of its ACCEPTED columns — a rejected draft can
+    reach neither the (slot, head) dequant scales nor the stored int8
+    bytes (the chunk attention reads float chunk K/V, and the grow-only
+    merge + quantized scatter run after acceptance over emitted columns
+    only). Pinned two ways: (a) end-to-end stream equality across a
+    weight-tied and a garbage draft on a mixed greedy/sampled trace;
+    (b) the unit contract — two verify calls on identical state whose
+    REJECTED draft columns differ (same accepted outcome) must return
+    bitwise-identical carries, scales and K/V bytes included. Before
+    the restructure, (b) failed: the whole-chunk amax let the rejected
+    columns grow a row's scale one step early."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformer import get_batch_verify_step
+    from bigdl_tpu.serving import (
+        SamplingParams, ServingEngine, SpeculativeConfig,
+    )
+    from bigdl_tpu.serving.sampling import lane_key, make_knob_rows
+
+    good = _make_lm()                       # weight-tied: accepts a lot
+    bad = _make_lm(seed=31)                 # garbage: rejects a lot
+    reqs = [([3, 7, 2], 9, None),
+            ([5, 1], 8, SamplingParams(temperature=0.9, top_k=8,
+                                       seed=123)),
+            ([9], 6, None)]
+
+    # (a) stream equality across drafts on a mixed trace
+    outs = []
+    for draft in (good, bad):
+        eng = ServingEngine(lm, n_slots=3, kv_dtype="int8",
+                            speculative=SpeculativeConfig(draft, k=3))
+        rids = [eng.submit(p, max_new_tokens=n, sampling=sp)
+                for p, n, sp in reqs]
+        drained = eng.drain()
+        outs.append([list(drained[r]) for r in rids])
+    assert outs[0] == outs[1]
+
+    # (b) the unit contract on the verify step itself
+    V = 29
+    verify_fn, init = get_batch_verify_step(lm, None, width=4,
+                                            kv_quant=True)
+    knobs = {k: jnp.asarray(v) for k, v in make_knob_rows(2).items()}
+
+    def fresh():
+        c = init(2)
+        c["rng"] = c["rng"].at[:].set(jnp.asarray(lane_key(3), jnp.uint32))
+        return c
+
+    import jax
+
+    from bigdl_tpu.models.transformer import serving_params
+
+    P = jax.device_put(serving_params(lm, None))
+
+    def call(tokens):
+        t, lp, ne, carry = verify_fn(
+            P, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray([4, 0], jnp.int32), fresh(), knobs)
+        return np.asarray(t), np.asarray(ne), carry
+
+    # learn the greedy draws so we can build drafts with a CONTROLLED
+    # accepted prefix: d0 = the draw after feeding token 2, d1 = the
+    # draw after the accepted continuation (2, d0)
+    probe, _, _ = call([[2, 0, 0, 0], [0] * 4])
+    d0 = int(probe[0, 0])
+    probe2, _, _ = call([[2, d0, 0, 0], [0] * 4])
+    d1 = int(probe2[0, 1])
+
+    def carry_bytes(c):
+        return {k: np.asarray(v) for k, v in c.items()}
+
+    # all-rejected: first draft mismatches in both calls, every later
+    # column differs between them -> n_emit == 1, carries bitwise equal
+    a = [[2, (d0 + 1) % V, (d0 + 3) % V, (d0 + 5) % V], [0] * 4]
+    b = [[2, (d0 + 2) % V, (d0 + 7) % V, (d0 + 11) % V], [0] * 4]
+    _, ne_a, ca = call(a)
+    _, ne_b, cb = call(b)
+    assert ne_a[0] == ne_b[0] == 1
+    for k, va in carry_bytes(ca).items():
+        np.testing.assert_array_equal(
+            va, np.asarray(cb[k]),
+            err_msg=f"rejected drafts leaked into carry[{k!r}]")
+
+    # partial accept: first draft matches (d0), second mismatches with
+    # DIFFERENT rejected tokens -> n_emit == 2, carries bitwise equal
+    a = [[2, d0, (d1 + 1) % V, (d1 + 3) % V], [0] * 4]
+    b = [[2, d0, (d1 + 2) % V, (d1 + 7) % V], [0] * 4]
+    _, ne_a, ca = call(a)
+    _, ne_b, cb = call(b)
+    assert ne_a[0] == ne_b[0] == 2
+    for k, va in carry_bytes(ca).items():
+        np.testing.assert_array_equal(
+            va, np.asarray(cb[k]),
+            err_msg=f"rejected tail leaked into carry[{k!r}]")
+
+
 def test_kv_dtype_validation(lm):
     """The knob is declarative and fails loudly: unknown formats,
     float spellings that contradict compute_dtype, and a KVPool whose

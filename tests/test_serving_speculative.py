@@ -119,15 +119,17 @@ def test_greedy_spec_matches_baseline_engine_bf16(lm, good_draft):
 def test_greedy_spec_matches_baseline_engine_int8(lm, good_draft,
                                                   bad_draft):
     """int8-KV speculative vs the int8-KV baseline engine (greedy,
-    pinned config), good AND garbage drafts. The contract here is
-    SCOPED, unlike the float cache's exact draft-independence: the
-    verify step's grow-only scale merge amaxes the WHOLE chunk (the
-    in-step attention must dequantize every position before acceptance
-    is known), so a rejected draft can grow a row's (slot, head) scale
-    one step early — bounded by the merge's <= half-quantum requant
-    error, the same class the int8 baseline's own parity caveat
-    documents. This pin is the regression tripwire for the
-    combination."""
+    pinned config), good AND garbage drafts. Since the accepted-only
+    scale merge, draft-independence is EXACT on the int8 cache too:
+    the verify step's chunk attention reads float chunk K/V and the
+    quantized scatter + grow-only merge cover accepted columns only,
+    so a rejected draft touches neither scales nor stored bytes (the
+    byte-level pin lives in tests/test_serving_kv_quant.py::
+    test_int8_draft_independence_exact); spec-vs-BASELINE parity
+    remains a pinned-config contract (the chunked step sees its own
+    K/V unrounded where plain decode reads the roundtripped write —
+    a sub-quantum numerics difference near-tied argmaxes could
+    notice)."""
     from bigdl_tpu.serving import ServingEngine
 
     rng = np.random.RandomState(11)
@@ -303,6 +305,40 @@ def test_rollback_and_draft_pool_lifecycle(lm, bad_draft):
     # draft-pool misuse raises like the target pool's
     with pytest.raises(ValueError, match="not allocated"):
         eng.pool.set_draft_pos(0, 3)
+
+
+def test_cancel_running_mid_chunk(lm, good_draft):
+    """Cancelling a RUNNING row between super-steps frees BOTH its
+    target and draft slots (one allocator, two caches), freezes its
+    output (no post-cancel tokens, ever), and leaves the engine
+    serving its neighbors unperturbed — including a neighbor admitted
+    into the recycled slot afterwards."""
+    from bigdl_tpu.models.transformer import generate
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=2, speculative=_spec(good_draft, k=3))
+    a = eng.submit([3, 7, 2], max_new_tokens=20)
+    b = eng.submit([5, 1], max_new_tokens=12)
+    for _ in range(2):
+        eng.step()                     # both rows mid-stream, chunks landed
+    assert eng.cancel(a)
+    frozen = list(eng.request(a).output)
+    assert frozen and len(frozen) < 20
+    assert eng.request(a).state == "cancelled"
+    assert eng.pool.free_slots == 1    # a's slot (target+draft) freed NOW
+    assert not eng.cancel(a)           # already cancelled: no-op
+    # a recycled-slot admission decodes correctly next to the survivor
+    c = eng.submit([9], max_new_tokens=5)
+    outs = eng.drain()
+    assert a not in outs               # cancelled rows never FINISH
+    assert list(eng.request(a).output) == frozen
+    np.testing.assert_array_equal(
+        outs[b], generate(lm, [5, 1], length=12, temperature=0.0))
+    np.testing.assert_array_equal(
+        outs[c], generate(lm, [9], length=5, temperature=0.0))
+    assert eng.pool.free_slots == eng.pool.n_slots
+    assert not np.asarray(eng.pool.carry["pos"]).any()
+    assert not np.asarray(eng.pool.draft_carry["pos"]).any()
 
 
 def test_attach_draft_guards(lm, good_draft):
