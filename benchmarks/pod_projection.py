@@ -55,6 +55,20 @@ SPECS = {
     "measured_lm371_step_ms": 213.3,            # 38.4k tok/s at B=4 T=2048
     "measured_produce_img_per_s_per_core": 930.0,   # native pipeline, 1 core
     "imagenet_train_images": 1_281_167,
+    # serving plane (the serving-QPS projection row): the v5e decode
+    # rates are decode_bench's pinned 137M bf16 numbers (B=1 vs B=8
+    # pooled slots); the host-side phase SHAPE (prefill ms/token,
+    # decode-step ms) is measured by serving_bench --scenario chunked
+    # on this rig (tiny model, 12 slots, chunk_budget 32) — CPU is
+    # compute-bound, so that rig ratio UPPER-bounds the admission share
+    # an accelerator would see
+    "measured_lm137_decode_tok_per_s_b1": 1740.0,
+    "measured_lm137_decode_tok_per_s_b8": 7438.0,
+    "measured_serving_decode_step_ms_rig": 5.92,
+    "measured_serving_prefill_ms_per_token_rig": 0.1405,
+    "serving_mfu_prefill": 0.4,          # assumed MXU utilization, prefill
+    "serving_prompt_tokens": 128,        # assumed request shape
+    "serving_output_tokens": 64,
 }
 
 RESNET50_PARAMS = 25_557_032          # counted from the model at build
@@ -222,6 +236,108 @@ def project(step_s: float, grad_bytes: float, n_chips: int,
             "aggregate_rate": round(n_chips * per_chip_rate * eff, 0)}
 
 
+def serving_rows() -> list:
+    """Projected serving QPS per v5e-256 pod (137M bf16, the serving
+    plane's flagship config) — the ROADMAP "Serving pod projection"
+    number, built the same way as the training rows: measured per-chip
+    step inputs + analytic collectives, every assumption priced through
+    SPECS.
+
+    Inputs: the measured v5e pooled-decode rate (decode_bench, B=8
+    slots), an analytic prefill rate (2·P FLOPs/token at the assumed
+    prefill MFU — prefill is MXU-bound where decode is weight-read-
+    bound), and the request shape (``serving_prompt_tokens`` in,
+    ``serving_output_tokens`` out). The host-side phase shape measured
+    by ``serving_bench --scenario chunked`` on this rig anchors the
+    admission-vs-decode split the projection assumes.
+
+    Honesty note on chunked admission: on ONE chip prefill and decode
+    are both MXU work — streaming chunks between decode steps cannot
+    create throughput (the chunked bench measures total wall slightly
+    WORSE: per-chunk dispatch overhead; it is a latency shaper). So
+    there is ONE QPS projection (prefill + decode serialized per chip)
+    and the chunked rows project what the subsystem actually changes:
+    the DECODE-STALL BOUND an in-flight request sees when a burst
+    lands — one admission wave's prefill under batched admission vs
+    one chunk + one decode step under chunked (the analytic twin of
+    the rig-measured 4.4x p99 win).
+
+    Slot data parallelism needs NO per-step collective (rows are
+    independent; that is the sharded plane's design), so the DP pod
+    scales at the admission-feed limit; the tp4 row prices the
+    tensor-parallel variant's two psums per block per step on the ICI
+    ring analytically — the overhead is microseconds against a
+    millisecond step, which is why TP serving scales to models that
+    don't fit one chip without touching the QPS story."""
+    dec_rate = SPECS["measured_lm137_decode_tok_per_s_b8"]
+    pre_rate = (SPECS["serving_mfu_prefill"] * SPECS["bf16_flops"]
+                / (2.0 * LM137_PARAMS))
+    p_in = SPECS["serving_prompt_tokens"]
+    p_out = SPECS["serving_output_tokens"]
+    t_decode = p_out / dec_rate              # chip-seconds per request
+    t_prefill = p_in / pre_rate
+    t_req = t_prefill + t_decode             # serialized on one chip
+    qps_chip = 1.0 / t_req
+    rows = []
+    for n in (8, 64, 256):
+        rows.append({
+            "model": "lm137", "metric": "serving_qps", "n_chips": n,
+            "qps_per_chip": round(qps_chip, 1),
+            "aggregate_qps": round(n * qps_chip, 0),
+            "prefill_share": round(t_prefill / t_req, 4),
+        })
+    # the chunked-admission projection: the stall an in-flight request
+    # eats when a burst of `burst` prompts lands — a whole admission
+    # wave's prefill (batched) vs one chunk + one decode step (chunked)
+    burst, chunk_budget = 8, 32
+    t_step = 8.0 / dec_rate                  # one B=8 decode step
+    stall_batched = burst * t_prefill + t_step
+    stall_chunked = chunk_budget / pre_rate + t_step
+    rows.append({
+        "model": "lm137", "metric": "serving_decode_stall_bound",
+        "burst_prompts": burst, "chunk_budget": chunk_budget,
+        "batched_stall_ms": round(1e3 * stall_batched, 3),
+        "chunked_stall_ms": round(1e3 * stall_chunked, 3),
+        "stall_bound_ratio": round(stall_batched / stall_chunked, 2),
+    })
+    # tensor-parallel variant: decode step splits over 4 chips
+    # (weight-read-bound → ~4x per-group token rate) at the cost of two
+    # psums per block per step on the ICI ring — the analytic
+    # collective term
+    hidden, layers, B = 768, 12, 8
+    psum_bytes = 2 * layers * B * hidden * 2        # bf16 activations
+    t_psum = allreduce_time_s(psum_bytes, 4)
+    t_step = (B / dec_rate) / 4                     # per TP-4 group
+    eff = t_step / (t_step + t_psum)
+    # a TP-4 group serves like one 4x-fast chip (weight reads split):
+    # per-request group-seconds = (prefill + decode/eff) / 4
+    qps_group = 4.0 / (t_prefill + t_decode / eff)
+    rows.append({
+        "model": "lm137", "metric": "serving_qps",
+        "parallelism": "tp4", "n_chips": 256,
+        "t_psum_us_per_step": round(1e6 * t_psum, 2),
+        "tp_scaling_efficiency": round(eff, 4),
+        "aggregate_qps": round(64 * qps_group, 0),
+    })
+    # the admission-feed requirement per host (DCN sanity check): token
+    # ids are 4 bytes, so even pod-scale QPS is kilobytes/s of prompt
+    # traffic per host — serving is never DCN-bound at this shape
+    qps_pod = 256.0 * qps_chip
+    n_hosts = 256 // SPECS["chips_per_host"]
+    rows.append({
+        "model": "lm137", "metric": "serving_feed",
+        "aggregate_qps": round(qps_pod, 0),
+        "prompt_bytes_per_s_per_host": round(
+            qps_pod / n_hosts * p_in * 4, 0),
+        "rig_phase_anchor_ms": {
+            "decode_step": SPECS["measured_serving_decode_step_ms_rig"],
+            "prefill_per_token":
+                SPECS["measured_serving_prefill_ms_per_token_rig"],
+        },
+    })
+    return rows
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--img_per_s", type=float,
@@ -295,6 +411,10 @@ def main(argv=None) -> None:
             p.update(model=name, compress="bf16",
                      aggregate_tokens_per_s=p.pop("aggregate_rate"))
             print(json.dumps(p))
+
+    # -- serving projection (QPS per pod) ------------------------------------
+    for row in serving_rows():
+        print(json.dumps(row))
 
 
 if __name__ == "__main__":

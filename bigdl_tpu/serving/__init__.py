@@ -11,7 +11,10 @@ zero recompiles, and per-row RNG lanes make a fixed seed bit-stable
 across batching and slot readmission. Admission itself is batched and
 shape-stable: ragged prompts prefill together through a bounded set of
 power-of-two length buckets (``admission.py``), optionally reusing
-shared-prefix K/V from a ref-counted radix cache (``prefix_cache.py``).
+shared-prefix K/V from a ref-counted radix cache (``prefix_cache.py``);
+``admission="chunked"`` streams prompts in as bounded suffix-
+continuation chunks interleaved with decode, so an arrival burst never
+stalls in-flight rows for a whole admission wave (``chunked.py``).
 The plane is OPERABLE under faults and overload (``scheduler.py`` +
 ``faults.py``): priority classes with per-request deadlines and
 loss-free preemption (evicted rows resume byte-identically), bounded-
@@ -35,6 +38,7 @@ without ever wedging the engine. See ``docs/serving.md``.
 from bigdl_tpu.serving.admission import (
     AdmissionController, Degrade, bucket_len,
 )
+from bigdl_tpu.serving.chunked import ChunkedAdmissionController
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import (
     FaultError, FaultInjector, VirtualClock, WatchdogConfig,
@@ -50,7 +54,8 @@ from bigdl_tpu.serving.sharded import (
 from bigdl_tpu.serving.speculative import SpeculativeConfig
 
 __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
-           "Scheduler", "AdmissionController", "PrefixCache",
+           "Scheduler", "AdmissionController",
+           "ChunkedAdmissionController", "PrefixCache",
            "SamplingParams", "SpeculativeConfig", "bucket_len",
            "ShardedEngine", "ShardedKVPool", "make_mesh",
            "emulate_cpu_devices", "Degrade", "FaultError",

@@ -125,7 +125,49 @@ class AdmissionController:
         # second engine over a warm model reports zero compiles.
         self.traced_shapes: set = set()
 
+    # -- streaming hooks (overridden by ChunkedAdmissionController) --------
+
+    def pump(self) -> None:
+        """Per-super-step streaming hook: batched admission does all
+        its prefill work inside :meth:`admit`, so this is a no-op —
+        the chunked controller (``serving/chunked.py``) overrides it to
+        feed one budget of prompt chunks before the decode step."""
+
+    def drop(self, slot: int) -> None:
+        """Forget any per-slot streaming state (no-op here; the chunked
+        controller drops the slot's chunk plan). Called by the engine
+        whenever a slot is torn down mid-admission (cancel, fault
+        eviction, preemption)."""
+
     # -- helpers -----------------------------------------------------------
+
+    def _bind_next(self, partial: bool = False):
+        """THE admission prologue, shared by the batched and chunked
+        controllers so the loss-free-readmission invariants have one
+        spelling: allocate a slot, bind the best waiting request
+        (``partial=True`` binds mid-prefill — chunked), and handle the
+        two zero-ingestion fast paths — an empty prefill list (1-token
+        prompts start decoding at pos 0) and a PREEMPTED row's
+        byte-exact ``resume_carry`` scatter. Returns ``(slot, req,
+        pf)`` with ``pf`` None when the row needs no prompt
+        ingestion."""
+        eng = self.engine
+        slot = eng.pool.alloc()
+        assert slot is not None                # admissible() checked
+        req = eng.scheduler.admit(slot, partial=partial)
+        # the last fed token is the first decode input — exactly
+        # generate()'s convention, so outputs match token-for-token
+        pf = eng._admitted_prefill_tokens(req)
+        if not pf:
+            eng.pool.set_pos(slot, 0)
+            return slot, req, None
+        if req.resume_carry is not None:
+            # byte-exact preemption resume: the evicted row's own
+            # bytes scatter straight back into the pool
+            eng.pool.write_prefill(slot, req.resume_carry, len(pf))
+            req.resume_carry = None
+            return slot, req, None
+        return slot, req, pf
 
     def _zero_carry(self) -> dict:
         if self._zero_carry_cache is None:
@@ -172,20 +214,8 @@ class AdmissionController:
         eng = self.engine
         groups: Dict[int, List[Tuple]] = {}    # L_bucket -> (req, slot, pf)
         for _ in range(n):
-            slot = eng.pool.alloc()
-            assert slot is not None            # admissible() checked
-            req = eng.scheduler.admit(slot)
-            # the last fed token is the first decode input — exactly
-            # generate()'s convention, so outputs match token-for-token
-            pf = eng._admitted_prefill_tokens(req)
-            if not pf:
-                eng.pool.set_pos(slot, 0)
-                continue
-            if req.resume_carry is not None:
-                # byte-exact preemption resume: the evicted row's own
-                # bytes scatter straight back into the pool
-                eng.pool.write_prefill(slot, req.resume_carry, len(pf))
-                req.resume_carry = None
+            slot, req, pf = self._bind_next()
+            if pf is None:
                 continue
             if self.prefix_cache is not None:
                 try:

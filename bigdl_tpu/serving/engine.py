@@ -18,7 +18,10 @@ compiled per-row-position decode program
   BOUNDED set of compiled prefill programs instead of compiling per
   novel length mid-admission (see ``serving/admission.py``).
   ``admission="per_request"`` keeps PR 1's one-at-a-time B=1
-  :func:`make_prefill_step` path (the parity baseline);
+  :func:`make_prefill_step` path (the parity baseline), and
+  ``admission="chunked"`` STREAMS prompts in as budget-bounded
+  suffix-continuation chunks interleaved with decode so long-prompt
+  bursts never stall in-flight rows (``serving/chunked.py``);
 * an optional :class:`bigdl_tpu.serving.prefix_cache.PrefixCache`
   (``prefix_cache=True`` or an instance) reuses prefilled K/V across
   requests sharing a token prefix — a full hit clones cached state
@@ -89,8 +92,30 @@ class ServingEngine:
     (priority, deadline, arrival) order with loss-free preemption —
     see ``serving.scheduler`` and the resilience notes below);
     ``admission`` picks the prompt-ingestion pipeline: ``"batched"``
-    (default — bucketed multi-row masked prefill, bounded compile set)
+    (default — bucketed multi-row masked prefill, bounded compile set),
+    ``"chunked"`` (streaming admission — requests bind a KV slot
+    immediately and their prompts stream in as suffix-continuation
+    chunks of at most ``chunk_budget`` tokens per step, interleaved
+    with decode so an arrival burst never stalls in-flight rows for a
+    whole admission wave; token-identical to batched, zero extra
+    decode compiles — ``serving/chunked.py``),
     or ``"per_request"`` (PR 1's B=1-per-admission baseline);
+    ``chunk_budget`` is the chunked pump's per-step prompt-token budget
+    (default 32; only valid with ``admission="chunked"``);
+    ``deadline_feasibility`` turns on feasibility ADMISSION CONTROL:
+    waiting requests whose remaining DECLARED token budget
+    (``max_new_tokens`` less what is already emitted — the pessimistic
+    bound; a request that would stop early at EOS under a generous cap
+    is shed conservatively, so deadline-carrying callers should set
+    honest caps) cannot fit inside their
+    deadline at the measured per-token service rate (the
+    ``decode_step_s`` median over the measured tokens-per-step — so
+    speculative engines' multi-token super-steps don't overstate
+    service time) are dropped at
+    admission with ``finish_reason="infeasible"`` (counted as shed +
+    deadline-missed) instead of burning decode steps on a guaranteed
+    SLO miss — the EDF-with-admission-control step beyond dropping
+    only already-expired work;
     ``prefix_cache`` enables shared-prefix K/V reuse under batched
     admission: ``True`` for a default-capacity
     :class:`~bigdl_tpu.serving.prefix_cache.PrefixCache`, or pass a
@@ -184,6 +209,8 @@ class ServingEngine:
                  policy: str = "prefill_priority",
                  metrics: Optional[ServingMetrics] = None,
                  admission: str = "batched",
+                 chunk_budget: Optional[int] = None,
+                 deadline_feasibility: bool = False,
                  prefix_cache=None,
                  keep_finished: Optional[int] = None,
                  seed: int = 0,
@@ -206,10 +233,18 @@ class ServingEngine:
         from bigdl_tpu.serving.admission import AdmissionController
         from bigdl_tpu.serving.prefix_cache import PrefixCache
 
-        if admission not in ("batched", "per_request"):
+        if admission not in ("batched", "per_request", "chunked"):
             raise ValueError(
                 f"unknown admission mode {admission!r} "
-                "(one of 'batched', 'per_request')")
+                "(one of 'batched', 'per_request', 'chunked')")
+        if chunk_budget is not None:
+            if admission != "chunked":
+                raise ValueError(
+                    "chunk_budget requires admission='chunked' — it is "
+                    "the streaming pump's per-step token budget")
+            if int(chunk_budget) < 1:
+                raise ValueError(
+                    f"chunk_budget must be >= 1, got {chunk_budget}")
         if keep_finished is not None and keep_finished < 0:
             raise ValueError(
                 f"keep_finished must be >= 0 or None, got {keep_finished}")
@@ -348,7 +383,18 @@ class ServingEngine:
         # watchdog cold-start grace: the step timeout arms only after
         # one healthy step has completed (see _timed_out)
         self._warm = False
-        if admission == "batched":
+        # feasibility admission control (EDF-with-admission-control):
+        # when on, _admit deadline-drops WAITING requests the running
+        # decode_step_s median says cannot finish inside their deadline —
+        # not just those already expired (finish_reason="infeasible")
+        self.deadline_feasibility = bool(deadline_feasibility)
+        # decode-stall bookkeeping: wall time of the last completed
+        # decode/verify dispatch, None while no rows are in flight —
+        # the gap between consecutive dispatches over a live batch is
+        # the stall signal chunked admission bounds (serving/
+        # decode_gap_s)
+        self._last_decode_end: Optional[float] = None
+        if admission in ("batched", "chunked"):
             # the tensor-parallel prefill shares the mesh (and must name
             # the sampling carry leaves in its shard_map specs); data-
             # only planes keep the stock prefill — its output rows
@@ -359,14 +405,23 @@ class ServingEngine:
             # True -> default cache, False/None -> off, else an instance
             self.prefix_cache = (PrefixCache() if prefix_cache is True
                                  else (prefix_cache or None))
-            self.admitter = AdmissionController(
-                self, prefix_cache=self.prefix_cache)
+            if admission == "chunked":
+                from bigdl_tpu.serving.chunked import (
+                    ChunkedAdmissionController,
+                )
+
+                self.admitter = ChunkedAdmissionController(
+                    self, chunk_budget=chunk_budget or 32,
+                    prefix_cache=self.prefix_cache)
+            else:
+                self.admitter = AdmissionController(
+                    self, prefix_cache=self.prefix_cache)
         else:
             if prefix_cache:
                 raise ValueError(
-                    "prefix_cache requires admission='batched' (the "
-                    "per-request prefill cannot continue from a cached "
-                    "carry)")
+                    "prefix_cache requires admission='batched' or "
+                    "'chunked' (the per-request prefill cannot continue "
+                    "from a cached carry)")
             self.prefix_cache = None
             self.admitter = None
             self._prefill_fn = get_prefill_step(model, compute_dtype,
@@ -512,6 +567,8 @@ class ServingEngine:
             slot, req.slot = req.slot, None
             self.pool.free(slot)
             self._configured.discard(slot)
+            if self.admitter is not None:
+                self.admitter.drop(slot)       # mid-prefill chunk plan
             req.resume_carry = None
         self.metrics.on_cancel()
         self._finished[req_id] = req
@@ -546,6 +603,48 @@ class ServingEngine:
         # still make theirs
         for req in self.scheduler.pop_expired(now):
             self._shed(req, "deadline")
+        # feasibility admission control: with a measured per-token
+        # service-time estimate in hand, a request whose DECLARED
+        # budget (max_new_tokens — the only bound available before the
+        # model runs; EOS-early traffic under a generous cap is shed
+        # conservatively, so deadline callers should set honest caps)
+        # cannot fit inside its deadline even decoding uncontended
+        # from this instant is dropped at the door instead of spending
+        # steps proving the miss. The
+        # estimate is the running decode_step_s MEDIAN (robust to the
+        # cold-compile first step and stall outliers) divided by the
+        # measured tokens-per-step, so a speculative engine's
+        # multi-token super-steps don't overstate service time and
+        # shed requests that would have made it. Before the first
+        # decode step there is no estimate and nothing is dropped —
+        # feasibility control never guesses.
+        if self.deadline_feasibility:
+            est = self.metrics.service_time_estimate()
+            if est is not None:
+                def _infeasible(req: Request) -> bool:
+                    dl = req.deadline_time
+                    if dl is None:
+                        return False
+                    # price the budget the request would ACTUALLY get:
+                    # under pressure _maybe_degrade will cap
+                    # max_new_tokens at admission, and shedding on the
+                    # un-degraded budget would drop requests the cap
+                    # makes feasible (mirrors _maybe_degrade's
+                    # first-admission condition)
+                    cap = req.max_new_tokens
+                    if (req.degrade is not None and not req.degraded
+                            and not req.output
+                            and self.degrade_at is not None
+                            and self.scheduler.queue_depth
+                            >= self.degrade_at
+                            and req.degrade.max_new_tokens is not None):
+                        cap = min(cap, int(req.degrade.max_new_tokens))
+                    rem = cap - len(req.output)
+                    return now + est * rem > dl
+
+                for req in self.scheduler.pop_waiting(_infeasible):
+                    self.metrics.on_infeasible()
+                    self._shed(req, "infeasible")
         # loss-free preemption (priority policy): evict lowest-priority
         # running rows while strictly-higher-priority requests wait
         # without a free slot — each eviction stashes the row's KV for
@@ -600,16 +699,24 @@ class ServingEngine:
     # -- resilience: shedding, degradation, preemption, recovery -----------
 
     def _shed(self, req: Request, reason: str) -> None:
-        """Load-shed a request WITHOUT running it (queue-full submit or
-        waiting-deadline expiry): ledgered with ``finish_reason`` set
-        and empty output — observable backpressure, never an
-        exception."""
+        """Load-shed a request WITHOUT running it (queue-full submit,
+        waiting-deadline expiry, or a feasibility drop): ledgered with
+        ``finish_reason`` set and empty output — observable
+        backpressure, never an exception. Deadline expiry and
+        feasibility drops both count as deadline misses (either way
+        the SLO was not going to be met)."""
         req.state = SHED
         req.finish_reason = reason
+        # a PREEMPTED request re-entering the queue carries its stashed
+        # KV row; shedding it must drop that stash (n_layers*2 max_len
+        # device slices) or the finished ledger pins it forever — the
+        # same teardown contract cancel() follows
+        req.resume_carry = None
         req.finish_time = self._clock()
         self._finished[req.req_id] = req
         self._evict_finished()
-        self.metrics.on_shed(deadline=(reason == "deadline"))
+        self.metrics.on_shed(deadline=(reason in ("deadline",
+                                                  "infeasible")))
 
     def _maybe_degrade(self, req: Request) -> None:
         """Apply the request's ``degrade`` knob at FIRST admission when
@@ -681,6 +788,8 @@ class ServingEngine:
         progress — a persistent fault fails requests, not the engine."""
         for slot, req in rows:
             self._configured.discard(slot)
+            if self.admitter is not None:
+                self.admitter.drop(slot)       # mid-prefill chunk plan
             req.retries += 1
             req.resume_carry = None
             mr = self.watchdog.max_retries
@@ -839,21 +948,51 @@ class ServingEngine:
                 self._knobs["ban"][slot] = ban
                 self._knobs_device = None
 
+    def _note_decode_gap(self, had_running: bool) -> None:
+        """Record the wall gap between consecutive decode (or verify)
+        dispatch completions while rows stayed in flight across it —
+        the decode-stall sample. Admission work between the two
+        dispatches (a batched prefill wave, a chunk budget) is exactly
+        what stretches the gap, which is the phenomenon
+        ``serving_bench --scenario chunked`` measures."""
+        now = self._clock()
+        if had_running and self._last_decode_end is not None:
+            self.metrics.on_decode_gap(now - self._last_decode_end)
+        self._last_decode_end = now
+
     def step(self) -> Dict[int, int]:
-        """Admit waiting requests, then decode for every active row:
-        ONE token per row on the plain engine, up to ``k + 1`` on a
+        """Admit waiting requests (CHUNKED admission then pumps at most
+        ``chunk_budget`` prompt tokens of streaming prefill —
+        ``serving/chunked.py``), then decode for every active row: ONE
+        token per row on the plain engine, up to ``k + 1`` on a
         speculative engine (draft-and-verify super-step —
         ``serving/speculative.py``). Returns ``{req_id: 1-based token}``
         emitted this step (the LAST emitted token per request when a
-        super-step lands several; empty when the engine is idle)."""
+        super-step lands several; empty when the engine is idle or
+        every slot-holding row is still mid-prefill)."""
         import jax.numpy as jnp
 
+        had_running = bool(self.scheduler.running)
         self._admit()
+        if self.admitter is not None:
+            self.admitter.pump()
         running = self.scheduler.running
         if not running:
+            # no decode dispatch this step: a gap measured across an
+            # empty batch would be idle time, not a stall
+            self._last_decode_end = None
             return {}
         if self._spec is not None:
-            return self._spec.step(running)
+            out = self._spec.step(running)
+            # a healthy super-step emits for every running row; an
+            # empty dict here means the step faulted and recovery
+            # evicted the batch — no dispatch completed, so there is
+            # no gap sample and no live batch to anchor the next one
+            if out:
+                self._note_decode_gap(had_running)
+            else:
+                self._last_decode_end = None
+            return out
         N = self.pool.n_slots
         tokens = np.zeros((N,), np.int32)
         active = np.zeros((N,), bool)
@@ -878,7 +1017,10 @@ class ServingEngine:
         except FaultError:
             # the dispatch failed BEFORE running: the pooled carry was
             # never donated and stays valid — evict + replay the rows
+            # (no gap sample: nothing dispatched, and the evicted
+            # batch anchors no future gap)
             self._recover_step(running, "fail")
+            self._last_decode_end = None
             return {}
         self.pool.carry = carry
         # the (N, V) distribution never crosses to host — sampling is
@@ -895,10 +1037,16 @@ class ServingEngine:
         if bad is not None:
             # outputs discarded; the returned carry is committed only
             # so the pool keeps valid (post-donation) buffers — every
-            # implicated row is evicted, so its bytes die with the slot
+            # implicated row is evicted, so its bytes die with the slot.
+            # No gap sample either: a discarded step served no tokens,
+            # and the evicted batch anchors no future gap
             self._recover_step(running, bad)
+            self._last_decode_end = None
             return {}
         self._warm = True                  # arms the watchdog timeout
+        # HEALTHY steps only: the decode-stall histogram measures gaps
+        # between dispatches that actually served the batch
+        self._note_decode_gap(had_running)
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.occupancy(), int(active.sum()))
         self.metrics.on_sample_rows(n_sampled, len(running) - n_sampled)

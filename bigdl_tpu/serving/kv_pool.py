@@ -129,6 +129,19 @@ class KVPool:
                                  for i in range(self.n_layers)
                                  for kind in ("k", "v")]
         self._free_reset = self._make_free_reset()
+        # CHUNK-PROGRESS tracking (chunked streaming admission —
+        # serving/chunked.py): host-side mirrors of how much of a
+        # slot's prompt is resident (`chunk_done`, kept in lockstep
+        # with the device `pos` by write_prefill/set_pos) and how much
+        # it ultimately needs (`chunk_target`, set by begin_chunks;
+        # 0 = no chunk plan). Host ints, so the chunk pump never reads
+        # the device back mid-stream. Both RESET with their slot in
+        # free() — the same recycled-slot contract the int8 scales
+        # follow: a new occupant must never inherit its predecessor's
+        # progress (a stale target would make a fresh row look
+        # mid-prefill and stall its activation forever).
+        self.chunk_done = np.zeros((self.n_slots,), np.int64)
+        self.chunk_target = np.zeros((self.n_slots,), np.int64)
         # optional DRAFT carry (speculative decoding): a second,
         # slot-aligned pooled carry for the draft model — see
         # attach_draft()
@@ -210,6 +223,11 @@ class KVPool:
         self.carry.update(self._free_reset(
             {k: self.carry[k] for k in self._reset_keys},
             jnp.int32(slot)))
+        # chunk-progress fields reset with the slot (recycled-slot
+        # contract): a leaked done/target pair would make the next
+        # occupant look mid-prefill
+        self.chunk_done[slot] = 0
+        self.chunk_target[slot] = 0
         if self.draft_carry is not None:
             # the draft carry frees WITH its slot: same pos-reset rule
             # (stale draft K/V behind pos are masked, like the target's)
@@ -272,6 +290,9 @@ class KVPool:
         self.carry = self._scatter(self.carry, prefill_carry,
                                    jnp.int32(slot), jnp.int32(prompt_len),
                                    jnp.int32(row))
+        # host mirror of the slot's device pos: the chunk pump plans
+        # the next chunk from this without a device readback
+        self.chunk_done[slot] = prompt_len
 
     def read_row(self, slot: int) -> Dict:
         """One allocated slot's carry as a B=1 slice, every leaf (K/V
@@ -294,6 +315,30 @@ class KVPool:
         if slot not in self._in_use:
             raise ValueError(f"slot {slot} is not allocated")
         self.carry["pos"] = self.carry["pos"].at[slot].set(int(pos))
+        self.chunk_done[slot] = int(pos)
+
+    # -- chunk progress (chunked streaming admission) ----------------------
+
+    def begin_chunks(self, slot: int, done: int, target: int) -> None:
+        """Open a chunk plan on an allocated slot: ``done`` prompt
+        tokens are already resident (0 for a fresh row, the matched
+        length after a prefix-cache head write), ``target`` is the full
+        prefill length the row needs before it may decode. The chunk
+        pump (``serving/chunked.py``) advances ``chunk_done`` through
+        ``write_prefill`` until it reaches ``target``."""
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        if not 0 <= done <= target <= self.max_len:
+            raise ValueError(
+                f"chunk plan done={done}..target={target} outside "
+                f"0..{self.max_len}")
+        self.chunk_done[slot] = int(done)
+        self.chunk_target[slot] = int(target)
+
+    def chunk_remaining(self, slot: int) -> int:
+        """Prompt tokens still to stream for a slot's chunk plan
+        (0 = complete or no plan)."""
+        return int(max(0, self.chunk_target[slot] - self.chunk_done[slot]))
 
     # -- sampling lanes ----------------------------------------------------
 

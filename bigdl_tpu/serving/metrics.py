@@ -18,6 +18,27 @@ Counters (all under the ``serving/`` prefix in the backing Metrics):
 * ``prefill_s`` / ``decode_step_s`` — phase timings
 * ``cancelled``         — requests cancelled while WAITING
 
+Chunked-admission counters (``serving/chunked.py``):
+
+* ``chunks`` / ``chunk_tokens`` — chunk-prefill calls fed by the pump
+  and the prompt tokens they carried (sums = total chunk traffic;
+  ``chunk_tokens``/``chunks`` mean = effective chunk width)
+* ``partial_rows``     — mid-prefill PARTIAL rows, sampled per pump
+* ``decode_gap_s``     — wall gap between consecutive decode (or
+  verify) dispatches while rows were in flight across the gap: the
+  DECODE-STALL signal chunked admission exists to shrink (a batched
+  admission burst shows up as one huge gap; chunked bounds it by the
+  chunk budget). ``decode_gap_percentiles()`` summarizes;
+  ``summary()`` reports the p99
+
+Feasibility admission control (``ServingEngine(deadline_feasibility=
+True)``):
+
+* ``infeasible``       — waiting requests deadline-dropped because the
+  running ``decode_step_s`` median says they cannot finish inside their
+  deadline (each also counts as shed + deadline_missed; the EDF-with-
+  admission-control step beyond dropping only already-expired work)
+
 Batched-admission counters (``serving/admission.py``):
 
 * ``prefill_batch``     — true rows per batched prefill call (mean =
@@ -98,9 +119,29 @@ class ServingMetrics:
     """Queue/latency/throughput counters for :class:`ServingEngine`."""
 
     def __init__(self, backing: Optional[Metrics] = None) -> None:
+        from collections import deque
+
         self.metrics = backing if backing is not None else Metrics()
         self._t_start: Optional[float] = None
         self._t_last: Optional[float] = None
+        # bounded recent-decode-step window for the feasibility
+        # estimator: the full-history sample list grows forever and
+        # _admit consults the estimate EVERY step, so the estimator
+        # must be O(window), not O(lifetime) — and a recent window
+        # also tracks drift (load changes, thermal throttling) where
+        # a lifetime median would lag
+        self._step_window: "deque" = deque(maxlen=512)
+        # the draft-phase twin: on speculative engines the k+1 draft
+        # dispatches per super-step land in the "draft" phase, not in
+        # "decode_step" (which times only the verify dispatch) — a
+        # service-time estimate that ignored them would understate
+        # true per-token wall by the whole draft share
+        self._draft_window: "deque" = deque(maxlen=512)
+        # running sums of the speculative counters: the estimator needs
+        # lifetime accepted/rows every step, and re-summing the backing
+        # Metrics sample lists would be O(lifetime) per call
+        self._spec_acc = 0.0
+        self._spec_rows = 0.0
 
     # -- engine hooks ------------------------------------------------------
 
@@ -185,6 +226,8 @@ class ServingMetrics:
         self.metrics.add("serving/draft_tokens", float(n_drafted))
         self.metrics.add("serving/accepted_tokens", float(n_accepted))
         self.metrics.add("serving/spec_rows", float(n_rows))
+        self._spec_acc += float(n_accepted)
+        self._spec_rows += float(n_rows)
 
     def on_cancel(self) -> None:
         self.metrics.add("serving/cancelled", 1.0)
@@ -218,6 +261,78 @@ class ServingMetrics:
         self.metrics.add("serving/shard_occupancy_max", hi / rows_per_shard)
         self.metrics.add("serving/shard_imbalance", float(hi - lo))
 
+    # -- chunked admission + feasibility hooks -----------------------------
+
+    def on_chunk(self, n_tokens: int) -> None:
+        """One chunk-prefill call fed by the streaming-admission pump,
+        carrying ``n_tokens`` true prompt tokens."""
+        self.metrics.add("serving/chunks", 1.0)
+        self.metrics.add("serving/chunk_tokens", float(n_tokens))
+
+    def on_partial_rows(self, n: int) -> None:
+        """Mid-prefill PARTIAL rows after one pump pass."""
+        self.metrics.add("serving/partial_rows", float(n))
+
+    def on_decode_gap(self, gap_s: float) -> None:
+        """Wall gap between consecutive decode dispatches while rows
+        stayed in flight — the decode-stall sample (admission work in
+        the gap is what stretches it)."""
+        self.metrics.add("serving/decode_gap_s", float(gap_s))
+
+    def on_infeasible(self) -> None:
+        """A waiting request dropped by feasibility admission control:
+        the service-time estimate says it cannot finish in time."""
+        self.metrics.add("serving/infeasible", 1.0)
+
+    def decode_step_estimate(self) -> Optional[float]:
+        """MEDIAN of the recent decode-step samples (a bounded window,
+        seconds), or None before the first decode step — the per-step
+        service-time estimate feasibility admission control builds on.
+        Median, not mean: the engine's first dispatch carries the
+        one-time XLA compile (multi-second at LM scale — the same
+        cold-start outlier the watchdog's arming grace exists for) and
+        fault-injected stalls are outliers too; a mean polluted by
+        either would spuriously shed early traffic as infeasible. A
+        bounded window, not full history: _admit consults this every
+        engine step, so the cost must stay O(window) for the engine's
+        whole lifetime."""
+        import numpy as np
+
+        if not self._step_window:
+            return None
+        return float(np.median(np.asarray(self._step_window)))
+
+    def service_time_estimate(self) -> Optional[float]:
+        """Estimated seconds per EMITTED TOKEN — what feasibility
+        admission control multiplies a request's remaining tokens by.
+        Per super-step wall = the decode-step median PLUS the draft-
+        phase median (zero on plain engines; on speculative engines
+        "decode_step" times only the verify dispatch, and skipping the
+        k+1 draft dispatches would understate service time and admit
+        guaranteed misses), divided by the measured tokens-per-step
+        (1.0 plain; a speculative engine emits 1..k+1 tokens per
+        super-step, and dividing by the lifetime rate keeps the
+        estimate from overstating service time by up to (k+1)x and
+        shedding requests that would have met their deadline — the
+        lifetime rate lags a mid-flight Degrade(draft_tokens=0) shift,
+        an accepted coarseness)."""
+        import numpy as np
+
+        est = self.decode_step_estimate()
+        if est is None:
+            return None
+        if self._draft_window:
+            est += float(np.median(np.asarray(self._draft_window)))
+        # running sums, not Metrics.get (which re-sums the full
+        # per-step sample lists — O(lifetime) on a hot path)
+        if self._spec_rows:
+            est /= (self._spec_acc + self._spec_rows) / self._spec_rows
+        return est
+
+    def decode_gap_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        """Percentiles of the decode-stall samples (seconds)."""
+        return self._pctl("decode_gap_s", qs)
+
     def on_prefill_batch(self, n_rows: int, n_padded: int) -> None:
         self.metrics.add("serving/prefill_batch", float(n_rows))
         self.metrics.add("serving/prefill_batch_padded", float(n_padded))
@@ -234,6 +349,10 @@ class ServingMetrics:
 
     def add_phase(self, name: str, seconds: float) -> None:
         self.metrics.add(f"serving/{name}_s", float(seconds))
+        if name == "decode_step":
+            self._step_window.append(float(seconds))
+        elif name == "draft":
+            self._draft_window.append(float(seconds))
 
     # -- derived views -----------------------------------------------------
 
@@ -249,14 +368,18 @@ class ServingMetrics:
             return 0.0
         return total / (self._t_last - self._t_start)
 
-    def ttft_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+    def _pctl(self, name: str, qs) -> Dict[str, float]:
+        """Percentiles of one counter's raw samples (0.0 when empty)."""
         import numpy as np
 
-        vals = self._values("ttft_s")
+        vals = self._values(name)
         if not vals:
             return {f"p{q}": 0.0 for q in qs}
         arr = np.asarray(vals)
         return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
+
+    def ttft_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        return self._pctl("ttft_s", qs)
 
     def summary(self) -> Dict[str, float]:
         """Means of every serving counter plus derived throughput/TTFT
@@ -276,7 +399,8 @@ class ServingMetrics:
         # Metrics means each add-series; "preempted 0.97 mean" is
         # useless where "preempted 13 rows" is the operational number)
         for name in ("preempted", "shed", "deadline_missed", "retries",
-                     "recovered_rows", "degraded", "finished_in_slo"):
+                     "recovered_rows", "degraded", "finished_in_slo",
+                     "infeasible", "chunks", "chunk_tokens"):
             total, n = self.metrics.get(f"serving/{name}")
             if n:
                 out[f"serving/{name}"] = total
@@ -295,6 +419,10 @@ class ServingMetrics:
             out["serving/accept_rate"] = n_acc / n_draft
         if n_rows:
             out["serving/tokens_per_step"] = (n_acc + n_rows) / n_rows
+        _, n_gap = self.metrics.get("serving/decode_gap_s")
+        if n_gap:
+            out["serving/decode_gap_p99_s"] = \
+                self.decode_gap_percentiles()["p99"]
         for k, v in self.ttft_percentiles().items():
             out[f"serving/ttft_{k}_s"] = v
         return out

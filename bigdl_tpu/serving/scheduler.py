@@ -4,6 +4,10 @@ The reference scheduled jobs onto a fixed executor pool FIFO (SoCC'19);
 here the "executors" are decode slots in the pooled KV cache and the
 "jobs" are generation requests. The scheduler owns the waiting queue and
 the WAITING → RUNNING → FINISHED lifecycle; the engine owns the tensors.
+Under CHUNKED admission (``serving/chunked.py``) a request passes
+through an extra PARTIAL stage between WAITING and RUNNING: it owns a
+KV slot while its prompt streams in chunk by chunk, but only
+``activate()`` adds it to the ``running`` table the decode step reads.
 
 Admission policies:
 
@@ -42,6 +46,12 @@ from bigdl_tpu.serving.sampling import SamplingParams
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 CANCELLED = "cancelled"
 SHED = "shed"
+#: Mid-prefill rows under CHUNKED admission (serving/chunked.py): the
+#: request owns a KV slot and its prompt is streaming in chunk by chunk,
+#: but it must NOT decode yet — the engine's decode step reads only
+#: ``running``, so PARTIAL rows sit in their own table until
+#: ``activate()`` promotes them.
+PARTIAL = "partial"
 
 _POLICIES = ("prefill_priority", "fifo", "priority")
 
@@ -133,6 +143,9 @@ class Scheduler:
         self.policy = policy
         self._waiting: List[list] = []            # heap of [key, req]
         self.running: Dict[int, Request] = {}     # slot -> request
+        # mid-prefill rows (chunked admission): slot-bound but not yet
+        # decoding — activate() moves them into `running`
+        self.partial: Dict[int, Request] = {}
         self._seq = 0
 
     def _key(self, req: Request):
@@ -158,8 +171,11 @@ class Scheduler:
         its place among same-priority peers — is preserved, and its slot
         binding is dropped. The engine frees the KV slot."""
         if req.slot is not None:
-            assert self.running.get(req.slot) is req
-            del self.running[req.slot]
+            if self.running.get(req.slot) is req:
+                del self.running[req.slot]
+            else:
+                assert self.partial.get(req.slot) is req
+                del self.partial[req.slot]
             req.slot = None
         req.state = WAITING
         req.next_token = None
@@ -169,15 +185,30 @@ class Scheduler:
         """How many waiting requests may be admitted right now."""
         if not free_slots or not self._waiting:
             return 0
-        if self.policy == "fifo" and self.running:
+        if self.policy == "fifo" and (self.running or self.partial):
             return 0          # run-to-completion: wait for a full drain
         return min(free_slots, len(self._waiting))
 
-    def admit(self, slot: int) -> Request:
-        """Pop the best waiting request and bind it to ``slot``."""
+    def admit(self, slot: int, partial: bool = False) -> Request:
+        """Pop the best waiting request and bind it to ``slot``.
+        ``partial=True`` binds it in the PARTIAL (mid-prefill) state —
+        chunked admission streams its prompt in before ``activate()``
+        lets it decode."""
         _, req = heapq.heappop(self._waiting)
-        req.state = RUNNING
         req.slot = slot
+        if partial:
+            req.state = PARTIAL
+            self.partial[slot] = req
+        else:
+            req.state = RUNNING
+            self.running[slot] = req
+        return req
+
+    def activate(self, slot: int) -> Request:
+        """Promote a PARTIAL row whose prompt has fully streamed in:
+        it joins ``running`` and decodes from the next step on."""
+        req = self.partial.pop(slot)
+        req.state = RUNNING
         self.running[slot] = req
         return req
 
@@ -202,23 +233,26 @@ class Scheduler:
         return min(self.running.values(),
                    key=lambda r: (r.priority, -r.seq))
 
+    def pop_waiting(self, pred) -> List[Request]:
+        """Remove and return every WAITING request ``pred`` selects —
+        the generic drop primitive behind deadline expiry and
+        feasibility admission control (the survivors' heap order is
+        preserved)."""
+        keep, dropped = [], []
+        for entry in self._waiting:
+            (dropped if pred(entry[1]) else keep).append(entry)
+        if dropped:
+            self._waiting = keep
+            heapq.heapify(self._waiting)
+        return [req for _, req in dropped]
+
     def pop_expired(self, now: float) -> List[Request]:
         """Remove and return WAITING requests whose absolute deadline
         has already passed — admitting them would spend decode steps on
         a guaranteed SLO miss. The engine ledgers them with
         ``finish_reason='deadline'``."""
-        keep, dropped = [], []
-        for entry in self._waiting:
-            req = entry[1]
-            dl = req.deadline_time
-            if dl is not None and now > dl:
-                dropped.append(req)
-            else:
-                keep.append(entry)
-        if dropped:
-            self._waiting = keep
-            heapq.heapify(self._waiting)
-        return dropped
+        return self.pop_waiting(
+            lambda r: r.deadline_time is not None and now > r.deadline_time)
 
     # -- cancellation -------------------------------------------------------
 
@@ -237,22 +271,29 @@ class Scheduler:
         return None
 
     def cancel_running(self, req_id: int) -> Optional[Request]:
-        """Unbind a RUNNING request (engine-driven cancellation): it
-        leaves the running set CANCELLED, with its slot id returned on
-        the request untouched for the engine to free. None if not
-        running."""
-        for slot, req in self.running.items():
-            if req.req_id == req_id:
-                del self.running[slot]
-                req.state = CANCELLED
-                return req
+        """Unbind a RUNNING (or mid-prefill PARTIAL) request
+        (engine-driven cancellation): it leaves its table CANCELLED,
+        with its slot id returned on the request untouched for the
+        engine to free. None if neither running nor partial."""
+        for table in (self.running, self.partial):
+            for slot, req in table.items():
+                if req.req_id == req_id:
+                    del table[slot]
+                    req.state = CANCELLED
+                    return req
         return None
 
     def finish(self, req: Request, now: float) -> int:
-        """Mark finished; returns the freed slot id."""
+        """Mark finished; returns the freed slot id. Covers RUNNING
+        rows and (for fault-recovery error-outs) mid-prefill PARTIAL
+        rows alike."""
         slot = req.slot
-        assert slot is not None and self.running.get(slot) is req
-        del self.running[slot]
+        assert slot is not None
+        if self.running.get(slot) is req:
+            del self.running[slot]
+        else:
+            assert self.partial.get(slot) is req
+            del self.partial[slot]
         req.state = FINISHED
         req.slot = None
         req.finish_time = now
@@ -270,7 +311,10 @@ class Scheduler:
 
     @property
     def active(self) -> int:
-        return len(self.running)
+        """Slot-holding requests: decoding rows plus mid-prefill
+        PARTIAL rows (chunked admission)."""
+        return len(self.running) + len(self.partial)
 
     def idle(self) -> bool:
-        return not self._waiting and not self.running
+        return (not self._waiting and not self.running
+                and not self.partial)

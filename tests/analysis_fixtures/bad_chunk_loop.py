@@ -1,0 +1,53 @@
+"""SPMD105 fixtures: the chunked-admission pump pattern.
+
+Chunked streaming admission (``serving/chunked.py``) keeps per-row
+chunk progress as HOST data (``KVPool.chunk_done``/``chunk_target``)
+and drives the pump loop entirely outside any trace — that is what
+lets the one compiled ``(1, L)`` chunk-prefill program serve every
+progress state.  The tempting spelling is to move the loop INSIDE a
+traced step and branch on (or iterate over) each row's traced
+progress: on a tracer that raises TracerBoolConversionError, and the
+"fix" of hoisting progress to the host bakes one progress pattern into
+the program — a recompile per distinct chunk schedule, exactly the
+admission stall the subsystem exists to remove.  Mask arithmetic
+(``jnp.arange(L) < remaining[:, None]``) is the legal in-trace
+spelling, and the host-side pump (progress as plain ints, never
+traced) is the legal loop — neither may be flagged.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def chunk_step(params, tokens, progress, carry):
+    # legal spelling: per-row chunk validity is MASK arithmetic, so the
+    # traced progress stays runtime data of the one program
+    L = tokens.shape[1]                          # static shape — fine
+    inb = jnp.arange(L)[None] < progress[:, None]
+    x = jnp.where(inb, tokens, 0)
+    if tokens.ndim != 2:                         # static fact — fine
+        x = x[None]
+    if progress.max() < L:  # EXPECT: SPMD105
+        x = x + 1
+    while progress.sum() > 0:  # EXPECT: SPMD105
+        progress = progress - 1
+    done = 1 if progress[0] else 0  # EXPECT: SPMD105
+    pos = carry["pos"] + jnp.where(progress > 0, 1, 0) + done
+    return x, pos
+
+
+chunk_prefill = jax.jit(chunk_step)
+
+
+def host_pump(pool, plans, budget):
+    """The serving engine's ACTUAL spelling: chunk progress is host
+    data (``pool.chunk_done`` is a numpy int array), so the pump may
+    branch and loop freely — nothing here is ever traced."""
+    spent = 0
+    for slot, (req, pf) in plans.items():
+        done = int(pool.chunk_done[slot])
+        while done < len(pf) and spent < budget:
+            n = min(budget - spent, len(pf) - done)
+            done += n
+            spent += n
+    return spent
