@@ -22,6 +22,18 @@ Layer map (mirrors SURVEY.md §1):
 
 __version__ = "0.1.0"
 
-from bigdl_tpu.utils.engine import Engine, EngineType
-
 __all__ = ["Engine", "EngineType", "__version__"]
+
+
+def __getattr__(name):
+    # PEP 562 lazy re-export: utils.engine drags in utils.table and
+    # with it jax (~2s of import on the dev box). The static-analysis
+    # plane (`python -m bigdl_tpu.analysis`, pure stdlib by contract)
+    # lives under this package and must not pay that — so the facade
+    # imports resolve on first ATTRIBUTE access, not at package import.
+    if name in ("Engine", "EngineType"):
+        from bigdl_tpu.utils.engine import Engine, EngineType
+
+        return {"Engine": Engine, "EngineType": EngineType}[name]
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,37 +1,47 @@
-"""SPMD hygiene analyzer — AST lint for recompilation, sharding-spec,
-and jax-compat drift.
+"""SPMD hygiene + serving-contract analyzer — whole-program AST lint.
 
 The serving/optim/parallel planes all rest on invariants XLA never
 checks: one compiled program per engine, one spelling per PartitionSpec
-axis, every version-moved jax API routed through ``utils/compat.py``.
+axis, every version-moved jax API routed through ``utils/compat.py``,
+every serving dispatch routed through ``engine._dispatch``, one closed
+schema for the pooled-carry keys and the finish-reason vocabulary.
 This package makes those invariants machine-checked — as a CLI
 (``python -m bigdl_tpu.analysis``) and as a tier-1 test
-(``tests/test_static_analysis.py``).  Pure stdlib ``ast``; never
-imports jax.  Rule catalog and war stories: ``docs/analysis.md``.
+(``tests/test_static_analysis.py``).  Per-file rules (SPMD1xx) ride a
+single parsed-tree index; cross-module rules (SRV2xx) ride the
+ProjectContext fact table (import-graph-qualified class hierarchy,
+step-cache bindings, donation call-graph lifting, declared schemas)
+plus embedded string-program units.  Pure stdlib ``ast``; never imports jax.  Rule catalog and war
+stories: ``docs/analysis.md``.
 """
 
 from bigdl_tpu.analysis.core import (
     DEFAULT_EXCLUDE_DIRS,
     FileContext,
     Finding,
+    ProjectContext,
     Rule,
     all_rules,
     analyze_paths,
     analyze_source,
     format_baseline_entry,
     load_baseline,
+    prune_baseline_text,
     rule_codes,
+    scan,
     split_baselined,
+    stale_entries,
 )
 # importing the rules module populates the registry
 from bigdl_tpu.analysis import rules as _rules  # noqa: F401
-from bigdl_tpu.analysis.cli import DEFAULT_PATHS, main
+from bigdl_tpu.analysis.cli import DEFAULT_PATHS, main, to_sarif
 
 __all__ = [
     "DEFAULT_EXCLUDE_DIRS",
     "DEFAULT_PATHS",
     "FileContext",
     "Finding",
+    "ProjectContext",
     "Rule",
     "all_rules",
     "analyze_paths",
@@ -39,6 +49,10 @@ __all__ = [
     "format_baseline_entry",
     "load_baseline",
     "main",
+    "prune_baseline_text",
     "rule_codes",
+    "scan",
     "split_baselined",
+    "stale_entries",
+    "to_sarif",
 ]

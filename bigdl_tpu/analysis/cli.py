@@ -2,27 +2,46 @@
 
 Exit status is the contract CI rides on: 0 when every finding is
 baselined (or there are none), 1 when NEW findings exist, 2 on usage
-errors.  ``--json`` emits a machine-readable report so future tooling
-can diff findings across PRs.
+errors.  ``--format json`` (or ``--json``) emits a machine-readable
+report so future tooling can diff findings across PRs; ``--format
+sarif`` emits SARIF 2.1.0 so GitHub code scanning renders findings as
+inline annotations.  The JSON schema is frozen — SARIF is a sibling
+format, not a replacement.
+
+Baseline hygiene: a normal scan WARNS (stderr, exit code preserved)
+when the baseline contains STALE entries — fingerprints matching no
+current finding, i.e. fixed-or-edited violations whose entries would
+silently grandfather a future regression pasted at the same spot —
+and ``--prune-baseline`` rewrites the baseline file without them
+(each entry's justification comment goes with it).
+
+Speed: the CLI (not the library API) runs with a content-hash findings
+cache (``.cache/analysis_cache.json``; ``--no-cache`` disables) and a
+forked parallel parser for cache misses (``--jobs``), so the
+steady-state pre-commit gate costs well under a second — see
+``core.scan``'s contract for why the cache can never change results.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 from typing import List, Optional
 
 from bigdl_tpu.analysis.core import (
-    DEFAULT_EXCLUDE_DIRS, all_rules, analyze_paths,
-    format_baseline_entry, load_baseline, rule_codes, split_baselined,
+    DEFAULT_EXCLUDE_DIRS, all_rules, covered_by_scan,
+    format_baseline_entry, load_baseline, prune_baseline_text,
+    rule_codes, scan, split_baselined, stale_entries,
 )
 
 #: what the pass covers when no paths are given — the three analyzed
 #: planes plus their tests/benchmarks, mirroring tests/test_static_analysis
 DEFAULT_PATHS = ["bigdl_tpu", "benchmarks", "tests"]
 DEFAULT_BASELINE = "analysis_baseline.txt"
+DEFAULT_CACHE = os.path.join(".cache", "analysis_cache.json")
 
 
 def _parse_codes(s: Optional[str]) -> Optional[List[str]]:
@@ -34,8 +53,10 @@ def _parse_codes(s: Optional[str]) -> Optional[List[str]]:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m bigdl_tpu.analysis",
-        description="SPMD hygiene analyzer: AST lint for recompilation, "
-                    "sharding-spec, and jax-compat drift.")
+        description="SPMD hygiene + serving-contract analyzer: "
+                    "whole-program AST lint for recompilation, "
+                    "sharding-spec, jax-compat, and serving-plane "
+                    "invariant drift.")
     p.add_argument("paths", nargs="*", default=None,
                    help=f"files/directories to analyze "
                         f"(default: {' '.join(DEFAULT_PATHS)})")
@@ -52,13 +73,73 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", action="store_true",
                    help="print ready-to-commit baseline entries for the "
                         "current findings and exit 0")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline file dropping STALE "
+                        "entries (fingerprints matching no current "
+                        "finding), then report as usual")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text", dest="fmt",
+                   help="report format (sarif renders as GitHub "
+                        "annotations in CI; json is the stable "
+                        "machine-readable schema)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit a JSON report (findings + summary) on stdout")
+                   help="alias for --format json (kept stable for "
+                        "existing tooling)")
     p.add_argument("--list-rules", action="store_true",
                    help="list rule codes and exit")
+    p.add_argument("--jobs", type=int, default=0, metavar="N",
+                   help="parallel parse workers for cache misses "
+                        "(default: the host's cores; 1 = serial)")
+    p.add_argument("--no-cache", action="store_true",
+                   help=f"disable the findings cache "
+                        f"({DEFAULT_CACHE})")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress per-finding hints")
     return p
+
+
+def to_sarif(findings, rules) -> dict:
+    """Findings as a minimal SARIF 2.1.0 log (one run, one result per
+    NEW finding; the content fingerprint rides along so code-scanning
+    dedup matches the baseline's identity rules)."""
+    by_code = {}
+    for r in rules:
+        by_code[r.code] = {
+            "id": r.code,
+            "name": r.name,
+            "shortDescription": {"text": r.summary},
+            "help": {"text": r.hint},
+        }
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col},
+                },
+            }],
+            "partialFingerprints": {
+                "bigdlAnalysis/v1": f.fingerprint(),
+            },
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "bigdl-tpu-analysis",
+                "informationUri": "docs/analysis.md",
+                "rules": [by_code[c] for c in sorted(by_code)],
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -69,6 +150,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{r.code}  {r.name}: {r.summary}")
         return 0
 
+    fmt = "json" if args.as_json else args.fmt
+    if args.prune_baseline and args.no_baseline:
+        # with the baseline ignored, EVERY entry would look stale and
+        # the prune would empty the file — refuse the combination
+        print("error: --prune-baseline conflicts with --no-baseline "
+              "(pruning judges entries against the baseline-aware scan)",
+              file=sys.stderr)
+        return 2
     select = _parse_codes(args.select)
     ignore = _parse_codes(args.ignore)
     known = set(rule_codes())
@@ -86,8 +175,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: path(s) do not exist: {', '.join(missing)} "
               f"(cwd: {Path.cwd()})", file=sys.stderr)
         return 2
-    findings = analyze_paths(paths, select=select, ignore=ignore,
-                             exclude_dirs=DEFAULT_EXCLUDE_DIRS)
+    jobs = args.jobs or (os.cpu_count() or 1)
+    findings = scan(paths, select=select, ignore=ignore,
+                    exclude_dirs=DEFAULT_EXCLUDE_DIRS,
+                    cache_path=None if args.no_cache else DEFAULT_CACHE,
+                    jobs=max(1, jobs))
 
     if args.write_baseline:
         print(f"# SPMD hygiene baseline — {len(findings)} grandfathered "
@@ -99,9 +191,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     baseline = set() if args.no_baseline else load_baseline(args.baseline)
+    # staleness is judged only over what THIS scan covered (files under
+    # the scanned paths, rules actually run): a partial scan must never
+    # declare other files' grandfathered entries dead, let alone prune
+    # them
+    run_codes = (set(select) if select else known) - set(ignore or [])
+    stale = stale_entries(findings, baseline,
+                          covered=covered_by_scan(paths),
+                          codes=run_codes)
+    if args.prune_baseline and Path(args.baseline).exists():
+        keep = set(baseline) - stale
+        text = Path(args.baseline).read_text(encoding="utf-8")
+        new_text, removed = prune_baseline_text(text, keep)
+        if removed:
+            Path(args.baseline).write_text(new_text, encoding="utf-8")
+        print(f"pruned {removed} stale baseline entr"
+              f"{'y' if removed == 1 else 'ies'} from {args.baseline}",
+              file=sys.stderr)
+        baseline -= stale
+        stale = set()
+    elif stale:
+        # exit-code preserving: a stale entry is hygiene debt, not a
+        # failure — but every scan says so until someone prunes
+        print(f"warning: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} in {args.baseline} "
+              f"match no current finding — run --prune-baseline",
+              file=sys.stderr)
     new, grandfathered = split_baselined(findings, baseline)
 
-    if args.as_json:
+    if fmt == "sarif":
+        print(json.dumps(to_sarif(new, all_rules()), indent=2))
+        return 1 if new else 0
+    if fmt == "json":
         print(json.dumps({
             "paths": list(paths),
             "rules": sorted(select or known),

@@ -1,4 +1,5 @@
-"""Rule engine for the SPMD hygiene analyzer.
+"""Rule engine for the whole-program SPMD-hygiene + serving-contract
+analyzer.
 
 Pure stdlib ``ast`` — importing this module (or running the CLI) never
 imports jax, so the pass costs milliseconds per file and runs anywhere,
@@ -12,11 +13,29 @@ The moving parts:
   fingerprint so baselines survive unrelated edits above the finding).
 * :class:`Rule` + :func:`register` — the rule registry.  Each rule walks
   one parsed file (:class:`FileContext`) and yields findings.
-* :func:`analyze_paths` — walk files/dirs, parse once, run every
-  selected rule.
-* :func:`load_baseline` / :func:`format_baseline_entry` — grandfathered
+* :class:`ProjectContext` — the WHOLE-PROGRAM half: every scanned file
+  parsed up front and a merged cross-module FACT table (per-file
+  collectors qualify names through each file's imports — class
+  inheritance edges, step-cache bindings, donation signatures, the
+  declared schemas — and the engine unions them project-wide).  Every
+  :class:`FileContext` carries a ``.project`` backref, so per-file
+  rules consult cross-module state without re-deriving it (the SRV2xx
+  family is built on this).
+* **Embedded program units** — string constants that hold Python
+  programs (e.g. the ``pod_projection._CHILD`` child source) are
+  parsed as nested :class:`FileContext` units and scanned by every
+  rule, with finding lines remapped into the host file.  This closes
+  the documented PR-5 blind spot; ``str.format`` templates are
+  unescaped first (``{{``/``}}`` → braces, ``{placeholder}`` → a
+  parseable stub).
+* :func:`analyze_paths` — walk files/dirs, parse once, build the
+  project, run every selected rule.
+* :func:`load_baseline` / :func:`format_baseline_entry` /
+  :func:`stale_entries` / :func:`prune_baseline_text` — grandfathered
   findings.  An entry matches ``path : code : fingerprint`` so moving a
-  violating line does not un-baseline it, while *editing* it does.
+  violating line does not un-baseline it, while *editing* it does;
+  entries whose fingerprint no longer matches ANY finding are STALE
+  (warned about on every scan, removed by ``--prune-baseline``).
 """
 
 from __future__ import annotations
@@ -25,13 +44,75 @@ import ast
 import dataclasses
 import hashlib
 import os
+import re
 from pathlib import Path
-from typing import Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import (
+    Any, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple,
+)
 
 #: directory basenames never walked into — fixture trees hold deliberate
 #: violations and must only be scanned when named explicitly as files
 DEFAULT_EXCLUDE_DIRS = frozenset(
     {"__pycache__", ".git", "_build", ".cache", "analysis_fixtures"})
+
+
+def _module_name(relpath: str) -> str:
+    """Dotted module name for a repo-relative path
+    (``bigdl_tpu/serving/engine.py`` → ``bigdl_tpu.serving.engine``;
+    ``__init__.py`` collapses onto its package)."""
+    p = relpath.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x not in ("", ".", "..")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _own_scope_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested
+    def/lambda subtrees — their assignment targets are locals of a
+    DIFFERENT scope and must not count as this function's bindings."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def literal_value(node: Optional[ast.AST]) -> Any:
+    """Best-effort Python value of a literal-ish AST node: constants,
+    tuples/lists/sets/dicts of literals, plus ``frozenset(...)`` /
+    ``set(...)`` / ``tuple(...)`` / ``list(...)`` calls over a literal
+    argument (``ast.literal_eval`` rejects those spellings).  Returns
+    :data:`UNRESOLVED` when the node is not statically evaluable —
+    callers must treat that as "provenance unknown", never as a
+    value."""
+    if node is None:
+        return UNRESOLVED
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set", "tuple", "list") \
+            and not node.keywords and len(node.args) <= 1:
+        inner = literal_value(node.args[0]) if node.args else ()
+        if inner is UNRESOLVED:
+            return UNRESOLVED
+        try:
+            return {"frozenset": frozenset, "set": set,
+                    "tuple": tuple, "list": list}[node.func.id](inner)
+        except TypeError:
+            return UNRESOLVED
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError, MemoryError,
+            RecursionError):
+        return UNRESOLVED
+
+
+#: sentinel for "this expression is not statically resolvable"
+UNRESOLVED = object()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,17 +158,36 @@ class Finding:
 
 class FileContext:
     """One parsed file handed to every rule: the tree, the raw lines,
-    and helpers for building findings and resolving imported names."""
+    and helpers for building findings and resolving imported names.
+
+    ``module`` is the dotted module name derived from the path
+    (``bigdl_tpu/serving/engine.py`` → ``bigdl_tpu.serving.engine``);
+    ``project`` is the owning :class:`ProjectContext` (set by the
+    engine — None only for hand-built contexts). An EMBEDDED unit (a
+    program parsed out of a string constant) shares its host's
+    ``relpath`` and carries ``line_base`` so findings report host-file
+    line numbers."""
 
     def __init__(self, path: str, relpath: str, text: str,
-                 tree: ast.Module) -> None:
+                 tree: ast.Module, line_base: int = 0,
+                 embedded: bool = False) -> None:
         self.path = path
         self.relpath = relpath
         self.text = text
         self.lines = text.splitlines()
         self.tree = tree
+        self.line_base = line_base      # host-line offset (embedded units)
+        self.embedded = embedded
+        self.module = _module_name(relpath)
+        self.project: Optional["ProjectContext"] = None
+        #: per-file memo for rule-computed facts (e.g. the traced-
+        #: function list two rules share) — one AST pass each, not one
+        #: per rule
+        self.cache: Dict[str, Any] = {}
         self._parents: Optional[dict] = None
         self._imports: Optional[dict] = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self._type_index: Dict[tuple, List[ast.AST]] = {}
 
     # -- identity ----------------------------------------------------------
 
@@ -110,20 +210,53 @@ class FileContext:
                 hint: str = "") -> Finding:
         line = getattr(node, "lineno", 1)
         col = getattr(node, "col_offset", 0) + 1
-        return Finding(path=self.relpath, line=line, col=col, code=code,
-                       message=message, hint=hint,
+        # embedded units report HOST-file lines (the string's first
+        # value line sits on the Constant node's own line)
+        return Finding(path=self.relpath, line=line + self.line_base,
+                       col=col, code=code, message=message, hint=hint,
                        source=self.source_line(line))
 
     # -- structure helpers -------------------------------------------------
 
     @property
+    def nodes(self) -> List[ast.AST]:
+        """Every AST node of the file, from ONE traversal that also
+        builds the parent map — the whole-file walk each rule reuses
+        instead of re-walking the tree (the analyzer's hot loop: six+
+        rules x every file)."""
+        if self._nodes is None:
+            self._nodes = []
+            self._parents = {}
+            buckets: Dict[type, List[ast.AST]] = {}
+            stack: List[ast.AST] = [self.tree]
+            while stack:
+                n = stack.pop()
+                self._nodes.append(n)
+                buckets.setdefault(type(n), []).append(n)
+                for child in ast.iter_child_nodes(n):
+                    self._parents[child] = n
+                    stack.append(child)
+            self._buckets = buckets
+        return self._nodes
+
+    def by_type(self, *types) -> List[ast.AST]:
+        """All nodes of the given exact AST type(s), from the shared
+        traversal — grouped once at build time, so each lookup is a
+        dict hit, not a re-scan."""
+        idx = self._type_index.get(types)
+        if idx is None:
+            _ = self.nodes
+            out: List[ast.AST] = []
+            for t in types:
+                out.extend(self._buckets.get(t, ()))
+            idx = self._type_index[types] = out
+        return idx
+
+    @property
     def parents(self) -> dict:
         """child-node -> parent-node map (built lazily, once per file)."""
         if self._parents is None:
-            self._parents = {}
-            for parent in ast.walk(self.tree):
-                for child in ast.iter_child_nodes(parent):
-                    self._parents[child] = parent
+            _ = self.nodes                 # builds both in one pass
         return self._parents
 
     def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
@@ -147,7 +280,7 @@ class FileContext:
         if self._imports is not None:
             return self._imports
         amap: dict = {}
-        for node in ast.walk(self.tree):
+        for node in self.by_type(ast.Import, ast.ImportFrom):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.asname:
@@ -192,6 +325,270 @@ class FileContext:
         if not isinstance(cur, ast.Name):
             return None
         return ".".join([cur.id] + list(reversed(parts)))
+
+    # -- scope-chain provenance (shared by SPMD103/106 + the SRV rules) ----
+
+    def scope_local_names(self, node: ast.AST) -> Set[str]:
+        """Names bound in the enclosing function/lambda scope chain of
+        ``node`` (params + assignment/loop/with targets) — the values a
+        closure at ``node`` could capture per call, as opposed to
+        module-level constants."""
+        names: Set[str] = set()
+        cur = self.enclosing_function(node)
+        while cur is not None:
+            a = cur.args
+            for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+                names.add(p.arg)
+            if a.vararg:
+                names.add(a.vararg.arg)
+            if a.kwarg:
+                names.add(a.kwarg.arg)
+            if not isinstance(cur, ast.Lambda):
+                for sub in _own_scope_nodes(cur):
+                    targets: List[ast.AST] = []
+                    if isinstance(sub, ast.Assign):
+                        targets = list(sub.targets)
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign,
+                                          ast.For)):
+                        targets = [sub.target]
+                    elif isinstance(sub, ast.withitem) and \
+                            sub.optional_vars is not None:
+                        targets = [sub.optional_vars]
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                names.add(n.id)
+            cur = self.enclosing_function(cur)
+        return names
+
+    def binding_candidates(self, dotted: str) -> List[
+            Tuple[Optional[ast.AST], int, ast.AST]]:
+        """Every plain assignment to ``dotted`` in the file:
+        ``(enclosing scope, lineno, value node)`` tuples in walk order.
+        The raw material for :meth:`resolve_binding`; cached per file."""
+        cache = getattr(self, "_binding_cache", None)
+        if cache is None:
+            cache = self._binding_cache = {}
+            for node in self.by_type(ast.Assign):
+                scope = self.enclosing_function(node)
+                for t in node.targets:
+                    d = self.dotted(t)
+                    if d:
+                        cache.setdefault(d, []).append(
+                            (scope, node.lineno, node.value))
+        return cache.get(dotted, [])
+
+    def resolve_binding(self, dotted: str,
+                        at: ast.AST) -> Optional[ast.AST]:
+        """The VALUE node of the assignment to ``dotted`` that is in
+        effect at ``at``: the nearest preceding assignment in ``at``'s
+        lexical scope chain, searched innermost-out.  Returns None when
+        no assignment is visible; an assignment whose provenance a rule
+        cannot interpret still SHADOWS outer ones (the caller sees its
+        value node and decides) — that is the scope-chain resolution
+        SPMD106 pioneered, now shared project-wide."""
+        cands = self.binding_candidates(dotted)
+        if not cands:
+            return None
+        scope: Optional[ast.AST] = self.enclosing_function(at)
+        while True:
+            in_scope = [(ln, val) for (s, ln, val) in cands
+                        if s is scope and ln <= at.lineno]
+            if in_scope:
+                return max(in_scope, key=lambda t: t[0])[1]
+            if scope is None:
+                return None
+            scope = self.enclosing_function(scope)
+
+
+# -- embedded program units -------------------------------------------------
+
+#: cheap screen before attempting a parse: a real child program has
+#: several lines and imports something
+_EMBED_MIN_LINES = 4
+_EMBED_HINT = "import "
+#: opt-out comment for assigned strings that are deliberate-violation
+#: test material rather than shipped child programs
+_NO_EMBED_MARK = "analysis: no-embed"
+
+
+def _format_unescape(s: str) -> str:
+    """Turn a ``str.format`` TEMPLATE into parseable Python: ``{{``/
+    ``}}`` become literal braces and ``{placeholder}`` fields become
+    ``None`` stubs.  Newlines are preserved, so line numbers survive
+    the transform (columns inside substituted spans do not — lines are
+    what the baseline and the fixtures key on)."""
+    s = s.replace("{{", "\x00").replace("}}", "\x01")
+    s = re.sub(r"\{[^{}\n]*\}", "None", s)
+    return s.replace("\x00", "{").replace("\x01", "}")
+
+
+def _parse_embedded(value: str) -> Optional[Tuple[ast.Module, str]]:
+    """Parse a candidate embedded program, trying the raw text first
+    and the format-unescaped form second.  Returns ``(tree, text)``
+    for whichever form parsed, or None when neither parses or the
+    result contains no import (prose/docstring-shaped strings never
+    qualify)."""
+    for text in (value, _format_unescape(value)):
+        try:
+            tree = ast.parse(text)
+        except (SyntaxError, ValueError):
+            continue
+        if any(isinstance(n, (ast.Import, ast.ImportFrom))
+               for n in ast.walk(tree)):
+            return tree, text
+    return None
+
+
+def extract_embedded_units(ctx: FileContext) -> List[FileContext]:
+    """Nested :class:`FileContext` units for every string constant in
+    ``ctx`` that holds a Python program — ASSIGNED strings only (bare
+    expression strings are docstrings), multi-line, import-bearing,
+    and parseable (after ``str.format`` unescaping for templates like
+    ``pod_projection._CHILD``).  Findings inside a unit report the
+    HOST file's path and line numbers.  One level deep: units never
+    recurse into their own strings."""
+    if ctx.embedded:
+        return []
+    units: List[FileContext] = []
+    for node in ctx.by_type(ast.Assign, ast.AnnAssign):
+        value = node.value
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, str)):
+            continue
+        text = value.value
+        if text.count("\n") + 1 < _EMBED_MIN_LINES or \
+                _EMBED_HINT not in text:
+            continue
+        # suppression idiom: a string that is a DELIBERATE violation
+        # (a test building bad source to assert the analyzer catches
+        # it) opts out with `# analysis: no-embed` on its opening line
+        open_line = ctx.source_line(value.lineno)
+        if _NO_EMBED_MARK in open_line or \
+                _NO_EMBED_MARK in ctx.source_line(value.lineno - 1):
+            continue
+        hit = _parse_embedded(text)
+        if hit is None:
+            continue
+        tree, parsed = hit
+        units.append(FileContext(
+            path=ctx.path, relpath=ctx.relpath, text=parsed, tree=tree,
+            # value line 1 sits on the Constant's own line (the
+            # canonical `X = r"""\n...` layout starts its code on the
+            # next line via a leading blank value line)
+            line_base=value.lineno - 1, embedded=True))
+    return units
+
+
+# -- the whole-program pass -------------------------------------------------
+
+#: registered per-file fact collectors: ctx -> {kind: value}.  Rules
+#: register these (like rules themselves) so the engine can compute
+#: cross-module facts without core importing the rules module.
+_FACT_COLLECTORS: List[Any] = []
+
+
+def register_fact_collector(fn):
+    _FACT_COLLECTORS.append(fn)
+    return fn
+
+
+def collect_file_facts(ctx: "FileContext") -> Dict[str, Any]:
+    """All registered fact kinds for one file (embedded units
+    included — a child program can bind step functions too)."""
+    out: Dict[str, Any] = {}
+    for fn in _FACT_COLLECTORS:
+        for kind, value in fn(ctx).items():
+            _merge_fact(out, kind, value)
+    return out
+
+
+def _copy_fact(value: Any) -> Any:
+    """One-level copy of a fact value. The merge target must NEVER
+    alias a contributor: per-file fact dicts live inside cache entries
+    (and are what _save_cache persists), so mutating a contributor
+    through the merged table would pollute the cache with other files'
+    facts and make cached scans diverge from fresh ones."""
+    if isinstance(value, dict):
+        return {k: (list(v) if isinstance(v, list) else v)
+                for k, v in value.items()}
+    if isinstance(value, list):
+        return list(value)
+    return value
+
+
+def _merge_fact(into: Dict[str, Any], kind: str, value: Any) -> None:
+    cur = into.get(kind)
+    if cur is None:
+        into[kind] = _copy_fact(value)
+    elif isinstance(cur, dict):
+        for k, v in value.items():
+            if isinstance(cur.get(k), list):
+                cur[k] = sorted(set(cur[k]) | set(v))
+            else:
+                cur.setdefault(k, _copy_fact(v))
+    elif isinstance(cur, list):
+        into[kind] = sorted(set(cur) | set(value))
+    else:
+        into[kind] = value
+
+
+def merge_facts(per_file: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Union per-file fact dicts into the project-wide fact table the
+    cross-module rules consume.  Values are JSON-shaped (lists/dicts of
+    strings) so facts can be cached and shipped between processes."""
+    out: Dict[str, Any] = {}
+    for facts in per_file:
+        for kind, value in facts.items():
+            _merge_fact(out, kind, value)
+    return out
+
+
+def facts_digest(facts: Dict[str, Any]) -> str:
+    """Stable content hash of a merged fact table — part of the
+    findings-cache key, so editing a file in a way that changes any
+    cross-module fact invalidates every file's cached findings."""
+    import json
+
+    blob = json.dumps(facts, sort_keys=True, default=sorted)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+
+class ProjectContext:
+    """Cross-module state for one analyzer run: every scanned file
+    (host files AND their embedded units), the merged cross-module
+    FACT table, and a memo cache for rule-computed project-wide state.
+    All cross-module resolution flows through the fact collectors
+    (``register_fact_collector``) — per-file facts are import-graph
+    qualified where they are collected, then merged here — so the
+    table is small, JSON-shaped, and the same object the findings
+    cache and the parallel workers ship around.
+
+    A single-file run (``analyze_source``, the fixture tests) builds a
+    one-file project: cross-module facts simply are not present, and
+    rules fall back to their documented per-file approximations."""
+
+    def __init__(self, contexts: Sequence[FileContext]) -> None:
+        self.contexts = list(contexts)
+        #: rules' project-wide memo (keyed by rule-chosen strings)
+        self.cache: Dict[str, Any] = {}
+        self._facts: Optional[Dict[str, Any]] = None
+        for ctx in self.contexts:
+            ctx.project = self
+
+    @property
+    def facts(self) -> Dict[str, Any]:
+        """The merged cross-module fact table (computed lazily from
+        this project's own files, or injected pre-merged by the
+        parallel scanner / the findings cache)."""
+        if self._facts is None:
+            self._facts = merge_facts(
+                collect_file_facts(ctx) for ctx in self.contexts)
+        return self._facts
+
+    @facts.setter
+    def facts(self, value: Dict[str, Any]) -> None:
+        self._facts = value
 
 
 class Rule:
@@ -252,39 +649,57 @@ def _relpath(p: Path) -> str:
         return p.as_posix()
 
 
+def _parse_file(text: str, path: str
+                ) -> Tuple[Optional[FileContext], Optional[Finding]]:
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return None, Finding(
+            path=path, line=e.lineno or 1, col=(e.offset or 1),
+            code="SPMD000", message=f"file does not parse: {e.msg}",
+            source=(e.text or "").strip())
+    return FileContext(path=path, relpath=path, text=text,
+                       tree=tree), None
+
+
+def _run_rules(contexts: Sequence[FileContext],
+               parse_errors: Sequence[Finding],
+               select: Optional[Iterable[str]],
+               ignore: Optional[Iterable[str]]) -> List[Finding]:
+    """Phase two of every analysis: build the whole-program
+    :class:`ProjectContext` over all parsed files + their embedded
+    units, run the selected rules over each unit, sort, and
+    occurrence-index duplicate (path, code, source) findings so each
+    duplicated line needs its own baseline entry."""
+    all_ctx: List[FileContext] = []
+    for ctx in contexts:
+        all_ctx.append(ctx)
+        all_ctx.extend(extract_embedded_units(ctx))
+    ProjectContext(all_ctx)
+    sel = set(select) if select else None
+    ign = set(ignore) if ignore else set()
+    out: List[Finding] = list(parse_errors)
+    for ctx in all_ctx:
+        for rule in _REGISTRY:
+            if sel is not None and rule.code not in sel:
+                continue
+            if rule.code in ign:
+                continue
+            out.extend(rule.check(ctx))
+    return _finalize(out)
+
+
 def analyze_source(text: str, path: str = "<string>",
                    select: Optional[Iterable[str]] = None,
                    ignore: Optional[Iterable[str]] = None) -> List[Finding]:
     """Run the selected rules over one source string (test/fixture entry
-    point; :func:`analyze_paths` is the file-walking wrapper)."""
-    try:
-        tree = ast.parse(text)
-    except SyntaxError as e:
-        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 1),
-                        code="SPMD000",
-                        message=f"file does not parse: {e.msg}",
-                        source=(e.text or "").strip())]
-    ctx = FileContext(path=path, relpath=path, text=text, tree=tree)
-    sel = set(select) if select else None
-    ign = set(ignore) if ignore else set()
-    out: List[Finding] = []
-    for rule in _REGISTRY:
-        if sel is not None and rule.code not in sel:
-            continue
-        if rule.code in ign:
-            continue
-        out.extend(rule.check(ctx))
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
-    # occurrence-index repeated (code, source) pairs in source order so
-    # each duplicate line needs its own baseline entry
-    seen: dict = {}
-    for i, f in enumerate(out):
-        k = (f.code, f.source)
-        idx = seen.get(k, 0)
-        seen[k] = idx + 1
-        if idx:
-            out[i] = dataclasses.replace(f, occurrence=idx)
-    return out
+    point; :func:`analyze_paths` is the file-walking wrapper).  The
+    string becomes a one-file project: cross-module resolution degrades
+    to per-file fallbacks."""
+    ctx, err = _parse_file(text, path)
+    if err is not None:
+        return [err]
+    return _run_rules([ctx], [], select, ignore)
 
 
 def analyze_paths(paths: Sequence[str],
@@ -292,14 +707,18 @@ def analyze_paths(paths: Sequence[str],
                   ignore: Optional[Iterable[str]] = None,
                   exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
                   ) -> List[Finding]:
-    """Walk ``paths`` (files and/or directories) and run the rules."""
-    findings: List[Finding] = []
+    """Walk ``paths`` (files and/or directories), parse everything,
+    build the whole-program project, and run the rules."""
+    contexts: List[FileContext] = []
+    errors: List[Finding] = []
     for f in _iter_py_files(paths, exclude_dirs):
         text = f.read_text(encoding="utf-8", errors="replace")
-        findings.extend(analyze_source(text, path=_relpath(f),
-                                       select=select, ignore=ignore))
-    findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
-    return findings
+        ctx, err = _parse_file(text, _relpath(f))
+        if err is not None:
+            errors.append(err)
+        else:
+            contexts.append(ctx)
+    return _run_rules(contexts, errors, select, ignore)
 
 
 # -- baseline --------------------------------------------------------------
@@ -341,3 +760,467 @@ def format_baseline_entry(f: Finding) -> str:
     comment so reviewers see what is being grandfathered)."""
     path, code, fp = f.baseline_key()
     return f"# line {f.line}: {f.source}\n{path}:{code}:{fp}"
+
+
+def covered_by_scan(paths: Sequence[str],
+                    exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+                    ) -> Tuple[Set[str], Tuple[str, ...]]:
+    """What a scan over ``paths`` can VOUCH for: the set of scanned
+    file relpaths plus the directory prefixes the scan walked.  A
+    baseline entry is only assessable (stale-warnable, prunable) when
+    its path falls inside this coverage — a partial scan must never
+    judge entries for files it did not look at (they would all look
+    "stale" and a prune would delete live grandfathered findings);
+    deleted files under a scanned TREE are covered by the prefix, so
+    their dead entries still prune."""
+    files = {_relpath(f) for f in _iter_py_files(paths, exclude_dirs)}
+    prefixes = tuple(
+        _relpath(Path(p)).rstrip("/") + "/"
+        for p in paths if Path(p).is_dir())
+    return files, prefixes
+
+
+def stale_entries(findings: Sequence[Finding],
+                  baseline: Set[Tuple[str, str, str]],
+                  covered: Optional[Tuple[Set[str],
+                                          Tuple[str, ...]]] = None,
+                  codes: Optional[Set[str]] = None,
+                  ) -> Set[Tuple[str, str, str]]:
+    """Baseline entries matching NO current finding — the violation was
+    fixed (or its line edited, which re-keys it), so the entry is dead
+    weight that would silently grandfather a future regression pasted
+    at the same spot.  Scans warn about these; ``--prune-baseline``
+    removes them.  ``covered`` (from :func:`covered_by_scan`) and
+    ``codes`` (the rule selection) restrict the verdict to entries this
+    scan actually assessed: entries for unscanned files or unselected
+    rules are never stale."""
+    live = {f.baseline_key() for f in findings}
+    out = set()
+    for entry in baseline:
+        path, code, _fp = entry
+        if entry in live:
+            continue
+        if codes is not None and code not in codes:
+            continue
+        if covered is not None:
+            files, prefixes = covered
+            if path not in files and \
+                    not any(path.startswith(p) for p in prefixes):
+                continue
+        out.add(entry)
+    return out
+
+
+def prune_baseline_text(text: str,
+                        live: Set[Tuple[str, str, str]]
+                        ) -> Tuple[str, int]:
+    """Rewrite a baseline file's text keeping only entries in ``live``
+    (each dropped entry takes its immediately preceding comment block —
+    the justification — with it).  Returns ``(new_text, n_removed)``;
+    header comments and blank lines elsewhere survive."""
+    out: List[str] = []
+    pending: List[str] = []          # comment run awaiting its entry
+    removed = 0
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("#"):
+            pending.append(raw)
+            continue
+        if not line:
+            out.extend(pending)
+            pending = []
+            out.append(raw)
+            continue
+        parts = line.rsplit(":", 2)
+        key = tuple(parts) if len(parts) == 3 else None
+        if key is not None and key not in live:
+            removed += 1
+            pending = []             # the justification goes with it
+            continue
+        out.extend(pending)
+        pending = []
+        out.append(raw)
+    out.extend(pending)
+    new = "\n".join(out)
+    if text.endswith("\n") and new and not new.endswith("\n"):
+        new += "\n"
+    return new, removed
+
+
+# -- the scan driver: content-hash cache + parallel workers -----------------
+#
+# `python -m bigdl_tpu.analysis` over the whole repo must stay fast
+# enough to run as a pre-commit gate (<2s steady-state on the dev box).
+# Two levers, both OFF in the library API (analyze_paths) and ON in the
+# CLI:
+#
+# * a FINDINGS CACHE keyed by (analyzer source digest, file path+text
+#   hash, merged-facts digest, rule selection): a file's findings are a
+#   pure function of those inputs, so unchanged files cost one sha1
+#   instead of a parse + six rule passes.  Editing any file re-analyzes
+#   it; editing a file in a way that changes a CROSS-MODULE fact (a new
+#   step binding, a schema change) flips the facts digest and
+#   re-analyzes everything — correctness first.
+# * PARALLEL WORKERS (fork) for cache misses: each worker parses its
+#   slice and returns per-file facts; the parent merges them with the
+#   cached facts and broadcasts the table; workers then run the rules
+#   over their already-parsed trees.  Guarded: fork only, and only in
+#   processes that have not initialized jax (forking a live XLA client
+#   can wedge) — anything else silently degrades to serial.
+
+CACHE_VERSION = 1
+#: cache entries untouched for this many runs age out (bounds growth
+#: from edited files' dead content-hash keys without evicting the
+#: whole-repo table on every subset scan)
+_CACHE_KEEP_RUNS = 64
+
+_ANALYZER_DIGEST: Optional[str] = None
+
+
+def analyzer_digest() -> str:
+    """Content hash of the analyzer's own source (core + rules): part
+    of every cache key, so editing a rule invalidates the cache."""
+    global _ANALYZER_DIGEST
+    if _ANALYZER_DIGEST is None:
+        h = hashlib.sha1()
+        pkg = Path(__file__).resolve().parent
+        for name in ("core.py", "rules.py"):
+            try:
+                h.update((pkg / name).read_bytes())
+            except OSError:
+                h.update(name.encode())
+        _ANALYZER_DIGEST = h.hexdigest()[:16]
+    return _ANALYZER_DIGEST
+
+
+def _file_key(relpath: str, text: str) -> str:
+    h = hashlib.sha1()
+    h.update(relpath.encode("utf-8", "replace"))
+    h.update(b"\0")
+    h.update(text.encode("utf-8", "replace"))
+    return h.hexdigest()
+
+
+def _load_cache(path: Optional[str]) -> dict:
+    import json
+
+    if not path:
+        return {"version": CACHE_VERSION, "analyzer": analyzer_digest(),
+                "files": {}}
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+        if data.get("version") == CACHE_VERSION and \
+                data.get("analyzer") == analyzer_digest() and \
+                isinstance(data.get("files"), dict):
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"version": CACHE_VERSION, "analyzer": analyzer_digest(),
+            "files": {}}
+
+
+def _save_cache(path: Optional[str], data: dict) -> None:
+    import json
+
+    if not path:
+        return
+    try:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        tmp.write_text(json.dumps(data), encoding="utf-8")
+        tmp.replace(p)
+    except OSError:
+        pass                       # the cache is an optimization only
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(path=d["path"], line=d["line"], col=d["col"],
+                   code=d["code"], message=d["message"],
+                   hint=d.get("hint", ""), source=d.get("source", ""),
+                   occurrence=0)
+
+
+def _file_facts(ctx: Optional[FileContext],
+                units: Sequence[FileContext]) -> Dict[str, Any]:
+    ctxs = ([ctx] if ctx is not None else []) + list(units)
+    return merge_facts(collect_file_facts(c) for c in ctxs)
+
+
+def _rules_for(select, ignore):
+    sel = set(select) if select else None
+    ign = set(ignore) if ignore else set()
+    return [r for r in _REGISTRY
+            if (sel is None or r.code in sel) and r.code not in ign]
+
+
+def _analyze_one(relpath: str, text: str, merged_facts: Dict[str, Any],
+                 rules) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Parse + rule-run ONE file (host + embedded units) against a
+    pre-merged fact table.  Returns (raw findings, the file's own
+    facts)."""
+    ctx, err = _parse_file(text, relpath)
+    if err is not None:
+        return [err], {}
+    units = extract_embedded_units(ctx)
+    ctxs = [ctx] + units
+    project = ProjectContext(ctxs)
+    project.facts = merged_facts
+    facts = _file_facts(ctx, units)
+    out: List[Finding] = []
+    for c in ctxs:
+        for rule in rules:
+            out.extend(rule.check(c))
+    return out, facts
+
+
+#: inline suppression idiom: `# analysis: ok` silences every finding on
+#: its line, `# analysis: ok: SRV205` (comma-separable) only the listed
+#: codes — for the rare line that is LEGITIMATE despite matching a rule
+#: (e.g. a test deliberately exercising an error path).  Prefer fixing;
+#: this exists so legitimate code never has to seed the baseline.
+_SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*ok\b(?:\s*:\s*([A-Z0-9_,\s]+))?")
+
+
+def _suppressed(f: Finding) -> bool:
+    m = _SUPPRESS_RE.search(f.source)
+    if not m:
+        return False
+    if not m.group(1):
+        return True
+    codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return f.code in codes
+
+
+def _finalize(findings: List[Finding]) -> List[Finding]:
+    findings[:] = [f for f in findings if not _suppressed(f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    seen: dict = {}
+    for i, f in enumerate(findings):
+        k = (f.path, f.code, f.source)
+        idx = seen.get(k, 0)
+        seen[k] = idx + 1
+        if idx != f.occurrence:
+            findings[i] = dataclasses.replace(f, occurrence=idx)
+    return findings
+
+
+def _fork_ok() -> bool:
+    import sys
+
+    if "jax" in sys.modules:       # forking a live XLA client can hang
+        return False
+    try:
+        import multiprocessing as mp
+
+        return "fork" in mp.get_all_start_methods()
+    except Exception:
+        return False
+
+
+def _worker_main(conn, entries, select, ignore) -> None:
+    """Parallel-scan worker: phase 1 parse + per-file facts; phase 2
+    (after receiving the merged table) rule runs."""
+    try:
+        rules = _rules_for(select, ignore)
+        parsed = []
+        facts_out: Dict[str, Dict] = {}
+        for relpath, text in entries:
+            ctx, err = _parse_file(text, relpath)
+            units = extract_embedded_units(ctx) if ctx is not None else []
+            facts_out[relpath] = _file_facts(ctx, units)
+            parsed.append((relpath, ctx, units, err))
+        conn.send(facts_out)
+        merged = conn.recv()
+        out: Dict[str, List[dict]] = {}
+        for relpath, ctx, units, err in parsed:
+            fs: List[Finding] = []
+            if err is not None:
+                fs.append(err)
+            else:
+                ctxs = [ctx] + units
+                project = ProjectContext(ctxs)
+                project.facts = merged
+                for c in ctxs:
+                    for rule in rules:
+                        fs.extend(rule.check(c))
+            out[relpath] = [f.to_dict() for f in fs]
+        conn.send(out)
+        conn.close()
+    except BaseException as e:                     # surface, don't hang
+        try:
+            conn.send({"__worker_error__": repr(e)})
+            conn.close()
+        except Exception:
+            pass
+
+
+def _parallel_fresh(misses, select, ignore, cached_facts, jobs):
+    """Run the two-phase fork protocol over the cache-miss files.
+    Returns {relpath: (finding dicts, facts)} or None when the
+    parallel path is unavailable/failed (caller falls back serial)."""
+    import multiprocessing as mp
+
+    ctx_mp = mp.get_context("fork")
+    n = max(1, min(jobs, len(misses)))
+    if n < 2:
+        return None
+    # balance slices by text size (parse cost is roughly linear)
+    order = sorted(misses, key=lambda e: -len(e[1]))
+    slices: List[list] = [[] for _ in range(n)]
+    loads = [0] * n
+    for entry in order:
+        i = loads.index(min(loads))
+        slices[i].append(entry)
+        loads[i] += len(entry[1])
+    conns, procs = [], []
+    try:
+        for sl in slices:
+            parent, child = ctx_mp.Pipe()
+            p = ctx_mp.Process(target=_worker_main,
+                               args=(child, sl, select, ignore))
+            p.start()
+            child.close()
+            conns.append(parent)
+            procs.append(p)
+        fresh_facts: Dict[str, Dict] = {}
+        for conn in conns:
+            got = conn.recv()
+            if "__worker_error__" in got:
+                raise RuntimeError(got["__worker_error__"])
+            fresh_facts.update(got)
+        merged = merge_facts(list(cached_facts.values())
+                             + list(fresh_facts.values()))
+        for conn in conns:
+            conn.send(merged)
+        results: Dict[str, Tuple[List[dict], Dict]] = {}
+        for conn in conns:
+            got = conn.recv()
+            if "__worker_error__" in got:
+                raise RuntimeError(got["__worker_error__"])
+            for relpath, fdicts in got.items():
+                results[relpath] = (fdicts, fresh_facts[relpath])
+        return results
+    except Exception:
+        return None
+    finally:
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+
+def scan(paths: Sequence[str],
+         select: Optional[Iterable[str]] = None,
+         ignore: Optional[Iterable[str]] = None,
+         exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+         cache_path: Optional[str] = None,
+         jobs: int = 1) -> List[Finding]:
+    """The CLI's scan driver: :func:`analyze_paths` semantics plus the
+    findings cache and the parallel cold path (module comment above).
+    ``cache_path=None, jobs=1`` is exactly ``analyze_paths``."""
+    select = list(select) if select else None
+    ignore = list(ignore) if ignore else None
+    entries: List[Tuple[str, str]] = []
+    for f in _iter_py_files(paths, exclude_dirs):
+        entries.append((_relpath(f),
+                        f.read_text(encoding="utf-8", errors="replace")))
+    cache = _load_cache(cache_path)
+    old_files = cache["files"]
+    new_files: Dict[str, dict] = {}
+    sel_key = ",".join(sorted(select or [])) + "|" + \
+        ",".join(sorted(ignore or []))
+
+    # facts pass: cached per file hash, computed (parse) on miss
+    all_facts: Dict[str, Dict] = {}
+    misses: List[Tuple[str, str]] = []
+    keys: Dict[str, str] = {}
+    for relpath, text in entries:
+        key = keys[relpath] = _file_key(relpath, text)
+        hit = old_files.get(key)
+        if hit is not None and "facts" in hit:
+            all_facts[relpath] = hit["facts"]
+            new_files[key] = hit
+        else:
+            misses.append((relpath, text))
+
+    parallel_ok = jobs > 1 and _fork_ok()
+    results: Dict[str, Tuple[List[dict], Dict]] = {}
+    if misses and parallel_ok:
+        got = _parallel_fresh(misses, select, ignore, all_facts, jobs)
+        if got is not None:
+            results = got
+            for relpath, (_fd, facts) in got.items():
+                all_facts[relpath] = facts
+    if len(all_facts) < len(entries):
+        # serial facts for the (remaining) misses: parse now; the ctx
+        # is not kept — _analyze_one reparses below, and this path only
+        # runs when the fork pool is unavailable or declined
+        for relpath, text in misses:
+            if relpath in all_facts:
+                continue
+            ctx, _err = _parse_file(text, relpath)
+            units = extract_embedded_units(ctx) if ctx is not None else []
+            all_facts[relpath] = _file_facts(ctx, units)
+    merged = merge_facts(all_facts.values())
+    fdig = facts_digest(merged)
+    run_key = f"{fdig}|{sel_key}"
+    rules = _rules_for(select, ignore)
+
+    # findings misses BEYOND the text misses: a changed cross-module
+    # fact (or rule selection) invalidates every file's cached findings
+    # even though their facts are still cached — exactly the
+    # re-analyze-everything case, so it gets the SAME fork pool as a
+    # cold scan instead of a one-core crawl through _analyze_one
+    if parallel_ok:
+        remaining = [
+            (relpath, text) for relpath, text in entries
+            if relpath not in results
+            and run_key not in (old_files.get(keys[relpath]) or {}).get(
+                "findings", {})]
+        if remaining:
+            other = {rp: f for rp, f in all_facts.items()
+                     if rp not in {r for r, _ in remaining}}
+            got = _parallel_fresh(remaining, select, ignore, other, jobs)
+            if got is not None:
+                results.update(got)
+
+    findings: List[Finding] = []
+    for relpath, text in entries:
+        key = keys[relpath]
+        entry = new_files.setdefault(key, old_files.get(key) or {})
+        per_run = entry.setdefault("findings", {})
+        fdicts = per_run.get(run_key)
+        if fdicts is None:
+            if relpath in results:
+                fdicts, facts = results[relpath]
+            else:
+                fs, facts = _analyze_one(relpath, text, merged, rules)
+                fdicts = [f.to_dict() for f in fs]
+            entry["facts"] = facts if "facts" not in entry \
+                else entry["facts"]
+            # one findings entry per cache file keeps growth bounded
+            entry["findings"] = {run_key: fdicts}
+        findings.extend(_finding_from_dict(d) for d in fdicts)
+
+    # MERGE this run's entries into the table rather than replacing it:
+    # a subset scan (`python -m bigdl_tpu.analysis bigdl_tpu/serving`)
+    # must not evict the whole-repo cache the next full gate relies on.
+    # Entries untouched for many runs age out so edited files' dead
+    # keys do not accumulate forever.
+    run_no = int(cache.get("run", 0)) + 1
+    cache["run"] = run_no
+    for entry in new_files.values():
+        entry["r"] = run_no
+    merged_files = dict(old_files)
+    merged_files.update(new_files)
+    cache["files"] = {k: v for k, v in merged_files.items()
+                      if run_no - int(v.get("r", 0)) <= _CACHE_KEEP_RUNS}
+    _save_cache(cache_path, cache)
+    return _finalize(findings)

@@ -21,9 +21,13 @@ reason the repo could not just grep for these).
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
-from bigdl_tpu.analysis.core import FileContext, Finding, Rule, register
+from bigdl_tpu.analysis.core import (
+    FileContext, Finding, Rule, _own_scope_nodes, register,
+    register_fact_collector as _register_facts,
+)
 
 # --------------------------------------------------------------------------
 # shared machinery
@@ -129,9 +133,12 @@ class _TracedFn:
 
 def _local_defs(ctx: FileContext) -> Dict[str, List[ast.AST]]:
     """name -> FunctionDefs in the file (all scopes), in source order."""
-    out: Dict[str, List[ast.AST]] = {}
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+    out = ctx.cache.get("local_defs")
+    if out is None:
+        out = ctx.cache["local_defs"] = {}
+        for node in sorted(ctx.by_type(ast.FunctionDef,
+                                       ast.AsyncFunctionDef),
+                           key=lambda n: n.lineno):
             out.setdefault(node.name, []).append(node)
     return out
 
@@ -181,7 +188,11 @@ def _jit_info(ctx: FileContext, value: ast.AST,
 
 def _traced_functions(ctx: FileContext) -> List[_TracedFn]:
     """Every local def/lambda the file hands to jit / shard_map / a lax
-    control-flow combinator, plus defs decorated with them."""
+    control-flow combinator, plus defs decorated with them.  Cached per
+    file — SPMD103 and SPMD105 share one derivation."""
+    cached = ctx.cache.get("traced_functions")
+    if cached is not None:
+        return cached
     defs = _local_defs(ctx)
     traced: List[_TracedFn] = []
     seen: Set[int] = set()
@@ -194,7 +205,8 @@ def _traced_functions(ctx: FileContext) -> List[_TracedFn]:
         seen.add(id(fn))
         traced.append(_TracedFn(fn, via, static_argnums, static_argnames))
 
-    for node in ast.walk(ctx.tree):
+    for node in ctx.by_type(ast.Call, ast.FunctionDef,
+                            ast.AsyncFunctionDef):
         if isinstance(node, ast.Call):
             q = ctx.qualname(node.func)
             if q in _JIT_QUALNAMES or q in _SHARD_MAP_QUALNAMES:
@@ -235,6 +247,7 @@ def _traced_functions(ctx: FileContext) -> List[_TracedFn]:
                     q = ctx.qualname(dec)
                     if q in _JIT_QUALNAMES:
                         add(node, q)
+    ctx.cache["traced_functions"] = traced
     return traced
 
 
@@ -331,7 +344,8 @@ class CompatDriftRule(Rule):
                 f"— this API moved between jax releases",
                 hint=f"use `{shim}` — {self.hint}")
 
-        for node in ast.walk(ctx.tree):
+        for node in ctx.by_type(ast.Import, ast.ImportFrom,
+                                ast.Attribute, ast.Call):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     m = _compat_match(a.name)
@@ -394,9 +408,7 @@ class SpecSpellingRule(Rule):
             "size-1 axes")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.by_type(ast.Call):
             if ctx.qualname(node.func) not in _PSPEC_QUALNAMES:
                 continue
             for arg in node.args:
@@ -416,54 +428,6 @@ class SpecSpellingRule(Rule):
 # --------------------------------------------------------------------------
 
 _BLOCKSPEC_QUALNAMES = {"jax.experimental.pallas.BlockSpec"}
-
-
-def _own_scope_nodes(fn: ast.AST) -> Iterator[ast.AST]:
-    """Walk a function body WITHOUT descending into nested
-    def/lambda subtrees — their assignment targets are locals of a
-    DIFFERENT scope and must not count as this function's bindings."""
-    stack = list(ast.iter_child_nodes(fn))
-    while stack:
-        sub = stack.pop()
-        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
-                            ast.Lambda)):
-            continue
-        yield sub
-        stack.extend(ast.iter_child_nodes(sub))
-
-
-def _scope_local_names(ctx: FileContext, node: ast.AST) -> Set[str]:
-    """Names bound in the enclosing function/lambda scope chain of
-    ``node`` (params + assignment/loop/with targets) — the values a
-    closure at ``node`` could capture per call, as opposed to
-    module-level constants."""
-    names: Set[str] = set()
-    cur = ctx.enclosing_function(node)
-    while cur is not None:
-        a = cur.args
-        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
-            names.add(p.arg)
-        if a.vararg:
-            names.add(a.vararg.arg)
-        if a.kwarg:
-            names.add(a.kwarg.arg)
-        if not isinstance(cur, ast.Lambda):
-            for sub in _own_scope_nodes(cur):
-                targets: List[ast.AST] = []
-                if isinstance(sub, ast.Assign):
-                    targets = list(sub.targets)
-                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign,
-                                      ast.For)):
-                    targets = [sub.target]
-                elif isinstance(sub, ast.withitem) and \
-                        sub.optional_vars is not None:
-                    targets = [sub.optional_vars]
-                for t in targets:
-                    for n in ast.walk(t):
-                        if isinstance(n, ast.Name):
-                            names.add(n.id)
-        cur = ctx.enclosing_function(cur)
-    return names
 
 
 @register
@@ -525,9 +489,8 @@ class RecompileHazardRule(Rule):
         # indices; per-call data belongs in operands. (Module-level
         # constants and the lambda's own params are fine — only names
         # bound in an enclosing function scope fire.)
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call) or \
-                    ctx.qualname(node.func) not in _BLOCKSPEC_QUALNAMES:
+        for node in ctx.by_type(ast.Call):
+            if ctx.qualname(node.func) not in _BLOCKSPEC_QUALNAMES:
                 continue
             im = node.args[1] if len(node.args) >= 2 else None
             for kw in node.keywords:
@@ -542,7 +505,7 @@ class RecompileHazardRule(Rule):
                 own.add(a.vararg.arg)
             if a.kwarg:
                 own.add(a.kwarg.arg)
-            outer = _scope_local_names(ctx, im)
+            outer = ctx.scope_local_names(im)
             for n in ast.walk(im.body):
                 if isinstance(n, ast.Name) and n.id not in own and \
                         n.id in outer:
@@ -558,7 +521,7 @@ class RecompileHazardRule(Rule):
         # (b) structure-varying container literally built at the call
         # site of a known-jitted callable
         jitted_names: Set[str] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.by_type(ast.Assign, ast.Return):
             if isinstance(node, ast.Assign) and _jit_info(ctx, node.value):
                 for t in node.targets:
                     d = ctx.dotted(t)
@@ -573,9 +536,7 @@ class RecompileHazardRule(Rule):
                     jitted_names.add(f"self.{fn.name}")
         if not jitted_names:
             return
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.by_type(ast.Call):
             if ctx.dotted(node.func) not in jitted_names:
                 continue
             for a in list(node.args) + [kw.value for kw in node.keywords]:
@@ -607,8 +568,43 @@ class DonationReuseRule(Rule):
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         # donated callable name -> donated positional indices
-        donated: Dict[str, Tuple[int, ...]] = {}
-        for node in ast.walk(ctx.tree):
+        donated = _donating_callables(ctx)
+        if not donated:
+            return
+
+        for node in ctx.by_type(ast.Call):
+            callee = ctx.dotted(node.func)
+            if callee not in donated:
+                continue
+            scope = ctx.enclosing_function(node) or ctx.tree
+            for i in donated[callee]:
+                if i >= len(node.args):
+                    continue
+                buf = ctx.dotted(node.args[i])
+                if buf is None or buf == "self":
+                    continue
+                reuse = _first_reuse(ctx, scope, buf, node)
+                if reuse is not None:
+                    yield ctx.finding(
+                        reuse, self.code,
+                        f"`{buf}` was donated to `{callee}` on line "
+                        f"{node.lineno} (donate_argnums includes position "
+                        f"{i}) and is read again here",
+                        hint=self.hint)
+
+
+def _donating_callables(ctx: FileContext) -> Dict[str, Tuple[int, ...]]:
+    """Dotted callable name -> donated positional indices, for every
+    jitted-with-donation binding visible in the file (the SPMD104
+    ground truth, shared with SRV204's call-graph lifting; cached per
+    file)."""
+    cached = ctx.cache.get("donating_callables")
+    if cached is not None:
+        return cached
+    donated: Dict[str, Tuple[int, ...]] = {}
+    try:
+        for node in ctx.by_type(ast.Assign, ast.Return, ast.FunctionDef,
+                                ast.AsyncFunctionDef):
             info = None
             if isinstance(node, ast.Assign):
                 info = _jit_info(ctx, node.value)
@@ -637,75 +633,54 @@ class DonationReuseRule(Rule):
                 for t in targets:
                     if t:
                         donated[t] = pos
+    finally:
+        ctx.cache["donating_callables"] = donated
+    return donated
 
-        if not donated:
-            return
 
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            callee = ctx.dotted(node.func)
-            if callee not in donated:
-                continue
-            scope = ctx.enclosing_function(node) or ctx.tree
-            for i in donated[callee]:
-                if i >= len(node.args):
-                    continue
-                buf = ctx.dotted(node.args[i])
-                if buf is None or buf == "self":
-                    continue
-                reuse = self._first_reuse(ctx, scope, buf, node)
-                if reuse is not None:
-                    yield ctx.finding(
-                        reuse, self.code,
-                        f"`{buf}` was donated to `{callee}` on line "
-                        f"{node.lineno} (donate_argnums includes position "
-                        f"{i}) and is read again here",
-                        hint=self.hint)
-
-    @staticmethod
-    def _first_reuse(ctx: FileContext, scope: ast.AST, buf: str,
-                     call: ast.Call) -> Optional[ast.AST]:
-        """First Load of ``buf`` after the donating ``call`` in ``scope``
-        (same function only — closures and other functions are out of
-        this linear approximation) with no intervening rebinding."""
-        call_line = getattr(call, "end_lineno", call.lineno)
-        scope_fn = scope if isinstance(
-            scope, (ast.FunctionDef, ast.AsyncFunctionDef,
-                    ast.Lambda)) else None
-        loads: List[ast.AST] = []
-        stores: List[int] = []
-        for n in ast.walk(scope):
-            if isinstance(n, ast.AugAssign):
-                # `cache += 1` reads the old buffer before rebinding —
-                # the target carries Store ctx only, so surface the
-                # implicit read here
-                if ctx.dotted(n.target) == buf and \
-                        ctx.enclosing_function(n) is scope_fn and \
-                        n.lineno > call_line:
-                    loads.append(n.target)
-                continue
-            d = ctx.dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) \
-                else None
-            if d != buf:
-                continue
-            if ctx.enclosing_function(n) is not scope_fn:
-                continue
-            ic = getattr(n, "ctx", None)
-            if isinstance(ic, ast.Load):
-                # strictly after the donating call's last line — the
-                # call's own argument loads never count
-                if n.lineno > call_line:
-                    loads.append(n)
-            elif isinstance(ic, (ast.Store, ast.Del)):
-                stores.append(n.lineno)
-        for n in sorted(loads, key=lambda x: (x.lineno, x.col_offset)):
-            # a store masks only loads on LATER lines: in
-            # `cache = cache + 1` the RHS reads the (dead) buffer before
-            # the same-statement rebind takes effect
-            if not any(call.lineno <= s < n.lineno for s in stores):
-                return n
-        return None
+def _first_reuse(ctx: FileContext, scope: ast.AST, buf: str,
+                 call: ast.Call) -> Optional[ast.AST]:
+    """First Load of ``buf`` after the donating ``call`` in ``scope``
+    (same function only — closures and other functions are out of
+    this linear approximation) with no intervening rebinding.  Shared
+    by SPMD104 and its call-graph-lifted twin SRV204."""
+    call_line = getattr(call, "end_lineno", call.lineno)
+    scope_fn = scope if isinstance(
+        scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                ast.Lambda)) else None
+    loads: List[ast.AST] = []
+    stores: List[int] = []
+    for n in ast.walk(scope):
+        if isinstance(n, ast.AugAssign):
+            # `cache += 1` reads the old buffer before rebinding —
+            # the target carries Store ctx only, so surface the
+            # implicit read here
+            if ctx.dotted(n.target) == buf and \
+                    ctx.enclosing_function(n) is scope_fn and \
+                    n.lineno > call_line:
+                loads.append(n.target)
+            continue
+        d = ctx.dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) \
+            else None
+        if d != buf:
+            continue
+        if ctx.enclosing_function(n) is not scope_fn:
+            continue
+        ic = getattr(n, "ctx", None)
+        if isinstance(ic, ast.Load):
+            # strictly after the donating call's last line — the
+            # call's own argument loads never count
+            if n.lineno > call_line:
+                loads.append(n)
+        elif isinstance(ic, (ast.Store, ast.Del)):
+            stores.append(n.lineno)
+    for n in sorted(loads, key=lambda x: (x.lineno, x.col_offset)):
+        # a store masks only loads on LATER lines: in
+        # `cache = cache + 1` the RHS reads the (dead) buffer before
+        # the same-statement rebind takes effect
+        if not any(call.lineno <= s < n.lineno for s in stores):
+            return n
+    return None
 
 
 # --------------------------------------------------------------------------
@@ -793,26 +768,7 @@ class MeshAxisRule(Rule):
             "Mesh construction")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
-        # mesh variable name -> [(enclosing scope, lineno, axes-or-None)];
-        # axes is None for assignments whose provenance the analyzer
-        # cannot see (helper calls, parameters...) — those SHADOW
-        # literal constructions rather than being skipped over
-        mesh_vars: Dict[str, List[Tuple[Optional[ast.AST], int,
-                                        Optional[Set[str]]]]] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Assign):
-                axes = _mesh_axes_from_call(ctx, node.value) \
-                    if isinstance(node.value, ast.Call) else None
-                scope = ctx.enclosing_function(node)
-                for t in node.targets:
-                    d = ctx.dotted(t)
-                    if d:
-                        mesh_vars.setdefault(d, []).append(
-                            (scope, node.lineno, axes))
-
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.by_type(ast.Call):
             q = ctx.qualname(node.func)
             if q not in _SHARD_MAP_QUALNAMES:
                 continue
@@ -825,8 +781,15 @@ class MeshAxisRule(Rule):
                 axes = _mesh_axes_from_call(ctx, mesh_arg)
             else:
                 d = ctx.dotted(mesh_arg)
-                if d in mesh_vars:
-                    axes = self._resolve_var(ctx, mesh_vars[d], node)
+                if d:
+                    # scope-chain provenance (core.resolve_binding):
+                    # the nearest preceding assignment wins, and a
+                    # binding the analyzer cannot see into (a helper
+                    # call, a parameter) SHADOWS literal constructions
+                    # rather than being skipped over
+                    val = ctx.resolve_binding(d, node)
+                    if isinstance(val, ast.Call):
+                        axes = _mesh_axes_from_call(ctx, val)
             if axes is None:
                 continue           # provenance unknown — stay silent
             for kw_name in ("in_specs", "out_specs"):
@@ -836,26 +799,6 @@ class MeshAxisRule(Rule):
                 for f in self._check_specs(ctx, specs, axes, kw_name,
                                            mesh_label):
                     yield f
-
-    @staticmethod
-    def _resolve_var(ctx: FileContext,
-                     cands: List[Tuple[Optional[ast.AST], int,
-                                       Optional[Set[str]]]],
-                     call: ast.Call) -> Optional[Set[str]]:
-        """Axes of the nearest preceding assignment to the mesh variable,
-        searching the call's lexical scope chain innermost-out.  Returns
-        None (silence) when the binding that actually wins is one the
-        analyzer cannot see into."""
-        scope: Optional[ast.AST] = ctx.enclosing_function(call)
-        while True:
-            in_scope = [(ln, axes) for (s, ln, axes) in cands
-                        if s is scope and ln <= call.lineno]
-            if in_scope:
-                # nearest preceding; its axes may be None -> silence
-                return max(in_scope, key=lambda t: t[0])[1]
-            if scope is None:
-                return None
-            scope = ctx.enclosing_function(scope)
 
     def _check_specs(self, ctx: FileContext, specs: ast.AST,
                      axes: Set[str], kw_name: str,
@@ -874,3 +817,635 @@ class MeshAxisRule(Rule):
                         f"`{mesh_label}` defines axes "
                         f"{sorted(axes)}",
                         hint=self.hint)
+
+
+# ==========================================================================
+# The SRV2xx serving-contract family — WHOLE-PROGRAM rules.
+#
+# Everything below consumes the ProjectContext fact table
+# (core.collect_file_facts / merge_facts): per-file fact collectors
+# extract the cross-module ground truth (which attributes hold compiled
+# steps, the pooled-carry key schema, the KVPool class hierarchy, the
+# finish-reason vocabulary, donation signatures of helper functions),
+# the engine merges them across every scanned file, and the rules below
+# check each file against the MERGED table.  Single-file scans (the
+# fixtures) degrade to per-file facts plus the documented fallbacks.
+# ==========================================================================
+
+#: the compiled-step caches in bigdl_tpu.models.transformer; value =
+#: index of the step fn in the returned tuple (None = the call's whole
+#: result IS the step fn)
+_STEP_GETTERS = {
+    "bigdl_tpu.models.transformer.get_decode_step": 0,
+    "bigdl_tpu.models.transformer.get_batch_decode_step": 0,
+    "bigdl_tpu.models.transformer.get_batch_verify_step": 0,
+    "bigdl_tpu.models.transformer.get_prefill_step": None,
+    "bigdl_tpu.models.transformer.get_batch_prefill_step": None,
+}
+
+#: fallback pooled-carry key schema, used only when the scan does not
+#: include models/transformer.py (single-file fixture runs): must match
+#: what _serving_init_carry declares
+_DEFAULT_CARRY_PATTERNS = (
+    "pos", "rng", "tok_counts", "prompt_mask",
+    r"k\d+", r"v\d+", r"k\d+_scale", r"v\d+_scale",
+)
+
+#: fallback finish-reason vocabulary (single-file fixture runs): must
+#: match ServingMetrics.FINISH_REASONS
+_DEFAULT_FINISH_REASONS = frozenset(
+    {"eos", "stop", "length", "shed", "deadline", "infeasible", "error",
+     "cancelled"})
+
+#: KVPool-lineage roots: any class whose base chain reaches a class
+#: with one of these qualified-name tails owns pooled device state with
+#: host mirrors
+_KVPOOL_TAILS = (".KVPool",)
+
+
+def _last_seg(dotted: Optional[str]) -> Optional[str]:
+    return None if dotted is None else dotted.rsplit(".", 1)[-1]
+
+
+def _in_serving_tree(ctx: FileContext) -> bool:
+    return "bigdl_tpu/serving/" in ctx.relpath.replace("\\", "/")
+
+
+def _serving_scope(ctx: FileContext) -> bool:
+    """True for files the serving-contract rules police: the serving
+    plane itself, plus any file that imports from it (tests, fixtures,
+    a future second engine) — cached per file."""
+    hit = ctx.cache.get("serving_scope")
+    if hit is None:
+        hit = _in_serving_tree(ctx) or any(
+            m.startswith("bigdl_tpu.serving")
+            or m.startswith("bigdl_tpu.models.transformer")
+            for m in _imported_modules(ctx))
+        ctx.cache["serving_scope"] = hit
+    return hit
+
+
+def _imported_modules(ctx: FileContext) -> List[str]:
+    mods = ctx.cache.get("imported_modules")
+    if mods is None:
+        mods = []
+        for node in ctx.by_type(ast.Import, ast.ImportFrom):
+            if isinstance(node, ast.Import):
+                mods.extend(a.name for a in node.names)
+            elif node.module and node.level == 0:
+                mods.append(node.module)
+        ctx.cache["imported_modules"] = mods
+    return mods
+
+
+def _facts(ctx: FileContext) -> Dict:
+    if ctx.project is not None:
+        return ctx.project.facts
+    # hand-built context (no engine): per-file facts only
+    from bigdl_tpu.analysis.core import collect_file_facts
+
+    return collect_file_facts(ctx)
+
+
+# -- fact collectors --------------------------------------------------------
+
+def _defines_dispatch(ctx: FileContext) -> bool:
+    """True when the file defines a ``_dispatch`` routing of its own —
+    the minimal-engine shape SRV201 polices outside bigdl_tpu/serving/."""
+    hit = ctx.cache.get("defines_dispatch")
+    if hit is None:
+        hit = any(fn.name == "_dispatch"
+                  for fn in ctx.by_type(ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+        ctx.cache["defines_dispatch"] = hit
+    return hit
+
+
+@_register_facts
+def _step_binding_facts(ctx: FileContext) -> Dict:
+    """Which attribute/variable names hold compiled steps from the
+    ``get_*_step`` caches — the SRV201 ground truth.  Collected from
+    files that live in dispatch scope (the serving tree, or a file
+    with a ``_dispatch`` of its own) and merged, so
+    ``eng._batch_prefill_fn`` used in admission.py resolves through the
+    binding in engine.py.  Bindings elsewhere (``generate()``/
+    ``beam_generate`` in models/, tests, benchmarks) are deliberately
+    NOT tracked — their generic names (``step``) would indict every
+    method called ``step`` in the engine."""
+    if not (_in_serving_tree(ctx) or _defines_dispatch(ctx)):
+        return {}
+    attrs: Dict[str, List[str]] = {}
+    for node in ctx.by_type(ast.Assign):
+        if not isinstance(node.value, ast.Call):
+            continue
+        q = ctx.qualname(node.value.func)
+        if q not in _STEP_GETTERS:
+            continue
+        idx = _STEP_GETTERS[q]
+        for t in node.targets:
+            target = t
+            if idx is not None:
+                if not (isinstance(t, (ast.Tuple, ast.List))
+                        and len(t.elts) > idx):
+                    continue
+                target = t.elts[idx]
+            seg = _last_seg(ctx.dotted(target))
+            if seg:
+                attrs.setdefault(seg, []).append(q)
+    return {"step_attrs": {k: sorted(set(v))
+                           for k, v in attrs.items()}} if attrs else {}
+
+
+@_register_facts
+def _carry_schema_facts(ctx: FileContext) -> Dict:
+    """The pooled-carry key schema, extracted from the ONE layout
+    declaration (``_serving_init_carry`` in models/transformer.py):
+    constant keys verbatim, f-string keys with interpolations widened
+    to ``\\d+`` (the layer index).  SRV202 checks every carry subscript
+    against these patterns."""
+    for fn in ctx.by_type(ast.FunctionDef):
+        if fn.name != "_serving_init_carry":
+            continue
+        pats: Set[str] = set()
+        for node in ast.walk(fn):
+            key = None
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.targets[0], ast.Subscript):
+                key = node.targets[0].slice
+            elif isinstance(node, ast.Dict):
+                for k in node.keys:
+                    p = _key_pattern(k)
+                    if p:
+                        pats.add(p)
+                continue
+            p = _key_pattern(key)
+            if p:
+                pats.add(p)
+        if pats:
+            return {"carry_patterns": sorted(pats)}
+    return {}
+
+
+def _key_pattern(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return re.escape(node.value)
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(re.escape(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                parts.append(r"\d+")
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+@_register_facts
+def _class_edge_facts(ctx: FileContext) -> Dict:
+    """Class-inheritance edges (qualified through each file's imports)
+    — SRV203 computes the KVPool lineage from the merged edge set, so
+    a subclass two modules away is still covered."""
+    edges: Dict[str, List[str]] = {}
+    for node in ctx.by_type(ast.ClassDef):
+        qual = f"{ctx.module}.{node.name}" if ctx.module else node.name
+        bases = []
+        for b in node.bases:
+            bq = ctx.qualname(b)
+            if bq is None:
+                d = ctx.dotted(b)
+                if d and "." not in d:
+                    bq = f"{ctx.module}.{d}" if ctx.module else d
+            if bq:
+                bases.append(bq)
+        edges[qual] = sorted(set(bases))
+    return {"class_edges": edges} if edges else {}
+
+
+@_register_facts
+def _finish_reason_facts(ctx: FileContext) -> Dict:
+    """The declared finish-reason vocabulary
+    (``ServingMetrics.FINISH_REASONS``) — SRV205's schema."""
+    from bigdl_tpu.analysis.core import UNRESOLVED as _UNRES
+    from bigdl_tpu.analysis.core import literal_value
+
+    for node in ctx.by_type(ast.ClassDef):
+        if node.name != "ServingMetrics":
+            continue
+        for sub in node.body:
+            if isinstance(sub, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "FINISH_REASONS"
+                    for t in sub.targets):
+                val = literal_value(sub.value)
+                if val is not _UNRES:
+                    return {"finish_reasons": sorted(val)}
+    return {}
+
+
+@_register_facts
+def _donated_wrapper_facts(ctx: FileContext) -> Dict:
+    """Module-level functions that DONATE one of their parameters (pass
+    it at a donated position of a jitted-with-donation callable) —
+    SRV204's cross-module half.  Keys are qualified function names;
+    values are the donated caller-argument positions."""
+    out: Dict[str, List[int]] = {}
+    for qual, positions in _donating_wrappers(ctx).items():
+        if "." not in qual:           # module-level plain function
+            full = f"{ctx.module}.{qual}" if ctx.module else qual
+            out[full] = sorted(positions)
+    return {"donated_wrappers": out} if out else {}
+
+
+def _donating_wrappers(ctx: FileContext) -> Dict[str, List[int]]:
+    """name -> donated caller-arg positions, for every function in the
+    file whose PARAMETER flows into a donated position of a local
+    donating callable.  Methods are keyed ``self.<name>`` (positions
+    already exclude ``self``); plain functions by bare name.  One level
+    of lifting — a wrapper of a wrapper is out of scope (documented)."""
+    cached = ctx.cache.get("donating_wrappers")
+    if cached is not None:
+        return cached
+    donated = _donating_callables(ctx)
+    wrappers: Dict[str, List[int]] = {}
+    if donated:
+        for fn in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef):
+            params = _param_names(fn)
+            is_method = bool(params) and params[0] == "self"
+            hits: Set[int] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = ctx.dotted(node.func)
+                if callee not in donated:
+                    continue
+                for i in donated[callee]:
+                    if i < len(node.args) and \
+                            isinstance(node.args[i], ast.Name) and \
+                            node.args[i].id in params:
+                        p = params.index(node.args[i].id)
+                        if is_method:
+                            if p > 0:
+                                hits.add(p - 1)
+                        else:
+                            hits.add(p)
+            if hits:
+                key = f"self.{fn.name}" if is_method else fn.name
+                wrappers[key] = sorted(hits)
+    ctx.cache["donating_wrappers"] = wrappers
+    return wrappers
+
+
+# -- SRV201 — dispatch bypass ----------------------------------------------
+
+@register
+class DispatchBypassRule(Rule):
+    code = "SRV201"
+    name = "dispatch-bypass"
+    summary = ("compiled serving step invoked directly inside the "
+               "serving plane instead of through engine._dispatch")
+    hint = ("every serving-path device dispatch must route through "
+            "`engine._dispatch(site, fn, *args)` — a direct call "
+            "silently bypasses fault injection, the step watchdog, and "
+            "retry accounting (serving/faults.py). Spell it "
+            "`self._dispatch(\"decode\", self._step_fn, ...)`; tests "
+            "and benchmarks outside serving/ may call steps directly")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # scope: the serving plane itself, or a file that defines a
+        # `_dispatch` routing of its own (the fixture/minimal-engine
+        # shape) — test/bench code without a dispatcher is exempt
+        if not (_in_serving_tree(ctx) or _defines_dispatch(ctx)):
+            return
+        step_attrs = _facts(ctx).get("step_attrs", {})
+        if not step_attrs:
+            return
+        # local aliases: `fn = self.engine._batch_prefill_fn` makes a
+        # bare-name call in the SAME function a bypass too
+        aliases: Dict[str, list] = {}
+        for node in ctx.by_type(ast.Assign):
+            seg = _last_seg(ctx.dotted(node.value)) \
+                if isinstance(node.value, (ast.Name, ast.Attribute)) \
+                else None
+            if seg in step_attrs:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.setdefault(t.id, []).append(
+                            (ctx.enclosing_function(node), seg))
+        for node in ctx.by_type(ast.Call):
+            seg = None
+            if isinstance(node.func, ast.Attribute):
+                seg = _last_seg(ctx.dotted(node.func))
+                if seg not in step_attrs:
+                    continue
+            elif isinstance(node.func, ast.Name):
+                nm = node.func.id
+                if nm in step_attrs:
+                    seg = nm
+                else:
+                    scope = ctx.enclosing_function(node)
+                    for ascope, aseg in aliases.get(nm, ()):
+                        if ascope is scope:
+                            seg = aseg
+                            break
+                if seg is None:
+                    continue
+            else:
+                continue
+            getters = step_attrs.get(seg, ["get_*_step"])
+            yield ctx.finding(
+                node, self.code,
+                f"compiled step `{_last_seg(ctx.dotted(node.func)) or seg}`"
+                f" (bound from {getters[0].rsplit('.', 1)[-1]}) invoked "
+                f"directly — this dispatch bypasses engine._dispatch",
+                hint=self.hint)
+
+
+# -- SRV202 — carry-key schema ---------------------------------------------
+
+@register
+class CarryKeyRule(Rule):
+    code = "SRV202"
+    name = "carry-key-schema"
+    summary = ("string key on a pooled serving carry that the declared "
+               "layout (_serving_init_carry) does not define")
+    hint = ("pooled-carry keys are a CLOSED schema declared once in "
+            "models/transformer.py:_serving_init_carry (pos, rng, "
+            "tok_counts, prompt_mask, k<i>/v<i> and their _scale rows) "
+            "— a typo'd key fails only at runtime, or worse, silently "
+            "creates a NEW key the step never reads; fix the spelling "
+            "or extend the layout declaration first")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_scope(ctx):
+            return
+        pats = _facts(ctx).get("carry_patterns") or \
+            list(_DEFAULT_CARRY_PATTERNS)
+        rx = re.compile("|".join(f"(?:{p})" for p in pats))
+        for node in ctx.by_type(ast.Subscript, ast.Call, ast.Compare):
+            recv, key = self._carry_key(ctx, node)
+            if recv is None or key is None:
+                continue
+            if rx.fullmatch(key):
+                continue
+            yield ctx.finding(
+                node, self.code,
+                f"key {key!r} on carry `{recv}` is not in the pooled-"
+                f"carry layout declared by _serving_init_carry",
+                hint=self.hint)
+
+    @staticmethod
+    def _carry_key(ctx: FileContext, node: ast.AST):
+        """(receiver, key) when ``node`` reads/writes a string key on a
+        carry-named object: subscripts, ``.get("k")`` calls, and
+        ``"k" in carry`` membership tests."""
+        if isinstance(node, ast.Subscript):
+            recv, key = node.value, node.slice
+        elif isinstance(node, ast.Call):
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get" and node.args):
+                return None, None
+            recv, key = node.func.value, node.args[0]
+        else:                                   # Compare: "k" in carry
+            if not (len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn))):
+                return None, None
+            recv, key = node.comparators[0], node.left
+        d = ctx.dotted(recv)
+        seg = _last_seg(d)
+        if seg is None or "carry" not in seg:
+            return None, None
+        if not (isinstance(key, ast.Constant)
+                and isinstance(key.value, str)):
+            return None, None
+        return d, key.value
+
+
+# -- SRV203 — host-mirror lockstep -----------------------------------------
+
+@register
+class MirrorLockstepRule(Rule):
+    code = "SRV203"
+    name = "mirror-lockstep"
+    summary = ("KVPool-lineage method moves the device `pos` without "
+               "updating the chunk_done/chunk_target host mirrors")
+    hint = ("KVPool.chunk_done/chunk_target are HOST MIRRORS of the "
+            "device `pos` (the chunked-admission pump plans from them "
+            "without a device readback — serving/chunked.py); any "
+            "method that moves a slot's target-carry pos must keep "
+            "them in lockstep (write the mirror, or delegate to "
+            "write_prefill/set_pos/begin_chunks/super()). The DRAFT "
+            "carry has no mirrors and is exempt")
+
+    #: calls that move pos as a side effect (the donated reset/scatter)
+    _POS_MOVERS = {"_free_reset", "_scatter"}
+    #: delegating calls that already maintain the mirrors
+    _MIRROR_KEEPERS = {"write_prefill", "set_pos", "begin_chunks", "free"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        lineage = self._lineage(ctx)
+        if not lineage:
+            return
+        for cls in ctx.by_type(ast.ClassDef):
+            qual = f"{ctx.module}.{cls.name}" if ctx.module else cls.name
+            if qual not in lineage:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                move = self._first_pos_move(ctx, fn)
+                if move is None:
+                    continue
+                if self._touches_mirror(ctx, fn):
+                    continue
+                yield ctx.finding(
+                    move, self.code,
+                    f"{cls.name}.{fn.name} moves the device `pos` but "
+                    f"never updates chunk_done/chunk_target — the host "
+                    f"mirrors drift from the device state",
+                    hint=self.hint)
+
+    @staticmethod
+    def _lineage(ctx: FileContext) -> Set[str]:
+        """Classes in this PROJECT whose base chain reaches KVPool,
+        computed from the merged class-edge facts (cross-module)."""
+        edges = _facts(ctx).get("class_edges", {})
+        out: Set[str] = set()
+        for qual in edges:
+            chain, todo = set(), [qual]
+            while todo:
+                q = todo.pop()
+                if q in chain:
+                    continue
+                chain.add(q)
+                todo.extend(edges.get(q, ()))
+            if any(q.endswith(t) or q == t.lstrip(".")
+                   for q in chain for t in _KVPOOL_TAILS):
+                out.add(qual)
+        return out
+
+    def _first_pos_move(self, ctx: FileContext,
+                        fn: ast.AST) -> Optional[ast.AST]:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            ctx.dotted(t.value) == "self.carry" and \
+                            isinstance(t.slice, ast.Constant) and \
+                            t.slice.value == "pos":
+                        return t
+            elif isinstance(node, ast.Call):
+                seg = _last_seg(ctx.dotted(node.func))
+                if seg in self._POS_MOVERS and \
+                        ctx.dotted(node.func) == f"self.{seg}":
+                    return node
+        return None
+
+    def _touches_mirror(self, ctx: FileContext, fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in ("chunk_done", "chunk_target") and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return True
+            if isinstance(node, ast.Call):
+                d = ctx.dotted(node.func)
+                seg = _last_seg(d)
+                if seg in self._MIRROR_KEEPERS and d != f"self.{fn.name}" \
+                        and (d or "").startswith("self."):
+                    return True
+                # super().free(...) etc. delegates the whole contract
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Call) and \
+                        isinstance(node.func.value.func, ast.Name) and \
+                        node.func.value.func.id == "super":
+                    return True
+        return False
+
+
+# -- SRV204 — interprocedural donation reuse -------------------------------
+
+@register
+class CrossDonationRule(Rule):
+    code = "SRV204"
+    name = "cross-donation-reuse"
+    summary = ("buffer donated through a helper function (the helper "
+               "passes its parameter to a donating jit) and read again "
+               "by the caller")
+    hint = ("SPMD104 lifted through the call graph: the helper's "
+            "parameter flows into a `donate_argnums` position, so the "
+            "CALLER's buffer is invalid after the helper returns — "
+            "rebind the name to the helper's result (`carry = "
+            "ingest(carry, u)`), exactly like the direct-donation "
+            "idiom. One level of lifting; wrappers of wrappers are out "
+            "of scope (docs/analysis.md)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        local = _donating_wrappers(ctx)
+        xmod = _facts(ctx).get("donated_wrappers", {})
+        if not local and not xmod:
+            return
+        for node in ctx.by_type(ast.Call):
+            callee = ctx.dotted(node.func)
+            if callee is None:
+                continue
+            positions = local.get(callee)
+            label = callee
+            if positions is None:
+                q = ctx.qualname(node.func)
+                if q:
+                    hit = xmod.get(q)
+                    if hit is None:
+                        # module keys are path-derived; the import may
+                        # spell a shorter (or sys.path-rooted) prefix —
+                        # match on the dotted suffix
+                        for k, v in xmod.items():
+                            if k.endswith("." + q):
+                                hit, q = v, k
+                                break
+                    if hit is not None:
+                        positions, label = hit, q
+            if not positions:
+                continue
+            # the wrapper's own body is exempt (that call is the
+            # definition site, already modeled)
+            scope = ctx.enclosing_function(node) or ctx.tree
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = _param_names(scope)
+                key = f"self.{scope.name}" if params[:1] == ["self"] \
+                    else scope.name
+                if key == callee:
+                    continue
+            for i in positions:
+                if i >= len(node.args):
+                    continue
+                buf = ctx.dotted(node.args[i])
+                if buf is None or buf == "self":
+                    continue
+                reuse = _first_reuse(ctx, scope, buf, node)
+                if reuse is not None:
+                    yield ctx.finding(
+                        reuse, self.code,
+                        f"`{buf}` was donated THROUGH `{label}` on line "
+                        f"{node.lineno} (its parameter {i} flows into a "
+                        f"donate_argnums position) and is read again "
+                        f"here",
+                        hint=self.hint)
+
+
+# -- SRV205 — finish-reason accounting -------------------------------------
+
+@register
+class FinishReasonRule(Rule):
+    code = "SRV205"
+    name = "finish-reason-accounting"
+    summary = ("finish_reason string outside the declared "
+               "ServingMetrics.FINISH_REASONS vocabulary")
+    hint = ("finish reasons are a CLOSED vocabulary declared by "
+            "ServingMetrics.FINISH_REASONS, and every reason has a "
+            "per-reason counter path (serving/finish_<reason> via "
+            "on_finish_reason) — a novel string silently escapes "
+            "goodput/shed accounting and dashboards. Fix the typo, or "
+            "add the reason to FINISH_REASONS + its counter first")
+
+    #: call sites that consume a reason string: final segment -> the
+    #: positional index of the reason argument
+    _REASON_CALLS = {"_shed": 1, "_finish_row": 1, "on_finish_reason": 0}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_scope(ctx):
+            return
+        vocab = _facts(ctx).get("finish_reasons")
+        vocab = set(vocab) if vocab else set(_DEFAULT_FINISH_REASONS)
+        for node in ctx.by_type(ast.Assign, ast.Call):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr == "finish_reason" and \
+                            isinstance(node.value, ast.Constant) and \
+                            isinstance(node.value.value, str) and \
+                            node.value.value not in vocab:
+                        yield ctx.finding(
+                            node, self.code,
+                            f"finish_reason {node.value.value!r} is not "
+                            f"in ServingMetrics.FINISH_REASONS "
+                            f"{sorted(vocab)}",
+                            hint=self.hint)
+                        break
+                continue
+            seg = _last_seg(ctx.dotted(node.func))
+            idx = self._REASON_CALLS.get(seg or "")
+            if idx is None:
+                continue
+            arg = node.args[idx] if idx < len(node.args) else \
+                _kwarg(node, "reason")
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and arg.value not in vocab:
+                yield ctx.finding(
+                    node, self.code,
+                    f"reason {arg.value!r} passed to {seg}() is not in "
+                    f"ServingMetrics.FINISH_REASONS {sorted(vocab)}",
+                    hint=self.hint)

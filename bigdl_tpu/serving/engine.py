@@ -571,6 +571,10 @@ class ServingEngine:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
             req.resume_carry = None
         self.metrics.on_cancel()
+        # cancellation is a disposition too: without this bucket the
+        # finish_<reason> counters would not sum to every request's
+        # fate (the accounting contract docs/serving.md states)
+        self.metrics.on_finish_reason("cancelled")
         self._finished[req_id] = req
         self._evict_finished()
         return True
@@ -715,6 +719,7 @@ class ServingEngine:
         req.finish_time = self._clock()
         self._finished[req.req_id] = req
         self._evict_finished()
+        self.metrics.on_finish_reason(reason)
         self.metrics.on_shed(deadline=(reason in ("deadline",
                                                   "infeasible")))
 
@@ -926,6 +931,7 @@ class ServingEngine:
         self._configured.discard(freed)
         self._finished[req.req_id] = req
         self._evict_finished()
+        self.metrics.on_finish_reason(reason)
         if reason == "error":
             met = None          # neither goodput nor a deadline miss
         else:
@@ -997,12 +1003,23 @@ class ServingEngine:
         tokens = np.zeros((N,), np.int32)
         active = np.zeros((N,), bool)
         n_sampled = 0
-        for slot, req in running.items():
+        for slot, req in list(running.items()):
             if slot not in self._configured:
-                self._configure_slot(slot, req)
+                try:
+                    self._configure_slot(slot, req)
+                except FaultError:
+                    # slot configuration dispatches device work (the
+                    # speculative draft prefill) — a fault there evicts
+                    # exactly this row for loss-free replay; the rest
+                    # of the batch decodes without it
+                    self._recover_admission([(slot, req)])
+                    continue
             tokens[slot] = req.next_token
             active[slot] = True
             n_sampled += not req.sampling.is_greedy
+        if not active.any():
+            self._last_decode_end = None
+            return {}
         t0 = self._clock()
         if self._knobs_device is None:
             self._knobs_device = {k: self._place_rows(jnp.asarray(v))

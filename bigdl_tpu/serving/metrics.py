@@ -118,6 +118,25 @@ from bigdl_tpu.optim.metrics import Metrics
 class ServingMetrics:
     """Queue/latency/throughput counters for :class:`ServingEngine`."""
 
+    #: THE closed finish-reason vocabulary. Every string a request can
+    #: finish with has a per-reason counter (``serving/finish_<reason>``
+    #: via :meth:`on_finish_reason`), so dashboards/goodput math can
+    #: never silently miss a disposition class. Adding a reason means
+    #: adding it HERE first — the static analyzer's SRV205 rule reads
+    #: this frozenset (cross-module) and flags any reason string the
+    #: serving plane uses that is not in it.
+    FINISH_REASONS = frozenset({
+        "eos",         # the request's private eos token appeared
+        "stop",        # stop-token / stop-sequence hit
+        "length",      # max_new_tokens reached
+        "shed",        # queue-full backpressure at submit
+        "deadline",    # expired while WAITING (deadline-drop)
+        "infeasible",  # feasibility admission control drop
+        "error",       # fault-recovery retry budget exhausted
+        "cancelled",   # caller cancel() — state-carried, so
+                       # Request.finish_reason stays None for these
+    })
+
     def __init__(self, backing: Optional[Metrics] = None) -> None:
         from collections import deque
 
@@ -176,6 +195,20 @@ class ServingMetrics:
                 self.metrics.add("serving/deadline_missed", 1.0)
 
     # -- resilience hooks (scheduler preemption + fault recovery) ----------
+
+    def on_finish_reason(self, reason: str) -> None:
+        """Per-reason disposition counter (``serving/finish_<reason>``),
+        recorded for EVERY request leaving the engine — finished,
+        shed, deadline-dropped, or errored out. The vocabulary is
+        closed (:data:`FINISH_REASONS`): an unknown reason raises here
+        rather than minting an unaccounted counter name, and SRV205
+        catches the same drift statically before it ever runs."""
+        if reason not in self.FINISH_REASONS:
+            raise ValueError(
+                f"unknown finish_reason {reason!r} — add it to "
+                f"ServingMetrics.FINISH_REASONS (and a counter "
+                f"consumer) first; known: {sorted(self.FINISH_REASONS)}")
+        self.metrics.add(f"serving/finish_{reason}", 1.0)
 
     def on_preempt(self) -> None:
         """A RUNNING row evicted loss-free to make room for a
@@ -400,7 +433,8 @@ class ServingMetrics:
         # useless where "preempted 13 rows" is the operational number)
         for name in ("preempted", "shed", "deadline_missed", "retries",
                      "recovered_rows", "degraded", "finished_in_slo",
-                     "infeasible", "chunks", "chunk_tokens"):
+                     "infeasible", "chunks", "chunk_tokens",
+                     *(f"finish_{r}" for r in sorted(self.FINISH_REASONS))):
             total, n = self.metrics.get(f"serving/{name}")
             if n:
                 out[f"serving/{name}"] = total

@@ -201,7 +201,13 @@ class Speculator:
         L = bucket_len(len(pf), self.draft_max_len)
         toks = np.zeros((1, L), np.int32)
         toks[0, :len(pf)] = pf
-        _, dc = self._draft_prefill_fn(
+        # routed through the engine's fault hook like every other
+        # serving dispatch (SRV201): an un-routed draft prefill would
+        # silently escape fault injection and retry accounting — a
+        # raised FaultError propagates to the caller (_configure_slot's
+        # callers recover the row like any admission-side fault)
+        _, dc = eng._dispatch(
+            "prefill", self._draft_prefill_fn,
             self._draft_params, jnp.asarray(toks),
             np.asarray([len(pf)], np.int32), self._zero_draft1)
         eng.pool.write_draft_prefill(slot, dc, len(pf))
@@ -273,13 +279,22 @@ class Speculator:
         active = np.zeros((N,), bool)
         k_r = np.zeros((N,), np.int32)
         n_sampled = 0
-        for slot, req in running.items():
+        for slot, req in list(running.items()):
             if slot not in eng._configured:
-                eng._configure_slot(slot, req)
+                try:
+                    eng._configure_slot(slot, req)
+                except FaultError:
+                    # the draft-prefill dispatch inside slot
+                    # configuration faulted: evict exactly this row for
+                    # loss-free replay, keep the rest of the super-step
+                    eng._recover_admission([(slot, req)])
+                    continue
             tokens[slot] = req.next_token
             active[slot] = True
             k_r[slot] = self._draft_budget(slot, req)
             n_sampled += not req.sampling.is_greedy
+        if not active.any():
+            return {}
         if eng._knobs_device is None:
             eng._knobs_device = {k: eng._place_rows(jnp.asarray(v))
                                  for k, v in eng._knobs.items()}

@@ -1,0 +1,35 @@
+"""SRV202 carry-key schema: string keys on the pooled serving carry
+checked against the layout ``_serving_init_carry`` declares.  A typo'd
+key fails only at runtime — or worse, a typo'd WRITE silently creates
+a new key the compiled step never reads.  Valid-key lines are the
+false-positive guards."""
+
+from bigdl_tpu.serving.kv_pool import KVPool
+
+
+def read_row_state(pool: KVPool, slot: int):
+    carry = pool.carry
+    pos = carry["pos"]                            # schema key — fine
+    k_scale = carry["k0_scale"]                   # int8 layout — fine
+    lanes = carry["rng"]                          # sampling state — fine
+    counts = carry["tok_counts"]                  # fine
+    typo_scale = carry["k0_scal"]                 # EXPECT: SRV202
+    typo_counts = carry["tok_count"]              # EXPECT: SRV202
+    return pos, k_scale, lanes, counts, typo_scale, typo_counts
+
+
+def write_row_state(pool: KVPool, slot: int, pos):
+    dcarry = dict(pool.draft_carry)
+    dcarry["pos"] = pos                           # draft shares the schema
+    pool.carry["positions"] = pos                 # EXPECT: SRV202
+    quantized = "k0_scale" in pool.carry          # membership test — fine
+    stale = pool.carry.get("v3_scale")            # .get read — fine
+    ghost = pool.carry.get("v3_scales")           # EXPECT: SRV202
+    return dcarry, quantized, stale, ghost
+
+
+def layer_loop(carry):
+    # non-constant keys are out of scope (checked at the declaration)
+    for i in range(4):
+        _ = carry[f"k{i}"]
+    return carry

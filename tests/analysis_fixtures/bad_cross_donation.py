@@ -1,0 +1,56 @@
+"""SRV204 interprocedural donation-reuse: SPMD104 lifted through the
+call graph.  ``ingest`` donates its parameter (it flows into a
+``donate_argnums`` position), so a CALLER's buffer is invalid after
+``ingest`` returns — even though no ``jax.jit`` appears at the call
+site.  The rebind spelling and the non-donated helper are the
+false-positive guards."""
+
+import jax
+
+
+def _scatter(carry, upd):
+    return {k: v + upd for k, v in carry.items()}
+
+
+scatter_jit = jax.jit(_scatter, donate_argnums=(0,))
+
+
+def ingest(pool_carry, upd):
+    """The helper hiding the donation behind a call boundary."""
+    return scatter_jit(pool_carry, upd)
+
+
+def inspect(pool_carry):
+    """Reads only — donates nothing."""
+    return pool_carry["pos"]
+
+
+def serve_broken(carry, upd):
+    out = ingest(carry, upd)
+    stale = carry["pos"]                          # EXPECT: SRV204
+    return out, stale
+
+
+def serve_rebound(carry, upd):
+    carry = ingest(carry, upd)        # the rebind idiom — fine
+    return carry["pos"]
+
+
+def serve_readonly(carry, upd):
+    head = inspect(carry)             # non-donating helper — fine
+    tail = carry["pos"]
+    return head, tail
+
+
+class PoolOwner:
+    def write(self, row, upd):
+        return scatter_jit(row, upd)  # method wrapper: donates row
+
+    def serve(self, carry, upd):
+        out = self.write(carry, upd)
+        ghost = carry["pos"]                      # EXPECT: SRV204
+        return out, ghost
+
+    def serve_ok(self, carry, upd):
+        carry = self.write(carry, upd)
+        return carry["pos"]

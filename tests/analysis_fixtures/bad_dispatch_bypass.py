@@ -1,0 +1,71 @@
+"""SRV201 dispatch-bypass: compiled steps from the ``get_*_step``
+caches invoked DIRECTLY inside a serving engine instead of through
+``_dispatch`` — silently defeating fault injection, the watchdog, and
+retry accounting.  Mirrors the REAL call shapes of
+``serving/engine.py`` (decode), ``serving/admission.py`` (bucketed
+prefill), and ``serving/speculative.py`` (verify + draft prefill).
+The routed spellings are the false-positive guards."""
+
+import jax.numpy as jnp
+
+from bigdl_tpu.models.transformer import (
+    get_batch_decode_step, get_batch_prefill_step, get_batch_verify_step,
+    get_prefill_step,
+)
+
+
+class MiniEngine:
+    """The minimal engine shape: binds compiled steps and owns a
+    ``_dispatch`` routing — exactly what makes direct invocation a
+    bypass rather than test code."""
+
+    def __init__(self, model, dtype):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._batch_prefill_fn = get_batch_prefill_step(model, dtype)
+        self._prefill_fn = get_prefill_step(model, dtype)
+        self.verify_fn, self.pool_init = get_batch_verify_step(
+            model, dtype, width=5)
+        self._faults = None
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, carry, knobs):
+        # the routed spelling — never flagged
+        tok, chosen, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        # the bypass: same dispatch, no routing
+        tok2, chosen2, carry = self._step_fn(   # EXPECT: SRV201
+            params, tokens, active, carry, knobs)
+        return tok, tok2, carry
+
+    def admit(self, params, toks, lengths, carry):
+        _, out = self._dispatch("prefill", self._batch_prefill_fn,
+                                params, toks, lengths, carry)
+        _, out = self._batch_prefill_fn(        # EXPECT: SRV201
+            params, toks, lengths, carry)
+        _, pc = self._prefill_fn(params, toks, carry)   # EXPECT: SRV201
+        return out, pc
+
+    def verify(self, params, vtoks, lengths, carry, knobs):
+        vt, vlp, n_emit, carry = self._dispatch(
+            "verify", self.verify_fn, params, vtoks, lengths, carry, knobs)
+        vt, vlp, n_emit, carry = self.verify_fn(        # EXPECT: SRV201
+            params, vtoks, lengths, carry, knobs)
+        return vt, carry
+
+    def aliased(self, params, toks, lengths, carry):
+        # a local alias is still the same compiled step
+        fn = self._batch_prefill_fn
+        _, out = fn(params, toks, lengths, carry)       # EXPECT: SRV201
+        # ...but merely READING the attribute (compile-count probes,
+        # `_note_shape`) is fine
+        seen = getattr(self._batch_prefill_fn, "_traced_shapes", None)
+        return out, seen
+
+    def passthrough(self, params, x, carry):
+        # handing the step to the router as an ARGUMENT is the idiom
+        return self._dispatch("decode", self._step_fn, params, x, carry)

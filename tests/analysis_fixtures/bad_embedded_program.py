@@ -1,0 +1,28 @@
+"""Embedded string-program scanning (the PR-5 blind-spot closure):
+child programs inside ASSIGNED string constants — the
+``pod_projection._CHILD`` shape, ``str.format`` placeholders included
+— are parsed as nested units and scanned by EVERY rule, with finding
+lines remapped into this host file.  The clean child below is the
+false-positive guard."""
+
+_BAD_CHILD = r"""
+import sys
+from jax.experimental.shard_map import shard_map   # EXPECT: SPMD101
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, {repo!r})
+
+ROWS = P(("data",))                                # EXPECT: SPMD102
+TABLE = {{"rows": ROWS}}
+"""
+
+_CLEAN_CHILD = r"""
+import sys
+
+from bigdl_tpu.utils.compat import shard_map
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, {repo!r})
+
+ROWS = P("data")
+"""

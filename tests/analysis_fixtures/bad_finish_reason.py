@@ -1,0 +1,30 @@
+"""SRV205 finish-reason accounting: every string a request can finish
+with must be in ``ServingMetrics.FINISH_REASONS`` (each has a
+``serving/finish_<reason>`` counter path).  A typo'd or novel reason
+silently escapes goodput/shed accounting.  The vocabulary spellings
+are the false-positive guards."""
+
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+
+def shed_paths(engine, req):
+    req.finish_reason = "shed"                    # vocabulary — fine
+    engine._shed(req, "deadline")                 # vocabulary — fine
+    req.finish_reason = "shedd"                   # EXPECT: SRV205
+    engine._shed(req, "overload")                 # EXPECT: SRV205
+
+
+def finish_paths(engine, req, now):
+    engine._finish_row(req, "length", now)        # vocabulary — fine
+    engine._finish_row(req, "lenght", now)        # EXPECT: SRV205
+    reason = compute_reason(req)
+    engine._finish_row(req, reason, now)          # dynamic — out of scope
+
+
+def account(metrics: ServingMetrics):
+    metrics.on_finish_reason("error")             # vocabulary — fine
+    metrics.on_finish_reason("oom")               # EXPECT: SRV205
+
+
+def compute_reason(req):
+    return "eos" if req.output else "length"

@@ -1,0 +1,47 @@
+"""SRV203 host-mirror lockstep: a KVPool-lineage method that moves the
+device ``pos`` must keep the ``chunk_done``/``chunk_target`` host
+mirrors in lockstep (the chunked-admission pump plans from the mirrors
+WITHOUT a device readback — a drifted mirror stalls or double-feeds a
+row).  The compliant overrides and the draft-carry methods (no
+mirrors) are the false-positive guards."""
+
+import jax.numpy as jnp
+
+from bigdl_tpu.serving.kv_pool import KVPool
+
+
+class DriftPool(KVPool):
+    """Overrides set_pos but forgets the host mirror."""
+
+    def set_pos(self, slot: int, pos: int) -> None:
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self.carry["pos"] = self.carry["pos"].at[slot].set(pos)  # EXPECT: SRV203
+
+
+class ResetDriftPool(KVPool):
+    """The reset-helper spelling of the same drift: the donated
+    ``_free_reset`` moves pos as a side effect."""
+
+    def recycle(self, slot: int) -> None:
+        self.carry.update(self._free_reset(            # EXPECT: SRV203
+            {"pos": self.carry["pos"]}, jnp.int32(slot)))
+
+
+class LockstepPool(KVPool):
+    """The compliant override — mirror written in the same method."""
+
+    def set_pos(self, slot: int, pos: int) -> None:
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self.carry["pos"] = self.carry["pos"].at[slot].set(int(pos))
+        self.chunk_done[slot] = int(pos)
+
+    def free(self, slot: int) -> None:
+        # delegating to super() keeps the whole contract
+        super().free(slot)
+
+    def set_draft_pos(self, slot: int, pos: int) -> None:
+        # the DRAFT carry has no host mirrors — exempt by design
+        self.draft_carry["pos"] = \
+            self.draft_carry["pos"].at[slot].set(int(pos))

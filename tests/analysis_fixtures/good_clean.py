@@ -75,3 +75,54 @@ def sharded_apply(f):
                    out_specs=P("data"))
     placement = NamedSharding(mesh, ROWS)
     return fn, placement
+
+
+# -- serving contracts (SRV201/202/203/204/205) -----------------------------
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.kv_pool import KVPool
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+
+class RoutedEngine:
+    """Every compiled-step dispatch through _dispatch (SRV201), every
+    finish reason in the vocabulary (SRV205)."""
+
+    def __init__(self, model):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, None, sampling=True)
+        self.metrics = ServingMetrics()
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def step(self, params, tokens, active, carry, knobs):
+        tok, chosen, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        return tok, carry
+
+    def finish(self, req):
+        req.finish_reason = "length"
+        self.metrics.on_finish_reason("length")
+
+
+class MirroredPool(KVPool):
+    """pos moves with the chunk mirrors in lockstep (SRV203); schema
+    keys only (SRV202)."""
+
+    def set_pos(self, slot: int, pos: int) -> None:
+        self.carry["pos"] = self.carry["pos"].at[slot].set(int(pos))
+        self.chunk_done[slot] = int(pos)
+
+
+donating_scatter = jax.jit(lambda c, u: c, donate_argnums=(0,))
+
+
+def ingest_row(row_carry, upd):
+    return donating_scatter(row_carry, upd)
+
+
+def serve_once(carry, upd):
+    # the rebind idiom ACROSS a call boundary (SRV204's clean twin)
+    carry = ingest_row(carry, upd)
+    return carry["pos"]

@@ -1,0 +1,17 @@
+"""Caller half of the cross-module SRV204 demonstration (see
+xmod_donation_helper.py).  The import resolves through the project
+symbol table: ``ingest`` donates its first parameter two modules away,
+so reading ``carry`` after the call is a use-after-donation."""
+
+from xmod_donation_helper import ingest
+
+
+def serve_broken(carry, upd):
+    out = ingest(carry, upd)
+    stale = carry["pos"]                          # EXPECT: SRV204
+    return out, stale
+
+
+def serve_rebound(carry, upd):
+    carry = ingest(carry, upd)
+    return carry["pos"]

@@ -1,0 +1,18 @@
+"""Cross-module half of the SRV204 demonstration: a module-level
+helper that donates its parameter.  Clean on its own — the reuse bug
+lives in the CALLER module (xmod_donation_caller.py); the pair must be
+analyzed together (``analyze_paths([caller, helper])``) for the
+project pass to lift the donation across the module boundary."""
+
+import jax
+
+
+def _scatter(carry, upd):
+    return {k: v + upd for k, v in carry.items()}
+
+
+scatter_jit = jax.jit(_scatter, donate_argnums=(0,))
+
+
+def ingest(pool_carry, upd):
+    return scatter_jit(pool_carry, upd)

@@ -325,6 +325,23 @@ def test_engine_metrics_counters():
     # the underlying Metrics is the standard observability object — a
     # TrainSummary-style consumer can read the same counters
     assert backing.mean("serving/queue_depth") >= 0.0
+    # per-reason disposition counters: every request leaving the engine
+    # lands in exactly one serving/finish_<reason> bucket (three cap out
+    # on length; a fourth is cancelled while waiting — the buckets sum
+    # to every submitted request's fate), and the vocabulary is CLOSED —
+    # an unknown reason raises instead of minting an unaccounted counter
+    # (the SRV205 contract's runtime half)
+    assert s["serving/finish_length"] == 3.0
+    assert "serving/finish_eos" not in s
+    c = eng.submit([4, 8], max_new_tokens=2)
+    assert eng.cancel(c)
+    s = eng.metrics.summary()
+    assert s["serving/finish_cancelled"] == 1.0
+    total, _ = eng.metrics.metrics.get("serving/submitted")
+    assert sum(v for k, v in s.items()
+               if k.startswith("serving/finish_")) == total == 4
+    with pytest.raises(ValueError, match="FINISH_REASONS"):
+        eng.metrics.on_finish_reason("oom")   # analysis: ok: SRV205
 
 
 # -- batch decode step (the model-layer factor the engine rides on) --------

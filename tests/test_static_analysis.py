@@ -29,14 +29,14 @@ BASELINE = REPO / "analysis_baseline.txt"
 
 BAD_FIXTURES = sorted(FIXTURES.glob("bad_*.py"))
 ALL_CODES = ("SPMD101", "SPMD102", "SPMD103", "SPMD104", "SPMD105",
-             "SPMD106")
+             "SPMD106", "SRV201", "SRV202", "SRV203", "SRV204", "SRV205")
 
 
 def _expected(path: Path):
     """(line, code) pairs from the fixture's `# EXPECT: CODE` comments."""
     out = set()
     for i, line in enumerate(path.read_text().splitlines(), start=1):
-        m = re.search(r"#\s*EXPECT:\s*(SPMD\d+)", line)
+        m = re.search(r"#\s*EXPECT:\s*([A-Z]+\d+)", line)
         if m:
             out.add((i, m.group(1)))
     return out
@@ -129,7 +129,7 @@ def test_duplicate_lines_get_distinct_fingerprints():
     """Baselining one occurrence of a drifted line must not silence a
     second paste of the identical line — fingerprints are occurrence-
     indexed."""
-    src = (
+    src = (  # analysis: no-embed — deliberate violations under test
         "from jax.sharding import PartitionSpec as P\n"
         "SPECS = [\n"
         "    P(('data',)),\n"
@@ -225,3 +225,399 @@ def test_module_entrypoint_subprocess():
     assert proc.returncode == 0
     for code in ALL_CODES:
         assert code in proc.stdout
+
+
+# -- whole-program: SRV201 coverage of the REAL dispatch sites --------------
+
+SERVING_DIR = REPO / "bigdl_tpu" / "serving"
+_DISPATCH_RE = re.compile(
+    r'(?:self|eng)\._dispatch\(\s*"[a-z_]+",\s*([\w.]+),')
+
+
+def _serving_tree(tmp_path: Path) -> Path:
+    """Copy bigdl_tpu/serving into a path that keeps the
+    bigdl_tpu/serving/ scope marker (the SRV201 rule's path scoping)."""
+    dst = tmp_path / "bigdl_tpu" / "serving"
+    dst.mkdir(parents=True)
+    for f in SERVING_DIR.glob("*.py"):
+        (dst / f.name).write_text(f.read_text())
+    return dst
+
+
+def test_srv201_real_dispatch_sites_enumerated():
+    """Every serving module that dispatches compiled steps routes them
+    through _dispatch — and the routed sites exist where we think."""
+    counts = {f.name: len(_DISPATCH_RE.findall(f.read_text()))
+              for f in sorted(SERVING_DIR.glob("*.py"))}
+    sites = {k: v for k, v in counts.items() if v}
+    assert sites == {"admission.py": 2, "chunked.py": 1,
+                     "engine.py": 2, "speculative.py": 3}, sites
+
+
+@pytest.mark.parametrize("fname", ["engine.py", "speculative.py",
+                                   "admission.py", "chunked.py"])
+def test_srv201_catches_every_unrouted_dispatch_site(tmp_path, fname):
+    """THE SRV201 acceptance proof: deleting the _dispatch routing on
+    any one decode/verify/draft/prefill call site in serving/ makes the
+    scan fail — demonstrated against a copy of the REAL serving tree
+    with each site's routing stripped in turn (the call shape stays
+    exactly the real one).  The unmutated copy must scan SRV201-clean,
+    so the coverage is exact, not vacuous."""
+    tree = _serving_tree(tmp_path)
+    clean = analyze_paths([str(tmp_path)], select=["SRV201"])
+    assert clean == [], [f.format() for f in clean]
+
+    src = (tree / fname).read_text()
+    matches = list(_DISPATCH_RE.finditer(src))
+    assert matches, f"{fname} has no dispatch sites?"
+    for i, _ in enumerate(matches):
+        mutated = []
+        for j, m in enumerate(matches):
+            if j == i:
+                mutated.append((m.start(), m.end(), f"{m.group(1)}("))
+        start, end, repl = mutated[0]
+        (tree / fname).write_text(src[:start] + repl + src[end:])
+        found = analyze_paths([str(tmp_path)], select=["SRV201"])
+        assert [f.code for f in found] == ["SRV201"], (
+            f"stripping dispatch site {i} in {fname} must yield exactly "
+            f"one SRV201, got: {[f.format() for f in found]}")
+        assert found[0].path.endswith(fname)
+    (tree / fname).write_text(src)
+
+
+# -- whole-program: cross-module donation lifting ---------------------------
+
+def test_cross_module_donation_reuse():
+    """SRV204 resolves a donating helper THROUGH the import graph: the
+    helper module is clean alone, the caller only fires when both files
+    are in the project."""
+    caller = FIXTURES / "xmod_donation_caller.py"
+    helper = FIXTURES / "xmod_donation_helper.py"
+    assert analyze_paths([str(helper)]) == []
+    # caller alone: the import cannot resolve — documented degradation
+    assert analyze_paths([str(caller)]) == []
+    got = [(Path(f.path).name, f.line, f.code)
+           for f in analyze_paths([str(caller), str(helper)])]
+    assert got == [("xmod_donation_caller.py", 11, "SRV204")]
+
+
+# -- whole-program: schema extraction beats the fallback --------------------
+
+def test_srv205_vocabulary_extracted_from_project():
+    """The finish-reason vocabulary comes from the PROJECT's
+    ServingMetrics.FINISH_REASONS when visible — not the built-in
+    fallback (proved by overriding it)."""
+    src = (  # analysis: no-embed — deliberate violations under test
+        "from bigdl_tpu.serving.metrics import whatever\n"
+        "class ServingMetrics:\n"
+        "    FINISH_REASONS = frozenset({'weird'})\n"
+        "def f(engine, req):\n"
+        "    engine._shed(req, 'weird')\n"
+        "    engine._shed(req, 'eos')\n"
+    )
+    got = [(f.line, f.code) for f in analyze_source(src, "mini.py")]
+    assert got == [(6, "SRV205")]
+
+
+def test_srv205_reads_real_vocabulary():
+    """The shipped ServingMetrics.FINISH_REASONS is what the repo gate
+    checks against (extraction, not fallback, on the real tree)."""
+    from bigdl_tpu.analysis.core import (
+        _parse_file, collect_file_facts, extract_embedded_units,
+    )
+
+    text = (REPO / "bigdl_tpu" / "serving" / "metrics.py").read_text()
+    ctx, err = _parse_file(text, "bigdl_tpu/serving/metrics.py")
+    assert err is None
+    facts = collect_file_facts(ctx)
+    assert set(facts.get("finish_reasons", [])) == {
+        "eos", "stop", "length", "shed", "deadline", "infeasible",
+        "error", "cancelled"}
+    assert extract_embedded_units(ctx) == []
+
+
+# -- embedded string programs (the PR-5 blind-spot closure) -----------------
+
+def test_embedded_program_line_mapping(tmp_path):
+    """Findings inside an assigned string program report HOST-file
+    lines; format placeholders are unescaped first."""
+    host = tmp_path / "host.py"
+    host.write_text(
+        'CHILD = r"""\n'
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "x = {repo!r}\n"
+        '"""\n')
+    got = [(f.code, f.line) for f in analyze_paths([str(host)])]
+    assert got == [("SPMD101", 3)]
+
+
+def test_docstrings_and_prose_are_not_embedded_units(tmp_path):
+    host = tmp_path / "host.py"
+    host.write_text(
+        '"""Module docstring mentioning import jax and\n'
+        "from jax.experimental.shard_map import shard_map\n"
+        'across several lines of prose."""\n'
+        "BANNER = (\n"
+        "    'no import here'\n"
+        ")\n")
+    assert analyze_paths([str(host)]) == []
+
+
+def test_pod_projection_child_scans_clean():
+    """The historical blind spot itself: pod_projection's _CHILD is now
+    parsed and scanned (it routes through compat, so it must be
+    clean)."""
+    target = REPO / "benchmarks" / "pod_projection.py"
+    assert analyze_paths([str(target)]) == []
+
+
+# -- baseline hygiene: stale warning + --prune-baseline ---------------------
+
+def _staled_tree(tmp_path):
+    """A tmp tree holding a copy of the spec-spelling fixture, plus a
+    baseline with its LIVE entries, one STALE entry for a deleted file
+    UNDER the tree, and one entry for a file OUTSIDE the tree."""
+    from bigdl_tpu.analysis import format_baseline_entry
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "bad_spec.py").write_text(
+        (FIXTURES / "bad_spec_spelling.py").read_text())
+    fs = analyze_paths([str(tree)])
+    assert fs
+    prefix = fs[0].path.rsplit("/", 1)[0]
+    lines = ["# header comment"]
+    for f in fs:
+        lines.append(format_baseline_entry(f))
+    lines += ["# stale justification",
+              f"{prefix}/deleted_file.py:SPMD102:deadbeefdead",
+              "# other-tree justification",
+              "elsewhere/other.py:SPMD102:feedfacefeed"]
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("\n".join(lines) + "\n")
+    return tree, baseline
+
+
+def test_stale_baseline_warning_preserves_exit_code(tmp_path, capsys,
+                                                    monkeypatch):
+    monkeypatch.chdir(REPO)
+    tree, baseline = _staled_tree(tmp_path)
+    assert main([str(tree), "--baseline", str(baseline)]) == 0
+    err = capsys.readouterr().err
+    assert "1 stale baseline entry" in err and "--prune-baseline" in err
+
+
+def test_prune_baseline_drops_only_covered_stale_entries(
+        tmp_path, capsys, monkeypatch):
+    """Pruning removes the dead entry for the deleted file UNDER the
+    scanned tree (justification comment included) but must NOT touch
+    live entries or entries for files the scan never covered — a
+    partial scan deleting other trees' grandfathered findings would
+    un-baseline them on the next full run."""
+    monkeypatch.chdir(REPO)
+    tree, baseline = _staled_tree(tmp_path)
+    assert main([str(tree), "--baseline", str(baseline),
+                 "--prune-baseline"]) == 0
+    out = capsys.readouterr()
+    assert "pruned 1 stale baseline entry" in out.err
+    text = baseline.read_text()
+    assert "deadbeefdead" not in text
+    assert "# stale justification" not in text     # justification went too
+    assert "# header comment" in text
+    assert "elsewhere/other.py" in text            # out of scope: kept
+    # every live entry survived: the scan is still fully baselined,
+    # and the out-of-scope entry is not warned about
+    assert main([str(tree), "--baseline", str(baseline)]) == 0
+    assert "stale" not in capsys.readouterr().err
+
+
+def test_partial_scan_never_prunes_other_files(tmp_path, capsys,
+                                               monkeypatch):
+    """The review-found regression shape: scanning file B with a
+    baseline full of file A's live entries must not warn about or
+    prune A's entries."""
+    monkeypatch.chdir(REPO)
+    tree, baseline = _staled_tree(tmp_path)
+    other = tmp_path / "clean.py"
+    other.write_text("X = 1\n")
+    before = baseline.read_text()
+    assert main([str(other), "--baseline", str(baseline),
+                 "--prune-baseline"]) == 0
+    err = capsys.readouterr().err
+    assert "pruned 0 stale" in err
+    assert baseline.read_text() == before
+
+
+# -- SARIF output -----------------------------------------------------------
+
+def test_sarif_output(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(REPO)
+    bad = str(FIXTURES / "bad_donation.py")
+    rc = main([bad, "--no-baseline", "--format", "sarif"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["version"] == "2.1.0"
+    run = report["runs"][0]
+    assert run["tool"]["driver"]["name"] == "bigdl-tpu-analysis"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(ALL_CODES) <= rule_ids
+    results = run["results"]
+    assert results and all(r["ruleId"] == "SPMD104" for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad_donation.py")
+    assert loc["region"]["startLine"] > 0
+    assert results[0]["partialFingerprints"]["bigdlAnalysis/v1"]
+    # clean input -> empty results, exit 0, same schema
+    rc = main([str(FIXTURES / "good_clean.py"), "--no-baseline",
+               "--format", "sarif"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["runs"][0]["results"] == []
+
+
+# -- the cached/parallel scan driver ----------------------------------------
+
+def test_scan_cache_and_parallel_parity(tmp_path, monkeypatch):
+    """scan() with the findings cache (cold AND warm) returns
+    byte-identical findings to analyze_paths — the cache can never
+    change results, only skip work.  (The FORK workers are pinned by
+    the subprocess tests below — in-process pytest has jax loaded,
+    which rightly disables fork.)"""
+    from bigdl_tpu.analysis import scan
+
+    monkeypatch.chdir(REPO)
+    paths = ["bigdl_tpu/analysis", "bigdl_tpu/serving"]
+    plain = [f.to_dict() for f in analyze_paths(paths)]
+    cache = tmp_path / "cache.json"
+    cold = [f.to_dict() for f in scan(paths, cache_path=str(cache))]
+    warm = [f.to_dict() for f in scan(paths, cache_path=str(cache))]
+    assert cold == plain and warm == plain
+    assert cache.exists()
+
+
+# -- the inline suppression idiom -------------------------------------------
+
+def test_inline_suppression_idiom():
+    """`# analysis: ok[: CODES]` silences a line that is legitimate
+    despite matching a rule — scoped to the listed codes; unrelated
+    codes on the line still fire."""
+    base = "from jax.sharding import PartitionSpec as P\n"
+    assert analyze_source(base + "R = P(('data',))\n", "s.py")
+    assert analyze_source(
+        base + "R = P(('data',))  # analysis: ok\n", "s.py") == []
+    assert analyze_source(
+        base + "R = P(('data',))  # analysis: ok: SPMD102\n",
+        "s.py") == []
+    # listing a DIFFERENT code does not suppress
+    fs = analyze_source(
+        base + "R = P(('data',))  # analysis: ok: SRV205\n", "s.py")
+    assert [f.code for f in fs] == ["SPMD102"]
+
+
+def test_scan_cache_never_pollutes_facts_across_edits(tmp_path):
+    """Regression: merge_facts must not mutate per-file fact dicts that
+    live inside cache entries — a polluted entry would keep replaying a
+    STALE cross-module fact (e.g. a deleted step binding) and make
+    cached scans diverge from --no-cache after an edit."""
+    from bigdl_tpu.analysis import scan
+
+    proj = tmp_path / "bigdl_tpu" / "serving"
+    proj.mkdir(parents=True)
+    (proj / "other.py").write_text("X = 1\n")
+    binder = (
+        "from bigdl_tpu.models.transformer import get_prefill_step\n"
+        "class A:\n"
+        "    def __init__(self, m):\n"
+        "        self._b_fn = get_prefill_step(m, None)\n")
+    (proj / "f.py").write_text(binder)
+    (proj / "g.py").write_text(
+        "class B:\n"
+        "    def run(self, x):\n"
+        "        return self._b_fn(x)\n")
+    cache = tmp_path / "cache.json"
+    run1 = scan([str(tmp_path)], cache_path=str(cache))
+    assert [f.code for f in run1] == ["SRV201"]
+    # delete the binding: the bypass callsite is no longer a step call
+    (proj / "f.py").write_text("def unrelated():\n    return 0\n")
+    fresh = scan([str(tmp_path)])
+    cached = scan([str(tmp_path)], cache_path=str(cache))
+    assert fresh == [] and cached == [], (
+        [f.format() for f in cached])
+
+
+def test_cli_parallel_scan_matches_library(tmp_path):
+    """The fork-worker path (CLI subprocess — in-process pytest has jax
+    loaded, which rightly disables fork) returns the same findings as
+    the serial library API, including cross-module facts split across
+    workers."""
+    lib = analyze_paths([str(FIXTURES / "bad_dispatch_bypass.py"),
+                         str(FIXTURES / "bad_finish_reason.py")])
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.analysis",
+         str(FIXTURES / "bad_dispatch_bypass.py"),
+         str(FIXTURES / "bad_finish_reason.py"),
+         "--no-baseline", "--json", "--jobs", "2",
+         "--no-cache"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    got = {(Path(f["path"]).name, f["line"], f["code"])
+           for f in report["findings"]}
+    want = {(Path(f.path).name, f.line, f.code) for f in lib}
+    assert got == want
+
+
+def test_prune_baseline_conflicts_with_no_baseline(tmp_path, capsys,
+                                                   monkeypatch):
+    """--no-baseline makes every entry look stale — the combination
+    must be a usage error, never an empty baseline file."""
+    monkeypatch.chdir(REPO)
+    tree, baseline = _staled_tree(tmp_path)
+    before = baseline.read_text()
+    rc = main([str(tree), "--baseline", str(baseline),
+               "--no-baseline", "--prune-baseline"])
+    assert rc == 2
+    assert "conflicts" in capsys.readouterr().err
+    assert baseline.read_text() == before
+
+
+def test_subset_scan_keeps_whole_repo_cache(tmp_path):
+    """A subset scan must MERGE into the cache, not evict the other
+    trees' entries — alternating full and subset scans would otherwise
+    pay the cold cost every time."""
+    import json as _json
+
+    from bigdl_tpu.analysis import scan
+
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    (a / "x.py").write_text("X = 1\n")
+    (b / "y.py").write_text("Y = 2\n")
+    cache = tmp_path / "cache.json"
+    scan([str(a), str(b)], cache_path=str(cache))
+    full = set(_json.loads(cache.read_text())["files"])
+    assert len(full) == 2
+    scan([str(a)], cache_path=str(cache))          # subset
+    assert set(_json.loads(cache.read_text())["files"]) == full
+
+
+def test_cli_parallel_workers_resolve_cross_file_facts(tmp_path):
+    """Fork workers over a REAL multi-file serving tree: the SRV201
+    binding lives in engine.py while the stripped call site is in
+    admission.py — different worker slices, so the finding only
+    survives if the phase-1 fact exchange merges across workers."""
+    tree = _serving_tree(tmp_path)
+    src = (tree / "admission.py").read_text()
+    m = next(_DISPATCH_RE.finditer(src))
+    (tree / "admission.py").write_text(
+        src[:m.start()] + f"{m.group(1)}(" + src[m.end():])
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.analysis", str(tmp_path),
+         "--no-baseline", "--select", "SRV201", "--json",
+         "--jobs", "2", "--no-cache"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    assert [(Path(f["path"]).name, f["code"])
+            for f in report["findings"]] == [("admission.py", "SRV201")]

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# The pre-commit-able static gate: the whole-program analyzer over the
+# three analyzed trees, then the `analysis`-marked pytest subset (exact
+# fixture parity, CLI contract, SRV201 dispatch-site coverage proof).
+#
+#   tools/check.sh            # run both gates
+#   tools/check.sh --scan     # analyzer only (sub-second warm)
+#
+# Exit nonzero on any new finding or test failure. The analyzer keeps a
+# findings cache in .cache/ (content-hashed — it can only skip work,
+# never change results), so the steady-state cost is well under a
+# second; the first run after an analyzer/source change re-parses cold.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m bigdl_tpu.analysis bigdl_tpu benchmarks tests
+
+if [[ "${1:-}" != "--scan" ]]; then
+    JAX_PLATFORMS=cpu python -m pytest -m analysis -q \
+        -p no:cacheprovider tests/test_static_analysis.py
+fi
