@@ -217,11 +217,24 @@ def run_engine(lm, dtype, trace, n_slots: int, policy: str):
     # charges that queueing delay to the engine, not to the trace)
     ttfts = [eng.request(rid).first_token_time - (t0 + arr)
              for rid, arr in arrivals.items()]
+    # the per-step host-vs-device split: host_step_s is the Python the
+    # device pipeline waits on between dispatches (scheduling,
+    # admission bookkeeping, per-token accounting) — THE before-number
+    # the async dispatch-ahead refactor will cite (docs/
+    # async_readiness.md); host_frac is its share of the serve
+    host_total, n_host = eng.metrics.metrics.get("serving/host_step_s")
+    device_total = eng.metrics.device_seconds
     return {"tokens_per_sec": round(n_tokens / wall, 1),
             "wall_s": round(wall, 3), "tokens": n_tokens,
             "ttft": _percentiles(ttfts),
             "occupancy_mean": round(
-                eng.metrics.metrics.mean("serving/slot_occupancy"), 3)}
+                eng.metrics.metrics.mean("serving/slot_occupancy"), 3),
+            "host_step": _percentiles(
+                eng.metrics.metrics.values("serving/host_step_s"),
+                qs=(50, 99)),
+            "host_frac": round(
+                host_total / max(host_total + device_total, 1e-9), 3)
+            if n_host else 0.0}
 
 
 def make_ragged_trace(cfg, n_requests: int, gen_tokens: int,

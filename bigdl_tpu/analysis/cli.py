@@ -33,8 +33,9 @@ from typing import List, Optional
 
 from bigdl_tpu.analysis.core import (
     DEFAULT_EXCLUDE_DIRS, all_rules, covered_by_scan,
-    format_baseline_entry, load_baseline, prune_baseline_text,
-    rule_codes, scan, split_baselined, stale_entries,
+    format_baseline_entry, load_baseline, load_project,
+    prune_baseline_text, rule_codes, scan, split_baselined,
+    stale_entries,
 )
 
 #: what the pass covers when no paths are given — the three analyzed
@@ -87,6 +88,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "existing tooling)")
     p.add_argument("--list-rules", action="store_true",
                    help="list rule codes and exit")
+    p.add_argument("--report", choices=("sync-points",), default=None,
+                   help="print a whole-program report instead of "
+                        "findings: 'sync-points' inventories every "
+                        "hot-path device→host sync (declared fences + "
+                        "ASY findings) with its root chain — the "
+                        "async-refactor worksheet (exit 0; combine "
+                        "with --format json for the machine shape)")
     p.add_argument("--jobs", type=int, default=0, metavar="N",
                    help="parallel parse workers for cache misses "
                         "(default: the host's cores; 1 = serial)")
@@ -142,6 +150,65 @@ def to_sarif(findings, rules) -> dict:
     }
 
 
+def _short_chain(chain: List[str]) -> str:
+    """Root chain with module prefixes dropped for the text report
+    (``ServingEngine.step -> ChunkedAdmissionController.pump``)."""
+    out = []
+    for q in chain:
+        parts = q.split(".")
+        out.append(".".join(parts[-2:]) if len(parts) >= 2 else q)
+    return " -> ".join(out)
+
+
+def report_sync_points(paths: List[str], fmt: str) -> int:
+    """``--report sync-points``: the async-refactor worksheet — every
+    hot-path device→host sync (declared fence sites + any un-fenced
+    ASY finding) with its call-graph root chain. Informational: exits
+    0 (the normal scan is the gate that FAILS on un-fenced syncs)."""
+    from bigdl_tpu.analysis.rules import sync_point_inventory
+
+    contexts, errors = load_project(paths,
+                                    exclude_dirs=DEFAULT_EXCLUDE_DIRS)
+    entries = sync_point_inventory(contexts)
+    if fmt in ("json", "sarif"):
+        print(json.dumps({
+            "report": "sync-points",
+            "paths": list(paths),
+            "entries": entries,
+            "summary": {
+                "declared": sum(1 for e in entries
+                                if e["kind"].startswith("fence")),
+                "findings": sum(1 for e in entries
+                                if e["kind"].startswith("ASY")),
+                "parse_errors": len(errors),
+            },
+        }, indent=2))
+        return 0
+    declared = [e for e in entries if e["kind"].startswith("fence")]
+    findings = [e for e in entries if e["kind"].startswith("ASY")]
+    print(f"# hot-path sync-point inventory — {len(declared)} declared "
+          f"fence site(s), {len(findings)} un-fenced finding(s)")
+    for err in errors:
+        # a file that does not parse is NOT inventoried — the
+        # worksheet must say so rather than read as complete
+        print(f"# WARNING: {err.path}:{err.line} failed to parse and "
+              f"is not inventoried ({err.message})", file=sys.stderr)
+    for e in entries:
+        supp = "  [suppressed: # analysis: ok]" if e["suppressed"] else ""
+        print(f"{e['path']}:{e['line']} [{e['kind']}]{supp}")
+        if e["function"]:
+            print(f"    in {e['function']}")
+        if e["chain"]:
+            print(f"    chain: {_short_chain(e['chain'])}")
+        if e["kind"].startswith("ASY"):
+            print(f"    {e['classification']}")
+        if e["detail"]:
+            print(f"    | {e['detail']}")
+        if e["kind"].startswith("ASY") and e["suggestion"]:
+            print(f"    fix: {e['suggestion']}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -175,6 +242,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: path(s) do not exist: {', '.join(missing)} "
               f"(cwd: {Path.cwd()})", file=sys.stderr)
         return 2
+    if args.report == "sync-points":
+        return report_sync_points(paths, fmt)
     jobs = args.jobs or (os.cpu_count() or 1)
     findings = scan(paths, select=select, ignore=ignore,
                     exclude_dirs=DEFAULT_EXCLUDE_DIRS,

@@ -554,6 +554,226 @@ def facts_digest(facts: Dict[str, Any]) -> str:
     return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
 
 
+# -- the interprocedural call graph + hot-path reachability -----------------
+#
+# The ASY3xx async-readiness rules (rules.py) need to know which
+# functions the serving SUPER-STEP can actually reach — a readback in
+# the decode loop is a stall every step, the same spelling in a bench
+# harness is free. Path globs cannot express that (benches construct
+# engines; tests copy engine shapes), so the exemption is REACHABILITY:
+# a mergeable per-file fact collector emits call edges for every
+# module-level function and class method, the engine merges them
+# project-wide, and a BFS from the serving plane's hot-path ROOTS
+# decides hot vs cold. Edges come in two strengths:
+#
+# * QUALIFIED — same-file defs, `self.` methods the class defines, and
+#   imported callables, resolved through the file's imports (with the
+#   suffix matching SRV204 pioneered for sys.path-rooted module
+#   spellings);
+# * SUFFIX (".name") — attribute calls on objects whose class the AST
+#   cannot know (`self.admitter.admit(n)`, `eng.pool.write_prefill`).
+#   A suffix edge reaches every METHOD unit with that name — an
+#   over-approximation in the safe direction (too-hot means a finding
+#   a human reviews; too-cold means a silent stall ships) — but only
+#   methods of DISPATCH-SCOPE files (the serving tree, files importing
+#   it, files with roots of their own), so a generic method name in an
+#   unrelated plane never gets dragged onto the hot path.
+#
+# Roots are facts too: the serving plane's super-step surface is
+# matched by (class, method) name, and any function can opt in with a
+# `# analysis: hotpath-root` comment on (or directly above) its `def`
+# line — new engine loops are born reachability-checked.
+
+#: the serving plane's built-in hot-path roots, matched by
+#: (class name, method name) anywhere they are defined
+HOTPATH_ROOT_METHODS = frozenset({
+    ("ServingEngine", "step"),
+    ("Speculator", "step"),
+    ("ChunkedAdmissionController", "pump"),
+    ("ServingEngine", "_dispatch"),
+})
+#: the opt-in annotation for new roots
+HOTPATH_MARK = "analysis: hotpath-root"
+
+
+def _unit_functions(ctx: "FileContext") -> List[Tuple[str, ast.AST,
+                                                      Optional[str]]]:
+    """The file's call-graph UNITS: ``(qualname, node, class name)``
+    for every module-level function and single-level class method
+    (nested defs/lambdas belong to their enclosing unit — their calls
+    are the unit's calls). Cached per file."""
+    units = ctx.cache.get("callgraph_units")
+    if units is None:
+        units = ctx.cache["callgraph_units"] = []
+        mod = ctx.module
+        for fn in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef):
+            parent = ctx.parents.get(fn)
+            if isinstance(parent, ast.Module):
+                qual = f"{mod}.{fn.name}" if mod else fn.name
+                units.append((qual, fn, None))
+            elif isinstance(parent, ast.ClassDef) and \
+                    isinstance(ctx.parents.get(parent), ast.Module):
+                qual = f"{mod}.{parent.name}.{fn.name}" if mod \
+                    else f"{parent.name}.{fn.name}"
+                units.append((qual, fn, parent.name))
+    return units
+
+
+def enclosing_unit(ctx: "FileContext",
+                   node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """The call-graph unit ``node`` belongs to: ``(qualname, fn node)``
+    of the nearest module-level function / class method enclosing it,
+    or None at module level."""
+    index = ctx.cache.get("callgraph_unit_index")
+    if index is None:
+        index = ctx.cache["callgraph_unit_index"] = {
+            id(fn): (qual, fn) for qual, fn, _cls in _unit_functions(ctx)}
+    cur = ctx.enclosing_function(node)
+    while cur is not None:
+        hit = index.get(id(cur))
+        if hit is not None:
+            return hit
+        cur = ctx.enclosing_function(cur)
+    return None
+
+
+def _is_hotpath_root(ctx: "FileContext", fn: ast.AST,
+                     cls: Optional[str]) -> bool:
+    if (cls, fn.name) in HOTPATH_ROOT_METHODS:
+        return True
+    # the annotation may sit on the def line or the line above it
+    for ln in (fn.lineno, fn.lineno - 1):
+        if HOTPATH_MARK in ctx.source_line(ln):
+            return True
+    return False
+
+
+def _dispatch_scope(ctx: "FileContext") -> bool:
+    """True for files whose METHODS are legal suffix-edge targets: the
+    serving tree, files importing the serving plane or the transformer
+    step caches, and files declaring hot-path roots of their own.
+    Keeps `self.pool.free(...)`-style suffix edges from dragging a
+    generic method name in an unrelated plane onto the hot path."""
+    hit = ctx.cache.get("dispatch_scope")
+    if hit is None:
+        p = ctx.relpath.replace("\\", "/")
+        hit = "bigdl_tpu/serving/" in p
+        if not hit:
+            for node in ctx.by_type(ast.Import, ast.ImportFrom):
+                names = [a.name for a in node.names] \
+                    if isinstance(node, ast.Import) \
+                    else ([node.module] if node.module else [])
+                if any(m.startswith("bigdl_tpu.serving")
+                       or m.startswith("bigdl_tpu.models.transformer")
+                       for m in names):
+                    hit = True
+                    break
+        if not hit:
+            hit = any(_is_hotpath_root(ctx, fn, cls)
+                      for _q, fn, cls in _unit_functions(ctx))
+        ctx.cache["dispatch_scope"] = hit
+    return hit
+
+
+@register_fact_collector
+def _call_graph_facts(ctx: "FileContext") -> Dict[str, Any]:
+    """Per-file call-graph facts: ``call_edges`` (unit qual -> callee
+    entries, qualified or ``.suffix``), ``method_units`` (bare method
+    name -> quals, the suffix-edge index — dispatch-scope files only),
+    and ``hotpath_roots``."""
+    units = _unit_functions(ctx)
+    if not units:
+        return {}
+    mod = ctx.module
+    local_defs = {fn.name for fn in ctx.tree.body
+                  if isinstance(fn, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+    class_methods: Dict[str, Set[str]] = {}
+    for cls in ctx.by_type(ast.ClassDef):
+        class_methods[cls.name] = {
+            f.name for f in cls.body
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    edges: Dict[str, List[str]] = {}
+    methods: Dict[str, List[str]] = {}
+    roots: List[str] = []
+    in_scope = _dispatch_scope(ctx)
+    for qual, fn, cls in units:
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in local_defs:
+                    callees.add(f"{mod}.{f.id}" if mod else f.id)
+                else:
+                    q = ctx.qualname(f)
+                    if q:
+                        callees.add(q)
+            elif isinstance(f, ast.Attribute):
+                q = ctx.qualname(f)
+                if q:
+                    callees.add(q)
+                    continue
+                d = ctx.dotted(f)
+                if d and cls and d == f"self.{f.attr}" and \
+                        f.attr in class_methods.get(cls, ()):
+                    callees.add(f"{mod}.{cls}.{f.attr}" if mod
+                                else f"{cls}.{f.attr}")
+                else:
+                    callees.add("." + f.attr)
+        edges[qual] = sorted(callees)
+        if cls is not None and in_scope:
+            methods.setdefault(fn.name, []).append(qual)
+        if _is_hotpath_root(ctx, fn, cls):
+            roots.append(qual)
+    out: Dict[str, Any] = {"call_edges": edges}
+    if methods:
+        out["method_units"] = {k: sorted(v) for k, v in methods.items()}
+    if roots:
+        out["hotpath_roots"] = sorted(roots)
+    return out
+
+
+def hotpath_chains(facts: Dict[str, Any]) -> Dict[str, Tuple[str, ...]]:
+    """BFS the merged call-edge facts from the hot-path roots:
+    ``unit qual -> (root, ..., unit)`` — the shortest root chain — for
+    every REACHABLE unit. Qualified edges resolve exactly or by dotted
+    suffix (the SRV204 rule for sys.path-rooted spellings); ``.name``
+    suffix edges reach every dispatch-scope method of that name."""
+    edges: Dict[str, List[str]] = facts.get("call_edges") or {}
+    methods: Dict[str, List[str]] = facts.get("method_units") or {}
+    roots = list(facts.get("hotpath_roots") or [])
+    if not edges or not roots:
+        return {r: (r,) for r in roots}
+    by_tail: Dict[str, List[str]] = {}
+    for q in edges:
+        by_tail.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+    chains: Dict[str, Tuple[str, ...]] = {}
+    queue: List[Tuple[str, Tuple[str, ...]]] = [
+        (r, (r,)) for r in roots if r in edges]
+    while queue:
+        qual, chain = queue.pop(0)
+        if qual in chains:
+            continue
+        chains[qual] = chain
+        for callee in edges.get(qual, ()):
+            targets: List[str] = []
+            if callee.startswith("."):
+                targets = methods.get(callee[1:], [])
+            elif callee in edges:
+                targets = [callee]
+            else:
+                tail = callee.rsplit(".", 1)[-1]
+                targets = [q for q in by_tail.get(tail, ())
+                           if q.endswith("." + callee)
+                           or callee.endswith("." + q)]
+            for t in targets:
+                if t not in chains:
+                    queue.append((t, chain + (t,)))
+    return chains
+
+
 class ProjectContext:
     """Cross-module state for one analyzer run: every scanned file
     (host files AND their embedded units), the merged cross-module
@@ -662,20 +882,14 @@ def _parse_file(text: str, path: str
                        tree=tree), None
 
 
-def _run_rules(contexts: Sequence[FileContext],
-               parse_errors: Sequence[Finding],
-               select: Optional[Iterable[str]],
-               ignore: Optional[Iterable[str]]) -> List[Finding]:
-    """Phase two of every analysis: build the whole-program
-    :class:`ProjectContext` over all parsed files + their embedded
-    units, run the selected rules over each unit, sort, and
+def _check_contexts(all_ctx: Sequence[FileContext],
+                    parse_errors: Sequence[Finding],
+                    select: Optional[Iterable[str]],
+                    ignore: Optional[Iterable[str]]) -> List[Finding]:
+    """Run the selected rules over an already-WIRED project (host files
+    + embedded units sharing one :class:`ProjectContext`), sort, and
     occurrence-index duplicate (path, code, source) findings so each
     duplicated line needs its own baseline entry."""
-    all_ctx: List[FileContext] = []
-    for ctx in contexts:
-        all_ctx.append(ctx)
-        all_ctx.extend(extract_embedded_units(ctx))
-    ProjectContext(all_ctx)
     sel = set(select) if select else None
     ign = set(ignore) if ignore else set()
     out: List[Finding] = list(parse_errors)
@@ -687,6 +901,21 @@ def _run_rules(contexts: Sequence[FileContext],
                 continue
             out.extend(rule.check(ctx))
     return _finalize(out)
+
+
+def _run_rules(contexts: Sequence[FileContext],
+               parse_errors: Sequence[Finding],
+               select: Optional[Iterable[str]],
+               ignore: Optional[Iterable[str]]) -> List[Finding]:
+    """Phase two of every analysis: wire the whole-program
+    :class:`ProjectContext` over all parsed files + their embedded
+    units, then run the selected rules."""
+    all_ctx: List[FileContext] = []
+    for ctx in contexts:
+        all_ctx.append(ctx)
+        all_ctx.extend(extract_embedded_units(ctx))
+    ProjectContext(all_ctx)
+    return _check_contexts(all_ctx, parse_errors, select, ignore)
 
 
 def analyze_source(text: str, path: str = "<string>",
@@ -702,13 +931,13 @@ def analyze_source(text: str, path: str = "<string>",
     return _run_rules([ctx], [], select, ignore)
 
 
-def analyze_paths(paths: Sequence[str],
-                  select: Optional[Iterable[str]] = None,
-                  ignore: Optional[Iterable[str]] = None,
-                  exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
-                  ) -> List[Finding]:
-    """Walk ``paths`` (files and/or directories), parse everything,
-    build the whole-program project, and run the rules."""
+def load_project(paths: Sequence[str],
+                 exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+                 ) -> Tuple[List[FileContext], List[Finding]]:
+    """Parse ``paths`` into ONE wired :class:`ProjectContext`: every
+    host file plus its embedded units, with parse errors as findings.
+    The raw material for non-rule consumers — the sync-point inventory
+    (``--report sync-points``) walks these contexts directly."""
     contexts: List[FileContext] = []
     errors: List[Finding] = []
     for f in _iter_py_files(paths, exclude_dirs):
@@ -718,7 +947,23 @@ def analyze_paths(paths: Sequence[str],
             errors.append(err)
         else:
             contexts.append(ctx)
-    return _run_rules(contexts, errors, select, ignore)
+    all_ctx: List[FileContext] = []
+    for ctx in contexts:
+        all_ctx.append(ctx)
+        all_ctx.extend(extract_embedded_units(ctx))
+    ProjectContext(all_ctx)
+    return all_ctx, errors
+
+
+def analyze_paths(paths: Sequence[str],
+                  select: Optional[Iterable[str]] = None,
+                  ignore: Optional[Iterable[str]] = None,
+                  exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+                  ) -> List[Finding]:
+    """Walk ``paths`` (files and/or directories), parse everything,
+    build the whole-program project, and run the rules."""
+    all_ctx, errors = load_project(paths, exclude_dirs)
+    return _check_contexts(all_ctx, errors, select, ignore)
 
 
 # -- baseline --------------------------------------------------------------

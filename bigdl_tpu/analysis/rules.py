@@ -1,4 +1,4 @@
-"""The six SPMD hygiene rules.
+"""The analyzer's rule families.
 
 Every rule here encodes a bug class this repo has actually shipped (see
 docs/analysis.md for the war stories):
@@ -10,6 +10,10 @@ SPMD103     recompile hazards in/around jitted programs
 SPMD104     donated buffer reused after the donating call
 SPMD105     Python control flow on traced values
 SPMD106     shard_map specs naming axes the mesh does not have
+SRV201-205  serving contracts (whole-program fact table)
+ASY301-305  async readiness: host-sync hygiene on the HOT PATH, scoped
+            by call-graph reachability from the serving super-step
+            roots (core.hotpath_chains)
 ==========  ==============================================================
 
 All rules are import-resolution based, not textual: ``lax.pvary`` is
@@ -22,10 +26,11 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from bigdl_tpu.analysis.core import (
-    FileContext, Finding, Rule, _own_scope_nodes, register,
+    UNRESOLVED, FileContext, Finding, Rule, _own_scope_nodes,
+    _unit_functions, hotpath_chains, literal_value, register,
     register_fact_collector as _register_facts,
 )
 
@@ -1449,3 +1454,694 @@ class FinishReasonRule(Rule):
                     f"reason {arg.value!r} passed to {seg}() is not in "
                     f"ServingMetrics.FINISH_REASONS {sorted(vocab)}",
                     hint=self.hint)
+
+
+# ==========================================================================
+# The ASY3xx async-readiness family — HOT-PATH host-sync rules.
+#
+# The async dispatch-ahead refactor (ROADMAP "raw speed") needs the
+# super-step loop to stop forcing device→host syncs it never declared.
+# These rules machine-inventory every such sync: ASY301 implicit
+# readbacks, ASY302 raw block_until_ready / fence-vocabulary drift,
+# ASY303 host branches on un-fenced device values, ASY304 per-iteration
+# readback accumulation, ASY305 wall-clock pairs timing un-fenced
+# device work. All of them apply ONLY to functions reachable from the
+# serving plane's hot-path roots through the merged call-graph facts
+# (core.hotpath_chains) — benches, tests, and setup/teardown code are
+# exempt by REACHABILITY, not by path glob. The one idiom a deliberate
+# sync may wear is serving/fences.py (fence = one batched device_get,
+# fence_wait = block_until_ready for timers); the rules extract its
+# module + site vocabulary as facts, so the fence sites the async
+# refactor will move are born machine-checked.
+# ==========================================================================
+
+#: fallback fence-site vocabulary (single-file fixture runs): must
+#: match serving/fences.py FENCE_SITES
+_DEFAULT_FENCE_SITES = frozenset({"decode", "verify", "draft", "prefill"})
+#: host-crossing cast builtins (one positional arg = the readback shape)
+_READBACK_CASTS = frozenset({"float", "int", "bool"})
+#: numpy conversions that force a device value across (jnp.asarray is
+#: the host→device UPLOAD and deliberately absent)
+_NP_READBACK_QUALS = frozenset({"numpy.asarray", "numpy.array"})
+_DEVICE_GET_QUALS = frozenset({"jax.device_get"})
+_BLOCK_READY_NAME = "block_until_ready"
+#: wall-clock sources (plus any `*._clock()` callable attribute — the
+#: engine's injectable clock)
+_CLOCK_QUALS = frozenset({"time.time", "time.perf_counter",
+                          "time.monotonic", "time.process_time"})
+#: calls whose RESULT lives on device: the engine's fault-routing
+#: dispatcher and the pool's row slice; compiled-step attrs come from
+#: the SRV201 step_attrs fact, jax factories from their qualnames
+_DEVICE_CALL_SEGS = frozenset({"_dispatch", "read_row"})
+_DEVICE_FACTORY_PREFIXES = ("jax.numpy.", "jax.random.", "jax.lax.")
+
+
+@_register_facts
+def _fence_facts(ctx: FileContext) -> Dict:
+    """The declared fence-site vocabulary (``FENCE_SITES``) and the
+    module that declares it — ASY301/302's ground truth, extracted the
+    way SRV205 reads FINISH_REASONS."""
+    for node in ctx.by_type(ast.Assign):
+        if not any(isinstance(t, ast.Name) and t.id == "FENCE_SITES"
+                   for t in node.targets):
+            continue
+        val = literal_value(node.value)
+        if val is not UNRESOLVED:
+            return {"fence_sites": sorted(val),
+                    "fence_modules": [ctx.module]}
+    return {}
+
+
+def _is_fence_module(ctx: FileContext) -> bool:
+    """True for the file that DECLARES the fence idiom — the one module
+    allowed to spell device_get/block_until_ready raw (the compat-shim
+    pattern)."""
+    hit = ctx.cache.get("is_fence_module")
+    if hit is None:
+        hit = any(
+            isinstance(t, ast.Name) and t.id == "FENCE_SITES"
+            for node in ctx.by_type(ast.Assign) for t in node.targets)
+        ctx.cache["is_fence_module"] = hit
+    return hit
+
+
+def _fence_call_kind(ctx: FileContext,
+                     call: ast.Call) -> Optional[str]:
+    """``"fence"``/``"fence_wait"`` when ``call`` resolves to the
+    declared fence module's idiom (fallback when the fact is absent —
+    single-file runs: any module spelled ``...fences``)."""
+    q = ctx.qualname(call.func)
+    if not q:
+        return None
+    mod, _, name = q.rpartition(".")
+    if name not in ("fence", "fence_wait"):
+        return None
+    mods = _facts(ctx).get("fence_modules")
+    if mods:
+        if mod in mods or any(m.endswith("." + mod) or
+                              mod.endswith("." + m) for m in mods):
+            return name
+        return None
+    return name if mod.rsplit(".", 1)[-1] == "fences" else None
+
+
+def _fence_sites(ctx: FileContext) -> Set[str]:
+    sites = _facts(ctx).get("fence_sites")
+    return set(sites) if sites else set(_DEFAULT_FENCE_SITES)
+
+
+def _carry_seg(name: str) -> bool:
+    """Names/attributes that ARE pooled device state by the serving
+    plane's naming convention: ``carry``, ``dcarry``, ``draft_carry``,
+    ``resume_carry``, ``prefill_carry``, ``_zero_carry``..."""
+    return name.endswith("carry")
+
+
+def _step_attr_segs(ctx: FileContext) -> Set[str]:
+    segs = ctx.cache.get("asy_step_segs")
+    if segs is None:
+        segs = set(_facts(ctx).get("step_attrs", {}).keys())
+        ctx.cache["asy_step_segs"] = segs
+    return segs
+
+
+def _device_call(ctx: FileContext, call: ast.Call) -> bool:
+    """Calls whose result is a device value."""
+    f = call.func
+    if isinstance(f, (ast.Name, ast.Attribute)):
+        seg = _last_seg(ctx.dotted(f))
+        if seg in _DEVICE_CALL_SEGS or seg in _step_attr_segs(ctx):
+            return True
+    q = ctx.qualname(f)
+    return bool(q) and (q.startswith(_DEVICE_FACTORY_PREFIXES)
+                        or q == "jax.device_put")
+
+
+def _readback_kind(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """``"cast"``/``"item"``/``"np"``/``"device_get"`` when ``call`` is
+    a host-crossing readback OPERATION (taint of its argument decides
+    whether it is a finding)."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in _READBACK_CASTS \
+            and len(call.args) == 1 and not call.keywords:
+        return "cast"
+    if isinstance(f, ast.Attribute) and f.attr == "item" \
+            and not call.args:
+        return "item"
+    q = ctx.qualname(f)
+    if q in _NP_READBACK_QUALS:
+        return "np"
+    if q in _DEVICE_GET_QUALS:
+        return "device_get"
+    return None
+
+
+def _taint_use(ctx: FileContext, expr: ast.AST,
+               tainted: Set[str]) -> Optional[ast.AST]:
+    """First DYNAMIC use of a device value in ``expr``: a tainted name,
+    a carry-suffixed name/attribute, or a device-producing call. Static
+    accessors (``x.shape``, ``len``, ``is None``) never count, and
+    fence/readback calls are boundaries — their results are host
+    values, judged at their own call sites."""
+    out: List[ast.AST] = []
+
+    def visit(node: ast.AST, static: bool) -> None:
+        if out:
+            return
+        if isinstance(node, ast.Name):
+            if not static and (node.id in tainted or _carry_seg(node.id)):
+                out.append(node)
+            return
+        if isinstance(node, ast.Attribute):
+            if not static and _carry_seg(node.attr):
+                out.append(node)
+                return
+            visit(node.value, static or node.attr in _STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Call):
+            if _fence_call_kind(ctx, node) or _readback_kind(ctx, node):
+                return
+            if _device_call(ctx, node):
+                if not static:
+                    out.append(node)
+                return
+            fname = node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            inner_static = static or fname in _STATIC_CALLS
+            for child in list(node.args) + \
+                    [kw.value for kw in node.keywords]:
+                visit(child, inner_static)
+            if not isinstance(node.func, ast.Name):
+                visit(node.func, static)
+            return
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                for child in [node.left] + list(node.comparators):
+                    visit(child, True)
+                return
+            if all(isinstance(op, (ast.In, ast.NotIn))
+                   for op in node.ops):
+                # key membership ("rng" in carry) inspects the carry
+                # DICT's structure on host — never a device sync; only
+                # the probed value itself can be one
+                visit(node.left, static)
+                for child in node.comparators:
+                    visit(child, True)
+                return
+        for child in ast.iter_child_nodes(node):
+            visit(child, static)
+
+    visit(expr, False)
+    return out[0] if out else None
+
+
+def _hot_chains(ctx: FileContext) -> Dict[str, Tuple[str, ...]]:
+    """unit qual -> root chain, for every unit reachable from a
+    hot-path root (project-memoized — one BFS per analyzer run)."""
+    proj = ctx.project
+    if proj is not None:
+        hit = proj.cache.get("hotpath_chains")
+        if hit is None:
+            hit = proj.cache["hotpath_chains"] = hotpath_chains(
+                proj.facts)
+        return hit
+    return hotpath_chains(_facts(ctx))
+
+
+class _AsyScan:
+    """One shared pass over a hot unit: the device-taint timeline, the
+    readback/fence/dispatch/clock inventories, and the loop-accumulation
+    claims — every ASY rule reads this instead of re-walking."""
+
+    def __init__(self, ctx: FileContext, fn: ast.AST) -> None:
+        self.ctx = ctx
+        self.fn = fn
+        #: name -> [(line, tainted_bool)] in line order
+        self.events: Dict[str, List[Tuple[int, bool]]] = {}
+        #: lines of super-step device dispatches (_dispatch / step attrs)
+        self.dispatch_lines: List[int] = []
+        #: lines where the pending device work is SYNCED (fences,
+        #: block_until_ready, readbacks of tainted values)
+        self.sync_lines: List[int] = []
+        #: (call node, kind, site literal or None) for fence idiom calls
+        self.fences: List[Tuple[ast.Call, str, Optional[str]]] = []
+        #: (call node, kind, offending use) readback candidates
+        self.readbacks: List[Tuple[ast.Call, str, Optional[ast.AST]]] = []
+        #: block_until_ready call nodes
+        self.blocks: List[ast.AST] = []
+        #: clock-call assignment targets: name -> [assign lines]
+        self.clock_assigns: Dict[str, List[int]] = {}
+        #: loads of clock targets: (node, name, line)
+        self.clock_loads: List[Tuple[ast.AST, str, int]] = []
+        #: node ids of readbacks claimed by loop accumulation (ASY304)
+        self.accum_claimed: Set[int] = set()
+        #: (accumulation node, inner readback call) ASY304 findings
+        self.accumulations: List[Tuple[ast.AST, ast.Call]] = []
+        self._build()
+
+    # -- taint timeline -----------------------------------------------------
+
+    def tainted_at(self, line: int) -> Set[str]:
+        out: Set[str] = set()
+        for name, evs in self.events.items():
+            state = False
+            for ln, val in evs:
+                if ln > line:
+                    break
+                state = val
+            if state:
+                out.add(name)
+        return out
+
+    def _target_names(self, target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in target.elts:
+                out.extend(self._target_names(e))
+            return out
+        return []
+
+    def _build(self) -> None:
+        ctx = self.ctx
+        cur: Set[str] = set()
+
+        def mark(names: List[str], line: int, val: bool) -> None:
+            for n in names:
+                if val:
+                    cur.add(n)
+                elif n in cur:
+                    cur.discard(n)
+                else:
+                    continue
+                self.events.setdefault(n, []).append((line, val))
+
+        stmts = sorted(
+            (n for n in ast.walk(self.fn)
+             if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                               ast.For, ast.Call, ast.Name, ast.If,
+                               ast.While))),
+            key=lambda n: (getattr(n, "lineno", 0),
+                           getattr(n, "col_offset", 0)))
+        clock_targets: Set[str] = set()
+        for node in stmts:
+            line = getattr(node, "lineno", 0)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if value is None:
+                    continue
+                # elementwise tuple unpacking: `best, n = node, i + m`
+                # must not smear one element's taint onto the others
+                if len(targets) == 1 and \
+                        isinstance(targets[0], (ast.Tuple, ast.List)) and \
+                        isinstance(value, (ast.Tuple, ast.List)) and \
+                        len(targets[0].elts) == len(value.elts):
+                    for t, v in zip(targets[0].elts, value.elts):
+                        mark(self._target_names(t), line,
+                             bool(_taint_use(ctx, v, cur)))
+                    continue
+                names = []
+                for t in targets:
+                    names.extend(self._target_names(t))
+                if isinstance(value, ast.Call):
+                    kind = _fence_call_kind(ctx, value)
+                    if kind == "fence":
+                        mark(names, line, False)     # host copies
+                        continue
+                    if kind == "fence_wait":
+                        # same (device) tree back — taint passes through
+                        mark(names, line, bool(
+                            any(_taint_use(ctx, a, cur)
+                                for a in value.args)))
+                        continue
+                    if _readback_kind(ctx, value):
+                        mark(names, line, False)     # host value now
+                        continue
+                    if self._is_clock_call(value) and len(names) == 1:
+                        self.clock_assigns.setdefault(
+                            names[0], []).append(line)
+                        clock_targets.add(names[0])
+                        continue
+                mark(names, line, bool(_taint_use(ctx, value, cur)))
+            elif isinstance(node, ast.AugAssign):
+                names = self._target_names(node.target)
+                if _taint_use(ctx, node.value, cur):
+                    mark(names, line, True)
+            elif isinstance(node, ast.For):
+                names = self._target_names(node.target)
+                mark(names, line, bool(_taint_use(ctx, node.iter, cur)))
+            elif isinstance(node, ast.Name):
+                if isinstance(getattr(node, "ctx", None), ast.Load) and \
+                        node.id in clock_targets:
+                    self.clock_loads.append((node, node.id, node.lineno))
+
+        # second pass: calls (dispatches, fences, readbacks, blocks)
+        for node in ast.walk(self.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            line = node.lineno
+            kind = _fence_call_kind(ctx, node)
+            if kind:
+                site = None
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    site = node.args[0].value
+                self.fences.append((node, kind, site))
+                self.sync_lines.append(line)
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr == _BLOCK_READY_NAME:
+                self.blocks.append(node)
+                self.sync_lines.append(line)
+                continue
+            q = ctx.qualname(f)
+            if q == f"jax.{_BLOCK_READY_NAME}":
+                self.blocks.append(node)
+                self.sync_lines.append(line)
+                continue
+            rb = _readback_kind(ctx, node)
+            if rb:
+                tainted = self.tainted_at(line)
+                if rb == "device_get":
+                    self.readbacks.append((node, rb, node))
+                    self.sync_lines.append(line)
+                    continue
+                src = node.func.value if rb == "item" else node.args[0]
+                off = _taint_use(ctx, src, tainted)
+                if off is not None:
+                    self.readbacks.append((node, rb, off))
+                    self.sync_lines.append(line)
+                continue
+            if isinstance(f, (ast.Name, ast.Attribute)):
+                seg = _last_seg(ctx.dotted(f))
+                if seg in _DEVICE_CALL_SEGS - {"read_row"} or \
+                        seg in _step_attr_segs(ctx):
+                    self.dispatch_lines.append(line)
+
+        # third pass: loop accumulation of readbacks (ASY304 claims)
+        rb_by_id = {id(n): (n, k, o) for n, k, o in self.readbacks}
+        for loop in (n for n in ast.walk(self.fn)
+                     if isinstance(n, (ast.For, ast.While))):
+            for node in ast.walk(loop):
+                value = None
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("append", "extend") and \
+                        len(node.args) == 1:
+                    value = node.args[0]
+                elif isinstance(node, ast.AugAssign):
+                    value = node.value
+                if value is None:
+                    continue
+                for sub in ast.walk(value):
+                    hit = rb_by_id.get(id(sub))
+                    if hit is not None and id(sub) not in \
+                            self.accum_claimed:
+                        self.accum_claimed.add(id(sub))
+                        self.accumulations.append((node, hit[0]))
+                        break
+
+    def _is_clock_call(self, call: ast.Call) -> bool:
+        q = self.ctx.qualname(call.func)
+        if q in _CLOCK_QUALS:
+            return True
+        seg = _last_seg(self.ctx.dotted(call.func))
+        return seg == "_clock" and not call.args
+
+
+def _asy_scan(ctx: FileContext, fn: ast.AST) -> _AsyScan:
+    key = ("asy_scan", id(fn))
+    hit = ctx.cache.get(key)
+    if hit is None:
+        hit = ctx.cache[key] = _AsyScan(ctx, fn)
+    return hit
+
+
+def _hot_units(ctx: FileContext):
+    """(qual, fn, chain) for this file's hot-path-reachable units."""
+    if _is_fence_module(ctx):
+        return
+    chains = _hot_chains(ctx)
+    if not chains:
+        return
+    for qual, fn, _cls in _unit_functions(ctx):
+        chain = chains.get(qual)
+        if chain is not None:
+            yield qual, fn, chain
+
+
+# -- ASY301 — implicit device→host readback on the hot path ----------------
+
+@register
+class HotReadbackRule(Rule):
+    code = "ASY301"
+    name = "hot-readback"
+    summary = ("implicit device→host readback (.item/float/int/bool/"
+               "np.asarray/device_get) on a hot-path-reachable function")
+    hint = ("every device→host crossing on the super-step hot path "
+            "must wear the fence idiom — "
+            "`fence(\"<site>\", *values)` (serving/fences.py) does ONE "
+            "batched jax.device_get and returns host arrays, so "
+            "downstream bookkeeping never syncs again. Batch several "
+            "small readbacks into one fence; cold code (benches, "
+            "tests, setup) is exempt by call-graph reachability")
+
+    _KINDS = {"cast": "host cast", "item": ".item()",
+              "np": "np.asarray/np.array",
+              "device_get": "raw jax.device_get"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            for node, kind, off in scan.readbacks:
+                if id(node) in scan.accum_claimed:
+                    continue                    # ASY304 owns it
+                what = ast.unparse(off)[:40] if off is not None else ""
+                yield ctx.finding(
+                    node, self.code,
+                    f"{self._KINDS[kind]} readback of device value "
+                    f"`{what}` in `{qual}` — hot-path-reachable "
+                    f"(via {' -> '.join(chain)})",
+                    hint=self.hint)
+
+
+# -- ASY302 — block_until_ready / fence vocabulary drift -------------------
+
+@register
+class UnfencedBlockRule(Rule):
+    code = "ASY302"
+    name = "unfenced-block"
+    summary = ("block_until_ready outside the declared fence module, "
+               "or a fence site string outside FENCE_SITES, on the "
+               "hot path")
+    hint = ("deliberate completion waits wear the fence idiom: "
+            "`fence_wait(\"<site>\", tree)` (serving/fences.py) is the "
+            "ONE designated home of block_until_ready, and its site "
+            "vocabulary is CLOSED (FENCE_SITES) so the async refactor "
+            "can enumerate every sync point it must move. Add new "
+            "sites to FENCE_SITES first")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        sites = _fence_sites(ctx)
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            for node in scan.blocks:
+                yield ctx.finding(
+                    node, self.code,
+                    f"raw block_until_ready in `{qual}` — hot-path-"
+                    f"reachable (via {' -> '.join(chain)}) and outside "
+                    f"the declared fence module",
+                    hint=self.hint)
+            for node, kind, site in scan.fences:
+                if site is not None and site not in sites:
+                    yield ctx.finding(
+                        node, self.code,
+                        f"{kind} site {site!r} is not in the declared "
+                        f"FENCE_SITES vocabulary {sorted(sites)}",
+                        hint=self.hint)
+
+
+# -- ASY303 — host control flow on un-fenced device values ------------------
+
+@register
+class LoopBranchSyncRule(Rule):
+    code = "ASY303"
+    name = "hot-branch-sync"
+    summary = ("Python branch (if/while/ternary/assert) on an un-fenced "
+               "device value in a hot-path-reachable function")
+    hint = ("a Python branch needs a concrete bool, so it SYNCS the "
+            "host on the whole pending device pipeline — exactly the "
+            "stall the async dispatch-ahead loop must not pay. Branch "
+            "on values from a declared `fence(...)` readback (host "
+            "arrays), keep pure host mirrors (KVPool.chunk_done), or "
+            "move the decision on-device (lax.cond/jnp.where)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            seen: Set[Tuple[int, int]] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp,
+                                         ast.Assert)):
+                    continue
+                off = _taint_use(ctx, node.test,
+                                 scan.tainted_at(node.lineno))
+                if off is None:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression",
+                        ast.Assert: "assert"}[type(node)]
+                yield ctx.finding(
+                    node, self.code,
+                    f"`{kind}` on un-fenced device value "
+                    f"`{ast.unparse(off)[:40]}` in `{qual}` — forces a "
+                    f"host sync before the next dispatch "
+                    f"(hot via {' -> '.join(chain)})",
+                    hint=self.hint)
+
+
+# -- ASY304 — per-iteration readback accumulation ---------------------------
+
+@register
+class ReadbackAccumulationRule(Rule):
+    code = "ASY304"
+    name = "readback-accumulation"
+    summary = ("append/+= of a per-iteration device readback inside a "
+               "hot-path loop — one host sync per iteration")
+    hint = ("accumulating readbacks item by item syncs the device "
+            "EVERY iteration; batch them — keep the loop on device "
+            "values (accumulating device handles is free) and cross to "
+            "host ONCE per step through a single `fence(...)` of the "
+            "small results, then do the host bookkeeping between "
+            "fences")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            for node, rb in scan.accumulations:
+                yield ctx.finding(
+                    node, self.code,
+                    f"per-iteration readback "
+                    f"`{ast.unparse(rb)[:48]}` accumulated inside a "
+                    f"loop in `{qual}` (hot via "
+                    f"{' -> '.join(chain)}) — one device sync per "
+                    f"iteration",
+                    hint=self.hint)
+
+
+# -- ASY305 — wall-clock reads straddling un-fenced device work -------------
+
+@register
+class ClockStraddleRule(Rule):
+    code = "ASY305"
+    name = "clock-straddle"
+    summary = ("clock-read pair timing a device dispatch with no fence "
+               "between dispatch and the second read — the measured "
+               "time is launch latency, not work")
+    hint = ("under async dispatch the host clock keeps running while "
+            "the device works, so `t1 - t0` around an un-synced "
+            "dispatch measures only the LAUNCH — decode_gap_s, phase "
+            "timers, and the watchdog all lie. Pin the timer to a "
+            "fence: `fence_wait(\"<site>\", out)` (or consume the "
+            "step's `fence(...)` readback) before reading the clock "
+            "again")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            if not scan.dispatch_lines:
+                continue
+            for name, assigns in scan.clock_assigns.items():
+                for i, a_line in enumerate(assigns):
+                    next_assign = assigns[i + 1] if i + 1 < len(assigns) \
+                        else float("inf")
+                    loads = sorted(
+                        ((node, ln) for node, n, ln in scan.clock_loads
+                         if n == name and a_line < ln < next_assign),
+                        key=lambda t: t[1])
+                    for node, ln in loads:
+                        bad = any(
+                            a_line < d < ln and not any(
+                                d < s <= ln for s in scan.sync_lines)
+                            for d in scan.dispatch_lines)
+                        if bad:
+                            yield ctx.finding(
+                                node, self.code,
+                                f"clock pair `{name}` (set line "
+                                f"{a_line}) read here straddles an "
+                                f"un-fenced device dispatch in "
+                                f"`{qual}` (hot via "
+                                f"{' -> '.join(chain)}) — the elapsed "
+                                f"time measures the launch, not the "
+                                f"work",
+                                hint=self.hint)
+                            break
+
+
+# -- the sync-point inventory (--report sync-points) ------------------------
+
+_ASY_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305")
+
+
+def sync_point_inventory(contexts: Sequence[FileContext]) -> List[dict]:
+    """The async-refactor worksheet: every DECLARED sync (fence /
+    fence_wait call) and every ASY finding on a hot-path-reachable
+    unit, each with its root chain — what ``python -m bigdl_tpu.
+    analysis --report sync-points`` prints. Suppressed findings
+    (``# analysis: ok``) are listed with ``suppressed: true`` rather
+    than hidden: the inventory is for reading, not gating."""
+    from bigdl_tpu.analysis.core import _SUPPRESS_RE
+
+    asy_rules = [r for r in all_rules_registry() if r.code in _ASY_CODES]
+    out: List[dict] = []
+    for ctx in contexts:
+        if _is_fence_module(ctx):
+            continue
+        sites = _fence_sites(ctx)
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            for node, kind, site in scan.fences:
+                if site is not None and site not in sites:
+                    continue        # vocabulary drift: listed as ASY302
+                out.append({
+                    "path": ctx.relpath,
+                    "line": node.lineno + ctx.line_base,
+                    "function": qual,
+                    "chain": list(chain),
+                    "kind": f"{kind}:{site or '?'}",
+                    "classification": "declared sync point",
+                    "detail": ctx.source_line(node.lineno),
+                    "suggestion": (
+                        "one batched device_get readback"
+                        if kind == "fence" else
+                        "completion wait (timer pin)"),
+                    "suppressed": False,
+                })
+        for rule in asy_rules:
+            for f in rule.check(ctx):
+                out.append({
+                    "path": f.path, "line": f.line,
+                    "function": "", "chain": [],
+                    "kind": f.code,
+                    "classification": f.message,
+                    "detail": f.source,
+                    "suggestion": rule.hint,
+                    "suppressed": bool(_SUPPRESS_RE.search(f.source)),
+                })
+    out.sort(key=lambda e: (e["path"], e["line"], e["kind"]))
+    return out
+
+
+def all_rules_registry():
+    from bigdl_tpu.analysis.core import all_rules
+
+    return all_rules()

@@ -43,6 +43,7 @@ from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import (
     FaultError, FaultInjector, VirtualClock, WatchdogConfig,
 )
+from bigdl_tpu.serving.fences import FENCE_SITES, fence, fence_wait
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.prefix_cache import PrefixCache
@@ -59,4 +60,5 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "SamplingParams", "SpeculativeConfig", "bucket_len",
            "ShardedEngine", "ShardedKVPool", "make_mesh",
            "emulate_cpu_devices", "Degrade", "FaultError",
-           "FaultInjector", "VirtualClock", "WatchdogConfig"]
+           "FaultInjector", "VirtualClock", "WatchdogConfig",
+           "FENCE_SITES", "fence", "fence_wait"]

@@ -55,6 +55,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from bigdl_tpu.serving.fences import fence_wait
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 
 
@@ -271,6 +272,9 @@ class AdmissionController:
                 "prefill", eng._batch_prefill_fn, eng.params,
                 jnp.asarray(toks), np.asarray([S], np.int32), carry)
             eng.metrics.on_prefill_batch(1, 1)
+            # completion fence before the finally-block timer read
+            # (ASY305): the phase measures the prefill, not its launch
+            out = fence_wait("prefill", out)
             eng.pool.write_prefill(slot, out, len(pf))
             self.prefix_cache.insert(pf, out)
             return True
@@ -298,6 +302,9 @@ class AdmissionController:
                                eng.params, jnp.asarray(toks), lengths,
                                self._zero_carry())
         eng.metrics.on_prefill_batch(k, B)
+        # completion fence before the timer read (ASY305): the phase
+        # measures the bucket's prefill, not its launch
+        out = fence_wait("prefill", out)
         for j, (_, slot, pf) in enumerate(rows):
             eng.pool.write_prefill(slot, out, len(pf), row=j)
             if self.prefix_cache is not None:

@@ -73,6 +73,7 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 from bigdl_tpu.serving.admission import AdmissionController, bucket_len
+from bigdl_tpu.serving.fences import fence_wait
 from bigdl_tpu.serving.scheduler import Request
 
 
@@ -213,6 +214,11 @@ class ChunkedAdmissionController(AdmissionController):
                                eng.params, jnp.asarray(toks),
                                np.asarray([n], np.int32), row)
         eng.metrics.on_prefill_batch(1, 1)
+        # completion fence before the timer read (ASY305): the chunk
+        # phase measures the prefill, not its launch — and the fence is
+        # the site the async refactor will move to overlap chunks with
+        # the decode step (docs/async_readiness.md)
+        out = fence_wait("prefill", out)
         eng.pool.write_prefill(slot, out, done + n)
         if done + n == len(pf) and self.prefix_cache is not None:
             self.prefix_cache.insert(pf, out)

@@ -71,6 +71,7 @@ import numpy as np
 from bigdl_tpu.serving.faults import (
     FaultError, WatchdogConfig, default_clock,
 )
+from bigdl_tpu.serving.fences import fence, fence_wait
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.sampling import (
@@ -696,7 +697,10 @@ class ServingEngine:
             except FaultError:
                 self._recover_admission([(slot, req)])
                 continue
-            self.pool.write_prefill(slot, pc, len(pf))
+            # completion fence before the timer read: without it the
+            # phase measures the LAUNCH, not the prefill (ASY305)
+            self.pool.write_prefill(slot, fence_wait("prefill", pc),
+                                    len(pf))
             self.metrics.add_phase("prefill", self._clock() - t0)
         self._note_shard_balance()
 
@@ -954,6 +958,18 @@ class ServingEngine:
                 self._knobs["ban"][slot] = ban
                 self._knobs_device = None
 
+    def _note_host_step(self, t_begin: float, device_before: float) -> None:
+        """Record the per-super-step HOST share: the step's wall time
+        minus the device phase windows timed inside it (decode/verify
+        dispatch, draft chain, prefill chunks). This is the Python the
+        device waits on between dispatches — the number the async
+        dispatch-ahead refactor exists to shrink (``serving/
+        host_step_s``; percentiles in ``summary()``), measured on the
+        engine's clock like every other serving timer."""
+        dev = self.metrics.device_seconds - device_before
+        self.metrics.add_phase(
+            "host_step", max(0.0, (self._clock() - t_begin) - dev))
+
     def _note_decode_gap(self, had_running: bool) -> None:
         """Record the wall gap between consecutive decode (or verify)
         dispatch completions while rows stayed in flight across it —
@@ -976,6 +992,21 @@ class ServingEngine:
         emitted this step (the LAST emitted token per request when a
         super-step lands several; empty when the engine is idle or
         every slot-holding row is still mid-prefill)."""
+        t_step = self._clock()
+        dev0 = self.metrics.device_seconds
+        ndec0 = self.metrics.decode_step_count
+        try:
+            return self._step_impl()
+        finally:
+            # exactly one host/device split sample per decode/verify
+            # dispatch sample — recovery paths included (a recovered
+            # step's discarded outputs still cost real host time), so
+            # the host_step_s and decode_step_s series stay comparable
+            # sample for sample
+            if self.metrics.decode_step_count > ndec0:
+                self._note_host_step(t_step, dev0)
+
+    def _step_impl(self) -> Dict[int, int]:
         import jax.numpy as jnp
 
         had_running = bool(self.scheduler.running)
@@ -1041,11 +1072,13 @@ class ServingEngine:
             return {}
         self.pool.carry = carry
         # the (N, V) distribution never crosses to host — sampling is
-        # fused into the step; only token ids + chosen log-probs do
-        # (the readback also syncs the dispatch, so the watchdog's
-        # elapsed time covers the device work, not just the launch)
-        nxt = np.asarray(tok)
-        lps = np.asarray(chosen)
+        # fused into the step; only token ids + chosen log-probs do,
+        # through ONE batched fence readback (THE declared per-step
+        # sync point — serving/fences.py; one device_get of the pair
+        # instead of two np.asarray round-trips, and it syncs the
+        # dispatch so the watchdog's elapsed time covers the device
+        # work, not just the launch)
+        nxt, lps = fence("decode", tok, chosen)
         elapsed = self._clock() - t0
         self.metrics.add_phase("decode_step", elapsed)
         bad = self._step_unhealthy(nxt, lps, active)

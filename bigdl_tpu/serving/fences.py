@@ -1,0 +1,82 @@
+"""Declared device→host synchronization points for the serving plane.
+
+The async dispatch-ahead refactor (ROADMAP "raw speed" item) lives or
+dies on ONE discipline: the super-step loop must never force a device
+sync it did not declare. jax dispatches asynchronously — the host is
+free to queue the next chunk prefill or draft chain while the decode
+step runs on device — until something reads a device value back
+(``np.asarray``, ``float()``, ``.item()``, a Python branch on an
+array), at which point the host silently stalls on the whole pending
+pipeline. Those implicit syncs are exactly what the ASY3xx analyzer
+rules inventory (docs/analysis.md); this module is the other half of
+the contract — the ONE idiom a deliberate sync is allowed to wear, so
+every host-crossing in the hot path is named, machine-checked, and
+enumerable (``python -m bigdl_tpu.analysis --report sync-points``).
+
+Two idioms, both over a CLOSED site vocabulary (:data:`FENCE_SITES`,
+the ``FINISH_REASONS`` pattern — an unknown site raises here and the
+analyzer's ASY302 flags it statically):
+
+* :func:`fence` — the READBACK fence: one batched ``jax.device_get``
+  of several small values (the per-step token/logprob/emit-count
+  readback). Batching matters: N separate ``np.asarray`` calls are N
+  host round-trips; one ``device_get`` of the tuple is one. The
+  returned values are host ``np.ndarray``s — everything downstream is
+  plain Python and never syncs again.
+* :func:`fence_wait` — the COMPLETION fence: ``jax.block_until_ready``
+  on a tree, no copy. This is what a *timer* needs — a phase timing
+  read off the clock before the dispatched work finished measures
+  launch latency, not work (the lie ASY305 flags) — and the designated
+  home of ``block_until_ready`` (ASY302 flags the raw spelling on any
+  hot-path-reachable function outside this module).
+
+The async refactor's job is then mechanical: every ``fence``/
+``fence_wait`` site in the sync-point inventory is a place the loop
+currently stops; moving one later (a delayed consumer) or deleting one
+(batched host bookkeeping) is a reviewable one-line diff the analyzer
+keeps honest.
+"""
+
+from __future__ import annotations
+
+#: THE closed fence-site vocabulary. Every deliberate device→host sync
+#: in the serving plane names one of these; the analyzer extracts this
+#: frozenset (cross-module) and ASY302 flags both unknown site strings
+#: and ``block_until_ready`` spelled outside this module.
+FENCE_SITES = frozenset({
+    "decode",    # the per-step token/logprob readback (engine.step)
+    "verify",    # the speculative super-step's verify readback
+    "draft",     # completion of the chained draft dispatches (timing)
+    "prefill",   # completion of a prefill/chunk dispatch (timing)
+})
+
+
+def _check_site(site: str) -> None:
+    if site not in FENCE_SITES:
+        raise ValueError(
+            f"unknown fence site {site!r} — add it to "
+            f"fences.FENCE_SITES first; known: {sorted(FENCE_SITES)}")
+
+
+def fence(site: str, *values):
+    """THE declared readback: one batched ``jax.device_get`` of
+    ``values``, returning host ``np.ndarray``s (a single value comes
+    back bare, several as a tuple). The one place per super-step the
+    host is ALLOWED to wait on the device — downstream bookkeeping
+    runs on the returned host arrays and never syncs again."""
+    import jax
+
+    _check_site(site)
+    out = jax.device_get(tuple(values))
+    return out[0] if len(out) == 1 else out
+
+
+def fence_wait(site: str, tree):
+    """THE declared completion wait: ``jax.block_until_ready`` on
+    ``tree`` (returned unchanged, still on device — no copy). Timers
+    bracket device work with this so the elapsed time measures the
+    work, not the launch."""
+    import jax
+
+    _check_site(site)
+    return jax.block_until_ready(tree)

@@ -30,6 +30,12 @@ Chunked-admission counters (``serving/chunked.py``):
   admission burst shows up as one huge gap; chunked bounds it by the
   chunk budget). ``decode_gap_percentiles()`` summarizes;
   ``summary()`` reports the p99
+* ``host_step_s``      — per-super-step HOST time: step wall minus the
+  fenced device phase windows (decode/verify dispatch, draft chain,
+  prefill chunks) timed inside it — the Python the device pipeline
+  waits on between dispatches, i.e. the async dispatch-ahead
+  refactor's before-number (``host_step_percentiles()``; ``summary()``
+  reports p50/p99)
 
 Feasibility admission control (``ServingEngine(deadline_feasibility=
 True)``):
@@ -161,6 +167,15 @@ class ServingMetrics:
         # Metrics sample lists would be O(lifetime) per call
         self._spec_acc = 0.0
         self._spec_rows = 0.0
+        # running sum of the DEVICE phase windows (decode/verify
+        # dispatch, draft chain, prefills): the engine's per-step
+        # host-vs-device split subtracts this across a step
+        # (serving/host_step_s — the async refactor's before-number),
+        # plus the decode/verify SAMPLE COUNT so the engine can pair
+        # one host_step sample with every decode_step sample — on
+        # recovery paths too — without re-summing the backing lists
+        self._device_s = 0.0
+        self._n_decode_steps = 0
 
     # -- engine hooks ------------------------------------------------------
 
@@ -380,12 +395,41 @@ class ServingMetrics:
             self.metrics.add("serving/prefix_hit_tokens",
                              float(matched_tokens))
 
+    #: phases timed around fenced DEVICE work — everything else a step
+    #: spends is host Python (scheduling, admission bookkeeping,
+    #: per-token accounting)
+    DEVICE_PHASES = frozenset({"decode_step", "draft", "draft_prefill",
+                               "prefill"})
+
     def add_phase(self, name: str, seconds: float) -> None:
         self.metrics.add(f"serving/{name}_s", float(seconds))
         if name == "decode_step":
             self._step_window.append(float(seconds))
+            self._n_decode_steps += 1
         elif name == "draft":
             self._draft_window.append(float(seconds))
+        if name in self.DEVICE_PHASES:
+            self._device_s += float(seconds)
+
+    @property
+    def device_seconds(self) -> float:
+        """Lifetime sum of the device phase windows (the fenced
+        dispatch timings) — the engine snapshots this around a step to
+        derive ``serving/host_step_s``."""
+        return self._device_s
+
+    @property
+    def decode_step_count(self) -> int:
+        """Lifetime count of decode/verify dispatch samples — the
+        engine pairs exactly one ``host_step_s`` sample with each (a
+        recovered step's discarded outputs still cost real host time),
+        so the split series stay comparable sample for sample."""
+        return self._n_decode_steps
+
+    def host_step_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        """Percentiles of the per-step host-side time (seconds) — the
+        Python the device pipeline waits on between dispatches."""
+        return self._pctl("host_step_s", qs)
 
     # -- derived views -----------------------------------------------------
 
@@ -457,6 +501,11 @@ class ServingMetrics:
         if n_gap:
             out["serving/decode_gap_p99_s"] = \
                 self.decode_gap_percentiles()["p99"]
+        _, n_host = self.metrics.get("serving/host_step_s")
+        if n_host:
+            hp = self.host_step_percentiles()
+            out["serving/host_step_p50_s"] = hp["p50"]
+            out["serving/host_step_p99_s"] = hp["p99"]
         for k, v in self.ttft_percentiles().items():
             out[f"serving/ttft_{k}_s"] = v
         return out

@@ -84,6 +84,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from bigdl_tpu.serving.admission import bucket_len
+from bigdl_tpu.serving.fences import fence, fence_wait
 
 
 @dataclass(frozen=True)
@@ -210,7 +211,10 @@ class Speculator:
             "prefill", self._draft_prefill_fn,
             self._draft_params, jnp.asarray(toks),
             np.asarray([len(pf)], np.int32), self._zero_draft1)
-        eng.pool.write_draft_prefill(slot, dc, len(pf))
+        # completion fence before the timer read (ASY305): the phase
+        # must measure the draft prefill, not its launch
+        eng.pool.write_draft_prefill(slot, fence_wait("prefill", dc),
+                                     len(pf))
         eng.metrics.add_phase("draft_prefill", eng._clock() - t0)
 
     # -- the super-step ------------------------------------------------------
@@ -330,6 +334,11 @@ class Speculator:
             return {}
         while len(drafts) < self.k:
             drafts.append(eng._place_rows(jnp.zeros((N,), jnp.int32)))
+        # completion fence pinning the draft timer: u is the chain's
+        # last output, so waiting on it waits on every draft dispatch —
+        # no copy, and the drafts themselves STAY on device for the
+        # verify step (the async-friendly half of the super-step)
+        fence_wait("draft", u)
         eng.metrics.add_phase("draft", eng._clock() - t0)
 
         # verify: ONE fixed-width target dispatch for the whole fleet
@@ -348,9 +357,10 @@ class Speculator:
             eng._recover_step(running, "fail")
             return {}
         eng.pool.carry = carry
-        nxt = np.asarray(vt)
-        lps = np.asarray(vlp)
-        nem = np.asarray(n_emit)
+        # ONE batched fence readback for the whole verify result —
+        # tokens, log-probs, emit counts cross to host together
+        # (serving/fences.py) instead of as three separate syncs
+        nxt, lps, nem = fence("verify", vt, vlp, n_emit)
         eng.metrics.add_phase("decode_step", eng._clock() - t0)
         bad = self._chunk_unhealthy(nxt, lps, nem, lengths, active)
         if bad is None and eng._timed_out(eng._clock() - t_start):

@@ -1,0 +1,64 @@
+"""ASY305 clock-straddle: wall-clock read pairs timing a device
+dispatch with NO fence between the dispatch and the second read —
+under async dispatch the elapsed value measures launch latency, not
+device work, so phase timers / decode-gap instrumentation / the
+watchdog all lie.  Fence-pinned timers, dispatch-free pairs, and the
+cold twin are the false-positive guards."""
+
+import time
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence, fence_wait
+
+
+class MiniEngine:
+    def __init__(self, model, dtype, clock=time.perf_counter):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+        self._clock = clock
+        self.phases = {}
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        t0 = time.perf_counter()
+        tok, lp, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        self.phases["decode"] = time.perf_counter() - t0  # EXPECT: ASY305
+        t1 = self._clock()
+        tok, lp, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        elapsed = self._clock() - t1                # EXPECT: ASY305
+        return tok, carry, elapsed
+
+    def fenced_step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        # timer pinned to the step's fence: measures the work
+        t0 = self._clock()
+        tok, lp, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        nxt, lps = fence("decode", tok, lp)
+        self.phases["decode"] = self._clock() - t0      # fenced: fine
+        # completion-wait spelling for trees that stay on device
+        t1 = self._clock()
+        tok, lp, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        carry = fence_wait("prefill", carry)
+        self.phases["prefill"] = self._clock() - t1     # fenced: fine
+        # a pair with NO dispatch between measures host work — fine
+        t2 = self._clock()
+        total = sum(int(x) for x in nxt)
+        self.phases["host"] = self._clock() - t2
+        return nxt, lps, carry, total
+
+
+def bench_step_wall(engine, params, tokens, active, carry, knobs):
+    """Cold twin: benches time un-synced dispatches deliberately (wall
+    around the whole run) — unreachable, exempt."""
+    t0 = time.perf_counter()
+    tok, lp, carry = engine._dispatch(
+        "decode", engine._step_fn, params, tokens, active, carry, knobs)
+    return time.perf_counter() - t0, tok

@@ -1,0 +1,73 @@
+"""ASY301 hot-readback: implicit device→host syncs on functions the
+serving super-step can REACH — ``.item()``, ``float()/int()/bool()``
+casts, ``np.asarray``/``np.array``, raw ``jax.device_get`` — flagged by
+call-graph reachability from the hot-path roots, never by path glob.
+The fenced spellings and the cold twin (same readbacks, unreachable)
+are the false-positive guards."""
+
+import jax
+import numpy as np
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence
+
+
+class MiniEngine:
+    """The minimal hot-loop shape: a `_dispatch` routing and a compiled
+    step binding (taint sources), plus an annotated root."""
+
+    def __init__(self, model, dtype):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        tok, chosen, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        nxt = np.asarray(tok)                       # EXPECT: ASY301
+        lp = float(chosen[0])                       # EXPECT: ASY301
+        done = bool(tok[0])                         # EXPECT: ASY301
+        raw = jax.device_get(chosen)                # EXPECT: ASY301
+        scalar = tok.item()                         # EXPECT: ASY301
+        pos = carry["pos"]
+        n = int(pos[0])                             # EXPECT: ASY301
+        # static accessors are trace/host metadata, never a sync
+        width = tok.shape[0]
+        nd = chosen.ndim
+        ok = carry is None
+        rows = len(tokens)
+        return nxt, lp, done, raw, scalar, n, width, nd, ok, rows
+
+    def fenced_step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        tok, chosen, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, carry, knobs)
+        # the routed spelling: ONE batched readback through the fence
+        nxt, lps = fence("decode", tok, chosen)
+        # fence results are HOST arrays — downstream casts never sync
+        first = int(nxt[0])
+        score = float(lps[0])
+        return first, score, carry
+
+    def helper(self, carry):
+        # reachable FROM step via the self-method edge — still hot
+        return float(carry["pos"][0])               # EXPECT: ASY301
+
+    def wired(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        return self.helper(carry)
+
+
+def bench_loop(engine, params, tokens, active, carry, knobs):
+    """The cold twin: identical readback spellings, but NOT reachable
+    from any hot-path root — exempt by reachability (unmarked lines =
+    the false-positive guard the issue asks for)."""
+    tok, chosen, carry = engine._dispatch(
+        "decode", engine._step_fn, params, tokens, active, carry, knobs)
+    nxt = np.asarray(tok)
+    lp = float(chosen[0])
+    raw = jax.device_get(chosen)
+    return nxt, lp, raw, tok.item(), int(carry["pos"][0])

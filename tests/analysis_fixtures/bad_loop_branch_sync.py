@@ -1,0 +1,77 @@
+"""ASY303 hot-branch-sync + ASY304 readback-accumulation: Python
+control flow branching on an UN-fenced device value between dispatches
+(every branch needs a concrete bool = a host sync on the whole pending
+pipeline), and per-iteration readbacks accumulated inside the dispatch
+loop (one sync per iteration — should batch through one fence).  The
+fenced/host-mirror spellings and device-handle accumulation are the
+false-positive guards."""
+
+import numpy as np
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence
+
+
+class MiniEngine:
+    def __init__(self, model, dtype):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+        self.chunk_done = np.zeros((8,), np.int64)   # host mirror
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        outs = []
+        total = 0.0
+        for _ in range(4):
+            tok, lp, carry = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, carry,
+                knobs)
+            if tok[0] > 0:                          # EXPECT: ASY303
+                break
+            while lp.any():                         # EXPECT: ASY303
+                break
+            best = tok if carry["pos"][0] else lp   # EXPECT: ASY303
+            assert lp[0] < 0.0                      # EXPECT: ASY303
+            outs.append(int(tok[0]))                # EXPECT: ASY304
+            total += float(lp[0])                   # EXPECT: ASY304
+        return outs, total, best
+
+    def fenced_step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        # the compliant spellings: accumulate DEVICE handles (free),
+        # branch on host mirrors / fenced host arrays only
+        drafts = []
+        for _ in range(4):
+            tok, lp, carry = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, carry,
+                knobs)
+            drafts.append(tok)                      # device handle: fine
+            if self.chunk_done[0] > 2:              # host mirror: fine
+                break
+        nxt, lps = fence("decode", tok, lp)
+        hist = []
+        if nxt[0] > 0:                              # fenced host value: fine
+            for t in nxt:
+                hist.append(int(t))                 # host cast: fine
+        # branching on trace-static facts never syncs either
+        if carry is None or len(drafts) == 0:
+            return hist, carry
+        return hist, carry
+
+
+def bench_loop(engine, params, tokens, active, carry, knobs):
+    """Cold twin: the same branch/accumulation spellings, unreachable
+    from any hot-path root — exempt by reachability."""
+    outs = []
+    for _ in range(4):
+        tok, lp, carry = engine._dispatch(
+            "decode", engine._step_fn, params, tokens, active, carry,
+            knobs)
+        if tok[0] > 0:
+            break
+        outs.append(int(tok[0]))
+    return outs

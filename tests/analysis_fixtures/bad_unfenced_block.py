@@ -1,0 +1,42 @@
+"""ASY302 unfenced-block: ``block_until_ready`` spelled raw on a
+hot-path-reachable function instead of through the ``fence_wait``
+idiom (serving/fences.py — the ONE designated home of the completion
+wait), plus fence-site strings outside the closed FENCE_SITES
+vocabulary.  The routed spelling and the cold twin are the
+false-positive guards."""
+
+import jax
+
+from bigdl_tpu.models.transformer import get_batch_prefill_step
+from bigdl_tpu.serving.fences import fence_wait
+
+
+class MiniPump:
+    def __init__(self, model, dtype):
+        self._batch_prefill_fn = get_batch_prefill_step(model, dtype)
+        self._faults = None
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def pump(self, params, toks, lengths, carry):  # analysis: hotpath-root
+        _, out = self._dispatch("prefill", self._batch_prefill_fn,
+                                params, toks, lengths, carry)
+        jax.block_until_ready(out)                  # EXPECT: ASY302
+        out.block_until_ready()                     # EXPECT: ASY302
+        # the routed spelling — the designated completion wait
+        out = fence_wait("prefill", out)
+        # ...but only over the CLOSED site vocabulary
+        out = fence_wait("warmup", out)             # EXPECT: ASY302
+        return out
+
+
+def bench_timing(engine, params, toks, lengths, carry):
+    """Cold twin: block_until_ready is exactly how a bench SHOULD time
+    device work — unreachable from the hot-path roots, so exempt."""
+    _, out = engine._dispatch("prefill", engine._batch_prefill_fn,
+                              params, toks, lengths, carry)
+    jax.block_until_ready(out)
+    return out

@@ -126,3 +126,49 @@ def serve_once(carry, upd):
     # the rebind idiom ACROSS a call boundary (SRV204's clean twin)
     carry = ingest_row(carry, upd)
     return carry["pos"]
+
+
+# -- async-readiness spellings (ASY301-305) --------------------------------
+
+from bigdl_tpu.serving.fences import fence, fence_wait
+
+
+class AsyncReadyEngine:
+    """The hot-loop spellings the ASY rules must never flag: ONE
+    batched fence readback per step, host bookkeeping on the fenced
+    arrays, device-handle accumulation, host-mirror branches, and
+    fence-pinned timers."""
+
+    def __init__(self, model, clock):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, None, sampling=True)
+        self._clock = clock
+        self.chunk_done = np.zeros((8,), np.int64)   # host mirror
+        self.metrics = ServingMetrics()
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def step(self, params, tokens, active, carry, knobs):  # analysis: hotpath-root
+        t0 = self._clock()
+        drafts = []
+        for _ in range(3):
+            tok, chosen, carry = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, carry,
+                knobs)
+            drafts.append(tok)                 # device handles are free
+            if self.chunk_done[0] > 2:         # host mirror, no sync
+                break
+        # THE one declared sync: a batched readback through the fence
+        nxt, lps = fence("decode", tok, chosen)
+        self.metrics.add_phase("decode_step", self._clock() - t0)
+        emitted = {}
+        for slot in range(nxt.shape[0]):       # fenced host arrays
+            tok1 = int(nxt[slot]) + 1
+            if tok1 > 0:
+                emitted[slot] = (tok1, float(lps[slot]))
+        # completion-wait idiom for a tree that stays on device
+        t1 = self._clock()
+        carry = fence_wait("prefill", carry)
+        self.metrics.add_phase("prefill", self._clock() - t1)
+        return emitted, carry

@@ -346,6 +346,45 @@ def test_engine_metrics_counters():
 
 # -- batch decode step (the model-layer factor the engine rides on) --------
 
+def test_host_step_split_metric():
+    """Every super-step records its host-vs-device split:
+    serving/host_step_s samples land one per decode step, host +
+    device account for (at most) the step wall, and summary() derives
+    the p50/p99 the async refactor's acceptance will cite."""
+    from bigdl_tpu.serving import ServingEngine
+
+    lm = _make_lm()
+    eng = ServingEngine(lm, n_slots=2)
+    eng.submit([3, 7], max_new_tokens=4)
+    eng.submit([5, 2], max_new_tokens=4)
+    eng.drain()
+
+    total, n = eng.metrics.metrics.get("serving/host_step_s")
+    _, n_dec = eng.metrics.metrics.get("serving/decode_step_s")
+    assert n == n_dec and n >= 4          # one split sample per decode step
+    assert total >= 0.0
+    assert eng.metrics.device_seconds > 0.0
+    s = eng.metrics.summary()
+    assert s["serving/host_step_p50_s"] <= s["serving/host_step_p99_s"]
+    pct = eng.metrics.host_step_percentiles()
+    assert set(pct) == {"p50", "p90", "p99"}
+
+    # the pairing survives fault recovery: a recovered step's discarded
+    # outputs still cost host time, so the split sample lands for every
+    # decode_step sample — the series stay comparable one for one
+    from bigdl_tpu.serving import FaultInjector
+
+    eng2 = ServingEngine(_make_lm(), n_slots=2,
+                         faults=FaultInjector(seed=1, p_garbage=0.4))
+    eng2.submit([3, 7], max_new_tokens=4)
+    eng2.submit([5, 2], max_new_tokens=4)
+    eng2.drain()
+    _, n2 = eng2.metrics.metrics.get("serving/host_step_s")
+    _, n2_dec = eng2.metrics.metrics.get("serving/decode_step_s")
+    assert n2 == n2_dec and eng2.metrics.metrics.get(
+        "serving/retries")[1] > 0
+
+
 def test_batch_decode_step_matches_single_row(rng):
     """Per-row-position decode: a row stepped inside a shared pool (other
     rows active at different depths) matches the single-request decode

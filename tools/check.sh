@@ -1,17 +1,36 @@
 #!/usr/bin/env bash
 # The pre-commit-able static gate: the whole-program analyzer over the
 # three analyzed trees, then the `analysis`-marked pytest subset (exact
-# fixture parity, CLI contract, SRV201 dispatch-site coverage proof).
+# fixture parity, CLI contract, SRV201 dispatch-site coverage proof,
+# the ASY fence-strip census).
 #
-#   tools/check.sh            # run both gates
-#   tools/check.sh --scan     # analyzer only (sub-second warm)
+#   tools/check.sh                      # run both gates
+#   tools/check.sh --scan               # analyzer only (sub-second warm)
+#   tools/check.sh --report sync-points # the async-refactor worksheet:
+#                                       # every hot-path sync point with
+#                                       # its root chain (pass-through to
+#                                       # `python -m bigdl_tpu.analysis
+#                                       # --report sync-points`; extra
+#                                       # args, e.g. --format json, are
+#                                       # forwarded)
 #
-# Exit nonzero on any new finding or test failure. The analyzer keeps a
-# findings cache in .cache/ (content-hashed — it can only skip work,
-# never change results), so the steady-state cost is well under a
-# second; the first run after an analyzer/source change re-parses cold.
+# Exit nonzero on any new finding or test failure — the scan fails on
+# non-baselined findings of EVERY family, ASY3xx included, so an
+# un-fenced hot-path readback cannot land while the committed baseline
+# stays empty. The analyzer keeps a findings cache in .cache/
+# (content-hashed — it can only skip work, never change results), so
+# the steady-state cost is well under a second; the first run after an
+# analyzer/source change re-parses cold. .github/workflows/check.yml
+# runs the same scan on every push/PR — the analyzer needs no jax, so
+# CI needs nothing but a Python interpreter.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--report" ]]; then
+    shift
+    exec python -m bigdl_tpu.analysis --report "$@" \
+        bigdl_tpu benchmarks tests
+fi
 
 python -m bigdl_tpu.analysis bigdl_tpu benchmarks tests
 
