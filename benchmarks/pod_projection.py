@@ -319,6 +319,64 @@ def serving_rows() -> list:
         "tp_scaling_efficiency": round(eff, 4),
         "aggregate_qps": round(64 * qps_group, 0),
     })
+    # DISAGGREGATED serving (serving/disagg.py): split the pod into a
+    # prefill pool and a decode pool sized so neither starves the other
+    # — chips in the ratio of the per-request phase times — and price
+    # the KV-row handoff each request pays between them. Same aggregate
+    # chip-seconds per request, so the pod QPS matches the serialized
+    # projection; what changes is WHO pays prefill: an in-flight decode
+    # row's worst-case stall drops from one admission wave (batched) or
+    # one chunk (chunked) to ZERO admission interference — decode chips
+    # never run prefill (fault-replay aside). The handoff payload is
+    # the row's full KV footprint at the prompt shape (2·layers·
+    # max_len·hidden at the serving dtype + the O(KB) lanes/mirrors —
+    # the row_state contract; int8 KV halves it), priced over ICI
+    # (pools inside one pod) and DCN (pools on separate hosts).
+    hidden, layers, max_len = 768, 12, 512
+    pre_frac = t_prefill / t_req
+    n_pre = max(1, round(256 * pre_frac))
+    n_dec = 256 - n_pre
+    handoff_bytes = 2 * layers * max_len * hidden * 2      # bf16 K/V
+    handoff_bytes_int8 = 2 * layers * max_len * hidden * 1 \
+        + 2 * layers * 12 * 4                              # + fp32 scales
+    ici_bw = SPECS["ici_bytes_per_s_per_link"] * SPECS["ici_links"]
+    t_xfer_ici = handoff_bytes / ici_bw
+    t_xfer_dcn = handoff_bytes / SPECS["dcn_bytes_per_s_per_host"]
+    t_step = 8.0 / dec_rate                   # one B=8 decode step
+    rows.append({
+        "model": "lm137", "metric": "serving_disagg_split",
+        "n_chips": 256, "prefill_chips": n_pre, "decode_chips": n_dec,
+        "prefill_pool_qps": round(n_pre / t_prefill, 0),
+        "decode_pool_qps": round(n_dec / t_decode, 0),
+        "aggregate_qps": round(min(n_pre / t_prefill,
+                                   n_dec / t_decode), 0),
+        "decode_interference_stall_ms": 0.0,
+        "note": "pools sized to the measured prefill/decode phase "
+                "ratio; aggregate matches the serialized projection — "
+                "the win is zero admission stall on decode rows",
+    })
+    rows.append({
+        "model": "lm137", "metric": "serving_disagg_transfer",
+        "handoff_bytes_bf16": handoff_bytes,
+        "handoff_bytes_int8": handoff_bytes_int8,
+        "transfer_ms_ici": round(1e3 * t_xfer_ici, 3),
+        "transfer_ms_dcn": round(1e3 * t_xfer_dcn, 3),
+        # how many decode steps the transfer hides behind at the
+        # measured decode rate — the overlap budget a prefetching
+        # handoff has before it would ever stall a decode slot
+        "decode_steps_per_ici_transfer": round(t_xfer_ici / t_step, 2),
+        "decode_steps_per_dcn_transfer": round(t_xfer_dcn / t_step, 2),
+        "handoff_rate_per_pool_qps": round(n_dec / t_decode, 0),
+        # EVERY handoff byte egresses from the (small) prefill pool's
+        # hosts, so the sender-side NICs are the DCN bottleneck — >1
+        # means cross-host handoff is infeasible at this shape and the
+        # pools must share a pod's ICI (or the KV must ship int8 AND
+        # the prefill pool spread over more hosts)
+        "dcn_oversubscription_prefill_side": round(
+            (n_dec / t_decode) * handoff_bytes
+            / (SPECS["dcn_bytes_per_s_per_host"]
+               * -(-n_pre // SPECS["chips_per_host"])), 2),
+    })
     # the admission-feed requirement per host (DCN sanity check): token
     # ids are 4 bytes, so even pod-scale QPS is kilobytes/s of prompt
     # traffic per host — serving is never DCN-bound at this shape

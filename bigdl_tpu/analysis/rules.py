@@ -862,6 +862,11 @@ _DEFAULT_FINISH_REASONS = frozenset(
     {"eos", "stop", "length", "shed", "deadline", "infeasible", "error",
      "cancelled"})
 
+#: fallback serialized row-payload schema (single-file fixture runs):
+#: must match serving/disagg.py's ROW_PAYLOAD_KEYS declaration
+_DEFAULT_PAYLOAD_KEYS = ("request", "carry", "draft", "chunk_done",
+                         "chunk_target")
+
 #: KVPool-lineage roots: any class whose base chain reaches a class
 #: with one of these qualified-name tails owns pooled device state with
 #: host mirrors
@@ -988,6 +993,26 @@ def _carry_schema_facts(ctx: FileContext) -> Dict:
                 pats.add(p)
         if pats:
             return {"carry_patterns": sorted(pats)}
+    return {}
+
+
+@_register_facts
+def _row_payload_facts(ctx: FileContext) -> Dict:
+    """The serialized row-payload key schema, extracted from the ONE
+    wire-format declaration (``ROW_PAYLOAD_KEYS`` in
+    serving/disagg.py).  SRV202's payload half checks every subscript
+    on a ``payload``-named dict against it — the cross-module twin of
+    the carry schema, so a typo'd transfer key is machine-caught
+    before it ships a row that restores wrong."""
+    from bigdl_tpu.analysis.core import UNRESOLVED as _UNRES
+    from bigdl_tpu.analysis.core import literal_value
+
+    for node in ctx.by_type(ast.Assign):
+        if any(isinstance(t, ast.Name) and t.id == "ROW_PAYLOAD_KEYS"
+               for t in node.targets):
+            val = literal_value(node.value)
+            if val is not _UNRES:
+                return {"payload_keys": sorted(val)}
     return {}
 
 
@@ -1172,58 +1197,85 @@ class DispatchBypassRule(Rule):
 class CarryKeyRule(Rule):
     code = "SRV202"
     name = "carry-key-schema"
-    summary = ("string key on a pooled serving carry that the declared "
-               "layout (_serving_init_carry) does not define")
+    summary = ("string key on a pooled serving carry (or serialized "
+               "row payload) that its declared schema does not define")
     hint = ("pooled-carry keys are a CLOSED schema declared once in "
             "models/transformer.py:_serving_init_carry (pos, rng, "
-            "tok_counts, prompt_mask, k<i>/v<i> and their _scale rows) "
-            "— a typo'd key fails only at runtime, or worse, silently "
-            "creates a NEW key the step never reads; fix the spelling "
-            "or extend the layout declaration first")
+            "tok_counts, prompt_mask, k<i>/v<i> and their _scale rows), "
+            "and row-payload keys one declared in serving/disagg.py:"
+            "ROW_PAYLOAD_KEYS (request, carry, draft, chunk_done, "
+            "chunk_target) — a typo'd key fails only at runtime, or "
+            "worse, silently creates a NEW key the step (or the "
+            "handoff restore) never reads; fix the spelling or extend "
+            "the schema declaration first")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if not _serving_scope(ctx):
             return
-        pats = _facts(ctx).get("carry_patterns") or \
+        facts = _facts(ctx)
+        carry_pats = facts.get("carry_patterns") or \
             list(_DEFAULT_CARRY_PATTERNS)
-        rx = re.compile("|".join(f"(?:{p})" for p in pats))
+        payload_keys = facts.get("payload_keys") or \
+            list(_DEFAULT_PAYLOAD_KEYS)
+        rx = {
+            "carry": re.compile(
+                "|".join(f"(?:{p})" for p in carry_pats)),
+            "payload": re.compile(
+                "|".join(re.escape(k) for k in payload_keys)),
+        }
+        what = {
+            "carry": "the pooled-carry layout declared by "
+                     "_serving_init_carry",
+            "payload": "the serialized row-payload schema declared by "
+                       "ROW_PAYLOAD_KEYS (serving/disagg.py)",
+        }
         for node in ctx.by_type(ast.Subscript, ast.Call, ast.Compare):
-            recv, key = self._carry_key(ctx, node)
+            recv, key, kind = self._carry_key(ctx, node)
             if recv is None or key is None:
                 continue
-            if rx.fullmatch(key):
+            if rx[kind].fullmatch(key):
                 continue
+            noun = "carry" if kind == "carry" else "row payload"
             yield ctx.finding(
                 node, self.code,
-                f"key {key!r} on carry `{recv}` is not in the pooled-"
-                f"carry layout declared by _serving_init_carry",
+                f"key {key!r} on {noun} `{recv}` is not in "
+                f"{what[kind]}",
                 hint=self.hint)
 
     @staticmethod
     def _carry_key(ctx: FileContext, node: ast.AST):
-        """(receiver, key) when ``node`` reads/writes a string key on a
-        carry-named object: subscripts, ``.get("k")`` calls, and
-        ``"k" in carry`` membership tests."""
+        """(receiver, key, schema kind) when ``node`` reads/writes a
+        string key on a carry-named object (the pooled-carry schema)
+        or a ``payload``-named one (the serialized row-payload schema
+        — ``KVPool.row_state`` dicts and the disagg wire payloads):
+        subscripts, ``.get("k")`` calls, and ``"k" in carry``
+        membership tests."""
         if isinstance(node, ast.Subscript):
             recv, key = node.value, node.slice
         elif isinstance(node, ast.Call):
             if not (isinstance(node.func, ast.Attribute)
                     and node.func.attr == "get" and node.args):
-                return None, None
+                return None, None, None
             recv, key = node.func.value, node.args[0]
         else:                                   # Compare: "k" in carry
             if not (len(node.ops) == 1
                     and isinstance(node.ops[0], (ast.In, ast.NotIn))):
-                return None, None
+                return None, None, None
             recv, key = node.comparators[0], node.left
         d = ctx.dotted(recv)
         seg = _last_seg(d)
-        if seg is None or "carry" not in seg:
-            return None, None
+        if seg is None:
+            return None, None, None
+        if "payload" in seg:
+            kind = "payload"
+        elif "carry" in seg:
+            kind = "carry"
+        else:
+            return None, None, None
         if not (isinstance(key, ast.Constant)
                 and isinstance(key.value, str)):
-            return None, None
-        return d, key.value
+            return None, None, None
+        return d, key.value, kind
 
 
 # -- SRV203 — host-mirror lockstep -----------------------------------------

@@ -119,6 +119,15 @@ class BlockStore:
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
+    def contains(self, key: str) -> bool:
+        """Existence probe WITHOUT fetching the value. The fallback
+        fetches-and-discards (correct everywhere); backends override
+        where a metadata check is cheaper — polling loops (e.g. the
+        serving handoff's ``pending()``) call this per tick, and a
+        fallback read would move the full payload just to answer a
+        boolean."""
+        return self.try_get(key) is not None
+
     def get_blocking(self, key: str, timeout_s: float,
                      poll_s: float = 0.002) -> bytes:
         deadline = time.monotonic() + timeout_s
@@ -132,6 +141,35 @@ class BlockStore:
                     "peer process likely died (bounded retry will restart "
                     "from checkpoint)")
             time.sleep(poll_s)
+
+
+class MemBlockStore(BlockStore):
+    """In-process dict backend: the cheapest store for single-process
+    tests and the in-process disaggregated-serving transfer
+    (``serving/disagg.py``). Thread-safe (one lock) so a producer
+    thread and the main loop can share it; it is NOT visible across
+    processes — use :class:`FsBlockStore` or
+    :class:`CoordServiceBlockStore` for that."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._blocks: Dict[str, bytes] = {}
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._blocks[key] = bytes(value)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._blocks.get(key)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._blocks.pop(key, None)
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._blocks
 
 
 class FsBlockStore(BlockStore):
@@ -163,6 +201,9 @@ class FsBlockStore(BlockStore):
             os.remove(self._path(key))
         except FileNotFoundError:
             pass
+
+    def contains(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
 
 
 class CoordServiceBlockStore(BlockStore):

@@ -21,7 +21,13 @@ loss-free preemption (evicted rows resume byte-identically), bounded-
 queue admission backpressure with shed/deadline-drop/degrade policies,
 and a step watchdog + deterministic fault injector whose
 retry-with-evict recovery replays failed, garbage, or stalled steps
-without ever wedging the engine. See ``docs/serving.md``.
+without ever wedging the engine. Past one host loop, ``disagg.py``
+splits the plane into a PREFILL POOL and DECODE POOLS with serialized
+KV-row handoff between them (``KVPool.row_state``/``restore_row`` —
+the same byte-exact payload the preemption stash speaks; in-process
+queue or ``block_store`` transfer backends), token-identical to the
+monolithic engine at zero extra compiles per pool. See
+``docs/serving.md``.
 
     from bigdl_tpu.serving import SamplingParams, ServingEngine
 
@@ -39,6 +45,11 @@ from bigdl_tpu.serving.admission import (
     AdmissionController, Degrade, bucket_len,
 )
 from bigdl_tpu.serving.chunked import ChunkedAdmissionController
+from bigdl_tpu.serving.disagg import (
+    BlockStoreTransfer, DecodeWorker, DisaggregatedEngine,
+    InProcessTransfer, KVTransfer, PrefillWorker, ROW_PAYLOAD_KEYS,
+    pack_payload, unpack_payload,
+)
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import (
     FaultError, FaultInjector, VirtualClock, WatchdogConfig,
@@ -61,4 +72,7 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "ShardedEngine", "ShardedKVPool", "make_mesh",
            "emulate_cpu_devices", "Degrade", "FaultError",
            "FaultInjector", "VirtualClock", "WatchdogConfig",
-           "FENCE_SITES", "fence", "fence_wait"]
+           "FENCE_SITES", "fence", "fence_wait",
+           "DisaggregatedEngine", "PrefillWorker", "DecodeWorker",
+           "KVTransfer", "InProcessTransfer", "BlockStoreTransfer",
+           "ROW_PAYLOAD_KEYS", "pack_payload", "unpack_payload"]

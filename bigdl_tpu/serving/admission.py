@@ -157,16 +157,22 @@ class AdmissionController:
         assert slot is not None                # admissible() checked
         req = eng.scheduler.admit(slot, partial=partial)
         # the last fed token is the first decode input — exactly
-        # generate()'s convention, so outputs match token-for-token
+        # generate()'s convention, so outputs match token-for-token.
+        # Called BEFORE the resume check on purpose: its side effects
+        # (req.next_token, the degrade knob) are required on the
+        # restored path too, even though pf itself goes unused there
         pf = eng._admitted_prefill_tokens(req)
+        if req.resume_carry is not None:
+            # byte-exact resume: the stashed row_state payload
+            # (preemption stash or disaggregated handoff) restores
+            # whole — KV + scales + lanes + mirrors + draft — and the
+            # slot skips _configure_slot's device reseeding
+            eng.pool.restore_row(slot, req.resume_carry)
+            req.resume_carry = None
+            eng._restored.add(slot)
+            return slot, req, None
         if not pf:
             eng.pool.set_pos(slot, 0)
-            return slot, req, None
-        if req.resume_carry is not None:
-            # byte-exact preemption resume: the evicted row's own
-            # bytes scatter straight back into the pool
-            eng.pool.write_prefill(slot, req.resume_carry, len(pf))
-            req.resume_carry = None
             return slot, req, None
         return slot, req, pf
 

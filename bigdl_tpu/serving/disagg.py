@@ -1,0 +1,727 @@
+"""Disaggregated serving: a prefill pool and a decode pool with KV-row
+handoff (the DistServe/Splitwise pattern, PAPERS.md).
+
+One engine interleaves prompt ingestion and decode on one device, so a
+burst of long prompts steals decode steps from every in-flight row —
+the interference chunked admission measures (``serving/decode_gap_s``)
+and bounds, but cannot eliminate: the bound is still paid from the
+decode budget. Past one host the fix is structural: run admission
+(prefill + prefix cache) on a PREFILL POOL, run the decode/sample/
+verify super-step on a DECODE POOL, and hand each finished KV row
+across. Decode rows then never wait on anyone's prompt, and each pool
+scales on its own axis (prefill is MXU-bound, decode weight-read-bound
+— ``benchmarks/pod_projection.py`` prices the split).
+
+The pieces were already lying around, which is why this module is thin:
+
+* ``KVPool.row_state()`` serializes EVERYTHING a row carries (K/V +
+  int8 scales + ``pos``, RNG lane, penalty counts, prompt mask, the
+  ``chunk_done``/``chunk_target`` host mirrors, the draft-carry slice)
+  and ``restore_row()`` is its byte-identical inverse — the SAME API
+  the engine's loss-free preemption stash speaks, so stash and handoff
+  can never drift apart field by field;
+* ``Request.resume_carry`` is the engine's existing "this row arrives
+  with its state attached" handle — a handed-off request is admitted
+  into the decode pool exactly like a preempted row resuming;
+* ``block_store`` is a working cross-process byte-transfer layer — the
+  production-shaped :class:`BlockStoreTransfer` backend rides it, and
+  :class:`InProcessTransfer` serializes through the same codec so the
+  in-process tests exercise the real wire format.
+
+Every engine contract is preserved (pinned by
+tests/test_serving_disagg.py and ``serving_bench --scenario disagg``):
+
+* **token identity** — per-row streams depend only on the row's own
+  carry + params, so splitting admission and decode across pools
+  changes WHERE state lives, never what any row computes: greedy and
+  fixed-seed sampled outputs are token-identical to the monolithic
+  :class:`~bigdl_tpu.serving.engine.ServingEngine`, through prefix
+  hits, evict/readmit inside the decode pool, and fault recovery.
+  Sampling lanes ride the payload (seeded by the prefill worker from
+  the GLOBAL request id), so a decode worker reproduces the stream
+  without knowing the request's seed;
+* **zero extra compiles per pool** — every worker wraps a stock
+  ``ServingEngine`` over the same model, and the per-(model, dtype)
+  step caches are process-wide: N decode pools share ONE compiled
+  decode (or verify) program, and the prefill pool shares the bucketed
+  prefill set;
+* **closed accounting** — shed/deadline/infeasible dispositions land
+  at the prefill door, eos/stop/length/error at the decode pool, and
+  the front end's ledger union keeps every ``finish_<reason>`` counter
+  summing to the submitted total. New handoff observability:
+  ``serving/handoffs``, ``serving/transfer_bytes``,
+  ``serving/transfer_s``, and per-pool occupancies.
+
+The wire payload is a CLOSED schema (:data:`ROW_PAYLOAD_KEYS`) checked
+statically: the analyzer's SRV202 rule reads this declaration
+(cross-module, like the carry-key schema it extends) and flags any
+subscript on a ``payload``-named dict whose key is not in it — a
+typo'd transfer key is machine-caught before it ships a row that
+restores wrong.
+
+    from bigdl_tpu.serving import DisaggregatedEngine
+
+    eng = DisaggregatedEngine(lm, prefill_slots=8, decode_slots=8,
+                              decode_pools=2, prefix_cache=True)
+    rid = eng.submit([3, 7, 2], max_new_tokens=32)
+    outs = eng.drain()                  # {rid: 1-based token ids}
+    eng.metrics.summary()["serving/handoffs"]
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from collections import deque
+from dataclasses import asdict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.parallel.block_store import (
+    BlockStore, decode_array, encode_array,
+)
+from bigdl_tpu.serving.engine import ServingEngine
+from bigdl_tpu.serving.faults import FaultError, default_clock
+from bigdl_tpu.serving.fences import fence
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.serving.sampling import SamplingParams
+from bigdl_tpu.serving.scheduler import FINISHED, Request
+
+#: THE serialized row-payload schema — every top-level key a handoff
+#: payload may carry. ``carry`` is the B=1 target-carry slice (its own
+#: keys are the SRV202 carry schema), ``draft`` the optional draft-carry
+#: slice, ``chunk_done``/``chunk_target`` the host chunk mirrors, and
+#: ``request`` the wire header's request metadata. Closed like
+#: ``ServingMetrics.FINISH_REASONS``: the static analyzer (SRV202)
+#: reads this declaration and flags any payload subscript outside it,
+#: so a typo'd transfer key cannot silently drop a field on the floor.
+ROW_PAYLOAD_KEYS = ("request", "carry", "draft", "chunk_done",
+                    "chunk_target")
+
+_WIRE_MAGIC = b"BDRH"                  # row-handoff wire format v1
+
+
+# -- request metadata <-> wire header ---------------------------------------
+
+def request_meta(req: Request) -> Dict:
+    """The JSON-serializable request half of a handoff payload: enough
+    to reconstruct the request at the decode pool with its GLOBAL id
+    (the RNG-lane key is a function of (engine seed, req_id), so the
+    id must survive the wire), its post-degrade budgets, and its
+    stream-so-far (empty for the normal prefill-complete handoff; the
+    general mid-stream form keeps the codec future-proof)."""
+    return {
+        "req_id": int(req.req_id),
+        "prompt": [int(t) for t in req.prompt],
+        "output": [int(t) for t in req.output],
+        "logprobs": [float(v) for v in req.logprobs],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_id": int(req.eos_id),
+        "sampling": asdict(req.sampling if req.sampling is not None
+                           else SamplingParams()),
+        "draft_tokens": req.draft_tokens,
+        "priority": int(req.priority),
+        "deadline_s": req.deadline_s,
+        "submit_time": float(req.submit_time),
+        "first_token_time": req.first_token_time,
+    }
+
+
+def request_from_meta(meta: Dict) -> Request:
+    """Reconstruct a :class:`Request` from its wire header (the decode
+    side of :func:`request_meta`). ``seq`` stays unset — the receiving
+    scheduler assigns its own arrival order, which is handoff order."""
+    sp = dict(meta["sampling"])
+    req = Request(
+        req_id=int(meta["req_id"]),
+        prompt=[int(t) for t in meta["prompt"]],
+        max_new_tokens=int(meta["max_new_tokens"]),
+        eos_id=int(meta["eos_id"]),
+        sampling=SamplingParams(**sp),
+        draft_tokens=meta.get("draft_tokens"),
+        priority=int(meta.get("priority", 0)),
+        deadline_s=meta.get("deadline_s"),
+        submit_time=float(meta.get("submit_time", 0.0)))
+    req.output = [int(t) for t in meta.get("output", ())]
+    req.logprobs = [float(v) for v in meta.get("logprobs", ())]
+    req.first_token_time = meta.get("first_token_time")
+    return req
+
+
+# -- the wire codec ---------------------------------------------------------
+
+def pack_payload(meta: Dict, payload: Dict) -> bytes:
+    """Serialize one handoff — request header + ``KVPool.row_state``
+    payload — to bytes: a JSON header (request metadata, chunk mirrors,
+    and the ORDERED carry/draft key lists) followed by one
+    length-prefixed :func:`~bigdl_tpu.parallel.block_store.encode_array`
+    blob per leaf. Every leaf rides the self-describing array codec, so
+    the receiver needs no out-of-band dtype/shape agreement (bf16 and
+    int8 carries round-trip bitwise)."""
+    carry = payload["carry"]
+    draft = payload.get("draft")
+    head = {
+        "request": meta,
+        "chunk_done": int(payload["chunk_done"]),
+        "chunk_target": int(payload["chunk_target"]),
+        "carry_keys": sorted(carry),
+        "draft_keys": None if draft is None else sorted(draft),
+    }
+    hj = json.dumps(head).encode()
+    parts = [_WIRE_MAGIC, struct.pack("<q", len(hj)), hj]
+    # serialization IS a device→host crossing, so it wears the declared
+    # fence idiom (serving/fences.py): ONE batched device_get of every
+    # payload leaf instead of a hidden sync per array (ASY301)
+    ordered = [carry[k] for k in head["carry_keys"]]
+    if draft is not None:
+        ordered += [draft[k] for k in head["draft_keys"]]
+    host = fence("transfer", *ordered)
+    if len(ordered) == 1:
+        host = (host,)
+    for arr in host:
+        blob = encode_array(arr)
+        parts.append(struct.pack("<q", len(blob)))
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def unpack_payload(blob: bytes) -> Tuple[Dict, Dict]:
+    """Inverse of :func:`pack_payload`: ``(request metadata, row_state
+    payload)`` with numpy leaves — exactly what ``KVPool.restore_row``
+    accepts."""
+    if blob[:4] != _WIRE_MAGIC:
+        raise ValueError("not a row-handoff payload")
+    off = 4
+    (nh,) = struct.unpack_from("<q", blob, off)
+    off += 8
+    head = json.loads(blob[off:off + nh].decode())
+    off += nh
+
+    def _arrays(keys):
+        nonlocal off
+        out = {}
+        for k in keys:
+            (nb,) = struct.unpack_from("<q", blob, off)
+            off += 8
+            out[k] = decode_array(blob[off:off + nb])
+            off += nb
+        return {k: v[None] if v.ndim == 0 else v for k, v in out.items()}
+
+    payload = {
+        "carry": _arrays(head["carry_keys"]),
+        "draft": (None if head["draft_keys"] is None
+                  else _arrays(head["draft_keys"])),
+        "chunk_done": int(head["chunk_done"]),
+        "chunk_target": int(head["chunk_target"]),
+    }
+    return head["request"], payload
+
+
+# -- transfer backends ------------------------------------------------------
+
+class KVTransfer:
+    """One ordered byte channel from the prefill pool to ONE decode
+    worker. ``send`` publishes a packed handoff; ``recv`` returns the
+    next pending payload or None when the channel is empty (never
+    blocks — the decode loop polls between steps). Backends:
+    :class:`InProcessTransfer` (a deque, for tests and the in-process
+    engine) and :class:`BlockStoreTransfer` (any
+    :class:`~bigdl_tpu.parallel.block_store.BlockStore` — the
+    cross-process production shape). Both carry the SAME packed bytes,
+    so the in-process tests exercise the real wire format."""
+
+    def send(self, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def recv(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def pending(self) -> int:
+        """Sent-but-not-received payloads (drain/idle bookkeeping)."""
+        raise NotImplementedError
+
+
+class InProcessTransfer(KVTransfer):
+    """Same-process queue backend: a deque of packed payloads."""
+
+    def __init__(self) -> None:
+        self._q: deque = deque()
+
+    def send(self, blob: bytes) -> None:
+        self._q.append(bytes(blob))
+
+    def recv(self) -> Optional[bytes]:
+        return self._q.popleft() if self._q else None
+
+    def pending(self) -> int:
+        return len(self._q)
+
+
+class BlockStoreTransfer(KVTransfer):
+    """Cross-process backend over a :class:`BlockStore`: sender and
+    receiver each track their own monotone sequence number, so the
+    channel is ordered with no coordination beyond the store itself
+    (``FsBlockStore`` for same-host processes,
+    ``CoordServiceBlockStore`` for a jax.distributed pod — the same
+    backends the gradient exchange already runs on). Received keys are
+    deleted, so the store never grows past the in-flight window.
+    ``pending()`` probes the receiver's NEXT key only — cheap, and
+    sufficient for the drain loop's "anything left?" question."""
+
+    def __init__(self, store: BlockStore, channel: str = "disagg") -> None:
+        self.store = store
+        self.channel = str(channel)
+        self._sent = 0
+        self._received = 0
+
+    def _key(self, n: int) -> str:
+        return f"{self.channel}/row_{n:08d}"
+
+    def send(self, blob: bytes) -> None:
+        self.store.put(self._key(self._sent), blob)
+        self._sent += 1
+
+    def recv(self) -> Optional[bytes]:
+        blob = self.store.try_get(self._key(self._received))
+        if blob is None:
+            return None
+        self.store.delete(self._key(self._received))
+        self._received += 1
+        return blob
+
+    def pending(self) -> int:
+        # when sender and receiver share this object (the in-process
+        # engine), the counters give the EXACT in-flight depth — the
+        # least-loaded router needs the real number, or a same-step
+        # burst all lands on whichever worker tied at "1". A pure
+        # receiver (its own process; _sent == 0) falls back to a cheap
+        # existence probe of its next key — never a payload fetch
+        n = self._sent - self._received
+        if n > 0:
+            return n
+        return 1 if self.store.contains(self._key(self._received)) else 0
+
+
+# -- the prefill pool -------------------------------------------------------
+
+class PrefillWorker:
+    """Owns ADMISSION: the waiting queue, batched or chunked prompt
+    ingestion, the prefix cache, sampling-lane seeding, and — on
+    speculative configs — the draft-cache prefill. Produces COMPLETED
+    KV rows: every pump, rows whose prompts are fully resident are
+    serialized via ``pool.row_state()`` and released (slot freed for
+    the next admission wave), never decoded here.
+
+    Wraps a stock :class:`ServingEngine`, so every admission behavior —
+    bucketed compile-bounded prefill, chunked streaming, prefix-cache
+    reuse, backpressure/deadline shedding at the door, admission-side
+    fault recovery — is the SAME code the monolithic engine runs, and
+    the compiled prefill programs are shared through the per-(model,
+    dtype) step caches.
+
+    ``transfer`` is optional: with one attached (the standalone
+    cross-process shape), :meth:`pump` packs and sends each finished
+    row itself, requeueing loss-free on a failed send; without one (the
+    in-process :class:`DisaggregatedEngine` shape) it returns
+    ``(request, payload)`` pairs and the front end routes them."""
+
+    def __init__(self, model, n_slots: int = 8,
+                 transfer: Optional[KVTransfer] = None,
+                 **engine_kw) -> None:
+        self.engine = ServingEngine(model, n_slots=n_slots, **engine_kw)
+        self.transfer = transfer
+        self._peak_occupancy = 0.0
+
+    def submit(self, *args, **kwargs) -> int:
+        """Queue one request (the :meth:`ServingEngine.submit`
+        surface, including backpressure shedding at the door)."""
+        return self.engine.submit(*args, **kwargs)
+
+    def _release(self, slot: int, req: Request) -> None:
+        # the row leaves this pool entirely: its lifecycle continues at
+        # a decode worker, so it is popped (not finished) and its slot
+        # returns to the free list for the next admission wave
+        del self.engine.scheduler.running[slot]
+        req.slot = None
+        self.engine.pool.free(slot)
+        self.engine._configured.discard(slot)
+        self.engine._restored.discard(slot)
+
+    def requeue(self, req: Request, payload: Dict) -> None:
+        """Loss-free return of a handoff that could not be delivered
+        (fault during pack or transfer): the payload goes back on the
+        request and it re-enters the queue at its ORIGINAL arrival
+        key — at the next pump it restores byte-identically (no
+        prefill replay) and hands off again. BOUNDED by the engine
+        watchdog's ``max_retries`` (the step-recovery budget): a
+        persistently failing fabric fails the REQUEST with
+        ``finish_reason='error'`` instead of wedging ``drain()`` in a
+        restore→pack→send loop forever — the same liveness contract
+        the step watchdog enforces."""
+        eng = self.engine
+        req.retries += 1
+        mr = eng.watchdog.max_retries
+        if mr is not None and req.retries > mr:
+            eng._ledger_finish(req, "error", eng._clock())
+            return
+        req.resume_carry = payload
+        eng.scheduler.submit(req)
+        eng.metrics.on_retry()
+
+    def pump(self) -> List[Tuple[Request, Dict]]:
+        """One admission super-step: deadline/feasibility drops, slot
+        binding, bucketed (or chunked) prefill, then serialize-and-
+        release every prompt-complete row. Returns the finished
+        ``(request, row_state payload)`` pairs (empty when a transfer
+        is attached — those were sent)."""
+        eng = self.engine
+        eng._admit()
+        if eng.admitter is not None:
+            eng.admitter.pump()
+        # sample occupancy at its per-pump PEAK — after admission,
+        # BEFORE the completed rows release their slots (post-release
+        # the batched pool is empty by construction, and a pool-sizing
+        # signal that always reads 0 can never fire)
+        self._peak_occupancy = eng.pool.occupancy()
+        out: List[Tuple[Request, Dict]] = []
+        for slot, req in list(eng.scheduler.running.items()):
+            if slot not in eng._configured:
+                try:
+                    # seeds the row's RNG lane/penalty counts (and the
+                    # draft cache) so the payload carries them — the
+                    # decode pool restores, never reseeds
+                    eng._configure_slot(slot, req)
+                except FaultError:
+                    eng._recover_admission([(slot, req)])
+                    continue
+            payload = eng.pool.row_state(slot)
+            self._release(slot, req)
+            if self.transfer is None:
+                out.append((req, payload))
+                continue
+            t0 = eng._clock()
+            try:
+                # pack INSIDE the recovery scope: the row already left
+                # every scheduler table, so a serialization failure
+                # (the transfer fence's device_get can surface real
+                # device errors) must requeue it, not lose it
+                blob = pack_payload(request_meta(req), payload)
+                self.transfer.send(blob)
+            except Exception:
+                self.requeue(req, payload)
+                continue
+            eng.metrics.on_handoff(len(blob), eng._clock() - t0)
+        return out
+
+    def idle(self) -> bool:
+        return self.engine.scheduler.idle()
+
+    @property
+    def occupancy(self) -> float:
+        """The last pump's PEAK slot occupancy (admitted rows before
+        their release) — the prefill pool-sizing signal. The live
+        post-pump occupancy is 0 by construction under batched
+        admission (completed rows hand off immediately)."""
+        return self._peak_occupancy
+
+
+# -- the decode pool --------------------------------------------------------
+
+class DecodeWorker:
+    """Owns the DECODE/sample/verify super-step over its own
+    :class:`~bigdl_tpu.serving.kv_pool.KVPool`: handed-off rows arrive
+    as ``row_state`` payloads, queue with ``resume_carry`` attached,
+    and are admitted through the engine's byte-exact restore path — a
+    handoff is admitted exactly like a preempted row resuming. Decode
+    never runs prompt prefill EXCEPT fault-recovery replay (a suspect
+    row's carry is never trusted — the engine re-prefills
+    ``prompt + output``, sharing the prefill pool's compiled bucket
+    programs through the step cache).
+
+    Wraps a stock :class:`ServingEngine` too, so priority preemption
+    inside the pool, the watchdog, fault injection, finish-reason
+    accounting, and the per-pool metrics plane all come for free, and
+    N decode workers share ONE compiled decode (or verify) program.
+    ``seed`` must match the front end's: a fault-recovery replay
+    rebuilds RNG lanes from (seed, GLOBAL req_id)."""
+
+    def __init__(self, model, n_slots: int = 8,
+                 transfer: Optional[KVTransfer] = None,
+                 **engine_kw) -> None:
+        self.engine = ServingEngine(model, n_slots=n_slots, **engine_kw)
+        self.transfer = transfer if transfer is not None \
+            else InProcessTransfer()
+
+    def ingest(self, blob: bytes) -> int:
+        """Accept one packed handoff: reconstruct the request (global
+        id intact) with its payload as ``resume_carry`` and queue it —
+        the next step's admission restores the row bitwise. Returns
+        the request id."""
+        meta, payload = unpack_payload(blob)
+        req = request_from_meta(meta)
+        req.resume_carry = payload
+        self.engine.scheduler.submit(req)
+        return req.req_id
+
+    def poll(self) -> int:
+        """Drain the transfer channel into the queue; returns how many
+        rows arrived."""
+        n = 0
+        while True:
+            blob = self.transfer.recv()
+            if blob is None:
+                return n
+            self.ingest(blob)
+            n += 1
+
+    def step(self) -> Dict[int, int]:
+        """Poll the channel, then one engine super-step (admission of
+        restored rows + the batched decode/verify dispatch)."""
+        self.poll()
+        return self.engine.step()
+
+    @property
+    def load(self) -> int:
+        """Rows this worker is responsible for (queued + slot-holding
+        + still on the wire) — the least-loaded routing key."""
+        return (self.engine.scheduler.queue_depth
+                + self.engine.scheduler.active
+                + self.transfer.pending())
+
+    def idle(self) -> bool:
+        return self.engine.scheduler.idle() \
+            and self.transfer.pending() == 0
+
+    @property
+    def occupancy(self) -> float:
+        return self.engine.pool.occupancy()
+
+
+# -- the front end ----------------------------------------------------------
+
+class DisaggregatedEngine:
+    """The disaggregated serving plane behind the familiar engine
+    surface (``submit``/``step``/``drain``/``result``/``cancel``):
+    ONE :class:`PrefillWorker` (admission + prefix cache) feeding
+    ``decode_pools`` :class:`DecodeWorker` s over per-worker transfer
+    channels, least-loaded routing, and loss-free requeue when a
+    transfer fails mid-handoff.
+
+    Construction knobs mirror :class:`ServingEngine` where they apply:
+    ``admission``/``chunk_budget``/``prefix_cache``/``max_queue``/
+    ``deadline_feasibility`` shape the PREFILL pool (admission lives
+    there); ``policy``/``preemption`` shape the DECODE pools
+    (decode-side scheduling lives there — the prefill pool shares the
+    policy for admission ORDER only); ``watchdog`` applies to both
+    (step recovery in the decode pools; its ``max_retries`` also
+    bounds the prefill side's transfer-retry budget); ``compute_dtype``/
+    ``kv_dtype``/``speculative``/``seed``/``clock``/``faults`` apply to
+    both (the pools must agree on the carry layout, and lanes are
+    seeded from the global seed + request id). ``transfer_factory``
+    builds one channel per decode worker (default
+    :class:`InProcessTransfer`; pass e.g. ``lambda i:
+    BlockStoreTransfer(store, f"decode{i}")`` for a shared store).
+
+    Output parity with the monolithic engine is the module-level
+    contract; the front end's own metrics add the handoff plane:
+    ``serving/handoffs``, ``serving/transfer_bytes``,
+    ``serving/transfer_s``, ``serving/prefill_occupancy``,
+    ``serving/decode_occupancy`` (see ``ServingMetrics``)."""
+
+    def __init__(self, model, prefill_slots: int = 8,
+                 decode_slots: int = 8, decode_pools: int = 1,
+                 admission: str = "batched",
+                 chunk_budget: Optional[int] = None,
+                 prefix_cache=None,
+                 compute_dtype=None, kv_dtype: Optional[str] = None,
+                 speculative=None, seed: int = 0,
+                 policy: str = "prefill_priority",
+                 preemption: Optional[bool] = None,
+                 deadline_feasibility: bool = False,
+                 max_queue: Optional[int] = None,
+                 keep_finished: Optional[int] = None,
+                 watchdog=None, faults=None, clock=None,
+                 metrics: Optional[ServingMetrics] = None,
+                 transfer_factory=None) -> None:
+        if decode_pools < 1:
+            raise ValueError(
+                f"decode_pools must be >= 1, got {decode_pools}")
+        self._clock = clock if clock is not None else default_clock
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        shared = dict(compute_dtype=compute_dtype, kv_dtype=kv_dtype,
+                      speculative=speculative, seed=seed, clock=clock,
+                      faults=faults, keep_finished=keep_finished)
+        # the prefill pool shares the decode policy so priority
+        # traffic orders ADMISSION too (no preemption there: its rows
+        # drain to handoff every pump, so eviction has nothing to buy)
+        self.prefill = PrefillWorker(
+            model, n_slots=prefill_slots, admission=admission,
+            chunk_budget=chunk_budget, prefix_cache=prefix_cache,
+            deadline_feasibility=deadline_feasibility,
+            max_queue=max_queue, policy=policy, preemption=False,
+            watchdog=watchdog, **shared)
+        make = transfer_factory if transfer_factory is not None \
+            else (lambda i: InProcessTransfer())
+        self.decoders = [
+            DecodeWorker(model, n_slots=decode_slots, transfer=make(i),
+                         policy=policy, preemption=preemption,
+                         watchdog=watchdog, **shared)
+            for i in range(decode_pools)]
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, *args, **kwargs) -> int:
+        """Queue one request at the prefill door (the full
+        :meth:`ServingEngine.submit` surface — validation, sampling
+        params, priorities/deadlines, backpressure shedding)."""
+        return self.prefill.submit(*args, **kwargs)
+
+    def _engines(self):
+        yield self.prefill.engine
+        for w in self.decoders:
+            yield w.engine
+
+    def _lookup(self, req_id: int) -> Optional[Request]:
+        for eng in self._engines():
+            req = eng._finished.get(req_id)
+            if req is not None:
+                return req
+        return None
+
+    def result(self, req_id: int) -> Optional[np.ndarray]:
+        req = self._lookup(req_id)
+        return None if req is None else np.asarray(req.output, np.int32)
+
+    def pop_result(self, req_id: int) -> Optional[np.ndarray]:
+        for eng in self._engines():
+            out = eng.pop_result(req_id)
+            if out is not None:
+                return out
+        return None
+
+    def logprobs(self, req_id: int) -> Optional[np.ndarray]:
+        req = self._lookup(req_id)
+        return None if req is None else np.asarray(req.logprobs,
+                                                   np.float32)
+
+    def request(self, req_id: int) -> Optional[Request]:
+        return self._lookup(req_id)
+
+    def cancel(self, req_id: int) -> bool:
+        """Cancel wherever the request currently lives: the prefill
+        pool (waiting / mid-prefill) or its decode pool (queued-for-
+        restore / decoding). With the in-process transfer there is no
+        wire window — every handoff lands in its decode pool's
+        scheduler within the same front-end step — but a row on a
+        CROSS-PROCESS wire is not recalled: this returns False and the
+        caller must re-issue the cancel after the row lands."""
+        for eng in self._engines():
+            if eng.cancel(req_id):
+                return True
+        return False
+
+    # -- the serving loop --------------------------------------------------
+
+    def _handoff(self, req: Request, payload: Dict) -> None:
+        worker = min(self.decoders, key=lambda w: w.load)
+        t0 = self._clock()
+        try:
+            # pack inside the recovery scope too — the row already
+            # left the prefill scheduler, so pack AND send failures
+            # both requeue loss-free (bounded by the watchdog's retry
+            # budget; past it the request fails with reason 'error')
+            blob = pack_payload(request_meta(req), payload)
+            worker.transfer.send(blob)
+        except Exception:
+            self.prefill.requeue(req, payload)
+            return
+        self.metrics.on_handoff(len(blob), self._clock() - t0)
+
+    def step(self) -> Dict[int, int]:
+        """One front-end super-step: pump the prefill pool, route every
+        finished row to the least-loaded decode worker, then one decode
+        super-step per pool. Returns the merged ``{req_id: last emitted
+        1-based token}`` across pools."""
+        for req, payload in self.prefill.pump():
+            self._handoff(req, payload)
+        out: Dict[int, int] = {}
+        for worker in self.decoders:
+            out.update(worker.step())
+        self.metrics.on_pool_occupancy(
+            self.prefill.occupancy,
+            [w.occupancy for w in self.decoders])
+        return out
+
+    def idle(self) -> bool:
+        return self.prefill.idle() and all(w.idle()
+                                           for w in self.decoders)
+
+    def drain(self) -> Dict[int, np.ndarray]:
+        """Step until every submitted request has finished; returns
+        ``{req_id: generated ids}`` for all retained FINISHED requests
+        across pools (the monolithic ``drain`` contract)."""
+        while not self.idle():
+            self.step()
+        out: Dict[int, np.ndarray] = {}
+        for eng in self._engines():
+            for rid, req in eng._finished.items():
+                if req.state == FINISHED:
+                    out[rid] = np.asarray(req.output, np.int32)
+        return out
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(eng.scheduler.queue_depth for eng in self._engines())
+
+    @property
+    def active(self) -> int:
+        return sum(eng.scheduler.active for eng in self._engines())
+
+    # -- introspection -----------------------------------------------------
+
+    def pool_summaries(self) -> Dict[str, Dict[str, float]]:
+        """Per-pool metric summaries (``prefill``, ``decode_<i>``) —
+        the disaggregated twin of ``engine.metrics.summary()``."""
+        out = {"prefill": self.prefill.engine.metrics.summary()}
+        for i, w in enumerate(self.decoders):
+            out[f"decode_{i}"] = w.engine.metrics.summary()
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        """One flat dict: the front end's handoff-plane counters plus
+        the pool-summed dispositions (finish_<reason> counters keep
+        summing to the submitted total across the split), aggregate
+        token counts, and the worst decode pool's decode-gap p99."""
+        out = dict(self.metrics.summary())
+        sums: Dict[str, float] = {}
+        gap_p99 = 0.0
+        for name, s in self.pool_summaries().items():
+            for k, v in s.items():
+                if k.startswith("serving/finish_") or k in (
+                        "serving/shed", "serving/preempted",
+                        "serving/retries", "serving/recovered_rows",
+                        "serving/deadline_missed", "serving/degraded",
+                        "serving/infeasible", "serving/finished_in_slo"):
+                    sums[k] = sums.get(k, 0.0) + v
+            if name != "prefill":
+                gap_p99 = max(gap_p99,
+                              s.get("serving/decode_gap_p99_s", 0.0))
+        out.update(sums)
+        pm = self.prefill.engine.metrics.metrics
+        n_sub, _ = pm.get("serving/submitted")
+        if n_sub:
+            out["serving/submitted"] = n_sub
+            out["serving/goodput"] = \
+                sums.get("serving/finished_in_slo", 0.0) / n_sub
+        n_fin = n_tok = 0.0
+        for eng in self._engines():
+            f, _ = eng.metrics.metrics.get("serving/finished")
+            t, _ = eng.metrics.metrics.get("serving/tokens_out")
+            n_fin += f
+            n_tok += t
+        out["serving/finished"] = n_fin
+        out["serving/tokens_out"] = n_tok
+        if gap_p99:
+            out["serving/decode_gap_p99_s"] = gap_p99
+        return out

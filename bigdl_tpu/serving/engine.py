@@ -377,6 +377,12 @@ class ServingEngine:
         self._knobs = make_knob_rows(n_slots)
         self._ban_base = np.zeros((n_slots,), bool)
         self._configured: set = set()
+        # slots whose occupant arrived as a FULL row_state payload
+        # (preemption resume or a disaggregated handoff): their RNG
+        # lane / penalty counts / draft cache were restored verbatim,
+        # so _configure_slot sets knobs only and skips the device
+        # reseeding. Torn down with _configured everywhere a slot is.
+        self._restored: set = set()
         # device-side knob cache: knobs only change at admission or a
         # min-tokens ban flip, so the steady-state decode loop reuses
         # the same device arrays instead of re-uploading every step
@@ -568,6 +574,7 @@ class ServingEngine:
             slot, req.slot = req.slot, None
             self.pool.free(slot)
             self._configured.discard(slot)
+            self._restored.discard(slot)
             if self.admitter is not None:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
             req.resume_carry = None
@@ -679,14 +686,19 @@ class ServingEngine:
             req = self.scheduler.admit(slot)
             # the last fed token is the first decode input — exactly
             # generate()'s convention, so outputs match token-for-token
+            # (called before the resume check: next_token/degrade are
+            # needed on the restored path too)
             pf = self._admitted_prefill_tokens(req)
+            if req.resume_carry is not None:
+                # byte-exact resume: the stashed row_state payload
+                # (KV + scales + lanes + mirrors + draft) restores
+                # whole — _configure_slot then sets knobs only
+                self.pool.restore_row(slot, req.resume_carry)
+                req.resume_carry = None
+                self._restored.add(slot)
+                continue
             if not pf:
                 self.pool.set_pos(slot, 0)
-                continue
-            if req.resume_carry is not None:
-                # byte-exact preemption resume: scatter the stashed row
-                self.pool.write_prefill(slot, req.resume_carry, len(pf))
-                req.resume_carry = None
                 continue
             t0 = self._clock()
             ptoks = jnp.asarray([pf], jnp.int32)
@@ -768,24 +780,28 @@ class ServingEngine:
         return self._faults.call(site, fn, *args)
 
     def _preempt_row(self, victim: Request) -> None:
-        """Loss-free preemption of one RUNNING row: stash its pooled
-        carry slice on the request (scattered back bitwise at
-        readmission), share it into the prefix cache when one is
-        attached (any request on the same prefix benefits), then free
-        the slot and requeue the request at its ORIGINAL arrival key —
-        preemption reorders latency, never tokens."""
+        """Loss-free preemption of one RUNNING row: stash its FULL
+        ``pool.row_state`` payload on the request (KV + int8 scales +
+        RNG lane + penalty counts + chunk mirrors + draft slice —
+        restored bitwise at readmission through ``restore_row``, the
+        same serialization the disaggregated handoff speaks), share its
+        carry into the prefix cache when one is attached (any request
+        on the same prefix benefits), then free the slot and requeue
+        the request at its ORIGINAL arrival key — preemption reorders
+        latency, never tokens."""
         slot = victim.slot
-        row = self.pool.read_row(slot)
+        payload = self.pool.row_state(slot)
         if len(victim.prompt) + len(victim.output) > 1:
-            victim.resume_carry = row
+            victim.resume_carry = payload
             if self.prefix_cache is not None:
                 fed0 = [t - 1 for t in victim.prompt] + \
                        [t - 1 for t in victim.output]
-                self.prefix_cache.insert(fed0[:-1], row)
+                self.prefix_cache.insert(fed0[:-1], payload["carry"])
         victim.preemptions += 1
         self.scheduler.requeue(victim)            # running -> waiting
         self.pool.free(slot)
         self._configured.discard(slot)
+        self._restored.discard(slot)
         self.metrics.on_preempt()
 
     def _recover_rows(self, rows, now: float) -> None:
@@ -797,6 +813,7 @@ class ServingEngine:
         progress — a persistent fault fails requests, not the engine."""
         for slot, req in rows:
             self._configured.discard(slot)
+            self._restored.discard(slot)
             if self.admitter is not None:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
             req.retries += 1
@@ -882,7 +899,12 @@ class ServingEngine:
         reflects the CURRENT output length, not the fresh-request
         default. That host-side reconstruction is the whole loss-free
         eviction contract's second half (the KV half is prefill
-        replay/the stashed row)."""
+        replay/the stashed row). A slot RESTORED from a full
+        ``row_state`` payload (preemption resume, disaggregated
+        handoff) skips the device half entirely: its lane, counts, and
+        draft cache arrived verbatim with the payload — byte-identical
+        to what the rebuild would write, without the device traffic —
+        and only the host knob rows are (re)built here."""
         sp = req.sampling
         scal, ban_row = knob_row_values(sp, req.eos_id)
         for k, v in scal.items():
@@ -893,6 +915,10 @@ class ServingEngine:
             # resumed mid-stream: the ban may already have lifted
             self._knobs["ban"][slot] = len(req.output) < sp.min_tokens
         self._knobs_device = None                # re-upload next step
+        if slot in self._restored:
+            self._restored.discard(slot)
+            self._configured.add(slot)
+            return
         key = self._lane_key(req)
         if req.output:
             key = advance_lane(key, len(req.output))
@@ -924,15 +950,27 @@ class ServingEngine:
         return None
 
     def _finish_row(self, req: Request, reason: str, now: float) -> None:
-        """Evict a finished request: free its slot, ledger it, account
-        the latency/throughput metrics (plus the SLO verdict for
-        goodput, and the recovery-success counter for requests that
-        survived a fault eviction)."""
-        req.finish_reason = reason
-        req.resume_carry = None
+        """Evict a finished request: free its slot, then the shared
+        ledger tail (:meth:`_ledger_finish`)."""
         freed = self.scheduler.finish(req, now)
         self.pool.free(freed)
         self._configured.discard(freed)
+        self._restored.discard(freed)
+        self._ledger_finish(req, reason, now)
+
+    def _ledger_finish(self, req: Request, reason: str,
+                       now: float) -> None:
+        """THE finish-ledger tail — reason counter, finished ledger,
+        latency/logprob/SLO accounting (plus the recovery-success
+        counter for requests that survived an eviction). One spelling
+        shared by :meth:`_finish_row` (slot-holding rows) and slotless
+        terminations (the disaggregated plane's transfer-retry
+        error-out), so a new finish-time counter can never cover one
+        path and miss the other."""
+        req.finish_reason = reason
+        req.resume_carry = None
+        req.state = FINISHED
+        req.finish_time = now
         self._finished[req.req_id] = req
         self._evict_finished()
         self.metrics.on_finish_reason(reason)

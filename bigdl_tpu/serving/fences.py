@@ -48,6 +48,8 @@ FENCE_SITES = frozenset({
     "verify",    # the speculative super-step's verify readback
     "draft",     # completion of the chained draft dispatches (timing)
     "prefill",   # completion of a prefill/chunk dispatch (timing)
+    "transfer",  # KV-row handoff serialization (disagg.pack_payload):
+                 # one batched readback of every payload leaf
 })
 
 

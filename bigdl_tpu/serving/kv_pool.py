@@ -296,10 +296,11 @@ class KVPool:
 
     def read_row(self, slot: int) -> Dict:
         """One allocated slot's carry as a B=1 slice, every leaf (K/V
-        layers + scales, pos, sampling lanes) — the stash a PREEMPTED
-        row leaves behind. The slices are fresh device arrays (jax
+        layers + scales, pos, sampling lanes) — the carry half of the
+        :meth:`row_state` payload a PREEMPTED or handed-off row leaves
+        behind. The slices are fresh device arrays (jax
         arrays are immutable), so they survive the slot's ``free()``
-        and later scatter BACK via :meth:`write_prefill` bitwise — the
+        and later scatter BACK via :meth:`restore_row` bitwise — the
         loss-free half of the eviction + readmission contract
         (``ServingEngine._preempt_row``). The dict is also a valid
         :class:`~bigdl_tpu.serving.prefix_cache.PrefixCache` entry (the
@@ -307,7 +308,22 @@ class KVPool:
         be shared with other requests on the same prefix."""
         if slot not in self._in_use:
             raise ValueError(f"slot {slot} is not allocated")
-        return {k: v[slot:slot + 1] for k, v in self.carry.items()}
+        return self._fresh_rows(self.carry, slot)
+
+    def _fresh_rows(self, carry: Dict, slot: int) -> Dict:
+        """B=1 slices of ``carry`` at ``slot`` that are guaranteed
+        FRESH buffers. The guarantee matters on an n_slots == 1 pool:
+        jax returns the array ITSELF for a full-window slice, so the
+        "stash" would alias the live pool buffers and die with the
+        next donated scatter/reset — the latent single-slot stash bug
+        the unified row_state API exists to close (pinned by
+        tests/test_serving_disagg.py)."""
+        import jax.numpy as jnp
+
+        rows = {k: v[slot:slot + 1] for k, v in carry.items()}
+        if self.n_slots == 1:
+            rows = {k: jnp.array(v, copy=True) for k, v in rows.items()}
+        return rows
 
     def set_pos(self, slot: int, pos: int) -> None:
         """Set one slot's position counter (the no-prefill admission path:
@@ -316,6 +332,72 @@ class KVPool:
             raise ValueError(f"slot {slot} is not allocated")
         self.carry["pos"] = self.carry["pos"].at[slot].set(int(pos))
         self.chunk_done[slot] = int(pos)
+
+    # -- unified row serialization (stash + handoff) -----------------------
+
+    def row_state(self, slot: int) -> Dict:
+        """EVERYTHING one allocated slot carries, as the canonical row
+        payload (``serving/disagg.py``'s ``ROW_PAYLOAD_KEYS`` schema
+        minus the request metadata): the B=1 target-carry slice from
+        :meth:`read_row` (K/V layers, int8 dequant scales, ``pos``, and
+        — on sampling carries — the RNG lane, penalty counts, and
+        prompt mask), the ``chunk_done``/``chunk_target`` host mirrors,
+        and the attached DRAFT carry's B=1 slice (``None`` without
+        one). This is THE row-serialization API: the engine's
+        preemption stash and the disaggregated prefill→decode handoff
+        both speak it, so a per-slot field added to the carry can never
+        again be captured by one path and silently dropped by the other
+        (the latent-bug class the old carry-only stash invited).
+        :meth:`restore_row` is the inverse — byte-identical, pinned by
+        tests/test_serving_disagg.py."""
+        payload = {"carry": self.read_row(slot),
+                   "chunk_done": int(self.chunk_done[slot]),
+                   "chunk_target": int(self.chunk_target[slot]),
+                   "draft": None}
+        if self.draft_carry is not None:
+            payload["draft"] = self._fresh_rows(self.draft_carry, slot)
+        return payload
+
+    def restore_row(self, slot: int, payload: Dict) -> None:
+        """Scatter a :meth:`row_state` payload into an allocated slot,
+        byte-identically: K/V + scales + ``pos`` through the donated
+        admission scatter, sampling lanes/counts/mask by direct row
+        set (the :meth:`write_sampling` leaves, restored verbatim
+        instead of rebuilt), the chunk mirrors from the payload's own
+        values, and the draft slice through the draft scatter when both
+        sides carry one. Accepts device arrays (in-process stash) and
+        the numpy arrays a deserialized transfer payload holds alike —
+        and never reads the device back (ASY301): the scatter's ``pos``
+        rides as the payload's own traced scalar, so a hot-path restore
+        costs dispatches, not syncs. A pos == 0 row (a 1-token prompt
+        that never prefilled) scatters harmlessly — its K/V bytes are
+        zeros/stale behind pos, like any recycled slot's."""
+        import jax.numpy as jnp
+
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        carry = payload["carry"]
+        # one donated scatter restores K/V + scales and sets pos from
+        # the payload's own (traced) value
+        self.carry = self._scatter(
+            self.carry, carry, jnp.int32(slot),
+            jnp.asarray(carry["pos"])[0], jnp.int32(0))
+        # sampling lanes ride the payload (write_sampling's leaves):
+        # restored verbatim, not rebuilt — the handoff receiver must
+        # reproduce the sender's lane state without knowing its seed
+        for key in ("rng", "tok_counts", "prompt_mask"):
+            if key in carry and key in self.carry:
+                self.carry[key] = self.carry[key].at[slot].set(
+                    jnp.asarray(carry[key])[0])
+        # host mirrors from the payload's own values (SRV203 lockstep):
+        # a completed prefill hands off done == pos, target == 0 or pos
+        self.chunk_done[slot] = int(payload["chunk_done"])
+        self.chunk_target[slot] = int(payload["chunk_target"])
+        draft = payload.get("draft")
+        if draft is not None and self.draft_carry is not None:
+            self.draft_carry = self._draft_scatter(
+                self.draft_carry, draft, jnp.int32(slot),
+                jnp.asarray(draft["pos"])[0], jnp.int32(0))
 
     # -- chunk progress (chunked streaming admission) ----------------------
 

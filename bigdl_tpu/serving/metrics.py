@@ -104,6 +104,20 @@ Resilience counters (``serving/scheduler.py`` + ``serving/faults.py``):
   ``goodput`` = finished_in_slo / submitted — the overload bench's
   headline (``serving_bench --scenario slo``)
 
+Disaggregated-plane counters (``serving/disagg.py`` — recorded on the
+front end's metrics; each pool's engine keeps its own full set):
+
+* ``handoffs``         — prefill→decode KV-row handoffs (sum)
+* ``transfer_bytes``   — serialized payload bytes per handoff (sum =
+  total wire traffic; ``summary()`` derives
+  ``transfer_bytes_per_handoff``)
+* ``transfer_s``       — per-handoff transfer wall (pack + send +
+  deliver on the in-process path); ``transfer_percentiles()``
+  summarizes, ``summary()`` reports the p99
+* ``prefill_occupancy`` / ``decode_occupancy`` — per-step pool slot
+  occupancies (one decode sample per pool per step) — the
+  pool-sizing signal
+
 KV-format counters (``serving/kv_pool.py`` — set once at construction):
 
 * ``kv_bits``            — bits per stored K/V element (32/16/8)
@@ -332,6 +346,33 @@ class ServingMetrics:
         the service-time estimate says it cannot finish in time."""
         self.metrics.add("serving/infeasible", 1.0)
 
+    # -- disaggregated-plane hooks (serving/disagg.py) ---------------------
+
+    def on_handoff(self, n_bytes: int, seconds: float) -> None:
+        """One prefill→decode KV-row handoff: the serialized payload's
+        size on the wire and the transfer wall (pack + send on the
+        sending clock; the in-process engine's sample covers the full
+        pack→deliver path). ``summary()`` derives the per-handoff byte
+        mean and the transfer_s p99."""
+        self.metrics.add("serving/handoffs", 1.0)
+        self.metrics.add("serving/transfer_bytes", float(n_bytes))
+        self.metrics.add("serving/transfer_s", float(seconds))
+
+    def on_pool_occupancy(self, prefill_occ: float, decode_occs) -> None:
+        """Per-front-end-step pool occupancies: the prefill pool's
+        slot usage and each decode pool's (one sample per pool per
+        step). A prefill pool pinned at 1.0 while decode pools idle
+        says the split is prefill-bound — resize the pools, not the
+        engine (the interference signal disaggregation turns into a
+        CAPACITY signal)."""
+        self.metrics.add("serving/prefill_occupancy", float(prefill_occ))
+        for occ in decode_occs:
+            self.metrics.add("serving/decode_occupancy", float(occ))
+
+    def transfer_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        """Percentiles of the per-handoff transfer wall (seconds)."""
+        return self._pctl("transfer_s", qs)
+
     def decode_step_estimate(self) -> Optional[float]:
         """MEDIAN of the recent decode-step samples (a bounded window,
         seconds), or None before the first decode step — the per-step
@@ -478,6 +519,7 @@ class ServingMetrics:
         for name in ("preempted", "shed", "deadline_missed", "retries",
                      "recovered_rows", "degraded", "finished_in_slo",
                      "infeasible", "chunks", "chunk_tokens",
+                     "handoffs", "transfer_bytes",
                      *(f"finish_{r}" for r in sorted(self.FINISH_REASONS))):
             total, n = self.metrics.get(f"serving/{name}")
             if n:
@@ -501,6 +543,12 @@ class ServingMetrics:
         if n_gap:
             out["serving/decode_gap_p99_s"] = \
                 self.decode_gap_percentiles()["p99"]
+        n_hand, n_hand_n = self.metrics.get("serving/handoffs")
+        if n_hand_n:
+            nb, _ = self.metrics.get("serving/transfer_bytes")
+            out["serving/transfer_bytes_per_handoff"] = nb / n_hand
+            out["serving/transfer_p99_s"] = \
+                self.transfer_percentiles()["p99"]
         _, n_host = self.metrics.get("serving/host_step_s")
         if n_host:
             hp = self.host_step_percentiles()

@@ -78,10 +78,13 @@ class Request:
     :class:`~bigdl_tpu.serving.admission.Degrade` applied at admission
     when the engine is under pressure), ``preemptions``/``retries``
     (how often this request was preempted / fault-evicted), and
-    ``resume_carry`` — a preempted row's stashed B=1 KV slice, scattered
-    back at readmission for byte-exact resumption (fault recovery
+    ``resume_carry`` — a stashed ``KVPool.row_state`` payload (KV +
+    int8 scales + RNG lane + penalty counts + chunk mirrors + draft
+    slice), restored whole at readmission for byte-exact resumption.
+    Preemption and the disaggregated prefill→decode handoff
+    (``serving/disagg.py``) both park their state here; fault recovery
     clears it and replays via prefill of ``prompt + output`` instead:
-    a suspect step's carry is never trusted)."""
+    a suspect step's carry is never trusted."""
 
     req_id: int
     prompt: List[int]                  # 1-based word ids, non-empty

@@ -1,0 +1,31 @@
+"""SRV202 (payload half): every string key on a serialized row-payload
+dict must be in ``serving/disagg.py``'s ``ROW_PAYLOAD_KEYS`` schema
+(request, carry, draft, chunk_done, chunk_target).  A typo'd transfer
+key silently drops a field on the wire — the receiving pool restores a
+row missing its chunk mirrors or draft slice and the stream diverges
+only under load.  The canonical spellings (and the inner carry-schema
+reads, which stay governed by the carry half) are the false-positive
+guards."""
+
+from bigdl_tpu.serving.disagg import unpack_payload
+
+
+def route_handoff(blob, pool, slot):
+    meta, payload = unpack_payload(blob)
+    done = payload["chunk_done"]                  # schema — fine
+    target = payload.get("chunk_target", 0)       # schema — fine
+    if "draft" in payload:                        # schema — fine
+        draft = payload["draft"]                  # schema — fine
+    carry = payload["carry"]                      # schema — fine
+    pos = carry["pos"]                            # carry schema — fine
+    stale = payload["chunk_doen"]                 # EXPECT: SRV202
+    payload["cary"] = carry                       # EXPECT: SRV202
+    extra = payload.get("draft_carry")            # EXPECT: SRV202
+    return meta, done, target, pos, stale, extra
+
+
+def repack(payload):
+    payload["request"] = {"req_id": 0}            # schema — fine
+    if "requset" in payload:                      # EXPECT: SRV202
+        del payload["requset"]                    # EXPECT: SRV202
+    return payload

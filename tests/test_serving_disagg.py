@@ -1,0 +1,487 @@
+"""Disaggregated serving plane (bigdl_tpu/serving/disagg.py): unified
+row-serialization byte-identity, monolithic-parity through handoff
+(greedy + fixed-seed sampled, fp32 + bf16), prefix-cache interop,
+evict/readmit inside the decode pool, fault-during-transfer recovery,
+zero-extra-compiles per pool, both transfer backends (in-process queue
+and block_store, including a real 2-process handoff), and the bench
+smoke."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.disagg
+
+
+def _make_lm(V=29, hidden=32, heads=4, layers=2, max_len=48, seed=9):
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(seed)
+    lm = TransformerLM(V, hidden_size=hidden, n_heads=heads,
+                       n_layers=layers, max_len=max_len)
+    lm._ensure_params()
+    lm.evaluate()
+    return lm
+
+
+def _trace(V=29, n=8, seed=3):
+    """Mixed prompts: a 1-token prompt, a shared prefix pair, ragged
+    lengths — the admission shapes that have historically broken."""
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, V + 1, size=(k,)).tolist()
+               for k in (4, 7, 1, 12, 5, 9, 6, 11)][:n]
+    if n >= 4:                      # a shared-prefix pair for the cache
+        prompts[3] = prompts[1][:5] + prompts[3][:4]
+    return prompts
+
+
+def _samplings(n=8):
+    from bigdl_tpu.serving import SamplingParams
+
+    mixes = [None,
+             SamplingParams(temperature=0.8, top_k=8, seed=11),
+             None,
+             SamplingParams(temperature=1.1, top_p=0.9),      # engine lane
+             SamplingParams(temperature=0.7, repetition_penalty=1.3,
+                            seed=5),
+             None,
+             SamplingParams(temperature=0.9, min_tokens=3,
+                            frequency_penalty=0.2, seed=7),
+             None]
+    return mixes[:n]
+
+
+def _drain_pair(lm, dtype, prompts, samplings, gen=8, slots=6, **dkw):
+    """The same trace through the monolithic engine and a
+    DisaggregatedEngine; returns (mono outputs, disagg outputs,
+    disagg engine)."""
+    from bigdl_tpu.serving import DisaggregatedEngine, ServingEngine
+
+    mono = ServingEngine(lm, n_slots=slots, compute_dtype=dtype)
+    for p, sp in zip(prompts, samplings):
+        mono.submit(p, max_new_tokens=gen, sampling=sp)
+    mono_out = mono.drain()
+
+    kw = dict(prefill_slots=slots, decode_slots=slots, decode_pools=2,
+              compute_dtype=dtype)
+    kw.update(dkw)
+    d = DisaggregatedEngine(lm, **kw)
+    for p, sp in zip(prompts, samplings):
+        d.submit(p, max_new_tokens=gen, sampling=sp)
+    d_out = d.drain()
+    return mono_out, d_out, d
+
+
+def _assert_same(mono_out, d_out):
+    assert set(mono_out) == set(d_out)
+    for rid in mono_out:
+        assert np.array_equal(mono_out[rid], d_out[rid]), (
+            f"request {rid}: {mono_out[rid]} != {d_out[rid]}")
+
+
+# -- unified row serialization ----------------------------------------------
+
+def test_row_state_round_trips_every_field_int8_speculative():
+    """row_state -> pack -> unpack -> restore_row is byte-identical for
+    EVERY per-slot field on the richest carry there is: int8 K/V with
+    per-(slot, head) dequant scales, RNG lane, penalty counts, prompt
+    mask, chunk mirrors, and the speculative draft carry (pos
+    included) — the fields the old carry-only stash path could have
+    silently dropped."""
+    from bigdl_tpu.serving import (
+        SamplingParams, ServingEngine, SpeculativeConfig,
+        pack_payload, unpack_payload,
+    )
+    from bigdl_tpu.serving.disagg import request_meta
+
+    lm = _make_lm()
+    draft = _make_lm(seed=21)
+    eng = ServingEngine(lm, n_slots=3, kv_dtype="int8",
+                        speculative=SpeculativeConfig(draft, k=2))
+    eng.submit([3, 7, 2, 9], max_new_tokens=6,
+               sampling=SamplingParams(temperature=0.8, top_k=6,
+                                       seed=13))
+    eng.step()
+    eng.step()
+    (slot, req), = eng.scheduler.running.items()
+    # give the chunk mirrors distinguishable values
+    eng.pool.chunk_target[slot] = 9
+
+    state = eng.pool.row_state(slot)
+    assert state["draft"] is not None            # draft slice captured
+    blob = pack_payload(request_meta(req), state)
+    meta, restored = unpack_payload(blob)
+    assert meta["req_id"] == req.req_id
+    assert meta["output"] == req.output
+
+    # wipe the slot, then restore from the deserialized payload
+    before = {k: np.asarray(v).copy() for k, v in eng.pool.carry.items()}
+    dbefore = {k: np.asarray(v).copy()
+               for k, v in eng.pool.draft_carry.items()}
+    eng.scheduler.running.pop(slot)
+    eng.pool.free(slot)
+    s2 = eng.pool.alloc()
+    assert s2 == slot                            # LIFO free list
+    eng.pool.restore_row(s2, restored)
+
+    for k, v in before.items():
+        got = np.asarray(eng.pool.carry[k])
+        assert np.array_equal(got[slot], v[slot]), f"carry[{k}] drifted"
+    for k, v in dbefore.items():
+        got = np.asarray(eng.pool.draft_carry[k])
+        assert np.array_equal(got[slot], v[slot]), f"draft[{k}] drifted"
+    assert int(eng.pool.chunk_done[slot]) == state["chunk_done"]
+    assert int(eng.pool.chunk_target[slot]) == 9
+    # int8 specifics really captured
+    assert any(k.endswith("_scale") for k in state["carry"])
+    assert {"rng", "tok_counts", "prompt_mask"} <= set(state["carry"])
+
+
+def test_preemption_stash_rides_row_state():
+    """The priority-preemption stash now speaks the unified payload:
+    the victim's resume_carry carries the chunk mirrors and (restored
+    at readmission) the exact RNG lane — and the stream stays
+    byte-identical to an unpreempted run."""
+    from bigdl_tpu.serving import SamplingParams, ServingEngine
+
+    lm = _make_lm()
+    base = ServingEngine(lm, n_slots=2)
+    sp = SamplingParams(temperature=0.9, top_k=10, seed=31)
+    r0 = base.submit([3, 7, 2, 9, 4], max_new_tokens=10, sampling=sp)
+    want = base.drain()[r0]
+
+    eng = ServingEngine(lm, n_slots=1, policy="priority")
+    r1 = eng.submit([3, 7, 2, 9, 4], max_new_tokens=10, sampling=sp,
+                    priority=0)
+    for _ in range(3):
+        eng.step()
+    eng.submit([5, 5], max_new_tokens=2, priority=5)   # forces eviction
+    victim = eng.request(r1)
+    while eng.scheduler.running and \
+            next(iter(eng.scheduler.running.values())).req_id == r1:
+        eng.step()
+    stash = next(e[1] for e in eng.scheduler._waiting
+                 if e[1].req_id == r1).resume_carry
+    assert stash is not None and set(stash) == {
+        "carry", "draft", "chunk_done", "chunk_target"}
+    outs = eng.drain()
+    assert eng.request(r1).preemptions >= 1
+    assert np.array_equal(outs[r1], want)
+
+
+# -- parity through handoff -------------------------------------------------
+
+@pytest.mark.parametrize("variant", ["fp32", "bf16"])
+def test_disagg_parity_mixed_trace(variant):
+    """Token identity vs the monolithic engine on a mixed greedy/
+    sampled trace (explicit AND engine-derived lanes) across two
+    decode pools, fp32 and bf16 serving params."""
+    import jax.numpy as jnp
+
+    lm = _make_lm()
+    dtype = None if variant == "fp32" else jnp.bfloat16
+    mono_out, d_out, _ = _drain_pair(lm, dtype, _trace(), _samplings())
+    _assert_same(mono_out, d_out)
+
+
+def test_disagg_prefix_cache_interop():
+    """The prefix cache lives in the prefill pool: shared-prefix
+    traffic HITS there and outputs stay identical to the monolithic
+    prefix-cached engine."""
+    lm = _make_lm()
+    prompts = _trace()
+    # several requests over one long shared prefix
+    prompts[5] = prompts[1] + [2, 4]
+    prompts[6] = prompts[1] + [8]
+    mono_out, d_out, d = _drain_pair(
+        lm, None, prompts, [None] * len(prompts), prefix_cache=True)
+    _assert_same(mono_out, d_out)
+    s = d.prefill.engine.metrics.summary()
+    assert s.get("serving/prefix_hits", 0.0) or \
+        s["serving/prefix_hit_rate"] > 0
+
+
+def test_disagg_chunked_admission_parity():
+    """Chunked streaming admission in the prefill pool (PARTIAL rows
+    never hand off mid-stream; completed rows do) stays
+    token-identical."""
+    lm = _make_lm()
+    mono_out, d_out, d = _drain_pair(
+        lm, None, _trace(), _samplings(), admission="chunked",
+        chunk_budget=6)
+    _assert_same(mono_out, d_out)
+    assert d.prefill.engine.metrics.summary().get("serving/chunks", 0) > 0
+
+
+def test_disagg_evict_readmit_in_decode_pool():
+    """Priority preemption INSIDE a decode pool (evict + byte-exact
+    readmit of a handed-off row) preserves parity with the monolithic
+    engine."""
+    lm = _make_lm()
+    prompts = _trace(n=6)
+    sps = _samplings(6)
+    from bigdl_tpu.serving import DisaggregatedEngine, ServingEngine
+
+    mono = ServingEngine(lm, n_slots=6)
+    for p, sp in zip(prompts, sps):
+        mono.submit(p, max_new_tokens=8, sampling=sp)
+    mono_out = mono.drain()
+
+    # low-priority rows first, driven until they hold the 2 decode
+    # slots; the late high-priority arrivals must then EVICT one
+    d = DisaggregatedEngine(lm, prefill_slots=6, decode_slots=2,
+                            decode_pools=1, policy="priority")
+    for p, sp in zip(prompts[:4], sps[:4]):
+        d.submit(p, max_new_tokens=8, sampling=sp, priority=0)
+    for _ in range(3):
+        d.step()
+    for p, sp in zip(prompts[4:], sps[4:]):
+        d.submit(p, max_new_tokens=8, sampling=sp, priority=5)
+    d_out = d.drain()
+    _assert_same(mono_out, d_out)
+    assert d.summary().get("serving/preempted", 0) >= 1
+
+
+def test_disagg_fault_during_transfer_recovers_loss_free():
+    """A transfer backend that fails its first sends: the front end
+    requeues the row WITH its payload (no prefill replay needed), the
+    handoff retries next step, and the streams stay identical."""
+    from bigdl_tpu.serving import DisaggregatedEngine, InProcessTransfer
+
+    class FlakyTransfer(InProcessTransfer):
+        def __init__(self, fail_first: int):
+            super().__init__()
+            self.fails_left = fail_first
+
+        def send(self, blob):
+            if self.fails_left > 0:
+                self.fails_left -= 1
+                raise OSError("transfer fabric hiccup")
+            super().send(blob)
+
+    lm = _make_lm()
+    prompts, sps = _trace(), _samplings()
+    from bigdl_tpu.serving import ServingEngine
+
+    mono = ServingEngine(lm, n_slots=6)
+    for p, sp in zip(prompts, sps):
+        mono.submit(p, max_new_tokens=8, sampling=sp)
+    mono_out = mono.drain()
+
+    d = DisaggregatedEngine(lm, prefill_slots=6, decode_slots=6,
+                            decode_pools=2,
+                            transfer_factory=lambda i: FlakyTransfer(2))
+    for p, sp in zip(prompts, sps):
+        d.submit(p, max_new_tokens=8, sampling=sp)
+    d_out = d.drain()
+    _assert_same(mono_out, d_out)
+    retries = d.prefill.engine.metrics.metrics.get("serving/retries")[0]
+    assert retries >= 1                  # the failed sends were retried
+
+
+def test_disagg_persistent_transfer_failure_errors_out():
+    """A fabric that NEVER delivers must fail requests with
+    finish_reason='error' (bounded by the watchdog's retry budget),
+    not wedge drain() in a restore→pack→send loop forever."""
+    from bigdl_tpu.serving import (
+        DisaggregatedEngine, InProcessTransfer, WatchdogConfig,
+    )
+
+    class DeadTransfer(InProcessTransfer):
+        def send(self, blob):
+            raise OSError("fabric down")
+
+    lm = _make_lm()
+    d = DisaggregatedEngine(lm, prefill_slots=2, decode_slots=2,
+                            decode_pools=1,
+                            watchdog=WatchdogConfig(max_retries=2),
+                            transfer_factory=lambda i: DeadTransfer())
+    rids = [d.submit(p, max_new_tokens=4) for p in _trace(n=3)]
+    d.drain()                            # must terminate
+    for rid in rids:
+        req = d.request(rid)
+        assert req.finish_reason == "error"
+        assert req.retries == 3          # budget + the failing try
+    s = d.summary()
+    assert s["serving/finish_error"] == len(rids)
+
+
+def test_disagg_zero_extra_compiles_per_pool():
+    """A disaggregated pass over a warm model compiles NOTHING: the
+    decode pools run the monolithic engine's ONE decode program and
+    the prefill pool its bucketed prefill set (per-(model, dtype) step
+    caches are process-wide)."""
+    from tests.compile_guards import compile_count
+
+    from bigdl_tpu.serving import DisaggregatedEngine, ServingEngine
+
+    lm = _make_lm()
+    prompts, sps = _trace(), _samplings()
+    mono = ServingEngine(lm, n_slots=6)
+    for p, sp in zip(prompts, sps):
+        mono.submit(p, max_new_tokens=8, sampling=sp)
+    mono.drain()
+    decode_before = compile_count(mono._step_fn)
+    prefill_before = compile_count(mono._batch_prefill_fn)
+    assert decode_before == 1            # the one-program discipline
+
+    d = DisaggregatedEngine(lm, prefill_slots=6, decode_slots=6,
+                            decode_pools=2)
+    for p, sp in zip(prompts, sps):
+        d.submit(p, max_new_tokens=8, sampling=sp)
+    d.drain()
+    for w in d.decoders:
+        assert compile_count(w.engine._step_fn) == decode_before
+    assert compile_count(d.prefill.engine._batch_prefill_fn) \
+        == prefill_before
+
+
+# -- transfer backends ------------------------------------------------------
+
+def test_disagg_blockstore_backend_in_process(tmp_path):
+    """The block_store transfer backend (Mem + Fs stores) carries the
+    same wire bytes as the in-process queue: parity holds and the
+    consumed keys are deleted (the store never grows)."""
+    import os
+
+    from bigdl_tpu.parallel.block_store import FsBlockStore, MemBlockStore
+    from bigdl_tpu.serving import BlockStoreTransfer
+
+    lm = _make_lm()
+    for store in (MemBlockStore(), FsBlockStore(str(tmp_path / "bs"))):
+        mono_out, d_out, d = _drain_pair(
+            lm, None, _trace(), _samplings(),
+            transfer_factory=lambda i, s=store:
+                BlockStoreTransfer(s, f"decode{i}"))
+        _assert_same(mono_out, d_out)
+    assert os.listdir(str(tmp_path / "bs")) == []    # consumed + deleted
+
+
+_TWO_PROC_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.utils.random_gen import RNG
+from bigdl_tpu.parallel.block_store import FsBlockStore, encode_array
+from bigdl_tpu.serving import BlockStoreTransfer, DecodeWorker
+
+RNG.set_seed(9)
+lm = TransformerLM(29, hidden_size=32, n_heads=4, n_layers=2, max_len=48)
+lm._ensure_params(); lm.evaluate()
+store = FsBlockStore({root!r})
+w = DecodeWorker(lm, n_slots=4,
+                 transfer=BlockStoreTransfer(store, "handoff"))
+want = {n}
+published = set()
+deadline = time.time() + 300
+while len(published) < want and time.time() < deadline:
+    if not w.step():
+        time.sleep(0.01)
+    for rid, req in list(w.engine._finished.items()):
+        if rid not in published and req.state == "finished":
+            store.put(f"result_{{rid}}",
+                      encode_array(np.asarray(req.output, np.int32)))
+            published.add(rid)
+sys.exit(0 if len(published) == want else 1)
+"""
+
+
+@pytest.mark.slow
+def test_disagg_two_process_blockstore_handoff(tmp_path):
+    """The real cross-process shape: this process runs the PREFILL
+    pool, a child process runs a DECODE pool, and KV rows cross
+    through an FsBlockStore — outputs must match the monolithic
+    engine run entirely in-process (the two processes build identical
+    weights from the shared seed)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    from bigdl_tpu.parallel.block_store import FsBlockStore, decode_array
+    from bigdl_tpu.serving import (
+        BlockStoreTransfer, PrefillWorker, ServingEngine,
+    )
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+
+    lm = _make_lm()
+    prompts = _trace(n=5)
+    sps = _samplings(5)
+    mono = ServingEngine(lm, n_slots=5)
+    rids = [mono.submit(p, max_new_tokens=6, sampling=sp)
+            for p, sp in zip(prompts, sps)]
+    mono_out = mono.drain()
+
+    root = str(tmp_path / "store")
+    store = FsBlockStore(root)
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         _TWO_PROC_CHILD.format(repo=repo, root=root,
+                                n=len(prompts))],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        pw = PrefillWorker(lm, n_slots=5,
+                           transfer=BlockStoreTransfer(store, "handoff"))
+        for p, sp in zip(prompts, sps):
+            pw.submit(p, max_new_tokens=6, sampling=sp)
+        while not pw.idle():
+            pw.pump()
+        for rid in rids:
+            blob = store.get_blocking(f"result_{rid}", timeout_s=300)
+            got = decode_array(blob)
+            assert np.array_equal(got, mono_out[rid]), (
+                f"request {rid} diverged across the process boundary")
+    finally:
+        try:
+            child.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait()
+    assert child.returncode == 0, child.stderr.read().decode()[-2000:]
+    assert pw.engine.metrics.summary().get("serving/handoffs", 0) \
+        == len(prompts)
+
+
+# -- accounting + bench smoke ----------------------------------------------
+
+def test_disagg_metrics_and_accounting():
+    """Handoff-plane counters populate, and the finish-reason union
+    across pools keeps summing to every request's fate (shed at the
+    prefill door included)."""
+    from bigdl_tpu.serving import DisaggregatedEngine
+
+    lm = _make_lm()
+    d = DisaggregatedEngine(lm, prefill_slots=2, decode_slots=2,
+                            decode_pools=2, max_queue=0)
+    rids = [d.submit(p, max_new_tokens=4) for p in _trace(n=6)]
+    d.drain()
+    s = d.summary()
+    n_fin = s.get("serving/finish_length", 0)
+    n_shed = s.get("serving/finish_shed", 0)
+    assert n_fin + n_shed == len(rids)
+    assert s["serving/handoffs"] == n_fin
+    assert s["serving/transfer_bytes_per_handoff"] > 0
+    assert s["serving/transfer_p99_s"] >= 0
+    assert 0 <= s["serving/decode_occupancy"] <= 1
+    # shed requests are observable per request, like the monolithic
+    # engine's backpressure contract
+    shed = [r for r in rids if d.request(r).finish_reason == "shed"]
+    assert len(shed) == n_shed
+    for r in shed:
+        assert d.result(r) is not None and len(d.result(r)) == 0
+
+
+def test_serving_bench_disagg_smoke():
+    """The bench scenario's contracts hold at smoke scale (parity +
+    compile-free timed passes are asserted inside run_disagg)."""
+    import importlib
+
+    bench = importlib.import_module("benchmarks.serving_bench")
+    out = bench.run_disagg("tiny", "fp32", n_requests=6, gen_tokens=6,
+                           n_slots=4, decode_pools=2)
+    assert out["outputs_match"] is True
+    assert out["disagg"]["handoffs"] == 6
+    assert out["disagg"]["decode_programs"] \
+        == out["monolithic"]["decode_programs"]

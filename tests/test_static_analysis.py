@@ -758,6 +758,7 @@ def test_async_census_sites_enumerated():
     for f, m in _fence_sites_in(SERVING_DIR):
         counts[f.name] = counts.get(f.name, 0) + 1
     assert counts == {"admission.py": 2, "chunked.py": 1,
+                      "disagg.py": 1,
                       "engine.py": 2, "speculative.py": 3}, counts
 
 
@@ -781,7 +782,13 @@ def test_async_census_every_fence_site_individually_detected(tmp_path):
             paren = src.index("(", m.start())
             repl = "jax.block_until_ready(" if m.group(1) \
                 else "jax.device_get("
-            fpath.write_text(src[:m.start()] + repl + src[paren + 1:])
+            mutated = src[:m.start()] + repl + src[paren + 1:]
+            if "import jax" not in mutated:
+                # the raw spelling must RESOLVE for the census to be a
+                # fair counterfactual — a file whose only jax touch was
+                # the fence idiom (disagg.py) never binds the name
+                mutated = "import jax\n" + mutated
+            fpath.write_text(mutated)
             found = analyze_paths([str(tmp_path)], select=ASY_CODES)
             want = "ASY302" if m.group(1) else "ASY301"
             assert [f.code for f in found] == [want], (
