@@ -72,6 +72,18 @@ latency percentiles. On one CPU host the split shows handoff OVERHEAD
 (both pools share the socket); the interference win is per-pool
 hardware, priced analytically by pod_projection's disagg rows.
 
+``--scenario failover`` exercises POOL-LEVEL fault tolerance
+(``serving/health.py``): a decode pool is KILLED mid-stream at several
+fault seeds (each seed varies the victim, the kill step, and the
+sampling lanes) and the scenario ASSERTS token-identical outputs vs
+the monolithic engine for every affected row plus zero new compiles
+on the surviving pool, reporting failover latency p50/p99 and the
+migrated/replayed row split. A second section runs the occupancy
+autoscaler (1 active + 1 standby pool) through a bursty
+submit-drain-idle cycle and asserts it is FLAP-FREE: at most one
+activation per burst, at most one drain-and-retire per lull, streams
+still identical.
+
 ``--scenario sampling`` exercises the per-row sampling subsystem
 (``serving/sampling.py``): mixed greedy/sampled traffic (distinct
 temperature/top-k/top-p/penalty mixes, fixed seeds) against an
@@ -1037,6 +1049,125 @@ def run_disagg(model: str = "tiny", variant: str = "fp32",
     }
 
 
+def run_failover(model: str = "tiny", variant: str = "fp32",
+                 n_requests: int = 12, gen_tokens: int = 16,
+                 n_slots: int = 6, decode_pools: int = 2,
+                 seeds=(0, 1, 2)) -> dict:
+    """Pool-death chaos + autoscaler cycle (``serving/health.py``).
+
+    Section 1 — FAILOVER: the mixed greedy/sampled trace runs through
+    the monolithic engine once, then through the disaggregated plane
+    once per fault seed; each pass KILLS one decode pool mid-stream
+    (the seed picks the victim, the kill step, and the trace).
+    ASSERTED (a green line IS the claim): token-identical outputs
+    request for request — rows the dead pool owned come back loss-free
+    from the last-handoff stash or by byte-identical prefill replay of
+    prompt + emitted — and ZERO new decode programs on the surviving
+    pools. REPORTED: failover latency p50/p99 (detect → every stranded
+    row re-routed, real wall clock) and the migrated/replayed split.
+
+    Section 2 — AUTOSCALER: one active + one standby decode pool under
+    a bursty submit→drain→idle cycle (two bursts). ASSERTED: streams
+    still match the monolithic engine, and the controller is
+    FLAP-FREE — at most one activation per burst and one
+    drain-and-retire per lull (hysteresis: dead band + sustain window
+    + cooldown; docs/serving.md has the math)."""
+    from bigdl_tpu.serving import AutoscalerConfig, DisaggregatedEngine
+
+    lm, dtype, cfg = build(model, variant)
+    trace = make_mixed_trace(cfg, n_requests, gen_tokens)
+    # warm both paths so the kill passes are compile-free and the
+    # failover timer measures re-routing, not XLA
+    warm = [(p, 2, sp) for p, _, sp in trace]
+    _run_sampling_engine(lm, dtype, warm, n_slots, greedy=False)
+    eng_m, rids_m, outs_m, mono = _run_sampling_engine(
+        lm, dtype, trace, n_slots, greedy=False)
+
+    fo_samples: list = []
+    n_migrated = n_replayed = n_deaths = 0
+    match = True
+    for seed in seeds:
+        # decode pools at HALF the slots: the kill then strands both
+        # row kinds — seated rows (stash stale → prefill replay) and
+        # queued rows (stash current → loss-free migration)
+        d = DisaggregatedEngine(lm, prefill_slots=n_slots,
+                                decode_slots=max(2, n_slots // 2),
+                                decode_pools=decode_pools,
+                                compute_dtype=dtype)
+        rids_d = [d.submit(p, max_new_tokens=n, sampling=sp)
+                  for p, n, sp in trace]
+        for _ in range(1 + seed):
+            d.step()
+        victim = seed % decode_pools
+        survivors = [w for j, w in enumerate(d.decoders) if j != victim]
+        programs_before = [w.engine._step_fn._cache_size()
+                           for w in survivors]
+        d.kill_pool(victim)
+        outs_d = d.drain()
+        match &= all(np.array_equal(outs_m[rm], outs_d[rd])
+                     for rm, rd in zip(rids_m, rids_d))
+        assert match, (
+            f"failover seed {seed}: outputs diverged through the pool "
+            "death — stash restore / prefill replay must be byte-exact")
+        after = [w.engine._step_fn._cache_size() for w in survivors]
+        assert after == programs_before, (
+            f"failover seed {seed}: survivors compiled "
+            f"{sum(after) - sum(programs_before)} new decode "
+            "program(s) — failover must reuse the shared step caches")
+        s = d.summary()
+        n_deaths += int(s.get("serving/pool_deaths", 0))
+        n_migrated += int(s.get("serving/migrated_rows", 0))
+        n_replayed += int(s.get("serving/replayed_rows", 0))
+        fo_samples += d.metrics.metrics.values("serving/failover_s")
+
+    fo = np.asarray(fo_samples) if fo_samples else np.zeros((1,))
+    failover_ms = {"p50": round(1e3 * float(np.percentile(fo, 50)), 3),
+                   "p99": round(1e3 * float(np.percentile(fo, 99)), 3)}
+
+    # -- autoscaler cycle (bursty trace) ------------------------------------
+    a = DisaggregatedEngine(
+        lm, prefill_slots=n_slots, decode_slots=max(2, n_slots // 3),
+        decode_pools=1, standby_pools=1, compute_dtype=dtype,
+        autoscaler=AutoscalerConfig(high_water=0.9, low_water=0.3,
+                                    sustain=2, cooldown=3))
+    bursts = 2
+    auto_match = True
+    for b in range(bursts):
+        rids_a = [a.submit(p, max_new_tokens=n, sampling=sp)
+                  for p, n, sp in trace]
+        outs_a = a.drain()
+        auto_match &= all(np.array_equal(outs_m[rm], outs_a[ra])
+                          for rm, ra in zip(rids_m, rids_a))
+        for _ in range(12):               # the lull: cold pools retire
+            a.step()
+    sa = a.summary()
+    ups = int(sa.get("serving/autoscale_up", 0))
+    downs = int(sa.get("serving/autoscale_down", 0))
+    flap_free = ups <= bursts and downs <= bursts and auto_match
+    assert flap_free, (
+        f"autoscaler flapped: {ups} up / {downs} down over {bursts} "
+        "burst cycles (hysteresis must bound one action per swing)")
+
+    return {
+        "metric": "serving_failover_parity_and_latency",
+        "model": model, "variant": variant, "requests": n_requests,
+        "gen_tokens": gen_tokens, "slots": n_slots,
+        "decode_pools": decode_pools, "fault_seeds": list(seeds),
+        "outputs_match": bool(match),
+        "pool_deaths": n_deaths,
+        "failover_ms": failover_ms,
+        "migrated_rows": n_migrated,
+        "replayed_rows": n_replayed,
+        "monolithic": mono,
+        "autoscaler": {
+            "bursts": bursts, "autoscale_up": ups,
+            "autoscale_down": downs,
+            "flap_free": bool(flap_free),
+            "final_pool_states": a.pool_states(),
+        },
+    }
+
+
 def _run_sharded_engine(lm, dtype, trace, n_slots: int, parallelism):
     from bigdl_tpu.serving import ServingEngine
 
@@ -1235,7 +1366,7 @@ def main() -> None:
     ap.add_argument("--scenario", default="mixed",
                     choices=["mixed", "admission", "sampling", "sharded",
                              "kv_quant", "speculative", "slo", "chunked",
-                             "disagg"])
+                             "disagg", "failover"])
     ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
     ap.add_argument("--variant", default="fp32", choices=["fp32", "bf16"])
     # requests/gen_tokens/slots default per scenario: mixed 12/48/12,
@@ -1269,6 +1400,14 @@ def main() -> None:
                     help="disagg: decode pools fed by the one prefill "
                          "pool (in-process transfer)")
     args = ap.parse_args()
+    if args.scenario == "failover":
+        print(json.dumps(run_failover(
+            args.model, args.variant,
+            n_requests=args.requests or 12,
+            gen_tokens=args.gen_tokens or 16,
+            n_slots=args.slots or 6,
+            decode_pools=args.decode_pools)))
+        return
     if args.scenario == "disagg":
         print(json.dumps(run_disagg(
             args.model, args.variant,

@@ -10,7 +10,7 @@ SPMD103     recompile hazards in/around jitted programs
 SPMD104     donated buffer reused after the donating call
 SPMD105     Python control flow on traced values
 SPMD106     shard_map specs naming axes the mesh does not have
-SRV201-205  serving contracts (whole-program fact table)
+SRV201-206  serving contracts (whole-program fact table)
 ASY301-305  async readiness: host-sync hygiene on the HOT PATH, scoped
             by call-graph reachability from the serving super-step
             roots (core.hotpath_chains)
@@ -1506,6 +1506,131 @@ class FinishReasonRule(Rule):
                     f"reason {arg.value!r} passed to {seg}() is not in "
                     f"ServingMetrics.FINISH_REASONS {sorted(vocab)}",
                     hint=self.hint)
+
+
+# -- SRV206 — stranded rows -------------------------------------------------
+
+@register
+class StrandedRowRule(Rule):
+    code = "SRV206"
+    name = "stranded-row"
+    summary = ("row removed from a scheduler table with no requeue, "
+               "handoff, or finish disposition in scope")
+    hint = ("every code path that takes a request out of a pool's "
+            "running/partial tables must leave it SOMEWHERE: "
+            "requeue/submit it back into a scheduler, serialize it "
+            "for handoff (row_state / pack_payload), or land a "
+            "FINISH_REASONS disposition (_finish_row/_ledger_finish/"
+            "_shed/on_finish_reason/finish/cancel) — the static twin "
+            "of the pool-failover invariant (docs/serving.md \"Pool "
+            "failover and autoscaling\"). A row that silently leaves "
+            "the tables strands its request: drain() never finishes "
+            "it and no finish_<reason> counter accounts for it. The "
+            "scheduler's own primitives (the class that OWNS the "
+            "tables) are the sanctioned removal spellings and are "
+            "exempt")
+
+    #: the slot-holding scheduler tables the invariant covers (the
+    #: waiting heap has its own closed drop surface — pop_waiting —
+    #: inside the owning class)
+    _TABLES = ("running", "partial")
+    #: removal spellings on a table receiver
+    _REMOVERS = ("pop", "clear", "popitem")
+    #: calls that give the removed row a destination: scheduler
+    #: re-entry, handoff serialization, or a finish disposition
+    _KEEPERS = {"requeue", "submit", "row_state", "pack_payload",
+                "finish", "_finish_row", "_ledger_finish", "_shed",
+                "on_finish_reason", "cancel_running", "cancel"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_scope(ctx):
+            return
+        for node in ctx.by_type(ast.Delete, ast.Call):
+            hit = self._removal(ctx, node)
+            if hit is None:
+                continue
+            table, recv = hit
+            fn = ctx.enclosing_function(node)
+            if fn is None:
+                continue                 # module-level = fixture setup
+            if recv == f"self.{table}" and self._class_owns_tables(ctx,
+                                                                   node):
+                continue                 # the owner's primitives
+            if self._has_keeper(ctx, fn, node):
+                continue
+            verb = "del" if isinstance(node, ast.Delete) else \
+                f".{node.func.attr}()"
+            yield ctx.finding(
+                node, self.code,
+                f"row removed from `{recv}` ({verb}) with no "
+                f"requeue/submit, row_state/pack_payload handoff, or "
+                f"finish disposition in "
+                f"`{getattr(fn, 'name', '<lambda>')}` — the request "
+                f"is stranded",
+                hint=self.hint)
+
+    def _removal(self, ctx: FileContext,
+                 node: ast.AST) -> Optional[Tuple[str, str]]:
+        """(table, receiver-spelling) when ``node`` removes from a
+        running/partial table: ``del <x>.running[...]`` or
+        ``<x>.running.pop/clear/popitem(...)``."""
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    recv = ctx.dotted(t.value)
+                    table = self._table_of(recv)
+                    if table is not None:
+                        return table, recv
+            return None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in self._REMOVERS:
+            recv = ctx.dotted(node.func.value)
+            table = self._table_of(recv)
+            if table is not None:
+                return table, recv
+        return None
+
+    def _table_of(self, recv: Optional[str]) -> Optional[str]:
+        if recv is None:
+            return None
+        for table in self._TABLES:
+            if recv == table or recv.endswith("." + table):
+                return table
+        return None
+
+    def _class_owns_tables(self, ctx: FileContext,
+                           node: ast.AST) -> bool:
+        """Is ``node`` inside a class whose own body assigns
+        ``self.running`` (the Scheduler shape)? Its methods ARE the
+        sanctioned removal primitives."""
+        cur = ctx.parents.get(node)
+        while cur is not None and not isinstance(cur, ast.ClassDef):
+            cur = ctx.parents.get(cur)
+        if cur is None:
+            return False
+        for sub in ast.walk(cur):
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, ast.AnnAssign):   # self.running: Dict
+                targets = [sub.target]
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        t.attr in self._TABLES and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    return True
+        return False
+
+    def _has_keeper(self, ctx: FileContext, fn: ast.AST,
+                    removal: ast.AST) -> bool:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and sub is not removal:
+                seg = _last_seg(ctx.dotted(sub.func))
+                if seg in self._KEEPERS:
+                    return True
+        return False
 
 
 # ==========================================================================

@@ -26,8 +26,12 @@ splits the plane into a PREFILL POOL and DECODE POOLS with serialized
 KV-row handoff between them (``KVPool.row_state``/``restore_row`` —
 the same byte-exact payload the preemption stash speaks; in-process
 queue or ``block_store`` transfer backends), token-identical to the
-monolithic engine at zero extra compiles per pool. See
-``docs/serving.md``.
+monolithic engine at zero extra compiles per pool — and ``health.py``
+makes each POOL a failure domain: heartbeat/transfer-failure health
+classification, decode-pool failover that reconstructs every stranded
+row loss-free-or-replayed with token-identical streams, graceful
+``drain_pool`` migration, backoff-hardened transfer retries, and an
+occupancy autoscaler with hysteresis. See ``docs/serving.md``.
 
     from bigdl_tpu.serving import SamplingParams, ServingEngine
 
@@ -48,7 +52,11 @@ from bigdl_tpu.serving.chunked import ChunkedAdmissionController
 from bigdl_tpu.serving.disagg import (
     BlockStoreTransfer, DecodeWorker, DisaggregatedEngine,
     InProcessTransfer, KVTransfer, PrefillWorker, ROW_PAYLOAD_KEYS,
-    pack_payload, unpack_payload,
+    pack_payload, payload_header, unpack_payload,
+)
+from bigdl_tpu.serving.health import (
+    AutoscalerConfig, HealthConfig, OccupancyAutoscaler, PoolHealth,
+    TransferRetryConfig,
 )
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import (
@@ -75,4 +83,7 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "FENCE_SITES", "fence", "fence_wait",
            "DisaggregatedEngine", "PrefillWorker", "DecodeWorker",
            "KVTransfer", "InProcessTransfer", "BlockStoreTransfer",
-           "ROW_PAYLOAD_KEYS", "pack_payload", "unpack_payload"]
+           "ROW_PAYLOAD_KEYS", "pack_payload", "payload_header",
+           "unpack_payload", "HealthConfig", "PoolHealth",
+           "TransferRetryConfig", "AutoscalerConfig",
+           "OccupancyAutoscaler"]

@@ -59,6 +59,39 @@ subscript on a ``payload``-named dict whose key is not in it — a
 typo'd transfer key is machine-caught before it ships a row that
 restores wrong.
 
+**Pool-level fault tolerance** (``serving/health.py``): each decode
+pool is a FAILURE DOMAIN. The front end stamps a heartbeat per
+completed worker super-step and records transfer-send verdicts, and
+classifies every pool HEALTHY / SUSPECT / DEAD from missed beats and
+consecutive send failures (:class:`~bigdl_tpu.serving.health.
+PoolHealth`, VirtualClock-driven so tests never sleep). SUSPECT pools
+stop receiving new handoffs; a DEAD pool triggers **failover**
+(:meth:`DisaggregatedEngine._failover_pool`): handoffs still on the
+wire are re-routed untouched (channel state outlives the pool
+process), and every row the dead pool's host-side ledger owned is
+reconstructed on a survivor — loss-free from the front end's
+last-handoff stash where that copy is still current, else by
+byte-identical prefill replay of ``prompt + emitted`` (the PR 8
+row-recovery contract lifted to pool scope). Token streams are
+IDENTICAL through a pool death (greedy and fixed-seed sampled,
+pinned by tests/test_serving_health.py and ``serving_bench
+--scenario failover``) and survivors compile NOTHING new.
+:meth:`DisaggregatedEngine.drain_pool` is the GRACEFUL twin: it stops
+routing to a live pool, migrates its rows out through the ordinary
+``row_state`` wire handoff, and retires it to STANDBY — reactivation
+is compile-free (the step caches are process-wide). On top of both
+sits the occupancy **autoscaler** (:class:`~bigdl_tpu.serving.health.
+OccupancyAutoscaler` over the existing ``prefill_occupancy``/
+``decode_occupancy`` signals): sustained pressure activates standby
+pools, sustained cold drains-and-retires the least-loaded pool, with
+hysteresis (dead band + sustain window + cooldown) so it never flaps.
+Transfer sends harden accordingly: per-request EXPONENTIAL BACKOFF
+and a send timeout (:class:`~bigdl_tpu.serving.health.
+TransferRetryConfig`; the injector's ``transfer_stall`` mode
+simulates the hung fabric), receiver-side duplicate suppression by
+request id, and cancel() sweeps handoffs still in a channel so a
+decode pool never restores a cancelled row.
+
     from bigdl_tpu.serving import DisaggregatedEngine
 
     eng = DisaggregatedEngine(lm, prefill_slots=8, decode_slots=8,
@@ -74,7 +107,7 @@ import json
 import struct
 from collections import deque
 from dataclasses import asdict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -84,9 +117,13 @@ from bigdl_tpu.parallel.block_store import (
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import FaultError, default_clock
 from bigdl_tpu.serving.fences import fence
+from bigdl_tpu.serving.health import (
+    DEAD, HEALTHY, POOL_ACTIVE, POOL_DEAD, POOL_STANDBY, AutoscalerConfig,
+    HealthConfig, OccupancyAutoscaler, PoolHealth, TransferRetryConfig,
+)
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.sampling import SamplingParams
-from bigdl_tpu.serving.scheduler import FINISHED, Request
+from bigdl_tpu.serving.scheduler import CANCELLED, FINISHED, Request
 
 #: THE serialized row-payload schema — every top-level key a handoff
 #: payload may carry. ``carry`` is the B=1 target-carry slice (its own
@@ -125,6 +162,11 @@ def request_meta(req: Request) -> Dict:
         "deadline_s": req.deadline_s,
         "submit_time": float(req.submit_time),
         "first_token_time": req.first_token_time,
+        # fault-budget continuity: a row bounced across pools by
+        # repeated failures must keep burning ONE watchdog retry
+        # budget, not get a fresh one per pool
+        "retries": int(req.retries),
+        "preemptions": int(req.preemptions),
     }
 
 
@@ -146,19 +188,32 @@ def request_from_meta(meta: Dict) -> Request:
     req.output = [int(t) for t in meta.get("output", ())]
     req.logprobs = [float(v) for v in meta.get("logprobs", ())]
     req.first_token_time = meta.get("first_token_time")
+    req.retries = int(meta.get("retries", 0))
+    req.preemptions = int(meta.get("preemptions", 0))
     return req
 
 
 # -- the wire codec ---------------------------------------------------------
 
-def pack_payload(meta: Dict, payload: Dict) -> bytes:
+def pack_payload(meta: Dict, payload: Optional[Dict]) -> bytes:
     """Serialize one handoff — request header + ``KVPool.row_state``
     payload — to bytes: a JSON header (request metadata, chunk mirrors,
     and the ORDERED carry/draft key lists) followed by one
     length-prefixed :func:`~bigdl_tpu.parallel.block_store.encode_array`
     blob per leaf. Every leaf rides the self-describing array codec, so
     the receiver needs no out-of-band dtype/shape agreement (bf16 and
-    int8 carries round-trip bitwise)."""
+    int8 carries round-trip bitwise).
+
+    ``payload=None`` packs a META-ONLY handoff (``carry_keys`` null, no
+    array blobs): the REPLAY form pool failover sends when a dead
+    pool's row has no current state copy — the receiver reconstructs
+    the request and replays ``prompt + emitted`` through prefill
+    (byte-identical, the PR 8 recovery contract)."""
+    if payload is None:
+        head = {"request": meta, "chunk_done": 0, "chunk_target": 0,
+                "carry_keys": None, "draft_keys": None}
+        hj = json.dumps(head).encode()
+        return b"".join([_WIRE_MAGIC, struct.pack("<q", len(hj)), hj])
     carry = payload["carry"]
     draft = payload.get("draft")
     head = {
@@ -186,10 +241,21 @@ def pack_payload(meta: Dict, payload: Dict) -> bytes:
     return b"".join(parts)
 
 
-def unpack_payload(blob: bytes) -> Tuple[Dict, Dict]:
+def payload_header(blob: bytes) -> Dict:
+    """Just the JSON header of a packed handoff — request metadata and
+    key lists, no array decode. The cheap read failover and the cancel
+    sweep use for bookkeeping (is this stash copy still current? whose
+    row is on this wire?) without touching the payload bytes."""
+    if blob[:4] != _WIRE_MAGIC:
+        raise ValueError("not a row-handoff payload")
+    (nh,) = struct.unpack_from("<q", blob, 4)
+    return json.loads(blob[12:12 + nh].decode())
+
+
+def unpack_payload(blob: bytes) -> Tuple[Dict, Optional[Dict]]:
     """Inverse of :func:`pack_payload`: ``(request metadata, row_state
     payload)`` with numpy leaves — exactly what ``KVPool.restore_row``
-    accepts."""
+    accepts. A meta-only (replay) handoff returns ``payload=None``."""
     if blob[:4] != _WIRE_MAGIC:
         raise ValueError("not a row-handoff payload")
     off = 4
@@ -197,6 +263,8 @@ def unpack_payload(blob: bytes) -> Tuple[Dict, Dict]:
     off += 8
     head = json.loads(blob[off:off + nh].decode())
     off += nh
+    if head["carry_keys"] is None:
+        return head["request"], None
 
     def _arrays(keys):
         nonlocal off
@@ -328,34 +396,50 @@ class PrefillWorker:
 
     def __init__(self, model, n_slots: int = 8,
                  transfer: Optional[KVTransfer] = None,
+                 retry: Optional[TransferRetryConfig] = None,
                  **engine_kw) -> None:
         self.engine = ServingEngine(model, n_slots=n_slots, **engine_kw)
         self.transfer = transfer
+        self.retry = retry if retry is not None else TransferRetryConfig()
         self._peak_occupancy = 0.0
+        # exponential-backoff parking lot: (due_time, request) entries
+        # a failed handoff deferred — pump() releases them back into
+        # the queue once the engine clock passes their due time, so a
+        # down fabric is probed at a decaying rate instead of
+        # hammered every pump
+        self._deferred: List[Tuple[float, Request]] = []
 
     def submit(self, *args, **kwargs) -> int:
         """Queue one request (the :meth:`ServingEngine.submit`
         surface, including backpressure shedding at the door)."""
         return self.engine.submit(*args, **kwargs)
 
-    def _release(self, slot: int, req: Request) -> None:
+    def _release(self, slot: int, req: Request) -> Dict:
         # the row leaves this pool entirely: its lifecycle continues at
-        # a decode worker, so it is popped (not finished) and its slot
-        # returns to the free list for the next admission wave
+        # a decode worker, so its FULL row_state payload is captured
+        # FIRST (a row may leave the tables only as a handoff payload,
+        # a requeue, or a finish disposition — the SRV206 invariant),
+        # then it is popped (not finished) and its slot returns to the
+        # free list for the next admission wave
+        payload = self.engine.pool.row_state(slot)
         del self.engine.scheduler.running[slot]
         req.slot = None
         self.engine.pool.free(slot)
         self.engine._configured.discard(slot)
         self.engine._restored.discard(slot)
+        return payload
 
     def requeue(self, req: Request, payload: Dict) -> None:
         """Loss-free return of a handoff that could not be delivered
         (fault during pack or transfer): the payload goes back on the
         request and it re-enters the queue at its ORIGINAL arrival
-        key — at the next pump it restores byte-identically (no
-        prefill replay) and hands off again. BOUNDED by the engine
-        watchdog's ``max_retries`` (the step-recovery budget): a
-        persistently failing fabric fails the REQUEST with
+        key — at the next due pump it restores byte-identically (no
+        prefill replay) and hands off again. Re-entry BACKS OFF
+        exponentially per request (``TransferRetryConfig.delay`` on
+        the engine clock — attempt n waits base·2^(n-1) up to the
+        cap), and the whole loop is BOUNDED by the engine watchdog's
+        ``max_retries`` (the step-recovery budget): a persistently
+        failing fabric fails the REQUEST with
         ``finish_reason='error'`` instead of wedging ``drain()`` in a
         restore→pack→send loop forever — the same liveness contract
         the step watchdog enforces."""
@@ -366,16 +450,82 @@ class PrefillWorker:
             eng._ledger_finish(req, "error", eng._clock())
             return
         req.resume_carry = payload
-        eng.scheduler.submit(req)
         eng.metrics.on_retry()
+        delay = self.retry.delay(req.retries)
+        if delay > 0:
+            self._deferred.append((eng._clock() + delay, req))
+        else:
+            eng.scheduler.submit(req)
+
+    def send_handoff(self, transfer: KVTransfer, req: Request,
+                     payload: Optional[Dict], metrics: ServingMetrics,
+                     health: Optional[PoolHealth] = None
+                     ) -> Optional[bytes]:
+        """Pack and send one handoff through the guarded path: the
+        send consults the engine's fault injector (site
+        ``"transfer"`` — the ``transfer_stall`` mode lands
+        here), a raise OR an elapsed time past the configured
+        ``send_timeout_s`` requeues the request loss-free with
+        backoff (delivery unconfirmed — the RECEIVER deduplicates by
+        request id in case a slow send did land), and the verdict
+        feeds the target pool's health record. Returns the packed
+        blob on confirmed delivery, None when the request was
+        requeued (or failed out past the retry budget)."""
+        eng = self.engine
+        t0 = eng._clock()
+        try:
+            # pack INSIDE the recovery scope: the row already left
+            # every scheduler table, so a serialization failure
+            # (the transfer fence's device_get can surface real
+            # device errors) must requeue it, not lose it
+            blob = pack_payload(request_meta(req), payload)
+            # the send consults the injector DIRECTLY, not through
+            # engine._dispatch: that routing is the compiled-step
+            # discipline (SRV201), and a send moves host bytes — every
+            # device byte was already fenced inside pack_payload, so
+            # the elapsed time below measures real pack+send wall
+            if eng._faults is not None:
+                eng._faults.call("transfer", transfer.send, blob)
+            else:
+                transfer.send(blob)
+        except Exception:
+            if health is not None:
+                health.on_transfer_failure()
+            self.requeue(req, payload)
+            return None
+        elapsed = eng._clock() - t0
+        to = self.retry.send_timeout_s
+        if to is not None and elapsed > to:
+            # the send returned, but past the timeout the caller had
+            # already abandoned it: treat delivery as UNCONFIRMED —
+            # resend after backoff (ingest-side dedup absorbs the
+            # case where the slow send did land) and mark the fabric
+            if health is not None:
+                health.on_transfer_failure()
+            metrics.on_transfer_timeout()
+            self.requeue(req, payload)
+            return None
+        if health is not None:
+            health.on_transfer_ok()
+        metrics.on_handoff(len(blob), elapsed)
+        return blob
 
     def pump(self) -> List[Tuple[Request, Dict]]:
-        """One admission super-step: deadline/feasibility drops, slot
-        binding, bucketed (or chunked) prefill, then serialize-and-
-        release every prompt-complete row. Returns the finished
-        ``(request, row_state payload)`` pairs (empty when a transfer
-        is attached — those were sent)."""
+        """One admission super-step: release due backoff entries,
+        deadline/feasibility drops, slot binding, bucketed (or
+        chunked) prefill, then serialize-and-release every
+        prompt-complete row. Returns the finished ``(request,
+        row_state payload)`` pairs (empty when a transfer is
+        attached — those were sent)."""
         eng = self.engine
+        now = eng._clock()
+        if self._deferred:
+            due = [e for e in self._deferred if e[0] <= now]
+            if due:
+                self._deferred = [e for e in self._deferred
+                                  if e[0] > now]
+                for _, req in due:
+                    eng.scheduler.submit(req)
         eng._admit()
         if eng.admitter is not None:
             eng.admitter.pump()
@@ -395,27 +545,28 @@ class PrefillWorker:
                 except FaultError:
                     eng._recover_admission([(slot, req)])
                     continue
-            payload = eng.pool.row_state(slot)
-            self._release(slot, req)
+            payload = self._release(slot, req)
             if self.transfer is None:
                 out.append((req, payload))
                 continue
-            t0 = eng._clock()
-            try:
-                # pack INSIDE the recovery scope: the row already left
-                # every scheduler table, so a serialization failure
-                # (the transfer fence's device_get can surface real
-                # device errors) must requeue it, not lose it
-                blob = pack_payload(request_meta(req), payload)
-                self.transfer.send(blob)
-            except Exception:
-                self.requeue(req, payload)
-                continue
-            eng.metrics.on_handoff(len(blob), eng._clock() - t0)
+            self.send_handoff(self.transfer, req, payload, eng.metrics)
         return out
 
     def idle(self) -> bool:
-        return self.engine.scheduler.idle()
+        return self.engine.scheduler.idle() and not self._deferred
+
+    def cancel_deferred(self, req_id: int) -> Optional[Request]:
+        """Remove and return a request parked in the backoff lot
+        (failed/timed-out handoff awaiting its retry window), or None.
+        Cancellation must reach it here: a deferred request is in NO
+        scheduler and has no stash entry (the stash records confirmed
+        deliveries only), so without this sweep it would be
+        uncancellable until its resend."""
+        for k, (_, req) in enumerate(self._deferred):
+            if req.req_id == req_id:
+                del self._deferred[k]
+                return req
+        return None
 
     @property
     def occupancy(self) -> float:
@@ -448,36 +599,86 @@ class DecodeWorker:
 
     def __init__(self, model, n_slots: int = 8,
                  transfer: Optional[KVTransfer] = None,
+                 cancelled: Optional[Set[int]] = None,
+                 claims: Optional[Dict[int, "DecodeWorker"]] = None,
                  **engine_kw) -> None:
         self.engine = ServingEngine(model, n_slots=n_slots, **engine_kw)
         self.transfer = transfer if transfer is not None \
             else InProcessTransfer()
+        # liveness: a killed pool (process crash) runs nothing — the
+        # front end stops stepping it and its missed heartbeats (or an
+        # immediate kill_pool) classify it DEAD (serving/health.py)
+        self.alive = True
+        # shared cancel-sweep set (DisaggregatedEngine.cancel): request
+        # ids cancelled while their payload was still on the wire —
+        # ingest drops them so a cancelled row is never restored
+        self._cancelled = cancelled if cancelled is not None else set()
+        # shared delivery-claims registry (req_id -> the worker that
+        # last admitted it): duplicate suppression must span POOLS —
+        # a timed-out resend routes least-loaded, so the copy can land
+        # on a different pool than the slow original. Standalone
+        # workers get a private dict (self-claims only).
+        self._claims = claims if claims is not None else {}
 
-    def ingest(self, blob: bytes) -> int:
+    def _owns(self, req_id: int) -> bool:
+        """Is this request already anywhere in the worker (queued,
+        slot-holding, or finished)? The duplicate-suppression check
+        behind at-least-once sends: a timed-out handoff is resent, and
+        if the slow original DID land, the copy must be dropped."""
+        eng = self.engine
+        if req_id in eng._finished:
+            return True
+        sched = eng.scheduler
+        return (any(r.req_id == req_id for r in sched.running.values())
+                or any(r.req_id == req_id
+                       for r in sched.partial.values())
+                or any(e[1].req_id == req_id for e in sched._waiting))
+
+    def ingest(self, blob: bytes) -> Optional[int]:
         """Accept one packed handoff: reconstruct the request (global
         id intact) with its payload as ``resume_carry`` and queue it —
-        the next step's admission restores the row bitwise. Returns
-        the request id."""
+        the next step's admission restores the row bitwise (or, for a
+        meta-only REPLAY handoff, re-prefills ``prompt + emitted``
+        byte-identically). Returns the request id, or None when the
+        payload was dropped: swept as cancelled mid-flight, or a
+        duplicate of a row some pool already owns (a timed-out send
+        that landed after its resend — checked across POOLS through
+        the shared claims registry, then locally). A claim whose
+        worker no longer owns the row (failover/drain moved it out)
+        does not block: legitimate re-ingest after migration."""
         meta, payload = unpack_payload(blob)
+        rid = int(meta["req_id"])
+        if rid in self._cancelled:
+            return None
+        holder = self._claims.get(rid)
+        if holder is not None and holder is not self \
+                and holder._owns(rid):
+            return None                      # cross-pool duplicate
+        if self._owns(rid):
+            return None                      # same-pool duplicate
         req = request_from_meta(meta)
         req.resume_carry = payload
         self.engine.scheduler.submit(req)
-        return req.req_id
+        self._claims[rid] = self
+        return rid
 
     def poll(self) -> int:
         """Drain the transfer channel into the queue; returns how many
-        rows arrived."""
+        rows were accepted."""
         n = 0
         while True:
             blob = self.transfer.recv()
             if blob is None:
                 return n
-            self.ingest(blob)
-            n += 1
+            if self.ingest(blob) is not None:
+                n += 1
 
     def step(self) -> Dict[int, int]:
         """Poll the channel, then one engine super-step (admission of
-        restored rows + the batched decode/verify dispatch)."""
+        restored rows + the batched decode/verify dispatch). A dead
+        worker steps nothing — a crashed process runs no code."""
+        if not self.alive:
+            return {}
         self.poll()
         return self.engine.step()
 
@@ -523,11 +724,29 @@ class DisaggregatedEngine:
     :class:`InProcessTransfer`; pass e.g. ``lambda i:
     BlockStoreTransfer(store, f"decode{i}")`` for a shared store).
 
+    POOL LIFECYCLE knobs (``serving/health.py``; module docstring):
+    ``standby_pools`` builds extra decode workers that start idle
+    (weights resident, programs shared — activation is compile-free);
+    ``health`` (a :class:`~bigdl_tpu.serving.health.HealthConfig`)
+    sets the heartbeat/transfer-failure thresholds behind the
+    HEALTHY/SUSPECT/DEAD classification; ``transfer_retry`` (a
+    :class:`~bigdl_tpu.serving.health.TransferRetryConfig`) sets the
+    send timeout and per-request exponential backoff; ``autoscaler``
+    (an :class:`~bigdl_tpu.serving.health.AutoscalerConfig`, or
+    ``True`` for defaults) turns on the occupancy control loop that
+    activates standby pools under sustained pressure and
+    drains-and-retires cold ones. ``kill_pool``/``drain_pool``/
+    ``pool_states`` are the operator surface.
+
     Output parity with the monolithic engine is the module-level
-    contract; the front end's own metrics add the handoff plane:
-    ``serving/handoffs``, ``serving/transfer_bytes``,
-    ``serving/transfer_s``, ``serving/prefill_occupancy``,
-    ``serving/decode_occupancy`` (see ``ServingMetrics``)."""
+    contract — through pool deaths included; the front end's own
+    metrics add the handoff plane: ``serving/handoffs``,
+    ``serving/transfer_bytes``, ``serving/transfer_s``,
+    ``serving/prefill_occupancy``, ``serving/decode_occupancy``, and
+    the lifecycle counters ``serving/pool_deaths``/``failovers``/
+    ``failover_s``/``migrated_rows``/``replayed_rows``/
+    ``transfer_timeouts``/``autoscale_up``/``autoscale_down`` (see
+    ``ServingMetrics``)."""
 
     def __init__(self, model, prefill_slots: int = 8,
                  decode_slots: int = 8, decode_pools: int = 1,
@@ -543,12 +762,23 @@ class DisaggregatedEngine:
                  keep_finished: Optional[int] = None,
                  watchdog=None, faults=None, clock=None,
                  metrics: Optional[ServingMetrics] = None,
-                 transfer_factory=None) -> None:
+                 transfer_factory=None,
+                 standby_pools: int = 0,
+                 health: Optional[HealthConfig] = None,
+                 transfer_retry: Optional[TransferRetryConfig] = None,
+                 autoscaler=None) -> None:
         if decode_pools < 1:
             raise ValueError(
                 f"decode_pools must be >= 1, got {decode_pools}")
+        if standby_pools < 0:
+            raise ValueError(
+                f"standby_pools must be >= 0, got {standby_pools}")
         self._clock = clock if clock is not None else default_clock
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.health_config = health if health is not None \
+            else HealthConfig()
+        self.transfer_retry = transfer_retry if transfer_retry is not None \
+            else TransferRetryConfig()
         shared = dict(compute_dtype=compute_dtype, kv_dtype=kv_dtype,
                       speculative=speculative, seed=seed, clock=clock,
                       faults=faults, keep_finished=keep_finished)
@@ -560,14 +790,46 @@ class DisaggregatedEngine:
             chunk_budget=chunk_budget, prefix_cache=prefix_cache,
             deadline_feasibility=deadline_feasibility,
             max_queue=max_queue, policy=policy, preemption=False,
-            watchdog=watchdog, **shared)
+            watchdog=watchdog, retry=self.transfer_retry, **shared)
         make = transfer_factory if transfer_factory is not None \
             else (lambda i: InProcessTransfer())
+        # cancel-sweep set + delivery-claims registry, SHARED with
+        # every decode worker's ingest: ids cancelled while their
+        # payload sat in a transfer channel, and which pool admitted
+        # each row (cross-pool duplicate suppression for timed-out
+        # resends that route to a different pool)
+        self._cancelled: Set[int] = set()
+        self._claims: Dict[int, DecodeWorker] = {}
         self.decoders = [
             DecodeWorker(model, n_slots=decode_slots, transfer=make(i),
                          policy=policy, preemption=preemption,
-                         watchdog=watchdog, **shared)
-            for i in range(decode_pools)]
+                         watchdog=watchdog, cancelled=self._cancelled,
+                         claims=self._claims, **shared)
+            for i in range(decode_pools + standby_pools)]
+        # pool lifecycle: the first decode_pools workers serve, the
+        # rest wait warm on the bench (serving/health.py states)
+        self._pool_state = [POOL_ACTIVE] * decode_pools \
+            + [POOL_STANDBY] * standby_pools
+        self._health = [PoolHealth(self._clock, self.health_config)
+                        for _ in self.decoders]
+        # last-handoff stash: req_id -> the packed payload most
+        # recently sent for it. THE loss-free half of pool failover
+        # (a dead pool's row whose stash is still current re-routes
+        # bitwise) and the cancel sweep's ledger source; entries drop
+        # when their request finishes. Costs one host copy of each
+        # in-flight row's KV bytes at the front end — the price of
+        # replay-free failover.
+        self._stash: Dict[int, bytes] = {}
+        # the front end's own stepping cadence: heartbeat SILENCE is
+        # only meaningful while the plane is being driven (see step())
+        self._last_step_t: Optional[float] = None
+        if autoscaler:
+            cfg = autoscaler if isinstance(autoscaler, AutoscalerConfig) \
+                else AutoscalerConfig()
+            self._scaler: Optional[OccupancyAutoscaler] = \
+                OccupancyAutoscaler(cfg)
+        else:
+            self._scaler = None
 
     # -- request surface ---------------------------------------------------
 
@@ -610,47 +872,343 @@ class DisaggregatedEngine:
 
     def cancel(self, req_id: int) -> bool:
         """Cancel wherever the request currently lives: the prefill
-        pool (waiting / mid-prefill) or its decode pool (queued-for-
-        restore / decoding). With the in-process transfer there is no
-        wire window — every handoff lands in its decode pool's
-        scheduler within the same front-end step — but a row on a
-        CROSS-PROCESS wire is not recalled: this returns False and the
-        caller must re-issue the cancel after the row lands."""
+        pool (waiting / mid-prefill), its decode pool (queued-for-
+        restore / decoding), or — the wire window — a transfer channel
+        a dead, draining, or not-yet-stepped pool has not consumed. A
+        payload in flight is SWEPT, not recalled: the id joins the
+        shared cancelled set every ``DecodeWorker.ingest`` consults
+        (the decode pool drops the payload instead of restoring it),
+        and the cancellation is ledgered HERE from the stash header so
+        the ``finish_*`` union still sums to every submitted
+        request's fate. Returns False only for unknown or
+        already-finished requests."""
         for eng in self._engines():
             if eng.cancel(req_id):
+                self._stash.pop(req_id, None)
                 return True
-        return False
+        if self._lookup(req_id) is not None:
+            return False                     # already finished
+        # the backoff parking lot: a failed/timed-out handoff awaiting
+        # its retry window is in NO scheduler and has no stash entry —
+        # cancellation must reach it here or be silently lost until
+        # the resend
+        req = self.prefill.cancel_deferred(req_id)
+        if req is not None:
+            req.resume_carry = None
+            self._ledger_cancel(req)
+            return True
+        blob = self._stash.pop(req_id, None)
+        if blob is None:
+            return False                     # unknown request
+        self._cancelled.add(req_id)
+        self._ledger_cancel(
+            request_from_meta(payload_header(blob)["request"]))
+        return True
+
+    def _ledger_cancel(self, req: Request) -> None:
+        """Front-end cancellation ledger tail (wire sweep + backoff
+        sweep): the request lands CANCELLED in the prefill engine's
+        ledger so result()/accounting stay closed."""
+        req.state = CANCELLED
+        peng = self.prefill.engine
+        peng._finished[req.req_id] = req
+        peng._evict_finished()
+        peng.metrics.on_cancel()
+        peng.metrics.on_finish_reason("cancelled")
+
+    # -- pool lifecycle (health, failover, drain, autoscaling) -------------
+
+    def pool_states(self) -> List[str]:
+        """Per-decode-pool lifecycle state (``active``/``standby``/
+        ``dead``), index-aligned with ``self.decoders``."""
+        return list(self._pool_state)
+
+    def pool_health(self, i: int) -> str:
+        """Decode pool ``i``'s current health classification."""
+        return self._health[i].state()
+
+    def _route_index(self) -> int:
+        """The routing decision: least-loaded HEALTHY active decode
+        pool; falls back to SUSPECT actives when no healthy pool
+        exists (degraded service beats dropped rows). Raises when no
+        active pool remains at all."""
+        cands = [i for i, s in enumerate(self._pool_state)
+                 if s == POOL_ACTIVE and self.decoders[i].alive]
+        healthy = [i for i in cands
+                   if self._health[i].state() == HEALTHY]
+        pool = healthy if healthy else cands
+        if not pool:
+            raise RuntimeError(
+                "no active decode pool to route to — every pool is "
+                "dead or retired (add standby_pools, or activate one)")
+        return min(pool, key=lambda i: self.decoders[i].load)
+
+    def _check_health(self) -> None:
+        """Classify every active pool; a DEAD verdict (heartbeat
+        silence past ``dead_after_s``, ``dead_after_failures``
+        consecutive send failures, or a forced kill) triggers
+        failover before any routing this step.
+
+        One deliberate exception: a pool whose WORKER is still alive
+        (the fabric looks dead, the pool may be fine) is NOT failed
+        over while it is the last serving capacity — with no survivor
+        and no standby there is nowhere to move its rows, and
+        declaring the whole plane down would turn a broken cable into
+        a total outage. It keeps serving; the per-request transfer
+        retry budget bounds the damage (requests error out, the
+        engine never wedges). A worker that actually stopped
+        (``kill_pool``, process exit) fails over regardless — and
+        with no fallback that IS a total outage, raised loudly."""
+        for i, st in enumerate(self._pool_state):
+            if st != POOL_ACTIVE or self._health[i].state() != DEAD:
+                continue
+            fallback = any(
+                s == POOL_ACTIVE and j != i and self.decoders[j].alive
+                or s == POOL_STANDBY and self.decoders[j].alive
+                for j, s in enumerate(self._pool_state))
+            if not fallback and self.decoders[i].alive:
+                continue
+            self._failover_pool(i)
+
+    def kill_pool(self, i: int, immediate: bool = True) -> None:
+        """Operator/chaos hook: decode pool ``i`` crashes NOW — its
+        worker stops stepping (a dead process runs no code). With
+        ``immediate=True`` the death is known out-of-band (connection
+        refused / process exit) and the next ``step()`` fails over at
+        once; with ``immediate=False`` the front end discovers it
+        through missed heartbeats on the shared clock
+        (``HealthConfig.dead_after_s`` — a VirtualClock test advances
+        time, never sleeps)."""
+        if not 0 <= i < len(self.decoders):
+            raise ValueError(f"no decode pool {i}")
+        if self._pool_state[i] == POOL_DEAD:
+            raise ValueError(f"decode pool {i} is already dead")
+        self.decoders[i].alive = False
+        if self._pool_state[i] == POOL_STANDBY:
+            # a standby owns nothing: no failover to run, it just can
+            # never be activated now
+            self._pool_state[i] = POOL_DEAD
+            self._health[i].force_dead()
+            return
+        if immediate:
+            self._health[i].force_dead()
+
+    def _activate_pool(self, i: int) -> None:
+        """Promote a STANDBY pool to ACTIVE: compile-free (its engine
+        shares every program through the process-wide step caches) —
+        just routing state and a fresh bill of health."""
+        if self._pool_state[i] != POOL_STANDBY:
+            raise ValueError(
+                f"decode pool {i} is {self._pool_state[i]}, not standby")
+        if not self.decoders[i].alive:
+            raise ValueError(f"decode pool {i} was killed on standby")
+        self._pool_state[i] = POOL_ACTIVE
+        self._health[i].reset()
+
+    def _failover_pool(self, i: int) -> None:
+        """Reconstruct everything DEAD decode pool ``i`` owns on the
+        survivors — loss-free wherever a current state copy exists,
+        byte-identical replay elsewhere. Three strata:
+
+        1. handoffs still ON THE WIRE: channel state outlives the pool
+           process (a deque here, a block store across processes), so
+           the packed bytes re-route to a survivor untouched;
+        2. rows in the pool's HOST-SIDE ledger (its scheduler tables —
+           in the real deployment this ledger lives with the router,
+           which streams every emitted token to clients anyway) whose
+           last-handoff stash is still CURRENT (no tokens emitted
+           since): the stash blob re-routes — restore is bitwise, no
+           recompute;
+        3. rows that decoded past their stash: device state died with
+           the pool and is NEVER read — a meta-only REPLAY handoff
+           re-prefills ``prompt + emitted`` on the survivor,
+           byte-identical by the PR 8 recovery contract (RNG lanes are
+           request-keyed, penalty counts rebuild from the emitted
+           tokens).
+
+        Survivors admit all three through their ordinary ingest path —
+        zero new compiled programs. If no active pool survives, a
+        standby pool is activated first (no standby → raises: total
+        outage is the caller's problem)."""
+        w = self.decoders[i]
+        t0 = self._clock()
+        w.alive = False
+        self._pool_state[i] = POOL_DEAD
+        self._health[i].force_dead()
+        self.metrics.on_pool_death()
+        if not any(s == POOL_ACTIVE for s in self._pool_state):
+            stand = [j for j, s in enumerate(self._pool_state)
+                     if s == POOL_STANDBY and self.decoders[j].alive]
+            if not stand:
+                raise RuntimeError(
+                    f"decode pool {i} died with no surviving active "
+                    "pool and no standby to activate")
+            self._activate_pool(stand[0])
+        n_migrated = n_replayed = 0
+        while True:                          # stratum 1: the wire
+            blob = w.transfer.recv()
+            if blob is None:
+                break
+            self._forward(blob)
+            n_migrated += 1
+        sched = w.engine.scheduler
+        stranded = sched.pop_waiting(lambda r: True)
+        for slot in list(sched.running):
+            stranded.append(sched.running.pop(slot))
+        for slot in list(sched.partial):
+            stranded.append(sched.partial.pop(slot))
+        for req in stranded:                 # strata 2 + 3
+            req.slot = None
+            req.resume_carry = None
+            blob = self._stash.get(req.req_id)
+            if blob is not None and \
+                    payload_header(blob)["request"]["output"] \
+                    == [int(t) for t in req.output]:
+                n_migrated += 1
+            else:
+                blob = pack_payload(request_meta(req), None)
+                self._stash[req.req_id] = blob
+                n_replayed += 1
+            self._forward(blob)
+        self.metrics.on_failover(n_migrated, n_replayed,
+                                 self._clock() - t0)
+
+    def drain_pool(self, i: int) -> int:
+        """GRACEFULLY retire ACTIVE decode pool ``i``: stop routing to
+        it, migrate every row it owns to the surviving pools through
+        the ordinary ``row_state`` wire handoff (LOSS-FREE — the pool
+        is alive, so mid-stream rows serialize their live carry and
+        resume byte-identically on the receiver), and leave it
+        STANDBY: weights resident, programs shared through the
+        process-wide step caches, so both retiring and a later
+        reactivation are compile-free. Returns the migrated row
+        count."""
+        if not 0 <= i < len(self.decoders):
+            raise ValueError(f"no decode pool {i}")
+        if self._pool_state[i] != POOL_ACTIVE:
+            raise ValueError(
+                f"decode pool {i} is {self._pool_state[i]}, not active")
+        if sum(1 for s in self._pool_state if s == POOL_ACTIVE) < 2:
+            raise ValueError(
+                "cannot drain the last active decode pool — activate "
+                "another first")
+        w = self.decoders[i]
+        self._pool_state[i] = POOL_STANDBY   # routing excludes it now
+        n = 0
+        while True:                          # unconsumed wire payloads
+            blob = w.transfer.recv()
+            if blob is None:
+                break
+            self._forward(blob)
+            n += 1
+        sched = w.engine.scheduler
+        for req in sched.pop_waiting(lambda r: True):
+            # queued-for-restore rows: their payload (or, for a
+            # replay-requeued row, its absence) re-packs as-is
+            payload, req.resume_carry = req.resume_carry, None
+            blob = pack_payload(request_meta(req), payload)
+            self._stash[req.req_id] = blob
+            self._forward(blob)
+            n += 1
+        seated = [(s, sched.running.pop(s)) for s in list(sched.running)]
+        seated += [(s, sched.partial.pop(s)) for s in list(sched.partial)]
+        for slot, req in seated:
+            # slot-holding rows serialize their LIVE carry — the
+            # clean path failover cannot take (it never trusts a
+            # dead device)
+            payload = w.engine.pool.row_state(slot)
+            req.slot = None
+            w.engine.pool.free(slot)
+            w.engine._configured.discard(slot)
+            w.engine._restored.discard(slot)
+            blob = pack_payload(request_meta(req), payload)
+            self._stash[req.req_id] = blob
+            self._forward(blob)
+            n += 1
+        self.metrics.on_migrated(n)
+        return n
+
+    def _forward(self, blob: bytes) -> None:
+        """Route one already-packed handoff to the best surviving
+        pool. Failover/drain internals: the send is direct — the
+        target was just chosen as a live survivor, and recovery paths
+        do not re-enter the fault injector."""
+        self.decoders[self._route_index()].transfer.send(blob)
+
+    def _autoscale(self) -> None:
+        active = [i for i, s in enumerate(self._pool_state)
+                  if s == POOL_ACTIVE]
+        standby = [i for i, s in enumerate(self._pool_state)
+                   if s == POOL_STANDBY and self.decoders[i].alive]
+        occ = sum(self.decoders[i].occupancy for i in active) \
+            / max(len(active), 1)
+        decision = self._scaler.observe(
+            occ, self.prefill.engine.scheduler.queue_depth,
+            can_up=bool(standby),
+            can_down=len(active) > self._scaler.config.min_pools)
+        if decision == "up":
+            self._activate_pool(standby[0])
+            self.metrics.on_autoscale("up")
+        elif decision == "down":
+            victim = min(active, key=lambda i: self.decoders[i].load)
+            self.drain_pool(victim)
+            self.metrics.on_autoscale("down")
 
     # -- the serving loop --------------------------------------------------
 
     def _handoff(self, req: Request, payload: Dict) -> None:
-        worker = min(self.decoders, key=lambda w: w.load)
-        t0 = self._clock()
-        try:
-            # pack inside the recovery scope too — the row already
-            # left the prefill scheduler, so pack AND send failures
-            # both requeue loss-free (bounded by the watchdog's retry
-            # budget; past it the request fails with reason 'error')
-            blob = pack_payload(request_meta(req), payload)
-            worker.transfer.send(blob)
-        except Exception:
-            self.prefill.requeue(req, payload)
-            return
-        self.metrics.on_handoff(len(blob), self._clock() - t0)
+        i = self._route_index()
+        worker = self.decoders[i]
+        blob = self.prefill.send_handoff(worker.transfer, req, payload,
+                                         self.metrics,
+                                         health=self._health[i])
+        if blob is not None:
+            self._stash[req.req_id] = blob
 
     def step(self) -> Dict[int, int]:
-        """One front-end super-step: pump the prefill pool, route every
-        finished row to the least-loaded decode worker, then one decode
-        super-step per pool. Returns the merged ``{req_id: last emitted
-        1-based token}`` across pools."""
+        """One front-end super-step: health sweep (failing over any
+        pool classified DEAD), pump the prefill pool, route every
+        finished row to the least-loaded healthy decode worker, one
+        decode super-step per active pool (each completed step stamps
+        the pool's heartbeat), then the autoscaler sample. Returns the
+        merged ``{req_id: last emitted 1-based token}`` across
+        pools."""
+        now = self._clock()
+        if self._last_step_t is None or \
+                now - self._last_step_t \
+                > self.health_config.suspect_after_s:
+            # a gap in the CALLER's stepping cadence is not pool
+            # silence: during a traffic lull nobody was expected to
+            # beat, and classifying the whole fleet dead on the next
+            # step would turn every idle minute into a pool massacre.
+            # Restart every live pool's beat clock; a genuinely hung
+            # worker (alive but not beating) re-accumulates silence
+            # over the next dead_after_s of ACTIVE stepping.
+            for i, st in enumerate(self._pool_state):
+                if st == POOL_ACTIVE and self.decoders[i].alive:
+                    self._health[i].beat()
+        self._last_step_t = now
+        self._check_health()
         for req, payload in self.prefill.pump():
             self._handoff(req, payload)
         out: Dict[int, int] = {}
-        for worker in self.decoders:
+        for i, worker in enumerate(self.decoders):
+            if self._pool_state[i] != POOL_ACTIVE or not worker.alive:
+                continue
             out.update(worker.step())
+            self._health[i].beat()
+        # stash hygiene: a finished request's handoff copy is dead
+        # weight (and must never shadow a future failover decision)
+        done = [rid for rid in self._stash
+                if self._lookup(rid) is not None]
+        for rid in done:
+            del self._stash[rid]
+        if self._scaler is not None:
+            self._autoscale()
         self.metrics.on_pool_occupancy(
             self.prefill.occupancy,
-            [w.occupancy for w in self.decoders])
+            [w.occupancy for i, w in enumerate(self.decoders)
+             if self._pool_state[i] == POOL_ACTIVE])
         return out
 
     def idle(self) -> bool:

@@ -577,7 +577,11 @@ class ServingEngine:
             self._restored.discard(slot)
             if self.admitter is not None:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
-            req.resume_carry = None
+        # WAITING cancellations drop their stashed payload too: a
+        # preempted/handed-off row cancelled before readmission must
+        # not pin its KV slices in the finished ledger forever (the
+        # same teardown contract _shed follows)
+        req.resume_carry = None
         self.metrics.on_cancel()
         # cancellation is a disposition too: without this bucket the
         # finish_<reason> counters would not sum to every request's

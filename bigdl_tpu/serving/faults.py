@@ -109,8 +109,9 @@ class WatchdogConfig:
 #: Dispatch sites the engine routes through the injector. "decode" is
 #: the pooled decode step, "verify"/"draft" the speculative plane's two
 #: dispatches, "prefill" every admission-side prefill (B=1, bucketed,
-#: and prefix-suffix alike).
-SITES = ("decode", "verify", "draft", "prefill")
+#: and prefix-suffix alike), "transfer" the disaggregated plane's
+#: handoff sends (serving/disagg.py).
+SITES = ("decode", "verify", "draft", "prefill", "transfer")
 
 
 class FaultInjector:
@@ -122,7 +123,13 @@ class FaultInjector:
     garbage logits" shape the engine's health check must catch), or
     advance the shared :class:`VirtualClock` by ``stall_s`` after the
     dispatch (a slow step the watchdog times out). ``p_admit_fail``
-    applies to the "prefill" site (admission errors). At most one fault
+    applies to the "prefill" site (admission errors).
+    ``p_transfer_stall`` applies to the "transfer" site (disaggregated
+    handoff sends): the fabric HANGS — the shared clock advances by
+    ``stall_s`` and the send raises WITHOUT delivering, the shape a
+    caller abandoning a hung ``BlockStoreTransfer.send`` at its
+    timeout observes (the sender requeues with backoff;
+    ``serving/health.py``). At most one fault
     fires per dispatch (the probabilities stack); ``max_faults`` caps
     the total injected so a high-rate schedule still lets traffic
     through eventually. ``counts`` tallies injections by kind — tests
@@ -130,31 +137,35 @@ class FaultInjector:
 
     def __init__(self, seed: int = 0, p_fail: float = 0.0,
                  p_garbage: float = 0.0, p_stall: float = 0.0,
-                 p_admit_fail: float = 0.0, stall_s: float = 10.0,
+                 p_admit_fail: float = 0.0,
+                 p_transfer_stall: float = 0.0, stall_s: float = 10.0,
                  clock: Optional[VirtualClock] = None,
                  max_faults: Optional[int] = None) -> None:
         import numpy as np
 
         for name, p in (("p_fail", p_fail), ("p_garbage", p_garbage),
                         ("p_stall", p_stall),
-                        ("p_admit_fail", p_admit_fail)):
+                        ("p_admit_fail", p_admit_fail),
+                        ("p_transfer_stall", p_transfer_stall)):
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"{name} must lie in [0, 1], got {p}")
         if p_fail + p_garbage + p_stall > 1.0:
             raise ValueError("p_fail + p_garbage + p_stall must be <= 1")
-        if p_stall > 0.0 and clock is None:
+        if (p_stall > 0.0 or p_transfer_stall > 0.0) and clock is None:
             raise ValueError(
-                "p_stall needs a shared VirtualClock — stalls are "
-                "simulated by advancing it, never by sleeping")
+                "p_stall/p_transfer_stall need a shared VirtualClock — "
+                "stalls are simulated by advancing it, never by sleeping")
         self.p_fail = float(p_fail)
         self.p_garbage = float(p_garbage)
         self.p_stall = float(p_stall)
         self.p_admit_fail = float(p_admit_fail)
+        self.p_transfer_stall = float(p_transfer_stall)
         self.stall_s = float(stall_s)
         self.clock = clock
         self.max_faults = max_faults
         self.counts: Dict[str, int] = {
-            "fail": 0, "garbage": 0, "stall": 0, "admit_fail": 0}
+            "fail": 0, "garbage": 0, "stall": 0, "admit_fail": 0,
+            "transfer_stall": 0}
         self._rng = np.random.default_rng(int(seed))
 
     @property
@@ -173,6 +184,15 @@ class FaultInjector:
             if self._armed() and u < self.p_admit_fail:
                 self.counts["admit_fail"] += 1
                 raise FaultError(site, "admit_fail")
+            return fn(*args)
+        if site == "transfer":
+            if self._armed() and u < self.p_transfer_stall:
+                # the hung-fabric shape: time passes (the caller's send
+                # timeout elapses on the shared clock), nothing is
+                # delivered, and the abandoned send surfaces as a raise
+                self.counts["transfer_stall"] += 1
+                self.clock.advance(self.stall_s)
+                raise FaultError(site, "transfer_stall")
             return fn(*args)
         if self._armed() and u < self.p_fail:
             self.counts["fail"] += 1

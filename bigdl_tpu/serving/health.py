@@ -1,0 +1,279 @@
+"""Pool-level health, transfer retry, and occupancy autoscaling for the
+disaggregated serving plane (``serving/disagg.py``).
+
+PR 13 split serving into a prefill pool and decode pools, but fault
+tolerance stopped at the ROW: the step watchdog evicts-and-replays a
+faulted dispatch, yet a dead POOL (process crash, hung transfer fabric,
+persistent device failure) strands every in-flight row it owns. A fleet
+serving millions of users loses whole hosts, not single steps — this
+module gives the plane a pool-level failure domain:
+
+* :class:`PoolHealth` — the per-pool liveness model. Each decode worker
+  stamps a heartbeat after every completed super-step (on the ENGINE's
+  clock, so a :class:`~bigdl_tpu.serving.faults.VirtualClock` lets the
+  whole state machine run in tests without one sleep), and every
+  transfer send to the pool records success or failure. The front end
+  classifies from those two signals: missed beats or consecutive
+  transfer failures move a pool HEALTHY → SUSPECT → DEAD
+  (:class:`HealthConfig` holds the thresholds). SUSPECT pools stop
+  receiving NEW handoffs but keep serving their rows; a DEAD pool
+  triggers failover (``DisaggregatedEngine._failover_pool``) — every
+  row it owned is reconstructed on a surviving pool, loss-free where a
+  current handoff stash exists, else by byte-identical prefill replay
+  of ``prompt + emitted`` (the PR 8 recovery contract lifted from row
+  to pool).
+* :class:`TransferRetryConfig` — send-side hardening. A failed handoff
+  used to retry IMMEDIATELY (the next pump); now each request backs
+  off exponentially (``delay(n)`` doubles per attempt up to a cap,
+  measured on the engine clock) and a send whose elapsed time exceeds
+  ``send_timeout_s`` is treated as FAILED-UNCONFIRMED: requeued for
+  resend, with the receiver deduplicating by request id so a
+  late-but-delivered payload can never admit twice. The fault
+  injector's ``transfer_stall`` mode (``serving/faults.py``) simulates
+  the hung fabric this bounds. Retries stay bounded by the watchdog's
+  ``max_retries`` budget — a persistently failing fabric fails the
+  request with ``finish_reason='error'``, never wedges ``drain()``.
+* :class:`OccupancyAutoscaler` — the control loop over the plane's
+  existing ``prefill_occupancy``/``decode_occupancy`` signals (the
+  pool-sizing remainder ROADMAP recorded at PR 13). It drains-and-
+  retires cold decode pools and activates standby pools under
+  sustained pressure, with HYSTERESIS so it never flaps: an action
+  needs the signal past a threshold for ``sustain`` CONSECUTIVE
+  samples, the up/down thresholds are separated by a dead band, and
+  any action opens a ``cooldown``-step window in which no further
+  action fires. Reversing a decision therefore takes a genuine
+  occupancy swing across the whole band, sustained, outside cooldown —
+  a boundary-riding signal can oscillate forever without triggering
+  anything (``docs/serving.md`` "Pool failover and autoscaling" has
+  the math).
+
+Everything here is host-side bookkeeping over plain floats/ints — no
+jax, no device traffic, no compiled programs. Deliberately: pool
+lifecycle decisions must keep working exactly when devices are failing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+#: The closed pool-health vocabulary (the FINISH_REASONS pattern):
+#: HEALTHY pools receive new handoffs, SUSPECT pools keep their rows
+#: but stop receiving new work, DEAD pools are failed over and never
+#: touched again (their device state is untrusted by definition).
+HEALTHY, SUSPECT, DEAD = "healthy", "suspect", "dead"
+
+#: Pool lifecycle states the front end tracks per decode pool. ACTIVE
+#: pools are routed to and stepped; STANDBY pools are built (weights
+#: resident, step programs shared through the process-wide caches — so
+#: activation is compile-free) but idle; DEAD pools were failed over.
+#: ``drain_pool`` moves active → standby; the autoscaler moves both
+#: directions.
+POOL_ACTIVE, POOL_STANDBY, POOL_DEAD = "active", "standby", "dead"
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Thresholds for :class:`PoolHealth`.
+
+    ``suspect_after_s``/``dead_after_s`` — seconds of heartbeat SILENCE
+    (on the engine clock) before a pool is classified SUSPECT / DEAD. A
+    worker beats once per completed super-step, so silence means the
+    pool is not making progress — hung, crashed, or partitioned.
+    ``suspect_after_failures``/``dead_after_failures`` — CONSECUTIVE
+    transfer-send failures to the pool before the same verdicts (a
+    delivered send resets the run): the fabric-side death signal, which
+    sees a pool the heartbeat path cannot even reach."""
+
+    suspect_after_s: float = 3.0
+    dead_after_s: float = 10.0
+    suspect_after_failures: int = 2
+    dead_after_failures: int = 5
+
+    def __post_init__(self):
+        if not 0 < self.suspect_after_s <= self.dead_after_s:
+            raise ValueError(
+                f"need 0 < suspect_after_s <= dead_after_s, got "
+                f"{self.suspect_after_s}/{self.dead_after_s}")
+        if not 0 < self.suspect_after_failures \
+                <= self.dead_after_failures:
+            raise ValueError(
+                f"need 0 < suspect_after_failures <= "
+                f"dead_after_failures, got "
+                f"{self.suspect_after_failures}/"
+                f"{self.dead_after_failures}")
+
+
+class PoolHealth:
+    """One pool's liveness record: last heartbeat + the consecutive
+    transfer-failure run, classified against a :class:`HealthConfig`
+    on demand. ``force_dead()`` is the operator/router short-circuit
+    for a death known out-of-band (connection refused, process exit) —
+    classification never resurrects a forced-dead pool."""
+
+    def __init__(self, clock, config: Optional[HealthConfig] = None) -> None:
+        self._clock = clock
+        self.config = config if config is not None else HealthConfig()
+        self._last_beat = float(clock())
+        self._failures = 0
+        self._forced_dead = False
+
+    def beat(self) -> None:
+        """Stamp a liveness beat (one per completed worker super-step)."""
+        self._last_beat = float(self._clock())
+
+    def on_transfer_failure(self) -> None:
+        self._failures += 1
+
+    def on_transfer_ok(self) -> None:
+        self._failures = 0
+
+    def force_dead(self) -> None:
+        self._forced_dead = True
+
+    def reset(self) -> None:
+        """Fresh bill of health (pool activation from standby): the
+        beat clock restarts NOW so a pool idle on the bench since
+        construction is not born dead. Forced death is permanent."""
+        if self._forced_dead:
+            raise ValueError("a forced-dead pool cannot be reset")
+        self._last_beat = float(self._clock())
+        self._failures = 0
+
+    @property
+    def silent_s(self) -> float:
+        """Seconds since the last beat, on the shared clock."""
+        return float(self._clock()) - self._last_beat
+
+    def state(self) -> str:
+        """Classify: DEAD / SUSPECT / HEALTHY (module docstring)."""
+        cfg = self.config
+        if self._forced_dead or self.silent_s > cfg.dead_after_s \
+                or self._failures >= cfg.dead_after_failures:
+            return DEAD
+        if self.silent_s > cfg.suspect_after_s \
+                or self._failures >= cfg.suspect_after_failures:
+            return SUSPECT
+        return HEALTHY
+
+
+@dataclass(frozen=True)
+class TransferRetryConfig:
+    """Send-side hardening knobs for the handoff path.
+
+    ``send_timeout_s`` — a send whose elapsed time (engine clock)
+    exceeds this is treated as FAILED even if it eventually returned:
+    delivery is unconfirmed (the abandoned-hang shape), so the request
+    requeues for resend and the RECEIVER deduplicates by request id
+    (``DecodeWorker.ingest``) in case the slow send did land. None =
+    no timeout verdict. ``backoff_base_s``/``backoff_cap_s`` — the
+    per-request exponential backoff between retries: attempt ``n``
+    waits ``min(cap, base * 2**(n-1))`` before the row re-enters the
+    queue, so a down fabric is probed at a decaying rate instead of
+    hammered every pump."""
+
+    send_timeout_s: Optional[float] = None
+    backoff_base_s: float = 0.02
+    backoff_cap_s: float = 2.0
+
+    def __post_init__(self):
+        if self.send_timeout_s is not None and self.send_timeout_s <= 0:
+            raise ValueError(
+                f"send_timeout_s must be positive or None, got "
+                f"{self.send_timeout_s}")
+        if self.backoff_base_s < 0 or self.backoff_cap_s < 0:
+            raise ValueError("backoff times must be >= 0")
+
+    def delay(self, n_retries: int) -> float:
+        """Backoff before retry ``n_retries`` (1-based)."""
+        if n_retries <= 0:
+            return 0.0
+        return min(self.backoff_cap_s,
+                   self.backoff_base_s * (2.0 ** (n_retries - 1)))
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    """Hysteresis knobs for :class:`OccupancyAutoscaler`.
+
+    ``high_water``/``low_water`` — mean ACTIVE-decode-pool occupancy
+    thresholds; the gap between them is the dead band (a signal inside
+    it never triggers anything). ``sustain`` — consecutive samples the
+    signal must sit past a threshold before the action fires (one
+    sample per front-end step). ``cooldown`` — front-end steps after
+    ANY action during which no further action may fire (counted in
+    steps, not seconds, so a VirtualClock test is deterministic).
+    ``min_pools`` — the floor scale-down never goes below."""
+
+    high_water: float = 0.85
+    low_water: float = 0.30
+    sustain: int = 3
+    cooldown: int = 8
+    min_pools: int = 1
+
+    def __post_init__(self):
+        if not 0.0 <= self.low_water < self.high_water <= 1.0:
+            raise ValueError(
+                f"need 0 <= low_water < high_water <= 1, got "
+                f"{self.low_water}/{self.high_water}")
+        if self.sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {self.sustain}")
+        if self.cooldown < 0:
+            raise ValueError(
+                f"cooldown must be >= 0, got {self.cooldown}")
+        if self.min_pools < 1:
+            raise ValueError(
+                f"min_pools must be >= 1, got {self.min_pools}")
+
+
+class OccupancyAutoscaler:
+    """The pool-count control loop (module docstring): one
+    :meth:`observe` per front-end step returns ``"up"``, ``"down"``,
+    or None; the engine executes (activate a standby pool / drain the
+    least-loaded active pool). Pure host arithmetic — deterministic
+    given the occupancy series, which is what lets the bench assert
+    flap-freedom instead of eyeballing it."""
+
+    def __init__(self, config: Optional[AutoscalerConfig] = None) -> None:
+        self.config = config if config is not None else AutoscalerConfig()
+        self._hi_run = 0
+        self._lo_run = 0
+        # born ready: the first action needs no cooldown to expire
+        self._since_action = self.config.cooldown
+
+    def observe(self, occupancy: float, backlog: int,
+                can_up: bool, can_down: bool) -> Optional[str]:
+        """One control sample: ``occupancy`` is the mean over ACTIVE
+        decode pools, ``backlog`` the prefill pool's waiting depth
+        (scale-down is refused while work is queued — low occupancy
+        with a backlog means admission is catching up, not that
+        capacity is idle). ``can_up``/``can_down`` gate on what the
+        engine can actually do (a standby pool exists / more than
+        ``min_pools`` active)."""
+        cfg = self.config
+        if occupancy >= cfg.high_water:
+            self._hi_run += 1
+            self._lo_run = 0
+        elif occupancy <= cfg.low_water and backlog == 0:
+            self._lo_run += 1
+            self._hi_run = 0
+        else:
+            # the dead band (or a backlogged lull): both runs restart —
+            # hysteresis demands CONSECUTIVE evidence
+            self._hi_run = 0
+            self._lo_run = 0
+        self._since_action += 1
+        if self._since_action <= cfg.cooldown:
+            return None
+        if self._hi_run >= cfg.sustain and can_up:
+            self._act()
+            return "up"
+        if self._lo_run >= cfg.sustain and can_down:
+            self._act()
+            return "down"
+        return None
+
+    def _act(self) -> None:
+        self._hi_run = 0
+        self._lo_run = 0
+        self._since_action = 0

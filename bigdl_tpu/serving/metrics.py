@@ -118,6 +118,30 @@ front end's metrics; each pool's engine keeps its own full set):
   occupancies (one decode sample per pool per step) — the
   pool-sizing signal
 
+Pool-lifecycle counters (``serving/health.py`` + the failover /
+autoscaler machinery in ``serving/disagg.py``):
+
+* ``pool_deaths``       — decode pools classified DEAD (missed
+  heartbeats, consecutive transfer failures, or an operator
+  ``kill_pool``)
+* ``failovers``         — completed pool failovers (one per death
+  that had rows to reconstruct or a state to retire)
+* ``failover_s``        — wall time of each failover (detect →
+  every stranded row re-routed); ``failover_percentiles()``
+  summarizes, ``summary()`` reports p50/p99
+* ``migrated_rows``     — rows moved pool-to-pool LOSS-FREE via a
+  ``row_state`` payload (graceful drain, wire re-routes, and
+  stash-current failover rows)
+* ``replayed_rows``     — rows reconstructed by byte-identical
+  prefill replay of ``prompt + emitted`` (failover of rows whose
+  handoff stash was stale — the PR 8 recovery contract lifted to
+  pool scope)
+* ``transfer_timeouts`` — sends past the configured
+  ``send_timeout_s`` (treated as failed-unconfirmed and resent;
+  the receiver deduplicates)
+* ``autoscale_up`` / ``autoscale_down`` — standby-pool activations /
+  drain-and-retire actions by the occupancy autoscaler
+
 KV-format counters (``serving/kv_pool.py`` — set once at construction):
 
 * ``kv_bits``            — bits per stored K/V element (32/16/8)
@@ -373,6 +397,51 @@ class ServingMetrics:
         """Percentiles of the per-handoff transfer wall (seconds)."""
         return self._pctl("transfer_s", qs)
 
+    # -- pool-lifecycle hooks (serving/health.py + disagg failover) --------
+
+    def on_pool_death(self) -> None:
+        """A decode pool classified DEAD (heartbeat silence,
+        consecutive transfer failures, or an operator kill)."""
+        self.metrics.add("serving/pool_deaths", 1.0)
+
+    def on_failover(self, n_migrated: int, n_replayed: int,
+                    seconds: float) -> None:
+        """One completed pool failover: rows reconstructed loss-free
+        from a current ``row_state`` payload (wire re-routes + stash
+        restores) vs by prefill replay of ``prompt + emitted``, and
+        the detect→done wall time."""
+        self.metrics.add("serving/failovers", 1.0)
+        if n_migrated:
+            self.metrics.add("serving/migrated_rows", float(n_migrated))
+        if n_replayed:
+            self.metrics.add("serving/replayed_rows", float(n_replayed))
+        self.metrics.add("serving/failover_s", float(seconds))
+
+    def on_migrated(self, n_rows: int) -> None:
+        """Rows moved pool-to-pool loss-free via the ``row_state``
+        handoff payload (graceful drain)."""
+        if n_rows:
+            self.metrics.add("serving/migrated_rows", float(n_rows))
+
+    def on_transfer_timeout(self) -> None:
+        """A handoff send exceeded ``send_timeout_s`` on the engine
+        clock: delivery unconfirmed, the request resends (the
+        receiver deduplicates by request id)."""
+        self.metrics.add("serving/transfer_timeouts", 1.0)
+
+    def on_autoscale(self, direction: str) -> None:
+        """One autoscaler action: ``"up"`` (standby pool activated)
+        or ``"down"`` (cold pool drained and retired)."""
+        if direction not in ("up", "down"):
+            raise ValueError(
+                f"autoscale direction must be 'up' or 'down', "
+                f"got {direction!r}")
+        self.metrics.add(f"serving/autoscale_{direction}", 1.0)
+
+    def failover_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        """Percentiles of the per-failover wall time (seconds)."""
+        return self._pctl("failover_s", qs)
+
     def decode_step_estimate(self) -> Optional[float]:
         """MEDIAN of the recent decode-step samples (a bounded window,
         seconds), or None before the first decode step — the per-step
@@ -520,6 +589,9 @@ class ServingMetrics:
                      "recovered_rows", "degraded", "finished_in_slo",
                      "infeasible", "chunks", "chunk_tokens",
                      "handoffs", "transfer_bytes",
+                     "pool_deaths", "failovers", "migrated_rows",
+                     "replayed_rows", "transfer_timeouts",
+                     "autoscale_up", "autoscale_down",
                      *(f"finish_{r}" for r in sorted(self.FINISH_REASONS))):
             total, n = self.metrics.get(f"serving/{name}")
             if n:
@@ -549,6 +621,11 @@ class ServingMetrics:
             out["serving/transfer_bytes_per_handoff"] = nb / n_hand
             out["serving/transfer_p99_s"] = \
                 self.transfer_percentiles()["p99"]
+        _, n_fo = self.metrics.get("serving/failover_s")
+        if n_fo:
+            fp = self.failover_percentiles()
+            out["serving/failover_p50_s"] = fp["p50"]
+            out["serving/failover_p99_s"] = fp["p99"]
         _, n_host = self.metrics.get("serving/host_step_s")
         if n_host:
             hp = self.host_step_percentiles()

@@ -1,0 +1,61 @@
+"""SRV206 stranded rows: any code path that removes a request from a
+pool's ``running``/``partial`` scheduler tables must requeue/submit it,
+serialize it for handoff (``row_state``/``pack_payload``), or land a
+finish disposition — the static twin of the pool-failover invariant.
+The compliant spellings and the table-owning scheduler class are the
+false-positive guards."""
+
+from bigdl_tpu.serving.scheduler import Request
+
+
+class Router:
+    def drop_row(self, slot):
+        del self.engine.scheduler.running[slot]       # EXPECT: SRV206
+
+    def pop_row(self, sched, slot):
+        req = sched.running.pop(slot)                 # EXPECT: SRV206
+        return req.req_id
+
+    def wipe_partials(self, sched):
+        sched.partial.clear()                         # EXPECT: SRV206
+
+    def migrate_row(self, sched, pool, slot, target):
+        payload = pool.row_state(slot)                # handed off — fine
+        req = sched.running.pop(slot)
+        target.submit(req, payload)
+
+    def failover_row(self, sched, slot, survivor):
+        req = sched.running.pop(slot)                 # requeued — fine
+        survivor.scheduler.requeue(req)
+
+    def finish_row(self, sched, req, now):
+        del sched.running[req.slot]                   # disposition — fine
+        self._ledger_finish(req, "length", now)
+
+    def drop_waiting(self, sched, req_id):
+        # the waiting heap is NOT a slot table: its drop surface
+        # (pop_waiting) is the owning class's closed primitive
+        sched._waiting.pop(0)
+        return req_id
+
+
+class MiniScheduler:
+    """Owns the tables (the Scheduler shape): its methods ARE the
+    sanctioned removal primitives — exempt."""
+
+    def __init__(self):
+        self.running = {}
+        self.partial = {}
+
+    def evict(self, slot):
+        del self.running[slot]                        # primitive — fine
+
+    def activate(self, slot):
+        req = self.partial.pop(slot)                  # primitive — fine
+        self.running[slot] = req
+        return req
+
+
+def lose_rows_at_module_scope_helper(sched, slot):
+    req = sched.partial.pop(slot)                     # EXPECT: SRV206
+    return req

@@ -30,7 +30,8 @@ BASELINE = REPO / "analysis_baseline.txt"
 BAD_FIXTURES = sorted(FIXTURES.glob("bad_*.py"))
 ALL_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305",
              "SPMD101", "SPMD102", "SPMD103", "SPMD104", "SPMD105",
-             "SPMD106", "SRV201", "SRV202", "SRV203", "SRV204", "SRV205")
+             "SPMD106", "SRV201", "SRV202", "SRV203", "SRV204", "SRV205",
+             "SRV206")
 ASY_CODES = ["ASY301", "ASY302", "ASY303", "ASY304", "ASY305"]
 
 
@@ -319,6 +320,29 @@ def test_srv205_vocabulary_extracted_from_project():
     )
     got = [(f.line, f.code) for f in analyze_source(src, "mini.py")]
     assert got == [(6, "SRV205")]
+
+
+def test_srv206_real_tree_clean_and_mutation_caught(tmp_path):
+    """SRV206 census over the REAL serving tree: the unmutated copy
+    scans clean (every removal from a running/partial table wears a
+    requeue/handoff/disposition or lives in the table-owning
+    scheduler), and stripping the row_state capture from the one
+    direct removal outside the scheduler (PrefillWorker._release —
+    the handoff release) yields exactly one SRV206 at disagg.py: the
+    no-stranded-rows invariant is enforced where the failover
+    machinery actually lives, not just on fixtures."""
+    tree = _serving_tree(tmp_path)
+    clean = analyze_paths([str(tmp_path)], select=["SRV206"])
+    assert clean == [], [f.format() for f in clean]
+    src = (tree / "disagg.py").read_text()
+    needle = "payload = self.engine.pool.row_state(slot)"
+    assert needle in src, "_release moved — update the census"
+    (tree / "disagg.py").write_text(
+        src.replace(needle, "payload = None", 1))
+    found = analyze_paths([str(tmp_path)], select=["SRV206"])
+    assert [f.code for f in found] == ["SRV206"], \
+        [f.format() for f in found]
+    assert found[0].path.endswith("disagg.py")
 
 
 def test_srv205_reads_real_vocabulary():
