@@ -88,13 +88,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "existing tooling)")
     p.add_argument("--list-rules", action="store_true",
                    help="list rule codes and exit")
-    p.add_argument("--report", choices=("sync-points",), default=None,
+    p.add_argument("--report", choices=("sync-points", "lockstep"),
+                   default=None,
                    help="print a whole-program report instead of "
                         "findings: 'sync-points' inventories every "
                         "hot-path device→host sync (declared fences + "
                         "ASY findings) with its root chain — the "
-                        "async-refactor worksheet (exit 0; combine "
-                        "with --format json for the machine shape)")
+                        "async-refactor worksheet; 'lockstep' "
+                        "inventories every cross-process agreement "
+                        "point, divergence root, and declared clock "
+                        "site — the multi-host pod worksheet "
+                        "(exit 0; combine with --format json for the "
+                        "machine shape)")
     p.add_argument("--jobs", type=int, default=0, metavar="N",
                    help="parallel parse workers for cache misses "
                         "(default: the host's cores; 1 = serial)")
@@ -160,39 +165,33 @@ def _short_chain(chain: List[str]) -> str:
     return " -> ".join(out)
 
 
-def report_sync_points(paths: List[str], fmt: str) -> int:
-    """``--report sync-points``: the async-refactor worksheet — every
-    hot-path device→host sync (declared fence sites + any un-fenced
-    ASY finding) with its call-graph root chain. Informational: exits
-    0 (the normal scan is the gate that FAILS on un-fenced syncs)."""
-    from bigdl_tpu.analysis.rules import sync_point_inventory
-
+def _run_report(paths: List[str], fmt: str, name: str, inventory_fn,
+                summary_counts, header_fn) -> int:
+    """Shared driver for the whole-program reports (`sync-points`,
+    `lockstep`): load the project, build the inventory, emit JSON or
+    the text shape (header, parse-error warnings, one block per entry
+    — findings carry their classification + fix hint). Informational:
+    exits 0 (the normal scan is the gate that FAILS on findings)."""
     contexts, errors = load_project(paths,
                                     exclude_dirs=DEFAULT_EXCLUDE_DIRS)
-    entries = sync_point_inventory(contexts)
+    entries = inventory_fn(contexts)
+    counts = {key: sum(1 for e in entries if e["kind"].startswith(pfx))
+              for key, pfx in summary_counts.items()}
     if fmt in ("json", "sarif"):
         print(json.dumps({
-            "report": "sync-points",
+            "report": name,
             "paths": list(paths),
             "entries": entries,
-            "summary": {
-                "declared": sum(1 for e in entries
-                                if e["kind"].startswith("fence")),
-                "findings": sum(1 for e in entries
-                                if e["kind"].startswith("ASY")),
-                "parse_errors": len(errors),
-            },
+            "summary": {**counts, "parse_errors": len(errors)},
         }, indent=2))
         return 0
-    declared = [e for e in entries if e["kind"].startswith("fence")]
-    findings = [e for e in entries if e["kind"].startswith("ASY")]
-    print(f"# hot-path sync-point inventory — {len(declared)} declared "
-          f"fence site(s), {len(findings)} un-fenced finding(s)")
+    print(header_fn(counts))
     for err in errors:
         # a file that does not parse is NOT inventoried — the
         # worksheet must say so rather than read as complete
         print(f"# WARNING: {err.path}:{err.line} failed to parse and "
               f"is not inventoried ({err.message})", file=sys.stderr)
+    finding_pfx = summary_counts["findings"]
     for e in entries:
         supp = "  [suppressed: # analysis: ok]" if e["suppressed"] else ""
         print(f"{e['path']}:{e['line']} [{e['kind']}]{supp}")
@@ -200,13 +199,45 @@ def report_sync_points(paths: List[str], fmt: str) -> int:
             print(f"    in {e['function']}")
         if e["chain"]:
             print(f"    chain: {_short_chain(e['chain'])}")
-        if e["kind"].startswith("ASY"):
+        if e["kind"].startswith(finding_pfx):
             print(f"    {e['classification']}")
         if e["detail"]:
             print(f"    | {e['detail']}")
-        if e["kind"].startswith("ASY") and e["suggestion"]:
+        if e["kind"].startswith(finding_pfx) and e["suggestion"]:
             print(f"    fix: {e['suggestion']}")
     return 0
+
+
+def report_sync_points(paths: List[str], fmt: str) -> int:
+    """``--report sync-points``: the async-refactor worksheet — every
+    hot-path device→host sync (declared fence sites + any un-fenced
+    ASY finding) with its call-graph root chain."""
+    from bigdl_tpu.analysis.rules import sync_point_inventory
+
+    return _run_report(
+        paths, fmt, "sync-points", sync_point_inventory,
+        {"declared": "fence", "findings": "ASY"},
+        lambda c: (f"# hot-path sync-point inventory — {c['declared']} "
+                   f"declared fence site(s), {c['findings']} un-fenced "
+                   f"finding(s)"))
+
+
+def report_lockstep(paths: List[str], fmt: str) -> int:
+    """``--report lockstep``: the multi-host pod worksheet — every
+    cross-process agreement point (collectives, compiled-step
+    dispatches, block-store barriers) with its root chain, every
+    divergence root (process_index/count, per-peer store reads), the
+    declared clock sites, and any un-fixed MH finding."""
+    from bigdl_tpu.analysis.rules import lockstep_inventory
+
+    return _run_report(
+        paths, fmt, "lockstep", lockstep_inventory,
+        {"agreement": "agreement", "divergence": "divergence",
+         "clock_sites": "clock", "findings": "MH"},
+        lambda c: (f"# multi-host lockstep inventory — {c['agreement']} "
+                   f"agreement point(s), {c['divergence']} divergence "
+                   f"root(s), {c['clock_sites']} declared clock "
+                   f"site(s), {c['findings']} MH finding(s)"))
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -244,6 +275,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.report == "sync-points":
         return report_sync_points(paths, fmt)
+    if args.report == "lockstep":
+        return report_lockstep(paths, fmt)
     jobs = args.jobs or (os.cpu_count() or 1)
     findings = scan(paths, select=select, ignore=ignore,
                     exclude_dirs=DEFAULT_EXCLUDE_DIRS,

@@ -30,8 +30,8 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from bigdl_tpu.analysis.core import (
     UNRESOLVED, FileContext, Finding, Rule, _own_scope_nodes,
-    _unit_functions, hotpath_chains, literal_value, register,
-    register_fact_collector as _register_facts,
+    _unit_functions, enclosing_unit, hotpath_chains, literal_value,
+    register, register_fact_collector as _register_facts,
 )
 
 # --------------------------------------------------------------------------
@@ -1846,6 +1846,36 @@ def _hot_chains(ctx: FileContext) -> Dict[str, Tuple[str, ...]]:
     return hotpath_chains(_facts(ctx))
 
 
+def _target_names_of(target: ast.AST) -> List[str]:
+    """Plain names bound by an assignment/loop target (tuple/list
+    destructuring included) — shared by the ASY device-taint and MH
+    divergence-taint timelines."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in target.elts:
+            out.extend(_target_names_of(e))
+        return out
+    return []
+
+
+def _taint_state_at(events: Dict[str, List[Tuple[int, bool]]],
+                    line: int) -> Set[str]:
+    """Names whose last taint event at or before ``line`` is True —
+    the one timeline-replay rule both taint scans share."""
+    out: Set[str] = set()
+    for name, evs in events.items():
+        state = False
+        for ln, val in evs:
+            if ln > line:
+                break
+            state = val
+        if state:
+            out.add(name)
+    return out
+
+
 class _AsyScan:
     """One shared pass over a hot unit: the device-taint timeline, the
     readback/fence/dispatch/clock inventories, and the loop-accumulation
@@ -1877,29 +1907,13 @@ class _AsyScan:
         self.accumulations: List[Tuple[ast.AST, ast.Call]] = []
         self._build()
 
-    # -- taint timeline -----------------------------------------------------
+    # -- taint timeline (shared replay rule: _taint_state_at) ---------------
 
     def tainted_at(self, line: int) -> Set[str]:
-        out: Set[str] = set()
-        for name, evs in self.events.items():
-            state = False
-            for ln, val in evs:
-                if ln > line:
-                    break
-                state = val
-            if state:
-                out.add(name)
-        return out
+        return _taint_state_at(self.events, line)
 
     def _target_names(self, target: ast.AST) -> List[str]:
-        if isinstance(target, ast.Name):
-            return [target.id]
-        if isinstance(target, (ast.Tuple, ast.List)):
-            out: List[str] = []
-            for e in target.elts:
-                out.extend(self._target_names(e))
-            return out
-        return []
+        return _target_names_of(target)
 
     def _build(self) -> None:
         ctx = self.ctx
@@ -2264,6 +2278,821 @@ class ClockStraddleRule(Rule):
                             break
 
 
+# ==========================================================================
+# The MH4xx multi-host lockstep & determinism family.
+#
+# The next serving tier runs the disaggregated pools process-per-host
+# over CoordServiceBlockStore on a real jax.distributed pod, and the
+# bug class that kills SPMD pods is SILENT LOCKSTEP DIVERGENCE: one
+# process traces a different program, calls a collective the others
+# skip, or makes a routing/replay decision from wall-clock or unseeded
+# randomness the other processes don't share. Every worker must execute
+# the identical step sequence (the synchronous-AllReduce design of the
+# BigDL reference and the MLPerf pod-scaling work both hinge on it).
+#
+# The machinery is a DIVERGENCE-TAINT layer on the existing
+# interprocedural call graph:
+#
+# * values derived from ``jax.process_index()`` or per-peer block-store
+#   reads (``try_get``/``get_blocking`` on a store) are
+#   *process-divergent* — each process sees a different value.
+#   ``jax.process_count()`` is recorded as a divergence ROOT for the
+#   worksheet (``--report lockstep``) but is pod-uniform in a healthy
+#   pod, so branches on it are lockstep-safe and exempt from MH401;
+# * facts record which units invoke cross-process AGREEMENT POINTS:
+#   collectives (psum / all_gather / ppermute ...), compiled-step
+#   dispatches (``_dispatch`` / step-attr calls — every process must
+#   trace and launch the same program), and block-store barriers /
+#   straggler waits (``get_blocking`` / ``get_weights``);
+# * a reverse reachability closure over the merged call edges answers
+#   "does this call reach an agreement point?" project-wide.
+#
+# On top of that: MH401 divergent branch reaching an agreement point
+# (the classic trace-divergence pod hang), MH402 collectives/handoffs
+# issued from unordered-set iteration (PYTHONHASHSEED makes set order
+# per-process), MH403 raw wall-clock reads in the serving plane outside
+# the closed CLOCK_SITES vocabulary (the FENCE_SITES pattern — lockstep
+# decisions must run on the injected engine clock), MH404 ambient
+# randomness on replay paths (byte-identical failover/preemption replay
+# must be a pure function of request seeds), MH405 block-store keys
+# built from divergent values without the process-id namespace
+# (cross-process key collisions).
+# ==========================================================================
+
+#: cross-process collective primitives: every process in the mesh must
+#: call these the same number of times in the same order or the pod
+#: hangs
+_COLLECTIVE_QUALS = frozenset({
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.psum_scatter", "jax.lax.all_gather", "jax.lax.all_to_all",
+    "jax.lax.ppermute", "jax.lax.pshuffle",
+})
+#: block-store barrier / straggler-wait spellings (the host-side
+#: agreement points of the blockstore parameter plane)
+_BARRIER_SEGS = frozenset({"get_blocking", "get_weights", "wait_all",
+                           "barrier"})
+#: the per-process identity — THE divergence root
+_PROCESS_ID_QUALS = frozenset({"jax.process_index"})
+#: recorded divergence roots for the worksheet (process_count is
+#: pod-uniform, so it feeds the inventory but not the MH401 taint)
+_PROCESS_TOPOLOGY_QUALS = frozenset({"jax.process_index",
+                                     "jax.process_count"})
+#: per-peer block-store reads: another process wrote the value, so
+#: what THIS process sees depends on arrival order — divergent
+_PEER_READ_SEGS = frozenset({"try_get", "get_blocking"})
+#: cross-process handoff spellings (payload send order feeds the
+#: receiver's agreement) — MH402's second trigger class
+_HANDOFF_SEGS = frozenset({"send", "pack_payload", "put"})
+#: raw wall-clock sources the serving plane must not read outside the
+#: declared CLOCK_SITES (time.sleep included: serving simulates stalls
+#: on the VirtualClock, never by sleeping)
+_WALL_CLOCK_QUALS = frozenset({"time.time", "time.perf_counter",
+                               "time.monotonic", "time.process_time",
+                               "time.sleep"})
+#: fallback CLOCK_SITES vocabulary (single-file fixture runs): must
+#: match serving/faults.py CLOCK_SITES
+_DEFAULT_CLOCK_SITES = frozenset({"faults.default_clock",
+                                  "metrics.ServingMetrics.on_step"})
+#: seeded RNG constructors — sanctioned WITH an explicit seed argument
+_SEEDED_RNG_QUALS = frozenset({
+    "numpy.random.default_rng", "numpy.random.RandomState",
+    "numpy.random.SeedSequence", "numpy.random.Generator",
+    "random.Random",
+})
+#: fresh jax key constructors — sanctioned only inside the sampling
+#: module's seed derivation (sampling.lane_key)
+_FRESH_KEY_QUALS = frozenset({"jax.random.PRNGKey", "jax.random.key"})
+
+
+@_register_facts
+def _clock_site_facts(ctx: FileContext) -> Dict:
+    """The declared clock-site vocabulary (``CLOCK_SITES``) and the
+    module that declares it — MH403's ground truth, extracted the way
+    ASY302 reads FENCE_SITES."""
+    for node in ctx.by_type(ast.Assign):
+        if not any(isinstance(t, ast.Name) and t.id == "CLOCK_SITES"
+                   for t in node.targets):
+            continue
+        val = literal_value(node.value)
+        if val is not UNRESOLVED:
+            return {"clock_sites": sorted(val),
+                    "clock_modules": [ctx.module]}
+    return {}
+
+
+def _clock_sites(ctx: FileContext) -> Set[str]:
+    sites = _facts(ctx).get("clock_sites")
+    return set(sites) if sites else set(_DEFAULT_CLOCK_SITES)
+
+
+def _is_blockstore_module(ctx: FileContext) -> bool:
+    """True for the module that DEFINES the block-store layer (the
+    ``BlockStore`` base class): its polling loops ARE the cross-process
+    synchronization implementation — branching on per-peer reads is its
+    job, so MH401 exempts it (the compat.py / fences.py pattern)."""
+    hit = ctx.cache.get("is_blockstore_module")
+    if hit is None:
+        hit = any(cls.name == "BlockStore"
+                  for cls in ctx.by_type(ast.ClassDef))
+        ctx.cache["is_blockstore_module"] = hit
+    return hit
+
+
+def _class_method_names(ctx: FileContext) -> Dict[str, Set[str]]:
+    out = ctx.cache.get("class_method_names")
+    if out is None:
+        out = ctx.cache["class_method_names"] = {}
+        for cls in ctx.by_type(ast.ClassDef):
+            out[cls.name] = {
+                f.name for f in cls.body
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    return out
+
+
+@_register_facts
+def _lockstep_facts(ctx: FileContext) -> Dict:
+    """Per-unit multi-host facts: ``collective_units`` (units that
+    directly invoke a cross-process agreement point — a collective, a
+    compiled-step dispatch, or a block-store barrier) and
+    ``divergent_units`` (units that read a divergence root —
+    ``jax.process_index``/``process_count`` or a per-peer store read).
+    The reachability closure and the ``--report lockstep`` worksheet
+    are built from the merged tables."""
+    units = _unit_functions(ctx)
+    if not units:
+        return {}
+    step_segs = set(_step_binding_facts(ctx).get("step_attrs", {}))
+    coll: Dict[str, List[str]] = {}
+    div: Dict[str, List[str]] = {}
+    for qual, fn, _cls in units:
+        kinds: Set[str] = set()
+        roots: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            q = ctx.qualname(node.func)
+            seg = _last_seg(ctx.dotted(node.func))
+            if q in _COLLECTIVE_QUALS:
+                kinds.add(f"collective:{q.rsplit('.', 1)[-1]}")
+            elif seg == "_dispatch" or seg in step_segs:
+                kinds.add("dispatch")
+            elif seg in _BARRIER_SEGS:
+                kinds.add(f"barrier:{seg}")
+            if q in _PROCESS_TOPOLOGY_QUALS:
+                roots.add(q.rsplit(".", 1)[-1])
+            elif seg in _PEER_READ_SEGS and _storeish_receiver(ctx,
+                                                              node):
+                roots.add("peer-read")
+        if kinds:
+            coll[qual] = sorted(kinds)
+        if roots:
+            div[qual] = sorted(roots)
+    out: Dict[str, Any] = {}
+    if coll:
+        out["collective_units"] = coll
+    if div:
+        out["divergent_units"] = div
+    return out
+
+
+def _storeish_receiver(ctx: FileContext, call: ast.Call) -> bool:
+    """True when the call's receiver looks like a block store
+    (``...store.try_get`` / ``bs.get_blocking``)."""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    d = ctx.dotted(call.func.value)
+    return bool(d) and "store" in d.rsplit(".", 1)[-1].lower()
+
+
+def _collective_reach(ctx: FileContext) -> Set[str]:
+    """Unit quals from which a cross-process agreement point is
+    reachable through the merged call-graph edges (the agreement units
+    themselves included) — reverse BFS over the same edge-resolution
+    rules ``core.hotpath_chains`` uses, project-memoized."""
+    def compute(facts: Dict) -> Set[str]:
+        edges: Dict[str, List[str]] = facts.get("call_edges") or {}
+        methods: Dict[str, List[str]] = facts.get("method_units") or {}
+        coll = set(facts.get("collective_units") or {})
+        if not coll:
+            return set()
+        by_tail: Dict[str, List[str]] = {}
+        for q in edges:
+            by_tail.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+        rev: Dict[str, List[str]] = {}
+        for qual, callees in edges.items():
+            for callee in callees:
+                if callee.startswith("."):
+                    targets = methods.get(callee[1:], [])
+                elif callee in edges:
+                    targets = [callee]
+                else:
+                    tail = callee.rsplit(".", 1)[-1]
+                    targets = [q for q in by_tail.get(tail, ())
+                               if q.endswith("." + callee)
+                               or callee.endswith("." + q)]
+                for t in targets:
+                    rev.setdefault(t, []).append(qual)
+        seen = set(coll)
+        queue = list(coll)
+        while queue:
+            q = queue.pop()
+            for p in rev.get(q, ()):
+                if p not in seen:
+                    seen.add(p)
+                    queue.append(p)
+        return seen
+
+    proj = ctx.project
+    if proj is not None:
+        hit = proj.cache.get("collective_reach")
+        if hit is None:
+            hit = proj.cache["collective_reach"] = compute(proj.facts)
+        return hit
+    return compute(_facts(ctx))
+
+
+def _callee_token(ctx: FileContext, call: ast.Call,
+                  cls: Optional[str]) -> Optional[str]:
+    """The call-graph edge token a Call would contribute (mirrors
+    ``core._call_graph_facts`` at one call site): a qualified name,
+    a ``.attr`` suffix, or None."""
+    f = call.func
+    mod = ctx.module
+    if isinstance(f, ast.Name):
+        local = ctx.cache.get("toplevel_defs")
+        if local is None:
+            local = ctx.cache["toplevel_defs"] = {
+                fn.name for fn in ctx.tree.body
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        if f.id in local:
+            return f"{mod}.{f.id}" if mod else f.id
+        return ctx.qualname(f)
+    if isinstance(f, ast.Attribute):
+        q = ctx.qualname(f)
+        if q:
+            return q
+        d = ctx.dotted(f)
+        if d and cls and d == f"self.{f.attr}" and \
+                f.attr in _class_method_names(ctx).get(cls, ()):
+            return f"{mod}.{cls}.{f.attr}" if mod else f"{cls}.{f.attr}"
+        return "." + f.attr
+    return None
+
+
+def _agreement_call(ctx: FileContext, call: ast.Call,
+                    cls: Optional[str]) -> Optional[str]:
+    """What cross-process agreement ``call`` commits this process to:
+    ``"collective:psum"``-style for a direct collective, ``"dispatch"``
+    for a compiled-step launch, ``"barrier:..."`` for a block-store
+    wait, ``"reaches <unit>"`` when the callee reaches one through the
+    merged call graph — else None."""
+    q = ctx.qualname(call.func)
+    if q in _COLLECTIVE_QUALS:
+        return f"collective:{q.rsplit('.', 1)[-1]}"
+    seg = _last_seg(ctx.dotted(call.func))
+    if seg == "_dispatch" or seg in _step_attr_segs(ctx):
+        return "dispatch"
+    if seg in _BARRIER_SEGS:
+        return f"barrier:{seg}"
+    token = _callee_token(ctx, call, cls)
+    if token is None:
+        return None
+    reach = _collective_reach(ctx)
+    if not reach:
+        return None
+    facts = _facts(ctx)
+    methods: Dict[str, List[str]] = facts.get("method_units") or {}
+    if token.startswith("."):
+        targets = methods.get(token[1:], [])
+    elif token in reach:
+        return f"reaches {token}"
+    else:
+        targets = [t for t in reach
+                   if t.endswith("." + token) or token.endswith("." + t)]
+    for t in targets:
+        if t in reach:
+            return f"reaches {t}"
+    return None
+
+
+def _divergent_self_attrs(ctx: FileContext) -> Dict[Tuple[str, str], str]:
+    """``(class name, attr) -> "pid" | "div"`` for attributes assigned
+    a divergence root anywhere in the class body (``self.pid =
+    jax.process_index()`` in ``__init__``, branched on in a method —
+    the cross-method half the per-unit timeline cannot see)."""
+    out = ctx.cache.get("divergent_self_attrs")
+    if out is None:
+        out = ctx.cache["divergent_self_attrs"] = {}
+        for cls in ctx.by_type(ast.ClassDef):
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                kind = None
+                if _pid_direct_expr(ctx, node.value, set()):
+                    kind = "pid"
+                elif _div_root_call(ctx, node.value):
+                    kind = "div"
+                if kind is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out[(cls.name, t.attr)] = kind
+    return out
+
+
+def _div_root_call(ctx: FileContext, expr: ast.AST) -> bool:
+    """Any divergence-root call inside ``expr`` (process_index or a
+    per-peer store read)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            if ctx.qualname(node.func) in _PROCESS_ID_QUALS:
+                return True
+            if _last_seg(ctx.dotted(node.func)) in _PEER_READ_SEGS and \
+                    _storeish_receiver(ctx, node):
+                return True
+    return False
+
+
+def _pid_direct_expr(ctx: FileContext, expr: ast.AST,
+                     pid_names: Set[str],
+                     cls: Optional[str] = None) -> bool:
+    """True when ``expr`` IS the process id (usable as a key
+    namespace): a bare ``jax.process_index()`` call, an ``int()`` or
+    ``str()`` wrap of one, a name currently bound to one, or a
+    pid-assigned ``self.`` attribute."""
+    if isinstance(expr, ast.Call):
+        if ctx.qualname(expr.func) in _PROCESS_ID_QUALS:
+            return True
+        if isinstance(expr.func, ast.Name) and \
+                expr.func.id in ("int", "str") and len(expr.args) == 1:
+            return _pid_direct_expr(ctx, expr.args[0], pid_names, cls)
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in pid_names
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return _divergent_self_attrs(ctx).get((cls or "", expr.attr)) \
+            == "pid"
+    return False
+
+
+class _DivScan:
+    """Per-unit divergence-taint timeline: which local names hold
+    process-divergent values (derived from ``jax.process_index()`` or
+    per-peer store reads) at each line, plus the ``pid``-direct subset
+    (names that ARE the process id — the legal key namespace)."""
+
+    def __init__(self, ctx: FileContext, fn: ast.AST,
+                 cls: Optional[str]) -> None:
+        self.ctx = ctx
+        self.fn = fn
+        self.cls = cls
+        self.events: Dict[str, List[Tuple[int, bool]]] = {}
+        self.pid_names_final: Set[str] = set()
+        self._pid_cur: Set[str] = set()
+        self._build()
+
+    def tainted_at(self, line: int) -> Set[str]:
+        return _taint_state_at(self.events, line)
+
+    def _build(self) -> None:
+        ctx = self.ctx
+        cur: Set[str] = set()
+
+        def mark(names: List[str], line: int, val: bool) -> None:
+            for n in names:
+                if val:
+                    cur.add(n)
+                elif n in cur:
+                    cur.discard(n)
+                else:
+                    continue
+                self.events.setdefault(n, []).append((line, val))
+
+        stmts = sorted(
+            (n for n in ast.walk(self.fn)
+             if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                               ast.For))),
+            key=lambda n: (getattr(n, "lineno", 0),
+                           getattr(n, "col_offset", 0)))
+        for node in stmts:
+            line = getattr(node, "lineno", 0)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                names: List[str] = []
+                for t in targets:
+                    names.extend(_target_names_of(t))
+                if _pid_direct_expr(ctx, value, self._pid_cur, self.cls):
+                    self._pid_cur.update(names)
+                else:
+                    self._pid_cur.difference_update(names)
+                mark(names, line,
+                     self.div_use(value, line, _cur=cur) is not None)
+            elif isinstance(node, ast.AugAssign):
+                if self.div_use(node.value, line, _cur=cur) is not None:
+                    mark(_target_names_of(node.target), line, True)
+            elif isinstance(node, ast.For):
+                mark(_target_names_of(node.target), line,
+                     self.div_use(node.iter, line, _cur=cur) is not None)
+        self.pid_names_final = set(self._pid_cur)
+
+    def div_use(self, expr: ast.AST, line: int,
+                _cur: Optional[Set[str]] = None) -> Optional[ast.AST]:
+        """First process-divergent use inside ``expr``: a tainted name,
+        a divergence-root call, or a divergent ``self.`` attribute."""
+        ctx = self.ctx
+        tainted = _cur if _cur is not None else self.tainted_at(line)
+        out: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            if out:
+                return
+            if isinstance(node, ast.Name):
+                if node.id in tainted:
+                    out.append(node)
+                return
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id == "self" and \
+                        (self.cls or "", node.attr) in \
+                        _divergent_self_attrs(ctx):
+                    out.append(node)
+                    return
+                visit(node.value)
+                return
+            if isinstance(node, ast.Call):
+                if ctx.qualname(node.func) in _PROCESS_ID_QUALS:
+                    out.append(node)
+                    return
+                if _last_seg(ctx.dotted(node.func)) in _PEER_READ_SEGS \
+                        and _storeish_receiver(ctx, node):
+                    out.append(node)
+                    return
+                for child in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    visit(child)
+                if not isinstance(node.func, ast.Name):
+                    visit(node.func)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        return out[0] if out else None
+
+    def pid_in_parts(self, parts: Sequence[ast.AST]) -> bool:
+        return any(_pid_direct_expr(self.ctx, p, self.pid_names_final,
+                                    self.cls) for p in parts)
+
+
+def _div_scan(ctx: FileContext, fn: ast.AST,
+              cls: Optional[str]) -> _DivScan:
+    key = ("div_scan", id(fn))
+    hit = ctx.cache.get(key)
+    if hit is None:
+        hit = ctx.cache[key] = _DivScan(ctx, fn, cls)
+    return hit
+
+
+def _file_has_div_roots(ctx: FileContext) -> bool:
+    """Cheap gate: any divergence-root call anywhere in the file
+    (process_index or a store-receiver peer read)."""
+    hit = ctx.cache.get("has_div_roots")
+    if hit is None:
+        hit = False
+        for node in ctx.by_type(ast.Call):
+            if ctx.qualname(node.func) in _PROCESS_ID_QUALS or (
+                    _last_seg(ctx.dotted(node.func)) in _PEER_READ_SEGS
+                    and _storeish_receiver(ctx, node)):
+                hit = True
+                break
+        ctx.cache["has_div_roots"] = hit
+    return hit
+
+
+# -- MH401 — divergent branch reaching a collective -------------------------
+
+@register
+class DivergentBranchRule(Rule):
+    code = "MH401"
+    name = "divergent-branch-collective"
+    summary = ("Python branch on a process-divergent value whose body "
+               "reaches a collective / compiled-step dispatch / "
+               "block-store barrier — the classic trace-divergence "
+               "pod hang")
+    hint = ("every process in an SPMD pod must execute the identical "
+            "dispatch + collective sequence; a branch on "
+            "jax.process_index() (or a per-peer store read) that "
+            "guards a collective means one process calls it and the "
+            "others don't — the pod hangs at the next barrier. Hoist "
+            "the agreement point out of the branch (all processes "
+            "dispatch; rank-gate only the pure-host side effects like "
+            "logging/checkpoint WRITES), or make the decision from "
+            "pod-uniform state")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if _is_blockstore_module(ctx) or not _file_has_div_roots(ctx):
+            return
+        for qual, fn, cls in _unit_functions(ctx):
+            scan = _div_scan(ctx, fn, cls)
+            seen: Set[Tuple[int, int]] = set()
+            for node in ast.walk(fn):
+                # If/While/IfExp only: an `assert` on a divergent value
+                # is the standard single-process TEST idiom (asserting
+                # on a store read), and the pod-hang shape is a guarded
+                # agreement point, which asserts cannot express
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    continue
+                off = scan.div_use(node.test, node.lineno)
+                if off is None:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                bodies: List[ast.AST] = []
+                if isinstance(node, ast.IfExp):
+                    bodies = [node.body, node.orelse]
+                else:
+                    bodies = list(node.body) + list(node.orelse)
+                hit = None
+                for b in bodies:
+                    for sub in ast.walk(b):
+                        if isinstance(sub, ast.Call):
+                            kind = _agreement_call(ctx, sub, cls)
+                            if kind:
+                                hit = (sub, kind)
+                                break
+                    if hit:
+                        break
+                if hit is None:
+                    continue
+                seen.add(key)
+                yield ctx.finding(
+                    node, self.code,
+                    f"branch on process-divergent value "
+                    f"`{ast.unparse(off)[:40]}` guards a cross-process "
+                    f"agreement point ({hit[1]}) in `{qual}` — "
+                    f"processes diverge on whether they "
+                    f"dispatch/collect",
+                    hint=self.hint)
+
+
+# -- MH402 — collectives/handoffs from unordered iteration ------------------
+
+@register
+class OrderDivergentIterationRule(Rule):
+    code = "MH402"
+    name = "unordered-agreement-iteration"
+    summary = ("collective or cross-process handoff issued from "
+               "iteration over a set — per-process iteration order "
+               "feeds cross-process agreement")
+    hint = ("set iteration order depends on hash seeding and insertion "
+            "history, which differ per process — two processes looping "
+            "`for x in pending:` issue their sends/collectives in "
+            "DIFFERENT orders and the receivers (or the collective "
+            "schedule) disagree. Iterate a canonical order instead: "
+            "`for x in sorted(pending):` (one reviewable line), or "
+            "keep the work queue a list")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qual, fn, cls in _unit_functions(ctx):
+            for loop in (n for n in ast.walk(fn)
+                         if isinstance(n, ast.For)):
+                if not _set_provenance(ctx, loop.iter, loop):
+                    continue
+                hit = None
+                for stmt in loop.body:
+                    for sub in ast.walk(stmt):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        kind = _agreement_call(ctx, sub, cls)
+                        if kind is None and \
+                                _last_seg(ctx.dotted(sub.func)) in \
+                                _HANDOFF_SEGS:
+                            kind = f"handoff:" \
+                                f"{_last_seg(ctx.dotted(sub.func))}"
+                        if kind:
+                            hit = kind
+                            break
+                    if hit:
+                        break
+                if hit is None:
+                    continue
+                yield ctx.finding(
+                    loop, self.code,
+                    f"iteration over a set issues a cross-process "
+                    f"agreement point ({hit}) in `{qual}` — set order "
+                    f"is per-process, so the agreement order diverges",
+                    hint=self.hint)
+
+
+_SET_METHOD_SEGS = frozenset({"union", "intersection", "difference",
+                              "symmetric_difference"})
+
+
+def _set_provenance(ctx: FileContext, node: ast.AST, at: ast.AST,
+                    depth: int = 0) -> bool:
+    """True when ``node`` is statically a ``set``: a literal /
+    comprehension / ``set()``/``frozenset()`` call / set-algebra method
+    or operator over one, or a name whose visible binding is one.
+    Unknown provenance stays silent (``sorted(s)`` is a list — the
+    compliant spelling)."""
+    if depth > 4:
+        return False
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in ("set", "frozenset"):
+            return True
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SET_METHOD_SEGS:
+            return _set_provenance(ctx, node.func.value, at, depth + 1)
+        return False
+    if isinstance(node, ast.BinOp) and \
+            isinstance(node.op, (ast.BitOr, ast.BitAnd, ast.Sub,
+                                 ast.BitXor)):
+        return _set_provenance(ctx, node.left, at, depth + 1) or \
+            _set_provenance(ctx, node.right, at, depth + 1)
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        d = ctx.dotted(node)
+        if d:
+            val = ctx.resolve_binding(d, at)
+            if val is not None:
+                return _set_provenance(ctx, val, at, depth + 1)
+    return False
+
+
+# -- MH403 — clock discipline -----------------------------------------------
+
+@register
+class ClockDisciplineRule(Rule):
+    code = "MH403"
+    name = "clock-discipline"
+    summary = ("raw wall-clock read (time.time/perf_counter/monotonic/"
+               "sleep) in the serving plane outside the declared "
+               "CLOCK_SITES vocabulary")
+    hint = ("serving-plane lifecycle decisions (deadlines, health, "
+            "backoff, autoscaling, stall simulation) run on the ONE "
+            "injected engine clock (`self._clock()` — a VirtualClock "
+            "in tests, `faults.default_clock` in production), so "
+            "every process and every replay sees the same time. A raw "
+            "time.* read forks the time source: route it through the "
+            "engine clock, or — for a genuinely new raw site — add "
+            "its unit to serving/faults.py CLOCK_SITES first (the "
+            "FENCE_SITES pattern). time.sleep never belongs in "
+            "serving: stalls advance the VirtualClock")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not (_in_serving_tree(ctx) or _defines_dispatch(ctx)):
+            return
+        sites = _clock_sites(ctx)
+        for node in ctx.by_type(ast.Call):
+            q = ctx.qualname(node.func)
+            if q not in _WALL_CLOCK_QUALS:
+                continue
+            unit = enclosing_unit(ctx, node)
+            if unit is not None:
+                uq = unit[0]
+                if any(uq == s or uq.endswith("." + s) for s in sites):
+                    continue
+            where = unit[0] if unit else "<module>"
+            yield ctx.finding(
+                node, self.code,
+                f"raw wall-clock read `{q}` in `{where}` — outside "
+                f"the declared CLOCK_SITES {sorted(sites)}",
+                hint=self.hint)
+
+
+# -- MH404 — ambient randomness on replay paths -----------------------------
+
+@register
+class AmbientRandomnessRule(Rule):
+    code = "MH404"
+    name = "ambient-randomness"
+    summary = ("ambient randomness in the serving plane: stdlib "
+               "random.*, the global numpy generator, an unseeded "
+               "default_rng, or a fresh PRNGKey outside sampling's "
+               "seed derivation")
+    hint = ("byte-identical failover/preemption replay is a pure "
+            "function of request seeds: every draw must come from "
+            "sampling.lane_key(seed) derivation (fold_in/split/"
+            "advance_lane) or an explicitly seeded generator "
+            "(np.random.default_rng(seed) — the fault injector's "
+            "sanctioned source). Ambient entropy (random.*, module-"
+            "level np.random draws, default_rng(), a fresh PRNGKey "
+            "outside serving/sampling.py) differs per process and per "
+            "run, so replays and pod peers silently diverge")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not (_in_serving_tree(ctx) or _defines_dispatch(ctx)):
+            return
+        in_sampling = ctx.module.rsplit(".", 1)[-1] == "sampling"
+        for node in ctx.by_type(ast.Call):
+            q = ctx.qualname(node.func)
+            if not q:
+                continue
+            if q in _SEEDED_RNG_QUALS:
+                if not node.args and not node.keywords:
+                    yield ctx.finding(
+                        node, self.code,
+                        f"`{q}()` with no seed draws ambient OS "
+                        f"entropy — replays and pod peers diverge",
+                        hint=self.hint)
+                continue
+            if q in _FRESH_KEY_QUALS:
+                if not in_sampling:
+                    yield ctx.finding(
+                        node, self.code,
+                        f"fresh `{q}` outside sampling's seed "
+                        f"derivation — request streams must derive "
+                        f"every key from sampling.lane_key",
+                        hint=self.hint)
+                continue
+            if q.startswith("random.") or q.startswith("numpy.random."):
+                yield ctx.finding(
+                    node, self.code,
+                    f"`{q}` draws from ambient/global RNG state — "
+                    f"not a pure function of request seeds",
+                    hint=self.hint)
+
+
+# -- MH405 — block-store key namespace --------------------------------------
+
+@register
+class StoreKeyNamespaceRule(Rule):
+    code = "MH405"
+    name = "store-key-namespace"
+    summary = ("block-store key built from a process-divergent value "
+               "without the process-id namespace — cross-process key "
+               "collisions")
+    hint = ("a store key derived from per-process state (a local slot "
+            "number, a peer-read value) can collide across processes: "
+            "two workers write the same key for DIFFERENT rows and "
+            "one silently wins. Namespace such keys by the process id "
+            "(the BlockStoreParameter pattern: "
+            "f\"{ns}/g/{t}/{part}/{src}\" carries the source pid) or "
+            "derive them from pod-uniform coordinates only")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _file_has_div_roots(ctx):
+            return
+        for qual, fn, cls in _unit_functions(ctx):
+            scan = _div_scan(ctx, fn, cls)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "put"
+                        and _storeish_receiver(ctx, node)
+                        and node.args):
+                    continue
+                key = node.args[0]
+                if isinstance(key, ast.Name):
+                    bound = ctx.resolve_binding(key.id, node)
+                    if bound is not None:
+                        key = bound
+                parts = _key_parts(key)
+                if parts is None:
+                    continue
+                div = [p for p in parts
+                       if scan.div_use(p, node.lineno) is not None]
+                if not div or scan.pid_in_parts(parts):
+                    continue
+                yield ctx.finding(
+                    node, self.code,
+                    f"store key interpolates process-divergent value "
+                    f"`{ast.unparse(div[0])[:40]}` without a process-"
+                    f"id component in `{qual}` — keys can collide "
+                    f"across processes",
+                    hint=self.hint)
+
+
+def _key_parts(key: ast.AST) -> Optional[List[ast.AST]]:
+    """Non-constant components of a constructed key: f-string
+    interpolations or ``+``-concat operands. None when the key is not
+    a visible construction (a helper call, a plain constant)."""
+    if isinstance(key, ast.JoinedStr):
+        return [v.value for v in key.values
+                if isinstance(v, ast.FormattedValue)]
+    if isinstance(key, ast.BinOp) and isinstance(key.op, ast.Add):
+        parts: List[ast.AST] = []
+        stack = [key]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+                stack.extend([n.left, n.right])
+            elif not isinstance(n, ast.Constant):
+                parts.append(n)
+        return parts
+    return None
+
+
 # -- the sync-point inventory (--report sync-points) ------------------------
 
 _ASY_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305")
@@ -2322,3 +3151,94 @@ def all_rules_registry():
     from bigdl_tpu.analysis.core import all_rules
 
     return all_rules()
+
+
+# -- the lockstep inventory (--report lockstep) ------------------------------
+
+_MH_CODES = ("MH401", "MH402", "MH403", "MH404", "MH405")
+
+
+def lockstep_inventory(contexts: Sequence[FileContext]) -> List[dict]:
+    """The multi-host pod worksheet (``--report lockstep``, the
+    ``--report sync-points`` twin): everything the process-per-host
+    refactor must keep in LOCKSTEP across the pod —
+
+    * **agreement points**: every unit that directly issues a
+      collective, a compiled-step dispatch, or a block-store barrier
+      (with its hot-path root chain when it has one) — the lines every
+      process must execute the same number of times in the same order;
+    * **divergence roots**: every unit that reads
+      ``jax.process_index()``/``process_count()`` or a per-peer store —
+      the values a lockstep decision must never branch on;
+    * **declared clock sites**: the CLOCK_SITES units (the only legal
+      raw wall-clock reads in the serving plane);
+    * any un-fixed MH401–405 finding, listed like the ASY findings in
+      the sync-point report (suppressed ones shown, not hidden).
+    """
+    from bigdl_tpu.analysis.core import _SUPPRESS_RE
+
+    mh_rules = [r for r in all_rules_registry() if r.code in _MH_CODES]
+    out: List[dict] = []
+    for ctx in contexts:
+        chains = _hot_chains(ctx)
+        sites = _clock_sites(ctx)
+        for qual, fn, cls in _unit_functions(ctx):
+            chain = chains.get(qual)
+            kinds: List[Tuple[ast.AST, str, str]] = []
+            step_segs = _step_attr_segs(ctx)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = ctx.qualname(node.func)
+                seg = _last_seg(ctx.dotted(node.func))
+                if q in _COLLECTIVE_QUALS:
+                    kinds.append((node, "agreement",
+                                  f"collective:{q.rsplit('.', 1)[-1]}"))
+                elif seg == "_dispatch" or seg in step_segs:
+                    kinds.append((node, "agreement", "dispatch"))
+                elif seg in _BARRIER_SEGS:
+                    kinds.append((node, "agreement", f"barrier:{seg}"))
+                if q in _PROCESS_TOPOLOGY_QUALS:
+                    kinds.append((node, "divergence",
+                                  q.rsplit(".", 1)[-1]))
+                elif seg in _PEER_READ_SEGS and \
+                        _storeish_receiver(ctx, node):
+                    kinds.append((node, "divergence", "peer-read"))
+                if q in _WALL_CLOCK_QUALS and any(
+                        qual == s or qual.endswith("." + s)
+                        for s in sites):
+                    kinds.append((node, "clock", q))
+            seen: Set[Tuple[int, str, str]] = set()
+            for node, cat, what in kinds:
+                key = (node.lineno, cat, what)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append({
+                    "path": ctx.relpath,
+                    "line": node.lineno + ctx.line_base,
+                    "function": qual,
+                    "chain": list(chain) if chain else [],
+                    "kind": f"{cat}:{what}",
+                    "classification": {
+                        "agreement": "cross-process agreement point",
+                        "divergence": "process-divergence root",
+                        "clock": "declared clock site",
+                    }[cat],
+                    "detail": ctx.source_line(node.lineno),
+                    "suggestion": "",
+                    "suppressed": False,
+                })
+        for rule in mh_rules:
+            for f in rule.check(ctx):
+                out.append({
+                    "path": f.path, "line": f.line,
+                    "function": "", "chain": [],
+                    "kind": f.code,
+                    "classification": f.message,
+                    "detail": f.source,
+                    "suggestion": rule.hint,
+                    "suppressed": bool(_SUPPRESS_RE.search(f.source)),
+                })
+    out.sort(key=lambda e: (e["path"], e["line"], e["kind"]))
+    return out

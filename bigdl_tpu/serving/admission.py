@@ -55,7 +55,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from bigdl_tpu.serving.fences import fence_wait
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 
 
@@ -256,12 +255,6 @@ class AdmissionController:
         eng.metrics.on_prefix_lookup(matched, len(pf))
         if matched == 0:
             return False
-        # the prefill phase timer brackets prefill AND pool scatter,
-        # matching the per-request path's accounting exactly (the bench
-        # compares serving/prefill_s across admission modes) — on the
-        # ENGINE's clock, like every other serving timer, so injected-
-        # clock runs never mix time sources
-        t0 = eng._clock()
         try:
             if matched == len(pf):             # full hit: zero prefill work
                 eng.pool.write_prefill(slot, carry, len(pf))
@@ -273,20 +266,20 @@ class AdmissionController:
             self._note_shape(1, L)
             # the cached carry's pos IS the start offset: the batch
             # prefill continues over the cached prefix, writing only
-            # positions matched..len(pf)-1
+            # positions matched..len(pf)-1. NO completion fence (and no
+            # phase timer — it would measure the launch, the ASY305
+            # lie): the suffix prefill overlaps the decode step under
+            # async dispatch, and the step's decode fence absorbs its
+            # completion (docs/async_readiness.md cashed-in entry).
             _, out = eng._dispatch(
                 "prefill", eng._batch_prefill_fn, eng.params,
                 jnp.asarray(toks), np.asarray([S], np.int32), carry)
             eng.metrics.on_prefill_batch(1, 1)
-            # completion fence before the finally-block timer read
-            # (ASY305): the phase measures the prefill, not its launch
-            out = fence_wait("prefill", out)
             eng.pool.write_prefill(slot, out, len(pf))
             self.prefix_cache.insert(pf, out)
             return True
         finally:
             self.prefix_cache.release(lease)
-            eng.metrics.add_phase("prefill", eng._clock() - t0)
 
     def _prefill_bucket(self, L: int, rows: List[Tuple]) -> None:
         """ONE masked multi-row prefill for every miss in an L-bucket,
@@ -302,19 +295,18 @@ class AdmissionController:
         for j, (_, _, pf) in enumerate(rows):
             toks[j, :len(pf)] = pf
             lengths[j] = len(pf)
-        t0 = eng._clock()
         self._note_shape(B, L)
+        # NO completion fence, no phase timer: the bucket prefill is
+        # the work async dispatch-ahead overlaps with the decode step —
+        # the step's decode fence absorbs its completion, and a timer
+        # here would measure only the launch (the ASY305 lie). The
+        # PR 12 worksheet marked this site deletable
+        # (docs/async_readiness.md).
         _, out = eng._dispatch("prefill", eng._batch_prefill_fn,
                                eng.params, jnp.asarray(toks), lengths,
                                self._zero_carry())
         eng.metrics.on_prefill_batch(k, B)
-        # completion fence before the timer read (ASY305): the phase
-        # measures the bucket's prefill, not its launch
-        out = fence_wait("prefill", out)
         for j, (_, slot, pf) in enumerate(rows):
             eng.pool.write_prefill(slot, out, len(pf), row=j)
             if self.prefix_cache is not None:
                 self.prefix_cache.insert(pf, self._carry_row(out, j))
-        # timer brackets prefill + per-row pool scatter, matching the
-        # per-request path's serving/prefill_s accounting
-        eng.metrics.add_phase("prefill", eng._clock() - t0)

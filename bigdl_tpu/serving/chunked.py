@@ -73,7 +73,6 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 from bigdl_tpu.serving.admission import AdmissionController, bucket_len
-from bigdl_tpu.serving.fences import fence_wait
 from bigdl_tpu.serving.scheduler import Request
 
 
@@ -137,12 +136,13 @@ class ChunkedAdmissionController(AdmissionController):
         eng.metrics.on_prefix_lookup(matched, len(pf))
         if matched == 0:
             return 0
-        t0 = eng._clock()
         try:
+            # no phase timer: the head write is a device scatter whose
+            # completion the step's decode fence absorbs, like every
+            # un-fenced prefill (the prefill_s phase is gone — PR 15)
             eng.pool.write_prefill(slot, carry, matched)
         finally:
             self.prefix_cache.release(lease)
-            eng.metrics.add_phase("prefill", eng._clock() - t0)
         return matched
 
     # -- the pump: one budget of chunks per super-step -----------------------
@@ -204,26 +204,26 @@ class ChunkedAdmissionController(AdmissionController):
         import numpy as np
 
         eng = self.engine
-        t0 = eng._clock()
         L = bucket_len(n, eng.max_len)
         toks = np.zeros((1, L), np.int32)
         toks[0, :n] = pf[done:done + n]
         row = eng.pool.read_row(slot)          # pos[0] == done
         self._note_shape(1, L)
+        # NO completion fence, no phase timer: the chunk prefill now
+        # dispatches and RETURNS — it overlaps the decode step (the
+        # very overlap chunked admission exists to create) and the
+        # step's decode fence absorbs its completion. A timer here
+        # would measure only the launch (the ASY305 lie); the PR 12
+        # worksheet marked this site deletable
+        # (docs/async_readiness.md).
         _, out = eng._dispatch("prefill", eng._batch_prefill_fn,
                                eng.params, jnp.asarray(toks),
                                np.asarray([n], np.int32), row)
         eng.metrics.on_prefill_batch(1, 1)
-        # completion fence before the timer read (ASY305): the chunk
-        # phase measures the prefill, not its launch — and the fence is
-        # the site the async refactor will move to overlap chunks with
-        # the decode step (docs/async_readiness.md)
-        out = fence_wait("prefill", out)
         eng.pool.write_prefill(slot, out, done + n)
         if done + n == len(pf) and self.prefix_cache is not None:
             self.prefix_cache.insert(pf, out)
         eng.metrics.on_chunk(n)
-        eng.metrics.add_phase("prefill", eng._clock() - t0)
 
     # -- teardown hooks (cancel / fault / preempt paths) --------------------
 

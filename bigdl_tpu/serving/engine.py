@@ -71,7 +71,7 @@ import numpy as np
 from bigdl_tpu.serving.faults import (
     FaultError, WatchdogConfig, default_clock,
 )
-from bigdl_tpu.serving.fences import fence, fence_wait
+from bigdl_tpu.serving.fences import fence
 from bigdl_tpu.serving.kv_pool import KVPool
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.sampling import (
@@ -704,7 +704,6 @@ class ServingEngine:
             if not pf:
                 self.pool.set_pos(slot, 0)
                 continue
-            t0 = self._clock()
             ptoks = jnp.asarray([pf], jnp.int32)
             try:
                 _, pc = self._dispatch("prefill", self._prefill_fn,
@@ -713,11 +712,14 @@ class ServingEngine:
             except FaultError:
                 self._recover_admission([(slot, req)])
                 continue
-            # completion fence before the timer read: without it the
-            # phase measures the LAUNCH, not the prefill (ASY305)
-            self.pool.write_prefill(slot, fence_wait("prefill", pc),
-                                    len(pf))
-            self.metrics.add_phase("prefill", self._clock() - t0)
+            # NO completion fence: the prefill dispatch is exactly the
+            # work async dispatch-ahead overlaps with the decode step —
+            # the step's one decode fence absorbs its completion, and
+            # the per-phase prefill timer went with the wait (a timer
+            # here would measure the launch — the ASY305 lie). The
+            # PR 12 worksheet marked this site deletable
+            # (docs/async_readiness.md).
+            self.pool.write_prefill(slot, pc, len(pf))
         self._note_shard_balance()
 
     # -- resilience: shedding, degradation, preemption, recovery -----------
@@ -1003,7 +1005,7 @@ class ServingEngine:
     def _note_host_step(self, t_begin: float, device_before: float) -> None:
         """Record the per-super-step HOST share: the step's wall time
         minus the device phase windows timed inside it (decode/verify
-        dispatch, draft chain, prefill chunks). This is the Python the
+        dispatch, draft chain). This is the Python the
         device waits on between dispatches — the number the async
         dispatch-ahead refactor exists to shrink (``serving/
         host_step_s``; percentiles in ``summary()``), measured on the

@@ -44,6 +44,22 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 
+#: THE closed clock-site vocabulary (the FENCE_SITES pattern, for
+#: time): the only units in the serving plane allowed to read the raw
+#: wall clock. Everything else runs on the ONE injected engine clock
+#: (``ServingEngine(clock=...)`` — a :class:`VirtualClock` in tests,
+#: :func:`default_clock` in production), so every process in a pod and
+#: every replay sees the same time source. The analyzer extracts this
+#: frozenset (cross-module) and MH403 flags any raw
+#: ``time.time``/``perf_counter``/``monotonic``/``sleep`` spelled in
+#: the serving tree outside these units; a genuinely new raw site must
+#: be added here FIRST — a reviewable one-line diff.
+CLOCK_SITES = frozenset({
+    "faults.default_clock",           # the production clock source
+    "metrics.ServingMetrics.on_step",  # serve-duration anchor timestamps
+})
+
+
 class FaultError(RuntimeError):
     """An injected (or real, if callers raise it) dispatch failure.
     The engine's recovery path catches exactly this: the step's outputs
@@ -166,6 +182,11 @@ class FaultInjector:
         self.counts: Dict[str, int] = {
             "fail": 0, "garbage": 0, "stall": 0, "admit_fail": 0,
             "transfer_stall": 0}
+        # the sanctioned SEEDED source (MH404's contract): an explicit
+        # per-injector Generator keyed by the constructor seed — the
+        # fault schedule is a pure function of (seed, dispatch order),
+        # never of ambient/global RNG state, so chaos runs replay
+        # byte-identically across processes and reruns
         self._rng = np.random.default_rng(int(seed))
 
     @property
@@ -233,5 +254,8 @@ def _corrupt(out: Tuple):
 
 
 def default_clock():
-    """The engine's default time source (the real wall clock)."""
+    """The engine's default time source (the real wall clock) — a
+    declared :data:`CLOCK_SITES` unit: the ONE production read of the
+    raw clock, behind which every serving timer/deadline/backoff
+    decision runs (MH403 flags raw reads anywhere else)."""
     return time.perf_counter()

@@ -47,7 +47,13 @@ FENCE_SITES = frozenset({
     "decode",    # the per-step token/logprob readback (engine.step)
     "verify",    # the speculative super-step's verify readback
     "draft",     # completion of the chained draft dispatches (timing)
-    "prefill",   # completion of a prefill/chunk dispatch (timing)
+    "prefill",   # vocabulary-reserved: the prefill completion fences
+                 # were DELETED in PR 15 (prefill dispatches overlap
+                 # the decode step — docs/async_readiness.md's
+                 # cashed-in entries), so no shipped site spells this
+                 # today; the name stays legal for a deliberate
+                 # prefill wait (e.g. a debugging pin) so re-adding
+                 # one is a diff, not a vocabulary change
     "transfer",  # KV-row handoff serialization (disagg.pack_payload):
                  # one batched readback of every payload leaf
 })

@@ -15,7 +15,11 @@ Counters (all under the ``serving/`` prefix in the backing Metrics):
 * ``latency_s``         — per-request submit → finish
 * ``tokens_out``        — generated tokens per request (recorded at
   finish; sum = total tokens served)
-* ``prefill_s`` / ``decode_step_s`` — phase timings
+* ``decode_step_s``     — the fenced decode/verify dispatch window
+  (prefill dispatches are no longer completion-fenced — they overlap
+  the decode step and their device time lands inside this window; the
+  former ``prefill_s``/``draft_prefill_s`` phase timers went with the
+  fences, see docs/async_readiness.md)
 * ``cancelled``         — requests cancelled while WAITING
 
 Chunked-admission counters (``serving/chunked.py``):
@@ -31,11 +35,10 @@ Chunked-admission counters (``serving/chunked.py``):
   chunk budget). ``decode_gap_percentiles()`` summarizes;
   ``summary()`` reports the p99
 * ``host_step_s``      — per-super-step HOST time: step wall minus the
-  fenced device phase windows (decode/verify dispatch, draft chain,
-  prefill chunks) timed inside it — the Python the device pipeline
-  waits on between dispatches, i.e. the async dispatch-ahead
-  refactor's before-number (``host_step_percentiles()``; ``summary()``
-  reports p50/p99)
+  fenced device phase windows (decode/verify dispatch, draft chain)
+  timed inside it — the Python the device pipeline waits on between
+  dispatches, i.e. the async dispatch-ahead refactor's before-number
+  (``host_step_percentiles()``; ``summary()`` reports p50/p99)
 
 Feasibility admission control (``ServingEngine(deadline_feasibility=
 True)``):
@@ -72,8 +75,9 @@ Speculative-decoding counters (``serving/speculative.py``):
 * ``spec_rows``          — active rows per super-step (row-steps);
   ``summary()`` derives ``tokens_per_step`` ((accepted + rows)/rows —
   emitted tokens per row per target invocation, 1.0 = plain decode)
-* ``draft_s`` / ``draft_prefill_s`` — draft-side phase timings (the
-  verify dispatch lands in ``decode_step_s``)
+* ``draft_s``            — draft-chain phase timing (the verify
+  dispatch lands in ``decode_step_s``; the draft PREFILL is un-fenced
+  and overlaps the step like every prefill)
 
 Sharded-plane counters (``serving/sharded.py``):
 
@@ -206,7 +210,7 @@ class ServingMetrics:
         self._spec_acc = 0.0
         self._spec_rows = 0.0
         # running sum of the DEVICE phase windows (decode/verify
-        # dispatch, draft chain, prefills): the engine's per-step
+        # dispatch, draft chain): the engine's per-step
         # host-vs-device split subtracts this across a step
         # (serving/host_step_s — the async refactor's before-number),
         # plus the decode/verify SAMPLE COUNT so the engine can pair
@@ -222,6 +226,12 @@ class ServingMetrics:
 
     def on_step(self, queue_depth: int, occupancy: float,
                 batch_active: int) -> None:
+        # a declared CLOCK_SITES unit (serving/faults.py): the serve-
+        # duration anchor timestamps (_t_start/_t_last span the whole
+        # serve for summary()'s wall number) deliberately read the raw
+        # wall clock — they are observability, never a lockstep
+        # decision. Everything decision-bearing runs on the engine
+        # clock; MH403 pins any NEW raw read to this vocabulary.
         now = time.perf_counter()
         if self._t_start is None:
             self._t_start = now
@@ -507,9 +517,12 @@ class ServingMetrics:
 
     #: phases timed around fenced DEVICE work — everything else a step
     #: spends is host Python (scheduling, admission bookkeeping,
-    #: per-token accounting)
-    DEVICE_PHASES = frozenset({"decode_step", "draft", "draft_prefill",
-                               "prefill"})
+    #: per-token accounting). The prefill/draft_prefill phases left
+    #: this set when their completion fences were deleted (the PR 12
+    #: worksheet's cashed-in "deletable" entries): prefill dispatches
+    #: now overlap the decode step and their device time lands inside
+    #: the step's one decode/verify fence window.
+    DEVICE_PHASES = frozenset({"decode_step", "draft"})
 
     def add_phase(self, name: str, seconds: float) -> None:
         self.metrics.add(f"serving/{name}_s", float(seconds))

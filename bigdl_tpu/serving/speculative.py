@@ -198,7 +198,6 @@ class Speculator:
         if not pf:
             eng.pool.set_draft_pos(slot, 0)
             return
-        t0 = eng._clock()
         L = bucket_len(len(pf), self.draft_max_len)
         toks = np.zeros((1, L), np.int32)
         toks[0, :len(pf)] = pf
@@ -206,16 +205,16 @@ class Speculator:
         # serving dispatch (SRV201): an un-routed draft prefill would
         # silently escape fault injection and retry accounting — a
         # raised FaultError propagates to the caller (_configure_slot's
-        # callers recover the row like any admission-side fault)
+        # callers recover the row like any admission-side fault).
+        # NO completion fence, no phase timer: the draft prefill
+        # overlaps the decode step under async dispatch and the super-
+        # step's verify fence absorbs its completion (the PR 12
+        # worksheet's deletable entry — docs/async_readiness.md).
         _, dc = eng._dispatch(
             "prefill", self._draft_prefill_fn,
             self._draft_params, jnp.asarray(toks),
             np.asarray([len(pf)], np.int32), self._zero_draft1)
-        # completion fence before the timer read (ASY305): the phase
-        # must measure the draft prefill, not its launch
-        eng.pool.write_draft_prefill(slot, fence_wait("prefill", dc),
-                                     len(pf))
-        eng.metrics.add_phase("draft_prefill", eng._clock() - t0)
+        eng.pool.write_draft_prefill(slot, dc, len(pf))
 
     # -- the super-step ------------------------------------------------------
 

@@ -1,0 +1,42 @@
+"""MH404 ambient-randomness: draws that are NOT a pure function of
+request seeds on serving replay paths — stdlib ``random.*``, the
+module-level numpy global generator, an UNSEEDED ``default_rng()``,
+and a fresh ``jax.random.PRNGKey`` outside the sampling module's seed
+derivation.  Byte-identical failover/preemption replay dies on any of
+them: the replacement draw differs per process and per run.  The
+seeded injector generator and derived-key spellings are the
+false-positive guards."""
+
+import random
+
+import jax
+import numpy as np
+
+
+class ReplayEngine:
+    def __init__(self, seed):
+        # compliant: the sanctioned SEEDED source (the FaultInjector
+        # pattern) — a pure function of the constructor seed
+        self.rng = np.random.default_rng(int(seed))
+        self.base = int(seed)
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def lane(self, req_id):
+        return jax.random.PRNGKey(req_id)           # EXPECT: MH404
+
+    def route(self, pools):
+        return random.choice(pools)                 # EXPECT: MH404
+
+    def jitter(self):
+        backoff = np.random.uniform(0.0, 1.0)       # EXPECT: MH404
+        fresh = np.random.default_rng()             # EXPECT: MH404
+        seeded = self.rng.random()  # compliant: the seeded generator
+        return backoff, fresh, seeded
+
+    def derived(self, key, n):
+        # compliant: deriving from an EXISTING key is the lane
+        # discipline (fold_in/split are pure functions of their input)
+        sub = jax.random.fold_in(key, n)
+        return jax.random.split(sub)[0]

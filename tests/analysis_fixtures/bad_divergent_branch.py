@@ -1,0 +1,61 @@
+"""MH401 divergent-branch-collective: Python branches on process-
+divergent values (``jax.process_index()`` or per-peer block-store
+reads) whose bodies reach a cross-process agreement point — a
+collective, a compiled-step dispatch, or a block-store barrier — by
+call-graph reachability.  One process takes the branch, the others
+don't, and the pod hangs at the next barrier (the classic
+trace-divergence shape).  Rank-gated pure-host side effects and
+branches on pod-uniform ``process_count`` are the false-positive
+guards."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def shard_norm(g):
+    """A helper that ISSUES a collective — branches guarding a call to
+    it diverge the pod exactly like an inline psum."""
+    return lax.psum(jnp.sum(g * g), "data")
+
+
+class PodEngine:
+    def __init__(self, store):
+        self.store = store
+        self.pid = jax.process_index()
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def step(self, step_fn, g):
+        if self.pid == 0:                           # EXPECT: MH401
+            norm = shard_norm(g)
+        blob = self.store.try_get("peer/row")
+        if blob is None:                            # EXPECT: MH401
+            out = self._dispatch("decode", step_fn, g)
+        n = jax.process_index()
+        while n > 0:                                # EXPECT: MH401
+            n = lax.psum(n, "data")
+        return g
+
+    def wait_rank0(self, g):
+        # a divergent branch guarding a block-store BARRIER hangs the
+        # same way: rank 0 waits, the rest never publish
+        if jax.process_index() == 0:                # EXPECT: MH401
+            return self.store.get_blocking("w/0", 1.0)
+        return g
+
+    def rank_gated_logging(self, g):
+        # compliant: the collective runs on EVERY process; only the
+        # pure-host side effect (logging) is rank-gated
+        norm = shard_norm(g)
+        if jax.process_index() == 0:
+            print("norm", norm)
+        return norm
+
+    def uniform_branch(self, g):
+        # compliant: process_count is pod-uniform — every process takes
+        # the same side, so the collective stays in lockstep
+        if jax.process_count() > 1:
+            return shard_norm(g)
+        return g

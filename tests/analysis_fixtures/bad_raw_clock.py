@@ -1,0 +1,36 @@
+"""MH403 clock-discipline: raw wall-clock reads (``time.time`` /
+``perf_counter`` / ``monotonic`` / ``sleep``) in serving-plane code
+outside the declared CLOCK_SITES vocabulary.  Lockstep decisions must
+run on the ONE injected engine clock so every pod peer and every
+replay sees the same time source; the vocabulary below (extraction
+beats the built-in fallback, the FENCE_SITES pattern) declares the one
+sanctioned raw read.  The engine-clock spelling and the declared site
+are the false-positive guards."""
+
+import time
+
+#: the declared vocabulary — the analyzer extracts this instead of the
+#: serving/faults.py fallback when the file is in the project
+CLOCK_SITES = frozenset({"bad_raw_clock.sanctioned_now"})
+
+
+def sanctioned_now():
+    # compliant: THE declared clock site — the one raw read everything
+    # else is injected from
+    return time.perf_counter()
+
+
+class MiniEngine:
+    def __init__(self, clock=sanctioned_now):
+        self._clock = clock
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def step(self, step_fn, x):
+        t0 = time.perf_counter()                    # EXPECT: MH403
+        out = self._dispatch("decode", step_fn, x)
+        time.sleep(0.001)                           # EXPECT: MH403
+        deadline = time.monotonic() + 1.0           # EXPECT: MH403
+        t1 = self._clock()       # compliant: the injected engine clock
+        return out, t1 - t0, deadline

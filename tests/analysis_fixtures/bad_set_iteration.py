@@ -1,0 +1,41 @@
+"""MH402 unordered-agreement-iteration: collectives or cross-process
+handoffs issued from iteration over a ``set`` — set order depends on
+hash seeding and insertion history, which differ per process, so two
+pod peers issue their sends/collectives in different orders and the
+receivers (or the collective schedule) disagree.  ``sorted(...)``
+iteration and handoff-free set loops are the false-positive guards."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+class Router:
+    def __init__(self, channel, store):
+        self.channel = channel
+        self.store = store
+
+    def flush(self, payloads):
+        pending = {1, 2, 3}
+        for slot in pending:                        # EXPECT: MH402
+            self.channel.send(payloads[slot])
+        for slot in sorted(pending):
+            # compliant: a canonical order — every process sends the
+            # same sequence
+            self.channel.send(payloads[slot])
+        live = set(payloads) - {0}
+        for slot in live:                           # EXPECT: MH402
+            self.store.put(f"row_{slot}", payloads[slot])
+        total = 0
+        for slot in live:
+            # compliant: pure host bookkeeping — no agreement point in
+            # the loop body, so per-process order is invisible
+            total += payloads[slot]
+        return total
+
+    def reduce_axes(self, axes, g):
+        for ax in set(axes):                        # EXPECT: MH402
+            g = lax.psum(g, ax)
+        for ax in sorted(set(axes)):
+            # compliant: sorted() materializes a list in ONE order
+            g = lax.pmean(g, ax)
+        return jnp.sum(g)

@@ -1,0 +1,28 @@
+"""MH405 store-key-namespace: block-store keys built from process-
+divergent values (a per-process slot number, anything derived from
+``jax.process_index()``) WITHOUT the process-id namespace — two
+processes can construct the same key for different rows and one
+silently wins (cross-process key collision).  Pid-namespaced keys (the
+BlockStoreParameter ``.../{src}`` pattern) and pod-uniform keys are
+the false-positive guards."""
+
+import jax
+
+
+class HandoffWriter:
+    def __init__(self, store):
+        self.store = store
+
+    def publish(self, t, payload):
+        slot = jax.process_index() * 4 + 1   # divergent, NOT the pid
+        self.store.put(f"row/{t}/{slot}", payload)      # EXPECT: MH405
+        key = f"stash/{slot}"
+        self.store.put(key, payload)                    # EXPECT: MH405
+        key2 = "g/" + str(t) + "/" + str(slot)
+        self.store.put(key2, payload)                   # EXPECT: MH405
+        pid = jax.process_index()
+        # compliant: the pid component namespaces the divergent slot
+        self.store.put(f"row/{t}/{pid}/{slot}", payload)
+        # compliant: pod-uniform coordinates only
+        self.store.put(f"w/{t}", payload)
+        return slot

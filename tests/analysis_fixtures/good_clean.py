@@ -172,3 +172,45 @@ class AsyncReadyEngine:
         carry = fence_wait("prefill", carry)
         self.metrics.add_phase("prefill", self._clock() - t1)
         return emitted, carry
+
+
+# -- multi-host lockstep spellings (MH401-405) ------------------------------
+
+import jax as _jax
+from jax import lax as _lax
+
+
+def pod_norm(g):
+    return _lax.psum(jnp.sum(g * g), "data")
+
+
+class LockstepEngine:
+    """The pod-safe spellings the MH rules must never flag: every
+    process runs the same collective/dispatch sequence, rank-gating
+    covers only pure-host side effects, handoffs iterate canonical
+    orders, keys carry the pid namespace, and all randomness is
+    seed-derived."""
+
+    def __init__(self, store, channel, clock, seed):
+        self.store = store
+        self.channel = channel
+        self._clock = clock                   # the injected engine clock
+        self.pid = _jax.process_index()
+        self.rng = np.random.default_rng(int(seed))   # seeded source
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def pod_step(self, step_fn, g):
+        # every process dispatches and collects — no divergent guard
+        out = self._dispatch("decode", step_fn, g)
+        norm = pod_norm(g)
+        if _jax.process_index() == 0:
+            print("norm", norm)               # rank-gated HOST effect only
+        if _jax.process_count() > 1:          # pod-uniform: lockstep-safe
+            norm = pod_norm(g)
+        t0 = self._clock()                    # injected clock, not time.*
+        for slot in sorted({1, 2, 3}):        # canonical handoff order
+            self.channel.send(slot)
+        self.store.put(f"row/{0}/{self.pid}", out)   # pid-namespaced key
+        return out, norm, self._clock() - t0

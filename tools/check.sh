@@ -8,11 +8,15 @@
 #   tools/check.sh --scan               # analyzer only (sub-second warm)
 #   tools/check.sh --report sync-points # the async-refactor worksheet:
 #                                       # every hot-path sync point with
-#                                       # its root chain (pass-through to
-#                                       # `python -m bigdl_tpu.analysis
-#                                       # --report sync-points`; extra
-#                                       # args, e.g. --format json, are
-#                                       # forwarded)
+#                                       # its root chain
+#   tools/check.sh --report lockstep    # the multi-host pod worksheet:
+#                                       # cross-process agreement points,
+#                                       # divergence roots, declared
+#                                       # clock sites
+#                                       # (both pass through to `python
+#                                       # -m bigdl_tpu.analysis --report
+#                                       # ...`; extra args, e.g.
+#                                       # --format json, are forwarded)
 #
 # Exit nonzero on any new finding or test failure — the scan fails on
 # non-baselined findings of EVERY family, ASY3xx included, so an
