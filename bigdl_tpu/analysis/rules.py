@@ -865,7 +865,7 @@ _DEFAULT_FINISH_REASONS = frozenset(
 #: fallback serialized row-payload schema (single-file fixture runs):
 #: must match serving/disagg.py's ROW_PAYLOAD_KEYS declaration
 _DEFAULT_PAYLOAD_KEYS = ("request", "carry", "draft", "chunk_done",
-                         "chunk_target")
+                         "chunk_target", "adapter")
 
 #: KVPool-lineage roots: any class whose base chain reaches a class
 #: with one of these qualified-name tails owns pooled device state with
@@ -1204,7 +1204,8 @@ class CarryKeyRule(Rule):
             "tok_counts, prompt_mask, k<i>/v<i> and their _scale rows), "
             "and row-payload keys one declared in serving/disagg.py:"
             "ROW_PAYLOAD_KEYS (request, carry, draft, chunk_done, "
-            "chunk_target) — a typo'd key fails only at runtime, or "
+            "chunk_target, adapter) — a typo'd key fails only at "
+            "runtime, or "
             "worse, silently creates a NEW key the step (or the "
             "handoff restore) never reads; fix the spelling or extend "
             "the schema declaration first")

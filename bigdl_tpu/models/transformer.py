@@ -474,6 +474,112 @@ def serving_carry_specs(model: Sequential, sampling: bool = False,
     return specs
 
 
+#: The six adapted projections of one transformer block, in block order —
+#: the layout contract between a model and a serving
+#: :class:`~bigdl_tpu.serving.lora.AdapterBank` (bank keys are
+#: ``f"{site}{layer}_a"`` / ``f"{site}{layer}_b"``).
+ADAPTER_SITES = ("wq", "wk", "wv", "wo", "fc1", "fc2")
+
+
+def adapter_site_shapes(model: Sequential):
+    """Per-layer ``{site: (out_dim, in_dim)}`` weight shapes for the six
+    adapted projections — what a serving AdapterBank sizes its pooled
+    low-rank factors against. Quantized (``weight_q``) layouts are
+    refused: the adapter delta maths against the float weight shapes,
+    and the serving TP plane cannot shard quantized weights anyway."""
+    model._ensure_params()
+    if _tree_has_key(model.params, "weight_q"):
+        raise NotImplementedError(
+            "LoRA adapter serving over quantized (weight_q/w_scale) "
+            "layouts is not wired up — serve the float model")
+    off = _decode_head_offset(model)
+    _, _, blocks, _, _ = _resolve_decode_views(model, off, model.params)
+    shapes = []
+    for blk, bp in blocks:
+        ap = bp[blk._child_key(1)]
+        layer = {name: tuple(ap[name]["weight"].shape)
+                 for name in ("wq", "wk", "wv", "wo")}
+        layer["fc1"] = tuple(bp[blk._child_key(3)]["weight"].shape)
+        layer["fc2"] = tuple(bp[blk._child_key(4)]["weight"].shape)
+        shapes.append(layer)
+    return shapes
+
+
+def adapter_bank_specs(model: Sequential, model_axis: str = "model"):
+    """``PartitionSpec`` dict mirroring an AdapterBank's device arrays
+    for the Megatron serving layout (:func:`tp_param_specs`'s sibling):
+    column-parallel sites (wq/wk/wv/fc1) shard B's OUT axis over
+    ``model_axis`` with A replicated — the delta lands directly on the
+    chip's head/hidden slice, zero communication; row-parallel sites
+    (wo/fc2) shard A's IN axis with B replicated — each chip's partial
+    delta folds into the block's one closing psum
+    (``row_parallel_linear(partial_add=...)``). The adapter-slot axis is
+    always replicated: the bank is tiny next to the weights and every
+    chip must gather any row's factors."""
+    from jax.sharding import PartitionSpec as P
+
+    model._ensure_params()
+    off = _decode_head_offset(model)
+    _, _, blocks, _, _ = _resolve_decode_views(model, off, model.params)
+    specs = {}
+    for i in range(len(blocks)):
+        for name in ("wq", "wk", "wv", "fc1"):
+            specs[f"{name}{i}_a"] = P()
+            specs[f"{name}{i}_b"] = P(None, model_axis)
+        for name in ("wo", "fc2"):
+            specs[f"{name}{i}_a"] = P(None, None, model_axis)
+            specs[f"{name}{i}_b"] = P()
+    return specs
+
+
+def _adapter_delta(bank, site: str, ids, h, scale):
+    """Per-row pooled-LoRA delta for one adapted projection: gather the
+    rows' (A, B) factor pairs from the bank by adapter id and compute
+    ``scale * (h @ A_r^T) @ B_r^T`` with fp32 accumulation. Bank slot 0
+    is the permanently all-zeros NULL adapter, so base-model rows
+    contribute an exact 0.0 and mixed base/tenant traffic stays one
+    compiled program (adding 0.0 is the fp identity up to -0.0 → +0.0).
+    Returns the raw fp32 accumulator — call sites round once."""
+    import jax.numpy as jnp
+
+    a = jnp.take(bank[site + "_a"], ids, axis=0)   # (N, r, in[/tp])
+    b = jnp.take(bank[site + "_b"], ids, axis=0)   # (N, out[/tp], r)
+    if h.ndim == 2:                                # decode: (N, in)
+        z = jnp.einsum("ni,nri->nr", h, a,
+                       preferred_element_type=jnp.float32)
+        d = jnp.einsum("nr,nor->no", z, b,
+                       preferred_element_type=jnp.float32)
+    else:                                          # chunk: (N, S, in)
+        z = jnp.einsum("nsi,nri->nsr", h, a,
+                       preferred_element_type=jnp.float32)
+        d = jnp.einsum("nsr,nor->nso", z, b,
+                       preferred_element_type=jnp.float32)
+    return d * jnp.float32(scale)
+
+
+def _adapter_proj_fns(adapter, adapter_ids, bank):
+    """``(proj, rp_delta)`` for one step invocation: ``proj(p, h, site)``
+    is the serving projection plus the rows' LoRA delta (plain
+    ``_serving_proj``, site ignored, when no adapter is configured);
+    ``rp_delta(h, site)`` is the fp32 partial delta the row-parallel
+    mesh sites fold into their closing psum via ``_tp_row_proj`` (None
+    without an adapter — the projection then runs unchanged)."""
+    if adapter is None:
+        return (lambda p, h, site: _serving_proj(p, h),
+                lambda h, site: None)
+    ascale = adapter.scale
+
+    def proj(p, h, site):
+        y = _serving_proj(p, h)
+        return y + _adapter_delta(bank, site, adapter_ids, h,
+                                  ascale).astype(y.dtype)
+
+    def rp_delta(h, site):
+        return _adapter_delta(bank, site, adapter_ids, h, ascale)
+
+    return proj, rp_delta
+
+
 # Over-provision a growing scale by this factor. A requantization
 # (round(q * s_old / s_new) over the whole stored row) costs up to half
 # a quantum of FRESH rounding error each time it runs, and without
@@ -742,7 +848,8 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
                             mesh=None, data_axis: str = "data",
                             model_axis: str = "model",
                             carry_sampling: bool = False,
-                            kv_quant: bool = False):
+                            kv_quant: bool = False,
+                            adapter=None):
     """MASKED multi-row prompt ingestion: one compiled program prefills a
     whole RAGGED batch of prompts (the admission path of
     ``bigdl_tpu.serving`` — see ``serving/admission.py``). Returns
@@ -803,7 +910,18 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
     when the suffix raises the scale — and the prompt's own attention
     reads the DEQUANTIZED cache, so prefill scores see exactly the
     values decode will (ballast rows still pass through bitwise:
-    zero-length rows have amax 0 and their scatter drops)."""
+    zero-length rows have amax 0 and their scatter drops).
+
+    ``adapter`` (a :class:`~bigdl_tpu.serving.lora.AdapterSpec`) makes
+    the returned step the multi-tenant variant: ``prefill(params,
+    tokens, lengths, carry, adapter_ids, bank)``, where ``adapter_ids``
+    (B,) int32 selects each row's pooled low-rank factor pair and
+    ``bank`` is the AdapterBank's device-array dict — both runtime
+    VALUES of the same one program (bank row 0 is the all-zeros null
+    adapter, so mixed base/tenant batches never recompile). The six
+    per-block projections add the rows' gathered delta; under a mesh
+    the row-parallel sites fold their fp32 partial delta into the
+    block's existing closing psum (collective count unchanged)."""
     import jax
     import jax.numpy as jnp
 
@@ -826,10 +944,12 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
         _check_tp_divisibility(model, heads, tp)
     heads_l = heads // tp
 
-    def prefill(params, tokens, lengths, carry):
+    def prefill(params, tokens, lengths, carry, adapter_ids=None,
+                bank=None):
         Pt = _cast_keep_scales(params, compute_dtype)
         lookup_w, pos_w, blocks, lnf_p, lin_p = \
             _resolve_decode_views(model, off, Pt)
+        aproj, rp_delta = _adapter_proj_fns(adapter, adapter_ids, bank)
         B, L = tokens.shape
         start = carry["pos"]                           # (B,) per-row offset
         rows = jnp.arange(B)
@@ -846,9 +966,9 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
         for i, (blk, bp) in enumerate(blocks):
             h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
             ap = bp[blk._child_key(1)]
-            q = _proj(ap["wq"], h).reshape(B, L, heads_l, hd)
-            k = _proj(ap["wk"], h).reshape(B, L, heads_l, hd)
-            v = _proj(ap["wv"], h).reshape(B, L, heads_l, hd)
+            q = aproj(ap["wq"], h, f"wq{i}").reshape(B, L, heads_l, hd)
+            k = aproj(ap["wk"], h, f"wk{i}").reshape(B, L, heads_l, hd)
+            v = aproj(ap["wv"], h, f"wv{i}").reshape(B, L, heads_l, hd)
             if kv_quant:
                 # int8 storage: per-(row, head) amax over the VALID
                 # columns only (pad columns must not inflate the scale),
@@ -900,15 +1020,17 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
                              preferred_element_type=jnp.float32
                              ).astype(x.dtype).reshape(B, L, heads_l * hd)
             if mesh is None:
-                x = x + _proj(ap["wo"], ctx)
+                x = x + aproj(ap["wo"], ctx, f"wo{i}")
             else:
-                x = x + _tp_row_proj(ap["wo"], ctx, model_axis)
+                x = x + _tp_row_proj(ap["wo"], ctx, model_axis,
+                                     delta32=rp_delta(ctx, f"wo{i}"))
             h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x)
-            hmid = jax.nn.gelu(_proj(bp[blk._child_key(3)], h2))
+            hmid = jax.nn.gelu(aproj(bp[blk._child_key(3)], h2, f"fc1{i}"))
             if mesh is None:
-                mlp = _proj(bp[blk._child_key(4)], hmid)
+                mlp = aproj(bp[blk._child_key(4)], hmid, f"fc2{i}")
             else:
-                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis)
+                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis,
+                                   delta32=rp_delta(hmid, f"fc2{i}"))
             x = x + mlp
         # each row's next-token logits come from its LAST VALID position
         last = jnp.clip(lengths - 1, 0, L - 1)
@@ -918,6 +1040,14 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
         return jax.nn.log_softmax(logits.astype(jnp.float32),
                                   axis=-1), new_carry
 
+    if adapter is None:
+        run = prefill
+    else:
+        # pin the adapter arity (shard_map's in_specs tree must match
+        # the call positionally — no defaulted tail)
+        def run(params, tokens, lengths, carry, adapter_ids, bank):
+            return prefill(params, tokens, lengths, carry, adapter_ids,
+                           bank)
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
@@ -939,14 +1069,18 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
             cspecs["rng"] = P()
             cspecs["tok_counts"] = P()
             cspecs["prompt_mask"] = P()
+        in_specs = [tp_param_specs(model, model_axis), P(), P(), cspecs]
+        if adapter is not None:
+            # adapter ids replicate like tokens/lengths (prefill rows
+            # are few); the bank shards Megatron-style with the weights
+            in_specs += [P(), adapter_bank_specs(model, model_axis)]
         jitted = jax.jit(_shard_map(
-            prefill, mesh=mesh,
-            in_specs=(tp_param_specs(model, model_axis), P(), P(), cspecs),
+            run, mesh=mesh, in_specs=tuple(in_specs),
             out_specs=(P(), cspecs), check_vma=False))
     else:
-        jitted = jax.jit(prefill)
+        jitted = jax.jit(run)
 
-    def prefill_checked(params, tokens, lengths, carry):
+    def prefill_checked(params, tokens, lengths, carry, *adapter_args):
         import numpy as np
 
         lengths = jnp.asarray(lengths, jnp.int32)
@@ -974,7 +1108,12 @@ def make_batch_prefill_step(model: Sequential, compute_dtype=None,
                 raise ValueError(
                     f"rows would write past max_len {max_len}: "
                     f"pos={ps.tolist()} + lengths={ln.tolist()}")
-        return jitted(params, tokens, lengths, carry)
+        if adapter is not None and len(adapter_args) != 2:
+            raise ValueError(
+                "this prefill step was built with an adapter spec — "
+                "call it as prefill(params, tokens, lengths, carry, "
+                "adapter_ids, bank)")
+        return jitted(params, tokens, lengths, carry, *adapter_args)
 
     # exposed so benchmarks/tests can count compiled (B, L) buckets
     prefill_checked._jitted = jitted
@@ -1146,20 +1285,23 @@ def make_decode_step(model: Sequential, compute_dtype=None):
     return jax.jit(step), init_carry
 
 
-def _tp_row_proj(p, x, axis_name: str):
+def _tp_row_proj(p, x, axis_name: str, delta32=None):
     """Row-parallel serving projection: this chip's partial product is
     completed by the block's one closing psum; the bias (replicated)
     is added once, post-psum (``parallel.tensor_parallel``'s layout).
     Partials and the psum accumulate fp32 and round to the serving
     dtype ONCE — matching the unsharded matmul's single rounding, so
     bf16 TP serving stays token-aligned with the single-device engine
-    instead of drifting an ulp per psum addend."""
+    instead of drifting an ulp per psum addend. ``delta32``: an fp32
+    per-chip LoRA partial delta folded into the SAME psum (the adapter
+    path keeps the two-collectives-per-block budget; None = no-op)."""
     import jax.numpy as jnp
 
     from bigdl_tpu.parallel.tensor_parallel import row_parallel_linear
 
     return row_parallel_linear(x, p["weight"], p.get("bias"), axis_name,
-                               accum_dtype=jnp.float32)
+                               accum_dtype=jnp.float32,
+                               partial_add=delta32)
 
 
 def _check_tp_divisibility(model: Sequential, heads: int, tp: int) -> None:
@@ -1192,7 +1334,8 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
                            sampling: bool = False, mesh=None,
                            data_axis: str = "data",
                            model_axis: str = "model",
-                           kv_quant: bool = False):
+                           kv_quant: bool = False,
+                           adapter=None):
     """Per-ROW-position decode step for continuous batching
     (``bigdl_tpu.serving``): every cache row advances independently, so
     one pooled carry can hold many requests at different depths and rows
@@ -1282,6 +1425,19 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     a ``kv_quant`` step is still ONE compiled program for every
     traffic mix, same as the float step (pinned by
     tests/test_serving_kv_quant.py).
+
+    ``adapter`` (a :class:`~bigdl_tpu.serving.lora.AdapterSpec`) selects
+    the multi-tenant variant: the signature grows a trailing
+    ``(adapter_ids, bank)`` pair — ``adapter_ids`` (N,) int32 per-row
+    bank-slot ids, ``bank`` the AdapterBank's device-array dict — and
+    every block's six projections add the rows' gathered low-rank delta
+    (``_adapter_delta``; bank row 0 is the all-zeros NULL adapter, so
+    base rows add an exact 0.0 and mixed base/tenant traffic is the
+    same ONE compiled program). Under a mesh the column-parallel sites
+    compute their delta chip-locally (A replicated, B's out axis
+    sharded) and the row-parallel sites fold an fp32 partial delta into
+    the block's existing closing psum — the two-collectives-per-block
+    budget is unchanged (see :func:`adapter_bank_specs`).
     """
     import jax
     import jax.numpy as jnp
@@ -1313,10 +1469,12 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
 
     _proj = _serving_proj
 
-    def forward(params, tokens, active, carry):
+    def forward(params, tokens, active, carry, adapter_ids=None,
+                bank=None):
         Pt = _cast_keep_scales(params, compute_dtype)
         lookup_w, pos_w, blocks, lnf_p, lin_p = \
             _resolve_decode_views(model, off, Pt)
+        aproj, rp_delta = _adapter_proj_fns(adapter, adapter_ids, bank)
         n = tokens.shape[0]
         pos = carry["pos"]                        # (N,) per-row
         rows = jnp.arange(n)
@@ -1332,9 +1490,9 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
             # under a mesh these params are per-chip column-parallel
             # slices (head-major rows), so the same _proj IS the
             # column-parallel half — zero communication
-            q = _proj(ap["wq"], h).reshape(n, heads_l, hd)
-            k_new = _proj(ap["wk"], h).reshape(n, heads_l, hd)
-            v_new = _proj(ap["wv"], h).reshape(n, heads_l, hd)
+            q = aproj(ap["wq"], h, f"wq{i}").reshape(n, heads_l, hd)
+            k_new = aproj(ap["wk"], h, f"wk{i}").reshape(n, heads_l, hd)
+            v_new = aproj(ap["wv"], h, f"wv{i}").reshape(n, heads_l, hd)
             kc_prev, vc_prev = new_carry[f"k{i}"], new_carry[f"v{i}"]
             if kv_quant:
                 # int8 storage: grow-only (slot, head) scale merge, then
@@ -1391,18 +1549,21 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
                                  vc, preferred_element_type=jnp.float32
                                  ).astype(x.dtype).reshape(n, heads_l * hd)
             if mesh is None:
-                x = x + _proj(ap["wo"], ctx)
+                x = x + aproj(ap["wo"], ctx, f"wo{i}")
             else:
                 # row-parallel output projection — collective 1 of 2
-                x = x + _tp_row_proj(ap["wo"], ctx, model_axis)
+                # (the adapter's partial delta rides the same psum)
+                x = x + _tp_row_proj(ap["wo"], ctx, model_axis,
+                                     delta32=rp_delta(ctx, f"wo{i}"))
             h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x[:, None])
             h2 = h2[:, 0]
-            hmid = jax.nn.gelu(_proj(bp[blk._child_key(3)], h2))
+            hmid = jax.nn.gelu(aproj(bp[blk._child_key(3)], h2, f"fc1{i}"))
             if mesh is None:
-                mlp = _proj(bp[blk._child_key(4)], hmid)
+                mlp = aproj(bp[blk._child_key(4)], hmid, f"fc2{i}")
             else:
                 # row-parallel MLP projection — collective 2 of 2
-                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis)
+                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis,
+                                   delta32=rp_delta(hmid, f"fc2{i}"))
             x = x + mlp
         xf, _ = lnf.apply(lnf_p, x[:, None])
         logits = _proj(lin_p, xf[:, 0])
@@ -1413,13 +1574,15 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     def step(params, tokens, active, carry):
         return forward(params, tokens, active, carry)
 
-    def sample_step(params, tokens, active, carry, knobs):
+    def sample_step(params, tokens, active, carry, knobs,
+                    adapter_ids=None, bank=None):
         # fused sampling epilogue: (N, vocab) log-probs reduce to a
         # per-row token + raw-model log-prob on device (sampling.py is
         # imported lazily — serving imports models, not vice versa)
         from bigdl_tpu.serving.sampling import sample_rows
 
-        logp, new_carry = forward(params, tokens, active, carry)
+        logp, new_carry = forward(params, tokens, active, carry,
+                                  adapter_ids, bank)
         tok, chosen, new_keys, new_counts = sample_rows(
             logp, carry["rng"], knobs, carry["tok_counts"],
             carry["prompt_mask"])
@@ -1436,7 +1599,17 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
     # complete second copy of the whole KV pool per generated token
     # (~300 MB/step at 137M/8 slots). Callers must not touch the input
     # carry after a step — read it (np.asarray) before stepping.
-    fn = sample_step if sampling else step
+    if adapter is None:
+        fn = sample_step if sampling else step
+    elif sampling:
+        # pinned adapter arity (shard_map in_specs match positionally)
+        def fn(params, tokens, active, carry, knobs, adapter_ids, bank):
+            return sample_step(params, tokens, active, carry, knobs,
+                               adapter_ids, bank)
+    else:
+        def fn(params, tokens, active, carry, adapter_ids, bank):
+            return forward(params, tokens, active, carry, adapter_ids,
+                           bank)
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
@@ -1456,6 +1629,11 @@ def make_batch_decode_step(model: Sequential, compute_dtype=None,
         else:
             in_specs = (pspecs, row, row, cspecs)
             out_specs = (row, cspecs)
+        if adapter is not None:
+            # per-row adapter ids shard with their rows; the bank
+            # shards Megatron-style with the weights it adapts
+            in_specs = in_specs + (row,
+                                   adapter_bank_specs(model, model_axis))
         # check_vma/check_rep off: sampled tokens and non-head state are
         # REPLICATED over the model axis (every model chip computes the
         # identical post-psum value deterministically), which the static
@@ -1471,7 +1649,8 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
                            width: int = 4, mesh=None,
                            data_axis: str = "data",
                            model_axis: str = "model",
-                           kv_quant: bool = False):
+                           kv_quant: bool = False,
+                           adapter=None):
     """Speculative DRAFT-AND-VERIFY step for the serving engine
     (``bigdl_tpu.serving.speculative``): one compiled program scores a
     per-row CHUNK of candidate tokens against the target model and
@@ -1579,6 +1758,14 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
     prefill pair documents — drift is pinned by the speculative parity
     tests (tests/test_serving_speculative.py: greedy outputs equal the
     baseline engine and generate()).
+
+    ``adapter`` follows :func:`make_batch_decode_step`: the signature
+    grows a trailing ``(adapter_ids, bank)`` pair and every chunk
+    position's six projections add the rows' gathered low-rank delta —
+    the TARGET model's verification scores each row under that ROW'S
+    adapter, so accept-rate accounting can never mix an adapted target
+    with the wrong factors (the engine pins drafts to the null
+    adapter; see serving/speculative.py).
     """
     import jax
     import jax.numpy as jnp
@@ -1609,12 +1796,14 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
                                      cache_dtype, kv_quant, True, vocab)
     _proj = _serving_proj
 
-    def verify(params, tokens, lengths, carry, knobs):
+    def verify(params, tokens, lengths, carry, knobs, adapter_ids=None,
+               bank=None):
         from bigdl_tpu.serving.sampling import sample_rows
 
         Pt = _cast_keep_scales(params, compute_dtype)
         lookup_w, pos_w, blocks, lnf_p, lin_p = \
             _resolve_decode_views(model, off, Pt)
+        aproj, rp_delta = _adapter_proj_fns(adapter, adapter_ids, bank)
         N = tokens.shape[0]
         start = carry["pos"]                          # (N,) per-row
         rows = jnp.arange(N)
@@ -1630,9 +1819,9 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
         for i, (blk, bp) in enumerate(blocks):
             h, _ = blk.ln1.apply(bp[blk._child_key(0)], x)
             ap = bp[blk._child_key(1)]
-            q = _proj(ap["wq"], h).reshape(N, S, heads_l, hd)
-            k = _proj(ap["wk"], h).reshape(N, S, heads_l, hd)
-            v = _proj(ap["wv"], h).reshape(N, S, heads_l, hd)
+            q = aproj(ap["wq"], h, f"wq{i}").reshape(N, S, heads_l, hd)
+            k = aproj(ap["wk"], h, f"wk{i}").reshape(N, S, heads_l, hd)
+            v = aproj(ap["wv"], h, f"wv{i}").reshape(N, S, heads_l, hd)
             if kv_quant:
                 # int8 storage, ACCEPTED-ONLY merge: the chunk attention
                 # reads the stored cache dequantized at the CURRENT
@@ -1675,15 +1864,17 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
                              preferred_element_type=jnp.float32
                              ).astype(x.dtype).reshape(N, S, heads_l * hd)
             if mesh is None:
-                x = x + _proj(ap["wo"], ctx)
+                x = x + aproj(ap["wo"], ctx, f"wo{i}")
             else:
-                x = x + _tp_row_proj(ap["wo"], ctx, model_axis)
+                x = x + _tp_row_proj(ap["wo"], ctx, model_axis,
+                                     delta32=rp_delta(ctx, f"wo{i}"))
             h2, _ = blk.ln2.apply(bp[blk._child_key(2)], x)
-            hmid = jax.nn.gelu(_proj(bp[blk._child_key(3)], h2))
+            hmid = jax.nn.gelu(aproj(bp[blk._child_key(3)], h2, f"fc1{i}"))
             if mesh is None:
-                mlp = _proj(bp[blk._child_key(4)], hmid)
+                mlp = aproj(bp[blk._child_key(4)], hmid, f"fc2{i}")
             else:
-                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis)
+                mlp = _tp_row_proj(bp[blk._child_key(4)], hmid, model_axis,
+                                   delta32=rp_delta(hmid, f"fc2{i}"))
             x = x + mlp
         # EVERY position's next-token distribution (the whole point —
         # prefill keeps only the last valid one)
@@ -1769,7 +1960,13 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
         new_carry["pos"] = start + n_emit
         return s_tok, s_lp, n_emit, new_carry
 
-    fn = verify
+    if adapter is None:
+        fn = verify
+    else:
+        # pinned adapter arity (shard_map in_specs match positionally)
+        def fn(params, tokens, lengths, carry, knobs, adapter_ids, bank):
+            return verify(params, tokens, lengths, carry, knobs,
+                          adapter_ids, bank)
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
@@ -1781,13 +1978,15 @@ def make_batch_verify_step(model: Sequential, compute_dtype=None,
                                      model_axis=model_axis,
                                      kv_quant=kv_quant)
         row = P(data_axis)
+        in_specs = (tp_param_specs(model, model_axis), row, row, cspecs,
+                    knob_partition_specs(data_axis))
+        if adapter is not None:
+            in_specs = in_specs + (row,
+                                   adapter_bank_specs(model, model_axis))
         # check_vma off for the decode step's reason: chunk draws and
         # non-head state replicate over the model axis deterministically,
         # which the static checker cannot prove through the sampler
-        fn = _shard_map(fn, mesh=mesh,
-                        in_specs=(tp_param_specs(model, model_axis),
-                                  row, row, cspecs,
-                                  knob_partition_specs(data_axis)),
+        fn = _shard_map(fn, mesh=mesh, in_specs=in_specs,
                         out_specs=(row, row, row, cspecs),
                         check_vma=False)
     # carry donated like the decode step's: the engine swaps its pooled
@@ -1860,21 +2059,26 @@ def get_batch_decode_step(model: Sequential, compute_dtype=None,
                           sampling: bool = False, mesh=None,
                           data_axis: str = "data",
                           model_axis: str = "model",
-                          kv_quant: bool = False):
+                          kv_quant: bool = False, adapter=None):
     """Cached :func:`make_batch_decode_step` (the serving engine's step).
     ``sampling=True`` selects the sampled-epilogue variant (its own
     cache entry — the two steps have different signatures/carries);
     ``mesh`` selects the shard_map-lowered tensor-parallel variant
     (cached per mesh); ``kv_quant`` the int8-KV variant (own entry —
-    different carry structure). See :func:`make_batch_decode_step`."""
+    different carry structure); ``adapter`` (a hashable
+    :class:`~bigdl_tpu.serving.lora.AdapterSpec`) the multi-tenant
+    variant — engines sharing a (model, dtype, adapter-config) share
+    one compiled program. See :func:`make_batch_decode_step`."""
     kind = "batch_decode_sample" if sampling else "batch_decode"
     extra = ("int8" if kv_quant else None,
-             None if mesh is None else (mesh, data_axis, model_axis))
+             None if mesh is None else (mesh, data_axis, model_axis),
+             adapter)
     return _step_cache(model, kind, compute_dtype,
                        lambda: make_batch_decode_step(
                            model, compute_dtype, sampling=sampling,
                            mesh=mesh, data_axis=data_axis,
-                           model_axis=model_axis, kv_quant=kv_quant),
+                           model_axis=model_axis, kv_quant=kv_quant,
+                           adapter=adapter),
                        extra=extra)
 
 
@@ -1882,19 +2086,20 @@ def get_batch_verify_step(model: Sequential, compute_dtype=None,
                           width: int = 4, mesh=None,
                           data_axis: str = "data",
                           model_axis: str = "model",
-                          kv_quant: bool = False):
+                          kv_quant: bool = False, adapter=None):
     """Cached :func:`make_batch_verify_step` (the speculative engine's
     one target-side program). ``width`` (the chunk width = max drafts
-    + 1) keys the cache alongside the mesh/kv_quant variants — engines
-    sharing a (model, dtype, width) share one compiled verify program,
-    exactly like the decode step cache."""
+    + 1) keys the cache alongside the mesh/kv_quant/adapter variants —
+    engines sharing a (model, dtype, width) share one compiled verify
+    program, exactly like the decode step cache."""
     extra = (int(width), "int8" if kv_quant else None,
-             None if mesh is None else (mesh, data_axis, model_axis))
+             None if mesh is None else (mesh, data_axis, model_axis),
+             adapter)
     return _step_cache(model, "batch_verify", compute_dtype,
                        lambda: make_batch_verify_step(
                            model, compute_dtype, width=width, mesh=mesh,
                            data_axis=data_axis, model_axis=model_axis,
-                           kv_quant=kv_quant),
+                           kv_quant=kv_quant, adapter=adapter),
                        extra=extra)
 
 
@@ -1902,21 +2107,23 @@ def get_batch_prefill_step(model: Sequential, compute_dtype=None,
                            mesh=None, data_axis: str = "data",
                            model_axis: str = "model",
                            carry_sampling: bool = False,
-                           kv_quant: bool = False):
+                           kv_quant: bool = False, adapter=None):
     """Cached :func:`make_batch_prefill_step` (the batched-admission
     prefill; one wrapper whose jit re-traces per (B, L) bucket).
     ``mesh``/``carry_sampling`` select the shard_map-lowered tensor-
     parallel variant (cached per mesh + carry layout); ``kv_quant``
-    the int8-KV-writing variant."""
+    the int8-KV-writing variant; ``adapter`` the multi-tenant variant
+    (prefill signature grows ``(adapter_ids, bank)``)."""
     extra = ("int8" if kv_quant else None,
              None if mesh is None else (mesh, data_axis, model_axis,
-                                        carry_sampling))
+                                        carry_sampling),
+             adapter)
     return _step_cache(model, "batch_prefill", compute_dtype,
                        lambda: make_batch_prefill_step(
                            model, compute_dtype, mesh=mesh,
                            data_axis=data_axis, model_axis=model_axis,
                            carry_sampling=carry_sampling,
-                           kv_quant=kv_quant),
+                           kv_quant=kv_quant, adapter=adapter),
                        extra=extra)
 
 

@@ -67,7 +67,7 @@ def column_parallel_linear(x, w_shard, b_shard=None, axis_name: str = "model",
 
 
 def row_parallel_linear(x_shard, w_shard, b=None, axis_name: str = "model",
-                        accum_dtype=None):
+                        accum_dtype=None, partial_add=None):
     """y = psum_over_axis(x_shard @ w_shard.T) (+ b).
 
     ``x_shard``: feature-sharded activations ``(..., in/n)``; ``w_shard``:
@@ -81,6 +81,12 @@ def row_parallel_linear(x_shard, w_shard, b=None, axis_name: str = "model",
     sharded result drifts a full low-precision ulp from the unsharded
     matmul (enough to flip a greedy argmax on near-tied logits; the
     serving plane's TP steps pass fp32 here for exactly that reason).
+
+    ``partial_add`` (requires ``accum_dtype``): an extra per-chip partial
+    contribution in the accumulation dtype, folded into the SAME closing
+    psum — the serving plane's per-row LoRA delta rides here, so adapted
+    projections keep the one-collective-per-projection budget (an
+    all-zeros partial passes through exactly: ``acc + 0.0 == acc``).
     """
     import jax.lax as lax
     import jax.numpy as jnp
@@ -90,8 +96,12 @@ def row_parallel_linear(x_shard, w_shard, b=None, axis_name: str = "model",
             x_shard, w_shard,
             (((x_shard.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=accum_dtype)
+        if partial_add is not None:
+            acc = acc + partial_add.astype(accum_dtype)
         y = lax.psum(acc, axis_name).astype(x_shard.dtype)
     else:
+        if partial_add is not None:
+            raise ValueError("partial_add requires accum_dtype")
         y = lax.psum(jnp.matmul(x_shard, w_shard.T), axis_name)
     if b is not None:
         y = y + b

@@ -31,7 +31,13 @@ makes each POOL a failure domain: heartbeat/transfer-failure health
 classification, decode-pool failover that reconstructs every stranded
 row loss-free-or-replayed with token-identical streams, graceful
 ``drain_pool`` migration, backoff-hardened transfer retries, and an
-occupancy autoscaler with hysteresis. See ``docs/serving.md``.
+occupancy autoscaler with hysteresis. The plane is MULTI-TENANT
+(``lora.py`` + ``constrain.py``): a pooled per-row LoRA adapter bank
+lets every request carry its own adapter id as runtime data of the one
+compiled step (id 0 = the base model, mixed traffic recompiles
+nothing), and per-row token-mask constrained decoding rides the same
+knob arrays — both replay byte-identically through preemption,
+handoff, and failover. See ``docs/serving.md``.
 
     from bigdl_tpu.serving import SamplingParams, ServingEngine
 
@@ -49,6 +55,10 @@ from bigdl_tpu.serving.admission import (
     AdmissionController, Degrade, bucket_len,
 )
 from bigdl_tpu.serving.chunked import ChunkedAdmissionController
+from bigdl_tpu.serving.constrain import (
+    ConstraintCursor, ConstraintError, TokenDFA, fixed_sequence,
+    from_token_sets,
+)
 from bigdl_tpu.serving.disagg import (
     BlockStoreTransfer, DecodeWorker, DisaggregatedEngine,
     InProcessTransfer, KVTransfer, PrefillWorker, ROW_PAYLOAD_KEYS,
@@ -64,6 +74,7 @@ from bigdl_tpu.serving.faults import (
 )
 from bigdl_tpu.serving.fences import FENCE_SITES, fence, fence_wait
 from bigdl_tpu.serving.kv_pool import KVPool
+from bigdl_tpu.serving.lora import AdapterBank, AdapterSpec
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.prefix_cache import PrefixCache
 from bigdl_tpu.serving.sampling import SamplingParams
@@ -86,4 +97,6 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "ROW_PAYLOAD_KEYS", "pack_payload", "payload_header",
            "unpack_payload", "HealthConfig", "PoolHealth",
            "TransferRetryConfig", "AutoscalerConfig",
-           "OccupancyAutoscaler"]
+           "OccupancyAutoscaler", "AdapterBank", "AdapterSpec",
+           "TokenDFA", "ConstraintCursor", "ConstraintError",
+           "fixed_sequence", "from_token_sets"]

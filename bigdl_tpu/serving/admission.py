@@ -225,7 +225,7 @@ class AdmissionController:
                 continue
             if self.prefix_cache is not None:
                 try:
-                    if self._try_prefix(slot, pf):
+                    if self._try_prefix(slot, req, pf):
                         continue
                 except FaultError:
                     eng._recover_admission([(slot, req)])
@@ -243,15 +243,20 @@ class AdmissionController:
                     eng._recover_admission(
                         [(slot, req) for req, slot, _ in chunk])
 
-    def _try_prefix(self, slot: int, pf: List[int]) -> bool:
+    def _try_prefix(self, slot: int, req, pf: List[int]) -> bool:
         """The prefix-cache path: full hit → clone into the pool;
         partial hit → clone + prefill only the suffix. Returns False on
-        a miss (the caller buckets the prompt normally)."""
+        a miss (the caller buckets the prompt normally). Lookups and
+        inserts are NAMESPACED by the request's adapter id — K/V
+        computed under one tenant's factors must never splice into
+        another tenant's row (null-adapter traffic keeps today's shared
+        namespace and hit rate)."""
         import jax.numpy as jnp
         import numpy as np
 
         eng = self.engine
-        carry, matched, lease = self.prefix_cache.acquire(pf)
+        carry, matched, lease = self.prefix_cache.acquire(
+            pf, adapter_id=req.adapter_id)
         eng.metrics.on_prefix_lookup(matched, len(pf))
         if matched == 0:
             return False
@@ -273,10 +278,11 @@ class AdmissionController:
             # completion (docs/async_readiness.md cashed-in entry).
             _, out = eng._dispatch(
                 "prefill", eng._batch_prefill_fn, eng.params,
-                jnp.asarray(toks), np.asarray([S], np.int32), carry)
+                jnp.asarray(toks), np.asarray([S], np.int32), carry,
+                *eng._prefill_adapter_args([req.adapter_id]))
             eng.metrics.on_prefill_batch(1, 1)
             eng.pool.write_prefill(slot, out, len(pf))
-            self.prefix_cache.insert(pf, out)
+            self.prefix_cache.insert(pf, out, adapter_id=req.adapter_id)
             return True
         finally:
             self.prefix_cache.release(lease)
@@ -292,9 +298,11 @@ class AdmissionController:
         B = self.prefill_rows
         toks = np.zeros((B, L), np.int32)
         lengths = np.zeros((B,), np.int32)     # pad rows stay ballast (0)
-        for j, (_, _, pf) in enumerate(rows):
+        aids = np.zeros((B,), np.int32)        # pad rows: null adapter
+        for j, (req, _, pf) in enumerate(rows):
             toks[j, :len(pf)] = pf
             lengths[j] = len(pf)
+            aids[j] = req.adapter_id
         self._note_shape(B, L)
         # NO completion fence, no phase timer: the bucket prefill is
         # the work async dispatch-ahead overlaps with the decode step —
@@ -304,9 +312,11 @@ class AdmissionController:
         # (docs/async_readiness.md).
         _, out = eng._dispatch("prefill", eng._batch_prefill_fn,
                                eng.params, jnp.asarray(toks), lengths,
-                               self._zero_carry())
+                               self._zero_carry(),
+                               *eng._prefill_adapter_args(aids))
         eng.metrics.on_prefill_batch(k, B)
-        for j, (_, slot, pf) in enumerate(rows):
+        for j, (req, slot, pf) in enumerate(rows):
             eng.pool.write_prefill(slot, out, len(pf), row=j)
             if self.prefix_cache is not None:
-                self.prefix_cache.insert(pf, self._carry_row(out, j))
+                self.prefix_cache.insert(pf, self._carry_row(out, j),
+                                         adapter_id=req.adapter_id)

@@ -117,7 +117,7 @@ class ChunkedAdmissionController(AdmissionController):
                 continue
             done = 0
             if self.prefix_cache is not None:
-                done = self._prefix_head(slot, pf)
+                done = self._prefix_head(slot, req, pf)
             if done >= len(pf):                # full hit: zero chunks
                 eng.scheduler.activate(slot)
                 continue
@@ -125,14 +125,16 @@ class ChunkedAdmissionController(AdmissionController):
             self._plans[slot] = (req, pf)
             self._order.append(slot)
 
-    def _prefix_head(self, slot: int, pf: List[int]) -> int:
+    def _prefix_head(self, slot: int, req, pf: List[int]) -> int:
         """Prefix-cache head write: the longest cached prefix lands in
         the slot in one scatter and its tokens SKIP the chunk plan —
         returns the matched length (0 on a miss). Unlike the batched
         path, the remaining suffix is NOT prefilled here; it becomes
-        the chunk plan."""
+        the chunk plan. Namespaced by the request's adapter id, like
+        every prefix-cache touch."""
         eng = self.engine
-        carry, matched, lease = self.prefix_cache.acquire(pf)
+        carry, matched, lease = self.prefix_cache.acquire(
+            pf, adapter_id=req.adapter_id)
         eng.metrics.on_prefix_lookup(matched, len(pf))
         if matched == 0:
             return 0
@@ -180,7 +182,7 @@ class ChunkedAdmissionController(AdmissionController):
                     full = True
                     break
                 try:
-                    self._feed_chunk(slot, pf, done, n)
+                    self._feed_chunk(slot, req, pf, done, n)
                 except FaultError:
                     # evicts this row only (drops its plan via the
                     # engine's recovery hook); the round continues
@@ -194,7 +196,7 @@ class ChunkedAdmissionController(AdmissionController):
         self._order = [s for s in self._order if s in self._plans]
         eng.metrics.on_partial_rows(len(self._plans))
 
-    def _feed_chunk(self, slot: int, pf: List[int], done: int,
+    def _feed_chunk(self, slot: int, req, pf: List[int], done: int,
                     n: int) -> None:
         """ONE suffix-continuation prefill of ``pf[done:done+n]`` for a
         slot: the slot's current row is the input carry (its ``pos`` is
@@ -218,11 +220,13 @@ class ChunkedAdmissionController(AdmissionController):
         # (docs/async_readiness.md).
         _, out = eng._dispatch("prefill", eng._batch_prefill_fn,
                                eng.params, jnp.asarray(toks),
-                               np.asarray([n], np.int32), row)
+                               np.asarray([n], np.int32), row,
+                               *eng._prefill_adapter_args(
+                                   [req.adapter_id]))
         eng.metrics.on_prefill_batch(1, 1)
         eng.pool.write_prefill(slot, out, done + n)
         if done + n == len(pf) and self.prefix_cache is not None:
-            self.prefix_cache.insert(pf, out)
+            self.prefix_cache.insert(pf, out, adapter_id=req.adapter_id)
         eng.metrics.on_chunk(n)
 
     # -- teardown hooks (cancel / fault / preempt paths) --------------------

@@ -128,13 +128,15 @@ from bigdl_tpu.serving.scheduler import CANCELLED, FINISHED, Request
 #: THE serialized row-payload schema — every top-level key a handoff
 #: payload may carry. ``carry`` is the B=1 target-carry slice (its own
 #: keys are the SRV202 carry schema), ``draft`` the optional draft-carry
-#: slice, ``chunk_done``/``chunk_target`` the host chunk mirrors, and
-#: ``request`` the wire header's request metadata. Closed like
-#: ``ServingMetrics.FINISH_REASONS``: the static analyzer (SRV202)
+#: slice, ``chunk_done``/``chunk_target`` the host chunk mirrors,
+#: ``adapter`` the row's LoRA adapter slot id (``serving/lora.py`` —
+#: rides the wire so a restored row keeps gathering its tenant's
+#: factors), and ``request`` the wire header's request metadata. Closed
+#: like ``ServingMetrics.FINISH_REASONS``: the static analyzer (SRV202)
 #: reads this declaration and flags any payload subscript outside it,
 #: so a typo'd transfer key cannot silently drop a field on the floor.
 ROW_PAYLOAD_KEYS = ("request", "carry", "draft", "chunk_done",
-                    "chunk_target")
+                    "chunk_target", "adapter")
 
 _WIRE_MAGIC = b"BDRH"                  # row-handoff wire format v1
 
@@ -167,6 +169,15 @@ def request_meta(req: Request) -> Dict:
         # budget, not get a fresh one per pool
         "retries": int(req.retries),
         "preemptions": int(req.preemptions),
+        # multi-tenant plane (serving/lora.py, serving/constrain.py):
+        # the adapter id must survive the wire so the decode pool
+        # gathers the same tenant's factors, and the constraint
+        # travels as its AUTOMATON meta — never a cursor: the
+        # receiver rebuilds the cursor from the emitted prefix
+        # (constraint.cursor(req.output)), THE replay rule
+        "adapter_id": int(req.adapter_id),
+        "constraint": (None if req.constraint is None
+                       else req.constraint.to_meta()),
     }
 
 
@@ -184,7 +195,13 @@ def request_from_meta(meta: Dict) -> Request:
         draft_tokens=meta.get("draft_tokens"),
         priority=int(meta.get("priority", 0)),
         deadline_s=meta.get("deadline_s"),
-        submit_time=float(meta.get("submit_time", 0.0)))
+        submit_time=float(meta.get("submit_time", 0.0)),
+        adapter_id=int(meta.get("adapter_id", 0)))
+    cmeta = meta.get("constraint")
+    if cmeta is not None:
+        from bigdl_tpu.serving.constrain import TokenDFA
+
+        req.constraint = TokenDFA.from_meta(cmeta)
     req.output = [int(t) for t in meta.get("output", ())]
     req.logprobs = [float(v) for v in meta.get("logprobs", ())]
     req.first_token_time = meta.get("first_token_time")
@@ -220,6 +237,7 @@ def pack_payload(meta: Dict, payload: Optional[Dict]) -> bytes:
         "request": meta,
         "chunk_done": int(payload["chunk_done"]),
         "chunk_target": int(payload["chunk_target"]),
+        "adapter": int(payload["adapter"]),
         "carry_keys": sorted(carry),
         "draft_keys": None if draft is None else sorted(draft),
     }
@@ -282,6 +300,7 @@ def unpack_payload(blob: bytes) -> Tuple[Dict, Optional[Dict]]:
                   else _arrays(head["draft_keys"])),
         "chunk_done": int(head["chunk_done"]),
         "chunk_target": int(head["chunk_target"]),
+        "adapter": int(head.get("adapter", 0)),
     }
     return head["request"], payload
 
@@ -427,6 +446,9 @@ class PrefillWorker:
         self.engine.pool.free(slot)
         self.engine._configured.discard(slot)
         self.engine._restored.discard(slot)
+        # the cursor never travels — the decode pool rebuilds it from
+        # the emitted prefix at slot configuration (the replay rule)
+        self.engine._constraints.pop(slot, None)
         return payload
 
     def requeue(self, req: Request, payload: Dict) -> None:
@@ -766,7 +788,7 @@ class DisaggregatedEngine:
                  standby_pools: int = 0,
                  health: Optional[HealthConfig] = None,
                  transfer_retry: Optional[TransferRetryConfig] = None,
-                 autoscaler=None) -> None:
+                 autoscaler=None, adapters=None) -> None:
         if decode_pools < 1:
             raise ValueError(
                 f"decode_pools must be >= 1, got {decode_pools}")
@@ -779,9 +801,15 @@ class DisaggregatedEngine:
             else HealthConfig()
         self.transfer_retry = transfer_retry if transfer_retry is not None \
             else TransferRetryConfig()
+        # ONE AdapterBank object shared by the prefill engine and every
+        # decode worker: the gather programs agree on the bank shapes,
+        # and the refcount taken at the prefill door (submit) is
+        # released by whichever engine finally finishes the request —
+        # one retain, one release, however many pools the row crosses
         shared = dict(compute_dtype=compute_dtype, kv_dtype=kv_dtype,
                       speculative=speculative, seed=seed, clock=clock,
-                      faults=faults, keep_finished=keep_finished)
+                      faults=faults, keep_finished=keep_finished,
+                      adapters=adapters)
         # the prefill pool shares the decode policy so priority
         # traffic orders ADMISSION too (no preemption there: its rows
         # drain to handoff every pump, so eviction has nothing to buy)
@@ -911,6 +939,10 @@ class DisaggregatedEngine:
         ledger so result()/accounting stay closed."""
         req.state = CANCELLED
         peng = self.prefill.engine
+        # the adapter refcount taken at the prefill door follows the
+        # request wherever it dies — including here, cancelled on the
+        # wire before any pool owned it
+        peng._release_adapter(req)
         peng._finished[req.req_id] = req
         peng._evict_finished()
         peng.metrics.on_cancel()
@@ -1121,6 +1153,7 @@ class DisaggregatedEngine:
             w.engine.pool.free(slot)
             w.engine._configured.discard(slot)
             w.engine._restored.discard(slot)
+            w.engine._constraints.pop(slot, None)
             blob = pack_payload(request_meta(req), payload)
             self._stash[req.req_id] = blob
             self._forward(blob)

@@ -223,7 +223,8 @@ class ServingEngine:
                  degrade_at: Optional[int] = None,
                  preemption: Optional[bool] = None,
                  watchdog: Optional[WatchdogConfig] = None,
-                 faults=None) -> None:
+                 faults=None,
+                 adapters=None) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -259,6 +260,22 @@ class ServingEngine:
             raise ValueError(
                 "preemption=True requires policy='priority' — victim "
                 "selection is a priority-order decision")
+        # multi-tenant LoRA (serving/lora.py): an AdapterBank makes the
+        # compiled steps gather per-row low-rank factors by the rows'
+        # adapter ids — runtime data, one program for mixed traffic.
+        # The per-request B=1 prefill path predates the batched row
+        # convention the adapter arguments ride, so it stays base-only.
+        if adapters is not None and admission == "per_request":
+            raise ValueError(
+                "adapters require admission='batched' or 'chunked' — "
+                "the per-request prefill has no adapter arguments")
+        self.adapters = adapters
+        self._adapter_spec = None if adapters is None else adapters.spec
+        # device-side bank cache, invalidated by the bank's version
+        # counter (alloc/free mutate the host arrays; steady-state
+        # decode reuses the placed arrays)
+        self._bank_device = None
+        self._bank_version = None
         # resilience wiring: the engine's ONE time source (a
         # VirtualClock here lets deadline/stall tests move time without
         # sleeping), the step watchdog, and the optional deterministic
@@ -343,7 +360,8 @@ class ServingEngine:
             self._spec = None
             self._step_fn, pool_init = get_batch_decode_step(
                 model, compute_dtype, sampling=True,
-                mesh=self.mesh if tp else None, kv_quant=kv_quant)
+                mesh=self.mesh if tp else None, kv_quant=kv_quant,
+                adapter=self._adapter_spec)
         else:
             from bigdl_tpu.serving.speculative import Speculator
 
@@ -374,7 +392,15 @@ class ServingEngine:
         self.seed = int(seed)
         # host-side per-slot knob rows (greedy no-op state) + which
         # slots have been configured for their current occupant
-        self._knobs = make_knob_rows(n_slots)
+        # the allow mask (constrained decoding — serving/constrain.py)
+        # always rides: an all-True row is the sampler identity, and
+        # carrying it unconditionally keeps the knob dict's structure
+        # one shape for plain / constrained / sharded engines alike
+        self._knobs = make_knob_rows(n_slots, vocab=self._vocab)
+        # live constraint cursors by slot (host-side; rebuilt from
+        # (request.constraint, request.output) at every (re)admission —
+        # never checkpointed, the replay rule constrain.py states)
+        self._constraints: Dict[int, object] = {}
         self._ban_base = np.zeros((n_slots,), bool)
         self._configured: set = set()
         # slots whose occupant arrived as a FULL row_state payload
@@ -408,7 +434,8 @@ class ServingEngine:
             # reshard into the sharded pool through the scatter
             self._batch_prefill_fn = get_batch_prefill_step(
                 model, compute_dtype, mesh=self.mesh if tp else None,
-                carry_sampling=tp, kv_quant=kv_quant)
+                carry_sampling=tp, kv_quant=kv_quant,
+                adapter=self._adapter_spec)
             # True -> default cache, False/None -> off, else an instance
             self.prefix_cache = (PrefixCache() if prefix_cache is True
                                  else (prefix_cache or None))
@@ -449,7 +476,8 @@ class ServingEngine:
     def submit(self, prompt_ids: Sequence[int], max_new_tokens: int = 32,
                eos_id: int = -1, sampling: Optional[SamplingParams] = None,
                draft_tokens: Optional[int] = None, priority: int = 0,
-               deadline_s: Optional[float] = None, degrade=None) -> int:
+               deadline_s: Optional[float] = None, degrade=None,
+               adapter_id: int = 0, constraint=None) -> int:
         """Queue one generation request (1-based prompt ids, like
         ``generate()``); returns its request id. Raises if the request
         could ever overflow the cache (same ``max_len`` guard as
@@ -483,13 +511,53 @@ class ServingEngine:
         queued: it lands in the finished ledger with
         ``finish_reason="shed"`` and empty output — still returns the
         request id, so callers observe backpressure per request rather
-        than as an exception."""
+        than as an exception.
+
+        Multi-tenant knobs: ``adapter_id`` selects the request's LoRA
+        adapter in the engine's :class:`~bigdl_tpu.serving.lora.
+        AdapterBank` (0 = the null adapter ≡ base model; nonzero ids
+        must be live in the bank, and the engine RETAINS the slot for
+        the request's lifetime so a tenant unload cannot recycle
+        factors under an in-flight row). On a SPECULATIVE engine a
+        nonzero ``adapter_id`` requires ``draft_tokens=0``: the draft
+        model carries no adapter factors, and scoring base-model drafts
+        against an adapted target would silently corrupt accept-rate
+        accounting — pinned by tests/test_serving_lora.py.
+        ``constraint`` is an optional
+        :class:`~bigdl_tpu.serving.constrain.TokenDFA`: the engine
+        advances its cursor per emitted token and masks the row's
+        sampler to the tokens the automaton allows (the per-row
+        ``allow`` knob); constrained rows on a speculative engine run
+        with draft budget 0 (the mask is per-position — a multi-token
+        super-step would verify against a stale mask)."""
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("need a non-empty prompt")
         if draft_tokens is not None and int(draft_tokens) < 0:
             raise ValueError(
                 f"draft_tokens must be >= 0 or None, got {draft_tokens}")
+        adapter_id = int(adapter_id)
+        if adapter_id:
+            if self.adapters is None:
+                raise ValueError(
+                    f"adapter_id={adapter_id} but this engine has no "
+                    "AdapterBank (pass adapters= at construction)")
+            if not self.adapters.is_live(adapter_id):
+                raise ValueError(
+                    f"adapter id {adapter_id} is not allocated in the "
+                    "bank (alloc() it first, or use 0 = base model)")
+            if self._spec is not None and (draft_tokens is None
+                                           or int(draft_tokens) > 0):
+                raise ValueError(
+                    "adapted requests on a speculative engine must "
+                    "submit draft_tokens=0 — drafts are pinned to the "
+                    "null adapter, and a base-model draft chain under "
+                    "an adapted target would corrupt accept-rate "
+                    "accounting")
+        if constraint is not None and not hasattr(constraint, "cursor"):
+            raise ValueError(
+                "constraint must be a TokenDFA-like object with a "
+                ".cursor(prefix) method (serving/constrain.py)")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(
                 f"deadline_s must be positive or None, got {deadline_s}")
@@ -519,7 +587,12 @@ class ServingEngine:
             priority=int(priority),
             deadline_s=None if deadline_s is None else float(deadline_s),
             degrade=degrade,
+            adapter_id=adapter_id, constraint=constraint,
             submit_time=self._clock())
+        # hold the adapter slot for the request's lifetime (released at
+        # every terminal disposition: finish, shed, cancel)
+        if adapter_id:
+            self.adapters.retain(adapter_id)
         self.metrics.on_submit()
         # admission backpressure: a bounded queue sheds at the door —
         # the cheapest place to reject work is before any of it runs.
@@ -575,6 +648,7 @@ class ServingEngine:
             self.pool.free(slot)
             self._configured.discard(slot)
             self._restored.discard(slot)
+            self._constraints.pop(slot, None)
             if self.admitter is not None:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
         # WAITING cancellations drop their stashed payload too: a
@@ -582,6 +656,7 @@ class ServingEngine:
         # not pin its KV slices in the finished ledger forever (the
         # same teardown contract _shed follows)
         req.resume_carry = None
+        self._release_adapter(req)
         self.metrics.on_cancel()
         # cancellation is a disposition too: without this bucket the
         # finish_<reason> counters would not sum to every request's
@@ -724,6 +799,16 @@ class ServingEngine:
 
     # -- resilience: shedding, degradation, preemption, recovery -----------
 
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the adapter-slot reference :meth:`submit` took — called
+        from every terminal disposition exactly once (finish ledger,
+        shed, cancel), so a freed tenant's slot recycles only after its
+        last in-flight request is gone."""
+        if (req.adapter_id and self.adapters is not None
+                and not getattr(req, "_adapter_released", False)):
+            req._adapter_released = True   # terminal paths run once
+            self.adapters.free(req.adapter_id)
+
     def _shed(self, req: Request, reason: str) -> None:
         """Load-shed a request WITHOUT running it (queue-full submit,
         waiting-deadline expiry, or a feasibility drop): ledgered with
@@ -731,6 +816,7 @@ class ServingEngine:
         backpressure, never an exception. Deadline expiry and
         feasibility drops both count as deadline misses (either way
         the SLO was not going to be met)."""
+        self._release_adapter(req)
         req.state = SHED
         req.finish_reason = reason
         # a PREEMPTED request re-entering the queue carries its stashed
@@ -802,12 +888,17 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 fed0 = [t - 1 for t in victim.prompt] + \
                        [t - 1 for t in victim.output]
-                self.prefix_cache.insert(fed0[:-1], payload["carry"])
+                # namespaced by the victim's adapter: its K/V was
+                # computed under those factors and must never serve a
+                # prefix hit for another tenant
+                self.prefix_cache.insert(fed0[:-1], payload["carry"],
+                                         adapter_id=victim.adapter_id)
         victim.preemptions += 1
         self.scheduler.requeue(victim)            # running -> waiting
         self.pool.free(slot)
         self._configured.discard(slot)
         self._restored.discard(slot)
+        self._constraints.pop(slot, None)
         self.metrics.on_preempt()
 
     def _recover_rows(self, rows, now: float) -> None:
@@ -820,6 +911,7 @@ class ServingEngine:
         for slot, req in rows:
             self._configured.discard(slot)
             self._restored.discard(slot)
+            self._constraints.pop(slot, None)
             if self.admitter is not None:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
             req.retries += 1
@@ -920,6 +1012,20 @@ class ServingEngine:
         if self._ban_base[slot] and req.output:
             # resumed mid-stream: the ban may already have lifted
             self._knobs["ban"][slot] = len(req.output) < sp.min_tokens
+        # the slot's adapter id (runtime data of the compiled steps;
+        # already set for restored rows — the payload carried it — but
+        # rewriting the same value is harmless and covers every path)
+        self.pool.adapter_ids[slot] = req.adapter_id
+        # constraint cursor: rebuilt from (constraint, emitted prefix)
+        # — THE replay rule; a recycled slot's stale mask is always
+        # overwritten (all-True for unconstrained occupants)
+        self._constraints.pop(slot, None)
+        if req.constraint is not None:
+            cur = req.constraint.cursor(req.output)
+            self._constraints[slot] = cur
+            cur.mask_row(self._vocab, out=self._knobs["allow"][slot])
+        else:
+            self._knobs["allow"][slot][:] = True
         self._knobs_device = None                # re-upload next step
         if slot in self._restored:
             self._restored.discard(slot)
@@ -962,6 +1068,7 @@ class ServingEngine:
         self.pool.free(freed)
         self._configured.discard(freed)
         self._restored.discard(freed)
+        self._constraints.pop(freed, None)
         self._ledger_finish(req, reason, now)
 
     def _ledger_finish(self, req: Request, reason: str,
@@ -973,6 +1080,7 @@ class ServingEngine:
         terminations (the disaggregated plane's transfer-retry
         error-out), so a new finish-time counter can never cover one
         path and miss the other."""
+        self._release_adapter(req)
         req.finish_reason = reason
         req.resume_carry = None
         req.state = FINISHED
@@ -1001,6 +1109,64 @@ class ServingEngine:
             if ban != self._knobs["ban"][slot]:
                 self._knobs["ban"][slot] = ban
                 self._knobs_device = None
+
+    def _advance_constraint(self, slot: int, req: Request) -> None:
+        """Advance a constrained row's automaton over the token JUST
+        emitted and rewrite its allow-mask row — a runtime VALUE
+        change, never a recompile (the constrained twin of
+        :meth:`_maybe_flip_ban`; no-op for unconstrained rows)."""
+        cur = self._constraints.get(slot)
+        if cur is None:
+            return
+        cur.advance(req.output[-1])
+        cur.mask_row(self._vocab, out=self._knobs["allow"][slot])
+        self._knobs_device = None
+
+    def _bank_device_arrays(self):
+        """The adapter bank as placed device arrays, cached against the
+        bank's version counter (tenant alloc/free re-uploads; the
+        steady-state decode loop reuses). Tensor-parallel planes pin
+        the Megatron bank sharding (``adapter_bank_specs``)."""
+        if (self._bank_device is None
+                or self._bank_version != self.adapters.version):
+            import jax
+
+            bank = self.adapters.device_arrays()
+            if self._plane is not None and self._plane.tensor_parallel:
+                from bigdl_tpu.models.transformer import adapter_bank_specs
+                from bigdl_tpu.serving.sharded import named_sharding
+
+                specs = adapter_bank_specs(self.model)
+                bank = jax.device_put(
+                    bank, {k: named_sharding(self.mesh, specs[k])
+                           for k in bank})
+            self._bank_device = bank
+            self._bank_version = self.adapters.version
+        return self._bank_device
+
+    def _adapter_args(self):
+        """The decode/verify dispatch's trailing adapter arguments:
+        ``()`` without a bank, else ``(per-slot adapter ids, bank)`` —
+        the ids re-upload each step like the token/active rows (tiny),
+        the bank rides the version-keyed cache."""
+        if self.adapters is None:
+            return ()
+        import jax.numpy as jnp
+
+        ids = self._place_rows(jnp.asarray(self.pool.adapter_ids))
+        return (ids, self._bank_device_arrays())
+
+    def _prefill_adapter_args(self, row_adapter_ids):
+        """The batched-prefill dispatch's trailing adapter arguments
+        for one bucket: ``()`` without a bank, else ``(per-ROW ids,
+        bank)`` — prefill rows are bucket rows, not pool slots, so the
+        admission paths pass the bucket's own id list."""
+        if self.adapters is None:
+            return ()
+        import jax.numpy as jnp
+
+        return (jnp.asarray(np.asarray(row_adapter_ids, np.int32)),
+                self._bank_device_arrays())
 
     def _note_host_step(self, t_begin: float, device_before: float) -> None:
         """Record the per-super-step HOST share: the step's wall time
@@ -1105,7 +1271,7 @@ class ServingEngine:
                 "decode", self._step_fn,
                 self.params, self._place_rows(jnp.asarray(tokens)),
                 self._place_rows(jnp.asarray(active)),
-                self.pool.carry, knobs)
+                self.pool.carry, knobs, *self._adapter_args())
         except FaultError:
             # the dispatch failed BEFORE running: the pooled carry was
             # never donated and stays valid — evict + replay the rows
@@ -1162,6 +1328,7 @@ class ServingEngine:
             else:
                 req.next_token = tok0
                 self._maybe_flip_ban(slot, req)
+                self._advance_constraint(slot, req)
         return emitted
 
     def drain(self) -> Dict[int, np.ndarray]:

@@ -142,6 +142,14 @@ class KVPool:
         # mid-prefill and stall its activation forever).
         self.chunk_done = np.zeros((self.n_slots,), np.int64)
         self.chunk_target = np.zeros((self.n_slots,), np.int64)
+        # per-slot ADAPTER id (multi-tenant LoRA — serving/lora.py):
+        # a host-side int mirror the engine feeds to the compiled steps
+        # as per-row runtime data. 0 = the null adapter (base model).
+        # Host ints like the chunk mirrors, and reset with the slot in
+        # free() under the same recycled-slot contract — a leaked id
+        # would serve the next occupant through the wrong tenant's
+        # factors.
+        self.adapter_ids = np.zeros((self.n_slots,), np.int32)
         # optional DRAFT carry (speculative decoding): a second,
         # slot-aligned pooled carry for the draft model — see
         # attach_draft()
@@ -228,6 +236,7 @@ class KVPool:
         # occupant look mid-prefill
         self.chunk_done[slot] = 0
         self.chunk_target[slot] = 0
+        self.adapter_ids[slot] = 0
         if self.draft_carry is not None:
             # the draft carry frees WITH its slot: same pos-reset rule
             # (stale draft K/V behind pos are masked, like the target's)
@@ -341,7 +350,8 @@ class KVPool:
         minus the request metadata): the B=1 target-carry slice from
         :meth:`read_row` (K/V layers, int8 dequant scales, ``pos``, and
         — on sampling carries — the RNG lane, penalty counts, and
-        prompt mask), the ``chunk_done``/``chunk_target`` host mirrors,
+        prompt mask), the ``chunk_done``/``chunk_target``/``adapter``
+        host mirrors,
         and the attached DRAFT carry's B=1 slice (``None`` without
         one). This is THE row-serialization API: the engine's
         preemption stash and the disaggregated prefill→decode handoff
@@ -353,6 +363,7 @@ class KVPool:
         payload = {"carry": self.read_row(slot),
                    "chunk_done": int(self.chunk_done[slot]),
                    "chunk_target": int(self.chunk_target[slot]),
+                   "adapter": int(self.adapter_ids[slot]),
                    "draft": None}
         if self.draft_carry is not None:
             payload["draft"] = self._fresh_rows(self.draft_carry, slot)
@@ -393,6 +404,9 @@ class KVPool:
         # a completed prefill hands off done == pos, target == 0 or pos
         self.chunk_done[slot] = int(payload["chunk_done"])
         self.chunk_target[slot] = int(payload["chunk_target"])
+        # adapter id rides the payload (absent in pre-adapter payloads
+        # → null adapter, today's behavior)
+        self.adapter_ids[slot] = int(payload.get("adapter", 0))
         draft = payload.get("draft")
         if draft is not None and self.draft_carry is not None:
             self.draft_carry = self._draft_scatter(

@@ -1,0 +1,200 @@
+"""Pooled per-row LoRA adapter bank for multi-tenant serving.
+
+A production pool multiplexes many tenant fine-tunes over one set of
+base weights. Swapping weight tensors per request would retrace the
+compiled step (and serialize the pool on weight uploads); dispatching a
+separate program per tenant would shatter the engine's one-program
+discipline. This module keeps BOTH invariants: the low-rank factors of
+every live adapter sit side by side in a pooled BANK, and each pool row
+carries only an integer ``adapter_id`` as runtime data — the compiled
+decode/prefill/verify steps gather the row's ``(A, B)`` factor pair from
+the bank by id *inside* the program
+(:func:`bigdl_tpu.models.transformer._adapter_delta`), so mixed
+base/tenant traffic is ONE compiled program and admitting, evicting, or
+swapping tenants never recompiles.
+
+Layout (the contract with ``transformer.ADAPTER_SITES``): for each
+transformer block ``i`` and each adapted projection ``site`` in
+``(wq, wk, wv, wo, fc1, fc2)`` the bank holds
+
+* ``f"{site}{i}_a"`` — ``(n_slots, r, in_dim)`` fp32, and
+* ``f"{site}{i}_b"`` — ``(n_slots, out_dim, r)`` fp32,
+
+and a row's delta for that projection is
+``scale * (h @ A[id].T) @ B[id].T`` with ``scale = alpha / r``. Slot 0
+is the permanently all-zeros NULL adapter: base-model rows gather exact
+zeros, and adding 0.0 is the fp identity (up to ``-0.0 → +0.0``), which
+is what makes null-adapter streams token-identical to an adapter-free
+engine — pinned by tests/test_serving_lora.py.
+
+Slot lifecycle mirrors the KV pool's: :meth:`AdapterBank.alloc` writes a
+tenant's factors into a free slot and returns its id with refcount 1;
+:meth:`retain` / :meth:`free` move the refcount, and when it reaches
+zero the slot's rows are ZEROED (like the int8 KV scales on row free —
+a recycled slot must not leak the previous tenant's factors through the
+null-adapter identity) and the slot returns to the free list. The
+``version`` counter bumps on every mutation so the engine can cache the
+bank's device placement and invalidate it only when the host arrays
+actually changed.
+
+Sharding: under tensor parallelism the bank shards exactly like the
+weights it adapts (``transformer.adapter_bank_specs``) — B out-sharded
+for column-parallel sites, A in-sharded for row-parallel sites, the
+slot axis always replicated. The fp32 partial delta of a row-parallel
+site folds into the block's one closing psum
+(``row_parallel_linear(partial_add=...)``), so the
+two-collectives-per-block budget survives adapters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class AdapterSpec:
+    """The hashable shape-and-scale summary of an :class:`AdapterBank`
+    — what the step factories key their compile caches on (two engines
+    over banks with equal specs share compiled steps; the factor VALUES
+    are runtime data and never enter the key)."""
+
+    rank: int
+    n_slots: int
+    alpha: float
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+class AdapterBank:
+    """Pooled low-rank adapter factors, alloc/free'd like KV slots
+    (module docstring). ``alpha`` defaults to ``rank`` (scale 1.0)."""
+
+    def __init__(self, model, rank: int, n_slots: int = 8,
+                 alpha: Optional[float] = None) -> None:
+        import numpy as np
+
+        from bigdl_tpu.models.transformer import adapter_site_shapes
+
+        if rank <= 0:
+            raise ValueError(f"rank must be positive, got {rank}")
+        if n_slots < 2:
+            raise ValueError(
+                f"n_slots must be >= 2 (slot 0 is the reserved null "
+                f"adapter), got {n_slots}")
+        self.rank = int(rank)
+        self.n_slots = int(n_slots)
+        self.alpha = float(rank if alpha is None else alpha)
+        self.site_shapes: List[Dict[str, tuple]] = adapter_site_shapes(model)
+        self.arrays: Dict[str, "np.ndarray"] = {}
+        for i, layer in enumerate(self.site_shapes):
+            for site, (out_dim, in_dim) in layer.items():
+                self.arrays[f"{site}{i}_a"] = np.zeros(
+                    (self.n_slots, self.rank, in_dim), np.float32)
+                self.arrays[f"{site}{i}_b"] = np.zeros(
+                    (self.n_slots, out_dim, self.rank), np.float32)
+        # slot 0 = null adapter: never allocated, never freed, refs pinned
+        self._free: List[int] = list(range(self.n_slots - 1, 0, -1))
+        self._refs: Dict[int, int] = {}
+        self.version = 0
+
+    @property
+    def spec(self) -> AdapterSpec:
+        return AdapterSpec(self.rank, self.n_slots, self.alpha)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def live(self) -> Dict[int, int]:
+        """``{adapter_id: refcount}`` of allocated slots (copy)."""
+        return dict(self._refs)
+
+    def is_live(self, adapter_id: int) -> bool:
+        """True for ids a row may carry: the null adapter or an
+        allocated slot."""
+        return adapter_id == 0 or adapter_id in self._refs
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def alloc(self, factors: Dict[str, "np.ndarray"]) -> int:
+        """Write a tenant's factors into a free slot; returns its
+        ``adapter_id`` with refcount 1. ``factors`` maps bank keys
+        (``f"{site}{layer}_a"`` / ``_b``) to ``(r, in)`` / ``(out, r)``
+        arrays; keys absent from ``factors`` stay zero (an adapter may
+        touch only some projections). Unknown keys and shape mismatches
+        raise — a silently ignored factor would serve the wrong model."""
+        import numpy as np
+
+        unknown = set(factors) - set(self.arrays)
+        if unknown:
+            raise KeyError(
+                f"unknown adapter factor keys {sorted(unknown)} (bank "
+                f"keys are site+layer pairs like 'wq0_a')")
+        for key, val in factors.items():
+            want = self.arrays[key].shape[1:]
+            if tuple(np.shape(val)) != want:
+                raise ValueError(
+                    f"adapter factor {key!r} has shape "
+                    f"{tuple(np.shape(val))}, bank expects {want}")
+        if not self._free:
+            raise RuntimeError(
+                f"adapter bank full: all {self.n_slots - 1} tenant "
+                f"slots are allocated")
+        slot = self._free.pop()
+        for key, val in factors.items():
+            self.arrays[key][slot] = np.asarray(val, np.float32)
+        self._refs[slot] = 1
+        self.version += 1
+        return slot
+
+    def retain(self, adapter_id: int) -> None:
+        """Bump an allocated slot's refcount (id 0 is a no-op — the
+        null adapter is never refcounted)."""
+        if adapter_id == 0:
+            return
+        if adapter_id not in self._refs:
+            raise KeyError(f"adapter id {adapter_id} is not allocated")
+        self._refs[adapter_id] += 1
+
+    def free(self, adapter_id: int) -> None:
+        """Drop one reference; at zero the slot's factor rows are ZEROED
+        and the slot returns to the free list (recycled slots must read
+        as the null adapter until re-allocated). Freeing id 0 raises."""
+        if adapter_id == 0:
+            raise ValueError("adapter id 0 is the reserved null adapter")
+        if adapter_id not in self._refs:
+            raise KeyError(f"adapter id {adapter_id} is not allocated")
+        self._refs[adapter_id] -= 1
+        if self._refs[adapter_id] > 0:
+            return
+        del self._refs[adapter_id]
+        for arr in self.arrays.values():
+            arr[adapter_id] = 0.0
+        self._free.append(adapter_id)
+        self.version += 1
+
+    # -- device view -------------------------------------------------------
+
+    def device_arrays(self) -> Dict[str, object]:
+        """The bank as jnp arrays — what the engine feeds the compiled
+        steps (cached against :attr:`version`; sharded engines place it
+        with ``transformer.adapter_bank_specs``)."""
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(v) for k, v in self.arrays.items()}
+
+    # -- test / bench helper -----------------------------------------------
+
+    def random_factors(self, seed: int, amp: float = 0.05):
+        """Deterministic random factors for every bank key (tests and
+        the multitenant bench) — ``N(0, amp)`` for A, ``N(0, amp)`` for
+        B, so the delta is small but nonzero at every site."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        return {k: rng.normal(0.0, amp, v.shape[1:]).astype(np.float32)
+                for k, v in self.arrays.items()}

@@ -73,6 +73,15 @@ class PrefixCache:
                 f"max_entries must be positive, got {max_entries}")
         self.max_entries = int(max_entries)
         self.root = _Node((), None, 0)
+        # the tree is NAMESPACED by adapter id (multi-tenant LoRA —
+        # serving/lora.py): K/V prefilled under one tenant's factors is
+        # only reusable under the SAME factors, so each adapter id gets
+        # its own radix root and lookups never cross tenants. Id 0 (the
+        # null adapter) is `self.root` — base-model traffic keeps
+        # today's shared namespace, hit rate, and entry layout.
+        # Capacity, LRU, and leases stay GLOBAL across namespaces: one
+        # budget of cached carries, whoever owns them.
+        self._roots: Dict[int, _Node] = {0: self.root}
         self._carry_nodes: set = set()
         self._clock = 0
         self.lookups = 0
@@ -103,7 +112,7 @@ class PrefixCache:
             stack.extend(n.children.values())
         return None
 
-    def _walk(self, tokens: Tuple[int, ...]):
+    def _walk(self, tokens: Tuple[int, ...], root: _Node):
         """Longest usable cached prefix of ``tokens``: ``(node,
         matched_len)``, where ``matched_len <= node.n_tokens`` — a
         strict inequality means a TRUNCATED hit: the donor carry covers
@@ -112,7 +121,7 @@ class PrefixCache:
         ``pos`` clamped to ``matched_len`` ARE the prefix's prefill
         state (zero-copy — the stale tail is overwritten/masked by the
         suffix prefill and decode exactly like recycled pool rows)."""
-        node, i, best, best_len = self.root, 0, None, 0
+        node, i, best, best_len = root, 0, None, 0
         while i < len(tokens):
             child = node.children.get(tokens[i])
             if child is None:
@@ -143,15 +152,21 @@ class PrefixCache:
 
     # -- lease surface -----------------------------------------------------
 
-    def acquire(self, tokens: Sequence[int]):
+    def acquire(self, tokens: Sequence[int], adapter_id: int = 0):
         """Longest-cached-prefix lookup with a lease: returns ``(carry,
         matched_len, lease)``; the lease pins the entry against eviction
         until :meth:`release`. Miss → ``(None, 0, None)``. The carry may
         be a truncated view of a longer cached prefill (see
-        :meth:`_walk`) — callers treat it exactly like an exact hit."""
+        :meth:`_walk`) — callers treat it exactly like an exact hit.
+        ``adapter_id`` selects the tenant namespace (0 = null adapter =
+        today's shared tree); a lookup only ever sees entries inserted
+        under the same id."""
         self.lookups += 1
         tokens = tuple(int(t) for t in tokens)
-        best, matched = self._walk(tokens)
+        root = self._roots.get(int(adapter_id))
+        if root is None:
+            return None, 0, None
+        best, matched = self._walk(tokens, root)
         if best is None:
             return None, 0, None
         best.refs += 1
@@ -177,13 +192,19 @@ class PrefixCache:
 
     # -- insertion / eviction ----------------------------------------------
 
-    def insert(self, tokens: Sequence[int], carry) -> None:
+    def insert(self, tokens: Sequence[int], carry,
+               adapter_id: int = 0) -> None:
         """Store ``carry`` as the prefill state for exactly ``tokens``
-        (0-based ids, non-empty), splitting edges as needed."""
+        (0-based ids, non-empty), splitting edges as needed, under the
+        ``adapter_id`` namespace (0 = null adapter)."""
         tokens = tuple(int(t) for t in tokens)
         if not tokens:
             raise ValueError("cannot cache an empty prefix")
-        node, i = self.root, 0
+        adapter_id = int(adapter_id)
+        root = self._roots.get(adapter_id)
+        if root is None:
+            root = self._roots[adapter_id] = _Node((), None, 0)
+        node, i = root, 0
         while i < len(tokens):
             child = node.children.get(tokens[i])
             if child is None:

@@ -100,6 +100,12 @@ class Request:
     # per super-step (clamped to the engine's k; ignored by
     # non-speculative engines — it is a budget, not a semantic)
     draft_tokens: Optional[int] = None
+    # multi-tenant fields (serving/lora.py, serving/constrain.py):
+    # adapter_id 0 = the null adapter (base model); constraint is an
+    # optional TokenDFA — the engine rebuilds its cursor from (this,
+    # output) at every (re)admission, never checkpointing cursor state
+    adapter_id: int = 0
+    constraint: Optional[object] = None
     logprobs: List[float] = field(default_factory=list)
     finish_reason: Optional[str] = None
     submit_time: float = 0.0

@@ -152,9 +152,14 @@ class Speculator:
         # speculative engine's decode step (a length-1 row IS plain
         # decode); its init_carry is the decode carry, so the pool is
         # layout-identical to a non-speculative engine's
+        # the target scores each row under that row's ADAPTER (the
+        # engine threads per-slot ids + the bank into the dispatch);
+        # drafts are pinned to the null adapter — submit() rejects
+        # adapted requests unless draft_tokens=0 — so the draft plane
+        # below stays adapter-free by construction
         self.verify_fn, self.pool_init = get_batch_verify_step(
             engine.model, dtype, width=self.width, mesh=mesh,
-            kv_quant=kv_quant)
+            kv_quant=kv_quant, adapter=engine._adapter_spec)
         # draft plane: weights REPLICATED (a model small enough to
         # draft with is small enough to replicate — on data-sharded
         # meshes XLA partitions the per-row step over the carry's slot
@@ -225,10 +230,15 @@ class Speculator:
         not overshoot ``max_new_tokens`` — that would desync the RNG
         lane from the baseline stream), and forced to 0 while the row's
         min-tokens ban is up (the ban is per-STEP host state; a chunk
-        must not cross its flip)."""
+        must not cross its flip). Constrained rows
+        (``serving/constrain.py``) are likewise forced to 0: the allow
+        mask is a function of the emitted PREFIX, so every chunk
+        position after the first would verify against a stale mask."""
         k = self.k if req.draft_tokens is None \
             else min(int(req.draft_tokens), self.k)
         if self.engine._knobs["ban"][slot]:
+            k = 0
+        if slot in self.engine._constraints:
             k = 0
         rem = req.max_new_tokens - len(req.output)
         return max(0, min(k, rem - 1))
@@ -350,7 +360,7 @@ class Speculator:
             vt, vlp, n_emit, carry = eng._dispatch(
                 "verify", self.verify_fn,
                 eng.params, vtoks, eng._place_rows(jnp.asarray(lengths)),
-                eng.pool.carry, knobs)
+                eng.pool.carry, knobs, *eng._adapter_args())
         except FaultError:
             eng.pool.draft_carry = dcarry     # target carry never donated
             eng._recover_step(running, "fail")
@@ -417,6 +427,7 @@ class Speculator:
             else:
                 req.next_token = int(nxt[slot, m - 1])
                 eng._maybe_flip_ban(slot, req)
+                eng._advance_constraint(slot, req)
         # accounted AFTER truncation: accepted = landed minus the one
         # non-draft draw per row, so accept_rate/tokens_per_step report
         # what the engine actually emitted, not what the verify step

@@ -1,0 +1,27 @@
+"""SRV202 (payload half, adapter field): the multi-tenant PR extended
+``serving/disagg.py``'s ``ROW_PAYLOAD_KEYS`` with ``adapter`` — the
+LoRA adapter slot id a restored row gathers its tenant's factors with.
+A typo'd spelling silently drops the id on the wire and the receiving
+pool restores the row under the NULL adapter: base-model logits for a
+tenant's request, diverging only for adapted traffic.  The canonical
+``adapter`` reads are the false-positive guards."""
+
+from bigdl_tpu.serving.disagg import unpack_payload
+
+
+def restore_tenant_row(blob, pool, slot):
+    meta, payload = unpack_payload(blob)
+    aid = payload["adapter"]                      # schema — fine
+    fallback = payload.get("adapter", 0)          # schema — fine
+    carry = payload["carry"]                      # schema — fine
+    stale = payload["adpater"]                    # EXPECT: SRV202
+    payload["adapter_slot"] = aid                 # EXPECT: SRV202
+    other = payload.get("adapterid")              # EXPECT: SRV202
+    return meta, aid, fallback, carry, stale, other
+
+
+def repack_tenant(payload, aid):
+    payload["adapter"] = int(aid)                 # schema — fine
+    if "adaptor" in payload:                      # EXPECT: SRV202
+        del payload["adaptor"]                    # EXPECT: SRV202
+    return payload

@@ -1,0 +1,261 @@
+"""Per-row token-mask constrained decoding (bigdl_tpu/serving/
+constrain.py): TokenDFA/cursor semantics and validation, the wire meta
+round trip, forced-template output, the permissive-mask identity
+contract, fixed-seed replay through evict/readmit, zero extra compiles
+for mixed constrained/unconstrained traffic, and parity on the
+speculative and disaggregated planes."""
+
+import numpy as np
+import pytest
+
+
+def _make_lm(V=29, hidden=32, heads=4, layers=2, max_len=48, seed=9):
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(seed)
+    lm = TransformerLM(V, hidden_size=hidden, n_heads=heads,
+                       n_layers=layers, max_len=max_len)
+    lm._ensure_params()
+    lm.evaluate()
+    return lm
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _make_lm()
+
+
+# -- automaton unit surface -------------------------------------------------
+
+def test_dfa_validation():
+    from bigdl_tpu.serving import TokenDFA, fixed_sequence, from_token_sets
+
+    with pytest.raises(ValueError, match="at least one state"):
+        TokenDFA([])
+    with pytest.raises(ValueError, match="1-based"):
+        TokenDFA([(frozenset({0}), {}, None)])
+    with pytest.raises(ValueError, match="leaves the DFA"):
+        TokenDFA([(None, {3: 7}, None)])
+    with pytest.raises(ValueError, match="allow-set"):
+        TokenDFA([(frozenset({2}), {3: 0}, None)])
+    with pytest.raises(ValueError, match="out of range"):
+        TokenDFA([(None, {}, 5)])
+    with pytest.raises(ValueError, match="start"):
+        TokenDFA([(None, {}, None)], start=2)
+    with pytest.raises(ValueError):
+        fixed_sequence([])
+    with pytest.raises(ValueError):
+        fixed_sequence([0, 3])
+    with pytest.raises(ValueError):
+        from_token_sets([])
+
+
+def test_cursor_advance_and_mask():
+    from bigdl_tpu.serving import (
+        ConstraintError, fixed_sequence, from_token_sets)
+
+    dfa = fixed_sequence([4, 9])
+    cur = dfa.cursor()
+    assert cur.allow == frozenset({4})
+    row = cur.mask_row(6)
+    assert row.tolist() == [False, False, False, True, False, False]
+    cur.advance(4)
+    assert cur.allow == frozenset({9})
+    with pytest.raises(ConstraintError):
+        cur.advance(5)                       # not allowed here
+    cur.advance(9)
+    assert cur.allow is None                 # exhausted: unconstrained
+    assert cur.mask_row(6).all()
+    # the replay rule: cursor(prefix) == advance token-by-token
+    assert dfa.cursor([4, 9]).state == cur.state
+    # in-place write into an engine knob row
+    out = np.zeros((6,), bool)
+    assert from_token_sets([[2, 5]]).cursor().mask_row(6, out=out) is out
+    assert out.tolist() == [False, True, False, False, True, False]
+    # ids beyond the vocab are simply absent from the mask
+    assert fixed_sequence([99]).cursor().mask_row(6).sum() == 0
+
+
+def test_dfa_meta_roundtrip():
+    import json
+
+    from bigdl_tpu.serving import TokenDFA, from_token_sets
+
+    dfa = from_token_sets([[3, 1], None, [7]])
+    meta = json.loads(json.dumps(dfa.to_meta()))     # real JSON round trip
+    back = TokenDFA.from_meta(meta)
+    assert back.states == dfa.states and back.start == dfa.start
+
+
+def test_submit_validates_constraint(lm):
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=2)
+    with pytest.raises(ValueError, match="constraint"):
+        eng.submit([3, 2], max_new_tokens=2, constraint=object())
+
+
+# -- engine contracts -------------------------------------------------------
+
+def test_fixed_sequence_forces_output(lm):
+    """The template constraint overrides whatever the model prefers —
+    greedy and sampled rows both emit exactly the forced ids, then
+    decode free."""
+    from bigdl_tpu.serving import SamplingParams, ServingEngine, \
+        fixed_sequence
+
+    eng = ServingEngine(lm, n_slots=2, seed=11)
+    forced = [4, 9, 2]
+    r0 = eng.submit([3, 7], max_new_tokens=5,
+                    constraint=fixed_sequence(forced))
+    r1 = eng.submit([3, 7], max_new_tokens=5,
+                    sampling=SamplingParams(temperature=0.9, top_k=10,
+                                            seed=42),
+                    constraint=fixed_sequence(forced))
+    outs = eng.drain()
+    assert list(outs[r0])[:3] == forced
+    assert list(outs[r1])[:3] == forced
+
+
+def test_permissive_mask_is_identity(lm):
+    """A constraint that allows the full vocabulary at every position
+    leaves greedy AND fixed-seed sampled streams token-identical to the
+    unconstrained engine — the mask path is exact, not approximate."""
+    from bigdl_tpu.serving import SamplingParams, ServingEngine, \
+        from_token_sets
+
+    V = 29
+    sp = SamplingParams(temperature=0.8, top_k=10, seed=77)
+    base = ServingEngine(lm, n_slots=2, seed=11)
+    b0 = base.submit([3, 7, 2], max_new_tokens=8)
+    b1 = base.submit([5, 1], max_new_tokens=8, sampling=sp)
+    want = base.drain()
+
+    eng = ServingEngine(lm, n_slots=2, seed=11)
+    full = from_token_sets([list(range(1, V + 1))] * 8)
+    c0 = eng.submit([3, 7, 2], max_new_tokens=8, constraint=full)
+    c1 = eng.submit([5, 1], max_new_tokens=8, sampling=sp,
+                    constraint=full)
+    got = eng.drain()
+    np.testing.assert_array_equal(want[b0], got[c0])
+    np.testing.assert_array_equal(want[b1], got[c1])
+    np.testing.assert_array_equal(base.logprobs(b1), eng.logprobs(c1))
+
+
+def test_mixed_traffic_zero_extra_compiles(lm):
+    """Unconstrained-only traffic, then mixed constrained traffic, on
+    one engine: zero new decode or prefill programs — the mask is a
+    runtime knob row."""
+    from tests.compile_guards import assert_compile_count, compile_count
+
+    from bigdl_tpu.serving import ServingEngine, fixed_sequence
+
+    eng = ServingEngine(lm, n_slots=2, seed=11)
+    eng.submit([3, 7, 2], max_new_tokens=4)
+    eng.submit([5, 1], max_new_tokens=4)
+    eng.drain()
+    decode0 = compile_count(eng._step_fn)
+    prefill0 = compile_count(eng._batch_prefill_fn)
+    assert decode0 == 1
+
+    eng.submit([3, 7, 2], max_new_tokens=4,
+               constraint=fixed_sequence([4, 9]))
+    eng.submit([5, 1], max_new_tokens=4)
+    eng.drain()
+    assert_compile_count(eng._step_fn, decode0, what="mixed decode")
+    assert_compile_count(eng._batch_prefill_fn, prefill0,
+                         what="mixed prefill")
+
+
+def test_constrained_replay_through_preemption(lm):
+    """A fixed-seed constrained stream evicted mid-template resumes
+    draw-for-draw: the cursor is rebuilt from the emitted prefix at
+    readmission (never checkpointed), so the mask at every step is
+    identical to the uncontended run."""
+    from bigdl_tpu.serving import SamplingParams, ServingEngine, \
+        from_token_sets
+
+    cons = from_token_sets([[4, 9, 2], None, [1, 2, 3], None, [7, 8]])
+    sp = SamplingParams(temperature=0.9, top_k=10, seed=31)
+
+    base = ServingEngine(lm, n_slots=2)
+    r0 = base.submit([3, 7, 2, 9, 4], max_new_tokens=10, sampling=sp,
+                     constraint=cons)
+    want = base.drain()[r0]
+
+    eng = ServingEngine(lm, n_slots=1, policy="priority")
+    r1 = eng.submit([3, 7, 2, 9, 4], max_new_tokens=10, sampling=sp,
+                    constraint=cons, priority=0)
+    for _ in range(3):
+        eng.step()
+    eng.submit([5, 5], max_new_tokens=2, priority=5)   # forces eviction
+    outs = eng.drain()
+    assert eng.request(r1).preemptions >= 1
+    np.testing.assert_array_equal(outs[r1], want)
+
+
+# -- composition: speculative + disagg --------------------------------------
+
+def test_constrained_rows_on_speculative_engine(lm):
+    """Constrained rows on a speculative engine emit one token per
+    super-step (their draft budget is forced to 0 — the mask is
+    per-position) and match the non-speculative engine token for
+    token; unconstrained rows keep drafting."""
+    from bigdl_tpu.serving import ServingEngine, SpeculativeConfig, \
+        fixed_sequence
+
+    draft = _make_lm(hidden=16, heads=2, layers=1, seed=21)
+    cons = fixed_sequence([4, 9, 2])
+
+    base = ServingEngine(lm, n_slots=2, seed=7)
+    b0 = base.submit([3, 7], max_new_tokens=6, constraint=cons)
+    b1 = base.submit([5, 1, 8], max_new_tokens=6)
+    want = base.drain()
+
+    se = ServingEngine(lm, n_slots=2, seed=7,
+                       speculative=SpeculativeConfig(draft, k=3))
+    s0 = se.submit([3, 7], max_new_tokens=6, constraint=cons)
+    s1 = se.submit([5, 1, 8], max_new_tokens=6)
+    got = se.drain()
+    np.testing.assert_array_equal(want[b0], got[s0])
+    np.testing.assert_array_equal(want[b1], got[s1])
+
+
+@pytest.mark.disagg
+def test_constraint_crosses_the_wire(lm):
+    """Constrained requests through the disaggregated plane — prefill
+    pool, KV handoff, decode pool, and a mid-stream pool kill — land
+    token-identical to the monolithic engine: the automaton rides the
+    wire as meta, the cursor is rebuilt from the emitted prefix."""
+    from bigdl_tpu.serving import (
+        DisaggregatedEngine, SamplingParams, ServingEngine,
+        from_token_sets)
+    from bigdl_tpu.serving.disagg import request_from_meta, request_meta
+    from bigdl_tpu.serving.scheduler import Request
+
+    cons = from_token_sets([[4, 9, 2], None, [1, 2, 3]])
+    # wire meta round trip preserves the automaton
+    req = Request(req_id=5, prompt=[3], max_new_tokens=4,
+                  constraint=cons)
+    back = request_from_meta(request_meta(req))
+    assert back.constraint.states == cons.states
+
+    sp = SamplingParams(temperature=0.8, top_k=10, seed=40)
+    mono = ServingEngine(lm, n_slots=4, seed=7)
+    m0 = mono.submit([3, 7, 2], max_new_tokens=8, sampling=sp,
+                     constraint=cons)
+    m1 = mono.submit([5, 1, 8], max_new_tokens=8)
+    want = mono.drain()
+
+    d = DisaggregatedEngine(lm, prefill_slots=2, decode_slots=2,
+                            decode_pools=2, seed=7)
+    d0 = d.submit([3, 7, 2], max_new_tokens=8, sampling=sp,
+                  constraint=cons)
+    d1 = d.submit([5, 1, 8], max_new_tokens=8)
+    for _ in range(3):
+        d.step()
+    d.kill_pool(0)
+    got = d.drain()
+    np.testing.assert_array_equal(want[m0], got[d0])
+    np.testing.assert_array_equal(want[m1], got[d1])

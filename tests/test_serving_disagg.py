@@ -163,7 +163,7 @@ def test_preemption_stash_rides_row_state():
     stash = next(e[1] for e in eng.scheduler._waiting
                  if e[1].req_id == r1).resume_carry
     assert stash is not None and set(stash) == {
-        "carry", "draft", "chunk_done", "chunk_target"}
+        "carry", "draft", "chunk_done", "chunk_target", "adapter"}
     outs = eng.drain()
     assert eng.request(r1).preemptions >= 1
     assert np.array_equal(outs[r1], want)
