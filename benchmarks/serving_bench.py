@@ -1518,12 +1518,139 @@ def run_multitenant(model: str = "tiny", variant: str = "fp32",
     }
 
 
+def make_tiered_trace(cfg, n_requests: int, gen_tokens: int,
+                      seed: int = 31):
+    """Two-wave priority traffic for ``--scenario tiered``: the first
+    wave (low priority) fills every slot and decodes until the second
+    wave (high priority) lands and preempts it — the preempted rows
+    are exactly the spill/fetch traffic under test. Half the rows
+    sample with fixed per-request seeds so byte-identity covers the
+    RNG-lane restore, not just greedy argmax."""
+    from bigdl_tpu.serving import SamplingParams
+
+    rng = np.random.RandomState(seed)
+    buckets = [5, 9, 17]
+    trace = []
+    for i in range(n_requests):
+        plen = buckets[i % len(buckets)]
+        prompt = rng.randint(1, cfg["vocab"] + 1, size=(plen,)).tolist()
+        sp = SamplingParams(temperature=0.8, top_k=20, seed=400 + i) \
+            if i % 2 else None
+        trace.append((prompt, gen_tokens, sp))
+    return trace
+
+
+def _run_tiered_engine(lm, dtype, trace, n_slots, tier,
+                       burst_after: int = 3):
+    """One two-wave pass: the first ``n_slots`` requests enter at
+    priority 0, decode ``burst_after`` steps, then the rest arrive at
+    priority 5 (higher number outranks) and evict them. Returns the
+    engine, submission-ordered outputs, and the timing/compile stats
+    every configuration is compared on."""
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=n_slots, compute_dtype=dtype,
+                        policy="priority", preemption=True, seed=5,
+                        tier=tier)
+    t0 = time.perf_counter()
+    rids = [eng.submit(p, max_new_tokens=n, sampling=sp, priority=0)
+            for p, n, sp in trace[:n_slots]]
+    for _ in range(burst_after):
+        eng.step()
+    rids += [eng.submit(p, max_new_tokens=n, sampling=sp, priority=5)
+             for p, n, sp in trace[n_slots:]]
+    outs = eng.drain()
+    wall = time.perf_counter() - t0
+    n_tokens = int(sum(len(v) for v in outs.values()))
+    return eng, [outs[r] for r in rids], {
+        "tokens_per_sec": round(n_tokens / wall, 1),
+        "wall_s": round(wall, 3), "tokens": n_tokens,
+        "decode_programs": eng._step_fn._cache_size(),
+        "prefill_programs": eng._batch_prefill_fn._jitted._cache_size(),
+    }
+
+
+def run_tiered(model: str = "tiny", variant: str = "fp32",
+               n_requests: int = 12, gen_tokens: int = 16,
+               n_slots: int = 4, host_budget_gb: float = 16.0) -> dict:
+    """Tiered KV (host-RAM spill) vs the legacy in-memory stash vs a
+    forced re-prefill baseline, on the same fixed "HBM budget" — a
+    deliberately small slot count that a high-priority burst overflows.
+
+    The contracts under test: (a) the tiered pass is BYTE-identical to
+    the stash pass (greedy + fixed-seed sampled rows through a
+    spill→fetch round trip); (b) evicted rows resume WITHOUT
+    re-prefill (``serving/resumed_without_prefill`` > 0 — the resume
+    shortcut, not a replay); (c) the tier adds ZERO compiled programs
+    (spill/fetch is host machinery around the one decode step). The
+    re-prefill baseline is the same engine with a starved tier budget
+    (every spill evicted before readmission → the PR 8 replay path):
+    still byte-identical, but every resume pays prefill again — the
+    reported wall-clock gap is what host DRAM buys. Also reports
+    spill/fetch p99 and the warm-prefix capacity ``host_budget_gb``
+    buys at the measured packed-row size (HBM capacity ends at
+    n_slots; tier capacity scales with DRAM)."""
+    from bigdl_tpu.serving import TieredKVStore
+
+    lm, dtype, cfg = build(model, variant)
+    trace = make_tiered_trace(cfg, n_requests, gen_tokens)
+
+    _run_tiered_engine(                      # warm the compile buckets
+        lm, dtype, [(p, 2, sp) for p, _, sp in trace], n_slots, None,
+        burst_after=1)
+    eng_s, outs_s, stash_stats = _run_tiered_engine(
+        lm, dtype, trace, n_slots, None)
+    eng_t, outs_t, tier_stats = _run_tiered_engine(
+        lm, dtype, trace, n_slots, TieredKVStore())
+    eng_r, outs_r, replay_stats = _run_tiered_engine(
+        lm, dtype, trace, n_slots, TieredKVStore(host_budget_bytes=1024))
+
+    tiered_identical = all(
+        np.array_equal(a, b) for a, b in zip(outs_s, outs_t))
+    replay_identical = all(
+        np.array_equal(a, b) for a, b in zip(outs_s, outs_r))
+    s_t = eng_t.metrics.summary()
+    assert tiered_identical, "tiered stream diverged from stash stream"
+    assert s_t.get("serving/resumed_without_prefill", 0) > 0, \
+        "no evicted row resumed from the tier without re-prefill"
+    per_row = s_t["serving/spill_bytes"] / s_t["serving/spills"]
+    fetch_pct = eng_t.metrics.fetch_percentiles()
+    return {
+        "metric": "serving_tiered_tokens_per_sec",
+        "model": model, "variant": variant, "requests": n_requests,
+        "gen_tokens": gen_tokens, "slots": n_slots,
+        "stash": stash_stats, "tiered": tier_stats,
+        "replay_baseline": replay_stats,
+        "tiered_identical": bool(tiered_identical),
+        "replay_identical": bool(replay_identical),
+        "extra_decode_compiles": (tier_stats["decode_programs"]
+                                  - stash_stats["decode_programs"]),
+        "extra_prefill_compiles": (tier_stats["prefill_programs"]
+                                   - stash_stats["prefill_programs"]),
+        "spills": s_t["serving/spills"],
+        "fetches": s_t["serving/fetches"],
+        "resumed_without_prefill": s_t["serving/resumed_without_prefill"],
+        "spill_bytes_per_row": round(per_row, 0),
+        "fetch_p50_ms": round(fetch_pct["p50"] * 1e3, 3),
+        "fetch_p99_ms": round(fetch_pct["p99"] * 1e3, 3),
+        # what DRAM buys: prefix entries a host budget holds at the
+        # measured packed-row size, vs the n_slots rows HBM holds
+        "host_budget_gb": host_budget_gb,
+        "warm_prefix_capacity": int(host_budget_gb * (1 << 30)
+                                    // max(per_row, 1.0)),
+        "resume_vs_reprefill_wall_pct": round(
+            100.0 * (replay_stats["wall_s"]
+                     / max(tier_stats["wall_s"], 1e-9) - 1.0), 1),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario", default="mixed",
                     choices=["mixed", "admission", "sampling", "sharded",
                              "kv_quant", "speculative", "slo", "chunked",
-                             "disagg", "failover", "multitenant"])
+                             "disagg", "failover", "multitenant",
+                             "tiered"])
     ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
     ap.add_argument("--variant", default="fp32", choices=["fp32", "bf16"])
     # requests/gen_tokens/slots default per scenario: mixed 12/48/12,
@@ -1559,7 +1686,18 @@ def main() -> None:
     ap.add_argument("--tenants", type=int, default=3,
                     help="multitenant: live LoRA adapters sharing the "
                          "pooled bank (plus the null adapter)")
+    ap.add_argument("--host_budget_gb", type=float, default=16.0,
+                    help="tiered: host DRAM budget the warm-prefix "
+                         "capacity figure is quoted against")
     args = ap.parse_args()
+    if args.scenario == "tiered":
+        print(json.dumps(run_tiered(
+            args.model, args.variant,
+            n_requests=args.requests or 12,
+            gen_tokens=args.gen_tokens or 16,
+            n_slots=args.slots or 4,
+            host_budget_gb=args.host_budget_gb)))
+        return
     if args.scenario == "multitenant":
         print(json.dumps(run_multitenant(
             args.model, args.variant,

@@ -10,7 +10,7 @@ SPMD103     recompile hazards in/around jitted programs
 SPMD104     donated buffer reused after the donating call
 SPMD105     Python control flow on traced values
 SPMD106     shard_map specs naming axes the mesh does not have
-SRV201-206  serving contracts (whole-program fact table)
+SRV201-207  serving contracts (whole-program fact table)
 ASY301-305  async readiness: host-sync hygiene on the HOT PATH, scoped
             by call-graph reachability from the serving super-step
             roots (core.hotpath_chains)
@@ -1632,6 +1632,166 @@ class StrandedRowRule(Rule):
                 if seg in self._KEEPERS:
                     return True
         return False
+
+
+# -- SRV207 — tier-codec bypass ---------------------------------------------
+
+@register
+class TierCodecBypassRule(Rule):
+    code = "SRV207"
+    name = "tier-codec-bypass"
+    summary = ("row state written to a block store outside the "
+               "row_state()/pack_payload codec, or device state read "
+               "from a slot already freed (spilled)")
+    hint = ("the host KV tier has exactly ONE wire format: a row "
+            "leaves HBM as `pack_payload(request_meta(req), "
+            "pool.row_state(slot))` bytes, and comes back through "
+            "`unpack_payload` + `restore_row` (docs/serving.md "
+            "\"Tiered KV\"). A raw row_state dict (or anything tainted "
+            "by one) written into a block store skips the length-"
+            "prefixed codec — the bytes are unreadable by every fetch "
+            "path and the byte-identity contract silently dies. And a "
+            "`pool.free(slot)` BEFORE `row_state(slot)` serializes a "
+            "recycled row: spill captures whatever request owns the "
+            "slot next. Pack first, free after — the order every "
+            "shipping site (preemption, handoff, drain) already "
+            "follows. Wrapper detection is one level deep: a helper "
+            "whose parameter flows into a store `.put()` counts as a "
+            "store write at its call sites")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _serving_scope(ctx):
+            return
+        wrappers = self._store_put_wrappers(ctx)
+        for fn in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef):
+            tainted, sanitized = self._taints(ctx, fn)
+            yield from self._raw_store_writes(ctx, fn, tainted,
+                                              sanitized, wrappers)
+            yield from self._freed_slot_reads(ctx, fn)
+
+    # -- taint bookkeeping (per function, flow-insensitive) ---------------
+
+    @staticmethod
+    def _params(fn: ast.AST) -> List[str]:
+        a = fn.args
+        return [p.arg for p in (getattr(a, "posonlyargs", []) + a.args
+                                + a.kwonlyargs)]
+
+    def _taints(self, ctx: FileContext,
+                fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+        """(tainted, sanitized) local names: tainted = carries a raw
+        row-state payload (a ``payload``-named parameter, a
+        ``row_state()`` result, or a copy of either); sanitized =
+        assigned from ``pack_payload()`` (the codec's output is the
+        ONLY sanctioned store content)."""
+        tainted = {p for p in self._params(fn)
+                   if p == "payload" or p.endswith("_payload")}
+        sanitized: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for sub in ast.walk(fn):
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)):
+                    continue
+                tgt, v = sub.targets[0].id, sub.value
+                if isinstance(v, ast.Call):
+                    seg = _last_seg(ctx.dotted(v.func))
+                    if seg == "row_state" and tgt not in tainted:
+                        tainted.add(tgt)
+                        changed = True
+                    elif seg == "pack_payload" and tgt not in sanitized:
+                        sanitized.add(tgt)
+                        changed = True
+                elif isinstance(v, ast.Name) and v.id in tainted \
+                        and tgt not in tainted:
+                    tainted.add(tgt)
+                    changed = True
+        return tainted, sanitized
+
+    # -- sink 1: un-coded writes into a block store -----------------------
+
+    def _store_put_wrappers(self, ctx: FileContext) -> Dict[str, Set[int]]:
+        """Function name -> positional indices (self excluded) whose
+        argument flows into a ``<...store>.put(...)`` call inside the
+        function body — one level of lifting, like SRV204."""
+        out: Dict[str, Set[int]] = {}
+        for fn in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef):
+            params = self._params(fn)
+            offset = 1 if params[:1] == ["self"] else 0
+            for sub in ast.walk(fn):
+                if not (isinstance(sub, ast.Call)
+                        and self._is_store_put(ctx, sub)):
+                    continue
+                for arg in sub.args:
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        i = params.index(arg.id) - offset
+                        if i >= 0:
+                            out.setdefault(fn.name, set()).add(i)
+        return out
+
+    @staticmethod
+    def _is_store_put(ctx: FileContext, call: ast.Call) -> bool:
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "put"):
+            return False
+        recv = ctx.dotted(call.func.value)
+        seg = _last_seg(recv)
+        return bool(seg) and "store" in seg.lower()
+
+    def _raw_store_writes(self, ctx: FileContext, fn: ast.AST,
+                          tainted: Set[str], sanitized: Set[str],
+                          wrappers: Dict[str, Set[int]]
+                          ) -> Iterator[Finding]:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call) or \
+                    ctx.enclosing_function(sub) is not fn:
+                continue
+            if self._is_store_put(ctx, sub):
+                bad_args = [a for a in sub.args[1:]]      # skip the key
+            else:
+                seg = _last_seg(ctx.dotted(sub.func))
+                positions = wrappers.get(seg or "")
+                # the wrapper's own body is the modeled definition site
+                if positions is None or seg == fn.name:
+                    continue
+                bad_args = [sub.args[i] for i in positions
+                            if i < len(sub.args)]
+            for arg in bad_args:
+                if isinstance(arg, ast.Name) and arg.id in tainted \
+                        and arg.id not in sanitized:
+                    yield ctx.finding(
+                        sub, self.code,
+                        f"`{arg.id}` carries a raw row_state payload "
+                        f"and is written into a block store without "
+                        f"passing through pack_payload — the tier's "
+                        f"fetch paths cannot decode it",
+                        hint=self.hint)
+
+    # -- sink 2: row_state after free (spilled-slot device read) ----------
+
+    def _freed_slot_reads(self, ctx: FileContext,
+                          fn: ast.AST) -> Iterator[Finding]:
+        freed: Dict[str, int] = {}
+        for sub in ast.walk(fn):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and len(sub.args) == 1
+                    and isinstance(sub.args[0], ast.Name)
+                    and ctx.enclosing_function(sub) is fn):
+                continue
+            name = sub.args[0].id
+            if sub.func.attr == "free":
+                freed.setdefault(name, sub.lineno)
+            elif sub.func.attr == "row_state" and name in freed \
+                    and freed[name] < sub.lineno:
+                yield ctx.finding(
+                    sub, self.code,
+                    f"row_state(`{name}`) on line {sub.lineno} reads a "
+                    f"slot freed on line {freed[name]} — the slot may "
+                    f"already be recycled; serialize BEFORE freeing",
+                    hint=self.hint)
 
 
 # ==========================================================================

@@ -37,7 +37,14 @@ lets every request carry its own adapter id as runtime data of the one
 compiled step (id 0 = the base model, mixed traffic recompiles
 nothing), and per-row token-mask constrained decoding rides the same
 knob arrays — both replay byte-identically through preemption,
-handoff, and failover. See ``docs/serving.md``.
+handoff, and failover. And capacity scales past HBM (``kv_tier.py``):
+a :class:`TieredKVStore` backs any engine with a budgeted host-RAM
+spill tier — the BigDL paper's BlockManager storage level mirrored
+below HBM — so cold KV rows spill as packed ``row_state`` bytes and
+resume WITHOUT re-prefill, evicted warm prefixes demote/promote
+through the same tier, and the preemption stash, disagg handoff
+staging, and failover copies become one store with one byte budget.
+See ``docs/serving.md``.
 
     from bigdl_tpu.serving import SamplingParams, ServingEngine
 
@@ -74,6 +81,7 @@ from bigdl_tpu.serving.faults import (
 )
 from bigdl_tpu.serving.fences import FENCE_SITES, fence, fence_wait
 from bigdl_tpu.serving.kv_pool import KVPool
+from bigdl_tpu.serving.kv_tier import TieredKVStore
 from bigdl_tpu.serving.lora import AdapterBank, AdapterSpec
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.serving.prefix_cache import PrefixCache
@@ -99,4 +107,4 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "TransferRetryConfig", "AutoscalerConfig",
            "OccupancyAutoscaler", "AdapterBank", "AdapterSpec",
            "TokenDFA", "ConstraintCursor", "ConstraintError",
-           "fixed_sequence", "from_token_sets"]
+           "fixed_sequence", "from_token_sets", "TieredKVStore"]

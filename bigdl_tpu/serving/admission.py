@@ -161,12 +161,13 @@ class AdmissionController:
         # (req.next_token, the degrade knob) are required on the
         # restored path too, even though pf itself goes unused there
         pf = eng._admitted_prefill_tokens(req)
-        if req.resume_carry is not None:
-            # byte-exact resume: the stashed row_state payload
-            # (preemption stash or disaggregated handoff) restores
-            # whole — KV + scales + lanes + mirrors + draft — and the
-            # slot skips _configure_slot's device reseeding
-            eng.pool.restore_row(slot, req.resume_carry)
+        payload = eng._resume_payload(req)
+        if payload is not None:
+            # byte-exact resume: the stashed/spilled row_state payload
+            # (preemption stash, host tier, or disaggregated handoff)
+            # restores whole — KV + scales + lanes + mirrors + draft —
+            # and the slot skips _configure_slot's device reseeding
+            eng.pool.restore_row(slot, payload)
             req.resume_carry = None
             eng._restored.add(slot)
             return slot, req, None

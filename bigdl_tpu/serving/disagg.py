@@ -20,9 +20,13 @@ The pieces were already lying around, which is why this module is thin:
   and ``restore_row()`` is its byte-identical inverse — the SAME API
   the engine's loss-free preemption stash speaks, so stash and handoff
   can never drift apart field by field;
-* ``Request.resume_carry`` is the engine's existing "this row arrives
-  with its state attached" handle — a handed-off request is admitted
-  into the decode pool exactly like a preempted row resuming;
+* the host tier (``serving/kv_tier.py``, shared across every pool of
+  the plane) is the engine's existing "this row arrives with its state
+  parked" handle — a handed-off request is admitted into the decode
+  pool exactly like a preempted row resuming, fetched from the same
+  :class:`~bigdl_tpu.serving.kv_tier.TieredKVStore` that holds
+  preemption spills and the front end's failover copies
+  (``Request.resume_carry`` remains the tier-less in-memory spelling);
 * ``block_store`` is a working cross-process byte-transfer layer — the
   production-shaped :class:`BlockStoreTransfer` backend rides it, and
   :class:`InProcessTransfer` serializes through the same codec so the
@@ -117,6 +121,7 @@ from bigdl_tpu.parallel.block_store import (
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import FaultError, default_clock
 from bigdl_tpu.serving.fences import fence
+from bigdl_tpu.serving.kv_tier import TieredKVStore
 from bigdl_tpu.serving.health import (
     DEAD, HEALTHY, POOL_ACTIVE, POOL_DEAD, POOL_STANDBY, AutoscalerConfig,
     HealthConfig, OccupancyAutoscaler, PoolHealth, TransferRetryConfig,
@@ -471,7 +476,7 @@ class PrefillWorker:
         if mr is not None and req.retries > mr:
             eng._ledger_finish(req, "error", eng._clock())
             return
-        req.resume_carry = payload
+        eng._spill_or_carry(req, payload)
         eng.metrics.on_retry()
         delay = self.retry.delay(req.retries)
         if delay > 0:
@@ -679,7 +684,13 @@ class DecodeWorker:
         if self._owns(rid):
             return None                      # same-pool duplicate
         req = request_from_meta(meta)
-        req.resume_carry = payload
+        if self.engine.tier is not None and payload is not None:
+            # the packed wire bytes ARE the row's tier entry: park
+            # them in the shared host tier instead of a per-request
+            # blob — admission fetches them back currency-checked
+            self.engine.tier.put_packed(blob, req_id=rid)
+        else:
+            req.resume_carry = payload
         self.engine.scheduler.submit(req)
         self._claims[rid] = self
         return rid
@@ -788,7 +799,7 @@ class DisaggregatedEngine:
                  standby_pools: int = 0,
                  health: Optional[HealthConfig] = None,
                  transfer_retry: Optional[TransferRetryConfig] = None,
-                 autoscaler=None, adapters=None) -> None:
+                 autoscaler=None, adapters=None, tier=None) -> None:
         if decode_pools < 1:
             raise ValueError(
                 f"decode_pools must be >= 1, got {decode_pools}")
@@ -801,6 +812,19 @@ class DisaggregatedEngine:
             else HealthConfig()
         self.transfer_retry = transfer_retry if transfer_retry is not None \
             else TransferRetryConfig()
+        # ONE host KV tier (serving/kv_tier.py) shared by the prefill
+        # engine and every decode worker — THE unified stash: the
+        # prefill side's transfer-retry payloads, each decode pool's
+        # preemption spills, and the front end's last-handoff failover
+        # copies all live under the same keys and the same byte
+        # budget. The disaggregated plane always runs tiered (the old
+        # per-request stash blobs and front-end _stash dict are this
+        # store now); attach_metrics is first-wins, so the front-end
+        # metrics object is the single spill/fetch sink.
+        if tier is None or tier is True:
+            tier = TieredKVStore()
+        self.tier = tier
+        self.tier.attach_metrics(self.metrics, clock=self._clock)
         # ONE AdapterBank object shared by the prefill engine and every
         # decode worker: the gather programs agree on the bank shapes,
         # and the refcount taken at the prefill door (submit) is
@@ -809,7 +833,7 @@ class DisaggregatedEngine:
         shared = dict(compute_dtype=compute_dtype, kv_dtype=kv_dtype,
                       speculative=speculative, seed=seed, clock=clock,
                       faults=faults, keep_finished=keep_finished,
-                      adapters=adapters)
+                      adapters=adapters, tier=tier)
         # the prefill pool shares the decode policy so priority
         # traffic orders ADMISSION too (no preemption there: its rows
         # drain to handoff every pump, so eviction has nothing to buy)
@@ -840,14 +864,12 @@ class DisaggregatedEngine:
             + [POOL_STANDBY] * standby_pools
         self._health = [PoolHealth(self._clock, self.health_config)
                         for _ in self.decoders]
-        # last-handoff stash: req_id -> the packed payload most
-        # recently sent for it. THE loss-free half of pool failover
-        # (a dead pool's row whose stash is still current re-routes
-        # bitwise) and the cancel sweep's ledger source; entries drop
-        # when their request finishes. Costs one host copy of each
-        # in-flight row's KV bytes at the front end — the price of
-        # replay-free failover.
-        self._stash: Dict[int, bytes] = {}
+        # (the last-handoff copies that used to live in a per-front-end
+        # _stash dict are tier row entries now: THE loss-free half of
+        # pool failover — a dead pool's row whose tier entry is still
+        # current re-routes bitwise — and the cancel sweep's ledger
+        # source; every engine's finish/cancel/shed disposition drops
+        # its entry eagerly, so nothing lingers until a hygiene sweep)
         # the front end's own stepping cadence: heartbeat SILENCE is
         # only meaningful while the plane is being driven (see step())
         self._last_step_t: Optional[float] = None
@@ -906,13 +928,15 @@ class DisaggregatedEngine:
         payload in flight is SWEPT, not recalled: the id joins the
         shared cancelled set every ``DecodeWorker.ingest`` consults
         (the decode pool drops the payload instead of restoring it),
-        and the cancellation is ledgered HERE from the stash header so
+        and the cancellation is ledgered HERE from the header of the
+        row's tier entry (the last-handoff failover copy) so
         the ``finish_*`` union still sums to every submitted
         request's fate. Returns False only for unknown or
         already-finished requests."""
         for eng in self._engines():
             if eng.cancel(req_id):
-                self._stash.pop(req_id, None)
+                # the engine's own teardown dropped the shared tier
+                # entry (engine.cancel -> _drop_tier_row)
                 return True
         if self._lookup(req_id) is not None:
             return False                     # already finished
@@ -925,7 +949,7 @@ class DisaggregatedEngine:
             req.resume_carry = None
             self._ledger_cancel(req)
             return True
-        blob = self._stash.pop(req_id, None)
+        blob = self.tier.pop_blob(req_id)
         if blob is None:
             return False                     # unknown request
         self._cancelled.add(req_id)
@@ -1092,14 +1116,14 @@ class DisaggregatedEngine:
         for req in stranded:                 # strata 2 + 3
             req.slot = None
             req.resume_carry = None
-            blob = self._stash.get(req.req_id)
+            blob = self.tier.get_blob(req.req_id)
             if blob is not None and \
                     payload_header(blob)["request"]["output"] \
                     == [int(t) for t in req.output]:
                 n_migrated += 1
             else:
                 blob = pack_payload(request_meta(req), None)
-                self._stash[req.req_id] = blob
+                self.tier.put_packed(blob, req_id=req.req_id)
                 n_replayed += 1
             self._forward(blob)
         self.metrics.on_failover(n_migrated, n_replayed,
@@ -1135,11 +1159,18 @@ class DisaggregatedEngine:
             n += 1
         sched = w.engine.scheduler
         for req in sched.pop_waiting(lambda r: True):
-            # queued-for-restore rows: their payload (or, for a
-            # replay-requeued row, its absence) re-packs as-is
-            payload, req.resume_carry = req.resume_carry, None
-            blob = pack_payload(request_meta(req), payload)
-            self._stash[req.req_id] = blob
+            # queued-for-restore rows: their payload already sits in
+            # the shared tier as packed bytes (ingest/requeue put it
+            # there) and re-routes as-is when still current; otherwise
+            # — a legacy in-memory carry, or no copy at all (replay-
+            # queued, or budget-evicted) — re-pack from the request
+            blob = self.tier.get_blob(req.req_id)
+            if blob is None or \
+                    payload_header(blob)["request"]["output"] \
+                    != [int(t) for t in req.output]:
+                payload, req.resume_carry = req.resume_carry, None
+                blob = pack_payload(request_meta(req), payload)
+                self.tier.put_packed(blob, req_id=req.req_id)
             self._forward(blob)
             n += 1
         seated = [(s, sched.running.pop(s)) for s in list(sched.running)]
@@ -1155,7 +1186,7 @@ class DisaggregatedEngine:
             w.engine._restored.discard(slot)
             w.engine._constraints.pop(slot, None)
             blob = pack_payload(request_meta(req), payload)
-            self._stash[req.req_id] = blob
+            self.tier.put_packed(blob, req_id=req.req_id)
             self._forward(blob)
             n += 1
         self.metrics.on_migrated(n)
@@ -1196,7 +1227,9 @@ class DisaggregatedEngine:
                                          self.metrics,
                                          health=self._health[i])
         if blob is not None:
-            self._stash[req.req_id] = blob
+            # the packed bytes double as the failover copy: one tier
+            # entry per in-flight row under the shared host budget
+            self.tier.put_packed(blob, req_id=req.req_id)
 
     def step(self) -> Dict[int, int]:
         """One front-end super-step: health sweep (failing over any
@@ -1230,12 +1263,10 @@ class DisaggregatedEngine:
                 continue
             out.update(worker.step())
             self._health[i].beat()
-        # stash hygiene: a finished request's handoff copy is dead
-        # weight (and must never shadow a future failover decision)
-        done = [rid for rid in self._stash
-                if self._lookup(rid) is not None]
-        for rid in done:
-            del self._stash[rid]
+        # (no stash hygiene sweep anymore: a finished request's
+        # handoff copy is dropped AT the finish disposition by the
+        # owning engine — ServingEngine._drop_tier_row — so the tier
+        # never carries dead rows between steps)
         if self._scaler is not None:
             self._autoscale()
         self.metrics.on_pool_occupancy(

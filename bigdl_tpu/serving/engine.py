@@ -224,7 +224,8 @@ class ServingEngine:
                  preemption: Optional[bool] = None,
                  watchdog: Optional[WatchdogConfig] = None,
                  faults=None,
-                 adapters=None) -> None:
+                 adapters=None,
+                 tier=None) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -379,8 +380,23 @@ class ServingEngine:
         if self._spec is not None:
             # the draft model's pooled carry rides the same slots
             self._spec.attach_pool(self.pool)
-        self.scheduler = Scheduler(policy)
+        # host spill tier (serving/kv_tier.py): True builds a default
+        # MemBlockStore-backed TieredKVStore; an instance is shared
+        # as-is (the disaggregated plane passes ONE tier to every
+        # pool); None keeps the legacy in-memory stash semantics
+        # (resume_carry blobs). With a tier, preemption spills rows to
+        # host RAM under its byte budget, readmission fetches them
+        # back currency-checked, and the scheduler's victim selection
+        # goes cold-first (LRU over last-decoded step).
+        if tier is True:
+            from bigdl_tpu.serving.kv_tier import TieredKVStore
+
+            tier = TieredKVStore()
+        self.tier = tier or None
+        self.scheduler = Scheduler(policy, tier=self.tier)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        if self.tier is not None:
+            self.tier.attach_metrics(self.metrics, clock=self._clock)
         if self._plane is not None:
             self.metrics.set_mesh_shape(self._plane.data_shards,
                                         self._plane.model_shards)
@@ -439,6 +455,12 @@ class ServingEngine:
             # True -> default cache, False/None -> off, else an instance
             self.prefix_cache = (PrefixCache() if prefix_cache is True
                                  else (prefix_cache or None))
+            # tier-backed prefix spill: capacity evictions demote to
+            # the host tier and lookups promote back (kv_tier.py); an
+            # explicitly pre-wired cache keeps its own tier
+            if (self.tier is not None and self.prefix_cache is not None
+                    and self.prefix_cache.tier is None):
+                self.prefix_cache.tier = self.tier
             if admission == "chunked":
                 from bigdl_tpu.serving.chunked import (
                     ChunkedAdmissionController,
@@ -656,6 +678,7 @@ class ServingEngine:
         # not pin its KV slices in the finished ledger forever (the
         # same teardown contract _shed follows)
         req.resume_carry = None
+        self._drop_tier_row(req)
         self._release_adapter(req)
         self.metrics.on_cancel()
         # cancellation is a disposition too: without this bucket the
@@ -753,6 +776,13 @@ class ServingEngine:
         n = self.scheduler.admissible(self.pool.free_slots)
         if not n:
             return
+        if self.tier is not None:
+            # batch the host->host fetches for the rows about to seat
+            # BEFORE admission touches the device, so tier latency
+            # never lands inside the decode gap (the fetch itself is
+            # host-side; only restore_row uploads, same as the legacy
+            # stash path)
+            self.tier.prefetch(self.scheduler.peek_waiting(n))
         if self.admitter is not None:
             # batched admission: bucketed multi-row masked prefill with
             # optional shared-prefix reuse (serving/admission.py)
@@ -768,11 +798,12 @@ class ServingEngine:
             # (called before the resume check: next_token/degrade are
             # needed on the restored path too)
             pf = self._admitted_prefill_tokens(req)
-            if req.resume_carry is not None:
-                # byte-exact resume: the stashed row_state payload
-                # (KV + scales + lanes + mirrors + draft) restores
-                # whole — _configure_slot then sets knobs only
-                self.pool.restore_row(slot, req.resume_carry)
+            payload = self._resume_payload(req)
+            if payload is not None:
+                # byte-exact resume: the stashed/spilled row_state
+                # payload (KV + scales + lanes + mirrors + draft)
+                # restores whole — _configure_slot then sets knobs only
+                self.pool.restore_row(slot, payload)
                 req.resume_carry = None
                 self._restored.add(slot)
                 continue
@@ -824,6 +855,7 @@ class ServingEngine:
         # device slices) or the finished ledger pins it forever — the
         # same teardown contract cancel() follows
         req.resume_carry = None
+        self._drop_tier_row(req)
         req.finish_time = self._clock()
         self._finished[req.req_id] = req
         self._evict_finished()
@@ -862,6 +894,44 @@ class ServingEngine:
         req.next_token = fed0[-1]
         return fed0[:-1]
 
+    def _spill_or_carry(self, req: Request, payload: Optional[dict]) -> None:
+        """Park a row's ``row_state`` payload for later readmission:
+        into the host tier when one backs this engine (packed host
+        bytes under the tier's budget — THE unified stash path), else
+        on ``req.resume_carry`` (the legacy in-memory stash of device
+        slices). One spelling for preemption, the disagg transfer
+        requeue, and handoff staging."""
+        if payload is None:
+            return
+        if self.tier is not None:
+            self.tier.put_row(req, payload)
+        else:
+            req.resume_carry = payload
+
+    def _resume_payload(self, req: Request) -> Optional[dict]:
+        """The byte-exact resume source for a (re)admitted request: its
+        in-memory stash if one rode the request (tier-less engines),
+        else a currency-checked fetch from the host tier. None -> no
+        resident copy: the row replays via prefill of ``prompt +
+        output`` (the PR 8 contract — a budget-evicted tier entry
+        downgrades to replay, never to corruption). Mid-stream resumes
+        count ``serving/resumed_without_prefill``."""
+        payload = req.resume_carry
+        if payload is None and self.tier is not None:
+            payload = self.tier.fetch_row(req)
+        if payload is not None and req.output:
+            self.metrics.on_resume_without_prefill()
+        return payload
+
+    def _drop_tier_row(self, req: Request) -> None:
+        """Tier-side twin of ``req.resume_carry = None``: every
+        terminal (or carry-distrusting) disposition drops the
+        request's spilled row eagerly, so the host tier never pins a
+        dead row's bytes — the fix for the old disagg wart where a
+        finished row's stash lingered until a later hygiene sweep."""
+        if self.tier is not None:
+            self.tier.drop_row(req.req_id)
+
     def _dispatch(self, site: str, fn, *args):
         """Every serving-path device dispatch routes through here so
         the optional :class:`~bigdl_tpu.serving.faults.FaultInjector`
@@ -873,10 +943,12 @@ class ServingEngine:
 
     def _preempt_row(self, victim: Request) -> None:
         """Loss-free preemption of one RUNNING row: stash its FULL
-        ``pool.row_state`` payload on the request (KV + int8 scales +
-        RNG lane + penalty counts + chunk mirrors + draft slice —
-        restored bitwise at readmission through ``restore_row``, the
-        same serialization the disaggregated handoff speaks), share its
+        ``pool.row_state`` payload (KV + int8 scales + RNG lane +
+        penalty counts + chunk mirrors + draft slice — restored
+        bitwise at readmission through ``restore_row``, the same
+        serialization the disaggregated handoff speaks) — into the
+        host tier when one is attached (packed bytes under the tier
+        budget, HBM freed outright), else on the request, share its
         carry into the prefix cache when one is attached (any request
         on the same prefix benefits), then free the slot and requeue
         the request at its ORIGINAL arrival key — preemption reorders
@@ -884,7 +956,7 @@ class ServingEngine:
         slot = victim.slot
         payload = self.pool.row_state(slot)
         if len(victim.prompt) + len(victim.output) > 1:
-            victim.resume_carry = payload
+            self._spill_or_carry(victim, payload)
             if self.prefix_cache is not None:
                 fed0 = [t - 1 for t in victim.prompt] + \
                        [t - 1 for t in victim.output]
@@ -916,6 +988,10 @@ class ServingEngine:
                 self.admitter.drop(slot)       # mid-prefill chunk plan
             req.retries += 1
             req.resume_carry = None
+            # recovery never trusts a stashed copy either: a faulted
+            # step may postdate the spill, so the tier row is dropped
+            # and the request replays from prompt + output
+            self._drop_tier_row(req)
             mr = self.watchdog.max_retries
             if mr is not None and req.retries > mr:
                 self._finish_row(req, "error", now)   # frees the slot
@@ -1083,6 +1159,7 @@ class ServingEngine:
         self._release_adapter(req)
         req.finish_reason = reason
         req.resume_carry = None
+        self._drop_tier_row(req)
         req.state = FINISHED
         req.finish_time = now
         self._finished[req.req_id] = req
@@ -1230,6 +1307,7 @@ class ServingEngine:
             self._last_decode_end = None
             return {}
         if self._spec is not None:
+            slots = list(running)
             out = self._spec.step(running)
             # a healthy super-step emits for every running row; an
             # empty dict here means the step faulted and recovery
@@ -1237,6 +1315,7 @@ class ServingEngine:
             # no gap sample and no live batch to anchor the next one
             if out:
                 self._note_decode_gap(had_running)
+                self.scheduler.note_decoded(slots)
             else:
                 self._last_decode_end = None
             return out
@@ -1307,6 +1386,9 @@ class ServingEngine:
         # HEALTHY steps only: the decode-stall histogram measures gaps
         # between dispatches that actually served the batch
         self._note_decode_gap(had_running)
+        # recency stamps feed the tier's cold-first victim selection:
+        # a row decoded this step is never the LRU preemption victim
+        self.scheduler.note_decoded(list(running))
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.pool.occupancy(), int(active.sum()))
         self.metrics.on_sample_rows(n_sampled, len(running) - n_sampled)

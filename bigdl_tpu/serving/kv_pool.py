@@ -354,12 +354,15 @@ class KVPool:
         host mirrors,
         and the attached DRAFT carry's B=1 slice (``None`` without
         one). This is THE row-serialization API: the engine's
-        preemption stash and the disaggregated prefill→decode handoff
-        both speak it, so a per-slot field added to the carry can never
-        again be captured by one path and silently dropped by the other
-        (the latent-bug class the old carry-only stash invited).
-        :meth:`restore_row` is the inverse — byte-identical, pinned by
-        tests/test_serving_disagg.py."""
+        preemption stash, the disaggregated prefill→decode handoff,
+        AND the host spill tier (``serving/kv_tier.py`` packs exactly
+        this payload through ``pack_payload`` before it leaves HBM —
+        the SRV207 codec discipline) all speak it, so a per-slot field
+        added to the carry can never again be captured by one path and
+        silently dropped by another (the latent-bug class the old
+        carry-only stash invited). :meth:`restore_row` is the inverse —
+        byte-identical, pinned by tests/test_serving_disagg.py and
+        tests/test_serving_tiered.py."""
         payload = {"carry": self.read_row(slot),
                    "chunk_done": int(self.chunk_done[slot]),
                    "chunk_target": int(self.chunk_target[slot]),

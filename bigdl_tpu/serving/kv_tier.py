@@ -1,0 +1,396 @@
+"""Host-RAM KV tier: spill cold rows and prefix entries below HBM.
+
+BigDL leaned on Spark's BlockManager as the storage tier below executor
+heaps; the serving plane needs the same tier below HBM. The pooled KV
+cache holds ``n_slots`` rows of device state, and everything that falls
+out of it today is either replayed (re-prefill of ``prompt + output``)
+or pinned as a per-request host blob: the preemption stash
+(``Request.resume_carry``), the disaggregated front end's last-handoff
+stash (``_stash``), and the failover re-route copy were three spellings
+of the same bytes. :class:`TieredKVStore` is the one subsystem behind
+all of them: a budgeted host tier over any
+:class:`~bigdl_tpu.parallel.block_store.BlockStore` (in-process dict by
+default — same-host DRAM; ``FsBlockStore``/``CoordServiceBlockStore``
+for cross-process deployments) holding two entry kinds under ONE
+global LRU byte budget:
+
+* **rows** — full ``KVPool.row_state()`` payloads packed through the
+  disagg wire codec (:func:`~bigdl_tpu.serving.disagg.pack_payload`:
+  JSON header + self-describing array leaves, bf16/int8 bitwise), keyed
+  by request id. Spilled at preemption, handoff staging, and transfer
+  requeue; fetched — currency-checked against the request's emitted
+  stream — at readmission, where ``restore_row()`` makes the resume
+  byte-exact. A fetched row entry is KEPT (LRU-touched): it doubles as
+  the failover stash until the request finishes, when every terminal
+  disposition drops it (no lingering blobs — the old stash-hygiene
+  sweep's job, done eagerly);
+* **prefixes** — :class:`~bigdl_tpu.serving.prefix_cache.PrefixCache`
+  carries demoted at HBM-capacity eviction instead of deleted, keyed by
+  (adapter id, token path) so tenant namespaces never cross. A later
+  lookup PROMOTES the best stored prefix back into the radix tree as an
+  ordinary (possibly truncated) hit — warm-prefix capacity is bounded
+  by ``host_budget_bytes``, not by the cache's HBM entry count.
+
+The byte budget is enforced by LRU eviction over BOTH kinds (the entry
+just written is immune for its own pass, mirroring the prefix cache's
+``protect`` rule). Evicting a row entry is loss-free by construction:
+the readmission fetch misses and the row replays through prefill —
+the tier only ever upgrades the replay baseline, never replaces it.
+Meta-only (replay-form) blobs ride the row API too so the failover and
+cancel-sweep bookkeeping stay uniform, but count no spill bytes.
+
+Codec discipline (analyzer rule SRV207): row state enters a block
+store ONLY as ``pack_payload`` bytes and leaves ONLY through
+``unpack_payload``/``payload_header`` — a raw ``row_state`` dict
+written to a store, or a ``row_state`` read of an already-freed slot,
+is machine-caught. Fetches can be BATCHED off the step path
+(:meth:`TieredKVStore.prefetch` decodes the next admission wave's
+blobs in one pass), so the decode gap never absorbs a payload decode.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from bigdl_tpu.parallel.block_store import BlockStore, MemBlockStore
+from bigdl_tpu.serving.faults import default_clock
+
+
+class TieredKVStore:
+    """One host spill tier shared by an engine (or a whole
+    disaggregated plane): row payloads + demoted prefix carries under
+    a global LRU byte budget (module docstring).
+
+    ``store`` is any :class:`BlockStore` (default an in-process
+    :class:`MemBlockStore`); ``host_budget_bytes`` bounds the resident
+    bytes (None = unbounded — the legacy stash semantics). The tier
+    keeps its own key index (block stores expose no iteration), so a
+    shared Fs/coord store still needs one tier OBJECT per serving
+    plane — the index, like the scheduler, is per-plane state.
+    ``clock`` times fetches (the engine attaches its own — a
+    VirtualClock plane stays sleep-free)."""
+
+    def __init__(self, store: Optional[BlockStore] = None,
+                 host_budget_bytes: Optional[int] = None,
+                 clock=None) -> None:
+        if host_budget_bytes is not None and host_budget_bytes <= 0:
+            raise ValueError(
+                f"host_budget_bytes must be positive or None, got "
+                f"{host_budget_bytes}")
+        self.store = store if store is not None else MemBlockStore()
+        self.host_budget_bytes = host_budget_bytes
+        self._clock = clock if clock is not None else default_clock
+        # ONE LRU over every resident entry (rows AND prefixes):
+        # key -> nbytes, oldest first; doubles as the key index
+        self._lru: "OrderedDict[str, int]" = OrderedDict()
+        self._bytes = 0
+        # prefix index: adapter id -> {token tuple -> store key}
+        self._prefixes: Dict[int, Dict[Tuple[int, ...], str]] = {}
+        self._pf_seq = 0
+        # batched-fetch staging: req_id -> decoded payload
+        # (prefetch() fills it off the step path; fetch_row drains it)
+        self._hot: Dict[int, dict] = {}
+        self._metrics = None
+        self.spills = 0
+        self.fetches = 0
+        self.evictions = 0
+        self.spill_bytes = 0
+        self.fetch_bytes = 0
+
+    # -- metrics plumbing --------------------------------------------------
+
+    def attach_metrics(self, metrics, clock=None) -> None:
+        """Bind ONE metrics sink (first caller wins — a disaggregated
+        plane attaches the front end's metrics before its pool engines
+        construct, so spills/fetches land in one summary)."""
+        if self._metrics is None and metrics is not None:
+            self._metrics = metrics
+            if clock is not None:
+                self._clock = clock
+            self._note_bytes()
+
+    def _note_bytes(self) -> None:
+        if self._metrics is not None:
+            self._metrics.on_tier_bytes(self._bytes)
+
+    def _spilled(self, n_bytes: int) -> None:
+        self.spills += 1
+        self.spill_bytes += n_bytes
+        if self._metrics is not None:
+            self._metrics.on_spill(n_bytes)
+
+    def _fetched(self, n_bytes: int, seconds: float) -> None:
+        self.fetches += 1
+        self.fetch_bytes += n_bytes
+        if self._metrics is not None:
+            self._metrics.on_fetch(n_bytes, seconds)
+
+    # -- budget / LRU core -------------------------------------------------
+
+    @staticmethod
+    def _row_key(req_id: int) -> str:
+        return f"tier/row/{int(req_id)}"
+
+    def _put_blob(self, key: str, blob: bytes, count: bool) -> None:
+        old = self._lru.pop(key, None)
+        if old is not None:
+            self._bytes -= old
+        self.store.put(key, blob)
+        self._lru[key] = len(blob)
+        self._bytes += len(blob)
+        if count:
+            self._spilled(len(blob))
+        self._evict_over_budget(protect=key)
+        self._note_bytes()
+
+    def _remove(self, key: str) -> bool:
+        n = self._lru.pop(key, None)
+        if n is None:
+            return False
+        self._bytes -= n
+        self.store.delete(key)
+        if key.startswith("tier/prefix/"):
+            for idx in self._prefixes.values():
+                for toks, k in list(idx.items()):
+                    if k == key:
+                        del idx[toks]
+        self._note_bytes()
+        return True
+
+    def _evict_over_budget(self, protect: Optional[str] = None) -> None:
+        # the entry just paid for is immune for its own pass (it sits
+        # newest in the LRU, so it is only ever the scan head when it
+        # is the LAST entry — a single over-budget blob stays resident
+        # rather than thrashing, the prefix cache's overflow rule)
+        if self.host_budget_bytes is None:
+            return
+        while self._bytes > self.host_budget_bytes and self._lru:
+            victim = next(iter(self._lru))
+            if victim == protect:
+                return
+            self._remove(victim)
+            self.evictions += 1
+            if self._metrics is not None:
+                self._metrics.on_tier_evict()
+
+    # -- row entries (preemption / handoff / failover stash) ---------------
+
+    def put_row(self, req, payload: dict) -> None:
+        """Spill one live row: pack its ``row_state`` payload through
+        the wire codec (THE sanctioned serialization — SRV207) under
+        the request's id. Overwrites any older copy — a re-preempted
+        row's fresher bytes supersede."""
+        from bigdl_tpu.serving.disagg import pack_payload, request_meta
+
+        self._hot.pop(int(req.req_id), None)
+        blob = pack_payload(request_meta(req), payload)
+        self._put_blob(self._row_key(req.req_id), blob, count=True)
+
+    def put_packed(self, blob: bytes, req_id: Optional[int] = None) -> None:
+        """Stage an ALREADY-packed handoff blob (the disagg front end's
+        confirmed-delivery stash, a decode worker's ingest, a failover
+        replay form). Meta-only blobs are tracked for bookkeeping but
+        count no spill — they carry no row bytes."""
+        from bigdl_tpu.serving.disagg import payload_header
+
+        head = payload_header(blob)
+        if req_id is None:
+            req_id = int(head["request"]["req_id"])
+        self._hot.pop(int(req_id), None)
+        self._put_blob(self._row_key(req_id), blob,
+                       count=head["carry_keys"] is not None)
+
+    def has_row(self, req_id: int) -> bool:
+        return self._row_key(req_id) in self._lru
+
+    def get_blob(self, req_id: int) -> Optional[bytes]:
+        """The raw packed blob for a request (or None) — the failover
+        path's read: it needs the bytes as-is to re-route, and does its
+        own header currency check. LRU-touches the entry."""
+        key = self._row_key(req_id)
+        if key not in self._lru:
+            return None
+        blob = self.store.try_get(key)
+        if blob is None:                  # backing store lost it
+            self._remove(key)
+            return None
+        self._lru.move_to_end(key)
+        return blob
+
+    def pop_blob(self, req_id: int) -> Optional[bytes]:
+        """:meth:`get_blob` + drop — the cancel sweep's consume."""
+        blob = self.get_blob(req_id)
+        if blob is not None:
+            self.drop_row(req_id)
+        return blob
+
+    def header(self, req_id: int) -> Optional[Dict]:
+        """Header-only cheap read of a stored row blob (no array
+        decode), or None."""
+        from bigdl_tpu.serving.disagg import payload_header
+
+        blob = self.get_blob(req_id)
+        return None if blob is None else payload_header(blob)
+
+    def drop_row(self, req_id: int) -> None:
+        """Forget a request's row entry (terminal dispositions, fault
+        recovery — a suspect carry is never trusted). Idempotent."""
+        self._hot.pop(int(req_id), None)
+        self._remove(self._row_key(req_id))
+
+    def _load_row(self, req) -> Optional[dict]:
+        """Decode one stored row payload for ``req`` if the copy is
+        CURRENT (its header's emitted stream equals the request's —
+        a row that decoded past its spill must replay instead; the
+        stale entry drops). Meta-only replay forms also load as None:
+        there is no state to restore."""
+        from bigdl_tpu.serving.disagg import payload_header, unpack_payload
+
+        t0 = self._clock()
+        key = self._row_key(req.req_id)
+        if key not in self._lru:
+            return None
+        blob = self.store.try_get(key)
+        if blob is None:
+            self._remove(key)
+            return None
+        head = payload_header(blob)
+        if head["carry_keys"] is None or \
+                head["request"]["output"] != [int(t) for t in req.output]:
+            self._remove(key)
+            return None
+        _, payload = unpack_payload(blob)
+        # KEEP the entry, freshly touched: until the request finishes
+        # it remains the failover/currency copy (drop-at-finish is the
+        # other half of this contract)
+        self._lru.move_to_end(key)
+        self._fetched(len(blob), self._clock() - t0)
+        return payload
+
+    def fetch_row(self, req) -> Optional[dict]:
+        """The readmission fetch: the request's spilled payload with
+        numpy leaves (what ``restore_row`` accepts), or None when no
+        current copy exists (budget-evicted, stale, or never spilled)
+        and the row must replay via prefill."""
+        payload = self._hot.pop(int(req.req_id), None)
+        if payload is not None:
+            return payload
+        return self._load_row(req)
+
+    def prefetch(self, reqs: Iterable) -> int:
+        """Decode the blobs for an upcoming admission wave in one pass
+        OFF the step path, so each :meth:`fetch_row` inside the
+        admission loop is a dict pop, not a payload decode. Returns
+        how many rows were staged."""
+        n = 0
+        for req in reqs:
+            rid = int(req.req_id)
+            if rid in self._hot or req.resume_carry is not None:
+                continue
+            payload = self._load_row(req)
+            if payload is not None:
+                self._hot[rid] = payload
+                n += 1
+        return n
+
+    # -- prefix entries (PrefixCache demote/promote) ------------------------
+
+    @staticmethod
+    def _common(a: Tuple[int, ...], b: Tuple[int, ...]) -> int:
+        n = min(len(a), len(b))
+        for i in range(n):
+            if a[i] != b[i]:
+                return i
+        return n
+
+    def demote_prefix(self, tokens, carry, adapter_id: int = 0) -> None:
+        """Store an HBM-evicted prefix carry instead of deleting it:
+        packed through the same wire codec (a synthetic header — no
+        request rides it), keyed by (adapter id, token path) so tenant
+        namespaces never cross. Only refs==0 entries ever reach here
+        (the cache's eviction rule), so no lease dangles."""
+        from bigdl_tpu.serving.disagg import pack_payload
+
+        tokens = tuple(int(t) for t in tokens)
+        if not tokens or carry is None:
+            return
+        aid = int(adapter_id)
+        idx = self._prefixes.setdefault(aid, {})
+        key = idx.get(tokens)
+        if key is None:
+            self._pf_seq += 1
+            key = f"tier/prefix/{aid}/{self._pf_seq}"
+        meta = {"kind": "prefix", "adapter": aid, "tokens": list(tokens)}
+        blob = pack_payload(meta, {"carry": carry, "draft": None,
+                                   "chunk_done": 0, "chunk_target": 0,
+                                   "adapter": aid})
+        idx[tokens] = key
+        self._put_blob(key, blob, count=True)
+
+    def promote_prefix(self, tokens, matched: int,
+                       adapter_id: int = 0) -> Optional[Tuple[Tuple[int, ...],
+                                                              dict]]:
+        """The lookup-side promotion: the stored prefix (same adapter)
+        sharing the LONGEST common prefix with ``tokens`` — strictly
+        longer than the ``matched`` tokens HBM already serves — decoded
+        and returned as ``(its token path, device carry)`` for the
+        cache to re-insert (causal K/V makes a longer stored entry
+        serve any shorter shared prefix as a truncated hit, exactly
+        the radix walk's rule). The entry leaves the tier: it lives in
+        HBM again. None when nothing stored beats ``matched``."""
+        import jax.numpy as jnp
+
+        from bigdl_tpu.serving.disagg import unpack_payload
+
+        idx = self._prefixes.get(int(adapter_id))
+        if not idx:
+            return None
+        tokens = tuple(int(t) for t in tokens)
+        best, best_use = None, int(matched)
+        for p in idx:
+            use = self._common(p, tokens)
+            if use > best_use:
+                best, best_use = p, use
+        if best is None:
+            return None
+        t0 = self._clock()
+        key = idx[best]
+        blob = self.store.try_get(key)
+        if blob is None:                  # backing store lost it
+            self._remove(key)
+            return None
+        _, decoded = unpack_payload(blob)
+        self._remove(key)                 # promotion consumes the entry
+        self._fetched(len(blob), self._clock() - t0)
+        carry = {k: jnp.asarray(v) for k, v in decoded["carry"].items()}
+        return best, carry
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def entries(self) -> int:
+        return len(self._lru)
+
+    @property
+    def prefix_entries(self) -> int:
+        return sum(len(v) for v in self._prefixes.values())
+
+    @property
+    def row_entries(self) -> int:
+        return self.entries - self.prefix_entries
+
+    def stats(self) -> Dict[str, float]:
+        return {"entries": float(self.entries),
+                "rows": float(self.row_entries),
+                "prefixes": float(self.prefix_entries),
+                "bytes": float(self._bytes),
+                "spills": float(self.spills),
+                "fetches": float(self.fetches),
+                "evictions": float(self.evictions),
+                "spill_bytes": float(self.spill_bytes),
+                "fetch_bytes": float(self.fetch_bytes)}

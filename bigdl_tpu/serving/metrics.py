@@ -452,6 +452,47 @@ class ServingMetrics:
         """Percentiles of the per-failover wall time (seconds)."""
         return self._pctl("failover_s", qs)
 
+    # -- host KV tier hooks (serving/kv_tier.py) ---------------------------
+
+    def on_spill(self, n_bytes: int) -> None:
+        """One row/prefix entry written into the host tier (packed
+        through the ``row_state``/``pack_payload`` codec). ``summary()``
+        surfaces the count and total bytes as sums and derives the
+        per-spill byte mean."""
+        self.metrics.add("serving/spills", 1.0)
+        self.metrics.add("serving/spill_bytes", float(n_bytes))
+
+    def on_fetch(self, n_bytes: int, seconds: float) -> None:
+        """One tier entry read back (row readmission or prefix
+        promotion): the blob size and the host-side unpack wall.
+        ``summary()`` derives the fetch_s p99 — the number to hold
+        against the re-prefill wall it replaces."""
+        self.metrics.add("serving/fetches", 1.0)
+        self.metrics.add("serving/fetch_bytes", float(n_bytes))
+        self.metrics.add("serving/fetch_s", float(seconds))
+
+    def on_tier_bytes(self, n_bytes: int) -> None:
+        """Resident tier footprint (a gauge, not a counter): the bytes
+        currently held against ``host_budget_bytes``."""
+        self.metrics.set("serving/tier_bytes", float(n_bytes))
+
+    def on_tier_evict(self) -> None:
+        """A tier entry evicted by the byte budget (LRU): the copy is
+        gone — a row readmission downgrades to prefill replay, a
+        prefix lookup to a miss. Loss-free either way; this counter
+        rising is the 'raise host_budget_bytes' signal."""
+        self.metrics.add("serving/tier_evictions", 1.0)
+
+    def on_resume_without_prefill(self) -> None:
+        """A mid-stream row (tokens already emitted) re-seated from a
+        stashed/spilled ``row_state`` payload instead of replaying
+        prefill — the capacity win the tier exists for."""
+        self.metrics.add("serving/resumed_without_prefill", 1.0)
+
+    def fetch_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
+        """Percentiles of the per-fetch host wall (seconds)."""
+        return self._pctl("fetch_s", qs)
+
     def decode_step_estimate(self) -> Optional[float]:
         """MEDIAN of the recent decode-step samples (a bounded window,
         seconds), or None before the first decode step — the per-step
@@ -605,6 +646,8 @@ class ServingMetrics:
                      "pool_deaths", "failovers", "migrated_rows",
                      "replayed_rows", "transfer_timeouts",
                      "autoscale_up", "autoscale_down",
+                     "spills", "fetches", "spill_bytes", "fetch_bytes",
+                     "tier_evictions", "resumed_without_prefill",
                      *(f"finish_{r}" for r in sorted(self.FINISH_REASONS))):
             total, n = self.metrics.get(f"serving/{name}")
             if n:
@@ -634,6 +677,13 @@ class ServingMetrics:
             out["serving/transfer_bytes_per_handoff"] = nb / n_hand
             out["serving/transfer_p99_s"] = \
                 self.transfer_percentiles()["p99"]
+        n_sp, n_sp_n = self.metrics.get("serving/spills")
+        if n_sp_n:
+            sb, _ = self.metrics.get("serving/spill_bytes")
+            out["serving/spill_bytes_per_row"] = sb / n_sp
+        _, n_fe = self.metrics.get("serving/fetch_s")
+        if n_fe:
+            out["serving/fetch_p99_s"] = self.fetch_percentiles()["p99"]
         _, n_fo = self.metrics.get("serving/failover_s")
         if n_fo:
             fp = self.failover_percentiles()

@@ -67,11 +67,19 @@ class _Node:
 class PrefixCache:
     """Radix-tree cache of prefilled prompt prefixes (module docstring)."""
 
-    def __init__(self, max_entries: int = 16) -> None:
+    def __init__(self, max_entries: int = 16, tier=None) -> None:
         if max_entries <= 0:
             raise ValueError(
                 f"max_entries must be positive, got {max_entries}")
         self.max_entries = int(max_entries)
+        # optional host spill tier (serving/kv_tier.py): capacity
+        # eviction DEMOTES refs==0 carries there instead of deleting,
+        # and acquire() PROMOTES the best stored prefix back as an
+        # ordinary hit — warm-prefix capacity then scales with the
+        # tier's host_budget_bytes, not max_entries of HBM. The
+        # engine wires its tier in at construction; settable because
+        # the cache may be built before the tier.
+        self.tier = tier
         self.root = _Node((), None, 0)
         # the tree is NAMESPACED by adapter id (multi-tenant LoRA —
         # serving/lora.py): K/V prefilled under one tenant's factors is
@@ -165,8 +173,22 @@ class PrefixCache:
         tokens = tuple(int(t) for t in tokens)
         root = self._roots.get(int(adapter_id))
         if root is None:
-            return None, 0, None
-        best, matched = self._walk(tokens, root)
+            best, matched = None, 0
+        else:
+            best, matched = self._walk(tokens, root)
+        if self.tier is not None:
+            # tier promotion: a demoted prefix sharing MORE of this
+            # prompt than HBM serves comes back as a real entry (the
+            # fresh insert is eviction-immune for its pass), then the
+            # re-walk serves it as an ordinary — possibly truncated —
+            # hit. The tier counts the fetch; the hit counts below.
+            promo = self.tier.promote_prefix(tokens, matched,
+                                             adapter_id=int(adapter_id))
+            if promo is not None:
+                ptoks, carry = promo
+                self.insert(ptoks, carry, adapter_id=int(adapter_id))
+                best, matched = self._walk(
+                    tokens, self._roots[int(adapter_id)])
         if best is None:
             return None, 0, None
         best.refs += 1
@@ -247,7 +269,29 @@ class PrefixCache:
             self._drop(victim)
             self.evictions += 1
 
+    def _path_of(self, node: _Node):
+        """The full token path from ``node``'s namespace root plus the
+        adapter id owning that root ((tokens, None) for a detached
+        node) — what a demotion is keyed by."""
+        parts = []
+        n = node
+        while n.parent is not None:
+            parts.append(n.edge)
+            n = n.parent
+        tokens = tuple(t for e in reversed(parts) for t in e)
+        for aid, root in self._roots.items():
+            if root is n:
+                return tokens, aid
+        return tokens, None
+
     def _drop(self, node: _Node) -> None:
+        # only capacity eviction reaches here, and it only ever picks
+        # refs==0 victims — so a demoted carry never has a live lease
+        if self.tier is not None and node.carry is not None:
+            tokens, aid = self._path_of(node)
+            if tokens and aid is not None:
+                self.tier.demote_prefix(tokens, node.carry,
+                                        adapter_id=aid)
         node.carry = None
         self._carry_nodes.discard(node)
         # prune now-useless structure: carry-less leaves up the path

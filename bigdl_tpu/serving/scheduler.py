@@ -145,7 +145,8 @@ class Scheduler:
     keys are assigned once per request (requeue reuses them), so a
     preempted request re-enters at its original position."""
 
-    def __init__(self, policy: str = "prefill_priority") -> None:
+    def __init__(self, policy: str = "prefill_priority",
+                 tier=None) -> None:
         if policy not in _POLICIES:
             raise ValueError(
                 f"unknown admission policy {policy!r} (one of {_POLICIES})")
@@ -156,6 +157,16 @@ class Scheduler:
         # decoding — activate() moves them into `running`
         self.partial: Dict[int, Request] = {}
         self._seq = 0
+        # host spill tier (serving/kv_tier.py). With one attached,
+        # victim selection goes COLD-FIRST: spilling a row that has
+        # not decoded recently costs the batch least, and its fetch
+        # is furthest away. The stamps below track recency.
+        self.tier = tier
+        self._step_no = 0
+        # slot -> step number of its occupant's last decode (admission
+        # stamps the current step: a row admitted this step is WARM by
+        # definition and must never be the same round's cold victim)
+        self._last_decoded: Dict[int, int] = {}
 
     def _key(self, req: Request):
         if self.policy != "priority":
@@ -205,6 +216,7 @@ class Scheduler:
         lets it decode."""
         _, req = heapq.heappop(self._waiting)
         req.slot = slot
+        self._last_decoded[slot] = self._step_no
         if partial:
             req.state = PARTIAL
             self.partial[slot] = req
@@ -219,7 +231,16 @@ class Scheduler:
         req = self.partial.pop(slot)
         req.state = RUNNING
         self.running[slot] = req
+        self._last_decoded[slot] = self._step_no
         return req
+
+    def note_decoded(self, slots) -> None:
+        """Stamp one completed decode/verify super-step for ``slots``
+        (the engine calls this once per HEALTHY dispatch) — the
+        recency signal behind cold-first victim selection."""
+        self._step_no += 1
+        for slot in slots:
+            self._last_decoded[slot] = self._step_no
 
     # -- priority/deadline surface (the engine's preemption loop) ----------
 
@@ -234,13 +255,29 @@ class Scheduler:
 
     def lowest_running(self) -> Optional[Request]:
         """The preemption victim candidate: the lowest-priority running
-        row, most recent arrival first among equals (least time in a
-        slot — replay cost is smallest and its completion is furthest
-        away)."""
+        row. Tie-break WITHIN a priority class: with a host tier
+        attached, the COLDEST row (LRU over last-decoded step — its
+        spill disturbs the batch least and eviction is loss-free
+        either way); without one, most recent arrival first (least
+        time in a slot — replay cost is smallest and its completion
+        is furthest away). PARTIAL (mid-prefill) rows are never
+        candidates — only ``running`` is scanned."""
         if not self.running:
             return None
+        if self.tier is not None:
+            return min(self.running.values(),
+                       key=lambda r: (r.priority,
+                                      self._last_decoded.get(r.slot, -1),
+                                      -r.seq))
         return min(self.running.values(),
                    key=lambda r: (r.priority, -r.seq))
+
+    def peek_waiting(self, n: int) -> List[Request]:
+        """The ``n`` requests the next ``n`` ``admit()`` calls would
+        pop, in order, WITHOUT popping them — the tier's prefetch
+        window (keys are unique per request, so the heap entries
+        totally order)."""
+        return [r for _, r in heapq.nsmallest(n, self._waiting)]
 
     def pop_waiting(self, pred) -> List[Request]:
         """Remove and return every WAITING request ``pred`` selects —

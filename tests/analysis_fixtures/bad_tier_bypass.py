@@ -1,0 +1,64 @@
+"""SRV207 tier-codec bypass: a row leaves HBM for the host tier ONLY
+as ``pack_payload(request_meta(req), pool.row_state(slot))`` bytes —
+a raw ``row_state`` dict (or any name tainted by one) written into a
+block store skips the length-prefixed wire codec and is unreadable by
+every fetch path; and ``row_state`` AFTER ``pool.free`` serializes a
+recycled slot. Wrapper detection is one level deep (a helper whose
+parameter flows into a store ``.put()`` counts as a store write at its
+call sites); ``pack_payload`` output is the sanitizer."""
+
+from bigdl_tpu.serving.disagg import pack_payload, request_meta
+
+
+class BadSpiller:
+    def spill_param(self, store, key, payload):
+        store.put(key, payload)                       # EXPECT: SRV207
+
+    def spill_row_state(self, pool, slot, key):
+        state = self.block_store.put(key, None)       # benign self-put key
+        payload = pool.row_state(slot)
+        self.block_store.put(key, payload)            # EXPECT: SRV207
+        return state
+
+    def spill_copy(self, pool, slot, key):
+        payload = pool.row_state(slot)
+        blob = payload                                # taint rides the copy
+        self.store.put(key, blob)                     # EXPECT: SRV207
+
+    def spill_through_helper(self, pool, slot, key, payload):
+        self._write(key, payload)                     # EXPECT: SRV207
+
+    def _write(self, key, blob):
+        # one-level wrapper: parameter 1 flows into a store put, so
+        # call sites are store writes (this body is the modeled
+        # definition site — exempt itself)
+        self.store.put(key, blob)
+
+    def free_then_read(self, pool, sched, slot):
+        req = sched.running.pop(slot)
+        pool.free(slot)
+        payload = pool.row_state(slot)                # EXPECT: SRV207
+        return req, payload
+
+
+class GoodSpiller:
+    def spill_packed(self, pool, req, slot, key):
+        payload = pool.row_state(slot)
+        blob = pack_payload(request_meta(req), payload)   # the codec
+        self.store.put(key, blob)                     # sanctioned — fine
+
+    def pack_then_free(self, pool, req, slot):
+        payload = pool.row_state(slot)                # serialize FIRST
+        pool.free(slot)
+        return pack_payload(request_meta(req), payload)
+
+
+class MiniStore:
+    """A block store's own put: the VALUE param is store internals,
+    not a row payload — no taint, no finding."""
+
+    def __init__(self):
+        self._blobs = {}
+
+    def put(self, key, value):
+        self._blobs[key] = value

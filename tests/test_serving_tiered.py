@@ -1,0 +1,378 @@
+"""Host KV tier (bigdl_tpu/serving/kv_tier.py): byte-identity through
+spill→fetch across preemption, disagg handoff, and a mid-stream pool
+kill (greedy + fixed-seed sampled, fp32 + bf16, int8 KV scales riding
+along); prefix demote/promote refcount invariants + per-adapter
+namespacing; host-budget LRU eviction order (protect rule included);
+budget-evicted rows downgrading to byte-identical replay; the
+zero-extra-compiles guard; runtime-pinned tier metrics; and
+``mesh``-marked DP2 parity."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tiered
+
+
+def _make_lm(V=29, hidden=32, heads=4, layers=2, max_len=48, seed=9):
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random_gen import RNG
+
+    RNG.set_seed(seed)
+    lm = TransformerLM(V, hidden_size=hidden, n_heads=heads,
+                       n_layers=layers, max_len=max_len)
+    lm._ensure_params()
+    lm.evaluate()
+    return lm
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return _make_lm()
+
+
+def _run_preempt(lm, tier, **ekw):
+    """The canonical spill trace: two low-priority rows decode for a
+    few steps on a 2-slot pool, then two high-priority arrivals force
+    loss-free preemption — the evicted rows resume (from the tier, or
+    the legacy in-memory stash) and everything drains. Returns
+    ``(outputs-by-submission-index, engine)``."""
+    from bigdl_tpu.serving import SamplingParams, ServingEngine
+
+    eng = ServingEngine(lm, n_slots=2, policy="priority",
+                        preemption=True, tier=tier, **ekw)
+    rids = [
+        eng.submit([3, 7, 2], max_new_tokens=10, eos_id=1, priority=0),
+        eng.submit([4, 9, 6], max_new_tokens=10, eos_id=1, priority=0,
+                   sampling=SamplingParams(temperature=0.9, top_k=7,
+                                           seed=11)),
+    ]
+    for _ in range(3):
+        eng.step()
+    rids.append(eng.submit([5, 6, 8], max_new_tokens=6, eos_id=1,
+                           priority=5))
+    rids.append(eng.submit([2, 2, 3, 4], max_new_tokens=6, eos_id=1,
+                           priority=5,
+                           sampling=SamplingParams(temperature=0.8,
+                                                   top_p=0.9, seed=23)))
+    out = eng.drain()
+    return {i: np.asarray(out[r]) for i, r in enumerate(rids)}, \
+        {i: eng.logprobs(r) for i, r in enumerate(rids)}, eng
+
+
+def _assert_same(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+# -- spill→fetch byte-identity ----------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_preemption_spill_byte_identity(lm, dtype):
+    """A tiered engine's streams (greedy + fixed-seed sampled rows,
+    preempted mid-stream and resumed from HOST bytes) are byte-
+    identical to the legacy in-memory stash path — tokens AND chosen
+    logprobs — and the resumes really came from the tier without
+    re-prefill."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.serving import TieredKVStore
+
+    cd = jnp.bfloat16 if dtype == "bfloat16" else None
+    ref, ref_lp, ref_eng = _run_preempt(lm, None, compute_dtype=cd)
+    got, got_lp, eng = _run_preempt(lm, TieredKVStore(), compute_dtype=cd)
+    _assert_same(ref, got)
+    for k in ref_lp:
+        np.testing.assert_array_equal(ref_lp[k], got_lp[k])
+    s = eng.metrics.summary()
+    assert s["serving/preempted"] >= 2
+    assert s["serving/spills"] >= 2
+    assert s["serving/fetches"] >= 2
+    assert s["serving/resumed_without_prefill"] >= 2
+    # the tier-less engine has no spill counters at all
+    assert "serving/spills" not in ref_eng.metrics.summary()
+
+
+def test_int8_kv_scales_ride_the_spill(lm):
+    """int8 KV rows spill WITH their per-(slot, head) dequant scales:
+    the tiered engine reproduces the tier-less int8 stream bitwise
+    through preemption."""
+    ref, ref_lp, _ = _run_preempt(lm, None, kv_dtype="int8")
+    from bigdl_tpu.serving import TieredKVStore
+
+    got, got_lp, eng = _run_preempt(lm, TieredKVStore(), kv_dtype="int8")
+    _assert_same(ref, got)
+    assert eng.metrics.summary()["serving/resumed_without_prefill"] >= 2
+
+
+def test_zero_extra_compiled_programs(lm):
+    """The tier is HOST machinery: the tiered engine compiles exactly
+    as many decode programs as the tier-less one (the spill/fetch path
+    touches the device only through the same restore_row scatter the
+    stash path used)."""
+    from tests.compile_guards import compile_count
+
+    from bigdl_tpu.serving import TieredKVStore
+
+    _, _, ref_eng = _run_preempt(lm, None)
+    _, _, eng = _run_preempt(lm, TieredKVStore())
+    assert compile_count(eng._step_fn) == compile_count(ref_eng._step_fn)
+    assert compile_count(eng._batch_prefill_fn) == \
+        compile_count(ref_eng._batch_prefill_fn)
+
+
+def test_budget_evicted_row_downgrades_to_replay(lm):
+    """A spilled row whose bytes the budget evicted BEFORE readmission
+    replays from ``prompt + output`` — streams still byte-identical
+    (the PR 8 recovery contract), just without the resume shortcut."""
+    from bigdl_tpu.serving import TieredKVStore
+
+    ref, _, _ = _run_preempt(lm, None)
+    # budget far below one row's packed size: every spill is evicted
+    # as soon as the next one lands, and the last one (protect rule)
+    # is dropped by the currency check or served if still current
+    tier = TieredKVStore(host_budget_bytes=1024)
+    got, _, eng = _run_preempt(lm, tier)
+    _assert_same(ref, got)
+    s = eng.metrics.summary()
+    assert s["serving/spills"] >= 2
+    assert s["serving/tier_evictions"] >= 1
+    assert eng.tier.resident_bytes <= 1024 or eng.tier.entries <= 1
+
+
+# -- disagg: one tier for handoff staging, failover, preemption -------------
+
+@pytest.mark.disagg
+def test_disagg_handoff_and_pool_kill_byte_identity(lm):
+    """The disaggregated plane (always tiered now: the front-end
+    ``_stash`` dict and per-request blobs ARE the shared tier) serves
+    the monolithic streams through handoff AND a mid-stream pool kill,
+    and the tier drains to zero — no finished row's bytes linger (the
+    old stash-hygiene wart, fixed by drop-at-disposition)."""
+    from bigdl_tpu.serving import (
+        DisaggregatedEngine, SamplingParams, ServingEngine,
+    )
+
+    prompts = [[3, 7, 2], [4, 9, 6], [5, 6, 8], [2, 2, 3, 4]]
+
+    def submit_all(e):
+        rids = []
+        for i, p in enumerate(prompts):
+            sp = (SamplingParams(temperature=0.8, top_k=9, seed=100 + i)
+                  if i % 2 else None)
+            rids.append(e.submit(p, max_new_tokens=8, eos_id=1,
+                                 sampling=sp))
+        return rids
+
+    mono = ServingEngine(lm, n_slots=4)
+    r0 = submit_all(mono)
+    ref = mono.drain()
+
+    d = DisaggregatedEngine(lm, prefill_slots=2, decode_slots=2,
+                            decode_pools=2)
+    assert d.tier is d.prefill.engine.tier
+    assert all(d.tier is w.engine.tier for w in d.decoders)
+    r1 = submit_all(d)
+    for _ in range(4):
+        d.step()
+    d.kill_pool(0)
+    out = d.drain()
+    for a, b in zip(r0, r1):
+        np.testing.assert_array_equal(ref[a], out[b])
+    # drop-at-disposition: nothing survives the drain
+    assert d.tier.entries == 0
+    assert d.tier.resident_bytes == 0
+    s = d.metrics.summary()
+    assert s["serving/handoffs"] >= 4
+    assert s["serving/spills"] >= 4
+    assert s["serving/fetches"] >= 4
+
+
+# -- prefix-cache demote / promote ------------------------------------------
+
+def _carry(v, n=6):
+    import jax.numpy as jnp
+
+    return {"k0": (jnp.arange(n, dtype=jnp.float32) + v).reshape(1, n),
+            "pos": jnp.full((1,), n, jnp.int32)}
+
+
+def test_prefix_demote_promote_round_trip():
+    """Eviction of a refs==0 entry demotes its carry to the tier;
+    a later lookup promotes it back as an ordinary (possibly
+    truncated) hit with the SAME bytes — warm-prefix capacity is the
+    tier budget, not max_entries of HBM."""
+    from bigdl_tpu.serving import PrefixCache, TieredKVStore
+
+    tier = TieredKVStore()
+    pc = PrefixCache(max_entries=1, tier=tier)
+    pc.insert((3, 7, 2), _carry(0.0))
+    pc.insert((4, 9), _carry(100.0))       # evicts (3,7,2) -> demoted
+    assert tier.prefix_entries == 1
+    assert tier.stats()["spills"] == 1
+
+    carry, matched, lease = pc.acquire([3, 7, 2, 8])
+    assert matched == 3 and lease is not None
+    np.testing.assert_array_equal(np.asarray(carry["k0"]),
+                                  np.asarray(_carry(0.0)["k0"]))
+    # promotion CONSUMED the tier entry and re-inserted into HBM —
+    # which (max_entries=1) demoted the OTHER entry in turn
+    assert tier.prefix_entries == 1
+    assert tier.stats()["fetches"] == 1
+    pc.release(lease)
+
+
+def test_prefix_promotion_respects_refcounts_and_leases():
+    """A leased (refs>0) entry is never demoted; release() restores
+    demotability. The _drop path only ever sees refs==0 nodes, so a
+    demoted carry can never have a live lease pointing at freed
+    state."""
+    from bigdl_tpu.serving import PrefixCache, TieredKVStore
+
+    tier = TieredKVStore()
+    pc = PrefixCache(max_entries=1, tier=tier)
+    pc.insert((3, 7, 2), _carry(0.0))
+    carry, matched, lease = pc.acquire([3, 7, 2])
+    assert matched == 3
+    pc.insert((4, 9), _carry(100.0))       # over capacity, but leased
+    assert pc.entries == 2               # pinned entry survives
+    assert tier.prefix_entries == 0      # nothing demoted
+    pc.release(lease)
+    pc.insert((5, 5), _carry(200.0))       # now eviction can demote
+    assert tier.prefix_entries >= 1
+
+
+def test_prefix_demote_promote_is_adapter_namespaced():
+    """PR 16's namespacing survives the tier round-trip: a prefix
+    demoted under adapter 7 never promotes into adapter 0's lookups."""
+    from bigdl_tpu.serving import PrefixCache, TieredKVStore
+
+    tier = TieredKVStore()
+    pc = PrefixCache(max_entries=1, tier=tier)
+    pc.insert((3, 7, 2), _carry(0.0), adapter_id=7)
+    pc.insert((4, 9), _carry(100.0), adapter_id=7)   # demotes under 7
+    assert tier.prefix_entries == 1
+    carry, matched, lease = pc.acquire([3, 7, 2], adapter_id=0)
+    assert carry is None and matched == 0 and lease is None
+    carry, matched, _ = pc.acquire([3, 7, 2], adapter_id=7)
+    assert matched == 3
+    np.testing.assert_array_equal(np.asarray(carry["k0"]),
+                                  np.asarray(_carry(0.0)["k0"]))
+
+
+# -- budget / LRU mechanics -------------------------------------------------
+
+def test_host_budget_evicts_lru_first():
+    """Entries leave the tier coldest-first, touching an entry
+    refreshes it, and the one-over-budget entry a put just protected
+    survives (the single-blob grace that keeps put->fetch of an
+    oversized row loss-free)."""
+    from bigdl_tpu.serving import TieredKVStore
+
+    tier = TieredKVStore()
+    pc_blobs = []
+    for v in range(3):
+        tier.demote_prefix((v + 1, v + 2), _carry(float(v)))
+        pc_blobs.append(tier.resident_bytes)
+    per = pc_blobs[0]
+    assert tier.entries == 3
+
+    # budget for exactly two entries: the OLDEST goes
+    tier2 = TieredKVStore(host_budget_bytes=int(per * 2.5))
+    tier2.demote_prefix((1, 2), _carry(0.0))
+    tier2.demote_prefix((3, 4), _carry(1.0))
+    # touch (1,2) so (3,4) becomes the LRU victim
+    assert tier2.promote_prefix((1, 2), 0) is not None
+    tier2.demote_prefix((1, 2), _carry(0.0))    # back in, freshest
+    tier2.demote_prefix((5, 6), _carry(2.0))    # over budget -> evict
+    assert tier2.stats()["evictions"] >= 1
+    assert tier2.promote_prefix((3, 4), 0) is None      # evicted
+    assert tier2.promote_prefix((5, 6), 0) is not None  # survived
+
+    # protect rule: a budget below ONE entry still keeps the newest
+    tier3 = TieredKVStore(host_budget_bytes=max(1, per // 2))
+    tier3.demote_prefix((1, 2), _carry(0.0))
+    assert tier3.entries == 1
+    assert tier3.promote_prefix((1, 2), 0) is not None
+
+    with pytest.raises(ValueError):
+        TieredKVStore(host_budget_bytes=0)
+
+
+def test_stale_row_entry_is_dropped_not_served(lm):
+    """The currency check: a tier row whose header ``output`` no
+    longer matches the request (the row decoded past its spill) is
+    DROPPED at fetch — readmission replays instead of restoring stale
+    bytes."""
+    from bigdl_tpu.serving import ServingEngine, TieredKVStore
+    from bigdl_tpu.serving.scheduler import Request
+
+    tier = TieredKVStore()
+    eng = ServingEngine(lm, n_slots=2, tier=tier)
+    rid = eng.submit([3, 7, 2], max_new_tokens=6, eos_id=1)
+    eng.step()
+    eng.step()
+    (slot, req), = eng.scheduler.running.items()
+    assert req.req_id == rid
+    tier.put_row(req, eng.pool.row_state(slot))
+    assert tier.has_row(rid)
+    req.output.append(4)               # the row decodes past the spill
+    assert tier.fetch_row(req) is None
+    assert not tier.has_row(rid)       # dropped, not kept stale
+    req.output.pop()
+
+    # meta-only blobs (failover replay forms) fetch as None too
+    from bigdl_tpu.serving.disagg import pack_payload, request_meta
+    tier.put_packed(pack_payload(request_meta(req), None), req_id=rid)
+    assert tier.fetch_row(req) is None
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_tier_metrics_runtime_pinned(lm):
+    """The new counters are pinned against the engine's actual
+    behavior: spills == tier-store writes of row bytes, every resumed
+    row fetched, the tier_bytes gauge returns to zero after drain, and
+    the summary derivations exist iff their inputs do."""
+    from bigdl_tpu.serving import TieredKVStore
+
+    tier = TieredKVStore()
+    _, _, eng = _run_preempt(lm, tier)
+    s = eng.metrics.summary()
+    st = tier.stats()
+    assert s["serving/spills"] == st["spills"] > 0
+    assert s["serving/fetches"] == st["fetches"] > 0
+    assert s["serving/spill_bytes"] == st["spill_bytes"] > 0
+    assert s["serving/fetch_bytes"] == st["fetch_bytes"] > 0
+    assert s["serving/tier_bytes"] == 0.0          # drained clean
+    assert s["serving/resumed_without_prefill"] >= 2
+    assert s["serving/spill_bytes_per_row"] == \
+        pytest.approx(st["spill_bytes"] / st["spills"])
+    assert s["serving/fetch_p99_s"] >= 0.0
+    # tier-less runs surface none of the TIER keys (the legacy stash
+    # still counts resumed_without_prefill — that counter describes
+    # the resume contract, not the tier)
+    _, _, ref = _run_preempt(lm, None)
+    rs = ref.metrics.summary()
+    for k in ("serving/spills", "serving/fetches", "serving/spill_bytes",
+              "serving/tier_evictions", "serving/spill_bytes_per_row",
+              "serving/fetch_p99_s"):
+        assert k not in rs, k
+    assert rs["serving/resumed_without_prefill"] >= 2
+
+
+# -- DP2 mesh parity --------------------------------------------------------
+
+@pytest.mark.mesh
+def test_tiered_dp2_parity(lm):
+    """The tier composes with slot-data-parallel serving: a DP2 tiered
+    engine reproduces the unsharded tier-less streams token for token
+    through preemption (spill packs the mesh pool's row_state, restore
+    scatters back onto the owning shard)."""
+    from bigdl_tpu.serving import TieredKVStore
+
+    ref, _, _ = _run_preempt(lm, None)
+    got, _, eng = _run_preempt(lm, TieredKVStore(),
+                               parallelism={"data": 2})
+    _assert_same(ref, got)
+    assert eng.metrics.summary()["serving/resumed_without_prefill"] >= 2

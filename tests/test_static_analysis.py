@@ -32,7 +32,7 @@ ALL_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305",
              "MH401", "MH402", "MH403", "MH404", "MH405",
              "SPMD101", "SPMD102", "SPMD103", "SPMD104", "SPMD105",
              "SPMD106", "SRV201", "SRV202", "SRV203", "SRV204", "SRV205",
-             "SRV206")
+             "SRV206", "SRV207")
 ASY_CODES = ["ASY301", "ASY302", "ASY303", "ASY304", "ASY305"]
 MH_CODES = ["MH401", "MH402", "MH403", "MH404", "MH405"]
 
@@ -345,6 +345,28 @@ def test_srv206_real_tree_clean_and_mutation_caught(tmp_path):
     assert [f.code for f in found] == ["SRV206"], \
         [f.format() for f in found]
     assert found[0].path.endswith("disagg.py")
+
+
+def test_srv207_real_tree_clean_and_mutation_caught(tmp_path):
+    """SRV207 census over the REAL serving tree: the unmutated copy
+    scans clean (every block-store write of row state rides the
+    pack_payload codec, and every spill site serializes BEFORE
+    freeing), and stripping the codec call at THE row-spill site
+    (TieredKVStore.put_row) yields exactly one SRV207 at kv_tier.py —
+    the tier-codec discipline is enforced where the spill machinery
+    actually lives, not just on fixtures."""
+    tree = _serving_tree(tmp_path)
+    clean = analyze_paths([str(tmp_path)], select=["SRV207"])
+    assert clean == [], [f.format() for f in clean]
+    src = (tree / "kv_tier.py").read_text()
+    needle = "blob = pack_payload(request_meta(req), payload)"
+    assert needle in src, "put_row moved — update the census"
+    (tree / "kv_tier.py").write_text(
+        src.replace(needle, "blob = payload", 1))
+    found = analyze_paths([str(tmp_path)], select=["SRV207"])
+    assert [f.code for f in found] == ["SRV207"], \
+        [f.format() for f in found]
+    assert found[0].path.endswith("kv_tier.py")
 
 
 def test_srv205_reads_real_vocabulary():
