@@ -10,7 +10,7 @@ SPMD103     recompile hazards in/around jitted programs
 SPMD104     donated buffer reused after the donating call
 SPMD105     Python control flow on traced values
 SPMD106     shard_map specs naming axes the mesh does not have
-SRV201-207  serving contracts (whole-program fact table)
+SRV201-208  serving contracts (whole-program fact table)
 ASY301-305  async readiness: host-sync hygiene on the HOT PATH, scoped
             by call-graph reachability from the serving super-step
             roots (core.hotpath_chains)
@@ -1791,6 +1791,122 @@ class TierCodecBypassRule(Rule):
                     f"row_state(`{name}`) on line {sub.lineno} reads a "
                     f"slot freed on line {freed[name]} — the slot may "
                     f"already be recycled; serialize BEFORE freeing",
+                    hint=self.hint)
+
+
+# -- SRV208 — undeclared actuation ------------------------------------------
+
+#: the serving plane's runtime CONTROL KNOBS — per-row / per-admitter
+#: host fields the autopilot's actuator bus owns. An attribute WRITE to
+#: one of these outside the declared ACTUATION_SITES (or a constructor)
+#: is an undeclared actuation: it moves a knob the audit log never sees
+_KNOB_ATTRS = frozenset({"chunk_budget", "max_new_tokens",
+                         "draft_tokens", "draft_cap", "degrade_at",
+                         "degraded"})
+#: pool lifecycle transitions — actuations spelled as CALLS, not writes
+_KNOB_CALLS = frozenset({"_activate_pool", "drain_pool"})
+#: fallback ACTUATION_SITES vocabulary (single-file fixture runs): must
+#: match serving/autopilot.py ACTUATION_SITES
+_DEFAULT_ACTUATION_SITES = frozenset({
+    "autopilot.ActuatorBus.set_chunk_budget",
+    "autopilot.ActuatorBus.set_draft_cap",
+    "autopilot.ActuatorBus.degrade_waiting",
+    "autopilot.ActuatorBus.restore_waiting",
+    "engine.ServingEngine._apply_degrade",
+    "engine.ServingEngine._restore_degrade",
+    "disagg.DisaggregatedEngine._autoscale",
+    "disagg.DisaggregatedEngine._failover_pool",
+})
+
+
+@_register_facts
+def _actuation_site_facts(ctx: FileContext) -> Dict:
+    """The declared actuator vocabulary (``ACTUATION_SITES``) —
+    SRV208's ground truth, extracted the way MH403 reads CLOCK_SITES."""
+    for node in ctx.by_type(ast.Assign):
+        if not any(isinstance(t, ast.Name) and t.id == "ACTUATION_SITES"
+                   for t in node.targets):
+            continue
+        val = literal_value(node.value)
+        if val is not UNRESOLVED:
+            return {"actuation_sites": sorted(val)}
+    return {}
+
+
+def _actuation_sites(ctx: FileContext) -> Set[str]:
+    sites = _facts(ctx).get("actuation_sites")
+    return set(sites) if sites else set(_DEFAULT_ACTUATION_SITES)
+
+
+@register
+class UndeclaredActuationRule(Rule):
+    code = "SRV208"
+    name = "undeclared-actuation"
+    summary = ("serving control knob mutated (chunk_budget / degrade "
+               "fields / draft cap / pool activate-drain) outside the "
+               "declared ACTUATION_SITES vocabulary")
+    hint = ("every runtime knob the control plane moves — the chunked "
+            "admitter's budget, a request's degrade fields, the "
+            "speculative draft cap, pool activation/drain — goes "
+            "through the declared actuator API "
+            "(serving/autopilot.py ACTUATION_SITES, the FENCE_SITES / "
+            "CLOCK_SITES pattern), so every actuation lands in the "
+            "bus's audit log and hysteresis owns the cadence. A knob "
+            "assigned anywhere else is an invisible actuation: it "
+            "fights the controllers, skips the log, and breaks the "
+            "replay story. Route it through ActuatorBus (or the "
+            "engine's _apply_degrade/_restore_degrade), or — for a "
+            "genuinely new actuator — add its unit to ACTUATION_SITES "
+            "first (a reviewable one-line diff). Constructors are "
+            "exempt: setting a knob's INITIAL value is configuration, "
+            "not actuation")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not (_in_serving_tree(ctx) or _defines_dispatch(ctx)):
+            return
+        sites = _actuation_sites(ctx)
+
+        def undeclared(node) -> Optional[str]:
+            """The enclosing unit's qualname when the node sits outside
+            every declared site (None = sanctioned). Module/class-body
+            statements (dataclass field defaults) are declarations, not
+            actuations, and constructors set initial values."""
+            unit = enclosing_unit(ctx, node)
+            if unit is None:
+                return None
+            uq = unit[0]
+            if uq.rsplit(".", 1)[-1] in ("__init__", "__post_init__"):
+                return None
+            if any(uq == s or uq.endswith("." + s) for s in sites):
+                return None
+            return uq
+
+        for node in ctx.by_type(ast.Assign, ast.AnnAssign, ast.AugAssign):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in _KNOB_ATTRS):
+                    continue
+                uq = undeclared(node)
+                if uq is not None:
+                    yield ctx.finding(
+                        node, self.code,
+                        f"control knob `.{tgt.attr}` assigned in "
+                        f"`{uq}` — outside the declared "
+                        f"ACTUATION_SITES vocabulary",
+                        hint=self.hint)
+        for node in ctx.by_type(ast.Call):
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KNOB_CALLS):
+                continue
+            uq = undeclared(node)
+            if uq is not None:
+                yield ctx.finding(
+                    node, self.code,
+                    f"pool lifecycle actuation `.{node.func.attr}()` "
+                    f"in `{uq}` — outside the declared "
+                    f"ACTUATION_SITES vocabulary",
                     hint=self.hint)
 
 

@@ -61,6 +61,9 @@ See ``docs/serving.md``.
 from bigdl_tpu.serving.admission import (
     AdmissionController, Degrade, bucket_len,
 )
+from bigdl_tpu.serving.autopilot import (
+    ACTUATION_SITES, ActuatorBus, Autopilot, AutopilotConfig, Controller,
+)
 from bigdl_tpu.serving.chunked import ChunkedAdmissionController
 from bigdl_tpu.serving.constrain import (
     ConstraintCursor, ConstraintError, TokenDFA, fixed_sequence,
@@ -77,7 +80,7 @@ from bigdl_tpu.serving.health import (
 )
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.faults import (
-    FaultError, FaultInjector, VirtualClock, WatchdogConfig,
+    FaultError, FaultInjector, SteppingClock, VirtualClock, WatchdogConfig,
 )
 from bigdl_tpu.serving.fences import FENCE_SITES, fence, fence_wait
 from bigdl_tpu.serving.kv_pool import KVPool
@@ -107,4 +110,6 @@ __all__ = ["ServingEngine", "KVPool", "ServingMetrics", "Request",
            "TransferRetryConfig", "AutoscalerConfig",
            "OccupancyAutoscaler", "AdapterBank", "AdapterSpec",
            "TokenDFA", "ConstraintCursor", "ConstraintError",
-           "fixed_sequence", "from_token_sets", "TieredKVStore"]
+           "fixed_sequence", "from_token_sets", "TieredKVStore",
+           "ACTUATION_SITES", "ActuatorBus", "Autopilot",
+           "AutopilotConfig", "Controller", "SteppingClock"]

@@ -70,7 +70,13 @@ class Degrade:
     already-compiled programs, so degrading traffic never recompiles —
     the same shape-stability rule every serving knob follows. ``None``
     fields leave the request untouched; a request with no ``degrade``
-    attached is never degraded."""
+    attached is never degraded.
+
+    The clamp is REVERTIBLE (PR 19): the engine's one degrade writer
+    records the request's original limits, and when pressure drops
+    while the row still WAITS (the static ``degrade_at`` path, or the
+    autopilot's ``restore_waiting`` actuator) the originals come back
+    — a burst's degrade must not outlive the burst."""
 
     max_new_tokens: Optional[int] = None
     draft_tokens: Optional[int] = None

@@ -1,0 +1,507 @@
+"""SLO autopilot: the closed control loop over the serving engine.
+
+PRs 8-18 built every sensor (goodput, decode-gap p99, accept rate,
+occupancy, queue depth — ``serving/metrics.py``) and every actuator
+(loss-free preemption, ``Degrade``, ``chunk_budget``, the speculative
+draft budget, the pool autoscaler) but ran them all on static knobs.
+This module closes the loop the BigDL way (SoCC'19: cluster behavior
+driven from runtime-observed state, not operator constants): a
+host-only controller — no jax imports, like ``health.py`` — sampled
+ONCE per engine super-step on the ENGINE clock, so a VirtualClock
+test drives the whole loop without sleeping.
+
+Three pieces:
+
+* :class:`Controller` — the dead-band / sustain / cooldown hysteresis
+  discipline ``OccupancyAutoscaler`` shipped in PR 14, generalized so
+  every knob's control loop shares ONE flap-freedom argument (the
+  autoscaler is now a subclass — ``health.py``). A signal must sit
+  past a waterline for ``sustain`` CONSECUTIVE samples before an
+  action fires, the dead band between the waterlines resets both
+  runs, and ``cooldown`` samples must pass after ANY action before
+  the next — so a boundary-riding signal can never flap an actuator.
+
+* :class:`ActuatorBus` — the ONE declared write surface for engine
+  knobs. Every mutation the autopilot can make (``chunk_budget``, the
+  per-class ``Degrade`` apply/restore, the speculative draft cap, the
+  pool scale decision log) goes through a bus method listed in
+  ``ACTUATION_SITES`` below; the analyzer's SRV208 rule flags any
+  knob mutation OUTSIDE this vocabulary (the FENCE_SITES/CLOCK_SITES
+  closed-vocabulary pattern applied to control authority). Every
+  actuation is host bookkeeping over per-row runtime data — the
+  compiled-program set is untouched by construction, and
+  test-pinned (tests/test_serving_autopilot.py).
+
+* :class:`Autopilot` — the per-step sample() that reads WINDOWED
+  metrics (``ServingMetrics.window`` — bounded recency, not whole-run
+  percentiles) and drives the controllers, plus the deadline-aware
+  preemption policy: with a measured per-token service-time estimate
+  in hand, a short-deadline FEASIBLE waiter that would miss while a
+  long-deadline row holds its slot evicts that row — preemption is
+  loss-free (``ServingEngine._preempt_row``), so this reorders
+  latency, never tokens. The same estimate folds into the scheduler's
+  priority key as a least-laxity term (``Scheduler.service_estimate``).
+
+Wiring: ``ServingEngine(..., autopilot=Autopilot())`` attaches the
+bus and samples the loop at the end of every ``step()``;
+``DisaggregatedEngine(..., autopilot=...)`` registers its
+``OccupancyAutoscaler`` on the bus so pool scale-up/down rides the
+same actuation log.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: The closed actuation vocabulary — the ONLY units allowed to mutate
+#: engine/scheduler knobs (`chunk_budget`, degrade fields, draft
+#: budgets) or drive the pool lifecycle (`_activate_pool`,
+#: `drain_pool`) outside `__init__`. The analyzer's SRV208 rule flags
+#: knob mutations in the serving plane outside these units; a
+#: genuinely new actuator must be added here FIRST — a reviewable
+#: one-line diff (the FENCE_SITES / CLOCK_SITES discipline applied to
+#: control authority).
+ACTUATION_SITES = frozenset({
+    "autopilot.ActuatorBus.set_chunk_budget",   # chunked pump budget
+    "autopilot.ActuatorBus.set_draft_cap",      # speculative k ceiling
+    "autopilot.ActuatorBus.degrade_waiting",    # per-class Degrade apply
+    "autopilot.ActuatorBus.restore_waiting",    # per-class Degrade revert
+    "engine.ServingEngine._apply_degrade",      # the one degrade writer
+    "engine.ServingEngine._restore_degrade",    # the one degrade restorer
+    "disagg.DisaggregatedEngine._autoscale",    # pool scale execution
+    "disagg.DisaggregatedEngine._failover_pool",  # death rescue: standby activation
+})
+
+
+class Controller:
+    """Dead-band / sustain / cooldown hysteresis over ONE scalar signal.
+
+    The exact discipline :class:`~bigdl_tpu.serving.health.
+    OccupancyAutoscaler` shipped (and the failover bench asserts
+    flap-free), factored out so every autopilot knob shares it: a
+    sample at or past ``high_water`` extends the high run, at or below
+    ``low_water`` the low run, anywhere in the dead band between
+    resets BOTH (hysteresis demands consecutive evidence). An action
+    fires only after ``sustain`` consecutive same-side samples AND
+    ``cooldown`` samples since the last action — born ready, so the
+    first action needs no cooldown to expire. Pure host arithmetic:
+    deterministic given the signal series, which is what lets tests
+    assert flap-freedom instead of eyeballing it.
+
+    ``observe`` returns ``"up"`` (signal high), ``"down"`` (signal
+    low), or None; what "up" MEANS (shrink a budget, add a pool) is
+    the caller's mapping — the controller only owns the debounce.
+    """
+
+    def __init__(self, high_water: float, low_water: float,
+                 sustain: int = 3, cooldown: int = 8) -> None:
+        if not low_water < high_water:
+            raise ValueError(
+                f"need low_water < high_water, got "
+                f"{low_water}/{high_water}")
+        if sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {sustain}")
+        if cooldown < 0:
+            raise ValueError(f"cooldown must be >= 0, got {cooldown}")
+        self.high_water = float(high_water)
+        self.low_water = float(low_water)
+        self.sustain = int(sustain)
+        self.cooldown = int(cooldown)
+        self._hi_run = 0
+        self._lo_run = 0
+        # born ready: the first action needs no cooldown to expire
+        self._since_action = self.cooldown
+
+    def observe(self, signal: float, can_up: bool = True,
+                can_down: bool = True,
+                hold_down: bool = False) -> Optional[str]:
+        """One control sample. ``can_up``/``can_down`` gate on what
+        the actuator can actually do (a budget already at its bound, no
+        standby pool); ``hold_down`` vetoes the LOW side only (the
+        autoscaler's backlogged-lull case: a low signal with queued
+        work means admission is catching up, not that capacity is
+        idle)."""
+        if signal >= self.high_water:
+            self._hi_run += 1
+            self._lo_run = 0
+        elif signal <= self.low_water and not hold_down:
+            self._lo_run += 1
+            self._hi_run = 0
+        else:
+            # the dead band (or a vetoed lull): both runs restart —
+            # hysteresis demands CONSECUTIVE evidence
+            self._hi_run = 0
+            self._lo_run = 0
+        self._since_action += 1
+        if self._since_action <= self.cooldown:
+            return None
+        if self._hi_run >= self.sustain and can_up:
+            self._act()
+            return "up"
+        if self._lo_run >= self.sustain and can_down:
+            self._act()
+            return "down"
+        return None
+
+    def _act(self) -> None:
+        self._hi_run = 0
+        self._lo_run = 0
+        self._since_action = 0
+
+
+@dataclass(frozen=True)
+class AutopilotConfig:
+    """Setpoints for the closed loop — each controller's waterlines
+    plus the shared debounce.
+
+    Chunk-budget loop: signal = windowed decode-gap p99 over
+    ``gap_target_s`` (ratio > ``gap_high`` sustained → halve the
+    pump's budget toward ``chunk_min``; ratio < ``gap_low`` with
+    prompts still queued → double it toward ``chunk_max``). Degrade
+    loop: signal = live queue depth (past ``queue_high`` sustained →
+    apply each WAITING row's submitted ``Degrade`` knob for classes at
+    or below ``degrade_below_priority``; below ``queue_low`` → restore
+    the recorded originals for rows still waiting). Draft loop
+    (speculative engines): signal = windowed accept rate (below
+    ``accept_low`` sustained → drop the engine-wide draft cap one
+    toward 0, drafting that misses wastes verify width; above
+    ``accept_high`` → raise it one toward the engine's k). Deadline
+    preemption: ``preempt_margin_s`` pads the would-miss test so a
+    waiter on the knife edge does not trigger an eviction its own
+    seating latency would waste."""
+
+    gap_target_s: float = 0.05
+    gap_high: float = 2.0
+    gap_low: float = 0.5
+    chunk_min: int = 8
+    chunk_max: int = 256
+    queue_high: float = 6.0
+    queue_low: float = 1.0
+    degrade_below_priority: int = 0
+    accept_high: float = 0.7
+    accept_low: float = 0.3
+    sustain: int = 3
+    cooldown: int = 8
+    window: int = 64
+    preempt: bool = True
+    preempt_margin_s: float = 0.0
+
+    def __post_init__(self):
+        if self.gap_target_s <= 0:
+            raise ValueError(
+                f"gap_target_s must be positive, got {self.gap_target_s}")
+        for lo, hi, what in ((self.gap_low, self.gap_high, "gap"),
+                             (self.queue_low, self.queue_high, "queue"),
+                             (self.accept_low, self.accept_high,
+                              "accept")):
+            if not lo < hi:
+                raise ValueError(
+                    f"need {what}_low < {what}_high, got {lo}/{hi}")
+        if not 1 <= self.chunk_min <= self.chunk_max:
+            raise ValueError(
+                f"need 1 <= chunk_min <= chunk_max, got "
+                f"{self.chunk_min}/{self.chunk_max}")
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.sustain < 1:
+            raise ValueError(f"sustain must be >= 1, got {self.sustain}")
+        if self.cooldown < 0:
+            raise ValueError(
+                f"cooldown must be >= 0, got {self.cooldown}")
+        if self.preempt_margin_s < 0:
+            raise ValueError(
+                f"preempt_margin_s must be >= 0, got "
+                f"{self.preempt_margin_s}")
+
+
+class ActuatorBus:
+    """The declared write surface for engine knobs (module docstring).
+
+    Every method here is listed in ``ACTUATION_SITES`` — SRV208 flags
+    knob mutations anywhere else in the serving plane. Each actuation
+    is appended to ``self.log`` as ``(sample_no, actuator, value)``
+    and counted on the metrics plane (``serving/actuations``), so
+    tests assert flap-freedom from the log instead of instrumenting
+    the engine."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self.log: List[Tuple[int, str, object]] = []
+        self._sample_no = 0
+
+    def _record(self, actuator: str, value) -> None:
+        self.log.append((self._sample_no, actuator, value))
+        self.engine.metrics.on_actuation(actuator)
+
+    def set_chunk_budget(self, n: int) -> bool:
+        """Set the chunked pump's per-step prompt-token budget — read
+        fresh each ``pump()``, so the new value takes effect next
+        step. No-op (False) on non-chunked engines or when already
+        there."""
+        adm = self.engine.admitter
+        if adm is None or not hasattr(adm, "chunk_budget"):
+            return False
+        n = max(1, int(n))
+        if adm.chunk_budget == n:
+            return False
+        adm.chunk_budget = n
+        self._record("chunk_budget", n)
+        return True
+
+    def set_draft_cap(self, n: Optional[int]) -> bool:
+        """Set the engine-wide ceiling on the speculative draft count
+        (None = the configured k). Per-row ``draft_tokens`` hints
+        still apply below it — the cap is runtime data the next
+        super-step's ``_draft_budget`` reads, never a recompile."""
+        n = None if n is None else max(0, int(n))
+        if self.engine.draft_cap == n:
+            return False
+        self.engine.draft_cap = n
+        self._record("draft_cap", n)
+        return True
+
+    def degrade_waiting(self, below_priority: int = 0) -> int:
+        """Apply each WAITING request's submitted ``Degrade`` knob for
+        priority classes AT OR BELOW ``below_priority`` (per-class
+        pressure relief: the interactive tier keeps its budget while
+        the batch tier sheds decode work). Originals are recorded on
+        the request — :meth:`restore_waiting` reverts them while the
+        row still waits. Returns how many rows were degraded."""
+        eng = self.engine
+        n = 0
+        for req in eng.scheduler.iter_waiting():
+            if req.priority <= below_priority and \
+                    eng._apply_degrade(req):
+                n += 1
+        if n:
+            self._record("degrade", n)
+        return n
+
+    def restore_waiting(self, below_priority: Optional[int] = None) -> int:
+        """Revert :meth:`degrade_waiting` (and the static
+        ``degrade_at`` path) for rows STILL WAITING: each degraded
+        waiter gets its recorded original ``max_new_tokens`` /
+        ``draft_tokens`` back. Rows already seated keep their caps —
+        their budget was already priced into admission. Returns how
+        many rows were restored."""
+        eng = self.engine
+        n = 0
+        for req in eng.scheduler.iter_waiting():
+            if below_priority is not None and \
+                    req.priority > below_priority:
+                continue
+            if eng._restore_degrade(req):
+                n += 1
+        if n:
+            self._record("restore", n)
+        return n
+
+    def note_pool_scale(self, direction: str) -> None:
+        """Log a pool scale decision executed by the disaggregated
+        front end (``DisaggregatedEngine._autoscale`` remains the
+        executing site — it owns the pool tables; the bus owns the
+        record, so pool actuations and knob actuations share one
+        audit stream)."""
+        self._record("pool_scale", direction)
+
+
+class Autopilot:
+    """The per-step control loop (module docstring): windowed sensors
+    → hysteresis controllers → bus actuations, plus the deadline-aware
+    preemption policy the engine's ``_admit`` consults. Attach via
+    ``ServingEngine(..., autopilot=Autopilot())``; one instance per
+    engine (the bus binds to it)."""
+
+    def __init__(self, config: Optional[AutopilotConfig] = None) -> None:
+        self.config = cfg = config if config is not None \
+            else AutopilotConfig()
+        self.bus: Optional[ActuatorBus] = None
+        # one Controller per knob — the shared flap-freedom argument
+        self._chunk = Controller(cfg.gap_high, cfg.gap_low,
+                                 cfg.sustain, cfg.cooldown)
+        self._load = Controller(cfg.queue_high, cfg.queue_low,
+                                cfg.sustain, cfg.cooldown)
+        # accept-rate loop: HIGH accept = raise the cap, LOW = cut it
+        self._draft = Controller(cfg.accept_high, cfg.accept_low,
+                                 cfg.sustain, cfg.cooldown)
+        #: externally registered controllers (the disagg front end
+        #: registers its OccupancyAutoscaler here) — name -> Controller
+        self.controllers: Dict[str, Controller] = {
+            "chunk_budget": self._chunk, "degrade": self._load,
+            "draft_cap": self._draft}
+        self._n_samples = 0
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach(self, engine) -> "Autopilot":
+        """Bind the bus to ``engine`` and fold the measured service-
+        time estimate into its scheduler's priority key (least-laxity
+        EDF: a waiter's urgency is its deadline minus the time its
+        remaining budget needs — ``Scheduler.service_estimate``)."""
+        if self.bus is not None and self.bus.engine is not engine:
+            raise ValueError(
+                "this Autopilot is already attached to another engine "
+                "— one instance per engine (the bus binds to it)")
+        self.bus = ActuatorBus(engine)
+        engine.scheduler.service_estimate = \
+            engine.metrics.service_time_estimate
+        return self
+
+    def register_controller(self, name: str,
+                            controller: Controller) -> None:
+        """Adopt an externally built controller (the disagg pool
+        scaler) so its hysteresis state shows up in the one
+        controller registry the tests and reports read."""
+        self.controllers[name] = controller
+
+    # -- the per-step sample -------------------------------------------------
+
+    def sample(self, engine) -> None:
+        """ONE control sample, called by the engine at the end of
+        every ``step()`` — engine clock, engine metrics, host
+        bookkeeping only. Idle steps sample too: pressure RELIEF
+        (degrade restore, budget re-growth) mostly happens in lulls,
+        exactly when no decode dispatch lands new metric samples —
+        which is why the degrade loop reads the LIVE queue depth
+        rather than the step-sampled series."""
+        cfg = self.config
+        bus = self.bus
+        if bus is None or bus.engine is not engine:
+            raise ValueError("autopilot not attached to this engine "
+                             "(pass autopilot= at engine construction)")
+        bus._sample_no = self._n_samples
+        m = engine.metrics
+
+        # chunk budget <- windowed decode-gap p99 vs target: a gap
+        # ratio sustained above gap_high means prefill chunks are
+        # stalling decode (halve the pump's budget); sustained below
+        # gap_low WITH prompts still queued means admission has
+        # headroom (double it)
+        adm = engine.admitter
+        if adm is not None and hasattr(adm, "chunk_budget"):
+            gap = m.window("decode_gap_s", cfg.window)
+            if gap is not None:
+                ratio = gap["p99"] / cfg.gap_target_s
+                d = self._chunk.observe(
+                    ratio,
+                    can_up=adm.chunk_budget > cfg.chunk_min,
+                    can_down=(adm.chunk_budget < cfg.chunk_max
+                              and engine.scheduler.queue_depth > 0))
+                if d == "up":
+                    bus.set_chunk_budget(
+                        max(cfg.chunk_min, adm.chunk_budget // 2))
+                elif d == "down":
+                    bus.set_chunk_budget(
+                        min(cfg.chunk_max, adm.chunk_budget * 2))
+
+        # per-class Degrade <- live queue depth (sustain IS the
+        # window here — see the docstring)
+        d = self._load.observe(float(engine.scheduler.queue_depth))
+        if d == "up":
+            bus.degrade_waiting(cfg.degrade_below_priority)
+        elif d == "down":
+            bus.restore_waiting()
+
+        # draft cap <- windowed accept rate (speculative engines): a
+        # rate sustained below accept_low means drafts are dying at
+        # verify (cut the cap one), above accept_high means the cap is
+        # leaving accepted tokens on the table (raise it one)
+        spec = getattr(engine, "_spec", None)
+        if spec is not None:
+            drafted = m.window("draft_tokens", cfg.window)
+            accepted = m.window("accepted_tokens", cfg.window)
+            if drafted is not None and drafted["mean"] > 0:
+                rate = (accepted["mean"] / drafted["mean"]
+                        if accepted is not None else 0.0)
+                cap = engine.draft_cap
+                cur = spec.k if cap is None else cap
+                d = self._draft.observe(rate,
+                                        can_up=cur < spec.k,
+                                        can_down=cur > 0)
+                if d == "up":
+                    bus.set_draft_cap(
+                        None if cur + 1 >= spec.k else cur + 1)
+                elif d == "down":
+                    bus.set_draft_cap(cur - 1)
+
+        self._n_samples += 1
+
+    # -- deadline-aware preemption -------------------------------------------
+
+    def deadline_victims(self, engine, now: float) -> List:
+        """RUNNING rows to evict so short-deadline feasible waiters
+        seat in time — consulted by the engine's ``_admit`` after the
+        static priority-demand loop (so cross-CLASS preemption keeps
+        its existing semantics; this adds the within/lower-class
+        deadline trade).
+
+        A waiter triggers only when ALL hold: it has a deadline; it is
+        FEASIBLE if seated now (``now + est*rem <= deadline`` — an
+        infeasible waiter is the shed path's problem, evicting for it
+        wastes a replay); no free slot will seat it anyway; and
+        waiting one victim-completion would make it miss (the
+        would-otherwise-miss test, padded by ``preempt_margin_s``).
+        The victim is the running row with the MOST deadline slack
+        (no-deadline rows = infinite slack), never from a higher
+        priority class, and only when the trade is strictly sound:
+        the victim's slack after the detour still exceeds what the
+        waiter has now. Deterministic: ties break by arrival order.
+        Preemption is loss-free, so a mis-estimate costs latency,
+        never tokens."""
+        cfg = self.config
+        if not cfg.preempt:
+            return []
+        est = engine.metrics.service_time_estimate()
+        if est is None or est <= 0:
+            return []
+        sched = engine.scheduler
+        running = list(sched.running.values())
+        if not running:
+            return []
+
+        def rem(req) -> int:
+            return max(1, req.max_new_tokens - len(req.output))
+
+        free = engine.pool.free_slots
+        victims: List = []
+        taken = set()
+        for w in sched.peek_waiting(len(running) + free):
+            dl = w.deadline_time
+            if dl is None:
+                continue
+            slack_w = dl - now - est * rem(w)
+            if slack_w < 0:
+                continue                    # infeasible even seated now
+            if free > 0:
+                free -= 1                   # this admit round seats it
+                continue
+            # would it still make its deadline after ONE victim
+            # completion? the shortest-remaining running row bounds
+            # the natural wait
+            left = [rem(r) for r in running if id(r) not in taken]
+            if not left:
+                break                       # every row already traded
+            wait = est * min(left)
+            if slack_w - wait >= cfg.preempt_margin_s:
+                continue                    # it can afford to wait
+            best, best_slack = None, None
+            for r in running:
+                if id(r) in taken or r.priority > w.priority:
+                    continue
+                rdl = r.deadline_time
+                slack_r = float("inf") if rdl is None \
+                    else rdl - now - est * rem(r)
+                # strictly sound: the victim, after waiting behind
+                # the seated waiter, keeps more slack than the waiter
+                # has now
+                if slack_r - est * rem(w) <= slack_w:
+                    continue
+                if best is None or slack_r > best_slack or \
+                        (slack_r == best_slack and r.seq < best.seq):
+                    best, best_slack = r, slack_r
+            if best is None:
+                break                       # no sound trade for anyone
+            taken.add(id(best))
+            victims.append(best)
+        return victims

@@ -90,6 +90,10 @@ class ChunkedAdmissionController(AdmissionController):
         if int(chunk_budget) < 1:
             raise ValueError(
                 f"chunk_budget must be >= 1, got {chunk_budget}")
+        # read FRESH each pump(), so the autopilot's declared actuator
+        # (ActuatorBus.set_chunk_budget — the ONE sanctioned writer
+        # outside this __init__; SRV208 flags any other) retunes the
+        # budget between steps without touching compiled programs
         self.chunk_budget = int(chunk_budget)
         # slot -> (request, full fed-token list); admission order decides
         # pump order (earliest-admitted row completes first — the TTFT-

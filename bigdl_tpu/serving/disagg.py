@@ -799,7 +799,8 @@ class DisaggregatedEngine:
                  standby_pools: int = 0,
                  health: Optional[HealthConfig] = None,
                  transfer_retry: Optional[TransferRetryConfig] = None,
-                 autoscaler=None, adapters=None, tier=None) -> None:
+                 autoscaler=None, adapters=None, tier=None,
+                 autopilot=None) -> None:
         if decode_pools < 1:
             raise ValueError(
                 f"decode_pools must be >= 1, got {decode_pools}")
@@ -880,6 +881,20 @@ class DisaggregatedEngine:
                 OccupancyAutoscaler(cfg)
         else:
             self._scaler = None
+        # the SLO autopilot (serving/autopilot.py): the PREFILL engine
+        # hosts the loop (it owns admission — chunk budget, degrade,
+        # the priority key fold — and its clock is the plane's clock),
+        # and the pool autoscaler registers on the same bus so scale
+        # decisions land in the one actuation log every other knob
+        # uses (_autoscale remains the executing site — it owns the
+        # pool tables)
+        self.autopilot = autopilot or None
+        if self.autopilot is not None:
+            self.autopilot.attach(self.prefill.engine)
+            self.prefill.engine.autopilot = self.autopilot
+            if self._scaler is not None:
+                self.autopilot.register_controller("pool_scale",
+                                                   self._scaler)
 
     # -- request surface ---------------------------------------------------
 
@@ -1217,6 +1232,10 @@ class DisaggregatedEngine:
             victim = min(active, key=lambda i: self.decoders[i].load)
             self.drain_pool(victim)
             self.metrics.on_autoscale("down")
+        if decision and self.autopilot is not None:
+            # the bus records pool actuations next to every other
+            # knob's — ONE audit stream for the whole control plane
+            self.autopilot.bus.note_pool_scale(decision)
 
     # -- the serving loop --------------------------------------------------
 

@@ -78,7 +78,9 @@ from bigdl_tpu.serving.sampling import (
     SamplingParams, advance_lane, knob_row_values, make_knob_rows,
     match_stop_sequences,
 )
-from bigdl_tpu.serving.scheduler import FINISHED, SHED, Request, Scheduler
+from bigdl_tpu.serving.scheduler import (
+    FINISHED, SHED, WAITING, Request, Scheduler,
+)
 
 
 class ServingEngine:
@@ -203,7 +205,17 @@ class ServingEngine:
       stalls / admission errors at the engine's dispatch sites — the
       test harness for all of the above; ``clock`` swaps the engine's
       time source (a :class:`~bigdl_tpu.serving.faults.VirtualClock`
-      lets deadline and stall tests run without sleeping).
+      lets deadline and stall tests run without sleeping);
+    * ``autopilot`` (a :class:`~bigdl_tpu.serving.autopilot.Autopilot`)
+      closes the control loop: sampled once at the end of every
+      ``step()`` on the engine clock, it drives ``chunk_budget``,
+      per-class ``Degrade`` apply/restore, and the speculative draft
+      cap from windowed metrics through the declared actuator bus,
+      folds the measured service-time estimate into the priority
+      key, and preempts FOR deadlines (a short-deadline feasible
+      waiter evicts the longest-slack running row rather than miss).
+      Every actuation is host bookkeeping over per-row runtime data —
+      the compiled-program set is untouched.
     """
 
     def __init__(self, model, n_slots: int = 8, compute_dtype=None,
@@ -225,7 +237,8 @@ class ServingEngine:
                  watchdog: Optional[WatchdogConfig] = None,
                  faults=None,
                  adapters=None,
-                 tier=None) -> None:
+                 tier=None,
+                 autopilot=None) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -492,6 +505,17 @@ class ServingEngine:
             self._zero_carry1 = pool_init(1)
         self._next_id = 0
         self._finished: Dict[int, Request] = {}
+        # the SLO autopilot (serving/autopilot.py): an engine-wide
+        # ceiling on the speculative draft count (runtime data the
+        # super-step's _draft_budget reads — never a recompile), and
+        # the closed control loop itself, sampled once at the end of
+        # every step() on the engine clock. attach() binds the
+        # actuator bus to this engine and folds the measured
+        # service-time estimate into the scheduler's priority key.
+        self.draft_cap: Optional[int] = None
+        self.autopilot = autopilot or None
+        if self.autopilot is not None:
+            self.autopilot.attach(self)
 
     # -- request surface ---------------------------------------------------
 
@@ -717,6 +741,16 @@ class ServingEngine:
         # still make theirs
         for req in self.scheduler.pop_expired(now):
             self._shed(req, "deadline")
+        # the static degrade path's REVERT half: when the queue has
+        # drained back below the pressure threshold, still-WAITING
+        # degraded rows (preempted/fault-evicted under the burst) get
+        # their recorded original limits back — a burst's clamp must
+        # not outlive the burst (the autopilot's bus drives the same
+        # restore from its own controller when attached)
+        if (self.degrade_at is not None
+                and self.scheduler.queue_depth < self.degrade_at):
+            for req in self.scheduler.iter_waiting():
+                self._restore_degrade(req)
         # feasibility admission control: with a measured per-token
         # service-time estimate in hand, a request whose DECLARED
         # budget (max_new_tokens — the only bound available before the
@@ -773,6 +807,15 @@ class ServingEngine:
                 if demand <= self.pool.free_slots:
                     break
                 self._preempt_row(victim)
+            # deadline-aware preemption (autopilot): evict long-slack
+            # running rows so short-deadline FEASIBLE waiters seat
+            # before their would-miss point — within or below class,
+            # where the static loop above only trades across classes.
+            # Loss-free like every preemption: latency reorders,
+            # tokens never do.
+            if self.autopilot is not None:
+                for victim in self.autopilot.deadline_victims(self, now):
+                    self._preempt_row(victim)
         n = self.scheduler.admissible(self.pool.free_slots)
         if not n:
             return
@@ -871,7 +914,20 @@ class ServingEngine:
                 or self.degrade_at is None
                 or self.scheduler.queue_depth < self.degrade_at):
             return
+        self._apply_degrade(req)
+
+    def _apply_degrade(self, req: Request) -> bool:
+        """The ONE degrade writer (a declared ACTUATION_SITES unit —
+        serving/autopilot.py): clamp the request to its submitted
+        ``Degrade`` knobs, RECORDING the originals on the request so
+        the clamp is revertible while the row still waits. Both the
+        static ``degrade_at`` path (via ``_maybe_degrade``) and the
+        autopilot's per-class pressure loop land here. False when
+        there is nothing to do (no knob, or already degraded)."""
         d = req.degrade
+        if d is None or req.degraded:
+            return False
+        req._pre_degrade = (req.max_new_tokens, req.draft_tokens)
         if d.max_new_tokens is not None:
             req.max_new_tokens = min(req.max_new_tokens,
                                      int(d.max_new_tokens))
@@ -879,6 +935,31 @@ class ServingEngine:
             req.draft_tokens = int(d.draft_tokens)
         req.degraded = True
         self.metrics.on_degrade()
+        return True
+
+    def _restore_degrade(self, req: Request) -> bool:
+        """Revert ``_apply_degrade`` for a still-WAITING row (a
+        declared ACTUATION_SITES unit): put the recorded original
+        ``max_new_tokens``/``draft_tokens`` back and clear the degraded
+        mark, so the knob can re-apply if pressure returns. Only
+        WAITING rows restore — a seated row's budget was already
+        priced into its admission (feasibility, chunk planning), and a
+        preempted-then-requeued row IS waiting, which is exactly the
+        regression this fixes: before PR 19 a row degraded at a
+        queue-depth spike kept its clamp forever, burst or no burst.
+        False when the row is not a restorable degraded waiter."""
+        if (not req.degraded or req._pre_degrade is None
+                or req.state != WAITING):
+            return False
+        mnt, dt = req._pre_degrade
+        # never clamp BELOW what already streamed out (a preempted
+        # row's emitted tokens are immutable history)
+        req.max_new_tokens = max(int(mnt), len(req.output))
+        req.draft_tokens = dt
+        req._pre_degrade = None
+        req.degraded = False
+        self.metrics.on_degrade_restored()
+        return True
 
     def _admitted_prefill_tokens(self, req: Request) -> List[int]:
         """0-based tokens whose K/V must be resident before ``req``
@@ -1292,6 +1373,11 @@ class ServingEngine:
             # sample for sample
             if self.metrics.decode_step_count > ndec0:
                 self._note_host_step(t_step, dev0)
+            # the SLO autopilot's ONE control sample per super-step —
+            # after the step's metrics landed, idle steps included
+            # (pressure relief mostly happens in lulls)
+            if self.autopilot is not None:
+                self.autopilot.sample(self)
 
     def _step_impl(self) -> Dict[int, int]:
         import jax.numpy as jnp

@@ -92,6 +92,33 @@ class VirtualClock:
         return self.t
 
 
+class SteppingClock(VirtualClock):
+    """A :class:`VirtualClock` that advances itself ``tick_s`` on
+    every READ — deterministic virtual time that actually PASSES as
+    the engine runs, with no sleeping and no wall clock.
+
+    The plain VirtualClock never moves unless the test advances it, so
+    timed spans measured INSIDE a step (decode_step_s, decode gaps,
+    TTFT) all come out zero and everything built on them — the
+    feasibility estimate, deadline-aware preemption, the autopilot's
+    windowed signals — degenerates. With a SteppingClock every clock
+    read costs one tick, so a decode step's elapsed time is (reads
+    between t0 and t1) x tick_s: fixed per code path, hence
+    deterministic per trace. The autopilot tests and the bench's
+    workload-zoo replay run on it — same seeded trace in, same
+    goodput out, every run."""
+
+    def __init__(self, tick_s: float = 0.001, start: float = 0.0) -> None:
+        super().__init__(start)
+        if tick_s <= 0:
+            raise ValueError(f"tick_s must be positive, got {tick_s}")
+        self.tick_s = float(tick_s)
+
+    def __call__(self) -> float:
+        self.advance(self.tick_s)
+        return self.t
+
+
 @dataclass(frozen=True)
 class WatchdogConfig:
     """Step-health knobs for :class:`ServingEngine`.

@@ -57,6 +57,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from bigdl_tpu.serving.autopilot import Controller
+
 #: The closed pool-health vocabulary (the FINISH_REASONS pattern):
 #: HEALTHY pools receive new handoffs, SUSPECT pools keep their rows
 #: but stop receiving new work, DEAD pools are failed over and never
@@ -226,54 +228,39 @@ class AutoscalerConfig:
                 f"min_pools must be >= 1, got {self.min_pools}")
 
 
-class OccupancyAutoscaler:
+class OccupancyAutoscaler(Controller):
     """The pool-count control loop (module docstring): one
     :meth:`observe` per front-end step returns ``"up"``, ``"down"``,
     or None; the engine executes (activate a standby pool / drain the
     least-loaded active pool). Pure host arithmetic — deterministic
     given the occupancy series, which is what lets the bench assert
-    flap-freedom instead of eyeballing it."""
+    flap-freedom instead of eyeballing it.
+
+    PR 19 generalized this class's dead-band/sustain/cooldown
+    discipline into the autopilot's :class:`~bigdl_tpu.serving.
+    autopilot.Controller` base (it debuted here in PR 14); the
+    autoscaler is now that base plus the occupancy-specific sample
+    shape — ``backlog`` vetoes the low side (a backlogged lull means
+    admission is catching up, not that capacity is idle) — so every
+    autopilot knob and the pool count share ONE flap-freedom
+    argument. A :class:`~bigdl_tpu.serving.disagg.
+    DisaggregatedEngine` built with ``autopilot=`` registers this
+    controller on the bus, putting pool scale decisions in the same
+    actuation log as every other knob."""
 
     def __init__(self, config: Optional[AutoscalerConfig] = None) -> None:
         self.config = config if config is not None else AutoscalerConfig()
-        self._hi_run = 0
-        self._lo_run = 0
-        # born ready: the first action needs no cooldown to expire
-        self._since_action = self.config.cooldown
+        super().__init__(self.config.high_water, self.config.low_water,
+                         sustain=self.config.sustain,
+                         cooldown=self.config.cooldown)
 
     def observe(self, occupancy: float, backlog: int,
                 can_up: bool, can_down: bool) -> Optional[str]:
         """One control sample: ``occupancy`` is the mean over ACTIVE
         decode pools, ``backlog`` the prefill pool's waiting depth
-        (scale-down is refused while work is queued — low occupancy
-        with a backlog means admission is catching up, not that
-        capacity is idle). ``can_up``/``can_down`` gate on what the
-        engine can actually do (a standby pool exists / more than
-        ``min_pools`` active)."""
-        cfg = self.config
-        if occupancy >= cfg.high_water:
-            self._hi_run += 1
-            self._lo_run = 0
-        elif occupancy <= cfg.low_water and backlog == 0:
-            self._lo_run += 1
-            self._hi_run = 0
-        else:
-            # the dead band (or a backlogged lull): both runs restart —
-            # hysteresis demands CONSECUTIVE evidence
-            self._hi_run = 0
-            self._lo_run = 0
-        self._since_action += 1
-        if self._since_action <= cfg.cooldown:
-            return None
-        if self._hi_run >= cfg.sustain and can_up:
-            self._act()
-            return "up"
-        if self._lo_run >= cfg.sustain and can_down:
-            self._act()
-            return "down"
-        return None
-
-    def _act(self) -> None:
-        self._hi_run = 0
-        self._lo_run = 0
-        self._since_action = 0
+        (scale-down is refused while work is queued).
+        ``can_up``/``can_down`` gate on what the engine can actually
+        do (a standby pool exists / more than ``min_pools`` active)."""
+        return Controller.observe(self, occupancy, can_up=can_up,
+                                  can_down=can_down,
+                                  hold_down=backlog > 0)

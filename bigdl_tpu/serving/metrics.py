@@ -302,6 +302,19 @@ class ServingMetrics:
         queue pressure."""
         self.metrics.add("serving/degraded", 1.0)
 
+    def on_degrade_restored(self) -> None:
+        """A still-WAITING degraded request got its recorded original
+        limits back after pressure dropped (the revertible-Degrade
+        contract: a burst's clamp must not outlive the burst)."""
+        self.metrics.add("serving/degrade_restored", 1.0)
+
+    def on_actuation(self, actuator: str) -> None:
+        """One autopilot bus actuation (``serving/autopilot.py``):
+        counted in total and per actuator, so a flapping controller is
+        visible on the metrics plane, not just in the bus log."""
+        self.metrics.add("serving/actuations", 1.0)
+        self.metrics.add(f"serving/actuation_{actuator}", 1.0)
+
     def on_sample_rows(self, n_sampled: int, n_greedy: int) -> None:
         """Per decode step: how many active rows drew from a sampled
         distribution (temperature > 0) vs took the argmax."""
@@ -493,23 +506,25 @@ class ServingMetrics:
         """Percentiles of the per-fetch host wall (seconds)."""
         return self._pctl("fetch_s", qs)
 
-    def decode_step_estimate(self) -> Optional[float]:
-        """MEDIAN of the recent decode-step samples (a bounded window,
-        seconds), or None before the first decode step — the per-step
-        service-time estimate feasibility admission control builds on.
-        Median, not mean: the engine's first dispatch carries the
-        one-time XLA compile (multi-second at LM scale — the same
-        cold-start outlier the watchdog's arming grace exists for) and
+    def decode_step_estimate(self, n: int = 64) -> Optional[float]:
+        """MEDIAN of the last ``n`` decode-step samples (seconds), or
+        None before the first decode step — the per-step service-time
+        estimate feasibility admission control builds on. Median, not
+        mean: the engine's first dispatch carries the one-time XLA
+        compile (multi-second at LM scale — the same cold-start
+        outlier the watchdog's arming grace exists for) and
         fault-injected stalls are outliers too; a mean polluted by
         either would spuriously shed early traffic as infeasible. A
-        bounded window, not full history: _admit consults this every
-        engine step, so the cost must stay O(window) for the engine's
-        whole lifetime."""
-        import numpy as np
-
+        bounded RECENT window (the :meth:`window` discipline), not
+        full history: _admit consults this every engine step, so the
+        cost must stay O(window) for the engine's whole lifetime — and
+        a whole-run median goes stale across traffic phases (a warm
+        lull's fast steps would understate a burst's service time and
+        admit guaranteed misses)."""
         if not self._step_window:
             return None
-        return float(np.median(np.asarray(self._step_window)))
+        return self._window_stats(
+            list(self._step_window)[-int(n):])["p50"]
 
     def service_time_estimate(self) -> Optional[float]:
         """Estimated seconds per EMITTED TOKEN — what feasibility
@@ -525,13 +540,12 @@ class ServingMetrics:
         shedding requests that would have met their deadline — the
         lifetime rate lags a mid-flight Degrade(draft_tokens=0) shift,
         an accepted coarseness)."""
-        import numpy as np
-
         est = self.decode_step_estimate()
         if est is None:
             return None
         if self._draft_window:
-            est += float(np.median(np.asarray(self._draft_window)))
+            est += self._window_stats(
+                list(self._draft_window)[-64:])["p50"]
         # running sums, not Metrics.get (which re-sums the full
         # per-step sample lists — O(lifetime) on a hot path)
         if self._spec_rows:
@@ -619,6 +633,34 @@ class ServingMetrics:
         arr = np.asarray(vals)
         return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
 
+    @staticmethod
+    def _window_stats(vals) -> Dict[str, float]:
+        """mean/p50/p99 over one bounded sample window — the shared
+        math behind :meth:`window` and the feasibility estimators."""
+        import numpy as np
+
+        arr = np.asarray(vals, dtype=float)
+        return {"n": int(arr.size),
+                "mean": float(arr.mean()),
+                "p50": float(np.percentile(arr, 50)),
+                "p99": float(np.percentile(arr, 99))}
+
+    def window(self, name: str, n: int) -> Optional[Dict[str, float]]:
+        """Rolling-window view of one serving counter: mean/p50/p99
+        (plus the actual sample count ``n``) over the LAST ``n``
+        samples of ``serving/<name>`` — the bounded-recency signal the
+        autopilot's controllers read. A whole-run percentile goes
+        stale across traffic phases (an hour of lull poisons the
+        burst's p99 for the rest of the run); a window follows the
+        phase. None before the first sample, so controllers never act
+        on a guess."""
+        if n < 1:
+            raise ValueError(f"window size must be >= 1, got {n}")
+        vals = self._values(name)
+        if not vals:
+            return None
+        return self._window_stats(vals[-int(n):])
+
     def ttft_percentiles(self, qs=(50, 90, 99)) -> Dict[str, float]:
         return self._pctl("ttft_s", qs)
 
@@ -640,7 +682,8 @@ class ServingMetrics:
         # Metrics means each add-series; "preempted 0.97 mean" is
         # useless where "preempted 13 rows" is the operational number)
         for name in ("preempted", "shed", "deadline_missed", "retries",
-                     "recovered_rows", "degraded", "finished_in_slo",
+                     "recovered_rows", "degraded", "degrade_restored",
+                     "actuations", "finished_in_slo",
                      "infeasible", "chunks", "chunk_tokens",
                      "handoffs", "transfer_bytes",
                      "pool_deaths", "failovers", "migrated_rows",

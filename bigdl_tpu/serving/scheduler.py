@@ -116,6 +116,12 @@ class Request:
     deadline_s: Optional[float] = None
     degrade: Optional[object] = None   # admission.Degrade
     degraded: bool = False
+    # the pre-degrade (max_new_tokens, draft_tokens) pair, recorded by
+    # the ONE degrade writer (ServingEngine._apply_degrade) so the
+    # clamp is REVERTIBLE: when pressure drops while this row still
+    # waits, _restore_degrade puts the originals back — a burst's
+    # degrade must not outlive the burst
+    _pre_degrade: Optional[tuple] = None
     seq: int = -1                      # arrival order, set by submit()
     preemptions: int = 0
     retries: int = 0
@@ -151,6 +157,16 @@ class Scheduler:
             raise ValueError(
                 f"unknown admission policy {policy!r} (one of {_POLICIES})")
         self.policy = policy
+        #: optional zero-arg callable returning the measured per-token
+        #: service-time estimate (seconds) or None — set by the
+        #: autopilot (``serving/autopilot.py``). With one attached,
+        #: the priority key's deadline term becomes LEAST-LAXITY: the
+        #: deadline minus the time the request's remaining budget
+        #: needs, i.e. the latest feasible start — a long-budget
+        #: request with the same deadline is genuinely more urgent.
+        #: Evaluated ONCE at submit (requeue preserves the key), so
+        #: heap order stays deterministic as the estimate drifts.
+        self.service_estimate: Optional[object] = None
         self._waiting: List[list] = []            # heap of [key, req]
         self.running: Dict[int, Request] = {}     # slot -> request
         # mid-prefill rows (chunked admission): slot-bound but not yet
@@ -172,7 +188,16 @@ class Scheduler:
         if self.policy != "priority":
             return (0, 0.0, req.seq)
         dl = req.deadline_time
-        return (-req.priority, _INF if dl is None else dl, req.seq)
+        urgency = _INF if dl is None else dl
+        if dl is not None and self.service_estimate is not None:
+            est = self.service_estimate()
+            if est:
+                # least-laxity: order by latest feasible START, not
+                # by deadline — folds the measured service time into
+                # the key (autopilot attach; plain EDF without one)
+                rem = max(1, req.max_new_tokens - len(req.output))
+                urgency = dl - est * rem
+        return (-req.priority, urgency, req.seq)
 
     def submit(self, req: Request) -> None:
         if not req.prompt:
@@ -278,6 +303,16 @@ class Scheduler:
         window (keys are unique per request, so the heap entries
         totally order)."""
         return [r for _, r in heapq.nsmallest(n, self._waiting)]
+
+    def iter_waiting(self):
+        """Read-only iteration over WAITING requests in HEAP order
+        (not admission order — cheaper than the sorted ``waiting``
+        view). The degrade apply/restore sweeps use it; mutating
+        priority/deadline/seq during iteration would corrupt the heap,
+        mutating budget fields (``max_new_tokens``/``draft_tokens``)
+        is safe — keys never depend on them."""
+        for _, req in self._waiting:
+            yield req
 
     def pop_waiting(self, pred) -> List[Request]:
         """Remove and return every WAITING request ``pred`` selects —

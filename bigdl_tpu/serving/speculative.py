@@ -236,6 +236,14 @@ class Speculator:
         position after the first would verify against a stale mask."""
         k = self.k if req.draft_tokens is None \
             else min(int(req.draft_tokens), self.k)
+        # the autopilot's engine-wide ceiling (ActuatorBus.
+        # set_draft_cap): when the windowed accept rate says drafts
+        # are dying at verify, the cap cuts spend for EVERY row —
+        # per-row hints still apply below it, and None means the
+        # configured k. Runtime data, never a recompile.
+        cap = getattr(self.engine, "draft_cap", None)
+        if cap is not None:
+            k = min(k, int(cap))
         if self.engine._knobs["ban"][slot]:
             k = 0
         if slot in self.engine._constraints:

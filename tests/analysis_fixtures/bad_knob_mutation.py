@@ -1,0 +1,55 @@
+"""SRV208 undeclared actuation: serving control knobs (the chunked
+admitter's ``chunk_budget``, a request's degrade fields, the
+speculative ``draft_cap``, pool activate/drain) mutated outside the
+declared ACTUATION_SITES vocabulary.  Every knob the control plane
+moves goes through the declared actuator API so the bus's audit log
+sees it and hysteresis owns the cadence; the vocabulary below
+(extraction beats the serving/autopilot.py fallback, the CLOCK_SITES
+pattern) declares this file's sanctioned actuators.  The constructor
+writes and the declared bus method are the false-positive guards."""
+
+#: the declared vocabulary — the analyzer extracts this instead of the
+#: serving/autopilot.py fallback when the file is in the project
+ACTUATION_SITES = frozenset({"bad_knob_mutation.MiniBus.set_chunk_budget",
+                             "bad_knob_mutation.MiniBus.degrade"})
+
+
+class MiniAdmitter:
+    def __init__(self, chunk_budget=32):
+        # compliant: constructors set INITIAL values — configuration,
+        # not actuation
+        self.chunk_budget = int(chunk_budget)
+
+
+class MiniBus:
+    def __init__(self, engine):
+        self.engine = engine
+
+    def set_chunk_budget(self, n):
+        # compliant: THE declared chunk-budget actuator
+        self.engine.admitter.chunk_budget = int(n)
+
+    def degrade(self, req):
+        # compliant: THE declared degrade actuator
+        req.max_new_tokens = 16
+        req.degraded = True
+
+
+class MiniEngine:
+    def __init__(self, admitter):
+        self.admitter = admitter
+        self.draft_cap = None                       # compliant: __init__
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def step(self, req):
+        self.admitter.chunk_budget = 8              # EXPECT: SRV208
+        req.max_new_tokens = 4                      # EXPECT: SRV208
+        self.draft_cap = 2                          # EXPECT: SRV208
+        req.degraded = True                         # EXPECT: SRV208
+        return self._dispatch("decode", lambda r: r, req)
+
+    def rebalance(self, pools, i):
+        pools.drain_pool(i)                         # EXPECT: SRV208
+        pools._activate_pool(i + 1)                 # EXPECT: SRV208

@@ -32,7 +32,7 @@ ALL_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305",
              "MH401", "MH402", "MH403", "MH404", "MH405",
              "SPMD101", "SPMD102", "SPMD103", "SPMD104", "SPMD105",
              "SPMD106", "SRV201", "SRV202", "SRV203", "SRV204", "SRV205",
-             "SRV206", "SRV207")
+             "SRV206", "SRV207", "SRV208")
 ASY_CODES = ["ASY301", "ASY302", "ASY303", "ASY304", "ASY305"]
 MH_CODES = ["MH401", "MH402", "MH403", "MH404", "MH405"]
 
@@ -367,6 +367,45 @@ def test_srv207_real_tree_clean_and_mutation_caught(tmp_path):
     assert [f.code for f in found] == ["SRV207"], \
         [f.format() for f in found]
     assert found[0].path.endswith("kv_tier.py")
+
+
+def test_srv208_real_tree_clean_and_mutation_caught(tmp_path):
+    """SRV208 census over the REAL serving tree: the unmutated copy
+    scans clean (every control-knob write lives in a constructor or a
+    declared ACTUATION_SITES unit — the bus's setters, the engine's
+    degrade pair, disagg's autoscale/kill paths), and adding a stray
+    ``req.max_new_tokens`` write inside the admission replay helper
+    yields exactly one SRV208 at engine.py — the declared-actuator
+    discipline is enforced where the knobs actually live, not just on
+    fixtures."""
+    tree = _serving_tree(tmp_path)
+    clean = analyze_paths([str(tmp_path)], select=["SRV208"])
+    assert clean == [], [f.format() for f in clean]
+    src = (tree / "engine.py").read_text()
+    needle = "req.next_token = fed0[-1]"
+    assert needle in src, "_admitted_prefill_tokens moved — update the census"
+    (tree / "engine.py").write_text(
+        src.replace(needle, needle + "\n        req.max_new_tokens = 1", 1))
+    found = analyze_paths([str(tmp_path)], select=["SRV208"])
+    assert [f.code for f in found] == ["SRV208"], \
+        [f.format() for f in found]
+    assert found[0].path.endswith("engine.py")
+
+
+def test_srv208_reads_real_vocabulary():
+    """The shipped autopilot.ACTUATION_SITES is what the repo gate
+    checks against (extraction, not fallback, on the real tree) — and
+    the fallback vocabulary stays in sync with it."""
+    from bigdl_tpu.analysis.core import _parse_file, collect_file_facts
+    from bigdl_tpu.analysis.rules import _DEFAULT_ACTUATION_SITES
+    from bigdl_tpu.serving.autopilot import ACTUATION_SITES
+
+    text = (REPO / "bigdl_tpu" / "serving" / "autopilot.py").read_text()
+    ctx, err = _parse_file(text, "bigdl_tpu/serving/autopilot.py")
+    assert err is None
+    facts = collect_file_facts(ctx)
+    assert set(facts["actuation_sites"]) == set(ACTUATION_SITES)
+    assert _DEFAULT_ACTUATION_SITES == ACTUATION_SITES
 
 
 def test_srv205_reads_real_vocabulary():
