@@ -112,6 +112,12 @@ Reports aggregate tokens/sec (first arrival → last finish) and
 time-to-first-token percentiles (arrival → first generated token, i.e.
 queueing + prefill + first step). Prints ONE JSON line.
 
+``--scenario async`` sweeps the dispatch-ahead window (``dispatch_ahead``
+W in {0, 1, 2, 4}) over the default mixed trace's prompts, asserting
+byte-identical streams and equal compile counts at every W and that
+``host_frac`` drops at W >= 1 — the measured before/after row for the
+delayed-consumer decode refactor (docs/async_readiness.md).
+
 Scale note: decode is weight-read-bound on an accelerator, so a pooled
 step costs ~a single-row step and the win approaches slot occupancy
 (decode_bench measured 137M bf16 at 1,740 tok/s B=1 vs 7,438 B=8 on
@@ -1965,13 +1971,107 @@ def run_autopilot(model: str = "tiny", variant: str = "fp32",
     }
 
 
+def _run_window_engine(lm, dtype, trace, n_slots: int, window: int):
+    """One drain()-to-empty pass at dispatch-ahead depth ``window`` —
+    everything submitted up front so the sweep is decode-dominant and
+    the streams are a pure function of the prompts (no arrival
+    timing in the loop)."""
+    from bigdl_tpu.serving import ServingEngine
+
+    eng = ServingEngine(lm, n_slots=n_slots, compute_dtype=dtype,
+                        dispatch_ahead=window)
+    rids = [eng.submit(p, max_new_tokens=n) for _, p, n in trace]
+    t0 = time.perf_counter()
+    outs = eng.drain()
+    wall = time.perf_counter() - t0
+    n_tokens = int(sum(len(v) for v in outs.values()))
+    host_total, n_host = eng.metrics.metrics.get("serving/host_step_s")
+    device_total = eng.metrics.device_seconds
+    s = eng.metrics.summary()
+    return eng, [tuple(outs[r]) for r in rids], {
+        "tokens_per_sec": round(n_tokens / wall, 1),
+        "wall_s": round(wall, 3), "tokens": n_tokens,
+        "host_frac": round(
+            host_total / max(host_total + device_total, 1e-9), 3)
+        if n_host else 0.0,
+        "host_step_p99_ms": round(
+            s.get("serving/host_step_p99_s", 0.0) * 1e3, 2),
+        "decode_gap_p99_ms": round(
+            s.get("serving/decode_gap_p99_s", 0.0) * 1e3, 2),
+        "decode_programs": eng._step_fn._cache_size(),
+    }
+
+
+def run_async(model: str = "tiny", variant: str = "fp32",
+              n_requests: int = 12, gen_tokens: int = 48,
+              n_slots: int = 12, windows=(0, 1, 2, 4)) -> dict:
+    """The dispatch-ahead W-sweep (``--scenario async``): the default
+    mixed trace's prompts replayed drain-to-empty through fresh engines
+    at ``dispatch_ahead`` W in {0, 1, 2, 4} — the measured row for the
+    ROADMAP's "THE number this item drives down" (`host_frac`, born in
+    docs/async_readiness.md, honestly inflated by PR 15's prefill-fence
+    deletion, driven down here by consuming step N's decode readback
+    only after step N+1..N+W have dispatched).
+
+    Asserted (the autopilot convention — a green line IS the claim):
+    every W emits BYTE-IDENTICAL token streams to W=0 (the window
+    re-times the fence, it never reorders math); every pass ends at
+    the SAME decode-program count (one warm pass owns every bucket —
+    a window depth is a host-side deque bound, never a trace input);
+    and `host_frac` at every W >= 1 is STRICTLY below W=0 (the
+    true-host residue the delayed consumer pays per step is smaller:
+    its readback lands on already-materialized buffers instead of
+    stalling the freshly-enqueued dispatch). Reported per W:
+    host_frac, host_step p99, decode-gap p99, tokens/sec."""
+    lm, dtype, cfg = build(model, variant)
+    trace = make_trace(cfg, n_requests, gen_tokens, stagger_s=0.0)
+    # warm the (model, dtype, n_slots) decode step + prefill buckets at
+    # the deepest window so every timed pass is compile-free and the
+    # sweep deltas are pure fence-timing
+    _run_window_engine(lm, dtype, [(a, p, 2) for a, p, _ in trace],
+                       n_slots, window=max(windows))
+    sweep = {}
+    base_outs = None
+    programs = set()
+    for w in windows:
+        eng, outs, stats = _run_window_engine(lm, dtype, trace,
+                                              n_slots, w)
+        if base_outs is None:
+            base_outs = outs
+        else:
+            assert outs == base_outs, \
+                f"W={w} diverged from the W=0 streams"
+        assert not eng._window, \
+            f"W={w}: drain() left {len(eng._window)} in-flight dispatches"
+        programs.add(stats["decode_programs"])
+        sweep[f"W{w}"] = stats
+    assert len(programs) == 1, \
+        f"decode-program counts diverged across the sweep: {programs}"
+    base_frac = sweep[f"W{windows[0]}"]["host_frac"]
+    deeper = [w for w in windows if w >= 1]
+    assert all(sweep[f"W{w}"]["host_frac"] < base_frac for w in deeper), \
+        "host_frac did not drop at W>=1: " + repr(
+            {k: v["host_frac"] for k, v in sweep.items()})
+    return {
+        "metric": "serving_dispatch_ahead_sweep",
+        "model": model, "variant": variant, "requests": n_requests,
+        "gen_tokens": gen_tokens, "slots": n_slots,
+        "windows": sweep,
+        "streams_identical": True,
+        "equal_decode_programs": True,
+        "host_frac_drop_at_w1": round(
+            base_frac - sweep["W1"]["host_frac"], 3) if 1 in windows
+        else None,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario", default="mixed",
                     choices=["mixed", "admission", "sampling", "sharded",
                              "kv_quant", "speculative", "slo", "chunked",
                              "disagg", "failover", "multitenant",
-                             "tiered", "autopilot"])
+                             "tiered", "autopilot", "async"])
     ap.add_argument("--model", default="tiny", choices=sorted(MODELS))
     ap.add_argument("--variant", default="fp32", choices=["fp32", "bf16"])
     # requests/gen_tokens/slots default per scenario: mixed 12/48/12,
@@ -2017,6 +2117,13 @@ def main() -> None:
     ap.add_argument("--tick_ms", type=float, default=2.0,
                     help="autopilot: SteppingClock tick per clock read")
     args = ap.parse_args()
+    if args.scenario == "async":
+        print(json.dumps(run_async(
+            args.model, args.variant,
+            n_requests=args.requests or 12,
+            gen_tokens=args.gen_tokens or 48,
+            n_slots=args.slots or 12)))
+        return
     if args.scenario == "autopilot":
         print(json.dumps(run_autopilot(
             args.model, args.variant,

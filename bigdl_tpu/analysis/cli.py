@@ -199,6 +199,10 @@ def _run_report(paths: List[str], fmt: str, name: str, inventory_fn,
             print(f"    in {e['function']}")
         if e["chain"]:
             print(f"    chain: {_short_chain(e['chain'])}")
+        if e.get("window"):
+            # sync-points only: which side of the dispatch-ahead
+            # window this site sits on (delayed consumer vs inline)
+            print(f"    window: {e['window']}")
         if e["kind"].startswith(finding_pfx):
             print(f"    {e['classification']}")
         if e["detail"]:

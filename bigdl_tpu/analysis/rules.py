@@ -2004,6 +2004,113 @@ def _fence_sites(ctx: FileContext) -> Set[str]:
     return set(sites) if sites else set(_DEFAULT_FENCE_SITES)
 
 
+#: fallbacks for single-file fixture runs — must match serving/fences.py
+_DEFAULT_WINDOW_KNOBS = frozenset({"dispatch_ahead"})
+_DEFAULT_DELAYED_SITES = frozenset({"decode"})
+
+
+@_register_facts
+def _window_facts(ctx: FileContext) -> Dict:
+    """The declared dispatch-ahead vocabulary — ``WINDOW_KNOBS`` (the
+    engine knobs a window-depth guard may reference, ASY308's ground
+    truth) and ``DELAYED_CONSUMER_SITES`` (the fence sites allowed to
+    sit behind the window, ASY306/309's ground truth) — extracted from
+    the fence module the way :func:`_fence_facts` reads FENCE_SITES."""
+    out: Dict = {}
+    for node in ctx.by_type(ast.Assign):
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "WINDOW_KNOBS":
+                val = literal_value(node.value)
+                if val is not UNRESOLVED:
+                    out["window_knobs"] = sorted(val)
+            elif t.id == "DELAYED_CONSUMER_SITES":
+                val = literal_value(node.value)
+                if val is not UNRESOLVED:
+                    out["delayed_sites"] = sorted(val)
+    return out
+
+
+def _window_knobs(ctx: FileContext) -> Set[str]:
+    v = _facts(ctx).get("window_knobs")
+    return set(v) if v else set(_DEFAULT_WINDOW_KNOBS)
+
+
+def _delayed_sites(ctx: FileContext) -> Set[str]:
+    v = _facts(ctx).get("delayed_sites")
+    return set(v) if v else set(_DEFAULT_DELAYED_SITES)
+
+
+def _is_window_pop(call: ast.Call) -> bool:
+    """``<recv>.popleft()`` / ``<recv>.pop(0)`` — the delayed
+    consumer's oldest-first take from a window collection."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr == "popleft" and not call.args:
+        return True
+    return (f.attr == "pop" and len(call.args) == 1
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value == 0)
+
+
+def _window_collections(ctx: FileContext) -> Set[str]:
+    """Dotted receivers that ARE dispatch-ahead window collections in
+    this file: something a hot unit ``.append``s DEVICE-tainted values
+    into AND something is ``popleft()``/``pop(0)``ed from (the
+    producer/consumer pair). Requiring the pop side keeps plain
+    device-handle accumulators — the speculative plane's draft chain
+    list, metric buffers — out: a window is a queue, not a list."""
+    hit = ctx.cache.get("asy_window_colls")
+    if hit is not None:
+        return hit
+    appended: Set[str] = set()
+    for _qual, fn, _chain in _hot_units(ctx):
+        scan = _asy_scan(ctx, fn)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append" and node.args):
+                continue
+            recv = ctx.dotted(node.func.value)
+            if not recv:
+                continue
+            if any(_taint_use(ctx, a, scan.tainted_at(node.lineno))
+                   for a in node.args):
+                appended.add(recv)
+    popped: Set[str] = set()
+    if appended:
+        for node in ctx.by_type(ast.Call):
+            if _is_window_pop(node):
+                recv = ctx.dotted(node.func.value)
+                if recv:
+                    popped.add(recv)
+    hit = appended & popped
+    ctx.cache["asy_window_colls"] = hit
+    return hit
+
+
+def _unit_window_role(ctx: FileContext, fn: ast.AST,
+                      colls: Set[str]) -> Tuple[bool, bool]:
+    """``(owns, consumes)`` for one unit: owns = appends to a window
+    collection (the dispatch side), consumes = pops one (the delayed-
+    consumer side). The ASY306-310 rules scope by these roles."""
+    owns = consumes = False
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        recv = ctx.dotted(node.func.value)
+        if recv not in colls:
+            continue
+        if node.func.attr == "append":
+            owns = True
+        elif _is_window_pop(node):
+            consumes = True
+    return owns, consumes
+
+
 def _carry_seg(name: str) -> bool:
     """Names/attributes that ARE pooled device state by the serving
     plane's naming convention: ``carry``, ``dcarry``, ``draft_carry``,
@@ -2524,10 +2631,27 @@ class ClockStraddleRule(Rule):
             "again")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
+        colls = _window_collections(ctx)
         for qual, fn, chain in _hot_units(ctx):
             scan = _asy_scan(ctx, fn)
             if not scan.dispatch_lines:
                 continue
+            # the entry-timestamp idiom is NOT a straddle: a pre-
+            # dispatch clock read riding a window-collection append
+            # (`win.append(Entry(..., t0, ...))`) is consumed by the
+            # DELAYED consumer, which measures elapsed against it
+            # strictly after its own fence — the pin ASY305 wants is
+            # the entry's consumption, and ASY310 checks that side
+            stamped: Set[int] = set()
+            if colls:
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "append"
+                            and ctx.dotted(node.func.value) in colls):
+                        for a in node.args:
+                            for sub in ast.walk(a):
+                                stamped.add(id(sub))
             for name, assigns in scan.clock_assigns.items():
                 for i, a_line in enumerate(assigns):
                     next_assign = assigns[i + 1] if i + 1 < len(assigns) \
@@ -2537,6 +2661,8 @@ class ClockStraddleRule(Rule):
                          if n == name and a_line < ln < next_assign),
                         key=lambda t: t[1])
                     for node, ln in loads:
+                        if id(node) in stamped:
+                            continue
                         bad = any(
                             a_line < d < ln and not any(
                                 d < s <= ln for s in scan.sync_lines)
@@ -2553,6 +2679,400 @@ class ClockStraddleRule(Rule):
                                 f"work",
                                 hint=self.hint)
                             break
+
+
+# ==========================================================================
+# ASY306-310 — the dispatch-ahead discipline (analyzer tier 5).
+#
+# The delayed-consumer refactor (ServingEngine dispatch_ahead=W —
+# docs/serving.md "Dispatch-ahead decode") keeps up to W decode
+# dispatches in flight BEHIND the fence that consumes them. Four
+# orderings make that window wrong and one makes it lie, and each is a
+# static shape: consuming a deferred readback into the SAME step's
+# dispatch (ASY306), re-donating a carry the in-flight window still
+# owns (ASY307), bounding the window by anything but a declared knob
+# (ASY308), an extra fence inside the dispatch side re-serializing the
+# window (ASY309), and a delayed consumer that stopped reading the
+# clock, starving the watchdog and fault replay (ASY310). A "window"
+# is detected structurally — a collection hot units append
+# device-tainted values into AND pop oldest-first from
+# (_window_collections) — so the rules were born BEFORE the refactor
+# landed and gate every future one.
+# ==========================================================================
+
+
+# -- ASY306 — deferred readback consumed into the same step's dispatch ------
+
+@register
+class StaleConsumerRule(Rule):
+    code = "ASY306"
+    name = "stale-consumer"
+    summary = ("a delayed-site fence readback feeds a value back into "
+               "a dispatch LATER in the same unit — consume-before-"
+               "dispatch ordering the window must not have")
+    hint = ("a deferred fence's readback (tokens, finish verdicts, "
+            "ban flips) is W steps STALE — feeding it into the same "
+            "unit's next dispatch silently re-serializes the window "
+            "(the dispatch must wait for the fence) or, worse, chains "
+            "the wrong tokens. Chain steady-state dispatches on the "
+            "previous dispatch's DEVICE handle and keep the fenced "
+            "host values in the delayed consumer's bookkeeping "
+            "(ServingEngine._consume_window); flush the window before "
+            "any dispatch that needs host-consumed state")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        dsites = _delayed_sites(ctx)
+        step_segs = _step_attr_segs(ctx)
+        for qual, fn, chain in _hot_units(ctx):
+            scan = _asy_scan(ctx, fn)
+            fence_ids = {id(node) for node, kind, site in scan.fences
+                         if kind == "fence" and site in dsites}
+            if not fence_ids:
+                continue
+            # names bound FROM a delayed-site fence, with simple
+            # forward propagation through assignments (`toks =
+            # jnp.asarray(nxt)` keeps the taint); name -> bind line
+            bound: Dict[str, int] = {}
+            assigns = sorted(
+                (n for n in ast.walk(fn) if isinstance(n, ast.Assign)),
+                key=lambda n: n.lineno)
+            for node in assigns:
+                names: List[str] = []
+                for t in node.targets:
+                    names.extend(_target_names_of(t))
+                if id(node.value) in fence_ids:
+                    for n in names:
+                        bound.setdefault(n, node.lineno)
+                    continue
+                if isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func,
+                                   (ast.Name, ast.Attribute)):
+                    seg = _last_seg(ctx.dotted(node.value.func))
+                    if seg in _DEVICE_CALL_SEGS or seg in step_segs:
+                        # a dispatch RESULT is a fresh device handle —
+                        # chaining the next dispatch on it is exactly
+                        # the sanctioned steady-state pattern, so the
+                        # stale-host taint stops here (the stale value
+                        # already fired on the dispatch's own args)
+                        continue
+                if any(isinstance(sub, ast.Name) and sub.id in bound
+                       and sub.lineno > bound[sub.id]
+                       for sub in ast.walk(node.value)):
+                    for n in names:
+                        bound.setdefault(n, node.lineno)
+            if not bound:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, (ast.Name, ast.Attribute)):
+                    continue
+                seg = _last_seg(ctx.dotted(f))
+                if seg not in _DEVICE_CALL_SEGS - {"read_row"} and \
+                        seg not in step_segs:
+                    continue
+                hit = next(
+                    (sub for a in list(node.args) +
+                     [kw.value for kw in node.keywords]
+                     for sub in ast.walk(a)
+                     if isinstance(sub, ast.Name) and sub.id in bound
+                     and node.lineno > bound[sub.id]), None)
+                if hit is not None:
+                    yield ctx.finding(
+                        node, self.code,
+                        f"delayed-site fence readback `{hit.id}` "
+                        f"(consumed line {bound[hit.id]}) feeds this "
+                        f"dispatch in `{qual}` (hot via "
+                        f"{' -> '.join(chain)}) — the window must "
+                        f"dispatch from device handles, not "
+                        f"just-fenced host state",
+                        hint=self.hint)
+
+
+# -- ASY307 — carry donated again while the window still owns it ------------
+
+@register
+class WindowDonationRule(Rule):
+    code = "ASY307"
+    name = "window-donation"
+    summary = ("a carry buffer donated to an in-flight (not-yet-"
+               "fenced) dispatch is read or donated again before it "
+               "is rebound — use-after-donate lifted to the multi-"
+               "step window")
+    hint = ("every dispatch DONATES its carry argument (the buffer is "
+            "dead the moment the call is issued — SPMD104/SRV204); "
+            "with W dispatches in flight the live buffer is the LAST "
+            "dispatch's return, so touching the donated spelling "
+            "before rebinding it reads freed memory W steps early. "
+            "Rebind on the same line (`_, carry = dispatch(..., "
+            "carry)`) or immediately commit the returned carry "
+            "(`pool.carry = carry`) before anything else reads it")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        colls = _window_collections(ctx)
+        if not colls:
+            return
+        step_segs = _step_attr_segs(ctx)
+        for qual, fn, chain in _hot_units(ctx):
+            owns, consumes = _unit_window_role(ctx, fn, colls)
+            if not (owns or consumes):
+                continue
+            # (line, kind, dotted, node) timeline of carry donations,
+            # loads, and stores, replayed in line order per spelling
+            events: List[Tuple[int, int, str, str, ast.AST]] = []
+            donated_ids: Set[int] = set()
+            # `_, carry = dispatch(..., carry)` rebinds the donated
+            # spelling in the SAME statement — the sanctioned idiom;
+            # that donation is cleared the instant the call returns
+            rebinds: Dict[int, Set[str]] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    tgts: Set[str] = set()
+                    for t in node.targets:
+                        for sub in ast.walk(t):
+                            d = ctx.dotted(sub)
+                            if d:
+                                tgts.add(d)
+                    rebinds[id(node.value)] = tgts
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, (ast.Name, ast.Attribute)):
+                    seg = _last_seg(ctx.dotted(node.func))
+                    if seg in _DEVICE_CALL_SEGS - {"read_row"} or \
+                            seg in step_segs:
+                        for a in node.args:
+                            d = ctx.dotted(a)
+                            if d and _carry_seg(_last_seg(d)):
+                                if d in rebinds.get(id(node), ()):
+                                    for sub in ast.walk(a):
+                                        donated_ids.add(id(sub))
+                                    continue
+                                # the donation anchors at the ARG's own
+                                # position (multi-line calls), and the
+                                # arg is the donation, not a read of it
+                                for sub in ast.walk(a):
+                                    donated_ids.add(id(sub))
+                                events.append(
+                                    (a.lineno, a.col_offset,
+                                     "donate", d, node))
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for sub in ast.walk(t):
+                            d = ctx.dotted(sub)
+                            if d and _carry_seg(_last_seg(d)):
+                                events.append(
+                                    (node.lineno, -1, "store", d, sub))
+                elif isinstance(node, (ast.Name, ast.Attribute)) and \
+                        isinstance(getattr(node, "ctx", None), ast.Load):
+                    if id(node) in donated_ids:
+                        continue
+                    d = ctx.dotted(node)
+                    if d and _carry_seg(_last_seg(d)):
+                        events.append((node.lineno, node.col_offset,
+                                       "load", d, node))
+            by_name: Dict[str, List] = {}
+            for ev in sorted(events, key=lambda e: (e[0], e[1])):
+                by_name.setdefault(ev[3], []).append(ev)
+            for name, evs in by_name.items():
+                donated_at: Optional[int] = None
+                for line, _col, kind, _d, node in evs:
+                    if kind == "store":
+                        donated_at = None    # rebound: live again
+                        # (a same-line store — `_, c = disp(..., c)` —
+                        # clears the donation it rode in on too)
+                    elif kind == "donate":
+                        if donated_at is not None and line > donated_at:
+                            yield ctx.finding(
+                                node, self.code,
+                                f"carry `{name}` donated again here "
+                                f"while an in-flight dispatch (line "
+                                f"{donated_at}) still owns it, in "
+                                f"`{qual}` (hot via "
+                                f"{' -> '.join(chain)})",
+                                hint=self.hint)
+                            break
+                        donated_at = line
+                    elif kind == "load" and donated_at is not None \
+                            and line > donated_at:
+                        yield ctx.finding(
+                            node, self.code,
+                            f"carry `{name}` read here after being "
+                            f"donated to the in-flight dispatch at "
+                            f"line {donated_at} in `{qual}` (hot via "
+                            f"{' -> '.join(chain)}) — rebind it from "
+                            f"the dispatch's return first",
+                            hint=self.hint)
+                        break
+
+
+# -- ASY308 — window depth not bound by a declared knob ---------------------
+
+@register
+class UnboundedWindowRule(Rule):
+    code = "ASY308"
+    name = "unbounded-window"
+    summary = ("a dispatch-ahead window depth guard that does not "
+               "reference a declared WINDOW_KNOBS engine knob — a "
+               "literal or bare counter bounds the window")
+    hint = ("the window depth is an ENGINE CONTRACT (W=0 must be the "
+            "fence-immediately engine, byte for byte), so every depth "
+            "guard must read a knob from the declared WINDOW_KNOBS "
+            "vocabulary (serving/fences.py — the FENCE_SITES pattern): "
+            "`while len(self._window) > self.dispatch_ahead`. A "
+            "literal depth or a bare loop counter is vocabulary drift "
+            "the W-sweep contracts cannot reach")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        colls = _window_collections(ctx)
+        if not colls:
+            return
+        knobs = _window_knobs(ctx)
+
+        def knob_ref(expr: ast.AST) -> bool:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Attribute) and \
+                        sub.attr.lstrip("_") in knobs:
+                    return True
+                if isinstance(sub, ast.Name) and \
+                        sub.id.lstrip("_") in knobs:
+                    return True
+            return False
+
+        def len_of_window(expr: ast.AST) -> bool:
+            return any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len" and len(sub.args) == 1
+                and ctx.dotted(sub.args[0]) in colls
+                for sub in ast.walk(expr))
+
+        def has_window_append(body_node: ast.AST) -> bool:
+            return any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "append"
+                and ctx.dotted(sub.func.value) in colls
+                for sub in ast.walk(body_node))
+
+        for qual, fn, chain in _hot_units(ctx):
+            owns, _consumes = _unit_window_role(ctx, fn, colls)
+            if not owns:
+                continue       # the consumer's `while window:` drain
+                               # is truthiness, not a depth bound
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.While, ast.If)):
+                    if len_of_window(node.test) and \
+                            not knob_ref(node.test):
+                        yield ctx.finding(
+                            node, self.code,
+                            f"window depth guard "
+                            f"`{ast.unparse(node.test)[:48]}` in "
+                            f"`{qual}` (hot via {' -> '.join(chain)}) "
+                            f"references no declared WINDOW_KNOBS "
+                            f"knob {sorted(knobs)}",
+                            hint=self.hint)
+                elif isinstance(node, ast.For):
+                    if has_window_append(node) and \
+                            not knob_ref(node.iter):
+                        yield ctx.finding(
+                            node, self.code,
+                            f"dispatch-ahead loop "
+                            f"`for {ast.unparse(node.target)} in "
+                            f"{ast.unparse(node.iter)[:40]}` fills a "
+                            f"window in `{qual}` (hot via "
+                            f"{' -> '.join(chain)}) without a "
+                            f"declared WINDOW_KNOBS bound "
+                            f"{sorted(knobs)}",
+                            hint=self.hint)
+
+
+# -- ASY309 — a fence inside the dispatch side of the window ----------------
+
+@register
+class InWindowFenceRule(Rule):
+    code = "ASY309"
+    name = "in-window-fence"
+    summary = ("a fence/fence_wait site other than the declared "
+               "delayed-consumer readback inside a window-DISPATCHING "
+               "unit — re-serializes the window by accident")
+    hint = ("the dispatch side of a dispatch-ahead window must not "
+            "wait on the device AT ALL — any fence there drains the "
+            "whole pipeline before the next dispatch, silently "
+            "turning W back into 0. Exactly the DELAYED_CONSUMER_SITES"
+            " readbacks (serving/fences.py) may be consumed against "
+            "the window, and they belong in the delayed consumer "
+            "(ServingEngine._consume_window), not the dispatch loop; "
+            "move any other sync out of the window-owning unit")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        colls = _window_collections(ctx)
+        if not colls:
+            return
+        dsites = _delayed_sites(ctx)
+        for qual, fn, chain in _hot_units(ctx):
+            owns, _consumes = _unit_window_role(ctx, fn, colls)
+            if not owns:
+                continue
+            scan = _asy_scan(ctx, fn)
+            for node, kind, site in scan.fences:
+                if kind == "fence" and site in dsites:
+                    continue   # the declared delayed readback (W=0
+                               # consumes it inline; ASY306 guards the
+                               # ordering either way)
+                yield ctx.finding(
+                    node, self.code,
+                    f"{kind}:{site or '?'} inside window-dispatching "
+                    f"unit `{qual}` (hot via {' -> '.join(chain)}) — "
+                    f"re-serializes the dispatch-ahead window",
+                    hint=self.hint)
+
+
+# -- ASY310 — delayed consumer without a clock sample -----------------------
+
+@register
+class UnpairedDeferredClockRule(Rule):
+    code = "ASY310"
+    name = "unpaired-deferred-clock"
+    summary = ("a window-consuming unit fences a delayed site without "
+               "reading the engine clock — the deferred sample is "
+               "unpaired, so watchdog + fault replay go blind")
+    hint = ("every deferred fence consumption must advance/read the "
+            "engine's virtual clock: the watchdog's elapsed "
+            "(dispatch t0 -> fence landed) is what catches a stalled "
+            "deferred readback, and byte-identical fault replay keys "
+            "off those clock samples. Bracket the fence with "
+            "`self._clock()` reads (the fence_wait phase + the "
+            "entry-elapsed watchdog sample, as "
+            "ServingEngine._consume_window does)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        colls = _window_collections(ctx)
+        if not colls:
+            return
+        dsites = _delayed_sites(ctx)
+        for qual, fn, chain in _hot_units(ctx):
+            _owns, consumes = _unit_window_role(ctx, fn, colls)
+            if not consumes:
+                continue
+            scan = _asy_scan(ctx, fn)
+            deferred = [node for node, kind, site in scan.fences
+                        if kind == "fence" and site in dsites]
+            if not deferred:
+                continue
+            has_clock = any(
+                isinstance(node, ast.Call) and scan._is_clock_call(node)
+                for node in ast.walk(fn))
+            if not has_clock:
+                yield ctx.finding(
+                    deferred[0], self.code,
+                    f"delayed consumer `{qual}` (hot via "
+                    f"{' -> '.join(chain)}) fences a deferred site "
+                    f"with NO engine-clock read — the watchdog's "
+                    f"elapsed and fault replay lose their sample",
+                    hint=self.hint)
 
 
 # ==========================================================================
@@ -3372,7 +3892,8 @@ def _key_parts(key: ast.AST) -> Optional[List[ast.AST]]:
 
 # -- the sync-point inventory (--report sync-points) ------------------------
 
-_ASY_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305")
+_ASY_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305",
+              "ASY306", "ASY307", "ASY308", "ASY309", "ASY310")
 
 
 def sync_point_inventory(contexts: Sequence[FileContext]) -> List[dict]:
@@ -3390,11 +3911,19 @@ def sync_point_inventory(contexts: Sequence[FileContext]) -> List[dict]:
         if _is_fence_module(ctx):
             continue
         sites = _fence_sites(ctx)
+        dsites = _delayed_sites(ctx)
+        knobs = ", ".join(sorted(_window_knobs(ctx)))
         for qual, fn, chain in _hot_units(ctx):
             scan = _asy_scan(ctx, fn)
             for node, kind, site in scan.fences:
                 if site is not None and site not in sites:
                     continue        # vocabulary drift: listed as ASY302
+                # the window column: which sites sit BEHIND the
+                # dispatch-ahead window (delayed consumer, depth from
+                # the declared knob) vs consumed inline at depth 0
+                window = (f"delayed (depth: {knobs})"
+                          if kind == "fence" and site in dsites
+                          else "inline")
                 out.append({
                     "path": ctx.relpath,
                     "line": node.lineno + ctx.line_base,
@@ -3402,6 +3931,7 @@ def sync_point_inventory(contexts: Sequence[FileContext]) -> List[dict]:
                     "chain": list(chain),
                     "kind": f"{kind}:{site or '?'}",
                     "classification": "declared sync point",
+                    "window": window,
                     "detail": ctx.source_line(node.lineno),
                     "suggestion": (
                         "one batched device_get readback"
@@ -3416,6 +3946,7 @@ def sync_point_inventory(contexts: Sequence[FileContext]) -> List[dict]:
                     "function": "", "chain": [],
                     "kind": f.code,
                     "classification": f.message,
+                    "window": "",
                     "detail": f.source,
                     "suggestion": rule.hint,
                     "suppressed": bool(_SUPPRESS_RE.search(f.source)),

@@ -800,7 +800,7 @@ class DisaggregatedEngine:
                  health: Optional[HealthConfig] = None,
                  transfer_retry: Optional[TransferRetryConfig] = None,
                  autoscaler=None, adapters=None, tier=None,
-                 autopilot=None) -> None:
+                 autopilot=None, dispatch_ahead: int = 0) -> None:
         if decode_pools < 1:
             raise ValueError(
                 f"decode_pools must be >= 1, got {decode_pools}")
@@ -857,7 +857,11 @@ class DisaggregatedEngine:
             DecodeWorker(model, n_slots=decode_slots, transfer=make(i),
                          policy=policy, preemption=preemption,
                          watchdog=watchdog, cancelled=self._cancelled,
-                         claims=self._claims, **shared)
+                         claims=self._claims,
+                         # the window lives in the decode loop; the
+                         # prefill pool drains to handoff every pump,
+                         # so dispatch-ahead has nothing to buy there
+                         dispatch_ahead=dispatch_ahead, **shared)
             for i in range(decode_pools + standby_pools)]
         # pool lifecycle: the first decode_pools workers serve, the
         # rest wait warm on the bench (serving/health.py states)
@@ -1305,6 +1309,12 @@ class DisaggregatedEngine:
         while not self.idle():
             self.step()
         out: Dict[int, np.ndarray] = {}
+        for eng in self._engines():
+            # idle() watches schedulers, not windows: a worker whose
+            # rows all finished can still hold in-flight dispatches —
+            # flush them (split-sample pairing intact) so no device
+            # handle outlives the drain
+            eng.flush_window()
         for eng in self._engines():
             for rid, req in eng._finished.items():
                 if req.state == FINISHED:

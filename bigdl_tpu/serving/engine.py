@@ -64,6 +64,7 @@ cached prefill's own jit cache.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -81,6 +82,31 @@ from bigdl_tpu.serving.sampling import (
 from bigdl_tpu.serving.scheduler import (
     FINISHED, SHED, WAITING, Request, Scheduler,
 )
+
+
+class _InFlight:
+    """One dispatched-but-not-yet-fenced decode step in the engine's
+    dispatch-ahead window: the device token/logprob handles the delayed
+    consumer will read back through the decode fence, plus the host
+    facts frozen
+    at dispatch time that its bookkeeping needs (the row snapshot, the
+    pre-dispatch clock read the watchdog's elapsed is measured from,
+    the sampled/greedy split, and whether rows were already in flight —
+    the decode-gap anchor)."""
+
+    __slots__ = ("tok", "chosen", "active", "active_dev", "rows", "t0",
+                 "n_sampled", "had_running")
+
+    def __init__(self, tok, chosen, active, active_dev, rows, t0,
+                 n_sampled, had_running):
+        self.tok = tok                  # device handle: next 0-based ids
+        self.chosen = chosen            # device handle: chosen logprobs
+        self.active = active            # host bool mask at dispatch
+        self.active_dev = active_dev    # the mask's PLACED device twin
+        self.rows = rows                # {slot: Request} at dispatch
+        self.t0 = t0                    # clock at dispatch (pre-launch)
+        self.n_sampled = n_sampled      # sampled rows in the batch
+        self.had_running = had_running  # decode-gap anchor flag
 
 
 class ServingEngine:
@@ -238,7 +264,8 @@ class ServingEngine:
                  faults=None,
                  adapters=None,
                  tier=None,
-                 autopilot=None) -> None:
+                 autopilot=None,
+                 dispatch_ahead: int = 0) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -270,6 +297,11 @@ class ServingEngine:
         if degrade_at is not None and degrade_at < 0:
             raise ValueError(
                 f"degrade_at must be >= 0 or None, got {degrade_at}")
+        if int(dispatch_ahead) < 0:
+            raise ValueError(
+                f"dispatch_ahead must be >= 0, got {dispatch_ahead} "
+                "(0 = consume each decode readback immediately; W = keep "
+                "up to W decode dispatches in flight behind the fence)")
         if preemption and policy != "priority":
             raise ValueError(
                 "preemption=True requires policy='priority' — victim "
@@ -442,6 +474,17 @@ class ServingEngine:
         # min-tokens ban flip, so the steady-state decode loop reuses
         # the same device arrays instead of re-uploading every step
         self._knobs_device = None
+        # dispatch-ahead window (docs/serving.md "Dispatch-ahead
+        # decode"): up to ``dispatch_ahead`` decode dispatches stay in
+        # flight BEHIND the one being consumed, each chained on the
+        # previous dispatch's device token handle, so the decode-fence
+        # readback of step N overlaps the device work of steps
+        # N+1..N+W. The deque holds _InFlight entries oldest-first; the
+        # delayed consumer (_consume_window) pops them. W=0 keeps the
+        # deque depth at zero across step() calls — dispatch-then-
+        # consume within one step, byte-for-byte the pre-window engine.
+        self.dispatch_ahead = int(dispatch_ahead)
+        self._window: deque = deque()
         # watchdog cold-start grace: the step timeout arms only after
         # one healthy step has completed (see _timed_out)
         self._warm = False
@@ -806,6 +849,17 @@ class ServingEngine:
                 demand = self.scheduler.waiting_higher_than(victim.priority)
                 if demand <= self.pool.free_slots:
                     break
+                if self._window:
+                    # a preemption spill snapshots the victim's DEVICE
+                    # row state — with dispatches in flight the device
+                    # KV is up to W positions AHEAD of the host's
+                    # emitted prefix, so a mid-window spill would
+                    # resume the row desynchronized. Flush first (only
+                    # when a preemption is actually due — the window
+                    # stays hot otherwise), then re-select: the flush
+                    # may have finished the victim or freed its slot.
+                    self._drain_window({})
+                    continue
                 self._preempt_row(victim)
             # deadline-aware preemption (autopilot): evict long-slack
             # running rows so short-deadline FEASIBLE waiters seat
@@ -814,7 +868,14 @@ class ServingEngine:
             # Loss-free like every preemption: latency reorders,
             # tokens never do.
             if self.autopilot is not None:
-                for victim in self.autopilot.deadline_victims(self, now):
+                victims = list(self.autopilot.deadline_victims(self, now))
+                if victims and self._window:
+                    # same mid-window spill hazard; re-select after
+                    # the flush for the same reasons as above
+                    self._drain_window({})
+                    victims = list(
+                        self.autopilot.deadline_victims(self, now))
+                for victim in victims:
                     self._preempt_row(victim)
         n = self.scheduler.admissible(self.pool.free_slots)
         if not n:
@@ -1326,17 +1387,27 @@ class ServingEngine:
         return (jnp.asarray(np.asarray(row_adapter_ids, np.int32)),
                 self._bank_device_arrays())
 
-    def _note_host_step(self, t_begin: float, device_before: float) -> None:
-        """Record the per-super-step HOST share: the step's wall time
-        minus the device phase windows timed inside it (decode/verify
-        dispatch, draft chain). This is the Python the
-        device waits on between dispatches — the number the async
-        dispatch-ahead refactor exists to shrink (``serving/
-        host_step_s``; percentiles in ``summary()``), measured on the
-        engine's clock like every other serving timer."""
+    def _note_host_step(self, t_begin: float, device_before: float,
+                        n_samples: int = 1) -> None:
+        """Record the per-super-step TRUE-HOST residue: the step's wall
+        time minus the fenced-wait windows timed inside it (the
+        ``DEVICE_PHASES`` accumulator — the time the host spent BLOCKED
+        on a fence readback or the draft chain's completion pin). What
+        remains is the Python the device waits on between dispatches —
+        the number the dispatch-ahead window exists to shrink
+        (``serving/host_step_s``; percentiles in ``summary()``),
+        measured on the engine's clock like every other serving timer.
+
+        ``n_samples`` keeps the host_step_s and decode_step_s series
+        comparable sample-for-sample when one super-step consumed
+        SEVERAL window entries (a flush): the residue lands once and
+        the remaining samples are recorded as zeros — the flush's host
+        cost is real but belongs to one wall-clock step."""
         dev = self.metrics.device_seconds - device_before
         self.metrics.add_phase(
             "host_step", max(0.0, (self._clock() - t_begin) - dev))
+        for _ in range(max(0, int(n_samples) - 1)):
+            self.metrics.add_phase("host_step", 0.0)
 
     def _note_decode_gap(self, had_running: bool) -> None:
         """Record the wall gap between consecutive decode (or verify)
@@ -1370,28 +1441,183 @@ class ServingEngine:
             # dispatch sample — recovery paths included (a recovered
             # step's discarded outputs still cost real host time), so
             # the host_step_s and decode_step_s series stay comparable
-            # sample for sample
-            if self.metrics.decode_step_count > ndec0:
-                self._note_host_step(t_step, dev0)
+            # sample for sample. A step that consumed several window
+            # entries (a flush) pads with zero samples to keep the pair
+            # count aligned; a step that consumed none (filling the
+            # window) records nothing — its host cost lands with the
+            # step that eventually fences it.
+            n_new = self.metrics.decode_step_count - ndec0
+            if n_new > 0:
+                self._note_host_step(t_step, dev0, n_samples=n_new)
             # the SLO autopilot's ONE control sample per super-step —
             # after the step's metrics landed, idle steps included
             # (pressure relief mostly happens in lulls)
             if self.autopilot is not None:
                 self.autopilot.sample(self)
 
+    def _account_token(self, slot: int, req: Request, tok0: int,
+                       lp: float, now: float,
+                       emitted: Dict[int, int]) -> Optional[str]:
+        """Host bookkeeping for ONE emitted token (0-based ``tok0``
+        with chosen log-prob ``lp``): append to the request's stream,
+        record it in ``emitted``, stamp the first-token latency, and
+        return the finish verdict (:meth:`_finish_check` — None =
+        still generating). Shared by the decode window's delayed
+        consumer and the speculative super-step's emission loop so the
+        two planes cannot drift on per-token accounting."""
+        tok1 = tok0 + 1                      # back to 1-based ids
+        req.output.append(tok1)
+        req.logprobs.append(lp)
+        emitted[req.req_id] = tok1
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.metrics.on_first_token(now - req.submit_time)
+        return self._finish_check(req)
+
+    def _window_open(self, running) -> bool:
+        """May this step EXTEND the dispatch-ahead window — chain a new
+        decode dispatch on the newest in-flight dispatch's device token
+        handle without fencing anything first? Only when nothing the
+        in-flight dispatches assumed has changed: same rows in the same
+        slots, knobs still the cached device arrays (no ban flip /
+        constraint rewrite invalidated them), no row whose knobs COULD
+        change mid-window (an armed min-tokens ban lifts on a consume;
+        a constrained row rewrites its allow mask every token). Any
+        mismatch answers False and the caller flushes the window
+        through the delayed consumer before dispatching classically."""
+        if not self._window or self.dispatch_ahead < 1:
+            return False
+        if self._knobs_device is None:
+            return False
+        prev = self._window[-1]
+        if len(prev.rows) != len(running):
+            return False
+        for slot, req in prev.rows.items():
+            if running.get(slot) is not req:
+                return False
+            if slot not in self._configured:
+                return False
+            if slot in self._constraints:
+                return False
+            if self._ban_base[slot] and self._knobs["ban"][slot]:
+                return False
+        return True
+
+    def _consume_window(self, emitted: Dict[int, int]) -> bool:
+        """THE delayed consumer: fence the OLDEST in-flight decode
+        dispatch and run its batched host bookkeeping (health verdict,
+        watchdog, metrics, per-token accounting, finish checks). Rows
+        that left ``running`` since the dispatch (finished or evicted
+        out from under the window) have their readback values
+        discarded — per-row independence makes the overshoot
+        harmless. Returns False when the entry was unhealthy: its
+        outputs are discarded, every implicated row is evicted for
+        loss-free replay, and the REST of the window is discarded too
+        (every newer dispatch chained through the poisoned carry)."""
+        entry = self._window.popleft()
+        t_f = self._clock()
+        # ONE batched fence readback per dispatch (THE declared
+        # delayed-consumer site — fences.DELAYED_CONSUMER_SITES; the
+        # (N, V) distribution never crosses to host, only token ids +
+        # chosen log-probs do). The t_f/now bracket is the fenced-wait
+        # sample: the time the host was genuinely BLOCKED here, the
+        # DEVICE_PHASES half of the host_step split.
+        nxt, lps = fence("decode", entry.tok, entry.chosen)
+        now = self._clock()
+        self.metrics.add_phase("fence_wait", now - t_f)
+        # the watchdog's elapsed spans dispatch → readback landed; at
+        # W>0 that window covers host work on other in-flight steps
+        # too, and a stall fault's clock advance at dispatch time is
+        # inside it either way, so step_timeout_s keeps firing
+        elapsed = now - entry.t0
+        self.metrics.add_phase("decode_step", elapsed)
+        running = self.scheduler.running
+        rows = {slot: req for slot, req in entry.rows.items()
+                if running.get(slot) is req}
+        bad = self._step_unhealthy(nxt, lps, entry.active)
+        if bad is None and self._timed_out(elapsed):
+            bad = "timeout"
+        if bad is not None:
+            # outputs discarded; the pooled carry was committed at each
+            # dispatch only so the pool keeps valid (post-donation)
+            # buffers — every implicated row is evicted, so its bytes
+            # die with the slot. Newer in-flight dispatches chained
+            # through the poisoned carry: discard them unfenced. No gap
+            # sample either: a discarded step served no tokens, and the
+            # evicted batch anchors no future gap
+            self._window.clear()
+            self._recover_step(rows, bad)
+            self._last_decode_end = None
+            return False
+        self._warm = True                  # arms the watchdog timeout
+        # HEALTHY steps only: the decode-stall histogram measures gaps
+        # between dispatches that actually served the batch
+        self._note_decode_gap(entry.had_running)
+        # recency stamps feed the tier's cold-first victim selection:
+        # a row decoded this step is never the LRU preemption victim
+        self.scheduler.note_decoded(list(rows))
+        self.metrics.on_step(self.scheduler.queue_depth,
+                             self.pool.occupancy(),
+                             int(entry.active.sum()))
+        self.metrics.on_sample_rows(entry.n_sampled,
+                                    len(entry.rows) - entry.n_sampled)
+        for slot, req in list(rows.items()):
+            tok0 = int(nxt[slot])
+            reason = self._account_token(slot, req, tok0,
+                                         float(lps[slot]), now, emitted)
+            if reason is not None:
+                self._finish_row(req, reason, now)
+            else:
+                req.next_token = tok0
+                self._maybe_flip_ban(slot, req)
+                self._advance_constraint(slot, req)
+        return True
+
+    def _drain_window(self, emitted: Dict[int, int]) -> bool:
+        """Flush every in-flight dispatch through the delayed consumer,
+        oldest first. Returns False when a flushed entry was unhealthy
+        (the consumer then discarded the rest of the window itself)."""
+        while self._window:
+            if not self._consume_window(emitted):
+                return False
+        return True
+
+    def flush_window(self) -> None:
+        """Flush every in-flight dispatch through the delayed consumer
+        OUTSIDE a step() — drain()'s teardown and the disaggregated
+        front end's — with the host/device split pairing intact: the
+        flush records one host_step_s sample per consumed entry, so
+        the host_step_s and decode_step_s series stay comparable
+        sample for sample no matter who drove the flush."""
+        if not self._window:
+            return
+        t0 = self._clock()
+        dev0 = self.metrics.device_seconds
+        ndec0 = self.metrics.decode_step_count
+        self._drain_window({})
+        n_new = self.metrics.decode_step_count - ndec0
+        if n_new > 0:
+            self._note_host_step(t0, dev0, n_samples=n_new)
+
     def _step_impl(self) -> Dict[int, int]:
         import jax.numpy as jnp
 
+        emitted: Dict[int, int] = {}
         had_running = bool(self.scheduler.running)
         self._admit()
         if self.admitter is not None:
             self.admitter.pump()
         running = self.scheduler.running
         if not running:
-            # no decode dispatch this step: a gap measured across an
-            # empty batch would be idle time, not a stall
+            # nothing to dispatch: flush any leftover in-flight work
+            # first (rows that finished out from under the window —
+            # the consumer's row filter discards their readbacks),
+            # then report idle. No decode dispatch this step: a gap
+            # measured across an empty batch would be idle time, not
+            # a stall
+            self._drain_window(emitted)
             self._last_decode_end = None
-            return {}
+            return emitted
         if self._spec is not None:
             slots = list(running)
             out = self._spec.step(running)
@@ -1405,27 +1631,58 @@ class ServingEngine:
             else:
                 self._last_decode_end = None
             return out
-        N = self.pool.n_slots
-        tokens = np.zeros((N,), np.int32)
-        active = np.zeros((N,), bool)
-        n_sampled = 0
-        for slot, req in list(running.items()):
-            if slot not in self._configured:
-                try:
-                    self._configure_slot(slot, req)
-                except FaultError:
-                    # slot configuration dispatches device work (the
-                    # speculative draft prefill) — a fault there evicts
-                    # exactly this row for loss-free replay; the rest
-                    # of the batch decodes without it
-                    self._recover_admission([(slot, req)])
-                    continue
-            tokens[slot] = req.next_token
-            active[slot] = True
-            n_sampled += not req.sampling.is_greedy
-        if not active.any():
-            self._last_decode_end = None
-            return {}
+        if self._window_open(running):
+            # STEADY-STATE window extension: nothing the in-flight
+            # dispatches assumed changed, so the next dispatch chains
+            # directly on the newest dispatch's device token handle —
+            # exactly the value its delayed consumer will set
+            # req.next_token to — and reuses its placed active mask.
+            # No host→device token upload, no fence, no readback: the
+            # device stays fed while step N-W's readback is in flight.
+            prev = self._window[-1]
+            tokens_dev = prev.tok
+            active = prev.active
+            active_dev = prev.active_dev
+            rows = dict(prev.rows)
+            n_sampled = prev.n_sampled
+        else:
+            # the window's assumptions broke (admission, finish, evict,
+            # knob change) or it is empty: flush everything in flight
+            # through the delayed consumer, then dispatch classically
+            # from host-built token rows
+            if not self._drain_window(emitted):
+                # a flushed entry was unhealthy — recovery evicted the
+                # batch and discarded the window; nothing to dispatch
+                return emitted
+            running = self.scheduler.running   # a flush may finish rows
+            if not running:
+                self._last_decode_end = None
+                return emitted
+            N = self.pool.n_slots
+            tokens = np.zeros((N,), np.int32)
+            active = np.zeros((N,), bool)
+            n_sampled = 0
+            for slot, req in list(running.items()):
+                if slot not in self._configured:
+                    try:
+                        self._configure_slot(slot, req)
+                    except FaultError:
+                        # slot configuration dispatches device work (the
+                        # speculative draft prefill) — a fault there
+                        # evicts exactly this row for loss-free replay;
+                        # the rest of the batch decodes without it
+                        self._recover_admission([(slot, req)])
+                        continue
+                tokens[slot] = req.next_token
+                active[slot] = True
+                n_sampled += not req.sampling.is_greedy
+            if not active.any():
+                self._last_decode_end = None
+                return emitted
+            tokens_dev = self._place_rows(jnp.asarray(tokens))
+            active_dev = self._place_rows(jnp.asarray(active))
+            rows = {slot: req for slot, req in running.items()
+                    if active[slot]}
         t0 = self._clock()
         if self._knobs_device is None:
             self._knobs_device = {k: self._place_rows(jnp.asarray(v))
@@ -1434,69 +1691,37 @@ class ServingEngine:
         try:
             tok, chosen, carry = self._dispatch(
                 "decode", self._step_fn,
-                self.params, self._place_rows(jnp.asarray(tokens)),
-                self._place_rows(jnp.asarray(active)),
+                self.params, tokens_dev, active_dev,
                 self.pool.carry, knobs, *self._adapter_args())
         except FaultError:
             # the dispatch failed BEFORE running: the pooled carry was
-            # never donated and stays valid — evict + replay the rows
-            # (no gap sample: nothing dispatched, and the evicted
-            # batch anchors no future gap)
-            self._recover_step(running, "fail")
+            # never donated and stays valid. Everything already in the
+            # window was dispatched BEFORE the fault and is healthy —
+            # flush it through the delayed consumer (its tokens are
+            # real), THEN evict + replay whatever rows remain (no gap
+            # sample for the failed dispatch: nothing dispatched, and
+            # the evicted batch anchors no future gap)
+            self._drain_window(emitted)
+            self._recover_step(self.scheduler.running, "fail")
             self._last_decode_end = None
-            return {}
+            return emitted
         self.pool.carry = carry
         # the (N, V) distribution never crosses to host — sampling is
-        # fused into the step; only token ids + chosen log-probs do,
-        # through ONE batched fence readback (THE declared per-step
-        # sync point — serving/fences.py; one device_get of the pair
-        # instead of two np.asarray round-trips, and it syncs the
-        # dispatch so the watchdog's elapsed time covers the device
-        # work, not just the launch)
-        nxt, lps = fence("decode", tok, chosen)
-        elapsed = self._clock() - t0
-        self.metrics.add_phase("decode_step", elapsed)
-        bad = self._step_unhealthy(nxt, lps, active)
-        if bad is None and self._timed_out(elapsed):
-            bad = "timeout"
-        if bad is not None:
-            # outputs discarded; the returned carry is committed only
-            # so the pool keeps valid (post-donation) buffers — every
-            # implicated row is evicted, so its bytes die with the slot.
-            # No gap sample either: a discarded step served no tokens,
-            # and the evicted batch anchors no future gap
-            self._recover_step(running, bad)
-            self._last_decode_end = None
-            return {}
-        self._warm = True                  # arms the watchdog timeout
-        # HEALTHY steps only: the decode-stall histogram measures gaps
-        # between dispatches that actually served the batch
-        self._note_decode_gap(had_running)
-        # recency stamps feed the tier's cold-first victim selection:
-        # a row decoded this step is never the LRU preemption victim
-        self.scheduler.note_decoded(list(running))
-        self.metrics.on_step(self.scheduler.queue_depth,
-                             self.pool.occupancy(), int(active.sum()))
-        self.metrics.on_sample_rows(n_sampled, len(running) - n_sampled)
-
-        emitted: Dict[int, int] = {}
-        now = self._clock()
-        for slot, req in list(running.items()):
-            tok0 = int(nxt[slot])
-            tok1 = tok0 + 1                      # back to 1-based ids
-            req.output.append(tok1)
-            req.logprobs.append(float(lps[slot]))
-            emitted[req.req_id] = tok1
-            if req.first_token_time is None:
-                req.first_token_time = now
-                self.metrics.on_first_token(now - req.submit_time)
-            reason = self._finish_check(req)
-            if reason is not None:
-                self._finish_row(req, reason, now)
-            else:
-                req.next_token = tok0
-                self._maybe_flip_ban(slot, req)
-                self._advance_constraint(slot, req)
+        # fused into the step; only token ids + chosen log-probs will,
+        # through ONE batched fence readback at this entry's DELAYED
+        # consumption (_consume_window — THE declared delayed-consumer
+        # site, serving/fences.py). t0 rides the entry so the
+        # watchdog's elapsed covers the device work, not the launch
+        self._window.append(_InFlight(tok, chosen, active, active_dev,
+                                      rows, t0, n_sampled, had_running))
+        # delayed consumer: fence the oldest entry once the window
+        # exceeds its DECLARED depth knob (fences.WINDOW_KNOBS —
+        # ASY308 rejects any other bound). dispatch_ahead=0 consumes
+        # the entry just appended: dispatch-then-fence within one
+        # step, byte-for-byte the pre-window engine
+        while len(self._window) > self.dispatch_ahead:
+            if not self._consume_window(emitted):
+                break
         return emitted
 
     def drain(self) -> Dict[int, np.ndarray]:
@@ -1506,6 +1731,11 @@ class ServingEngine:
         evicted some)."""
         while not self.scheduler.idle():
             self.step()
+        # the last consume can finish every row while NEWER dispatches
+        # are still in flight (their readbacks belong to finished rows
+        # — pure overshoot): flush them so no device handle outlives
+        # the drain. The consumer's row filter discards every token.
+        self.flush_window()
         return {rid: np.asarray(r.output, np.int32)
                 for rid, r in self._finished.items()
                 if r.state == FINISHED}

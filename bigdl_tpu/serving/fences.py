@@ -44,7 +44,9 @@ from __future__ import annotations
 #: frozenset (cross-module) and ASY302 flags both unknown site strings
 #: and ``block_until_ready`` spelled outside this module.
 FENCE_SITES = frozenset({
-    "decode",    # the per-step token/logprob readback (engine.step)
+    "decode",    # the per-step token/logprob readback — consumed by the
+                 # engine's DELAYED consumer (the dispatch-ahead window;
+                 # see DELAYED_CONSUMER_SITES below)
     "verify",    # the speculative super-step's verify readback
     "draft",     # completion of the chained draft dispatches (timing)
     "prefill",   # vocabulary-reserved: the prefill completion fences
@@ -56,6 +58,41 @@ FENCE_SITES = frozenset({
                  # one is a diff, not a vocabulary change
     "transfer",  # KV-row handoff serialization (disagg.pack_payload):
                  # one batched readback of every payload leaf
+})
+
+
+#: THE closed dispatch-ahead vocabulary, the FENCE_SITES pattern lifted
+#: to the multi-step window (PR 20 — the cashed-in async refactor).
+#:
+#: ``WINDOW_KNOBS`` names the engine knobs a dispatch-ahead window may
+#: be bounded by: the analyzer's ASY308 demands every window-depth
+#: guard (a ``len(<window>)`` comparison controlling dispatch or
+#: consumption) reference one of these attributes — a bare loop
+#: counter or a literal depth is vocabulary drift, exactly like an
+#: unknown fence site string.
+WINDOW_KNOBS = frozenset({
+    "dispatch_ahead",   # ServingEngine(dispatch_ahead=W): in-flight
+                        # decode dispatches beyond the one being
+                        # consumed (W=0 = consume-immediately, the
+                        # pre-window engine)
+})
+
+#: ``DELAYED_CONSUMER_SITES`` names the fence sites whose readback is
+#: allowed to sit BEHIND the window — consumed by the delayed consumer
+#: one-or-more dispatches after it was issued. Exactly the sites here
+#: may appear in a window-consuming unit; any other fence reachable
+#: from a window-DISPATCHING unit re-serializes the window by accident
+#: and ASY309 flags it. The census in tests/test_serving_async.py
+#: proves the serving tree has exactly ONE such site.
+DELAYED_CONSUMER_SITES = frozenset({
+    "decode",   # the engine's per-step token/logprob readback — THE
+                # delayed-consumer site (ServingEngine._consume_window
+                # fences the OLDEST in-flight dispatch while newer
+                # ones keep the device fed). The speculative plane's
+                # "verify" site stays an immediate consumer: each
+                # super-step's draft budgets are a host decision made
+                # from the previous verify readback, so its window
+                # depth is structurally 0 (docs/serving.md).
 })
 
 

@@ -570,14 +570,22 @@ class ServingMetrics:
             self.metrics.add("serving/prefix_hit_tokens",
                              float(matched_tokens))
 
-    #: phases timed around fenced DEVICE work — everything else a step
-    #: spends is host Python (scheduling, admission bookkeeping,
-    #: per-token accounting). The prefill/draft_prefill phases left
-    #: this set when their completion fences were deleted (the PR 12
-    #: worksheet's cashed-in "deletable" entries): prefill dispatches
-    #: now overlap the decode step and their device time lands inside
-    #: the step's one decode/verify fence window.
-    DEVICE_PHASES = frozenset({"decode_step", "draft"})
+    #: phases during which the host is genuinely BLOCKED on device
+    #: completion — everything else a step spends is host Python
+    #: (scheduling, admission bookkeeping, per-token accounting).
+    #: The prefill/draft_prefill phases left this set when their
+    #: completion fences were deleted (the PR 12 worksheet's cashed-in
+    #: "deletable" entries). The dispatch-ahead refactor (PR 20) moved
+    #: ``decode_step`` out too: under a window the dispatch→consume
+    #: elapsed OVERLAPS host work on other in-flight steps, so summing
+    #: it as "device" would double-count against the step wall and the
+    #: host_step residue would lie at W>0. What remains is exactly the
+    #: blocked time: ``fence_wait`` (the bracket around each fence
+    #: readback — the delayed consumer's actual stall) and ``draft``
+    #: (the chain's completion pin). ``decode_step`` samples still
+    #: land (the service-time estimator and the step windows read
+    #: them); they just stop feeding ``device_seconds``.
+    DEVICE_PHASES = frozenset({"fence_wait", "draft"})
 
     def add_phase(self, name: str, seconds: float) -> None:
         self.metrics.add(f"serving/{name}_s", float(seconds))

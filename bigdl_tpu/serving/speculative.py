@@ -376,9 +376,18 @@ class Speculator:
         eng.pool.carry = carry
         # ONE batched fence readback for the whole verify result —
         # tokens, log-probs, emit counts cross to host together
-        # (serving/fences.py) instead of as three separate syncs
+        # (serving/fences.py) instead of as three separate syncs. The
+        # verify site stays an IMMEDIATE consumer (window depth
+        # structurally 0 — fences.DELAYED_CONSUMER_SITES): next
+        # super-step's draft budgets are a host decision made from
+        # THIS readback, so there is nothing to dispatch ahead of it.
+        # The t_f bracket is the fenced-wait sample — the blocked half
+        # of the host_step split (metrics.DEVICE_PHASES)
+        t_f = eng._clock()
         nxt, lps, nem = fence("verify", vt, vlp, n_emit)
-        eng.metrics.add_phase("decode_step", eng._clock() - t0)
+        now_f = eng._clock()
+        eng.metrics.add_phase("fence_wait", now_f - t_f)
+        eng.metrics.add_phase("decode_step", now_f - t0)
         bad = self._chunk_unhealthy(nxt, lps, nem, lengths, active)
         if bad is None and eng._timed_out(eng._clock() - t_start):
             bad = "timeout"
@@ -419,15 +428,14 @@ class Speculator:
             m = int(nem[slot])
             reason = None
             for j in range(m):
-                tok1 = int(nxt[slot, j]) + 1        # back to 1-based
-                req.output.append(tok1)
-                req.logprobs.append(float(lps[slot, j]))
-                emitted[req.req_id] = tok1
+                # the engine's shared per-token accounting
+                # (_account_token): append + emitted + first-token
+                # latency + finish verdict — one spelling for the
+                # decode window's delayed consumer and this loop
+                reason = eng._account_token(
+                    slot, req, int(nxt[slot, j]),
+                    float(lps[slot, j]), now, emitted)
                 n_landed += 1
-                if req.first_token_time is None:
-                    req.first_token_time = now
-                    eng.metrics.on_first_token(now - req.submit_time)
-                reason = eng._finish_check(req)
                 if reason is not None:
                     break
             if reason is not None:

@@ -1,0 +1,81 @@
+"""ASY309 in-window-fence: a blocking sync (any ``fence``/
+``fence_wait`` that is not the declared delayed-consumer readback)
+inside a unit that OWNS the dispatch-ahead window — the sync lands
+between the window's dispatches and re-serializes exactly the overlap
+the window exists to buy.  The delayed consumer itself and hot units
+that do not own the window are the false-positive guards."""
+
+import time
+from collections import deque
+
+from bigdl_tpu.models.transformer import (
+    get_batch_decode_step, get_prefill_step)
+from bigdl_tpu.serving.fences import fence, fence_wait
+
+
+class _Entry:
+    def __init__(self, tok, chosen):
+        self.tok = tok
+        self.chosen = chosen
+
+
+class InWindowFenceEngine:
+    def __init__(self, model, dtype, clock=time.perf_counter):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._prefill_fn = get_prefill_step(model, dtype)
+        self._faults = None
+        self._clock = clock
+        self.dispatch_ahead = 2
+        self._win = deque()
+        self.phases = {}
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        tok, lp = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, knobs)
+        # blocks on THIS step's launch before the next dispatch can be
+        # issued — the window never holds more than one step
+        fence_wait("draft", tok)                    # EXPECT: ASY309
+        self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+
+    def probe_step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        tok, lp = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, knobs)
+        self._win.append(_Entry(tok, lp))
+        # an eager metrics probe: a readback at a non-delayed site is
+        # still a blocking sync inside the owning unit
+        probe, = fence("verify", lp)                # EXPECT: ASY309
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+
+    def _consume(self):
+        # the ONE declared delayed readback — exempt (this unit
+        # consumes the window, it does not own it)
+        e = self._win.popleft()
+        t_f = self._clock()
+        nxt, lps = fence("decode", e.tok, e.chosen)
+        self.phases["fence_wait"] = self._clock() - t_f
+
+    def admit(self, params, prompt, carry):  # analysis: hotpath-root
+        # a hot unit that never touches the window may block freely —
+        # admission waits on prefill before the slot enters the pool
+        out, carry = self._dispatch(
+            "decode", self._prefill_fn, params, prompt, carry)
+        carry = fence_wait("prefill", carry)
+        return out, carry
+
+
+def drain_blocking(engine, params, tokens, active, knobs):
+    """Cold twin: a shutdown path may sync mid-window on purpose —
+    unreachable from a hot root, exempt."""
+    tok, lp = engine._dispatch(
+        "decode", engine._step_fn, params, tokens, active, knobs)
+    fence_wait("draft", tok)
+    engine._win.append(_Entry(tok, lp))

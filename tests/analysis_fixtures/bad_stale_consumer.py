@@ -1,0 +1,82 @@
+"""ASY306 stale-consumer: a delayed-site fence readback fed back into
+a LATER dispatch in the same hot unit — the consume-before-dispatch
+ordering that re-serializes the dispatch-ahead window (the dispatch
+must wait for the fence) and ships host state that is W steps stale.
+Chaining on the previous dispatch's DEVICE handle and the unreachable
+replay harness are the false-positive guards."""
+
+import time
+from collections import deque
+
+import jax.numpy as jnp
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence
+
+
+class _Entry:
+    def __init__(self, tok, chosen):
+        self.tok = tok
+        self.chosen = chosen
+
+
+class StaleWindowEngine:
+    def __init__(self, model, dtype, clock=time.perf_counter):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+        self._clock = clock
+        self.dispatch_ahead = 2
+        self._win = deque()
+        self.phases = {}
+        self.carry = None
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # steady state here consumes the DEFERRED readback and re-uploads
+        # it as the next dispatch's tokens — every window step now blocks
+        # on the fence before it can launch (W buys nothing), and the
+        # re-uploaded tokens lag the in-flight dispatches by W steps
+        if self._win:
+            e = self._win.popleft()
+            t_f = self._clock()
+            nxt, lps = fence("decode", e.tok, e.chosen)
+            self.phases["fence_wait"] = self._clock() - t_f
+            toks = jnp.asarray(nxt)            # stale host state, re-uploaded
+            tok, lp = self._dispatch(          # EXPECT: ASY306
+                "decode", self._step_fn, params, toks, active, knobs)
+        else:
+            tok, lp = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, knobs)
+        self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            e = self._win.popleft()
+            t_f = self._clock()
+            nxt, lps = fence("decode", e.tok, e.chosen)
+            self.phases["fence_wait"] = self._clock() - t_f
+
+    def steady_step(self, params, active, knobs):  # analysis: hotpath-root
+        # the sanctioned spelling: chain on the previous dispatch's
+        # DEVICE handle — no fence on the dispatch path, no staleness
+        prev = self._win[-1]
+        tok, lp = self._dispatch(
+            "decode", self._step_fn, params, prev.tok, active, knobs)
+        self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            e = self._win.popleft()
+            t_f = self._clock()
+            nxt, lps = fence("decode", e.tok, e.chosen)
+            self.phases["fence_wait"] = self._clock() - t_f
+
+
+def replay_consumer(engine, params, tokens, active, knobs):
+    """Cold twin: a replay harness legitimately re-feeds fenced tokens
+    through the step function — unreachable from a hot root, exempt."""
+    nxt, lps = fence("decode", tokens, active)
+    toks = jnp.asarray(nxt)
+    return engine._dispatch(
+        "decode", engine._step_fn, params, toks, active, knobs)

@@ -1,0 +1,82 @@
+"""ASY308 unbounded-window: the dispatch-ahead window's depth bound
+spelled as a literal (or any non-knob expression) instead of the
+declared engine knob — the analyzer can no longer tie the in-flight
+depth to configuration, and a drive-by edit can silently deepen the
+window past what the SLO math (and the watchdog budget) assumed.
+Knob-bounded loops and the consumer's truthiness drain are the
+false-positive guards."""
+
+import time
+from collections import deque
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence
+
+
+class _Entry:
+    def __init__(self, tok, chosen):
+        self.tok = tok
+        self.chosen = chosen
+
+
+class UnboundedWindowEngine:
+    def __init__(self, model, dtype, clock=time.perf_counter):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+        self._clock = clock
+        self.dispatch_ahead = 2
+        self._win = deque()
+        self.phases = {}
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # a literal depth bound: the window grows to 4 regardless of
+        # what dispatch_ahead says
+        while len(self._win) < 4:                  # EXPECT: ASY308
+            tok, lp = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, knobs)
+            self._win.append(_Entry(tok, lp))
+        self._consume()
+
+    def burst(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # a fixed-trip fill loop and a literal high-water check — both
+        # detach the in-flight depth from the declared knob
+        for _ in range(3):                          # EXPECT: ASY308
+            tok, lp = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, knobs)
+            self._win.append(_Entry(tok, lp))
+        if len(self._win) > 6:                      # EXPECT: ASY308
+            self._consume()
+
+    def knob_step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # the sanctioned spellings: depth checks and fill loops that
+        # reference the declared knob
+        for _ in range(self.dispatch_ahead):
+            tok, lp = self._dispatch(
+                "decode", self._step_fn, params, tokens, active, knobs)
+            self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+
+    def _consume(self):
+        # the consumer's drain-everything spelling needs no knob — it
+        # only shrinks the window
+        while self._win:
+            e = self._win.popleft()
+            t_f = self._clock()
+            nxt, lps = fence("decode", e.tok, e.chosen)
+            self.phases["fence_wait"] = self._clock() - t_f
+
+
+def fill_to_depth(engine, params, tokens, active, knobs, depth=4):
+    """Cold twin: a bench harness fills to an arbitrary depth on
+    purpose — unreachable from a hot root, exempt."""
+    while len(engine._win) < depth:
+        tok, lp = engine._dispatch(
+            "decode", engine._step_fn, params, tokens, active, knobs)
+        engine._win.append(_Entry(tok, lp))

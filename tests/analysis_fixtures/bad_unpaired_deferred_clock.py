@@ -1,0 +1,75 @@
+"""ASY310 unpaired-deferred-clock: a delayed consumer (the unit that
+pops the window and fences the deferred readback) with NO engine-clock
+read anywhere — the fence wait is invisible to the phase timers and
+the watchdog, so a stalled in-flight dispatch hangs the engine with no
+deadline to trip.  The clock-bracketed consumer and the cold drain are
+the false-positive guards."""
+
+import time
+from collections import deque
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence
+
+
+class _Entry:
+    def __init__(self, tok, chosen):
+        self.tok = tok
+        self.chosen = chosen
+
+
+class BlindConsumerEngine:
+    def __init__(self, model, dtype, clock=time.perf_counter):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+        self._clock = clock
+        self.dispatch_ahead = 2
+        self._win = deque()
+        self.phases = {}
+        self.emitted = {}
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        tok, lp = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, knobs)
+        self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+        self._timed_consume()
+
+    def _consume(self):
+        # no clock read anywhere in this consumer: the deferred fence's
+        # wait never reaches the timers or the watchdog
+        e = self._win.popleft()
+        nxt, lps = fence("decode", e.tok, e.chosen)  # EXPECT: ASY310
+        self._account(nxt, lps)
+
+    def _timed_consume(self):
+        # the sanctioned spelling: the consumer brackets the deferred
+        # fence with the engine clock, so the wait lands in the phase
+        # timers and the watchdog's elapsed budget
+        if not self._win:
+            return
+        e = self._win.popleft()
+        t_f = self._clock()
+        nxt, lps = fence("decode", e.tok, e.chosen)
+        self.phases["fence_wait"] = self._clock() - t_f
+        self._account(nxt, lps)
+
+    def _account(self, nxt, lps):
+        for slot in range(nxt.shape[0]):
+            self.emitted[slot] = (int(nxt[slot]), float(lps[slot]))
+
+
+def drain_all(engine):
+    """Cold twin: a teardown drain needs no timers — unreachable from
+    a hot root, exempt."""
+    while engine._win:
+        e = engine._win.popleft()
+        nxt, lps = fence("decode", e.tok, e.chosen)
+        engine._account(nxt, lps)

@@ -1,0 +1,100 @@
+"""ASY307 window-donation: a carry buffer donated to an in-flight
+(not-yet-fenced) dispatch is donated AGAIN or read before being
+rebound — use-after-donate lifted to the multi-step window, where the
+live buffer is the LAST dispatch's return.  Same-statement rebinding,
+commit-before-reuse, and the window-free twin are the false-positive
+guards."""
+
+import time
+from collections import deque
+
+from bigdl_tpu.models.transformer import get_batch_decode_step
+from bigdl_tpu.serving.fences import fence
+
+
+class _Entry:
+    def __init__(self, tok, chosen):
+        self.tok = tok
+        self.chosen = chosen
+
+
+class DonationWindowEngine:
+    def __init__(self, model, dtype, clock=time.perf_counter):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, dtype, sampling=True)
+        self._faults = None
+        self._clock = clock
+        self.dispatch_ahead = 2
+        self._win = deque()
+        self.phases = {}
+        self.carry = None
+        self.vcarry = None
+        self.dcarry = None
+        self.stash = None
+
+    def _dispatch(self, site, fn, *args):
+        if self._faults is None:
+            return fn(*args)
+        return self._faults.call(site, fn, *args)
+
+    def step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # the first dispatch donates self.carry; before its return is
+        # committed, the SECOND dispatch donates the same (now freed)
+        # buffer — with the window open the first is still in flight
+        tok, lp, new_carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active,
+            self.carry, knobs)
+        tok2, lp2, newer = self._dispatch(     # EXPECT: ASY307
+            "decode", self._step_fn, params, tokens, active,
+            self.carry, knobs)
+        self.carry = newer
+        self._win.append(_Entry(tok2, lp2))
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+
+    def spill_step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # the donated buffer is READ (spilled) before the rebind — the
+        # spill copies freed memory while the dispatch is in flight
+        tok, lp, vcarry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active,
+            self.vcarry, knobs)
+        self.stash = self.vcarry               # EXPECT: ASY307
+        self.vcarry = vcarry
+        self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+
+    def clean_step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        # commit-before-reuse: the returned carry is rebound before
+        # anything else touches the spelling
+        tok, lp, carry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active,
+            self.carry, knobs)
+        self.carry = carry
+        self.stash = self.carry                # read AFTER the rebind: live
+        # same-statement rebinding: `_, c = dispatch(..., c)` — the
+        # donation is cleared the instant the call returns
+        dcarry = self.dcarry
+        lp2, dcarry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, dcarry)
+        lp3, dcarry = self._dispatch(
+            "decode", self._step_fn, params, tokens, active, dcarry)
+        self.dcarry = dcarry
+        self._win.append(_Entry(tok, lp))
+        while len(self._win) > self.dispatch_ahead:
+            self._consume()
+
+    def _consume(self):
+        e = self._win.popleft()
+        t_f = self._clock()
+        nxt, lps = fence("decode", e.tok, e.chosen)
+        self.phases["fence_wait"] = self._clock() - t_f
+
+
+def replay_double_donate(engine, params, tokens, active, knobs):
+    """Cold twin: a debugging harness may re-donate deliberately (e.g.
+    bisecting a donation bug) — unreachable from a hot root, exempt."""
+    engine._dispatch("decode", engine._step_fn, params, tokens, active,
+                     engine.carry, knobs)
+    return engine._dispatch("decode", engine._step_fn, params, tokens,
+                            active, engine.carry, knobs)

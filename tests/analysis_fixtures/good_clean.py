@@ -214,3 +214,68 @@ class LockstepEngine:
             self.channel.send(slot)
         self.store.put(f"row/{0}/{self.pid}", out)   # pid-namespaced key
         return out, norm, self._clock() - t0
+
+
+# -- dispatch-ahead window spellings (ASY306-310) ---------------------------
+
+from collections import deque
+
+
+class _InFlightEntry:
+    def __init__(self, tok, chosen, t0):
+        self.tok = tok
+        self.chosen = chosen
+        self.t0 = t0
+
+
+class DispatchAheadEngine:
+    """The window spellings the ASY306-310 tier must never flag: depth
+    bound by the declared knob, steady-state dispatch chained on the
+    previous dispatch's DEVICE handle, exactly the declared delayed
+    readback behind the window, clock-bracketed consumption (with the
+    entry timestamp riding the append — ASY305's entry-stamp
+    exemption), and donate-then-commit carry hygiene."""
+
+    def __init__(self, model, clock):
+        self._step_fn, self._pool_init = get_batch_decode_step(
+            model, None, sampling=True)
+        self._clock = clock
+        self.dispatch_ahead = 2
+        self._win = deque()
+        self.carry = None
+        self.metrics = ServingMetrics()
+
+    def _dispatch(self, site, fn, *args):
+        return fn(*args)
+
+    def step(self, params, tokens, active, knobs):  # analysis: hotpath-root
+        if self._win:
+            toks = self._win[-1].tok       # device chain: no fence, no upload
+        else:
+            toks = tokens
+        t0 = self._clock()
+        tok, chosen, carry = self._dispatch(
+            "decode", self._step_fn, params, toks, active,
+            self.carry, knobs)
+        self.carry = carry                 # committed before anything reads it
+        self._win.append(_InFlightEntry(tok, chosen, t0))   # t0 rides the entry
+        emitted = {}
+        while len(self._win) > self.dispatch_ahead:   # the declared knob
+            self._consume(emitted)
+        return emitted
+
+    def _consume(self, emitted):
+        e = self._win.popleft()
+        t_f = self._clock()
+        # THE declared delayed readback, clock-bracketed: the wait and
+        # the cross-step elapsed both land in the phase timers
+        nxt, lps = fence("decode", e.tok, e.chosen)
+        now = self._clock()
+        self.metrics.add_phase("fence_wait", now - t_f)
+        self.metrics.add_phase("decode_step", now - e.t0)
+        for slot in range(nxt.shape[0]):
+            emitted[slot] = (int(nxt[slot]) + 1, float(lps[slot]))
+
+    def flush(self, emitted):  # analysis: hotpath-root
+        while self._win:                   # truthiness drain only shrinks
+            self._consume(emitted)

@@ -384,6 +384,22 @@ def test_host_step_split_metric():
     assert n2 == n2_dec and eng2.metrics.metrics.get(
         "serving/retries")[1] > 0
 
+    # the split's windowed half: at dispatch_ahead>0 the residue's
+    # device side is the BLOCKED time (fence_wait — decode_step
+    # overlaps host work under a window and no longer feeds
+    # device_seconds), fence_wait pairs one for one with decode_step,
+    # and the pairing survives the drain teardown's out-of-step flush
+    eng3 = ServingEngine(_make_lm(), n_slots=2, dispatch_ahead=2)
+    eng3.submit([3, 7], max_new_tokens=4)
+    eng3.submit([5, 2], max_new_tokens=4)
+    eng3.drain()
+    _, n3 = eng3.metrics.metrics.get("serving/host_step_s")
+    _, n3_dec = eng3.metrics.metrics.get("serving/decode_step_s")
+    _, n3_fence = eng3.metrics.metrics.get("serving/fence_wait_s")
+    assert n3 == n3_dec == n3_fence
+    assert "decode_step" not in eng3.metrics.DEVICE_PHASES
+    assert "fence_wait" in eng3.metrics.DEVICE_PHASES
+
 
 def test_batch_decode_step_matches_single_row(rng):
     """Per-row-position decode: a row stepped inside a shared pool (other

@@ -29,11 +29,13 @@ BASELINE = REPO / "analysis_baseline.txt"
 
 BAD_FIXTURES = sorted(FIXTURES.glob("bad_*.py"))
 ALL_CODES = ("ASY301", "ASY302", "ASY303", "ASY304", "ASY305",
+             "ASY306", "ASY307", "ASY308", "ASY309", "ASY310",
              "MH401", "MH402", "MH403", "MH404", "MH405",
              "SPMD101", "SPMD102", "SPMD103", "SPMD104", "SPMD105",
              "SPMD106", "SRV201", "SRV202", "SRV203", "SRV204", "SRV205",
              "SRV206", "SRV207", "SRV208")
-ASY_CODES = ["ASY301", "ASY302", "ASY303", "ASY304", "ASY305"]
+ASY_CODES = ["ASY301", "ASY302", "ASY303", "ASY304", "ASY305",
+             "ASY306", "ASY307", "ASY308", "ASY309", "ASY310"]
 MH_CODES = ["MH401", "MH402", "MH403", "MH404", "MH405"]
 
 
